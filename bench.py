@@ -1,0 +1,96 @@
+"""Benchmark driver: prints ONE JSON line for the round harness.
+
+Config: BASELINE.json configs[0] — MLP 784-500-10 on MNIST, the reference's
+MultiLayerNetwork.fit hot loop (reference nn/multilayer/
+MultiLayerNetwork.java:1130). Metric: training examples/sec/chip.
+
+``vs_baseline`` compares against an ESTIMATED reference figure: the
+reference publishes no numbers (BASELINE.md), so we use 3000 examples/sec
+as a generous stand-in for 2015-era nd4j-native CPU throughput on this
+config; the real floor will be measured when the harness provides one.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_CPU_EXAMPLES_PER_SEC = 3000.0  # estimated; none published
+BATCH = 512
+WARMUP_STEPS = 5
+TIMED_STEPS = 50
+
+
+def main() -> None:
+    import jax
+
+    from deeplearning4j_tpu.datasets.mnist import mnist_dataset
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(12345)
+        .learning_rate(0.1)
+        .updater(Updater.NESTEROVS)
+        .momentum(0.9)
+        .list()
+        .layer(0, L.DenseLayer(n_in=784, n_out=500, activation="relu"))
+        .layer(
+            1,
+            L.OutputLayer(
+                n_in=500, n_out=10, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+
+    ds = mnist_dataset(train=True, num_examples=BATCH * 8)
+    batches = ds.batch_by(BATCH)
+
+    feats = [jax.numpy.asarray(b.features) for b in batches]
+    labels = [jax.numpy.asarray(b.labels) for b in batches]
+
+    def step(i: int):
+        k = i % len(feats)
+        net._key, sub = jax.random.split(net._key)
+        net.params, net.state, net.updater_state, score = net._train_step(
+            net.params, net.state, net.updater_state,
+            net.iteration, sub, feats[k], labels[k], None, None,
+        )
+        net.iteration += 1
+        return score
+
+    for i in range(WARMUP_STEPS):
+        score = step(i)
+    jax.block_until_ready(score)
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_STEPS):
+        score = step(i)
+    jax.block_until_ready(score)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = TIMED_STEPS * BATCH / dt
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_mlp_784_500_10_train_throughput",
+                "value": round(examples_per_sec, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(
+                    examples_per_sec / REFERENCE_CPU_EXAMPLES_PER_SEC, 2
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up re-design of the capabilities of 2015-era Deeplearning4j
+(reference: horanghi/deeplearning4j) for TPUs: configurations build *pure
+step functions* that are traced by ``jax.jit``/``pjit`` into single XLA
+computations, instead of the reference's eager op-by-op INDArray dispatch
+(see reference nn/multilayer/MultiLayerNetwork.java:1130 and SURVEY.md §3.1).
+
+Top-level surface mirrors the reference's public capability set:
+
+- :mod:`deeplearning4j_tpu.nn.conf` — builder-style, JSON-serializable
+  network configuration (reference nn/conf/NeuralNetConfiguration.java:52).
+- :mod:`deeplearning4j_tpu.nn` — Model/Layer runtime
+  (reference nn/api/Model.java:35, nn/api/Layer.java:37).
+- :mod:`deeplearning4j_tpu.optimize` — solver loop, updaters, listeners
+  (reference optimize/solvers/BaseOptimizer.java:55).
+- :mod:`deeplearning4j_tpu.datasets` — DataSet iterators, MNIST/Iris/CSV
+  (reference datasets/iterator/DataSetIterator.java:54).
+- :mod:`deeplearning4j_tpu.eval` — classification evaluation
+  (reference eval/Evaluation.java:38).
+- :mod:`deeplearning4j_tpu.parallel` — SPMD data/tensor/pipeline/sequence
+  parallelism over a ``jax.sharding.Mesh`` (replaces the reference's
+  Spark/Akka/YARN scale-out, SURVEY.md §2.7, with compiled XLA collectives).
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork

@@ -1,0 +1,17 @@
+"""Data pipeline: DataSet container, iterators, built-in datasets, records.
+
+Mirror of reference datasets/** (DataSetIterator.java:54, mnist/*,
+iterator/impl/*, canova adapters — SURVEY.md §2.4). Host-side, feeding
+device transfers; the AsyncDataSetIterator overlaps host prep with device
+compute exactly like the reference's prefetch thread.
+"""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+    TestDataSetIterator,
+)

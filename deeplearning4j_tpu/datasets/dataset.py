@@ -1,0 +1,116 @@
+"""DataSet: (features, labels, feature mask, label mask).
+
+Mirror of ND4J's DataSet as used throughout the reference (merge at
+IterativeReduceFlatMap.java:84, masks through MultiLayerNetwork.fit :1152).
+Numpy-backed on host; conversion to device arrays happens at the jit
+boundary.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DataSet:
+    def __init__(
+        self,
+        features,
+        labels,
+        features_mask=None,
+        labels_mask=None,
+    ):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = (
+            None if features_mask is None else np.asarray(features_mask)
+        )
+        self.labels_mask = (
+            None if labels_mask is None else np.asarray(labels_mask)
+        )
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def num_inputs(self) -> int:
+        return int(self.features.shape[1])
+
+    def num_outcomes(self) -> int:
+        return int(self.labels.shape[1])
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        """Concatenate along the example axis (reference DataSet.merge)."""
+
+        def cat(parts):
+            parts = [p for p in parts if p is not None]
+            return np.concatenate(parts, axis=0) if parts else None
+
+        return DataSet(
+            cat([d.features for d in datasets]),
+            cat([d.labels for d in datasets]),
+            cat([d.features_mask for d in datasets]),
+            cat([d.labels_mask for d in datasets]),
+        )
+
+    def split_test_and_train(
+        self, n_train: int
+    ) -> Tuple["DataSet", "DataSet"]:
+        return self.get_range(0, n_train), self.get_range(
+            n_train, self.num_examples()
+        )
+
+    def get_range(self, start: int, end: int) -> "DataSet":
+        sl = slice(start, end)
+        return DataSet(
+            self.features[sl],
+            self.labels[sl],
+            None if self.features_mask is None else self.features_mask[sl],
+            None if self.labels_mask is None else self.labels_mask[sl],
+        )
+
+    def sample(self, n: int, rng: Optional[np.random.Generator] = None) -> "DataSet":
+        rng = rng or np.random.default_rng()
+        idx = rng.choice(self.num_examples(), size=n, replace=False)
+        return self.get_examples(idx)
+
+    def get_examples(self, idx) -> "DataSet":
+        return DataSet(
+            self.features[idx],
+            self.labels[idx],
+            None if self.features_mask is None else self.features_mask[idx],
+            None if self.labels_mask is None else self.labels_mask[idx],
+        )
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        return [
+            self.get_range(i, min(i + batch_size, self.num_examples()))
+            for i in range(0, self.num_examples(), batch_size)
+        ]
+
+    def scale_0_1(self) -> None:
+        mn, mx = self.features.min(), self.features.max()
+        if mx > mn:
+            self.features = (self.features - mn) / (mx - mn)
+
+    def normalize_zero_mean_unit_variance(self) -> None:
+        mu = self.features.mean(axis=0, keepdims=True)
+        sd = self.features.std(axis=0, keepdims=True) + 1e-8
+        self.features = (self.features - mu) / sd
+
+    def __repr__(self) -> str:
+        return (
+            f"DataSet(features={self.features.shape}, "
+            f"labels={self.labels.shape})"
+        )

@@ -1,0 +1,323 @@
+"""DataSetIterator SPI + combinators.
+
+Mirror of reference datasets/iterator/** — DataSetIterator.java:54 contract
+(next(num), totalExamples, inputColumns, reset, preprocessor hook),
+AsyncDataSetIterator (background prefetch thread + blocking queue),
+MultipleEpochsIterator, SamplingDataSetIterator, ListDataSetIterator, and
+the TestDataSetIterator wrapper (datasets/test/TestDataSetIterator.java).
+
+Iterators are Python iterables of :class:`DataSet`; ``reset()`` rewinds.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base contract (reference DataSetIterator.java:54)."""
+
+    def __init__(self, batch_size: int = 10):
+        self.batch = batch_size
+        self.preprocessor: Optional[Callable[[DataSet], DataSet]] = None
+
+    # -- iteration ------------------------------------------------------
+    def __iter__(self) -> "DataSetIterator":
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        ds = self.next()
+        if ds is None:
+            raise StopIteration
+        return ds
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    # -- metadata -------------------------------------------------------
+    def total_examples(self) -> int:
+        raise NotImplementedError
+
+    def input_columns(self) -> int:
+        raise NotImplementedError
+
+    def total_outcomes(self) -> int:
+        raise NotImplementedError
+
+    def set_preprocessor(self, fn: Callable[[DataSet], DataSet]) -> None:
+        self.preprocessor = fn
+
+    def _post(self, ds: Optional[DataSet]) -> Optional[DataSet]:
+        if ds is not None and self.preprocessor is not None:
+            ds = self.preprocessor(ds)
+        return ds
+
+
+class BaseDataSetIterator(DataSetIterator):
+    """Cursor-over-in-memory-arrays base (reference BaseDatasetIterator +
+    fetcher split)."""
+
+    def __init__(self, batch_size: int, dataset: DataSet):
+        super().__init__(batch_size)
+        self._data = dataset
+        self._cursor = 0
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        n = num or self.batch
+        if self._cursor >= self._data.num_examples():
+            return None
+        ds = self._data.get_range(
+            self._cursor, min(self._cursor + n, self._data.num_examples())
+        )
+        self._cursor += n
+        return self._post(ds)
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def total_examples(self) -> int:
+        return self._data.num_examples()
+
+    def input_columns(self) -> int:
+        return self._data.num_inputs()
+
+    def total_outcomes(self) -> int:
+        return self._data.num_outcomes()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a pre-built list of DataSets (reference
+    ListDataSetIterator)."""
+
+    def __init__(self, datasets: Iterable[DataSet], batch_size: int = 0):
+        datasets = list(datasets)
+        if batch_size and batch_size > 0:
+            merged = DataSet.merge(datasets)
+            datasets = merged.batch_by(batch_size)
+        super().__init__(batch_size or (len(datasets) and datasets[0].num_examples()) or 1)
+        self._list: List[DataSet] = datasets
+        self._idx = 0
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        if self._idx >= len(self._list):
+            return None
+        ds = self._list[self._idx]
+        self._idx += 1
+        return self._post(ds)
+
+    def reset(self) -> None:
+        self._idx = 0
+
+    def total_examples(self) -> int:
+        return sum(d.num_examples() for d in self._list)
+
+    def input_columns(self) -> int:
+        return self._list[0].num_inputs()
+
+    def total_outcomes(self) -> int:
+        return self._list[0].num_outcomes()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded blocking queue (reference
+    AsyncDataSetIterator). Overlaps host-side batch preparation with device
+    compute — the 2015 pattern that anticipates tf.data/grain prefetch."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+        super().__init__(base.batch)
+        self._base = base
+        self._queue_size = queue_size
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def _start(self) -> None:
+        self._stop()
+        self._base.reset()
+        # The queue and stop-event are bound into the worker closure, so a
+        # stale worker from before a reset() can never feed the new epoch's
+        # queue. (It does still share self._base: a worker surviving the
+        # join timeout — base.next() blocked >5s — could race the new
+        # worker's cursor, a limitation shared with the reference's
+        # AsyncDataSetIterator thread shutdown.)
+        q: queue.Queue = queue.Queue(maxsize=self._queue_size)
+        stop = threading.Event()
+        self._queue = q
+        self._stop_event = stop
+        self._error = None
+
+        def worker():
+            try:
+                while not stop.is_set():
+                    ds = self._base.next()
+                    if ds is None:
+                        break
+                    while not stop.is_set():
+                        try:
+                            q.put(ds, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except BaseException as e:  # surfaced on the consumer side
+                self._error = e
+            finally:
+                # Deliver the sentinel unless we were told to stop (in which
+                # case the consumer is draining, not reading).
+                while not stop.is_set():
+                    try:
+                        q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def _stop(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            self._stop_event.set()
+            # Drain so a producer blocked on put() can observe the event.
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5)
+        self._thread = None
+        self._queue = None
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        if self._queue is None:
+            self._start()
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            self._queue = None
+            self._thread = None
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            return None
+        return self._post(item)
+
+    def reset(self) -> None:
+        self._start()
+
+    def total_examples(self) -> int:
+        return self._base.total_examples()
+
+    def input_columns(self) -> int:
+        return self._base.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self._base.total_outcomes()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replay a base iterator N times (reference MultipleEpochsIterator)."""
+
+    def __init__(self, num_epochs: int, base: DataSetIterator):
+        super().__init__(base.batch)
+        self._base = base
+        self.num_epochs = num_epochs
+        self._epoch = 0
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        ds = self._base.next(num)
+        if ds is None:
+            self._epoch += 1
+            if self._epoch >= self.num_epochs:
+                return None
+            self._base.reset()
+            ds = self._base.next(num)
+        return self._post(ds)
+
+    def reset(self) -> None:
+        self._epoch = 0
+        self._base.reset()
+
+    def total_examples(self) -> int:
+        return self._base.total_examples() * self.num_epochs
+
+    def input_columns(self) -> int:
+        return self._base.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self._base.total_outcomes()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample batches with replacement from one DataSet (reference
+    SamplingDataSetIterator)."""
+
+    def __init__(
+        self,
+        dataset: DataSet,
+        batch_size: int,
+        total_num_samples: int,
+        seed: int = 123,
+    ):
+        super().__init__(batch_size)
+        self._data = dataset
+        self._total = total_num_samples
+        self._given = 0
+        self._rng = np.random.default_rng(seed)
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        n = num or self.batch
+        if self._given >= self._total:
+            return None
+        idx = self._rng.integers(0, self._data.num_examples(), size=n)
+        self._given += n
+        return self._post(self._data.get_examples(idx))
+
+    def reset(self) -> None:
+        self._given = 0
+
+    def total_examples(self) -> int:
+        return self._total
+
+    def input_columns(self) -> int:
+        return self._data.num_inputs()
+
+    def total_outcomes(self) -> int:
+        return self._data.num_outcomes()
+
+
+class TestDataSetIterator(DataSetIterator):
+    """Wrapper that tracks call counts for iterator-contract tests
+    (reference datasets/test/TestDataSetIterator.java)."""
+
+    def __init__(self, base: DataSetIterator):
+        super().__init__(base.batch)
+        self._base = base
+        self.next_calls = 0
+        self.reset_calls = 0
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        self.next_calls += 1
+        return self._post(self._base.next(num))
+
+    def reset(self) -> None:
+        self.reset_calls += 1
+        self._base.reset()
+
+    def total_examples(self) -> int:
+        return self._base.total_examples()
+
+    def input_columns(self) -> int:
+        return self._base.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self._base.total_outcomes()

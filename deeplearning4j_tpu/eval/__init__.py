@@ -1,0 +1,6 @@
+"""Evaluation: classification metrics with distributed merge.
+
+Mirror of reference eval/** (Evaluation.java:38, ConfusionMatrix).
+"""
+
+from deeplearning4j_tpu.eval.evaluation import ConfusionMatrix, Evaluation

@@ -1,0 +1,3 @@
+"""Gradient checking (finite differences vs analytic autodiff)."""
+
+from deeplearning4j_tpu.gradientcheck.gradient_check_util import check_gradients

@@ -1,0 +1,6 @@
+"""NN core: configuration, layers, parameters, updaters, networks.
+
+Mirror of the reference's ``org.deeplearning4j.nn`` package
+(reference deeplearning4j-core/src/main/java/org/deeplearning4j/nn,
+SURVEY.md §2.2) redesigned around pure functions and pytrees.
+"""

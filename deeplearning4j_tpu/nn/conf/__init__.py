@@ -1,0 +1,20 @@
+"""Configuration system: JSON-serializable beans + fluent builders.
+
+Mirror of reference nn/conf (NeuralNetConfiguration.java:52,
+MultiLayerConfiguration.java, nn/conf/layers/*.java). Configurations are
+frozen-ish dataclasses with polymorphic JSON serde; the JSON is the wire
+format for distributed training exactly as in the reference
+(SparkDl4jMultiLayer ships conf.toJson() to executors, reference
+spark/.../SparkDl4jMultiLayer.java:319).
+"""
+
+from deeplearning4j_tpu.nn.conf.neural_net import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf import layers
+from deeplearning4j_tpu.nn.conf import preprocessors
+from deeplearning4j_tpu.nn.conf.enums import (
+    BackpropType,
+    GradientNormalization,
+    OptimizationAlgorithm,
+    Updater,
+)

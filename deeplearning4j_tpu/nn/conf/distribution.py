@@ -1,0 +1,57 @@
+"""Weight-init distributions as config beans.
+
+Mirror of the reference's ``nn/conf/distribution`` beans backing
+``WeightInit.DISTRIBUTION`` (reference nn/weights/WeightInitUtil.java uses
+``Nd4j.getDistributions()``). Sampling here is a stateless ``jax.random``
+draw from a threaded key — the TPU-native replacement for ND4J's stateful
+device RNG (SURVEY.md §2.9 RNG row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.serde import register_bean
+
+
+@register_bean("NormalDistribution")
+@dataclasses.dataclass
+class NormalDistribution:
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return self.mean + self.std * jax.random.normal(key, shape, dtype)
+
+
+@register_bean("UniformDistribution")
+@dataclasses.dataclass
+class UniformDistribution:
+    lower: float = -1.0
+    upper: float = 1.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return jax.random.uniform(
+            key, shape, dtype, minval=self.lower, maxval=self.upper
+        )
+
+
+@register_bean("BinomialDistribution")
+@dataclasses.dataclass
+class BinomialDistribution:
+    number_of_trials: int = 1
+    probability_of_success: float = 0.5
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        draws = jax.random.bernoulli(
+            key,
+            self.probability_of_success,
+            (self.number_of_trials,) + tuple(shape),
+        )
+        return jnp.sum(draws, axis=0).astype(dtype)
+
+
+Distribution = NormalDistribution | UniformDistribution | BinomialDistribution

@@ -1,0 +1,165 @@
+"""Input preprocessors: shape adapters between heterogeneous layers.
+
+Mirror of reference nn/conf/preprocessor/*.java (13 beans, applied in
+MultiLayerNetwork.calcBackpropGradients :1229-1252). In the reference each
+preprocessor implements both ``preProcess`` and ``backprop`` (the reshape
+adjoint); here only the forward reshape is written — the backward pass falls
+out of ``jax.grad`` over the traced step function.
+
+Layout conventions (same as reference): feed-forward [N, C]; CNN
+[N, C, H, W]; RNN [N, C, T].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.serde import register_bean
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class InputPreProcessor:
+    def pre_process(self, x: Array, rng: Optional[Array] = None) -> Array:
+        raise NotImplementedError
+
+
+@register_bean("CnnToFeedForwardPreProcessor")
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def pre_process(self, x, rng=None):
+        return x.reshape(x.shape[0], -1)
+
+
+@register_bean("FeedForwardToCnnPreProcessor")
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 1
+
+    def pre_process(self, x, rng=None):
+        if x.ndim == 4:
+            return x
+        return x.reshape(
+            x.shape[0], self.num_channels, self.input_height, self.input_width
+        )
+
+
+@register_bean("RnnToFeedForwardPreProcessor")
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[N, C, T] -> [N*T, C] (reference RnnToFeedForwardPreProcessor)."""
+
+    def pre_process(self, x, rng=None):
+        return jnp.transpose(x, (0, 2, 1)).reshape(-1, x.shape[1])
+
+
+@register_bean("FeedForwardToRnnPreProcessor")
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[N*T, C] -> [N, C, T]; needs the minibatch size captured at trace
+    time via ``miniBatchSize`` (reference passes it through preProcess)."""
+
+    minibatch_size: int = 0
+
+    def pre_process(self, x, rng=None):
+        n = self.minibatch_size or 1
+        t = x.shape[0] // n
+        return jnp.transpose(x.reshape(n, t, x.shape[1]), (0, 2, 1))
+
+
+@register_bean("CnnToRnnPreProcessor")
+@dataclasses.dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+    minibatch_size: int = 0
+
+    def pre_process(self, x, rng=None):
+        # [N*T, C, H, W] -> [N, C*H*W, T]
+        n = self.minibatch_size or 1
+        t = x.shape[0] // n
+        flat = x.reshape(n, t, -1)
+        return jnp.transpose(flat, (0, 2, 1))
+
+
+@register_bean("RnnToCnnPreProcessor")
+@dataclasses.dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    input_height: int = 0
+    input_width: int = 0
+    num_channels: int = 0
+
+    def pre_process(self, x, rng=None):
+        # [N, C*H*W, T] -> [N*T, C, H, W]
+        n, _, t = x.shape
+        xt = jnp.transpose(x, (0, 2, 1)).reshape(
+            n * t, self.num_channels, self.input_height, self.input_width
+        )
+        return xt
+
+
+@register_bean("ReshapePreProcessor")
+@dataclasses.dataclass
+class ReshapePreProcessor(InputPreProcessor):
+    shape: Sequence[int] = ()
+
+    def pre_process(self, x, rng=None):
+        return x.reshape(tuple(self.shape))
+
+
+@register_bean("ZeroMeanPrePreProcessor")
+@dataclasses.dataclass
+class ZeroMeanPrePreProcessor(InputPreProcessor):
+    def pre_process(self, x, rng=None):
+        return x - jnp.mean(x, axis=0, keepdims=True)
+
+
+@register_bean("ZeroMeanAndUnitVariancePreProcessor")
+@dataclasses.dataclass
+class ZeroMeanAndUnitVariancePreProcessor(InputPreProcessor):
+    def pre_process(self, x, rng=None):
+        mu = jnp.mean(x, axis=0, keepdims=True)
+        sd = jnp.std(x, axis=0, keepdims=True) + 1e-8
+        return (x - mu) / sd
+
+
+@register_bean("UnitVarianceProcessor")
+@dataclasses.dataclass
+class UnitVarianceProcessor(InputPreProcessor):
+    def pre_process(self, x, rng=None):
+        return x / (jnp.std(x, axis=0, keepdims=True) + 1e-8)
+
+
+@register_bean("BinomialSamplingPreProcessor")
+@dataclasses.dataclass
+class BinomialSamplingPreProcessor(InputPreProcessor):
+    """Bernoulli-sample the input probabilities (reference
+    BinomialSamplingPreProcessor); identity when no rng key is threaded."""
+
+    def pre_process(self, x, rng=None):
+        if rng is None:
+            return x
+        return jax.random.bernoulli(rng, x).astype(x.dtype)
+
+
+@register_bean("ComposableInputPreProcessor")
+@dataclasses.dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    components: Sequence[InputPreProcessor] = ()
+
+    def pre_process(self, x, rng=None):
+        for p in self.components:
+            x = p.pre_process(x, rng)
+        return x

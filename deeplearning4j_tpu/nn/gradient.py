@@ -1,0 +1,50 @@
+"""Gradient container: ordered map paramName -> gradient array.
+
+Mirror of reference nn/gradient/{Gradient,DefaultGradient}.java. Keys use the
+reference's flat naming "<layerIdx>_<param>" (e.g. "0_W", "2_b" — see
+MultiLayerNetwork.calcBackpropGradients :1226,:1245) so gradient-check and
+updater tests can address parameters identically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+
+Array = jax.Array
+
+
+class Gradient:
+    def __init__(self, flat: Dict[str, Array] | None = None):
+        self._map: Dict[str, Array] = dict(flat or {})
+
+    @staticmethod
+    def from_tree(tree: Dict[str, Dict[str, Array]]) -> "Gradient":
+        flat = {}
+        for idx in sorted(tree, key=int):
+            for name, g in tree[idx].items():
+                flat[f"{idx}_{name}"] = g
+        return Gradient(flat)
+
+    def to_tree(self) -> Dict[str, Dict[str, Array]]:
+        tree: Dict[str, Dict[str, Array]] = {}
+        for key, g in self._map.items():
+            idx, name = key.split("_", 1)
+            tree.setdefault(idx, {})[name] = g
+        return tree
+
+    def gradient_for_variable(self, key: str) -> Array:
+        return self._map[key]
+
+    def set_gradient_for(self, key: str, value: Array) -> None:
+        self._map[key] = value
+
+    def gradient_map(self) -> Dict[str, Array]:
+        return dict(self._map)
+
+    def keys(self):
+        return self._map.keys()
+
+    def __iter__(self):
+        return iter(self._map.items())

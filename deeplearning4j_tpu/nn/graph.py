@@ -1,0 +1,455 @@
+"""ComputationGraph: DAG network runtime.
+
+Mirror of reference nn/graph/ComputationGraph.java:59 (1,598 LoC):
+topologicalSortOrder :593, computeGradientAndScore :656, feedForward :689,
+multi-input/multi-output fit. Same TPU inversion as MultiLayerNetwork: the
+whole DAG forward + multi-output loss + backward + update is one jitted XLA
+computation; vertex structure is resolved at trace time (static), so XLA
+sees a flat fused graph.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertex,
+    ElementWiseOp,
+    ElementWiseVertex,
+    LastTimeStepVertex,
+    LayerVertex,
+    MergeVertex,
+    PreprocessorVertex,
+    SubsetVertex,
+)
+from deeplearning4j_tpu.nn.gradient import Gradient
+from deeplearning4j_tpu.nn.layers import get_impl
+from deeplearning4j_tpu.nn.multilayer import _dtype_of, _REGULARIZED_KEYS
+from deeplearning4j_tpu.nn.updater.updaters import (
+    make_layer_updater,
+    normalize_gradients,
+    resolve_lr,
+)
+
+Array = jax.Array
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        conf.validate()
+        for out in conf.network_outputs:
+            v = conf.vertices[out]
+            if not (
+                isinstance(v, LayerVertex)
+                and isinstance(v.conf.layer, (L.BaseOutputLayer,))
+            ):
+                raise ValueError(
+                    f"Network output {out!r} must be an output layer vertex "
+                    "(OutputLayer/RnnOutputLayer) to compute a loss"
+                )
+        self.conf = conf
+        self.order = conf.topological_order()
+        self.params: Dict[str, Dict[str, Array]] = {}
+        self.state: Dict[str, Any] = {}
+        self.updater_state: Dict[str, Any] = {}
+        self.iteration = 0
+        self.score_value = float("nan")
+        self.listeners: List = []
+        self._layer_vertices = {
+            name: v
+            for name, v in conf.vertices.items()
+            if isinstance(v, LayerVertex)
+        }
+        self._impls = {
+            name: get_impl(v.conf.layer)
+            for name, v in self._layer_vertices.items()
+        }
+        self._updaters = {
+            name: make_layer_updater(v.conf)
+            for name, v in self._layer_vertices.items()
+        }
+        first = next(iter(self._layer_vertices.values()), None)
+        self._dtype = _dtype_of(first.conf.dtype if first else "float32")
+        seed = first.conf.seed if first else 12345
+        self._key = jax.random.key(seed)
+        self._seed = seed
+        self._initialized = False
+
+    # ------------------------------------------------------------------
+    def init(self) -> "ComputationGraph":
+        if self._initialized:
+            return self
+        key = jax.random.key(self._seed)
+        names = sorted(self._layer_vertices)
+        keys = jax.random.split(key, max(1, len(names)))
+        for k, name in zip(keys, names):
+            v = self._layer_vertices[name]
+            impl = self._impls[name]
+            self.params[name] = impl.init(k, v.conf, self._dtype)
+            st = impl.init_state(v.conf, self._dtype)
+            if st is not None:
+                self.state[name] = st
+            self.updater_state[name] = self._updaters[name].init(
+                self.params[name]
+            )
+        self._initialized = True
+        return self
+
+    # ------------------------------------------------------------------
+    def _forward_fn(
+        self,
+        params,
+        state,
+        inputs: Dict[str, Array],
+        rng,
+        train: bool,
+        masks: Optional[Dict[str, Array]] = None,
+    ):
+        """Topological-order forward. Returns (activation dict, new_state)."""
+        acts: Dict[str, Array] = dict(inputs)
+        new_state = dict(state) if state else {}
+        # Masks propagate along edges: a vertex inherits its first input's
+        # time mask, so stacked recurrent layers stay masked (parity with
+        # MultiLayerNetwork, which hands feature_mask to every recurrent
+        # layer). Time-collapsing vertices drop the mask.
+        vmasks: Dict[str, Optional[Array]] = dict(masks or {})
+        n_layers = max(1, len(self._layer_vertices))
+        if rng is not None:
+            layer_keys = dict(
+                zip(
+                    sorted(self._layer_vertices),
+                    jax.random.split(rng, n_layers),
+                )
+            )
+        else:
+            layer_keys = {}
+        for name in self.order:
+            vertex = self.conf.vertices[name]
+            in_names = self.conf.vertex_inputs[name]
+            xs = [acts[i] for i in in_names]
+            in_mask = vmasks.get(in_names[0])
+            if isinstance(vertex, LastTimeStepVertex):
+                vmasks[name] = None  # collapses the time axis
+            else:
+                vmasks[name] = in_mask
+            if isinstance(vertex, LayerVertex):
+                x = xs[0]
+                if vertex.preprocessor is not None:
+                    x = vertex.preprocessor.pre_process(
+                        x, layer_keys.get(name) if train else None
+                    )
+                impl = self._impls[name]
+                layer_state = new_state.get(name)
+                is_recurrent = isinstance(
+                    vertex.conf.layer, L.RECURRENT_LAYER_TYPES
+                )
+                mask = in_mask if is_recurrent else None
+                out, st = impl.apply(
+                    vertex.conf,
+                    params[name],
+                    x,
+                    state=layer_state,
+                    train=train,
+                    rng=layer_keys.get(name) if train else None,
+                    mask=mask,
+                )
+                if st is not None and name in new_state:
+                    new_state[name] = st
+                acts[name] = out
+            elif isinstance(vertex, MergeVertex):
+                acts[name] = jnp.concatenate(xs, axis=1)
+            elif isinstance(vertex, ElementWiseVertex):
+                acts[name] = _elementwise(vertex.op, xs)
+            elif isinstance(vertex, SubsetVertex):
+                acts[name] = xs[0][:, vertex.from_index : vertex.to_index + 1]
+            elif isinstance(vertex, PreprocessorVertex):
+                acts[name] = vertex.preprocessor.pre_process(xs[0])
+            elif isinstance(vertex, LastTimeStepVertex):
+                acts[name] = _last_time_step(
+                    xs[0], vmasks.get(vertex.mask_input)
+                )
+            elif isinstance(vertex, DuplicateToTimeSeriesVertex):
+                ref = acts[vertex.reference_input]
+                acts[name] = jnp.broadcast_to(
+                    xs[0][:, :, None],
+                    xs[0].shape + (ref.shape[-1],),
+                )
+            else:
+                raise ValueError(f"Unknown vertex type {type(vertex).__name__}")
+        return acts, new_state
+
+    def _loss_fn(self, params, state, rng, inputs, labels, masks, label_masks):
+        acts, new_state = self._forward_fn(
+            params, state, inputs, rng, True, masks
+        )
+        score = 0.0
+        for out_name, y in zip(self.conf.network_outputs, labels):
+            impl = self._impls[out_name]
+            v = self._layer_vertices[out_name]
+            lm = None if label_masks is None else label_masks.get(out_name)
+            score = score + impl.loss(v.conf, acts[out_name], y, lm)
+        score = score + self._reg_score(params)
+        return score, new_state
+
+    def _reg_score(self, params):
+        reg = 0.0
+        for name, v in self._layer_vertices.items():
+            c = v.conf
+            if not c.use_regularization:
+                continue
+            l1 = float(c.resolved("l1") or 0.0)
+            l2 = float(c.resolved("l2") or 0.0)
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for pname, p in params[name].items():
+                if pname not in _REGULARIZED_KEYS:
+                    continue
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(p))
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(p * p)
+        return reg
+
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _train_step(self):
+        def step(params, state, upd_state, iteration, rng, inputs, labels,
+                 masks, label_masks):
+            (score, new_state), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(params, state, rng, inputs, labels, masks, label_masks)
+            new_params = {}
+            new_upd = {}
+            for name, v in self._layer_vertices.items():
+                c = v.conf
+                g = normalize_gradients(
+                    c.resolved("gradient_normalization"),
+                    grads[name],
+                    float(c.resolved("gradient_normalization_threshold")),
+                )
+                updates, new_upd[name] = self._updaters[name].update(
+                    g, upd_state[name], resolve_lr(c, iteration), iteration
+                )
+                new_params[name] = jax.tree.map(
+                    lambda p, u: p - u, params[name], updates
+                )
+            return new_params, new_state, new_upd, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _output_fn(self):
+        def out(params, state, inputs):
+            acts, _ = self._forward_fn(params, state, inputs, None, False)
+            return [acts[name] for name in self.conf.network_outputs]
+
+        return jax.jit(out)
+
+    # ------------------------------------------------------------------
+    def _coerce_multi(self, data) -> Tuple[Dict[str, Array], List[Array], Optional[Dict], Optional[Dict]]:
+        """Accept DataSet (single in/out) or MultiDataSet-style tuples."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if isinstance(data, DataSet):
+            inputs = {
+                self.conf.network_inputs[0]: jnp.asarray(
+                    data.features, self._dtype
+                )
+            }
+            labels = [jnp.asarray(data.labels, self._dtype)]
+            masks = (
+                None
+                if data.features_mask is None
+                else {
+                    self.conf.network_inputs[0]: jnp.asarray(data.features_mask)
+                }
+            )
+            lmasks = (
+                None
+                if data.labels_mask is None
+                else {
+                    self.conf.network_outputs[0]: jnp.asarray(data.labels_mask)
+                }
+            )
+            return inputs, labels, masks, lmasks
+        features, labels = data  # (list-of-arrays, list-of-arrays)
+        inputs = {
+            n: jnp.asarray(f, self._dtype)
+            for n, f in zip(self.conf.network_inputs, features)
+        }
+        return inputs, [jnp.asarray(y, self._dtype) for y in labels], None, None
+
+    def fit(self, data, labels=None) -> None:
+        self.init()
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+        if labels is not None:
+            data = DataSet(data, labels)
+        if isinstance(data, DataSetIterator):
+            for ds in data:
+                self._fit_one(ds)
+        else:
+            self._fit_one(data)
+
+    def _fit_one(self, data) -> None:
+        inputs, labels, masks, lmasks = self._coerce_multi(data)
+        first_conf = next(iter(self._layer_vertices.values())).conf
+        n_iter = max(1, first_conf.num_iterations)
+        for _ in range(n_iter):
+            self._key, sub = jax.random.split(self._key)
+            (
+                self.params,
+                self.state,
+                self.updater_state,
+                score,
+            ) = self._train_step(
+                self.params, self.state, self.updater_state,
+                self.iteration, sub, inputs, labels, masks, lmasks,
+            )
+            self.score_value = score
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------------
+    def output(self, *features) -> List[Array]:
+        self.init()
+        inputs = {
+            n: jnp.asarray(f, self._dtype)
+            for n, f in zip(self.conf.network_inputs, features)
+        }
+        return self._output_fn(self.params, self.state, inputs)
+
+    def feed_forward(self, *features) -> Dict[str, Array]:
+        self.init()
+        inputs = {
+            n: jnp.asarray(f, self._dtype)
+            for n, f in zip(self.conf.network_inputs, features)
+        }
+        acts, _ = self._forward_fn(self.params, self.state, inputs, None, False)
+        return acts
+
+    def score(self, data=None) -> float:
+        if data is None:
+            return float(self.score_value)
+        self.init()
+        inputs, labels, masks, lmasks = self._coerce_multi(data)
+        s, _ = self._loss_fn(
+            self.params, self.state, None, inputs, labels, masks, lmasks
+        )
+        return float(s)
+
+    def compute_gradient_and_score(self, data) -> Tuple[float, Gradient]:
+        self.init()
+        inputs, labels, masks, lmasks = self._coerce_multi(data)
+        (score, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+            self.params, self.state, None, inputs, labels, masks, lmasks
+        )
+        flat = {}
+        for name in sorted(grads):
+            for pname, g in grads[name].items():
+                flat[f"{name}_{pname}"] = g
+        return float(score), Gradient(flat)
+
+    def evaluate(self, data_iter):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        self.init()
+        ev = Evaluation()
+        for ds in data_iter:
+            out = self.output(ds.features)[0]
+            if np.asarray(ds.labels).ndim == 3:
+                ev.eval_time_series(ds.labels, out, ds.labels_mask)
+            else:
+                ev.eval(ds.labels, out)
+        return ev
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    # ------------------------------------------------------------------
+    def params_flat(self) -> Array:
+        flat, _ = ravel_pytree(self.params)
+        return flat
+
+    def num_params(self) -> int:
+        return int(self.params_flat().shape[0])
+
+    def save(self, path: str) -> None:
+        self.init()
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "conf.json"), "w") as f:
+            f.write(self.conf.to_json())
+        with open(os.path.join(path, "params.pkl"), "wb") as f:
+            pickle.dump(jax.tree.map(np.asarray, self.params), f)
+        extras = {
+            "updater_state": jax.tree.map(np.asarray, self.updater_state),
+            "state": jax.tree.map(np.asarray, self.state),
+            "iteration": self.iteration,
+        }
+        with open(os.path.join(path, "updater.pkl"), "wb") as f:
+            pickle.dump(extras, f)
+
+    @staticmethod
+    def load(path: str) -> "ComputationGraph":
+        with open(os.path.join(path, "conf.json")) as f:
+            conf = ComputationGraphConfiguration.from_json(f.read())
+        net = ComputationGraph(conf).init()
+        with open(os.path.join(path, "params.pkl"), "rb") as f:
+            net.params = jax.tree.map(jnp.asarray, pickle.load(f))
+        upath = os.path.join(path, "updater.pkl")
+        if os.path.exists(upath):
+            with open(upath, "rb") as f:
+                extras = pickle.load(f)
+            net.updater_state = jax.tree.map(jnp.asarray, extras["updater_state"])
+            net.state = jax.tree.map(jnp.asarray, extras["state"])
+            net.iteration = int(extras["iteration"])
+        return net
+
+
+def _elementwise(op: ElementWiseOp, xs: Sequence[Array]) -> Array:
+    if op == ElementWiseOp.ADD:
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+    if op == ElementWiseOp.SUBTRACT:
+        if len(xs) != 2:
+            raise ValueError("SUBTRACT requires exactly 2 inputs")
+        return xs[0] - xs[1]
+    if op == ElementWiseOp.PRODUCT:
+        out = xs[0]
+        for x in xs[1:]:
+            out = out * x
+        return out
+    if op == ElementWiseOp.AVERAGE:
+        return sum(xs) / len(xs)
+    if op == ElementWiseOp.MAX:
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.maximum(out, x)
+        return out
+    raise ValueError(f"Unknown elementwise op {op}")
+
+
+def _last_time_step(x: Array, mask: Optional[Array]) -> Array:
+    if mask is None:
+        return x[:, :, -1]
+    # Index of last nonzero mask entry per example.
+    idx = (
+        mask.shape[1]
+        - 1
+        - jnp.argmax(jnp.flip(mask, axis=1) > 0, axis=1)
+    ).astype(jnp.int32)
+    return jnp.take_along_axis(x, idx[:, None, None], axis=2)[:, :, 0]

@@ -1,0 +1,68 @@
+"""Shared layer-impl machinery: dropout, dropconnect, activation resolution.
+
+Reference counterparts: nn/layers/BaseLayer.java (preOutput :327, activate
+:337-352, dropout hook :424-428) and util/Dropout.java (applyDropout :32 —
+inverted dropout with a Bernoulli mask; applyDropConnect :20).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.activations import activation as act_fn
+
+Array = jax.Array
+
+
+def apply_dropout(x: Array, rate: float, rng: Optional[Array]) -> Array:
+    """Inverted dropout on input activations (reference Dropout.applyDropout
+    :32). ``rate`` is the DROP probability, matching the reference's
+    ``dropOut`` semantics. No-op when rng is None (inference)."""
+    if rate <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def apply_dropconnect(w: Array, rate: float, rng: Optional[Array]) -> Array:
+    """DropConnect on a weight matrix (reference Dropout.applyDropConnect)."""
+    if rate <= 0.0 or rng is None:
+        return w
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, w.shape)
+    return jnp.where(mask, w / keep, 0.0).astype(w.dtype)
+
+
+class LayerImplBase:
+    """Default no-param, identity-state implementation skeleton."""
+
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        return {}
+
+    @classmethod
+    def init_state(cls, conf, dtype=jnp.float32):
+        return None
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None, mask=None):
+        raise NotImplementedError
+
+    # -- helpers -------------------------------------------------------
+    @staticmethod
+    def activation_of(conf):
+        return act_fn(conf.resolved("activation"))
+
+    @staticmethod
+    def dropout_of(conf) -> float:
+        return float(conf.resolved("dropout") or 0.0)
+
+    @staticmethod
+    def maybe_dropout(conf, x, train, rng):
+        if train and rng is not None:
+            return apply_dropout(x, LayerImplBase.dropout_of(conf), rng)
+        return x

@@ -1,0 +1,79 @@
+"""Convolution + subsampling (pooling) layers.
+
+Reference: nn/layers/convolution/ConvolutionLayer.java (conv2d as im2col +
+GEMM, :135 forward, :109 backward col2im) and SubsamplingLayer.java (max/avg
+pooling). TPU-native inversion (SURVEY.md §2.9): convolution is
+``lax.conv_general_dilated``, which XLA tiles directly onto the MXU — no
+explicit im2col materialization; pooling is ``lax.reduce_window``.
+
+Layouts: activations [N, C, H, W]; kernels [O, I, kH, kW] (OIHW).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.layers import PoolingType
+from deeplearning4j_tpu.nn.layers.base import LayerImplBase
+from deeplearning4j_tpu.nn.weights import init_weights
+
+_DIMSPEC = ("NCHW", "OIHW", "NCHW")
+
+
+class ConvolutionImpl(LayerImplBase):
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        lc = conf.layer
+        kh, kw = lc.kernel_size
+        w = init_weights(
+            key,
+            (lc.n_out, lc.n_in, kh, kw),
+            conf.resolved("weight_init"),
+            conf.resolved("dist"),
+            dtype,
+        )
+        b = jnp.full((lc.n_out,), conf.resolved("bias_init"), dtype)
+        return {"W": w, "b": b}
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None, mask=None):
+        lc = conf.layer
+        x = cls.maybe_dropout(conf, x, train, rng)
+        ph, pw = lc.padding
+        z = lax.conv_general_dilated(
+            x,
+            params["W"],
+            window_strides=tuple(lc.stride),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=_DIMSPEC,
+        )
+        z = z + params["b"][None, :, None, None]
+        return cls.activation_of(conf)(z), state
+
+
+class SubsamplingImpl(LayerImplBase):
+    """Parameter-free spatial pooling (reference SubsamplingLayer.java)."""
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None, mask=None):
+        lc = conf.layer
+        kh, kw = lc.kernel_size
+        sh, sw = lc.stride
+        ph, pw = lc.padding
+        window = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if lc.pooling_type == PoolingType.MAX:
+            out = lax.reduce_window(
+                x, -jnp.inf, lax.max, window, strides, padding
+            )
+        elif lc.pooling_type == PoolingType.SUM:
+            out = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        elif lc.pooling_type == PoolingType.AVG:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            out = s / float(kh * kw)
+        else:
+            raise ValueError(f"Unknown pooling type {lc.pooling_type}")
+        return out, state

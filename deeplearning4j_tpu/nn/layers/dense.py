@@ -1,0 +1,51 @@
+"""Dense + Output layer implementations.
+
+Reference: nn/layers/feedforward/dense/DenseLayer.java over BaseLayer
+(preOutput = input.mmul(W).addiRowVector(b), BaseLayer.java:327) and
+nn/layers/OutputLayer.java / BaseOutputLayer.java (439 LoC: loss function +
+labels). The matmul is the MXU hot path; XLA fuses the bias add and
+activation into the GEMM epilogue.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import LayerImplBase, apply_dropconnect
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.losses import loss_fn
+import jax
+
+
+class DenseImpl(LayerImplBase):
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        lc = conf.layer
+        wkey, _ = jax.random.split(key)
+        w = init_weights(
+            wkey,
+            (lc.n_in, lc.n_out),
+            conf.resolved("weight_init"),
+            conf.resolved("dist"),
+            dtype,
+        )
+        b = jnp.full((lc.n_out,), conf.resolved("bias_init"), dtype)
+        return {"W": w, "b": b}
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None, mask=None):
+        x = cls.maybe_dropout(conf, x, train, rng)
+        w = params["W"]
+        if train and rng is not None and conf.use_drop_connect:
+            w = apply_dropconnect(w, cls.dropout_of(conf), rng)
+        z = x @ w + params["b"]
+        return cls.activation_of(conf)(z), state
+
+
+class OutputImpl(DenseImpl):
+    """Dense layer whose conf carries the loss function; scoring happens in
+    the network-level loss (reference BaseOutputLayer.computeScore)."""
+
+    @classmethod
+    def loss(cls, conf, activations, labels, mask=None):
+        return loss_fn(conf.layer.loss_function)(activations, labels, mask)

@@ -1,0 +1,186 @@
+"""Pretrainable layers: RBM (contrastive divergence) + (denoising) AutoEncoder.
+
+Reference: nn/layers/feedforward/rbm/RBM.java (contrastiveDivergence :101,
+computeGradientAndScore CD-k :110-178, sampleHiddenGivenVisible :225,
+gibbhVh :267; BINARY/GAUSSIAN/RECTIFIED/SOFTMAX unit kinds) and
+nn/layers/feedforward/autoencoder/AutoEncoder.java. The reference's stateful
+device RNG (RBM.java:236,:251) becomes explicit ``jax.random`` keys threaded
+through the Gibbs chain; the whole CD-k update is one jitted computation.
+
+CD-k is not the gradient of a tractable loss, so ``RBMImpl`` provides
+``pretrain_value_and_grad`` directly instead of a loss for autodiff.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import HiddenUnit, VisibleUnit
+from deeplearning4j_tpu.nn.layers.base import LayerImplBase
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.losses import loss_fn
+
+Array = jax.Array
+
+
+class RBMImpl(LayerImplBase):
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        lc = conf.layer
+        w = init_weights(
+            key,
+            (lc.n_in, lc.n_out),
+            conf.resolved("weight_init"),
+            conf.resolved("dist"),
+            dtype,
+        )
+        b = jnp.full((lc.n_out,), conf.resolved("bias_init"), dtype)
+        vb = jnp.full((lc.n_in,), lc.visible_bias_init, dtype)
+        return {"W": w, "b": b, "vb": vb}
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None, mask=None):
+        x = cls.maybe_dropout(conf, x, train, rng)
+        z = x @ params["W"] + params["b"]
+        return cls.activation_of(conf)(z), state
+
+    # ------------------------------------------------------------------
+    # CD-k machinery
+    # ------------------------------------------------------------------
+    @classmethod
+    def _hidden_mean(cls, conf, params, v):
+        z = v @ params["W"] + params["b"]
+        hu = conf.layer.hidden_unit
+        if hu == HiddenUnit.BINARY:
+            return jax.nn.sigmoid(z)
+        if hu == HiddenUnit.GAUSSIAN:
+            return z
+        if hu == HiddenUnit.RECTIFIED:
+            return jax.nn.relu(z)
+        if hu == HiddenUnit.SOFTMAX:
+            return jax.nn.softmax(z, axis=-1)
+        raise ValueError(hu)
+
+    @classmethod
+    def _sample_hidden(cls, conf, params, v, key):
+        mean = cls._hidden_mean(conf, params, v)
+        hu = conf.layer.hidden_unit
+        if hu == HiddenUnit.BINARY:
+            return mean, jax.random.bernoulli(key, mean).astype(v.dtype)
+        if hu == HiddenUnit.GAUSSIAN:
+            return mean, mean + jax.random.normal(key, mean.shape, v.dtype)
+        if hu == HiddenUnit.RECTIFIED:
+            # NReLU sampling: max(0, mean + N(0, sigmoid(mean))).
+            noise = jax.random.normal(key, mean.shape, v.dtype)
+            return mean, jax.nn.relu(
+                mean + noise * jnp.sqrt(jax.nn.sigmoid(mean) + 1e-8)
+            )
+        if hu == HiddenUnit.SOFTMAX:
+            return mean, mean
+        raise ValueError(hu)
+
+    @classmethod
+    def _visible_mean(cls, conf, params, h):
+        z = h @ params["W"].T + params["vb"]
+        vu = conf.layer.visible_unit
+        if vu == VisibleUnit.BINARY:
+            return jax.nn.sigmoid(z)
+        if vu in (VisibleUnit.GAUSSIAN, VisibleUnit.LINEAR):
+            return z
+        if vu == VisibleUnit.SOFTMAX:
+            return jax.nn.softmax(z, axis=-1)
+        raise ValueError(vu)
+
+    @classmethod
+    def _sample_visible(cls, conf, params, h, key):
+        mean = cls._visible_mean(conf, params, h)
+        vu = conf.layer.visible_unit
+        if vu == VisibleUnit.BINARY:
+            return mean, jax.random.bernoulli(key, mean).astype(h.dtype)
+        if vu == VisibleUnit.GAUSSIAN:
+            return mean, mean + jax.random.normal(key, mean.shape, h.dtype)
+        return mean, mean
+
+    @classmethod
+    def pretrain_value_and_grad(cls, conf, params, x, rng):
+        """One CD-k estimate: (score, grads) with grads oriented for
+        gradient DESCENT (params -= lr * grad), matching the reference's
+        sign handling in RBM.computeGradientAndScore :140-178."""
+        lc = conf.layer
+        k = max(1, lc.k)
+        n = x.shape[0]
+
+        key0, key_chain = jax.random.split(rng)
+        h0_mean, h0_sample = cls._sample_hidden(conf, params, x, key0)
+
+        def gibbs_step(carry, key):
+            h_sample = carry
+            kv, kh = jax.random.split(key)
+            v_mean, v_sample = cls._sample_visible(conf, params, h_sample, kv)
+            h_mean, h_new = cls._sample_hidden(conf, params, v_sample, kh)
+            return h_new, (v_mean, v_sample, h_mean)
+
+        keys = jax.random.split(key_chain, k)
+        _, (v_means, v_samples, h_means) = jax.lax.scan(
+            gibbs_step, h0_sample, keys
+        )
+        vk_mean, vk = v_means[-1], v_samples[-1]
+        hk_mean = h_means[-1]
+
+        w_grad = -(x.T @ h0_mean - vk.T @ hk_mean) / n
+        hb_grad = -jnp.mean(h0_mean - hk_mean, axis=0)
+        vb_grad = -jnp.mean(x - vk, axis=0)
+        score = loss_fn(lc.loss_function)(vk_mean, x)
+        return score, {"W": w_grad, "b": hb_grad, "vb": vb_grad}
+
+
+class AutoEncoderImpl(LayerImplBase):
+    """Denoising autoencoder with tied decode weights (reference
+    AutoEncoder.java; corruption via ``corruption_level`` Bernoulli mask)."""
+
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        lc = conf.layer
+        w = init_weights(
+            key,
+            (lc.n_in, lc.n_out),
+            conf.resolved("weight_init"),
+            conf.resolved("dist"),
+            dtype,
+        )
+        b = jnp.full((lc.n_out,), conf.resolved("bias_init"), dtype)
+        vb = jnp.full((lc.n_in,), lc.visible_bias_init, dtype)
+        return {"W": w, "b": b, "vb": vb}
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None, mask=None):
+        x = cls.maybe_dropout(conf, x, train, rng)
+        z = x @ params["W"] + params["b"]
+        return cls.activation_of(conf)(z), state
+
+    @classmethod
+    def pretrain_loss(cls, conf, params, x, rng):
+        lc = conf.layer
+        act = cls.activation_of(conf)
+        corrupted = x
+        if lc.corruption_level > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - lc.corruption_level, x.shape)
+            corrupted = x * keep.astype(x.dtype)
+        h = act(corrupted @ params["W"] + params["b"])
+        recon = act(h @ params["W"].T + params["vb"])
+        score = loss_fn(lc.loss_function)(recon, x)
+        if getattr(lc, "sparsity", 0.0):
+            rho, rho_hat = lc.sparsity, jnp.mean(h, axis=0)
+            eps = 1e-7
+            kl = rho * jnp.log(rho / (rho_hat + eps)) + (1 - rho) * jnp.log(
+                (1 - rho) / (1 - rho_hat + eps)
+            )
+            score = score + jnp.sum(kl)
+        return score
+
+    @classmethod
+    def pretrain_value_and_grad(cls, conf, params, x, rng):
+        return jax.value_and_grad(
+            lambda p: cls.pretrain_loss(conf, p, x, rng)
+        )(params)

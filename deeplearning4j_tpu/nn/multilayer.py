@@ -1,0 +1,537 @@
+"""MultiLayerNetwork: the sequential-stack network.
+
+Mirror of reference nn/multilayer/MultiLayerNetwork.java:67 (2,343 LoC):
+init() :335, fit(DataSetIterator) :1130, feedForward :578-715, backprop
+:1176, pretrain :150, doTruncatedBPTT :1262, params pack/unpack :984-1063.
+
+TPU-native inversion (SURVEY.md §3.1 takeaway): where the reference runs
+eager op-by-op INDArray dispatch with a JVM->JNI->BLAS crossing per op, here
+the entire train step — forward, loss, backward (``jax.value_and_grad``),
+gradient normalization, updater — is ONE jitted XLA computation, compiled
+once per (shape, dtype) and cached. Backprop is never hand-written; the
+per-parameter gradient map ("0_W", "1_b", ...) is recovered from the pytree
+for updater/gradient-check parity.
+"""
+
+from __future__ import annotations
+
+import functools
+import io
+import json
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.enums import BackpropType, OptimizationAlgorithm
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.gradient import Gradient
+from deeplearning4j_tpu.nn.layers import get_impl
+from deeplearning4j_tpu.nn.updater.updaters import (
+    make_layer_updater,
+    normalize_gradients,
+    resolve_lr,
+)
+
+Array = jax.Array
+
+
+def _dtype_of(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16, "float64": jnp.float64}[name]
+
+
+_REGULARIZED_KEYS = ("W", "RW", "W_bwd", "RW_bwd")
+
+
+class MultiLayerNetwork:
+    """Sequential network over layer conf beans.
+
+    Also usable as a building block the way the reference's
+    MultiLayerNetwork implements ``Layer`` (nn/api/Layer.java nesting).
+    """
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.params: Dict[str, Dict[str, Array]] = {}
+        self.state: Dict[str, Any] = {}
+        self.updater_state: Dict[str, Any] = {}
+        self.iteration = 0
+        self.score_value = float("nan")
+        self.listeners: List = []
+        self._impls = [get_impl(c.layer) for c in conf.confs]
+        self._updaters = [make_layer_updater(c) for c in conf.confs]
+        self._rnn_state: Dict[str, Any] = {}
+        self._initialized = False
+        self._dtype = _dtype_of(conf.dtype)
+        self._key = jax.random.key(conf.seed)
+
+    # ------------------------------------------------------------------
+    # Initialization (reference init() :335-370)
+    # ------------------------------------------------------------------
+    def init(self) -> "MultiLayerNetwork":
+        if self._initialized:
+            return self
+        key = jax.random.key(self.conf.seed)
+        n = len(self.conf.confs)
+        keys = jax.random.split(key, n)
+        for i, (c, impl) in enumerate(zip(self.conf.confs, self._impls)):
+            self.params[str(i)] = impl.init(keys[i], c, self._dtype)
+            st = impl.init_state(c, self._dtype)
+            if st is not None:
+                self.state[str(i)] = st
+        for i, upd in enumerate(self._updaters):
+            self.updater_state[str(i)] = upd.init(self.params[str(i)])
+        self._initialized = True
+        return self
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.conf.confs)
+
+    # ------------------------------------------------------------------
+    # Pure functional forward (traced under jit)
+    # ------------------------------------------------------------------
+    def _forward_fn(
+        self,
+        params,
+        state,
+        x,
+        rng,
+        train: bool,
+        feature_mask=None,
+        rnn_state=None,
+        collect: bool = False,
+    ):
+        """Returns (final_or_all_activations, new_state, new_rnn_state)."""
+        acts = []
+        new_state = dict(state) if state else {}
+        new_rnn = {}
+        rngs = (
+            jax.random.split(rng, self.n_layers)
+            if rng is not None
+            else [None] * self.n_layers
+        )
+        for i, (c, impl) in enumerate(zip(self.conf.confs, self._impls)):
+            si = str(i)
+            pp = self.conf.preprocessor_for(i)
+            if pp is not None:
+                x = pp.pre_process(x, rngs[i] if train else None)
+            layer_state = None
+            if state and si in state:
+                layer_state = state[si]
+            elif rnn_state and si in rnn_state:
+                layer_state = rnn_state[si]
+            is_recurrent = isinstance(c.layer, L.RECURRENT_LAYER_TYPES)
+            mask = feature_mask if is_recurrent else None
+            x, st = impl.apply(
+                c,
+                params[si],
+                x,
+                state=layer_state,
+                train=train,
+                rng=rngs[i] if train else None,
+                mask=mask,
+            )
+            if st is not None:
+                if state and si in state:
+                    new_state[si] = st
+                else:
+                    new_rnn[si] = st
+            if collect:
+                acts.append(x)
+        return (acts if collect else x), new_state, new_rnn
+
+    def _loss_fn(
+        self, params, state, rng, features, labels, feature_mask, label_mask
+    ):
+        out, new_state, _ = self._forward_fn(
+            params, state, features, rng, True, feature_mask
+        )
+        out_conf = self.conf.confs[-1]
+        impl = self._impls[-1]
+        if not hasattr(impl, "loss"):
+            raise ValueError(
+                "Last layer must be an output layer to compute a score"
+            )
+        score = impl.loss(out_conf, out, labels, label_mask)
+        score = score + self._reg_score(params)
+        return score, new_state
+
+    def _reg_score(self, params):
+        reg = 0.0
+        for i, c in enumerate(self.conf.confs):
+            if not c.use_regularization:
+                continue
+            l1 = float(c.resolved("l1") or 0.0)
+            l2 = float(c.resolved("l2") or 0.0)
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for name, p in params[str(i)].items():
+                if name not in _REGULARIZED_KEYS:
+                    continue
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(p))
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(p * p)
+        return reg
+
+    # ------------------------------------------------------------------
+    # The jitted train step (whole §3.1 stack as one XLA computation)
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _train_step(self):
+        def step(params, state, upd_state, iteration, rng, features, labels,
+                 feature_mask, label_mask):
+            (score, new_state), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(params, state, rng, features, labels, feature_mask, label_mask)
+            new_params = {}
+            new_upd = {}
+            for i, (c, upd) in enumerate(zip(self.conf.confs, self._updaters)):
+                si = str(i)
+                g = normalize_gradients(
+                    c.resolved("gradient_normalization"),
+                    grads[si],
+                    float(c.resolved("gradient_normalization_threshold")),
+                )
+                lr = resolve_lr(c, iteration)
+                updates, new_upd[si] = upd.update(
+                    g, upd_state[si], lr, iteration
+                )
+                new_params[si] = jax.tree.map(
+                    lambda p, u: p - u, params[si], updates
+                )
+            return new_params, new_state, new_upd, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _grad_and_score(self):
+        def gs(params, state, rng, features, labels, feature_mask, label_mask):
+            (score, new_state), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(params, state, rng, features, labels, feature_mask, label_mask)
+            return score, grads, new_state
+
+        return jax.jit(gs)
+
+    @functools.cached_property
+    def _output_fn(self):
+        def out(params, state, x):
+            y, _, _ = self._forward_fn(params, state, x, None, False)
+            return y
+
+        return jax.jit(out)
+
+    # ------------------------------------------------------------------
+    # Public training API (reference fit(...) :1130)
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None) -> None:
+        """fit(DataSet) / fit(features, labels) / fit(DataSetIterator)."""
+        self.init()
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if labels is not None:
+            self._fit_batch(DataSet(data, labels))
+        elif isinstance(data, DataSet):
+            self._fit_batch(data)
+        else:  # iterator
+            if self.conf.pretrain:
+                self.pretrain(data)
+                data.reset()
+            if self.conf.backprop:
+                for ds in data:
+                    self._fit_batch(ds)
+
+    def _fit_batch(self, ds) -> None:
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            self._fit_tbptt(ds)
+            return
+        algo = self.conf.confs[0].optimization_algo
+        if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            from deeplearning4j_tpu.optimize.solver import Solver
+
+            Solver(self).optimize(ds)
+            return
+        n_iter = max(1, self.conf.confs[0].num_iterations)
+        feats = jnp.asarray(ds.features, self._dtype)
+        labels = jnp.asarray(ds.labels, self._dtype)
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        for _ in range(n_iter):
+            self._key, sub = jax.random.split(self._key)
+            self.params, self.state, self.updater_state, score = (
+                self._train_step(
+                    self.params, self.state, self.updater_state,
+                    self.iteration, sub, feats, labels, fm, lm,
+                )
+            )
+            self.score_value = score
+            self.iteration += 1
+            for listener in self.listeners:
+                if listener.invoked_every <= 1 or (
+                    self.iteration % listener.invoked_every == 0
+                ):
+                    listener.iteration_done(self, self.iteration)
+
+    def _fit_tbptt(self, ds) -> None:
+        """Truncated BPTT (reference doTruncatedBPTT :1262-1320): chop the
+        time axis into windows, carry rnn state (stop-gradient) across."""
+        length = self.conf.tbptt_fwd_length
+        feats = jnp.asarray(ds.features, self._dtype)
+        labels = jnp.asarray(ds.labels, self._dtype)
+        t_total = feats.shape[2]
+        rnn_state = None
+        for start in range(0, t_total, length):
+            end = min(start + length, t_total)
+            fw = feats[:, :, start:end]
+            lw = labels[:, :, start:end]
+            fmw = (
+                None
+                if ds.features_mask is None
+                else jnp.asarray(ds.features_mask)[:, start:end]
+            )
+            lmw = (
+                None
+                if ds.labels_mask is None
+                else jnp.asarray(ds.labels_mask)[:, start:end]
+            )
+            self._key, sub = jax.random.split(self._key)
+            (
+                self.params,
+                self.updater_state,
+                rnn_state,
+                score,
+            ) = self._tbptt_step(
+                self.params, self.updater_state, self.iteration, sub,
+                fw, lw, fmw, lmw, rnn_state,
+            )
+            self.score_value = score
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+
+    @functools.cached_property
+    def _tbptt_step(self):
+        def loss(params, rng, f, y, fm, lm, rnn_state):
+            out, _, new_rnn = self._forward_fn(
+                params, self.state, f, rng, True, fm, rnn_state=rnn_state
+            )
+            impl = self._impls[-1]
+            score = impl.loss(self.conf.confs[-1], out, y, lm)
+            score = score + self._reg_score(params)
+            return score, new_rnn
+
+        def step(params, upd_state, iteration, rng, f, y, fm, lm, rnn_state):
+            (score, new_rnn), grads = jax.value_and_grad(loss, has_aux=True)(
+                params, rng, f, y, fm, lm, rnn_state
+            )
+            new_params = {}
+            new_upd = {}
+            for i, (c, upd) in enumerate(zip(self.conf.confs, self._updaters)):
+                si = str(i)
+                g = normalize_gradients(
+                    c.resolved("gradient_normalization"),
+                    grads[si],
+                    float(c.resolved("gradient_normalization_threshold")),
+                )
+                updates, new_upd[si] = upd.update(
+                    g, upd_state[si], resolve_lr(c, iteration), iteration
+                )
+                new_params[si] = jax.tree.map(
+                    lambda p, u: p - u, params[si], updates
+                )
+            new_rnn = jax.lax.stop_gradient(new_rnn)
+            return new_params, new_upd, new_rnn, score
+
+        return jax.jit(step)
+
+    # ------------------------------------------------------------------
+    # Pretraining (reference pretrain :150-226, §3.3)
+    # ------------------------------------------------------------------
+    def pretrain(self, data_iter) -> None:
+        """Greedy layer-wise pretraining of RBM/AutoEncoder layers."""
+        self.init()
+        from deeplearning4j_tpu.optimize.pretrainer import pretrain_network
+
+        pretrain_network(self, data_iter)
+
+    # ------------------------------------------------------------------
+    # Inference (reference output/feedForward :578-715)
+    # ------------------------------------------------------------------
+    def output(self, x, train: bool = False) -> Array:
+        self.init()
+        x = jnp.asarray(x, self._dtype)
+        return self._output_fn(self.params, self.state, x)
+
+    def feed_forward(self, x, train: bool = False) -> List[Array]:
+        """All layer activations, input first (reference feedForward)."""
+        self.init()
+        x = jnp.asarray(x, self._dtype)
+        acts, _, _ = self._forward_fn(
+            self.params, self.state, x, None, False, collect=True
+        )
+        return [x] + list(acts)
+
+    def predict(self, x) -> np.ndarray:
+        """Argmax class predictions (reference Classifier.predict)."""
+        out = self.output(x)
+        return np.asarray(jnp.argmax(out, axis=1))
+
+    def score(self, ds=None) -> float:
+        if ds is None:
+            return float(self.score_value)
+        self.init()
+        feats = jnp.asarray(ds.features, self._dtype)
+        labels = jnp.asarray(ds.labels, self._dtype)
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        s, _ = self._loss_eval(self.params, self.state, feats, labels, fm, lm)
+        return float(s)
+
+    @functools.cached_property
+    def _loss_eval(self):
+        def f(params, state, x, y, fm, lm):
+            out, _, _ = self._forward_fn(params, state, x, None, False, fm)
+            impl = self._impls[-1]
+            score = impl.loss(self.conf.confs[-1], out, y, lm)
+            return score + self._reg_score(params), out
+
+        return jax.jit(f)
+
+    # ------------------------------------------------------------------
+    # Gradient access for gradient checks (reference
+    # computeGradientAndScore + gradient())
+    # ------------------------------------------------------------------
+    def compute_gradient_and_score(self, ds) -> Tuple[float, Gradient]:
+        self.init()
+        feats = jnp.asarray(ds.features, self._dtype)
+        labels = jnp.asarray(ds.labels, self._dtype)
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        score, grads, _ = self._grad_and_score(
+            self.params, self.state, None, feats, labels, fm, lm
+        )
+        return float(score), Gradient.from_tree(grads)
+
+    # ------------------------------------------------------------------
+    # RNN streaming + state (reference rnnTimeStep, stateMap)
+    # ------------------------------------------------------------------
+    def rnn_time_step(self, x) -> Array:
+        """Stateful single/multi-step inference carrying hidden state
+        between calls (reference rnnTimeStep)."""
+        self.init()
+        x = jnp.asarray(x, self._dtype)
+        if x.ndim == 2:
+            x = x[:, :, None]
+        out, _, new_rnn = self._forward_fn(
+            self.params, self.state, x, None, False,
+            rnn_state=self._rnn_state or None,
+        )
+        self._rnn_state = new_rnn
+        return out
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_state = {}
+
+    # ------------------------------------------------------------------
+    # Parameter pack/unpack (reference params() :984-1063)
+    # ------------------------------------------------------------------
+    def params_flat(self) -> Array:
+        flat, _ = ravel_pytree(self.params)
+        return flat
+
+    def set_params_flat(self, flat) -> None:
+        _, unravel = ravel_pytree(self.params)
+        self.params = unravel(jnp.asarray(flat))
+
+    def num_params(self) -> int:
+        return int(self.params_flat().shape[0])
+
+    def param_table(self) -> Dict[str, Array]:
+        """Flat "idx_name" -> array view (reference paramTable())."""
+        out = {}
+        for idx in sorted(self.params, key=int):
+            for name, p in self.params[idx].items():
+                out[f"{idx}_{name}"] = p
+        return out
+
+    def set_param(self, key: str, value) -> None:
+        idx, name = key.split("_", 1)
+        self.params[idx][name] = jnp.asarray(value, self._dtype)
+
+    # ------------------------------------------------------------------
+    # Evaluation + listeners
+    # ------------------------------------------------------------------
+    def evaluate(self, data_iter):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        self.init()
+        ev = Evaluation()
+        for ds in data_iter:
+            out = self.output(ds.features)
+            if ds.labels_mask is not None or (
+                np.asarray(ds.labels).ndim == 3
+            ):
+                ev.eval_time_series(ds.labels, out, ds.labels_mask)
+            else:
+                ev.eval(ds.labels, out)
+        return ev
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    # ------------------------------------------------------------------
+    # Serialization (reference checkpoint triple: conf JSON + params +
+    # updater, SURVEY.md §5.4; here conf JSON + params npz + updater npz)
+    # ------------------------------------------------------------------
+    def save(self, path: str) -> None:
+        self.init()
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "conf.json"), "w") as f:
+            f.write(self.conf.to_json())
+        np_params = jax.tree.map(np.asarray, self.params)
+        with open(os.path.join(path, "params.pkl"), "wb") as f:
+            pickle.dump(np_params, f)
+        extras = {
+            "updater_state": jax.tree.map(np.asarray, self.updater_state),
+            "state": jax.tree.map(np.asarray, self.state),
+            "iteration": self.iteration,
+        }
+        with open(os.path.join(path, "updater.pkl"), "wb") as f:
+            pickle.dump(extras, f)
+
+    @staticmethod
+    def load(path: str) -> "MultiLayerNetwork":
+        with open(os.path.join(path, "conf.json")) as f:
+            conf = MultiLayerConfiguration.from_json(f.read())
+        net = MultiLayerNetwork(conf).init()
+        with open(os.path.join(path, "params.pkl"), "rb") as f:
+            net.params = jax.tree.map(jnp.asarray, pickle.load(f))
+        upath = os.path.join(path, "updater.pkl")
+        if os.path.exists(upath):
+            with open(upath, "rb") as f:
+                extras = pickle.load(f)
+            net.updater_state = jax.tree.map(
+                jnp.asarray, extras["updater_state"]
+            )
+            net.state = jax.tree.map(jnp.asarray, extras["state"])
+            net.iteration = int(extras["iteration"])
+        return net
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(self.conf.clone()).init()
+        net.params = jax.tree.map(lambda x: x, self.params)
+        net.updater_state = jax.tree.map(lambda x: x, self.updater_state)
+        net.state = jax.tree.map(lambda x: x, self.state)
+        net.iteration = self.iteration
+        return net

@@ -1,0 +1,16 @@
+"""Updaters: per-parameter learning rules with aggregatable state.
+
+Mirror of reference nn/updater/*.java (BaseUpdater + Sgd, Adam, AdaDelta,
+AdaGrad, Nesterovs, RmsProp, NoOp; MultiLayerUpdater composition; state
+aggregation SPI nn/updater/aggregate/UpdaterAggregator.java used for
+parameter averaging). Redesigned as pure gradient transforms over pytrees:
+``init(params) -> state``; ``update(grads, state, lr, it) -> (updates,
+state)`` where the caller applies ``params -= updates``. All jit-safe.
+"""
+
+from deeplearning4j_tpu.nn.updater.updaters import (
+    LayerUpdater,
+    aggregate_updater_states,
+    make_layer_updater,
+    normalize_gradients,
+)

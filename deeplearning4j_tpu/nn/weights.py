@@ -1,0 +1,68 @@
+"""Weight initialization schemes.
+
+Mirror of reference nn/weights/WeightInit.java:37 (DISTRIBUTION, NORMALIZED,
+SIZE, UNIFORM, VI, ZERO, XAVIER, RELU) and WeightInitUtil. Sampling is a
+stateless ``jax.random`` draw (replaces ND4J's stateful device RNG).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.enums import WeightInit
+
+Array = jax.Array
+
+
+def _fans(shape: Sequence[int]) -> tuple[int, int]:
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # Conv kernels [out_ch, in_ch, kh, kw]: receptive-field scaled fans.
+    receptive = int(jnp.prod(jnp.array(shape[2:])))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def init_weights(
+    key: Array,
+    shape: Sequence[int],
+    scheme: WeightInit,
+    dist=None,
+    dtype=jnp.float32,
+) -> Array:
+    """Draw one weight tensor (reference WeightInitUtil.initWeights)."""
+    shape = tuple(int(s) for s in shape)
+    fan_in, fan_out = _fans(shape)
+    if scheme == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme == WeightInit.XAVIER:
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == WeightInit.RELU:
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == WeightInit.UNIFORM:
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.VI:
+        # Variance-scaled uniform over both fans (reference "VI").
+        r = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-r, maxval=r)
+    if scheme == WeightInit.SIZE:
+        # Scaled by tensor size (legacy scheme kept for parity).
+        a = 1.0 / math.sqrt(fan_in + fan_out)
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.NORMALIZED:
+        return (
+            jax.random.uniform(key, shape, dtype) - 0.5
+        ) / float(max(fan_in, 1))
+    if scheme == WeightInit.DISTRIBUTION:
+        if dist is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a distribution")
+        return dist.sample(key, shape, dtype)
+    raise ValueError(f"Unknown weight init scheme {scheme}")

@@ -1,0 +1,11 @@
+"""Tensor-op substrate: activation/transform/loss registries over jax.numpy.
+
+Replaces the reference's ND4J op factory surface
+(``Nd4j.getOpFactory().createTransform(name, x)``, used e.g. at reference
+nn/layers/BaseLayer.java:344) with plain jitted functions looked up by the
+same string names. There is no eager executioner: callers compose these
+into pure step functions that are traced once by XLA.
+"""
+
+from deeplearning4j_tpu.ops.activations import activation, ACTIVATIONS
+from deeplearning4j_tpu.ops.losses import loss_fn, LossFunction

@@ -1,0 +1,82 @@
+"""Activation registry.
+
+TPU-native replacement for the reference's string-keyed transform-op factory
+(``Nd4j.getOpFactory().createTransform("sigmoid"|"softmax"|...)``, reference
+nn/layers/BaseLayer.java:337-352 and nn/conf/NeuralNetConfiguration.java:502).
+Each entry is a pure ``Array -> Array`` function; derivatives come from
+``jax.grad`` of the composed network, so there is no ``...Derivative`` op
+family to mirror.
+
+All functions are elementwise except ``softmax``/``logsoftmax`` which reduce
+over the feature axis. Feature axis convention: axis 1 (reference layouts are
+[N, C], [N, C, T], [N, C, H, W]).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+FEATURE_AXIS = 1
+
+
+def _softmax(x: Array) -> Array:
+    # Reference applies softmax over columns of [N, C] (SoftMax op). For
+    # rank>2 inputs ([N, C, T]) the class axis is still axis 1.
+    axis = FEATURE_AXIS if x.ndim > 1 else -1
+    return jax.nn.softmax(x, axis=axis)
+
+
+def _logsoftmax(x: Array) -> Array:
+    axis = FEATURE_AXIS if x.ndim > 1 else -1
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+ACTIVATIONS: dict[str, Callable[[Array], Array]] = {
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "tanh": jnp.tanh,
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "relu": jax.nn.relu,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    "elu": jax.nn.elu,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "cube": lambda x: x * x * x,
+    "softmax": _softmax,
+    "logsoftmax": _logsoftmax,
+    # ``timesoneminus`` is the x*(1-x) transform the reference uses for the
+    # sigmoid derivative (createTransform("timesoneminus", x)); kept for
+    # registry-name parity even though backprop here is jax.grad.
+    "timesoneminus": lambda x: x * (1.0 - x),
+    "exp": jnp.exp,
+    "sign": jnp.sign,
+    "abs": jnp.abs,
+    "sqrt": jnp.sqrt,
+    "floor": jnp.floor,
+    "round": jnp.round,
+    "log": jnp.log,
+    "negative": jnp.negative,
+    "stabilize": lambda x: jnp.clip(x, -50.0, 50.0),
+}
+
+
+def activation(name: str) -> Callable[[Array], Array]:
+    """Look up an activation by its reference-compatible string name."""
+    try:
+        return ACTIVATIONS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name!r}. Known: {sorted(ACTIVATIONS)}"
+        ) from None
+
+
+def register_activation(name: str, fn: Callable[[Array], Array]) -> None:
+    """Register a custom activation (reference: custom transform ops)."""
+    ACTIVATIONS[name.lower()] = fn

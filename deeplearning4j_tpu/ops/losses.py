@@ -1,0 +1,136 @@
+"""Loss functions.
+
+Capability-parity set for the reference's ``LossFunctions.LossFunction`` enum
+(external ND4J dependency, consumed by output-layer confs at reference
+nn/conf/layers/BaseOutputLayer — values MSE, EXPLL, XENT, MCXENT, RMSE_XENT,
+SQUARED_LOSS, RECONSTRUCTION_CROSSENTROPY, NEGATIVELOGLIKELIHOOD).
+
+Convention (matches the reference's scoring): each loss returns the *mean
+per-example* loss where the per-example loss sums over output units. Time
+series inputs of shape [N, C, T] are scored per (example, timestep) with an
+optional ``mask`` of shape [N, T] (reference: masked scoring in
+BaseOutputLayer + Evaluation.evalTimeSeries, eval/Evaluation.java:171-226).
+
+All functions are pure and jit-safe: ``loss_fn(name)(activations, labels,
+mask)`` returns a scalar.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_EPS = 1e-8
+
+
+class LossFunction(str, enum.Enum):
+    MSE = "mse"
+    EXPLL = "expll"
+    XENT = "xent"
+    MCXENT = "mcxent"
+    RMSE_XENT = "rmse_xent"
+    SQUARED_LOSS = "squared_loss"
+    RECONSTRUCTION_CROSSENTROPY = "reconstruction_crossentropy"
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    COSINE_PROXIMITY = "cosine_proximity"
+    L1 = "l1"
+    HINGE = "hinge"
+
+
+def _flatten_time(a: Array) -> Array:
+    """[N, C, T] -> [N*T, C] so losses see a 2-d (example, unit) matrix."""
+    if a.ndim == 3:
+        return jnp.transpose(a, (0, 2, 1)).reshape(-1, a.shape[1])
+    return a
+
+
+def _flatten_mask(mask: Optional[Array], n_rows: int) -> Optional[Array]:
+    if mask is None:
+        return None
+    return mask.reshape(-1)[:n_rows]
+
+
+def _reduce(per_example: Array, mask: Optional[Array]) -> Array:
+    """Mean over (possibly masked) examples of a per-example loss vector."""
+    if mask is None:
+        return jnp.mean(per_example)
+    mask = mask.astype(per_example.dtype)
+    return jnp.sum(per_example * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def _make(per_example_fn: Callable[[Array, Array], Array]):
+    def loss(activations: Array, labels: Array, mask: Optional[Array] = None) -> Array:
+        a = _flatten_time(activations)
+        y = _flatten_time(labels)
+        m = _flatten_mask(mask, a.shape[0])
+        return _reduce(per_example_fn(a, y), m)
+
+    return loss
+
+
+def _mse(a, y):
+    return jnp.sum((y - a) ** 2, axis=-1) / a.shape[-1]
+
+
+def _squared(a, y):
+    return jnp.sum((y - a) ** 2, axis=-1)
+
+
+def _xent(a, y):
+    a = jnp.clip(a, _EPS, 1.0 - _EPS)
+    return -jnp.sum(y * jnp.log(a) + (1.0 - y) * jnp.log(1.0 - a), axis=-1)
+
+
+def _mcxent(a, y):
+    return -jnp.sum(y * jnp.log(jnp.clip(a, _EPS, None)), axis=-1)
+
+
+def _expll(a, y):
+    # Poisson-style exponential log likelihood.
+    return jnp.sum(a - y * jnp.log(jnp.clip(a, _EPS, None)), axis=-1)
+
+
+def _rmse_xent(a, y):
+    return jnp.sqrt(_mse(a, y))
+
+
+def _cosine(a, y):
+    an = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + _EPS)
+    yn = y / (jnp.linalg.norm(y, axis=-1, keepdims=True) + _EPS)
+    return -jnp.sum(an * yn, axis=-1)
+
+
+def _l1(a, y):
+    return jnp.sum(jnp.abs(y - a), axis=-1)
+
+
+def _hinge(a, y):
+    # labels in {0,1} one-hot -> {-1,+1}
+    return jnp.sum(jnp.maximum(0.0, 1.0 - (2.0 * y - 1.0) * a), axis=-1)
+
+
+_LOSSES: dict[LossFunction, Callable] = {
+    LossFunction.MSE: _make(_mse),
+    LossFunction.SQUARED_LOSS: _make(_squared),
+    LossFunction.XENT: _make(_xent),
+    LossFunction.MCXENT: _make(_mcxent),
+    LossFunction.NEGATIVELOGLIKELIHOOD: _make(_mcxent),
+    LossFunction.RECONSTRUCTION_CROSSENTROPY: _make(_xent),
+    LossFunction.EXPLL: _make(_expll),
+    LossFunction.RMSE_XENT: _make(_rmse_xent),
+    LossFunction.COSINE_PROXIMITY: _make(_cosine),
+    LossFunction.L1: _make(_l1),
+    LossFunction.HINGE: _make(_hinge),
+}
+
+
+def loss_fn(which: LossFunction | str) -> Callable[..., Array]:
+    """Look up ``(activations, labels, mask=None) -> scalar`` by name."""
+    if isinstance(which, str):
+        which = LossFunction(which.lower())
+    return _LOSSES[which]

@@ -1,0 +1,15 @@
+"""Optimization: solver loop, line-search optimizers, listeners, terminations.
+
+Mirror of reference optimize/** (Solver.java:42, BaseOptimizer.java:55,
+solvers/{StochasticGradientDescent,ConjugateGradient,LBFGS,
+BackTrackLineSearch}.java, api/IterationListener.java). The SGD path lives
+inside MultiLayerNetwork's jitted train step; the second-order paths here
+drive jitted flat-parameter value_and_grad evaluations from a host loop
+(they are capability-parity paths, not the TPU hot loop).
+"""
+
+from deeplearning4j_tpu.optimize.listeners import (
+    ComposableIterationListener,
+    IterationListener,
+    ScoreIterationListener,
+)

@@ -1,0 +1,96 @@
+"""Iteration listeners.
+
+Mirror of reference optimize/api/IterationListener.java + listeners/
+{ScoreIterationListener.java:31, ParamAndGradientIterationListener.java,
+ComposableIterationListener.java}. Invoked from the host loop after each
+optimizer iteration (the one host sync point per step).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class IterationListener:
+    """SPI: ``iteration_done(model, iteration)``."""
+
+    invoked_every: int = 1
+
+    def iteration_done(self, model, iteration: int) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    """Log the score every N iterations (reference
+    ScoreIterationListener.java:31)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.invoked_every = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        log.info("Score at iteration %d is %s", iteration, float(model.score_value))
+
+
+class ComposableIterationListener(IterationListener):
+    def __init__(self, *listeners: IterationListener):
+        self.listeners: List[IterationListener] = list(listeners)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        for listener in self.listeners:
+            listener.iteration_done(model, iteration)
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Accumulate (iteration, score) pairs in memory (reference
+    CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.invoked_every = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration: int) -> None:
+        self.scores.append((iteration, float(model.score_value)))
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Log parameter norms each iteration (reference
+    ParamAndGradientIterationListener.java)."""
+
+    def __init__(self, iterations: int = 1):
+        self.invoked_every = max(1, iterations)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        import jax.numpy as jnp
+
+        for key, p in model.param_table().items():
+            log.info(
+                "iter %d param %s: mean=%.6f l2=%.6f",
+                iteration, key, float(jnp.mean(p)),
+                float(jnp.linalg.norm(p.ravel())),
+            )
+
+
+class TimeIterationListener(IterationListener):
+    """Wall-clock per-iteration logging."""
+
+    def __init__(self):
+        self._last: Optional[float] = None
+
+    def iteration_done(self, model, iteration: int) -> None:
+        now = time.time()
+        if self._last is not None:
+            log.info("iteration %d took %.4fs", iteration, now - self._last)
+        self._last = now
+
+
+class LambdaIterationListener(IterationListener):
+    def __init__(self, fn: Callable, every: int = 1):
+        self._fn = fn
+        self.invoked_every = max(1, every)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        self._fn(model, iteration)

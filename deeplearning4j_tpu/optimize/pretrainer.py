@@ -1,0 +1,71 @@
+"""Greedy layer-wise unsupervised pretraining.
+
+Mirror of reference MultiLayerNetwork.pretrain(DataSetIterator) :150-226
+(§3.3 call stack): for each pretrainable layer, feed data forward through
+the already-trained stack, then run that layer's unsupervised update
+(RBM CD-k / denoising-AE gradient) for conf.numIterations iterations per
+batch. Each layer's update is one jitted computation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import PRETRAIN_LAYER_TYPES
+from deeplearning4j_tpu.nn.updater.updaters import resolve_lr
+
+
+def pretrain_network(net, data_iter) -> None:
+    for i, (conf, impl) in enumerate(zip(net.conf.confs, net._impls)):
+        if not isinstance(conf.layer, PRETRAIN_LAYER_TYPES):
+            continue
+        step = _make_pretrain_step(net, i, conf, impl)
+        data_iter.reset()
+        n_iter = max(1, conf.num_iterations)
+        for ds in data_iter:
+            x = jnp.asarray(ds.features, net._dtype)
+            x_in = _activate_to(net, i, x)
+            for _ in range(n_iter):
+                net._key, sub = jax.random.split(net._key)
+                si = str(i)
+                (
+                    net.params[si],
+                    net.updater_state[si],
+                    score,
+                ) = step(net.params[si], net.updater_state[si],
+                         net.iteration, sub, x_in)
+                net.score_value = score
+                net.iteration += 1
+                for listener in net.listeners:
+                    listener.iteration_done(net, net.iteration)
+
+
+def _activate_to(net, layer_idx: int, x):
+    """Input activations for layer ``layer_idx`` (reference
+    activationFromPrevLayer :199-226), inference mode."""
+    if layer_idx == 0:
+        pp = net.conf.preprocessor_for(0)
+        return pp.pre_process(x) if pp is not None else x
+    acts, _, _ = net._forward_fn(
+        net.params, net.state, x, None, False, collect=True
+    )
+    out = acts[layer_idx - 1]
+    pp = net.conf.preprocessor_for(layer_idx)
+    return pp.pre_process(out) if pp is not None else out
+
+
+def _make_pretrain_step(net, i: int, conf, impl):
+    upd = net._updaters[i]
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(layer_params, upd_state, iteration, rng, x):
+        score, grads = impl.pretrain_value_and_grad(conf, layer_params, x, rng)
+        lr = resolve_lr(conf, iteration)
+        updates, new_upd = upd.update(grads, upd_state, lr, iteration)
+        new_params = jax.tree.map(lambda p, u: p - u, layer_params, updates)
+        return new_params, new_upd, score
+
+    return step

@@ -1,0 +1,243 @@
+"""Solver: line-search-based convex optimizers over flat parameters.
+
+Mirror of reference optimize/Solver.java:42 + solvers/{BaseOptimizer.java:55
+(main loop :163-226), LineGradientDescent, ConjugateGradient (91 LoC,
+Polak-Ribiere), LBFGS (163 LoC, m=4 two-loop recursion),
+BackTrackLineSearch.java (Armijo backtracking)}.
+
+The SGD path is NOT here — it is fused into MultiLayerNetwork's jitted
+train step. These optimizers evaluate a jitted flat ``value_and_grad`` from
+a host-side loop; they exist for capability parity (CG/LBFGS training,
+t-SNE, RBM fine-tuning experiments), not as the TPU hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+from deeplearning4j_tpu.optimize.terminations import DEFAULT_CONDITIONS
+
+Array = jax.Array
+
+
+def backtrack_line_search(
+    f: Callable[[Array], float],
+    x: Array,
+    fx: float,
+    grad: Array,
+    direction: Array,
+    max_iterations: int = 5,
+    initial_step: float = 1.0,
+    c1: float = 1e-4,
+    rho: float = 0.5,
+) -> Tuple[float, float]:
+    """Armijo backtracking (reference BackTrackLineSearch.java).
+    Returns (step, f(x + step*direction))."""
+    slope = float(jnp.vdot(grad, direction))
+    step = initial_step
+    for _ in range(max_iterations):
+        fnew = float(f(x + step * direction))
+        if fnew <= fx + c1 * step * slope:
+            return step, fnew
+        step *= rho
+    return step, float(f(x + step * direction))
+
+
+class FlatProblem:
+    """Adapter exposing a network's loss on one batch as f(flat_params).
+
+    The batch enters the jitted functions as ARGUMENTS (not trace-time
+    constants), and the compiled fns are cached on the network, so
+    iterating over many batches compiles once per batch shape rather than
+    once per batch.
+    """
+
+    def __init__(self, net, ds):
+        from jax.flatten_util import ravel_pytree
+
+        net.init()
+        self._net = net
+        self._feats = jnp.asarray(ds.features, net._dtype)
+        self._labels = jnp.asarray(ds.labels, net._dtype)
+        x0, unravel = ravel_pytree(net.params)
+        self.x0 = x0
+        self._unravel = unravel
+
+        if not hasattr(net, "_flat_loss_cache"):
+            def loss_flat(flat, state, feats, labels):
+                params = unravel(flat)
+                score, _ = net._loss_fn(
+                    params, state, None, feats, labels, None, None
+                )
+                return score
+
+            net._flat_loss_cache = (
+                jax.jit(jax.value_and_grad(loss_flat)),
+                jax.jit(loss_flat),
+            )
+        self._vag, self._val = net._flat_loss_cache
+
+    def value_and_grad(self, flat):
+        return self._vag(flat, self._net.state, self._feats, self._labels)
+
+    def value(self, flat):
+        return self._val(flat, self._net.state, self._feats, self._labels)
+
+    def write_back(self, flat: Array) -> None:
+        self._net.params = self._unravel(flat)
+
+
+class BaseOptimizer:
+    """Shared loop (reference BaseOptimizer.optimize :163-226):
+    gradientAndScore -> direction -> line search -> step -> listeners ->
+    termination."""
+
+    def __init__(self, net, max_iterations: Optional[int] = None,
+                 terminations=DEFAULT_CONDITIONS):
+        self.net = net
+        conf = net.conf.confs[0]
+        self.max_iterations = max_iterations or conf.num_iterations
+        self.max_ls_iterations = conf.max_num_line_search_iterations
+        self.terminations = list(terminations)
+
+    def direction(self, x, grad, it: int) -> Array:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def optimize(self, ds) -> float:
+        problem = FlatProblem(self.net, ds)
+        x = problem.x0
+        score = None
+        self.reset()
+        for it in range(self.max_iterations):
+            score, grad = problem.value_and_grad(x)
+            score = float(score)
+            direction = self.direction(x, grad, it)
+            step, new_score = backtrack_line_search(
+                problem.value, x, score, grad, direction,
+                self.max_ls_iterations,
+            )
+            x = x + step * direction
+            self._post_step(x, grad, direction, step)
+            problem.write_back(x)
+            self.net.score_value = new_score
+            self.net.iteration += 1
+            for listener in self.net.listeners:
+                listener.iteration_done(self.net, self.net.iteration)
+            if any(
+                t.terminate(new_score, score, np.asarray(direction))
+                for t in self.terminations
+            ):
+                break
+        return float(self.net.score_value)
+
+    def _post_step(self, x, grad, direction, step) -> None:
+        pass
+
+
+class LineGradientDescent(BaseOptimizer):
+    """Steepest descent + line search (reference
+    solvers/LineGradientDescent.java)."""
+
+    def direction(self, x, grad, it):
+        return -grad
+
+
+class ConjugateGradient(BaseOptimizer):
+    """Nonlinear CG with Polak-Ribiere beta (reference
+    solvers/ConjugateGradient.java)."""
+
+    def reset(self):
+        self._prev_grad = None
+        self._prev_dir = None
+
+    def direction(self, x, grad, it):
+        if self._prev_grad is None:
+            d = -grad
+        else:
+            y = grad - self._prev_grad
+            beta = float(
+                jnp.maximum(
+                    0.0,
+                    jnp.vdot(grad, y)
+                    / jnp.maximum(jnp.vdot(self._prev_grad, self._prev_grad), 1e-12),
+                )
+            )
+            d = -grad + beta * self._prev_dir
+        self._prev_grad = grad
+        self._prev_dir = d
+        return d
+
+
+class LBFGS(BaseOptimizer):
+    """Limited-memory BFGS, m=4 history, two-loop recursion (reference
+    solvers/LBFGS.java)."""
+
+    m = 4
+
+    def reset(self):
+        self._s: List[Array] = []
+        self._y: List[Array] = []
+        self._prev_x = None
+        self._prev_grad = None
+
+    def direction(self, x, grad, it):
+        if self._prev_x is not None:
+            s = x - self._prev_x
+            y = grad - self._prev_grad
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.m:
+                    self._s.pop(0)
+                    self._y.pop(0)
+        self._prev_x = x
+        self._prev_grad = grad
+        q = grad
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / float(jnp.vdot(y, s))
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = float(jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-12))
+            q = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.vdot(y, q))
+            q = q + (a - b) * s
+        return -q
+
+
+_OPTIMIZERS = {
+    OptimizationAlgorithm.LINE_GRADIENT_DESCENT: LineGradientDescent,
+    OptimizationAlgorithm.CONJUGATE_GRADIENT: ConjugateGradient,
+    OptimizationAlgorithm.LBFGS: LBFGS,
+}
+
+
+class Solver:
+    """Facade: build the right optimizer from the conf and run it
+    (reference optimize/Solver.java:42)."""
+
+    def __init__(self, net):
+        self.net = net
+
+    def optimize(self, ds) -> float:
+        algo = self.net.conf.confs[0].optimization_algo
+        if algo == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            self.net._fit_batch(ds)
+            return float(self.net.score_value)
+        try:
+            cls = _OPTIMIZERS[algo]
+        except KeyError:
+            raise ValueError(f"Unsupported optimization algorithm {algo}")
+        return cls(self.net).optimize(ds)

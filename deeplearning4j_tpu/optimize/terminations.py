@@ -1,0 +1,42 @@
+"""Termination conditions for the iterative optimizers.
+
+Mirror of reference optimize/terminations/{EpsTermination,Norm2Termination,
+ZeroDirection}.java, checked at the end of each optimizer iteration
+(BaseOptimizer.java:222).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class TerminationCondition:
+    def terminate(self, cost: float, old_cost: float, direction) -> bool:
+        raise NotImplementedError
+
+
+class EpsTermination(TerminationCondition):
+    def __init__(self, eps: float = 1e-4, tolerance: float = 1e-8):
+        self.eps = eps
+        self.tolerance = tolerance
+
+    def terminate(self, cost, old_cost, direction) -> bool:
+        if old_cost == 0.0:
+            return abs(cost - old_cost) < self.tolerance
+        return abs(cost - old_cost) / abs(old_cost) < self.eps
+
+
+class Norm2Termination(TerminationCondition):
+    def __init__(self, gradient_tolerance: float = 1e-6):
+        self.gradient_tolerance = gradient_tolerance
+
+    def terminate(self, cost, old_cost, direction) -> bool:
+        return float(np.linalg.norm(np.asarray(direction))) < self.gradient_tolerance
+
+
+class ZeroDirection(TerminationCondition):
+    def terminate(self, cost, old_cost, direction) -> bool:
+        return float(np.abs(np.asarray(direction)).max()) == 0.0
+
+
+DEFAULT_CONDITIONS = (ZeroDirection(), EpsTermination())

@@ -1,0 +1,18 @@
+"""SPMD parallelism over a device mesh.
+
+Replaces the reference's entire scale-out stack (SURVEY.md §2.7: Spark
+parameter averaging SparkDl4jMultiLayer.java:271-383, Akka async parameter
+server MasterActor.java:61, YARN iterative-reduce, Hogwild) with compiled
+XLA collectives over ICI/DCN: the driver-side O(N) Adder reduction becomes
+an all-reduce inside the jitted step; serialized-object shipping becomes
+sharding annotations.
+
+Axes (new capabilities beyond the reference, flagged in SURVEY.md §2.7):
+- dp: data parallel (the reference's param/gradient averaging semantics)
+- tp: tensor parallel (Megatron-style column/row sharded matmuls)
+- pp: pipeline parallel (staged execution)
+- sp: sequence/context parallel (time-axis sharding for long sequences)
+"""
+
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer

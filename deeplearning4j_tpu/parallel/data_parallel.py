@@ -1,0 +1,254 @@
+"""Data/tensor-parallel training over a mesh.
+
+TPU-native replacement for the reference's synchronous data-parallel
+trainers (SURVEY.md §3.4): where SparkDl4jMultiLayer broadcasts params to
+executors (:307), trains clones, and averages through a driver-side
+accumulator (:355-361, an O(N) reduction through one process), here the
+global batch is sharded over the mesh's ``dp`` axis and gradients are
+combined by a compiled all-reduce that XLA derives from the mean-loss
+autodiff — the averaging semantics are identical (per-iteration parameter
+averaging of SGD == gradient averaging), the communication is ICI.
+
+Tensor parallelism (absent in the reference, added per SURVEY.md §7 stage
+10) shards Dense weight matrices Megatron-style: even layers column-
+parallel [None, "tp"], odd layers row-parallel ["tp", None]; XLA inserts
+the partial-sum all-reduce after row-parallel matmuls.
+
+Also provides K-local-steps-then-average (the reference's
+``AVERAGE_EACH_ITERATION=false`` mode, SparkDl4jMultiLayer.java:79,
+:275-295) via ``shard_map``: each dp group runs K independent steps on its
+local shard, then params and updater state are ``pmean``-ed — byte-for-byte
+the Spark semantics, compiled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.updater.updaters import (
+    normalize_gradients,
+    resolve_lr,
+)
+
+
+def tp_param_specs(net, mesh_axis: str = "tp"):
+    """PartitionSpec pytree for a network's params: Megatron column/row
+    alternation for stacked Dense layers; replicate everything else."""
+    specs = {}
+    col = True
+    for i, c in enumerate(net.conf.confs):
+        lc = c.layer
+        layer_specs = {}
+        if isinstance(lc, (L.DenseLayer,)) and not isinstance(
+            lc, L.OutputLayer
+        ):
+            if col:
+                layer_specs["W"] = P(None, mesh_axis)
+                layer_specs["b"] = P(mesh_axis)
+            else:
+                layer_specs["W"] = P(mesh_axis, None)
+                layer_specs["b"] = P()
+            col = not col
+        for name in net.params[str(i)]:
+            layer_specs.setdefault(name, P())
+        specs[str(i)] = layer_specs
+    return specs
+
+
+class ParallelTrainer:
+    """Synchronous SPMD trainer wrapping a MultiLayerNetwork.
+
+    ``average_each_iteration=True`` (reference default): one global step
+    per iteration, gradients all-reduced — train via sharded batch.
+    ``average_each_iteration=False`` with ``local_steps=K``: K independent
+    local steps per round, then parameter + updater-state averaging.
+    """
+
+    def __init__(
+        self,
+        net,
+        mesh: Mesh,
+        dp_axis: str = "dp",
+        tp_axis: Optional[str] = None,
+        average_each_iteration: bool = True,
+        local_steps: int = 1,
+    ):
+        net.init()
+        self.net = net
+        self.mesh = mesh
+        self.dp_axis = dp_axis
+        self.tp_axis = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
+        self.average_each_iteration = average_each_iteration
+        self.local_steps = max(1, local_steps)
+        if not average_each_iteration and net.state:
+            raise ValueError(
+                "K-local-steps-then-average mode does not support layers "
+                "with running state (BatchNormalization); use "
+                "average_each_iteration=True"
+            )
+        self._place_params()
+
+    # ------------------------------------------------------------------
+    def _param_sharding(self):
+        if self.tp_axis:
+            specs = tp_param_specs(self.net, self.tp_axis)
+        else:
+            specs = jax.tree.map(
+                lambda _: P(), self.net.params,
+                is_leaf=lambda x: isinstance(x, jax.Array),
+            )
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def _place_params(self) -> None:
+        shardings = self._param_sharding()
+        self.net.params = jax.device_put(self.net.params, shardings)
+        # Updater state mirrors param shapes; give it the same placement.
+        ushard = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, P()),
+            self.net.updater_state,
+            is_leaf=lambda x: isinstance(x, jax.Array),
+        )
+        self.net.updater_state = jax.device_put(self.net.updater_state, ushard)
+        if self.net.state:
+            self.net.state = jax.device_put(
+                self.net.state, NamedSharding(self.mesh, P())
+            )
+
+    def _shard_batch(self, arr):
+        if arr is None:
+            return None
+        return jax.device_put(
+            jnp.asarray(arr, self.net._dtype),
+            NamedSharding(self.mesh, P(self.dp_axis)),
+        )
+
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None) -> float:
+        """One (or more) global synchronous steps on the given batch."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if labels is not None:
+            data = DataSet(data, labels)
+        if isinstance(data, DataSet):
+            batches = [data]
+        else:
+            batches = data  # iterator
+        score = float("nan")
+        for ds in batches:
+            if self.average_each_iteration:
+                score = self._fit_sync(ds)
+            else:
+                score = self._fit_local_then_average(ds)
+        return score
+
+    def _fit_sync(self, ds) -> float:
+        net = self.net
+        feats = self._shard_batch(ds.features)
+        labels = self._shard_batch(ds.labels)
+        fm = self._shard_batch(ds.features_mask)
+        lm = self._shard_batch(ds.labels_mask)
+        net._key, sub = jax.random.split(net._key)
+        net.params, net.state, net.updater_state, score = net._train_step(
+            net.params, net.state, net.updater_state,
+            net.iteration, sub, feats, labels, fm, lm,
+        )
+        net.score_value = score
+        net.iteration += 1
+        for listener in net.listeners:
+            listener.iteration_done(net, net.iteration)
+        return float(score)
+
+    # ------------------------------------------------------------------
+    def _fit_local_then_average(self, ds) -> float:
+        """K local steps per dp shard, then pmean of params+updater state
+        (reference average-at-end semantics)."""
+        net = self.net
+        step = self._local_steps_fn
+        feats = self._shard_batch(ds.features)
+        labels = self._shard_batch(ds.labels)
+        fm = self._shard_batch(ds.features_mask)
+        lm = self._shard_batch(ds.labels_mask)
+        net._key, sub = jax.random.split(net._key)
+        net.params, net.updater_state, score = step(
+            net.params, net.updater_state, jnp.asarray(net.iteration),
+            sub, feats, labels, fm, lm,
+        )
+        net.score_value = score
+        net.iteration += self.local_steps
+        for listener in net.listeners:
+            listener.iteration_done(net, net.iteration)
+        return float(score)
+
+    @functools.cached_property
+    def _local_steps_fn(self):
+        net = self.net
+        dp = self.dp_axis
+        K = self.local_steps
+
+        def local_steps(params, upd_state, iteration, rng, feats, labels,
+                        fm, lm):
+            def one_step(carry, k):
+                params, upd_state = carry
+                (score, _), grads = jax.value_and_grad(
+                    net._loss_fn, has_aux=True
+                )(params, {}, jax.random.fold_in(rng, k), feats, labels,
+                  fm, lm)
+                new_params = {}
+                new_upd = {}
+                for i, (c, upd) in enumerate(
+                    zip(net.conf.confs, net._updaters)
+                ):
+                    si = str(i)
+                    g = normalize_gradients(
+                        c.resolved("gradient_normalization"),
+                        grads[si],
+                        float(c.resolved("gradient_normalization_threshold")),
+                    )
+                    updates, new_upd[si] = upd.update(
+                        g, upd_state[si], resolve_lr(c, iteration + k),
+                        iteration + k,
+                    )
+                    new_params[si] = jax.tree.map(
+                        lambda p, u: p - u, params[si], updates
+                    )
+                return (new_params, new_upd), score
+
+            (params, upd_state), scores = jax.lax.scan(
+                one_step, (params, upd_state), jnp.arange(K)
+            )
+            # The reference's average-at-end: params and updater state are
+            # mean-combined across workers (UpdaterAggregator semantics).
+            params = jax.tree.map(lambda p: jax.lax.pmean(p, dp), params)
+            upd_state = jax.tree.map(
+                lambda s: jax.lax.pmean(s, dp), upd_state
+            )
+            return params, upd_state, jax.lax.pmean(scores[-1], dp)
+
+        pspec = jax.tree.map(
+            lambda _: P(), self.net.params,
+            is_leaf=lambda x: isinstance(x, jax.Array),
+        )
+        uspec = jax.tree.map(
+            lambda _: P(), self.net.updater_state,
+            is_leaf=lambda x: isinstance(x, jax.Array),
+        )
+        fn = shard_map(
+            local_steps,
+            mesh=self.mesh,
+            in_specs=(pspec, uspec, P(), P(), P(dp), P(dp), P(dp), P(dp)),
+            out_specs=(pspec, uspec, P()),
+            check_rep=False,
+        )
+        return jax.jit(fn)

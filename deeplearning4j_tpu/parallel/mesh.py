@@ -1,0 +1,64 @@
+"""Device-mesh construction helpers.
+
+The mesh replaces the reference's cluster membership machinery (Spark
+executor lists, Akka worker pools, Hazelcast membership): placement is a
+static, compiler-visible grid; collectives ride ICI within a slice and DCN
+across slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """Named axis sizes, e.g. {"dp": 4, "tp": 2}. Size -1 means "absorb
+    remaining devices" (at most one axis)."""
+
+    axes: Dict[str, int]
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = dict(self.axes)
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError("At most one axis may be -1")
+        fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        return sizes
+
+
+def make_mesh(
+    spec: MeshSpec | Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    if isinstance(spec, dict):
+        spec = MeshSpec(spec)
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.resolve(len(devices))
+    total = int(np.prod(list(sizes.values())))
+    if total > len(devices):
+        raise ValueError(
+            f"Mesh needs {total} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[:total]).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (example) axis over the data-parallel mesh axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))

@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-virtual-device CPU JAX platform.
+
+Multi-host/multi-chip behavior is tested on a virtual CPU mesh exactly the
+way the reference tests distributed code without a cluster (BaseSparkTest
+spins local[*] Spark in-JVM; SURVEY.md §4): 8 XLA host-platform devices
+stand in for an 8-chip TPU slice.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The axon sitecustomize force-registers the TPU platform via
+# jax.config.update("jax_platforms", ...); override it back to CPU for
+# deterministic, parallel-safe unit tests.
+jax.config.update("jax_platforms", "cpu")

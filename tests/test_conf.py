@@ -1,0 +1,189 @@
+"""Config-system tests: builder semantics + JSON round-trips.
+
+Pattern from reference tests MultiLayerNeuralNetConfigurationTest,
+LayerConfigTest (SURVEY.md §4 "Conf/serde").
+"""
+
+import dataclasses
+
+from deeplearning4j_tpu.nn.conf import (
+    BackpropType,
+    GradientNormalization,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    Updater,
+)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.distribution import NormalDistribution
+from deeplearning4j_tpu.nn.conf.enums import WeightInit
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToRnnPreProcessor,
+)
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def _mlp_conf() -> MultiLayerConfiguration:
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(42)
+        .learning_rate(0.1)
+        .updater(Updater.NESTEROVS)
+        .momentum(0.9)
+        .regularization(True)
+        .l2(1e-4)
+        .list()
+        .layer(0, L.DenseLayer(n_in=4, n_out=10, activation="relu"))
+        .layer(
+            1,
+            L.OutputLayer(
+                n_in=10, n_out=3, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .backprop(True)
+        .pretrain(False)
+        .build()
+    )
+
+
+class TestBuilder:
+    def test_list_builder_produces_per_layer_confs(self):
+        conf = _mlp_conf()
+        assert len(conf.confs) == 2
+        assert isinstance(conf.confs[0].layer, L.DenseLayer)
+        assert isinstance(conf.confs[1].layer, L.OutputLayer)
+        # Global hyperparams copied into each conf.
+        for c in conf.confs:
+            assert c.seed == 42
+            assert c.learning_rate == 0.1
+            assert c.updater == Updater.NESTEROVS
+
+    def test_layer_override_beats_global(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .learning_rate(0.5)
+            .activation("tanh")
+            .list()
+            .layer(0, L.DenseLayer(n_in=2, n_out=2, learning_rate=0.01))
+            .layer(1, L.OutputLayer(n_in=2, n_out=2))
+            .build()
+        )
+        assert conf.confs[0].resolved("learning_rate") == 0.01
+        assert conf.confs[1].resolved("learning_rate") == 0.5
+        assert conf.confs[0].resolved("activation") == "tanh"
+
+    def test_missing_layer_index_raises(self):
+        import pytest
+
+        builder = (
+            NeuralNetConfiguration.Builder()
+            .list()
+            .layer(0, L.DenseLayer(n_in=2, n_out=2))
+            .layer(2, L.OutputLayer(n_in=2, n_out=2))
+        )
+        with pytest.raises(ValueError):
+            builder.build()
+
+
+class TestJsonRoundTrip:
+    def test_mlp_round_trip(self):
+        conf = _mlp_conf()
+        js = conf.to_json()
+        back = MultiLayerConfiguration.from_json(js)
+        assert back.to_json() == js
+        assert back.confs[0].updater == Updater.NESTEROVS
+        assert isinstance(back.confs[1].layer, L.OutputLayer)
+        assert back.confs[1].layer.loss_function == LossFunction.MCXENT
+
+    def test_all_layer_beans_round_trip(self):
+        beans = [
+            L.DenseLayer(n_in=3, n_out=4),
+            L.OutputLayer(n_in=4, n_out=2),
+            L.RnnOutputLayer(n_in=4, n_out=2),
+            L.AutoEncoder(n_in=5, n_out=3, corruption_level=0.2),
+            L.RecursiveAutoEncoder(n_in=5, n_out=3),
+            L.RBM(n_in=6, n_out=4, hidden_unit=L.HiddenUnit.RECTIFIED, k=3),
+            L.GravesLSTM(n_in=4, n_out=5),
+            L.GravesBidirectionalLSTM(n_in=4, n_out=5),
+            L.GRU(n_in=4, n_out=5),
+            L.ImageLSTM(n_in=4, n_out=5),
+            L.EmbeddingLayer(n_in=100, n_out=8),
+            L.ConvolutionLayer(n_in=1, n_out=6, kernel_size=(5, 5)),
+            L.SubsamplingLayer(pooling_type=L.PoolingType.AVG),
+            L.LocalResponseNormalization(n=5, alpha=1e-4),
+            L.BatchNormalization(n_in=4, n_out=4, decay=0.95),
+        ]
+        from deeplearning4j_tpu.nn.conf.serde import from_json, to_json
+
+        for bean in beans:
+            back = from_json(to_json(bean))
+            assert type(back) is type(bean)
+            # JSON-stable (tuples become lists, so compare serialized form).
+            assert to_json(back) == to_json(bean)
+
+    def test_distribution_round_trip(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .weight_init(WeightInit.DISTRIBUTION)
+            .dist(NormalDistribution(mean=0.0, std=0.01))
+            .list()
+            .layer(0, L.DenseLayer(n_in=2, n_out=2))
+            .layer(1, L.OutputLayer(n_in=2, n_out=2))
+            .build()
+        )
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert isinstance(back.confs[0].dist, NormalDistribution)
+        assert back.confs[0].dist.std == 0.01
+
+    def test_preprocessors_round_trip(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .list()
+            .layer(0, L.DenseLayer(n_in=784, n_out=10))
+            .layer(1, L.OutputLayer(n_in=10, n_out=10))
+            .input_pre_processor(
+                0, CnnToFeedForwardPreProcessor(28, 28, 1)
+            )
+            .input_pre_processor(1, FeedForwardToRnnPreProcessor())
+            .build()
+        )
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert isinstance(
+            back.preprocessor_for(0), CnnToFeedForwardPreProcessor
+        )
+        assert back.preprocessor_for(0).input_height == 28
+        assert isinstance(back.preprocessor_for(1), FeedForwardToRnnPreProcessor)
+
+    def test_tbptt_flags_round_trip(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .list()
+            .layer(0, L.GravesLSTM(n_in=3, n_out=4))
+            .layer(1, L.RnnOutputLayer(n_in=4, n_out=2))
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .t_bptt_forward_length(7)
+            .t_bptt_backward_length(7)
+            .build()
+        )
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert back.backprop_type == BackpropType.TRUNCATED_BPTT
+        assert back.tbptt_fwd_length == 7
+
+    def test_gradient_normalization_round_trip(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .gradient_normalization(
+                GradientNormalization.CLIP_L2_PER_LAYER
+            )
+            .gradient_normalization_threshold(5.0)
+            .list()
+            .layer(0, L.DenseLayer(n_in=2, n_out=2))
+            .layer(1, L.OutputLayer(n_in=2, n_out=2))
+            .build()
+        )
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert (
+            back.confs[0].gradient_normalization
+            == GradientNormalization.CLIP_L2_PER_LAYER
+        )

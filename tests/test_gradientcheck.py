@@ -1,0 +1,125 @@
+"""Finite-difference gradient checks — the correctness backbone.
+
+Pattern from reference gradientcheck/{GradientCheckTests,
+CNNGradientCheckTest, GradientCheckTestsMasking}.java driving
+GradientCheckUtil.java:48 (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+RNG = np.random.default_rng(12345)
+
+
+def _random_ds(n=6, n_in=4, n_out=3):
+    x = RNG.normal(size=(n, n_in)).astype(np.float32)
+    y = np.zeros((n, n_out), np.float32)
+    y[np.arange(n), RNG.integers(0, n_out, n)] = 1.0
+    return DataSet(x, y)
+
+
+def _check(conf, ds, **kw):
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(
+        net, ds, max_params_to_check=60, print_results=True, **kw
+    )
+
+
+class TestGradientCheckMLP:
+    @pytest.mark.parametrize("activation", ["sigmoid", "tanh", "relu", "elu"])
+    def test_mlp_activations(self, activation):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=5, activation=activation))
+            .layer(
+                1,
+                L.OutputLayer(
+                    n_in=5, n_out=3, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+            )
+            .build()
+        )
+        _check(conf, _random_ds())
+
+    @pytest.mark.parametrize(
+        "loss,out_act",
+        [
+            (LossFunction.MCXENT, "softmax"),
+            (LossFunction.MSE, "identity"),
+            (LossFunction.MSE, "tanh"),
+            (LossFunction.XENT, "sigmoid"),
+        ],
+    )
+    def test_loss_functions(self, loss, out_act):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=5, activation="tanh"))
+            .layer(
+                1,
+                L.OutputLayer(
+                    n_in=5, n_out=3, activation=out_act, loss_function=loss
+                ),
+            )
+            .build()
+        )
+        y = RNG.normal(size=(6, 3)).astype(np.float32)
+        if loss == LossFunction.XENT:
+            y = (y > 0).astype(np.float32)
+        if loss == LossFunction.MCXENT:
+            onehot = np.zeros((6, 3), np.float32)
+            onehot[np.arange(6), RNG.integers(0, 3, 6)] = 1.0
+            y = onehot
+        ds = DataSet(_random_ds().features, y)
+        _check(conf, ds)
+
+    def test_l1_l2_regularization_gradients(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .regularization(True)
+            .l1(0.01)
+            .l2(0.02)
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=5, activation="tanh"))
+            .layer(
+                1,
+                L.OutputLayer(
+                    n_in=5, n_out=3, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+            )
+            .build()
+        )
+        _check(conf, _random_ds())
+
+    def test_embedding_layer_gradients(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .list()
+            .layer(0, L.EmbeddingLayer(n_in=10, n_out=5, activation="tanh"))
+            .layer(
+                1,
+                L.OutputLayer(
+                    n_in=5, n_out=3, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+            )
+            .build()
+        )
+        x = RNG.integers(0, 10, size=(6, 1)).astype(np.float32)
+        y = np.zeros((6, 3), np.float32)
+        y[np.arange(6), RNG.integers(0, 3, 6)] = 1.0
+        _check(conf, DataSet(x, y))

@@ -1,0 +1,56 @@
+"""End-to-end MNIST slice: the SURVEY.md §7 stage-5 milestone.
+
+MLP 784-500-10 trains on (possibly synthetic-fallback) MNIST with the
+whole train step as ONE XLA computation; asserts the reference-parity
+accuracy gate on the test split.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator, mnist_dataset
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def test_mnist_mlp_end_to_end():
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(12345)
+        .learning_rate(0.1)
+        .updater(Updater.NESTEROVS)
+        .momentum(0.9)
+        .list()
+        .layer(0, L.DenseLayer(n_in=784, n_out=128, activation="relu"))
+        .layer(
+            1,
+            L.OutputLayer(
+                n_in=128, n_out=10, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+
+    train = mnist_dataset(train=True, num_examples=4096, seed=1)
+    test = mnist_dataset(train=False, num_examples=1024)
+
+    for _ in range(3):
+        for batch in train.batch_by(128):
+            net.fit(batch)
+
+    ev = net.evaluate(ListDataSetIterator(test.batch_by(256)))
+    assert ev.accuracy() > 0.90, ev.stats()
+
+
+def test_mnist_iterator_contract():
+    it = MnistDataSetIterator(batch_size=100, num_examples=250)
+    sizes = [ds.num_examples() for ds in it]
+    assert sizes == [100, 100, 50]
+    assert it.input_columns() == 784
+    assert it.total_outcomes() == 10
+    it.reset()
+    assert it.next().num_examples() == 100

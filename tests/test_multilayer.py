@@ -1,0 +1,195 @@
+"""Network integration tests: small MLPs on Iris-like data.
+
+Pattern from reference nn/multilayer/{MultiLayerTest, BackPropMLPTest}.java
+(SURVEY.md §4 "Network integration"): tiny real nets, assert score
+decreases / accuracy threshold / determinism by seed.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator, iris_dataset
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def _iris_net(seed=42, updater=Updater.SGD, lr=0.1):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(updater)
+        .list()
+        .layer(0, L.DenseLayer(n_in=4, n_out=16, activation="relu"))
+        .layer(
+            1,
+            L.OutputLayer(
+                n_in=16, n_out=3, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+class TestInit:
+    def test_param_shapes_and_count(self):
+        net = _iris_net()
+        table = net.param_table()
+        assert table["0_W"].shape == (4, 16)
+        assert table["0_b"].shape == (16,)
+        assert table["1_W"].shape == (16, 3)
+        assert table["1_b"].shape == (3,)
+        assert net.num_params() == 4 * 16 + 16 + 16 * 3 + 3
+
+    def test_same_seed_same_params(self):
+        a, b = _iris_net(seed=7), _iris_net(seed=7)
+        np.testing.assert_array_equal(
+            np.asarray(a.param_table()["0_W"]),
+            np.asarray(b.param_table()["0_W"]),
+        )
+
+    def test_different_seed_different_params(self):
+        a, b = _iris_net(seed=7), _iris_net(seed=8)
+        assert not np.array_equal(
+            np.asarray(a.param_table()["0_W"]),
+            np.asarray(b.param_table()["0_W"]),
+        )
+
+
+class TestForward:
+    def test_output_shape_and_softmax(self):
+        net = _iris_net()
+        x = np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (10, 3)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_feed_forward_collects_all_activations(self):
+        net = _iris_net()
+        x = np.zeros((5, 4), np.float32)
+        acts = net.feed_forward(x)
+        assert len(acts) == 3  # input + 2 layers
+        assert acts[1].shape == (5, 16)
+        assert acts[2].shape == (5, 3)
+
+
+class TestTraining:
+    def test_score_decreases_on_iris(self):
+        net = _iris_net(lr=0.1)
+        ds = iris_dataset()
+        ds.normalize_zero_mean_unit_variance()
+        first = net.score(ds)
+        for _ in range(30):
+            net.fit(ds)
+        assert net.score(ds) < first * 0.7
+
+    def test_iris_accuracy(self):
+        net = _iris_net(updater=Updater.ADAM, lr=0.05)
+        ds = iris_dataset()
+        ds.normalize_zero_mean_unit_variance()
+        train, test = ds.split_test_and_train(120)
+        for _ in range(150):
+            net.fit(train)
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+
+        ev = net.evaluate(ListDataSetIterator([test]))
+        assert ev.accuracy() > 0.85, ev.stats()
+
+    def test_deterministic_training_same_seed(self):
+        ds = iris_dataset()
+        nets = [_iris_net(seed=3), _iris_net(seed=3)]
+        for net in nets:
+            for _ in range(5):
+                net.fit(ds)
+        np.testing.assert_array_equal(
+            np.asarray(nets[0].params_flat()), np.asarray(nets[1].params_flat())
+        )
+
+    def test_fit_with_iterator(self):
+        net = _iris_net()
+        it = IrisDataSetIterator(batch_size=50)
+        net.fit(it)
+        assert np.isfinite(net.score_value)
+
+    def test_num_iterations_honored(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .iterations(5)
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=4))
+            .layer(1, L.OutputLayer(n_in=4, n_out=3, activation="softmax"))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        ds = iris_dataset()
+        net.fit(ds)
+        assert net.iteration == 5
+
+
+class TestListeners:
+    def test_score_listener_collects(self):
+        from deeplearning4j_tpu.optimize.listeners import (
+            CollectScoresIterationListener,
+        )
+
+        net = _iris_net()
+        collector = CollectScoresIterationListener()
+        net.set_listeners(collector)
+        ds = iris_dataset()
+        for _ in range(3):
+            net.fit(ds)
+        assert len(collector.scores) == 3
+        assert all(np.isfinite(s) for _, s in collector.scores)
+
+
+class TestSerde:
+    def test_save_load_round_trip(self, tmp_path):
+        net = _iris_net()
+        ds = iris_dataset()
+        for _ in range(3):
+            net.fit(ds)
+        path = str(tmp_path / "model")
+        net.save(path)
+        loaded = MultiLayerNetwork.load(path)
+        x = np.random.default_rng(0).normal(size=(7, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(net.output(x)), np.asarray(loaded.output(x)), atol=1e-6
+        )
+        assert loaded.iteration == net.iteration
+        # Training continues identically from the checkpoint (updater state
+        # restored — reference checkpoint triple semantics, SURVEY.md §5.4).
+        net.fit(ds)
+        loaded.fit(ds)
+        np.testing.assert_allclose(
+            np.asarray(net.params_flat()),
+            np.asarray(loaded.params_flat()),
+            atol=1e-6,
+        )
+
+
+class TestRegularization:
+    def test_l2_shrinks_weights(self):
+        ds = iris_dataset()
+        conf_reg = (
+            NeuralNetConfiguration.Builder()
+            .regularization(True)
+            .l2(0.5)
+            .learning_rate(0.1)
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=8))
+            .layer(1, L.OutputLayer(n_in=8, n_out=3, activation="softmax"))
+            .build()
+        )
+        net_reg = MultiLayerNetwork(conf_reg).init()
+        net_plain = _iris_net(lr=0.1)
+        for _ in range(20):
+            net_reg.fit(ds)
+            net_plain.fit(ds)
+        w_reg = np.linalg.norm(np.asarray(net_reg.param_table()["0_W"]))
+        w_plain = np.linalg.norm(np.asarray(net_plain.param_table()["0_W"]))
+        assert w_reg < w_plain

@@ -1,0 +1,139 @@
+"""Updater unit tests with closed-form expected updates.
+
+Pattern from reference nn/updater/TestUpdaters.java +
+TestGradientNormalization.java (SURVEY.md §4 "Updaters/optimizers").
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.enums import GradientNormalization, Updater
+from deeplearning4j_tpu.nn.updater.updaters import (
+    LayerUpdater,
+    aggregate_updater_states,
+    normalize_gradients,
+)
+
+HP = {
+    "momentum": 0.9,
+    "rho": 0.95,
+    "rms_decay": 0.95,
+    "adam_mean_decay": 0.9,
+    "adam_var_decay": 0.999,
+    "epsilon": 1e-8,
+}
+
+
+def _params():
+    return {"W": jnp.ones((2, 2)), "b": jnp.zeros((2,))}
+
+
+def _grads():
+    return {"W": jnp.full((2, 2), 0.5), "b": jnp.full((2,), 0.25)}
+
+
+class TestRules:
+    def test_sgd(self):
+        upd = LayerUpdater(Updater.SGD, HP)
+        updates, _ = upd.update(_grads(), upd.init(_params()), 0.1, 0)
+        np.testing.assert_allclose(np.asarray(updates["W"]), 0.05)
+        np.testing.assert_allclose(np.asarray(updates["b"]), 0.025)
+
+    def test_none_passes_gradient_through(self):
+        upd = LayerUpdater(Updater.NONE, HP)
+        updates, _ = upd.update(_grads(), upd.init(_params()), 0.1, 0)
+        np.testing.assert_allclose(np.asarray(updates["W"]), 0.5)
+
+    def test_adagrad(self):
+        upd = LayerUpdater(Updater.ADAGRAD, HP)
+        state = upd.init(_params())
+        g = _grads()
+        updates, state = upd.update(g, state, 0.1, 0)
+        expected = 0.1 * 0.5 / (np.sqrt(0.25) + 1e-8)
+        np.testing.assert_allclose(
+            np.asarray(updates["W"]), expected, rtol=1e-6
+        )
+        # Second step accumulates.
+        updates2, _ = upd.update(g, state, 0.1, 1)
+        expected2 = 0.1 * 0.5 / (np.sqrt(0.5) + 1e-8)
+        np.testing.assert_allclose(
+            np.asarray(updates2["W"]), expected2, rtol=1e-6
+        )
+
+    def test_rmsprop(self):
+        upd = LayerUpdater(Updater.RMSPROP, HP)
+        updates, _ = upd.update(_grads(), upd.init(_params()), 0.1, 0)
+        accum = 0.05 * 0.25  # (1-decay)*g^2
+        expected = 0.1 * 0.5 / np.sqrt(accum + 1e-8)
+        np.testing.assert_allclose(
+            np.asarray(updates["W"]), expected, rtol=1e-6
+        )
+
+    def test_adam_first_step_magnitude(self):
+        upd = LayerUpdater(Updater.ADAM, HP)
+        updates, _ = upd.update(_grads(), upd.init(_params()), 0.1, 0)
+        # First Adam step with bias correction ~= lr * sign(g).
+        np.testing.assert_allclose(
+            np.asarray(updates["W"]), 0.1, rtol=1e-4
+        )
+
+    def test_nesterovs(self):
+        upd = LayerUpdater(Updater.NESTEROVS, HP)
+        state = upd.init(_params())
+        g = _grads()
+        updates, state = upd.update(g, state, 0.1, 0)
+        # v0=0: v1 = -lr*g; update = mu*0 - (1+mu)*v1 = (1+mu)*lr*g
+        np.testing.assert_allclose(
+            np.asarray(updates["W"]), 1.9 * 0.1 * 0.5, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(state["v"]["W"]), -0.1 * 0.5, rtol=1e-6
+        )
+
+    def test_adadelta_no_lr_dependence(self):
+        upd = LayerUpdater(Updater.ADADELTA, HP)
+        u1, _ = upd.update(_grads(), upd.init(_params()), 0.1, 0)
+        u2, _ = upd.update(_grads(), upd.init(_params()), 99.0, 0)
+        np.testing.assert_allclose(np.asarray(u1["W"]), np.asarray(u2["W"]))
+
+    def test_state_aggregation_mean(self):
+        upd = LayerUpdater(Updater.ADAGRAD, HP)
+        s1 = {"g2": {"W": jnp.full((2, 2), 1.0)}}
+        s2 = {"g2": {"W": jnp.full((2, 2), 3.0)}}
+        merged = aggregate_updater_states([s1, s2])
+        np.testing.assert_allclose(np.asarray(merged["g2"]["W"]), 2.0)
+
+
+class TestGradientNormalization:
+    def test_clip_elementwise(self):
+        g = {"W": jnp.array([[3.0, -3.0], [0.5, -0.5]])}
+        out = normalize_gradients(
+            GradientNormalization.CLIP_ELEMENT_WISE_ABSOLUTE_VALUE, g, 1.0
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["W"]), [[1.0, -1.0], [0.5, -0.5]]
+        )
+
+    def test_renormalize_per_layer(self):
+        g = {"W": jnp.full((2, 2), 2.0), "b": jnp.zeros((2,))}
+        out = normalize_gradients(
+            GradientNormalization.RENORMALIZE_L2_PER_LAYER, g, 0.0
+        )
+        total = np.sqrt(
+            sum((np.asarray(v) ** 2).sum() for v in out.values())
+        )
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+    def test_clip_l2_per_param_type(self):
+        g = {"W": jnp.full((2, 2), 10.0), "b": jnp.full((2,), 0.1)}
+        out = normalize_gradients(
+            GradientNormalization.CLIP_L2_PER_PARAM_TYPE, g, 1.0
+        )
+        assert np.linalg.norm(np.asarray(out["W"])) <= 1.0 + 1e-5
+        np.testing.assert_allclose(np.asarray(out["b"]), 0.1)  # untouched
+
+    def test_none_identity(self):
+        g = _grads()
+        out = normalize_gradients(GradientNormalization.NONE, g, 1.0)
+        assert out is g
