@@ -1,0 +1,26 @@
+"""Early stopping: config, trainer, savers, terminations, score calculators.
+
+Mirror of reference earlystopping/** (EarlyStoppingConfiguration.java,
+trainer/{BaseEarlyStoppingTrainer,EarlyStoppingTrainer}.java, saver/
+{InMemoryModelSaver,LocalFileModelSaver}.java, termination/*.java,
+scorecalc/DataSetLossCalculator.java — SURVEY.md §2.5).
+"""
+
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+)
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+from deeplearning4j_tpu.earlystopping.savers import (
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.terminations import (
+    BestScoreEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.scorecalc import DataSetLossCalculator

@@ -1,0 +1,100 @@
+"""Early stopping trainer loop.
+
+Mirror of reference earlystopping/trainer/BaseEarlyStoppingTrainer.java:
+epoch loop over the training iterator with per-iteration and per-epoch
+termination checks, best-model tracking through the saver. Works for both
+MultiLayerNetwork and ComputationGraph (the reference needs a separate
+EarlyStoppingGraphTrainer only because of Java typing).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    TerminationReason,
+)
+
+log = logging.getLogger(__name__)
+
+
+class EarlyStoppingTrainer:
+    def __init__(
+        self,
+        config: EarlyStoppingConfiguration,
+        net,
+        train_iterator,
+    ):
+        self.config = config
+        self.net = net
+        self.train_iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for cond in cfg.epoch_terminations:
+            cond.initialize()
+        for cond in cfg.iteration_terminations:
+            cond.initialize()
+
+        best_score = math.inf
+        best_epoch = -1
+        score_vs_epoch = {}
+        epoch = 0
+        start_ms = time.time() * 1000.0
+        reason = None
+        details = ""
+
+        while reason is None:
+            self.train_iterator.reset()
+            for ds in self.train_iterator:
+                self.net.fit(ds)
+                elapsed = time.time() * 1000.0 - start_ms
+                score = float(self.net.score_value)
+                for cond in cfg.iteration_terminations:
+                    if cond.terminate(elapsed, score):
+                        reason = TerminationReason.ITERATION_TERMINATION_CONDITION
+                        details = f"{type(cond).__name__} at epoch {epoch}"
+                        break
+                if reason is not None:
+                    break
+
+            if reason is not None:
+                break
+
+            if epoch % max(1, cfg.evaluate_every_n_epochs) == 0:
+                if cfg.score_calculator is not None:
+                    score = cfg.score_calculator.calculate_score(self.net)
+                else:
+                    score = float(self.net.score_value)
+                score_vs_epoch[epoch] = score
+                if score < best_score:
+                    best_score = score
+                    best_epoch = epoch
+                    cfg.model_saver.save_best_model(self.net, score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.net, score)
+                for cond in cfg.epoch_terminations:
+                    if cond.terminate(epoch, score):
+                        reason = TerminationReason.EPOCH_TERMINATION_CONDITION
+                        details = f"{type(cond).__name__} at epoch {epoch}"
+                        break
+            if reason is not None:
+                break
+            epoch += 1
+
+        best = cfg.model_saver.get_best_model()
+        if best is None:
+            best = self.net
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            total_epochs=epoch + 1,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score,
+            score_vs_epoch=score_vs_epoch,
+            best_model=best,
+        )

@@ -1,0 +1,8 @@
+"""Model zoo: reference-parity example architectures as conf builders.
+
+The reference ships these as examples/tests (LeNet in CNNGradientCheckTest
+and the MNIST examples; MLPs in BackPropMLPTest). Each function returns a
+MultiLayerConfiguration ready for MultiLayerNetwork.
+"""
+
+from deeplearning4j_tpu.models.zoo import lenet5, mlp, lstm_classifier, dbn

@@ -1,0 +1,167 @@
+"""Reference-parity architectures (BASELINE.json configs)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.enums import WeightInit
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def mlp(
+    sizes: Sequence[int] = (784, 500, 10),
+    activation: str = "relu",
+    lr: float = 0.1,
+    seed: int = 12345,
+    updater: Updater = Updater.NESTEROVS,
+):
+    """BASELINE.json configs[0]: MLP 784-500-10 on MNIST."""
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(updater)
+        .momentum(0.9)
+        .weight_init(WeightInit.XAVIER)
+        .list()
+    )
+    for i in range(len(sizes) - 2):
+        b.layer(
+            i,
+            L.DenseLayer(
+                n_in=sizes[i], n_out=sizes[i + 1], activation=activation
+            ),
+        )
+    b.layer(
+        len(sizes) - 2,
+        L.OutputLayer(
+            n_in=sizes[-2], n_out=sizes[-1], activation="softmax",
+            loss_function=LossFunction.MCXENT,
+        ),
+    )
+    return b.build()
+
+
+def lenet5(
+    height: int = 28,
+    width: int = 28,
+    channels: int = 1,
+    n_classes: int = 10,
+    lr: float = 0.05,
+    seed: int = 12345,
+):
+    """BASELINE.json configs[1]: LeNet-5-style CNN on MNIST (conv-pool-
+    conv-pool-dense-out, the reference's im2col path —
+    nn/layers/convolution/ConvolutionLayer.java:135 — as MXU convs)."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(Updater.NESTEROVS)
+        .momentum(0.9)
+        .weight_init(WeightInit.XAVIER)
+        .list()
+        .layer(
+            0,
+            L.ConvolutionLayer(
+                n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                activation="identity",
+            ),
+        )
+        .layer(
+            1,
+            L.SubsamplingLayer(
+                pooling_type=L.PoolingType.MAX,
+                kernel_size=(2, 2), stride=(2, 2),
+            ),
+        )
+        .layer(
+            2,
+            L.ConvolutionLayer(
+                n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                activation="identity",
+            ),
+        )
+        .layer(
+            3,
+            L.SubsamplingLayer(
+                pooling_type=L.PoolingType.MAX,
+                kernel_size=(2, 2), stride=(2, 2),
+            ),
+        )
+        .layer(4, L.DenseLayer(n_out=500, activation="relu"))
+        .layer(
+            5,
+            L.OutputLayer(
+                n_out=n_classes, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .set_input_type(InputType.convolutional(height, width, channels))
+        .build()
+    )
+
+
+def lstm_classifier(
+    n_in: int,
+    n_hidden: int,
+    n_classes: int,
+    lr: float = 0.05,
+    seed: int = 12345,
+):
+    """Sequence classifier: GravesLSTM -> RnnOutputLayer."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(Updater.ADAM)
+        .activation("tanh")
+        .list()
+        .layer(0, L.GravesLSTM(n_in=n_in, n_out=n_hidden))
+        .layer(
+            1,
+            L.RnnOutputLayer(
+                n_in=n_hidden, n_out=n_classes, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .build()
+    )
+
+
+def dbn(
+    sizes: Sequence[int] = (784, 500, 250, 10),
+    lr: float = 0.05,
+    seed: int = 12345,
+):
+    """BASELINE.json configs[3]: DBN — stacked RBMs + softmax output,
+    pretrain+finetune (reference MultiLayerNetwork.pretrain :150)."""
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(Updater.SGD)
+        .activation("sigmoid")
+        .list()
+    )
+    for i in range(len(sizes) - 2):
+        b.layer(
+            i,
+            L.RBM(
+                n_in=sizes[i], n_out=sizes[i + 1],
+                hidden_unit=L.HiddenUnit.BINARY,
+                visible_unit=L.VisibleUnit.BINARY,
+                loss_function=LossFunction.RECONSTRUCTION_CROSSENTROPY,
+            ),
+        )
+    b.layer(
+        len(sizes) - 2,
+        L.OutputLayer(
+            n_in=sizes[-2], n_out=sizes[-1], activation="softmax",
+            loss_function=LossFunction.MCXENT,
+        ),
+    )
+    return b.pretrain(True).backprop(True).build()

@@ -82,6 +82,7 @@ class ListBuilder:
         self._backprop_type = BackpropType.STANDARD
         self._tbptt_fwd = 20
         self._tbptt_bwd = 20
+        self._input_type = None
 
     def layer(self, index: int, layer_bean: L.Layer) -> "ListBuilder":
         self._layers[index] = layer_bean
@@ -113,6 +114,19 @@ class ListBuilder:
         self._tbptt_bwd = n
         return self
 
+    def set_input_type(self, input_type) -> "ListBuilder":
+        """Enable shape inference + automatic preprocessor insertion
+        (reference ConvolutionLayerSetup / setInputType)."""
+        self._input_type = input_type
+        return self
+
+    def cnn_input_size(self, height: int, width: int, channels: int) -> "ListBuilder":
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+        return self.set_input_type(
+            InputType.convolutional(height, width, channels)
+        )
+
     def build(self) -> MultiLayerConfiguration:
         if not self._layers:
             raise ValueError("No layers configured")
@@ -123,9 +137,11 @@ class ListBuilder:
         confs = []
         for i in range(n):
             c = self._base.clone()
-            c.layer = self._layers[i]
+            # Copy the bean so shape inference never mutates caller-owned
+            # objects (they may be reused across builders).
+            c.layer = dataclasses.replace(self._layers[i])
             confs.append(c)
-        return MultiLayerConfiguration(
+        conf = MultiLayerConfiguration(
             confs=confs,
             input_preprocessors={str(k): v for k, v in self._preprocessors.items()},
             backprop=self._backprop,
@@ -134,3 +150,8 @@ class ListBuilder:
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_bwd_length=self._tbptt_bwd,
         )
+        if self._input_type is not None:
+            from deeplearning4j_tpu.nn.conf.inputs import setup_shapes
+
+            setup_shapes(conf, self._input_type)
+        return conf

@@ -19,10 +19,17 @@ from deeplearning4j_tpu.nn.updater.updaters import resolve_lr
 
 
 def pretrain_network(net, data_iter) -> None:
+    # jitted steps are cached on the network so repeated pretrain() calls
+    # reuse the compiled executable instead of retracing.
+    cache = getattr(net, "_pretrain_step_cache", None)
+    if cache is None:
+        cache = net._pretrain_step_cache = {}
     for i, (conf, impl) in enumerate(zip(net.conf.confs, net._impls)):
         if not isinstance(conf.layer, PRETRAIN_LAYER_TYPES):
             continue
-        step = _make_pretrain_step(net, i, conf, impl)
+        step = cache.get(i)
+        if step is None:
+            step = cache[i] = _make_pretrain_step(net, i, conf, impl)
         data_iter.reset()
         n_iter = max(1, conf.num_iterations)
         for ds in data_iter:
@@ -31,12 +38,15 @@ def pretrain_network(net, data_iter) -> None:
             for _ in range(n_iter):
                 net._key, sub = jax.random.split(net._key)
                 si = str(i)
+                # lr resolved host-side per call so conf edits between
+                # pretrain() passes take effect despite the cached jit.
+                lr = resolve_lr(conf, net.iteration)
                 (
                     net.params[si],
                     net.updater_state[si],
                     score,
                 ) = step(net.params[si], net.updater_state[si],
-                         net.iteration, sub, x_in)
+                         net.iteration, lr, sub, x_in)
                 net.score_value = score
                 net.iteration += 1
                 for listener in net.listeners:
@@ -61,9 +71,8 @@ def _make_pretrain_step(net, i: int, conf, impl):
     upd = net._updaters[i]
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(layer_params, upd_state, iteration, rng, x):
+    def step(layer_params, upd_state, iteration, lr, rng, x):
         score, grads = impl.pretrain_value_and_grad(conf, layer_params, x, rng)
-        lr = resolve_lr(conf, iteration)
         updates, new_upd = upd.update(grads, upd_state, lr, iteration)
         new_params = jax.tree.map(lambda p, u: p - u, layer_params, updates)
         return new_params, new_upd, score

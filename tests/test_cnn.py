@@ -1,0 +1,272 @@
+"""CNN tests: shape inference, layer semantics, gradient checks, LeNet.
+
+Pattern from reference tests ConvolutionLayerTest, SubsamplingLayerTest,
+CNNProcessorTest, CNNGradientCheckTest (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.models.zoo import lenet5
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+RNG = np.random.default_rng(7)
+
+
+def _image_ds(n=4, c=1, h=10, w=10, n_out=3):
+    x = RNG.normal(size=(n, c, h, w)).astype(np.float32)
+    y = np.zeros((n, n_out), np.float32)
+    y[np.arange(n), RNG.integers(0, n_out, n)] = 1.0
+    return DataSet(x, y)
+
+
+class TestShapeInference:
+    def test_lenet_shapes(self):
+        conf = lenet5()
+        # conv1: 1->20ch 24x24; pool->12x12; conv2: 20->50ch 8x8; pool->4x4
+        assert conf.confs[0].layer.n_in == 1
+        assert conf.confs[2].layer.n_in == 20
+        assert conf.confs[4].layer.n_in == 50 * 4 * 4
+        assert conf.confs[5].layer.n_in == 500
+        pp = conf.preprocessor_for(4)
+        assert isinstance(pp, CnnToFeedForwardPreProcessor)
+        assert (pp.input_height, pp.input_width, pp.num_channels) == (4, 4, 50)
+
+    def test_invalid_geometry_raises(self):
+        with pytest.raises(ValueError, match="geometry"):
+            (
+                NeuralNetConfiguration.Builder()
+                .list()
+                .layer(
+                    0,
+                    L.ConvolutionLayer(n_out=4, kernel_size=(9, 9)),
+                )
+                .layer(1, L.OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.convolutional(6, 6, 1))
+                .build()
+            )
+
+
+class TestConvolutionForward:
+    def test_known_convolution_values(self):
+        """3x3 image, 2x2 kernel of ones, no pad: each output = window sum."""
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .list()
+            .layer(
+                0,
+                L.ConvolutionLayer(
+                    n_in=1, n_out=1, kernel_size=(2, 2), stride=(1, 1),
+                    activation="identity",
+                ),
+            )
+            .layer(
+                1,
+                L.OutputLayer(n_in=4, n_out=2, activation="softmax"),
+            )
+            .input_pre_processor(1, CnnToFeedForwardPreProcessor(2, 2, 1))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        net.params["0"]["W"] = np.ones((1, 1, 2, 2), np.float32)
+        net.params["0"]["b"] = np.zeros((1,), np.float32)
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        acts = net.feed_forward(x)
+        conv_out = np.asarray(acts[1])
+        expected = np.array([[0 + 1 + 3 + 4, 1 + 2 + 4 + 5],
+                             [3 + 4 + 6 + 7, 4 + 5 + 7 + 8]], np.float32)
+        np.testing.assert_allclose(conv_out[0, 0], expected)
+
+    def test_max_and_avg_pooling_values(self):
+        for pool, expected in [
+            (L.PoolingType.MAX, np.array([[4.0, 5.0], [7.0, 8.0]])),
+            (L.PoolingType.AVG, np.array([[2.0, 3.0], [5.0, 6.0]])),
+        ]:
+            conf = (
+                NeuralNetConfiguration.Builder()
+                .list()
+                .layer(
+                    0,
+                    L.SubsamplingLayer(
+                        pooling_type=pool, kernel_size=(2, 2), stride=(1, 1)
+                    ),
+                )
+                .layer(1, L.OutputLayer(n_in=4, n_out=2, activation="softmax"))
+                .input_pre_processor(1, CnnToFeedForwardPreProcessor(2, 2, 1))
+                .build()
+            )
+            net = MultiLayerNetwork(conf).init()
+            x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+            out = np.asarray(net.feed_forward(x)[1])
+            np.testing.assert_allclose(out[0, 0], expected)
+
+
+class TestCNNGradients:
+    def test_conv_pool_dense_gradient_check(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .list()
+            .layer(
+                0,
+                L.ConvolutionLayer(
+                    n_out=3, kernel_size=(3, 3), activation="tanh"
+                ),
+            )
+            .layer(
+                1,
+                L.SubsamplingLayer(
+                    pooling_type=L.PoolingType.MAX,
+                    kernel_size=(2, 2), stride=(2, 2),
+                ),
+            )
+            .layer(2, L.DenseLayer(n_out=8, activation="tanh"))
+            .layer(
+                3,
+                L.OutputLayer(
+                    n_out=3, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+            )
+            .set_input_type(InputType.convolutional(10, 10, 1))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(
+            net, _image_ds(), max_params_to_check=50, print_results=True
+        )
+
+    def test_lrn_gradient_check(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .list()
+            .layer(
+                0,
+                L.ConvolutionLayer(
+                    n_out=4, kernel_size=(3, 3), activation="tanh"
+                ),
+            )
+            .layer(1, L.LocalResponseNormalization())
+            .layer(
+                2,
+                L.OutputLayer(
+                    n_out=3, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+            )
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(
+            net, _image_ds(h=8, w=8), max_params_to_check=40,
+            print_results=True,
+        )
+
+    def test_batchnorm_gradient_check(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .list()
+            .layer(0, L.DenseLayer(n_in=6, n_out=8, activation="tanh"))
+            .layer(1, L.BatchNormalization(n_in=8, n_out=8))
+            .layer(
+                2,
+                L.OutputLayer(
+                    n_in=8, n_out=3, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+            )
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.normal(size=(8, 6)).astype(np.float32)
+        y = np.zeros((8, 3), np.float32)
+        y[np.arange(8), RNG.integers(0, 3, 8)] = 1.0
+        assert check_gradients(
+            net, DataSet(x, y), max_params_to_check=40, print_results=True
+        )
+
+
+class TestLeNetTraining:
+    def test_lenet_learns_synthetic_mnist(self):
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        from deeplearning4j_tpu.datasets.mnist import mnist_dataset
+
+        net = MultiLayerNetwork(lenet5(lr=0.05)).init()
+        train = mnist_dataset(train=True, num_examples=2048, as_image=True, seed=3)
+        test = mnist_dataset(train=False, num_examples=512, as_image=True)
+        for _ in range(3):
+            for batch in train.batch_by(128):
+                net.fit(batch)
+        ev = net.evaluate(ListDataSetIterator(test.batch_by(256)))
+        assert ev.accuracy() > 0.85, ev.stats()
+
+    def test_batchnorm_running_stats_update(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .list()
+            .layer(0, L.BatchNormalization(n_in=4, n_out=4))
+            .layer(1, L.OutputLayer(n_in=4, n_out=2, activation="softmax"))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        before = np.asarray(net.state["0"]["mean"]).copy()
+        x = RNG.normal(loc=5.0, size=(32, 4)).astype(np.float32)
+        y = np.zeros((32, 2), np.float32)
+        y[:, 0] = 1.0
+        net.fit(DataSet(x, y))
+        after = np.asarray(net.state["0"]["mean"])
+        assert not np.allclose(before, after)
+
+
+class TestShapeInferenceRegressions:
+    def test_conv_bn_conv_stack(self):
+        """BatchNormalization between convs must not trigger CNN->FF
+        flattening (it is shape-preserving in every representation)."""
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .list()
+            .layer(0, L.ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                         activation="relu"))
+            .layer(1, L.BatchNormalization())
+            .layer(2, L.ConvolutionLayer(n_out=6, kernel_size=(3, 3),
+                                         activation="relu"))
+            .layer(3, L.OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.convolutional(10, 10, 1))
+            .build()
+        )
+        assert conf.confs[1].layer.n_in == 4  # per-channel BN
+        assert conf.confs[2].layer.n_in == 4
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(np.zeros((2, 1, 10, 10), np.float32))
+        assert out.shape == (2, 2)
+
+    def test_builder_does_not_mutate_caller_beans(self):
+        dense = L.DenseLayer(n_out=10)
+        out = L.OutputLayer(n_out=3, activation="softmax")
+        from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+
+        conf1 = (
+            NeuralNetConfiguration.Builder().list()
+            .layer(0, dense).layer(1, out)
+            .set_input_type(IT.feed_forward(784)).build()
+        )
+        conf2 = (
+            NeuralNetConfiguration.Builder().list()
+            .layer(0, dense).layer(1, out)
+            .set_input_type(IT.feed_forward(100)).build()
+        )
+        assert dense.n_in == 0  # caller bean untouched
+        assert conf1.confs[0].layer.n_in == 784
+        assert conf2.confs[0].layer.n_in == 100

@@ -1,0 +1,115 @@
+"""Early stopping tests (pattern from reference TestEarlyStopping.java)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iris import iris_dataset
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.config import TerminationReason
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _net(lr=0.1):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(42)
+        .learning_rate(lr)
+        .list()
+        .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="relu"))
+        .layer(1, L.OutputLayer(n_in=8, n_out=3, activation="softmax"))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _iters():
+    ds = iris_dataset()
+    ds.normalize_zero_mean_unit_variance()
+    train, test = ds.split_test_and_train(120)
+    return (
+        ListDataSetIterator(train.batch_by(40)),
+        ListDataSetIterator([test]),
+    )
+
+
+class TestEarlyStopping:
+    def test_max_epochs_termination(self):
+        train_it, test_it = _iters()
+        conf = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(test_it))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+            .model_saver(InMemoryModelSaver())
+            .build()
+        )
+        result = EarlyStoppingTrainer(conf, _net(), train_it).fit()
+        assert (
+            result.termination_reason
+            == TerminationReason.EPOCH_TERMINATION_CONDITION
+        )
+        assert result.total_epochs == 5
+        assert result.best_model is not None
+        assert np.isfinite(result.best_model_score)
+
+    def test_score_improvement_termination(self):
+        train_it, test_it = _iters()
+        conf = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(test_it))
+            .epoch_termination_conditions(
+                ScoreImprovementEpochTerminationCondition(3),
+                MaxEpochsTerminationCondition(500),
+            )
+            .build()
+        )
+        # lr=0 -> no learning -> no improvement -> stops after 4 stale epochs
+        result = EarlyStoppingTrainer(conf, _net(lr=0.0), train_it).fit()
+        assert result.total_epochs < 10
+
+    def test_invalid_score_termination(self):
+        train_it, test_it = _iters()
+        conf = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(test_it))
+            .iteration_termination_conditions(
+                InvalidScoreIterationTerminationCondition()
+            )
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+            .build()
+        )
+        # Absurd learning rate diverges to nan/inf quickly.
+        result = EarlyStoppingTrainer(conf, _net(lr=1e6), train_it).fit()
+        assert result.termination_reason in (
+            TerminationReason.ITERATION_TERMINATION_CONDITION,
+            TerminationReason.EPOCH_TERMINATION_CONDITION,
+        )
+
+    def test_local_file_saver_round_trip(self, tmp_path):
+        train_it, test_it = _iters()
+        saver = LocalFileModelSaver(str(tmp_path))
+        conf = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(test_it))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+            .model_saver(saver)
+            .save_last_model(True)
+            .build()
+        )
+        EarlyStoppingTrainer(conf, _net(), train_it).fit()
+        best = saver.get_best_model()
+        latest = saver.get_latest_model()
+        assert best is not None and latest is not None
+        x = np.zeros((2, 4), np.float32)
+        assert best.output(x).shape == (2, 3)

@@ -1,0 +1,133 @@
+"""Pretraining tests: RBM CD-k, denoising autoencoder, DBN pretrain+finetune.
+
+Pattern from reference RBMTests, nn/multilayer pretrain paths (SURVEY.md
+§3.3, §4).
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.models.zoo import dbn
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+RNG = np.random.default_rng(11)
+
+
+def _binary_patterns(n=128, d=12):
+    """Two prototype binary patterns + flip noise: reconstructible."""
+    protos = (RNG.random((2, d)) > 0.5).astype(np.float32)
+    idx = RNG.integers(0, 2, n)
+    x = protos[idx].copy()
+    flips = RNG.random((n, d)) < 0.05
+    x[flips] = 1.0 - x[flips]
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), idx] = 1.0
+    return DataSet(x, y)
+
+
+class TestRBM:
+    def _rbm_net(self, d=12, h=8, lr=0.1):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(lr)
+            .activation("sigmoid")
+            .list()
+            .layer(
+                0,
+                L.RBM(
+                    n_in=d, n_out=h,
+                    loss_function=LossFunction.RECONSTRUCTION_CROSSENTROPY,
+                ),
+            )
+            .layer(
+                1,
+                L.OutputLayer(n_in=h, n_out=2, activation="softmax"),
+            )
+            .pretrain(True)
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def test_cd1_reduces_reconstruction_error(self):
+        net = self._rbm_net()
+        ds = _binary_patterns()
+        it = ListDataSetIterator([ds])
+
+        def recon_error(net):
+            from deeplearning4j_tpu.nn.layers.pretrain import RBMImpl
+            import jax.numpy as jnp
+
+            v = jnp.asarray(ds.features)
+            h = RBMImpl._hidden_mean(net.conf.confs[0], net.params["0"], v)
+            recon = RBMImpl._visible_mean(
+                net.conf.confs[0], net.params["0"], h
+            )
+            return float(jnp.mean((v - recon) ** 2))
+
+        before = recon_error(net)
+        for _ in range(30):
+            net.pretrain(it)
+        after = recon_error(net)
+        assert after < before * 0.8, (before, after)
+
+    def test_pretrain_changes_only_pretrainable_layer(self):
+        net = self._rbm_net()
+        out_w_before = np.asarray(net.param_table()["1_W"]).copy()
+        rbm_w_before = np.asarray(net.param_table()["0_W"]).copy()
+        net.pretrain(ListDataSetIterator([_binary_patterns()]))
+        assert not np.allclose(
+            rbm_w_before, np.asarray(net.param_table()["0_W"])
+        )
+        np.testing.assert_array_equal(
+            out_w_before, np.asarray(net.param_table()["1_W"])
+        )
+
+
+class TestAutoEncoder:
+    def test_denoising_ae_reduces_loss(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.5)
+            .activation("sigmoid")
+            .list()
+            .layer(
+                0,
+                L.AutoEncoder(
+                    n_in=12, n_out=6, corruption_level=0.2,
+                    loss_function=LossFunction.RECONSTRUCTION_CROSSENTROPY,
+                ),
+            )
+            .layer(1, L.OutputLayer(n_in=6, n_out=2, activation="softmax"))
+            .pretrain(True)
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        ds = _binary_patterns()
+        it = ListDataSetIterator([ds])
+        net.pretrain(it)
+        first_score = float(net.score_value)
+        for _ in range(40):
+            net.pretrain(it)
+        assert float(net.score_value) < first_score * 0.8
+
+
+class TestDBN:
+    def test_dbn_pretrain_then_finetune(self):
+        conf = dbn(sizes=(12, 10, 6, 2), lr=0.5)
+        net = MultiLayerNetwork(conf).init()
+        ds = _binary_patterns()
+        it = ListDataSetIterator(ds.batch_by(64))
+        # Greedy layer-wise pretrain once, then supervised fine-tuning
+        # (reference pretrain :150 then finetune via fit :1130-1147).
+        net.pretrain(it)
+        conf.pretrain = False
+        for _ in range(20):
+            net.fit(it)
+        ev = net.evaluate(ListDataSetIterator([ds]))
+        assert ev.accuracy() > 0.9, ev.stats()

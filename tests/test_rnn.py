@@ -1,0 +1,190 @@
+"""RNN tests: LSTM/GRU/BiLSTM gradients, masking, tBPTT, streaming.
+
+Pattern from reference GravesLSTMTest, GRUTest, MultiLayerTestRNN,
+TestVariableLengthTS, GradientCheckTestsMasking (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.models.zoo import lstm_classifier
+from deeplearning4j_tpu.nn.conf import BackpropType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+RNG = np.random.default_rng(99)
+
+
+def _seq_ds(n=4, n_in=3, n_out=2, t=6, with_mask=False):
+    x = RNG.normal(size=(n, n_in, t)).astype(np.float32)
+    y = np.zeros((n, n_out, t), np.float32)
+    cls = RNG.integers(0, n_out, (n, t))
+    for i in range(n):
+        y[i, cls[i], np.arange(t)] = 1.0
+    fm = lm = None
+    if with_mask:
+        lengths = RNG.integers(2, t + 1, n)
+        fm = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float32)
+        lm = fm.copy()
+    return DataSet(x, y, fm, lm)
+
+
+def _rnn_conf(layer_bean, n_hidden=4, n_in=3, n_out=2):
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(42)
+        .activation("tanh")
+        .list()
+        .layer(0, layer_bean)
+        .layer(
+            1,
+            L.RnnOutputLayer(
+                n_in=n_hidden, n_out=n_out, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .build()
+    )
+
+
+class TestRecurrentGradients:
+    @pytest.mark.parametrize(
+        "bean",
+        [
+            L.GravesLSTM(n_in=3, n_out=4),
+            L.GRU(n_in=3, n_out=4),
+            L.GravesBidirectionalLSTM(n_in=3, n_out=4),
+        ],
+        ids=["lstm", "gru", "bilstm"],
+    )
+    def test_gradient_check(self, bean):
+        net = MultiLayerNetwork(_rnn_conf(bean)).init()
+        assert check_gradients(
+            net, _seq_ds(), max_params_to_check=50, print_results=True
+        )
+
+    def test_gradient_check_with_masks(self):
+        net = MultiLayerNetwork(
+            _rnn_conf(L.GravesLSTM(n_in=3, n_out=4))
+        ).init()
+        assert check_gradients(
+            net, _seq_ds(with_mask=True), max_params_to_check=50,
+            print_results=True,
+        )
+
+
+class TestShapesAndParams:
+    def test_lstm_param_shapes(self):
+        net = MultiLayerNetwork(
+            _rnn_conf(L.GravesLSTM(n_in=3, n_out=4))
+        ).init()
+        t = net.param_table()
+        assert t["0_W"].shape == (3, 16)
+        assert t["0_RW"].shape == (4, 19)  # 4*4 gates + 3 peephole columns
+        assert t["0_b"].shape == (16,)
+        # Forget-gate bias block initialized to 1.
+        b = np.asarray(t["0_b"])
+        np.testing.assert_allclose(b[4:8], 1.0)
+        np.testing.assert_allclose(b[:4], 0.0)
+
+    def test_output_shape(self):
+        net = MultiLayerNetwork(
+            _rnn_conf(L.GravesLSTM(n_in=3, n_out=4))
+        ).init()
+        out = net.output(np.zeros((5, 3, 7), np.float32))
+        assert out.shape == (5, 2, 7)
+        # Softmax over class axis per timestep.
+        np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, atol=1e-5)
+
+
+class TestMasking:
+    def test_masked_timesteps_do_not_affect_loss(self):
+        """Changing features at masked positions must not change the score."""
+        net = MultiLayerNetwork(
+            _rnn_conf(L.GravesLSTM(n_in=3, n_out=4))
+        ).init()
+        ds = _seq_ds(with_mask=True)
+        s1 = net.score(ds)
+        noisy = ds.features.copy()
+        # Perturb only masked-out positions.
+        mask3 = ds.features_mask[:, None, :]
+        noisy = noisy + 100.0 * (1.0 - mask3)
+        s2 = net.score(DataSet(noisy, ds.labels, ds.features_mask, ds.labels_mask))
+        np.testing.assert_allclose(s1, s2, rtol=1e-5)
+
+
+class TestStreaming:
+    def test_rnn_time_step_matches_full_forward(self):
+        net = MultiLayerNetwork(
+            _rnn_conf(L.GravesLSTM(n_in=3, n_out=4))
+        ).init()
+        x = RNG.normal(size=(2, 3, 5)).astype(np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        step_outs = []
+        for t in range(5):
+            out = net.rnn_time_step(x[:, :, t])
+            step_outs.append(np.asarray(out)[:, :, 0])
+        stepped = np.stack(step_outs, axis=2)
+        np.testing.assert_allclose(full, stepped, atol=1e-5)
+
+    def test_clear_state_resets(self):
+        net = MultiLayerNetwork(
+            _rnn_conf(L.GravesLSTM(n_in=3, n_out=4))
+        ).init()
+        x = RNG.normal(size=(1, 3)).astype(np.float32)
+        a = np.asarray(net.rnn_time_step(x))
+        b = np.asarray(net.rnn_time_step(x))
+        assert not np.allclose(a, b)  # state carried
+        net.rnn_clear_previous_state()
+        c = np.asarray(net.rnn_time_step(x))
+        np.testing.assert_allclose(a, c, atol=1e-6)
+
+
+class TestTBPTT:
+    def test_tbptt_trains_and_windows(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.05)
+            .activation("tanh")
+            .list()
+            .layer(0, L.GravesLSTM(n_in=3, n_out=8))
+            .layer(
+                1,
+                L.RnnOutputLayer(
+                    n_in=8, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+            )
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .t_bptt_forward_length(5)
+            .t_bptt_backward_length(5)
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        ds = _seq_ds(n=4, t=20)
+        net.fit(ds)
+        # 20 timesteps / window 5 = 4 optimizer iterations.
+        assert net.iteration == 4
+        assert np.isfinite(net.score_value)
+
+    def test_lstm_learns_sequence_task(self):
+        """Predict sign of the running sum — requires memory."""
+        conf = lstm_classifier(n_in=1, n_hidden=12, n_classes=2, lr=0.02)
+        net = MultiLayerNetwork(conf).init()
+        n, t = 64, 10
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(n, 1, t)).astype(np.float32)
+        csum = np.cumsum(x[:, 0, :], axis=1)
+        y = np.zeros((n, 2, t), np.float32)
+        y[:, 0, :] = (csum <= 0).astype(np.float32)
+        y[:, 1, :] = (csum > 0).astype(np.float32)
+        ds = DataSet(x, y)
+        first = net.score(ds)
+        for _ in range(60):
+            net.fit(ds)
+        assert net.score(ds) < first * 0.6
