@@ -47,44 +47,64 @@ class EarlyStoppingTrainer:
         start_ms = time.time() * 1000.0
         reason = None
         details = ""
+        last_score = math.inf
 
-        while reason is None:
-            self.train_iterator.reset()
-            for ds in self.train_iterator:
-                self.net.fit(ds)
-                elapsed = time.time() * 1000.0 - start_ms
-                score = float(self.net.score_value)
-                for cond in cfg.iteration_terminations:
-                    if cond.terminate(elapsed, score):
-                        reason = TerminationReason.ITERATION_TERMINATION_CONDITION
+        try:
+            while reason is None:
+                self.train_iterator.reset()
+                for ds in self.train_iterator:
+                    self.net.fit(ds)
+                    if not cfg.iteration_terminations:
+                        continue  # keep device dispatch asynchronous
+                    elapsed = time.time() * 1000.0 - start_ms
+                    score = float(self.net.score_value)
+                    for cond in cfg.iteration_terminations:
+                        if cond.terminate(elapsed, score):
+                            reason = (
+                                TerminationReason.ITERATION_TERMINATION_CONDITION
+                            )
+                            details = f"{type(cond).__name__} at epoch {epoch}"
+                            break
+                    if reason is not None:
+                        break
+
+                if reason is not None:
+                    # Reference saves the latest model when an iteration
+                    # condition fires (BaseEarlyStoppingTrainer.java:147-154).
+                    if cfg.save_last_model:
+                        cfg.model_saver.save_latest_model(
+                            self.net, float(self.net.score_value)
+                        )
+                    break
+
+                if epoch % max(1, cfg.evaluate_every_n_epochs) == 0:
+                    if cfg.score_calculator is not None:
+                        last_score = cfg.score_calculator.calculate_score(
+                            self.net
+                        )
+                    else:
+                        last_score = float(self.net.score_value)
+                    score_vs_epoch[epoch] = last_score
+                    if last_score < best_score:
+                        best_score = last_score
+                        best_epoch = epoch
+                        cfg.model_saver.save_best_model(self.net, last_score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.net, last_score)
+                # Epoch conditions run EVERY epoch with the latest known
+                # score (epoch counts, not evaluation counts).
+                for cond in cfg.epoch_terminations:
+                    if cond.terminate(epoch, last_score):
+                        reason = TerminationReason.EPOCH_TERMINATION_CONDITION
                         details = f"{type(cond).__name__} at epoch {epoch}"
                         break
                 if reason is not None:
                     break
-
-            if reason is not None:
-                break
-
-            if epoch % max(1, cfg.evaluate_every_n_epochs) == 0:
-                if cfg.score_calculator is not None:
-                    score = cfg.score_calculator.calculate_score(self.net)
-                else:
-                    score = float(self.net.score_value)
-                score_vs_epoch[epoch] = score
-                if score < best_score:
-                    best_score = score
-                    best_epoch = epoch
-                    cfg.model_saver.save_best_model(self.net, score)
-                if cfg.save_last_model:
-                    cfg.model_saver.save_latest_model(self.net, score)
-                for cond in cfg.epoch_terminations:
-                    if cond.terminate(epoch, score):
-                        reason = TerminationReason.EPOCH_TERMINATION_CONDITION
-                        details = f"{type(cond).__name__} at epoch {epoch}"
-                        break
-            if reason is not None:
-                break
-            epoch += 1
+                epoch += 1
+        except Exception as e:  # return best-so-far (reference :86-126)
+            log.exception("Early stopping training failed")
+            reason = TerminationReason.ERROR
+            details = f"{type(e).__name__}: {e}"
 
         best = cfg.model_saver.get_best_model()
         if best is None:

@@ -1,0 +1,27 @@
+"""NLP: embeddings (Word2Vec/GloVe/ParagraphVectors) + text pipeline.
+
+Mirror of reference deeplearning4j-scaleout/deeplearning4j-nlp (32,749 LoC
+— SURVEY.md §2.8): SequenceVectors engine, Word2Vec skip-gram with
+hierarchical softmax + negative sampling, vocabulary construction with
+Huffman coding, tokenizers/sentence iterators, vector serialization.
+
+TPU inversion (SURVEY.md §7 stage 11): the reference trains via Hogwild —
+N threads racing lock-free on shared syn0/syn1 tables
+(SequenceVectors.fit :133-160, InMemoryLookupTable.iterateSample). Here
+training is *batched deterministic SPMD*: pairs are mined host-side into
+index arrays and the update is one jitted gather/scatter-add computation,
+data-parallel over the mesh — same convergence role, reproducible, and the
+scatter rides the MXU/VPU instead of the Java memory bus.
+"""
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LineSentenceIterator,
+)

@@ -1,0 +1,146 @@
+"""GloVe: co-occurrence counting + weighted least-squares factorization.
+
+Mirror of reference nlp models/glove/{Glove.java:31, AbstractCoOccurrences,
+GloveWeightLookupTable}. The reference counts co-occurrences with an actor
+pipeline spilling to binary files and trains with per-element AdaGrad
+(Hogwild); here counting is a host-side dict pass (1/distance weighting,
+symmetric window) and training is a jitted batched AdaGrad scatter update.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.vocab import build_vocab
+
+
+class Glove(SequenceVectors):
+    def __init__(
+        self,
+        layer_size: int = 100,
+        window: int = 15,
+        learning_rate: float = 0.05,
+        min_word_frequency: int = 5,
+        epochs: int = 25,
+        x_max: float = 100.0,
+        alpha: float = 0.75,
+        batch_size: int = 65536,
+        symmetric: bool = True,
+        seed: int = 12345,
+    ):
+        super().__init__(
+            layer_size=layer_size,
+            window=window,
+            learning_rate=learning_rate,
+            min_word_frequency=min_word_frequency,
+            epochs=epochs,
+            batch_size=batch_size,
+            seed=seed,
+            use_hierarchic_softmax=False,
+        )
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+
+    # ------------------------------------------------------------------
+    def _count_cooccurrences(
+        self, sequences: Iterable[Sequence[str]]
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        counts: Dict[Tuple[int, int], float] = {}
+        for tokens in sequences:
+            idxs = [
+                self.vocab.index_of(t)
+                for t in tokens
+                if self.vocab.contains_word(t)
+            ]
+            for pos, center in enumerate(idxs):
+                for off in range(1, self.window + 1):
+                    j = pos + off
+                    if j >= len(idxs):
+                        break
+                    w = 1.0 / off
+                    a, b = center, idxs[j]
+                    counts[(a, b)] = counts.get((a, b), 0.0) + w
+                    if self.symmetric:
+                        counts[(b, a)] = counts.get((b, a), 0.0) + w
+        if not counts:
+            raise ValueError("Empty co-occurrence matrix")
+        ij = np.asarray(list(counts.keys()), np.int32)
+        x = np.asarray(list(counts.values()), np.float32)
+        return ij[:, 0], ij[:, 1], x
+
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _glove_step(self):
+        x_max, alpha = self.x_max, self.alpha
+
+        @jax.jit
+        def step(w, wt, b, bt, gw, gwt, gb, gbt, rows, cols, xij, lr):
+            wi = w[rows]
+            wj = wt[cols]
+            diff = (
+                jnp.sum(wi * wj, axis=-1) + b[rows] + bt[cols] - jnp.log(xij)
+            )
+            fx = jnp.minimum(1.0, (xij / x_max) ** alpha)
+            g = fx * diff  # [B]
+            loss = 0.5 * jnp.mean(fx * diff * diff)
+            dwi = g[:, None] * wj
+            dwj = g[:, None] * wi
+            # AdaGrad accumulators (reference GloveWeightLookupTable's
+            # per-element historical gradient).
+            gw = gw.at[rows].add(dwi * dwi)
+            gwt = gwt.at[cols].add(dwj * dwj)
+            gb = gb.at[rows].add(g * g)
+            gbt = gbt.at[cols].add(g * g)
+            w = w.at[rows].add(-lr * dwi / jnp.sqrt(gw[rows] + 1e-8))
+            wt = wt.at[cols].add(-lr * dwj / jnp.sqrt(gwt[cols] + 1e-8))
+            b = b.at[rows].add(-lr * g / jnp.sqrt(gb[rows] + 1e-8))
+            bt = bt.at[cols].add(-lr * g / jnp.sqrt(gbt[cols] + 1e-8))
+            return w, wt, b, bt, gw, gwt, gb, gbt, loss
+
+        return step
+
+    # ------------------------------------------------------------------
+    def fit(self, sequences_factory) -> None:
+        seqs = (
+            sequences_factory()
+            if callable(sequences_factory)
+            else sequences_factory
+        )
+        seqs = list(seqs)
+        if self.vocab is None:
+            self.vocab = build_vocab(seqs, self.min_word_frequency)
+        v, d = self.vocab.num_words(), self.layer_size
+        key = jax.random.key(self.seed)
+        k1, k2 = jax.random.split(key)
+        w = (jax.random.uniform(k1, (v, d)) - 0.5) / d
+        wt = (jax.random.uniform(k2, (v, d)) - 0.5) / d
+        b = jnp.zeros((v,))
+        bt = jnp.zeros((v,))
+        gw = jnp.zeros((v, d))
+        gwt = jnp.zeros((v, d))
+        gb = jnp.zeros((v,))
+        gbt = jnp.zeros((v,))
+
+        rows, cols, xij = self._count_cooccurrences(seqs)
+        rng = np.random.default_rng(self.seed)
+        n = len(rows)
+        self.losses: List[float] = []
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            for start in range(0, n, self.batch_size):
+                sel = order[start : start + self.batch_size]
+                (w, wt, b, bt, gw, gwt, gb, gbt, loss) = self._glove_step(
+                    w, wt, b, bt, gw, gwt, gb, gbt,
+                    jnp.asarray(rows[sel]), jnp.asarray(cols[sel]),
+                    jnp.asarray(xij[sel]), self.learning_rate,
+                )
+            self.losses.append(float(loss))
+        # Final embedding = w + wt (standard GloVe practice).
+        self.syn0 = w + wt

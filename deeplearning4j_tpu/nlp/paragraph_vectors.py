@@ -1,0 +1,148 @@
+"""ParagraphVectors: document embeddings (PV-DBOW).
+
+Mirror of reference nlp models/paragraphvectors/ParagraphVectors.java
+(666 LoC): document labels are added to the vocabulary and trained like
+words — the DBOW sequence-learning algorithm (learning/impl/sequence/
+DBOW.java) trains the label vector to predict each word in the document
+via the same HS/NS objective. Inference for unseen docs trains a fresh
+vector against the frozen word tables.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabCache,
+    assign_huffman_codes,
+    build_vocab,
+)
+
+
+class ParagraphVectors(SequenceVectors):
+    LABEL_PREFIX = "DOC_"
+
+    def __init__(self, tokenizer_factory: Optional[TokenizerFactory] = None,
+                 **kwargs):
+        kwargs.setdefault("min_word_frequency", 1)
+        super().__init__(**kwargs)
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.labels: List[str] = []
+
+    # ------------------------------------------------------------------
+    def fit_documents(
+        self, docs: Sequence[str], labels: Optional[Sequence[str]] = None
+    ) -> None:
+        if labels is None:
+            labels = [f"{self.LABEL_PREFIX}{i}" for i in range(len(docs))]
+        self.labels = list(labels)
+        token_docs = [
+            self.tokenizer_factory.create(d).get_tokens() for d in docs
+        ]
+        # Vocab over words only; labels appended after (reference adds
+        # labels to the vocab with count ~ document length).
+        self.vocab = build_vocab(token_docs, self.min_word_frequency)
+        for lbl, toks in zip(labels, token_docs):
+            vw = self.vocab.add_token(lbl, max(1, len(toks)))
+        self.vocab.finalize_indices()
+        if self.use_hs:
+            assign_huffman_codes(self.vocab)
+        self._reset_weights()
+
+        # DBOW pairs: (center=word, context=label) — the label vector
+        # learns to predict every word of its document.
+        def factory():
+            return self._label_sequences(token_docs, labels)
+
+        super().fit(factory)
+
+    def _label_sequences(self, token_docs, labels):
+        """Each 'sequence' = [label, w1, w2, ...]; the engine's window pair
+        mining would mix word-word pairs too (that is PV + W2V combined,
+        which the reference also trains); to keep the DBOW objective we
+        mine label-word pairs explicitly instead."""
+        out = []
+        for lbl, toks in zip(labels, token_docs):
+            kept = [t for t in toks if self.vocab.contains_word(t)]
+            out.append((lbl, kept))
+        return out
+
+    # Override pair mining: every (word, label) pair of each doc.
+    def _mine_pairs(self, sequences, rng):
+        centers: List[int] = []
+        contexts: List[int] = []
+        for lbl, toks in sequences:
+            li = self.vocab.index_of(lbl)
+            if li < 0:
+                continue
+            for t in toks:
+                centers.append(self.vocab.index_of(t))
+                contexts.append(li)
+                if len(centers) >= self.batch_size:
+                    yield (
+                        np.asarray(centers, np.int32),
+                        np.asarray(contexts, np.int32),
+                    )
+                    centers, contexts = [], []
+        if centers:
+            yield (
+                np.asarray(centers, np.int32),
+                np.asarray(contexts, np.int32),
+            )
+
+    # ------------------------------------------------------------------
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        return self.get_word_vector(label)
+
+    def infer_vector(self, text: str, steps: int = 50,
+                     lr: float = 0.025) -> np.ndarray:
+        """Train a fresh vector for unseen text against frozen tables
+        (reference inferVector)."""
+        toks = [
+            t
+            for t in self.tokenizer_factory.create(text).get_tokens()
+            if self.vocab.contains_word(t)
+        ]
+        d = self.layer_size
+        key = jax.random.key(abs(hash(text)) % (2**31))
+        vec = (jax.random.uniform(key, (d,)) - 0.5) / d
+        if not toks:
+            return np.asarray(vec)
+        idxs = jnp.asarray(
+            [self.vocab.index_of(t) for t in toks], jnp.int32
+        )
+        codes = self._codes[idxs].astype(jnp.float32)
+        points = self._points[idxs]
+        cmask = self._code_mask[idxs]
+        syn1 = self.syn1
+
+        @jax.jit
+        def one_step(vec, lr):
+            w = syn1[points]  # [T, L, D]
+            dot = jnp.einsum("tld,d->tl", w, vec)
+            g = (1.0 - codes - jax.nn.sigmoid(dot)) * cmask
+            dvec = jnp.einsum("tl,tld->d", g, w)
+            return vec + lr * dvec
+
+        for s in range(steps):
+            vec = one_step(vec, lr * (1.0 - s / steps))
+        return np.asarray(vec)
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        v = self.infer_vector(text)
+        u = self.doc_vector(label)
+        if u is None:
+            return float("nan")
+        return float(
+            np.dot(v, u)
+            / (np.linalg.norm(v) * np.linalg.norm(u) + 1e-12)
+        )

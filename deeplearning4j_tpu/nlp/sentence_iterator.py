@@ -1,0 +1,153 @@
+"""Sentence/document iterators.
+
+Mirror of reference nlp text/sentenceiterator/** (BasicLineIterator,
+CollectionSentenceIterator, FileSentenceIterator, LineSentenceIterator,
+preprocessors, label-aware variants).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, Iterator, List, Optional
+
+
+class SentencePreProcessor:
+    def pre_process(self, sentence: str) -> str:
+        raise NotImplementedError
+
+
+class SentenceIterator:
+    def __init__(self):
+        self.preprocessor: Optional[Callable[[str], str]] = None
+
+    def _apply(self, s: str) -> str:
+        return self.preprocessor(s) if self.preprocessor else s
+
+    def __iter__(self) -> Iterator[str]:
+        self.reset()
+        while self.has_next():
+            yield self.next_sentence()
+
+    def next_sentence(self) -> str:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Iterable[str]):
+        super().__init__()
+        self._list: List[str] = list(sentences)
+        self._i = 0
+
+    def next_sentence(self) -> str:
+        s = self._list[self._i]
+        self._i += 1
+        return self._apply(s)
+
+    def has_next(self) -> bool:
+        return self._i < len(self._list)
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class LineSentenceIterator(SentenceIterator):
+    """One sentence per line of a file (reference LineSentenceIterator /
+    BasicLineIterator)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._f = None
+        self._next: Optional[str] = None
+        self.reset()
+
+    def _advance(self) -> None:
+        line = self._f.readline()
+        while line and not line.strip():
+            line = self._f.readline()
+        self._next = line.strip() if line else None
+
+    def next_sentence(self) -> str:
+        s = self._next
+        self._advance()
+        return self._apply(s)
+
+    def has_next(self) -> bool:
+        return self._next is not None
+
+    def reset(self) -> None:
+        if self._f:
+            self._f.close()
+        self._f = open(self.path, "r", encoding="utf-8", errors="replace")
+        self._advance()
+
+
+class FileSentenceIterator(SentenceIterator):
+    """Every line of every file under a directory (reference
+    FileSentenceIterator)."""
+
+    def __init__(self, directory: str):
+        super().__init__()
+        self.directory = directory
+        self.reset()
+
+    def _files(self) -> List[str]:
+        out = []
+        for root, _, files in os.walk(self.directory):
+            for f in sorted(files):
+                out.append(os.path.join(root, f))
+        return out
+
+    def reset(self) -> None:
+        self._lines: List[str] = []
+        for path in self._files():
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                self._lines.extend(
+                    line.strip() for line in f if line.strip()
+                )
+        self._i = 0
+
+    def next_sentence(self) -> str:
+        s = self._lines[self._i]
+        self._i += 1
+        return self._apply(s)
+
+    def has_next(self) -> bool:
+        return self._i < len(self._lines)
+
+
+class LabelAwareSentenceIterator(SentenceIterator):
+    """Sentence + current label, for ParagraphVectors (reference
+    labelaware variants)."""
+
+    def current_label(self) -> str:
+        raise NotImplementedError
+
+
+class LabelledCollectionSentenceIterator(LabelAwareSentenceIterator):
+    def __init__(self, sentences: List[str], labels: List[str]):
+        super().__init__()
+        assert len(sentences) == len(labels)
+        self._sentences = sentences
+        self._labels = labels
+        self._i = 0
+
+    def next_sentence(self) -> str:
+        s = self._sentences[self._i]
+        self._i += 1
+        return self._apply(s)
+
+    def current_label(self) -> str:
+        return self._labels[max(0, self._i - 1)]
+
+    def has_next(self) -> bool:
+        return self._i < len(self._sentences)
+
+    def reset(self) -> None:
+        self._i = 0

@@ -1,0 +1,394 @@
+"""SequenceVectors: the generic embedding training engine.
+
+Mirror of reference nlp models/sequencevectors/SequenceVectors.java (866
+LoC; fit :100-176) + the learning-algorithm SPI (ElementsLearningAlgorithm
+-> SkipGram, learning/impl/elements/SkipGram.java 234 LoC) and the
+InMemoryLookupTable hot loop (iterateSample).
+
+TPU inversion of the Hogwild design (SURVEY.md §7 "Hogwild -> synchronous"
+hard part): instead of N threads racing on shared syn0/syn1, each epoch
+mines (center, context) index pairs host-side, then a jitted step performs
+the skip-gram update for a whole batch via gather -> dense HS/NS loss ->
+scatter-add, with the learning rate annealed per batch exactly like the
+reference's per-word anneal. Deterministic, reproducible, and batched onto
+the VPU/MXU. Subsampling of frequent words matches word2vec semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabCache,
+    assign_huffman_codes,
+    build_vocab,
+    huffman_arrays,
+    unigram_table_probs,
+)
+
+Array = jax.Array
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+class SequenceVectors:
+    """Trains element embeddings over an iterable of token sequences."""
+
+    def __init__(
+        self,
+        layer_size: int = 100,
+        window: int = 5,
+        learning_rate: float = 0.025,
+        min_learning_rate: float = 1e-4,
+        negative: int = 0,
+        use_hierarchic_softmax: bool = True,
+        min_word_frequency: int = 5,
+        subsampling: float = 1e-3,
+        epochs: int = 1,
+        batch_size: int = 4096,
+        seed: int = 12345,
+    ):
+        self.layer_size = layer_size
+        self.window = window
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax
+        self.min_word_frequency = min_word_frequency
+        self.subsampling = subsampling
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[Array] = None  # [V, D] word vectors
+        self.syn1: Optional[Array] = None  # [V, D] HS inner-node weights
+        self.syn1neg: Optional[Array] = None  # [V, D] NS context weights
+
+    # ------------------------------------------------------------------
+    # Vocab + weights
+    # ------------------------------------------------------------------
+    def build_vocab_from(self, sequences: Iterable[Sequence[str]]) -> None:
+        self.vocab = build_vocab(sequences, self.min_word_frequency)
+        if self.use_hs:
+            assign_huffman_codes(self.vocab)
+        self._reset_weights()
+
+    def _reset_weights(self) -> None:
+        v = self.vocab.num_words()
+        d = self.layer_size
+        key = jax.random.key(self.seed)
+        # syn0 ~ U(-0.5, 0.5)/D (reference InMemoryLookupTable.resetWeights)
+        self.syn0 = (
+            jax.random.uniform(key, (v, d), jnp.float32) - 0.5
+        ) / d
+        self.syn1 = jnp.zeros((v, d), jnp.float32)
+        self.syn1neg = jnp.zeros((v, d), jnp.float32)
+        if self.use_hs:
+            codes, points, mask = huffman_arrays(self.vocab)
+            self._codes = jnp.asarray(codes)
+            self._points = jnp.asarray(points)
+            self._code_mask = jnp.asarray(mask)
+        self._neg_logits = jnp.log(
+            jnp.asarray(unigram_table_probs(self.vocab))
+        )
+
+    # ------------------------------------------------------------------
+    # Pair mining (host side)
+    # ------------------------------------------------------------------
+    def _keep_probs(self) -> np.ndarray:
+        """Frequent-word subsampling keep-probability per vocab index
+        (word2vec formula, reference iterateSample's sampling branch)."""
+        total = max(1, self.vocab.total_word_occurrences())
+        counts = np.array(
+            [w.count for w in self.vocab.vocab_words()], np.float64
+        )
+        if self.subsampling <= 0:
+            return np.ones_like(counts)
+        f = counts / total
+        keep = (np.sqrt(f / self.subsampling) + 1) * self.subsampling / f
+        return np.minimum(1.0, keep)
+
+    def _mine_pairs(
+        self, sequences: Iterable[Sequence[str]], rng: np.random.Generator
+    ):
+        """Yield (center_idx, context_idx) int32 arrays in batches, applying
+        frequent-word subsampling and the word2vec per-center random window
+        shrink. Fully vectorized: the corpus is flattened into one index
+        array with sequence ids, and every window offset is one numpy
+        slice-compare — no per-token Python loop (this mining is the
+        words/sec hot path feeding the jitted update)."""
+        keep_prob = self._keep_probs()
+        word_to_idx = {
+            w.word: w.index for w in self.vocab.vocab_words()
+        }
+        flat_parts: List[np.ndarray] = []
+        seq_parts: List[np.ndarray] = []
+        for sid, tokens in enumerate(sequences):
+            idxs = [word_to_idx[t] for t in tokens if t in word_to_idx]
+            if idxs:
+                arr = np.asarray(idxs, np.int32)
+                flat_parts.append(arr)
+                seq_parts.append(np.full(len(arr), sid, np.int32))
+        if not flat_parts:
+            return
+        flat = np.concatenate(flat_parts)
+        seq_id = np.concatenate(seq_parts)
+        # Subsample frequent words (removal shortens the effective window
+        # distance, as in word2vec).
+        keep = rng.random(len(flat)) < keep_prob[flat]
+        flat, seq_id = flat[keep], seq_id[keep]
+        if len(flat) == 0:
+            return
+        # Per-center random window size b in [1, window].
+        b = rng.integers(1, self.window + 1, size=len(flat))
+        cen_parts: List[np.ndarray] = []
+        ctx_parts: List[np.ndarray] = []
+        for d in range(1, self.window + 1):
+            if d >= len(flat):
+                break
+            same = seq_id[:-d] == seq_id[d:]
+            # (center=i, context=i+d) if d <= b[i]; and the mirror pair.
+            m1 = same & (d <= b[:-d])
+            m2 = same & (d <= b[d:])
+            cen_parts.append(flat[:-d][m1])
+            ctx_parts.append(flat[d:][m1])
+            cen_parts.append(flat[d:][m2])
+            ctx_parts.append(flat[:-d][m2])
+        centers = np.concatenate(cen_parts)
+        contexts = np.concatenate(ctx_parts)
+        # Shuffle so batches mix offsets/sequences (SGD quality).
+        order = rng.permutation(len(centers))
+        centers, contexts = centers[order], contexts[order]
+        # Pad the tail to a full batch by resampling existing pairs, so
+        # every jitted step sees one static shape (no tail recompiles).
+        n = len(centers)
+        rem = n % self.batch_size
+        if rem and n > self.batch_size:
+            extra = rng.integers(0, n, size=self.batch_size - rem)
+            centers = np.concatenate([centers, centers[extra]])
+            contexts = np.concatenate([contexts, contexts[extra]])
+        for start in range(0, len(centers), self.batch_size):
+            yield (
+                centers[start : start + self.batch_size],
+                contexts[start : start + self.batch_size],
+            )
+
+    # ------------------------------------------------------------------
+    # Jitted batched skip-gram updates
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _hs_step(self):
+        """Scanned multi-batch HS update: one dispatch trains S batches
+        (centers/contexts [S, B], lrs [S]) via lax.scan — amortizes the
+        host->device dispatch latency that would otherwise dominate
+        words/sec."""
+        inner = self._hs_inner
+
+        @jax.jit
+        def steps(syn0, syn1, centers, contexts, lrs):
+            def body(carry, inp):
+                s0, s1 = carry
+                c, x, lr = inp
+                s0, s1, loss = inner(s0, s1, c, x, lr)
+                return (s0, s1), loss
+
+            (syn0, syn1), losses = jax.lax.scan(
+                body, (syn0, syn1), (centers, contexts, lrs)
+            )
+            return syn0, syn1, jnp.mean(losses)
+
+        return steps
+
+    @functools.cached_property
+    def _hs_inner(self):
+        codes, points, cmask = self._codes, self._points, self._code_mask
+
+        def step(syn0, syn1, centers, contexts, lr):
+            # Skip-gram HS: input vector = context word (word2vec trains
+            # the *context* against the center's Huffman path).
+            h = syn0[contexts]  # [B, D]
+            pts = points[centers]  # [B, L]
+            cds = codes[centers].astype(jnp.float32)  # [B, L]
+            msk = cmask[centers]  # [B, L]
+            w = syn1[pts]  # [B, L, D]
+            dot = jnp.einsum("bld,bd->bl", w, h)
+            # p(code) via sigmoid; gradient of -log-likelihood:
+            g = (1.0 - cds - _sigmoid(dot)) * msk  # [B, L]
+            dh = jnp.einsum("bl,bld->bd", g, w)  # accumulate into syn0
+            dw = jnp.einsum("bl,bd->bld", g, h)  # into syn1 rows
+            syn0 = syn0.at[contexts].add(lr * dh)
+            syn1 = syn1.at[pts.reshape(-1)].add(
+                lr * dw.reshape(-1, dw.shape[-1])
+            )
+            loss = -jnp.sum(
+                jnp.log(
+                    _sigmoid(jnp.where(cds > 0, -dot, dot)) + 1e-10
+                )
+                * msk
+            ) / jnp.maximum(1, centers.shape[0])
+            return syn0, syn1, loss
+
+        return step
+
+    @functools.cached_property
+    def _ns_step(self):
+        """Scanned multi-batch negative-sampling update (see _hs_step)."""
+        inner = self._ns_inner
+
+        @jax.jit
+        def steps(syn0, syn1neg, centers, contexts, lrs, rng):
+            def body(carry, inp):
+                s0, s1, key = carry
+                c, x, lr = inp
+                key, sub = jax.random.split(key)
+                s0, s1, loss = inner(s0, s1, c, x, lr, sub)
+                return (s0, s1, key), loss
+
+            (syn0, syn1neg, _), losses = jax.lax.scan(
+                body, (syn0, syn1neg, rng), (centers, contexts, lrs)
+            )
+            return syn0, syn1neg, jnp.mean(losses)
+
+        return steps
+
+    @functools.cached_property
+    def _ns_inner(self):
+        neg_logits = self._neg_logits
+        k = self.negative
+
+        def step(syn0, syn1neg, centers, contexts, lr, rng):
+            h = syn0[contexts]  # [B, D]
+            pos = syn1neg[centers]  # [B, D]
+            negs = jax.random.categorical(
+                rng, neg_logits, shape=(centers.shape[0], k)
+            )  # [B, K]
+            wneg = syn1neg[negs]  # [B, K, D]
+            dot_pos = jnp.sum(pos * h, axis=-1)  # [B]
+            dot_neg = jnp.einsum("bkd,bd->bk", wneg, h)
+            g_pos = 1.0 - _sigmoid(dot_pos)  # label 1
+            g_neg = -_sigmoid(dot_neg)  # label 0
+            dh = g_pos[:, None] * pos + jnp.einsum("bk,bkd->bd", g_neg, wneg)
+            syn0 = syn0.at[contexts].add(lr * dh)
+            syn1neg = syn1neg.at[centers].add(lr * g_pos[:, None] * h)
+            syn1neg = syn1neg.at[negs.reshape(-1)].add(
+                lr * (g_neg[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+            )
+            loss = -(
+                jnp.sum(jnp.log(_sigmoid(dot_pos) + 1e-10))
+                + jnp.sum(jnp.log(_sigmoid(-dot_neg) + 1e-10))
+            ) / jnp.maximum(1, centers.shape[0])
+            return syn0, syn1neg, loss
+
+        return step
+
+    # ------------------------------------------------------------------
+    def fit(self, sequences_factory) -> None:
+        """Train. ``sequences_factory`` is a zero-arg callable returning a
+        fresh iterable of token sequences (one pass per epoch), or a list.
+        """
+        if self.vocab is None:
+            seqs = (
+                sequences_factory()
+                if callable(sequences_factory)
+                else sequences_factory
+            )
+            self.build_vocab_from(seqs)
+        total_pairs_est = None
+        rng = np.random.default_rng(self.seed)
+        key = jax.random.key(self.seed + 1)
+        pairs_done = 0
+        # Rough anneal denominator: total occurrences * window * epochs.
+        denom = max(
+            1,
+            self.vocab.total_word_occurrences() * self.window * self.epochs,
+        )
+        CHUNK = 64  # batches per device dispatch (see _hs_step docstring)
+        for epoch in range(self.epochs):
+            seqs = (
+                sequences_factory()
+                if callable(sequences_factory)
+                else sequences_factory
+            )
+            batches = list(self._mine_pairs(seqs, rng))
+            groups: dict = {}
+            for c, x in batches:
+                groups.setdefault(len(c), []).append((c, x))
+            for bsize, group in groups.items():
+                for start in range(0, len(group), CHUNK):
+                    chunk = group[start : start + CHUNK]
+                    s = len(chunk)
+                    cen = np.stack([c for c, _ in chunk])
+                    ctx = np.stack([x for _, x in chunk])
+                    fracs = (
+                        pairs_done + np.arange(s) * bsize
+                    ) / denom
+                    lrs = np.maximum(
+                        self.min_learning_rate,
+                        self.learning_rate * (1.0 - np.minimum(1.0, fracs)),
+                    ).astype(np.float32)
+                    cen_d = jnp.asarray(cen)
+                    ctx_d = jnp.asarray(ctx)
+                    lrs_d = jnp.asarray(lrs)
+                    if self.use_hs:
+                        self.syn0, self.syn1, loss = self._hs_step(
+                            self.syn0, self.syn1, cen_d, ctx_d, lrs_d
+                        )
+                    if self.negative > 0:
+                        key, sub = jax.random.split(key)
+                        self.syn0, self.syn1neg, loss = self._ns_step(
+                            self.syn0, self.syn1neg, cen_d, ctx_d, lrs_d, sub
+                        )
+                    pairs_done += s * bsize
+        self._pairs_trained = pairs_done
+
+    # ------------------------------------------------------------------
+    # WordVectors API (reference wordvectors/WordVectors.java)
+    # ------------------------------------------------------------------
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        if i < 0:
+            return None
+        return np.asarray(self.syn0[i])
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        if denom == 0:
+            return 0.0
+        return float(np.dot(va, vb) / denom)
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            v = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+            if v is None:
+                return []
+        else:
+            v = np.asarray(word_or_vec)
+            exclude = set()
+        m = np.asarray(self.syn0)
+        norms = np.linalg.norm(m, axis=1) * (np.linalg.norm(v) + 1e-12)
+        sims = m @ v / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w in exclude:
+                continue
+            out.append(w)
+            if len(out) >= top_n:
+                break
+        return out

@@ -1,0 +1,95 @@
+"""Tokenizers + factories + preprocessors.
+
+Mirror of reference nlp text/tokenization/** (DefaultTokenizer,
+NGramTokenizer, factories, CommonPreprocessor/EndingPreProcessor).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+
+class TokenPreProcess:
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation (reference CommonPreprocessor)."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class EndingPreProcessor(TokenPreProcess):
+    """Crude stemmer from the reference (strips common English endings)."""
+
+    def pre_process(self, token: str) -> str:
+        for ending in ("ing", "ed", "es", "s", "ly"):
+            if token.endswith(ending) and len(token) > len(ending) + 2:
+                return token[: -len(ending)]
+        return token
+
+
+class Tokenizer:
+    def __init__(self, tokens: List[str],
+                 preprocessor: Optional[TokenPreProcess] = None):
+        self._tokens = tokens
+        self._pre = preprocessor
+
+    def get_tokens(self) -> List[str]:
+        if self._pre is None:
+            return list(self._tokens)
+        out = []
+        for t in self._tokens:
+            p = self._pre.pre_process(t)
+            if p:
+                out.append(p)
+        return out
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+
+class TokenizerFactory:
+    def __init__(self):
+        self.preprocessor: Optional[TokenPreProcess] = None
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self.preprocessor = pre
+
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace tokenization (reference DefaultTokenizer wraps
+    StringTokenizer)."""
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(text.split(), self.preprocessor)
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """Word n-grams over the base tokenization (reference NGramTokenizer)."""
+
+    def __init__(self, n_min: int = 1, n_max: int = 2):
+        super().__init__()
+        self.n_min = n_min
+        self.n_max = n_max
+
+    def create(self, text: str) -> Tokenizer:
+        words = text.split()
+        grams: List[str] = []
+        for n in range(self.n_min, self.n_max + 1):
+            for i in range(len(words) - n + 1):
+                grams.append(" ".join(words[i : i + n]))
+        return Tokenizer(grams, self.preprocessor)

@@ -20,16 +20,21 @@ from deeplearning4j_tpu.nn.updater.updaters import resolve_lr
 
 def pretrain_network(net, data_iter) -> None:
     # jitted steps are cached on the network so repeated pretrain() calls
-    # reuse the compiled executable instead of retracing.
+    # reuse the compiled executable instead of retracing. The cache key
+    # includes the conf's serialized form, so editing hyperparameters
+    # (k, corruption_level, ...) between calls correctly retraces.
+    from deeplearning4j_tpu.nn.conf.serde import to_json as _conf_json
+
     cache = getattr(net, "_pretrain_step_cache", None)
     if cache is None:
         cache = net._pretrain_step_cache = {}
     for i, (conf, impl) in enumerate(zip(net.conf.confs, net._impls)):
         if not isinstance(conf.layer, PRETRAIN_LAYER_TYPES):
             continue
-        step = cache.get(i)
+        key = (i, _conf_json(conf, indent=None))
+        step = cache.get(key)
         if step is None:
-            step = cache[i] = _make_pretrain_step(net, i, conf, impl)
+            step = cache[key] = _make_pretrain_step(net, i, conf, impl)
         data_iter.reset()
         n_iter = max(1, conf.num_iterations)
         for ds in data_iter:

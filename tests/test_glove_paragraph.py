@@ -1,0 +1,78 @@
+"""GloVe + ParagraphVectors tests (pattern from reference GloveTest,
+ParagraphVectorsTest)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+
+def _topic_corpus(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    day = ["day", "sun", "light", "morning", "noon"]
+    night = ["night", "moon", "dark", "evening", "star"]
+    sents = []
+    for _ in range(n):
+        topic = day if rng.random() < 0.5 else night
+        sents.append(" ".join(rng.choice(topic, size=6)))
+    return sents
+
+
+class TestGlove:
+    def test_loss_decreases_and_topics_cluster(self):
+        corpus = [s.split() for s in _topic_corpus()]
+        glove = Glove(
+            layer_size=16, window=4, min_word_frequency=5,
+            epochs=40, learning_rate=0.05, x_max=10.0, seed=1,
+        )
+        glove.fit(corpus)
+        assert glove.losses[-1] < glove.losses[0] * 0.5
+        in_topic = glove.similarity("day", "sun")
+        cross = glove.similarity("day", "moon")
+        assert in_topic > cross, (in_topic, cross)
+
+    def test_empty_corpus_raises(self):
+        glove = Glove(min_word_frequency=1, epochs=1)
+        with pytest.raises(ValueError):
+            glove.fit([[]])
+
+
+class TestParagraphVectors:
+    def test_doc_vectors_cluster_by_topic(self):
+        rng = np.random.default_rng(1)
+        day = ["day", "sun", "light", "morning", "noon"]
+        night = ["night", "moon", "dark", "evening", "star"]
+        docs, labels = [], []
+        for i in range(30):
+            topic, prefix = (day, "DAY") if i % 2 == 0 else (night, "NIGHT")
+            docs.append(" ".join(rng.choice(topic, size=12)))
+            labels.append(f"{prefix}_{i}")
+        pv = ParagraphVectors(
+            layer_size=24, epochs=30, learning_rate=0.05, seed=5,
+        )
+        pv.fit_documents(docs, labels)
+
+        def sim(a, b):
+            va, vb = pv.doc_vector(a), pv.doc_vector(b)
+            return float(
+                np.dot(va, vb)
+                / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12)
+            )
+
+        same = sim("DAY_0", "DAY_2")
+        cross = sim("DAY_0", "NIGHT_1")
+        assert same > cross, (same, cross)
+
+    def test_infer_vector_close_to_topic_docs(self):
+        rng = np.random.default_rng(2)
+        day = ["day", "sun", "light", "morning", "noon"]
+        night = ["night", "moon", "dark", "evening", "star"]
+        docs = [" ".join(rng.choice(day, size=10)) for _ in range(10)]
+        docs += [" ".join(rng.choice(night, size=10)) for _ in range(10)]
+        labels = [f"D{i}" for i in range(10)] + [f"N{i}" for i in range(10)]
+        pv = ParagraphVectors(layer_size=24, epochs=40, seed=8)
+        pv.fit_documents(docs, labels)
+        day_sim = pv.similarity_to_label("sun light noon day", "D0")
+        night_sim = pv.similarity_to_label("sun light noon day", "N0")
+        assert day_sim > night_sim, (day_sim, night_sim)

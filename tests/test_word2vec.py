@@ -1,0 +1,205 @@
+"""Word2Vec/NLP tests.
+
+Pattern from reference Word2VecTests, Word2VecTestsSmall,
+WordVectorSerializerTest, VocabConstructorTest (SURVEY.md §4 "NLP"):
+end-to-end on a small corpus asserting topical similarity, serializer
+round-trips, vocab/Huffman invariants.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.sentence_iterator import CollectionSentenceIterator
+from deeplearning4j_tpu.nlp.serializer import (
+    load_google_binary,
+    load_txt_vectors,
+    write_google_binary,
+    write_word_vectors,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    assign_huffman_codes,
+    build_vocab,
+    huffman_arrays,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def _topic_corpus(n=400, seed=0):
+    """Two topics with disjoint vocabularies -> in-topic words must end up
+    more similar than cross-topic words."""
+    rng = np.random.default_rng(seed)
+    day = ["day", "sun", "light", "morning", "noon"]
+    night = ["night", "moon", "dark", "evening", "star"]
+    sents = []
+    for _ in range(n):
+        topic = day if rng.random() < 0.5 else night
+        words = rng.choice(topic, size=6)
+        sents.append(" ".join(words))
+    return sents
+
+
+class TestVocab:
+    def test_min_frequency_filter(self):
+        vocab = build_vocab([["a", "a", "a", "b"], ["a", "c", "c"]],
+                            min_word_frequency=2)
+        assert vocab.contains_word("a")
+        assert vocab.contains_word("c")
+        assert not vocab.contains_word("b")
+        # Index 0 = most frequent.
+        assert vocab.index_of("a") == 0
+
+    def test_huffman_codes_prefix_free_and_frequency_ordered(self):
+        vocab = build_vocab(
+            [["a"] * 50 + ["b"] * 20 + ["c"] * 10 + ["d"] * 5 + ["e"] * 2],
+            min_word_frequency=1,
+        )
+        assign_huffman_codes(vocab)
+        codes = {
+            w.word: "".join(map(str, w.codes)) for w in vocab.vocab_words()
+        }
+        # Prefix-free.
+        for w1, c1 in codes.items():
+            for w2, c2 in codes.items():
+                if w1 != w2:
+                    assert not c2.startswith(c1)
+        # Most frequent word has the (weakly) shortest code.
+        assert len(codes["a"]) == min(len(c) for c in codes.values())
+
+    def test_huffman_arrays_padding(self):
+        vocab = build_vocab([["a", "b", "c", "a", "a", "b"]], 1)
+        assign_huffman_codes(vocab)
+        codes, points, mask = huffman_arrays(vocab)
+        assert codes.shape == points.shape == mask.shape
+        for w in vocab.vocab_words():
+            assert mask[w.index].sum() == len(w.codes)
+
+
+class TestTokenization:
+    def test_default_tokenizer_with_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        tokens = tf.create("The QUICK, brown fox!! 123").get_tokens()
+        assert tokens == ["the", "quick", "brown", "fox"]
+
+    def test_ngram_tokenizer(self):
+        tf = NGramTokenizerFactory(1, 2)
+        tokens = tf.create("a b c").get_tokens()
+        assert "a" in tokens and "a b" in tokens and "b c" in tokens
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("mode", ["hs", "ns"])
+    def test_topic_similarity(self, mode):
+        vec = (
+            Word2Vec.Builder()
+            .iterate(CollectionSentenceIterator(_topic_corpus()))
+            .layer_size(32)
+            .window_size(3)
+            .min_word_frequency(5)
+            .learning_rate(0.05)
+            .epochs(8)
+            .seed(7)
+            .use_hierarchic_softmax(mode == "hs")
+            .negative_sample(5 if mode == "ns" else 0)
+            .build()
+        )
+        vec.fit()
+        in_topic = vec.similarity("day", "sun")
+        cross = vec.similarity("day", "moon")
+        assert in_topic > cross, (in_topic, cross)
+        nearest = vec.words_nearest("night", top_n=4)
+        assert set(nearest) & {"moon", "dark", "evening", "star"}, nearest
+
+    def test_deterministic_same_seed(self):
+        def make():
+            v = (
+                Word2Vec.Builder()
+                .iterate(CollectionSentenceIterator(_topic_corpus(100)))
+                .layer_size(16)
+                .min_word_frequency(1)
+                .epochs(2)
+                .seed(3)
+                .build()
+            )
+            v.fit()
+            return np.asarray(v.syn0)
+
+        np.testing.assert_array_equal(make(), make())
+
+    def test_unknown_word(self):
+        vec = (
+            Word2Vec.Builder()
+            .iterate(CollectionSentenceIterator(["a b c a b"]))
+            .layer_size(8)
+            .min_word_frequency(1)
+            .epochs(1)
+            .build()
+        )
+        vec.fit()
+        assert vec.get_word_vector("zzz") is None
+        assert np.isnan(vec.similarity("a", "zzz"))
+
+
+class TestSerializer:
+    def _vec(self):
+        v = (
+            Word2Vec.Builder()
+            .iterate(CollectionSentenceIterator(_topic_corpus(50)))
+            .layer_size(12)
+            .min_word_frequency(2)
+            .epochs(1)
+            .build()
+        )
+        v.fit()
+        return v
+
+    def test_text_round_trip(self, tmp_path):
+        v = self._vec()
+        path = str(tmp_path / "vecs.txt")
+        write_word_vectors(v, path)
+        loaded = load_txt_vectors(path)
+        for w in ["day", "night"]:
+            if v.has_word(w):
+                np.testing.assert_allclose(
+                    v.get_word_vector(w),
+                    loaded.get_word_vector(w),
+                    rtol=1e-4, atol=1e-5,
+                )
+
+    def test_google_binary_round_trip(self, tmp_path):
+        v = self._vec()
+        path = str(tmp_path / "vecs.bin")
+        write_google_binary(v, path)
+        loaded = load_google_binary(path)
+        assert loaded.vocab.num_words() == v.vocab.num_words()
+        for w in v.vocab.words()[:5]:
+            np.testing.assert_allclose(
+                v.get_word_vector(w), loaded.get_word_vector(w), atol=1e-6
+            )
+
+
+class TestVectorizers:
+    def test_bag_of_words_counts(self):
+        from deeplearning4j_tpu.nlp.vectorizers import BagOfWordsVectorizer
+
+        v = BagOfWordsVectorizer()
+        x = v.fit_transform(["a b a", "b c"])
+        assert x.shape == (2, 3)
+        ia, ib = v.vocab.index_of("a"), v.vocab.index_of("b")
+        assert x[0, ia] == 2.0 and x[0, ib] == 1.0
+
+    def test_tfidf_downweights_common_terms(self):
+        from deeplearning4j_tpu.nlp.vectorizers import TfidfVectorizer
+
+        docs = ["common rare1 common", "common rare2", "common rare3"]
+        v = TfidfVectorizer()
+        x = v.fit_transform(docs)
+        ic = v.vocab.index_of("common")
+        ir = v.vocab.index_of("rare1")
+        # Per-occurrence weight of the ubiquitous term is lower.
+        assert x[0, ic] / 2.0 < x[0, ir]
