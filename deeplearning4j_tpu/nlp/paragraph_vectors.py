@@ -10,6 +10,7 @@ vector against the frozen word tables.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -103,6 +104,50 @@ class ParagraphVectors(SequenceVectors):
     def doc_vector(self, label: str) -> Optional[np.ndarray]:
         return self.get_word_vector(label)
 
+    @functools.cached_property
+    def _infer_fn(self):
+        """Compiled inference: all ``steps`` updates in one lax.fori_loop
+        dispatch; compiled once per (token-count) shape, reused across
+        calls. Supports both HS and negative-sampling models."""
+        use_hs = self.use_hs
+        negative = self.negative
+
+        @functools.partial(jax.jit, static_argnames=("steps",))
+        def infer(vec, idxs, syn1, syn1neg, codes, points, cmask,
+                  neg_logits, key, lr0, steps):
+            def body(s, carry):
+                vec, key = carry
+                lr = lr0 * (1.0 - s / steps)
+                dvec = jnp.zeros_like(vec)
+                if use_hs:
+                    w = syn1[points]  # [T, L, D]
+                    dot = jnp.einsum("tld,d->tl", w, vec)
+                    g = (1.0 - codes - jax.nn.sigmoid(dot)) * cmask
+                    dvec = dvec + jnp.einsum("tl,tld->d", g, w)
+                if negative > 0:
+                    key, sub = jax.random.split(key)
+                    pos = syn1neg[idxs]  # [T, D]
+                    negs = jax.random.categorical(
+                        sub, neg_logits, shape=(idxs.shape[0], negative)
+                    )
+                    wneg = syn1neg[negs]  # [T, K, D]
+                    g_pos = 1.0 - jax.nn.sigmoid(pos @ vec)  # [T]
+                    g_neg = -jax.nn.sigmoid(
+                        jnp.einsum("tkd,d->tk", wneg, vec)
+                    )
+                    g_neg = g_neg * (negs != idxs[:, None]).astype(
+                        g_neg.dtype
+                    )
+                    dvec = dvec + g_pos @ pos + jnp.einsum(
+                        "tk,tkd->d", g_neg, wneg
+                    )
+                return vec + lr * dvec, key
+
+            vec, _ = jax.lax.fori_loop(0, steps, body, (vec, key))
+            return vec
+
+        return infer
+
     def infer_vector(self, text: str, steps: int = 50,
                      lr: float = 0.025) -> np.ndarray:
         """Train a fresh vector for unseen text against frozen tables
@@ -120,21 +165,19 @@ class ParagraphVectors(SequenceVectors):
         idxs = jnp.asarray(
             [self.vocab.index_of(t) for t in toks], jnp.int32
         )
-        codes = self._codes[idxs].astype(jnp.float32)
-        points = self._points[idxs]
-        cmask = self._code_mask[idxs]
-        syn1 = self.syn1
-
-        @jax.jit
-        def one_step(vec, lr):
-            w = syn1[points]  # [T, L, D]
-            dot = jnp.einsum("tld,d->tl", w, vec)
-            g = (1.0 - codes - jax.nn.sigmoid(dot)) * cmask
-            dvec = jnp.einsum("tl,tld->d", g, w)
-            return vec + lr * dvec
-
-        for s in range(steps):
-            vec = one_step(vec, lr * (1.0 - s / steps))
+        if self.use_hs:
+            codes = self._codes[idxs].astype(jnp.float32)
+            points = self._points[idxs]
+            cmask = self._code_mask[idxs]
+        else:
+            t = idxs.shape[0]
+            codes = jnp.zeros((t, 1), jnp.float32)
+            points = jnp.zeros((t, 1), jnp.int32)
+            cmask = jnp.zeros((t, 1), jnp.float32)
+        vec = self._infer_fn(
+            vec, idxs, self.syn1, self.syn1neg, codes, points, cmask,
+            self._neg_logits, key, lr, steps,
+        )
         return np.asarray(vec)
 
     def similarity_to_label(self, text: str, label: str) -> float:

@@ -162,8 +162,12 @@ class SequenceVectors:
             ctx_parts.append(flat[d:][m1])
             cen_parts.append(flat[d:][m2])
             ctx_parts.append(flat[:-d][m2])
+        if not cen_parts:
+            return  # corpus degenerated to (at most) one surviving token
         centers = np.concatenate(cen_parts)
         contexts = np.concatenate(ctx_parts)
+        if len(centers) == 0:
+            return
         # Shuffle so batches mix offsets/sequences (SGD quality).
         order = rng.permutation(len(centers))
         centers, contexts = centers[order], contexts[order]
@@ -275,6 +279,9 @@ class SequenceVectors:
             dot_neg = jnp.einsum("bkd,bd->bk", wneg, h)
             g_pos = 1.0 - _sigmoid(dot_pos)  # label 1
             g_neg = -_sigmoid(dot_neg)  # label 0
+            # Exclude accidental positives: the reference's iterateSample
+            # skips sampled negatives equal to the target word.
+            g_neg = g_neg * (negs != centers[:, None]).astype(g_neg.dtype)
             dh = g_pos[:, None] * pos + jnp.einsum("bk,bkd->bd", g_neg, wneg)
             syn0 = syn0.at[contexts].add(lr * dh)
             syn1neg = syn1neg.at[centers].add(lr * g_pos[:, None] * h)
@@ -294,6 +301,12 @@ class SequenceVectors:
         """Train. ``sequences_factory`` is a zero-arg callable returning a
         fresh iterable of token sequences (one pass per epoch), or a list.
         """
+        if not self.use_hs and self.negative <= 0:
+            raise ValueError(
+                "No training objective: enable hierarchical softmax "
+                "(use_hierarchic_softmax=True) and/or negative sampling "
+                "(negative > 0)"
+            )
         if self.vocab is None:
             seqs = (
                 sequences_factory()

@@ -248,12 +248,10 @@ class BatchNormalization(FeedForwardLayer):
     lock_gamma_beta: bool = False
 
 
-# Names of layer kinds that consume/produce [N, C, T] time series.
+# Layer kinds that consume/produce [N, C, T] time series. Matching on the
+# base classes keeps extensions (e.g. MultiHeadSelfAttention) covered.
 RECURRENT_LAYER_TYPES = (
-    GravesLSTM,
-    GravesBidirectionalLSTM,
-    GRU,
-    ImageLSTM,
+    BaseRecurrentLayer,
     RnnOutputLayer,
 )
 

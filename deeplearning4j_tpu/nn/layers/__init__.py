@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.layers import (
+    attention,
     convolution,
     dense,
     embedding,
@@ -41,6 +42,7 @@ _IMPLS = {
     L.RBM: pretrain.RBMImpl,
     L.AutoEncoder: pretrain.AutoEncoderImpl,
     L.RecursiveAutoEncoder: pretrain.AutoEncoderImpl,
+    attention.MultiHeadSelfAttention: attention.AttentionImpl,
 }
 
 

@@ -1,0 +1,109 @@
+"""Multi-head self-attention layer.
+
+NEW capability relative to the reference (2015 — predates attention;
+SURVEY.md §5.7 mandates long-context support as first-class in this
+framework). Follows the framework's [N, C, T] recurrent layout so it
+composes with GravesLSTM/RnnOutputLayer in a MultiLayerNetwork stack.
+
+When ``ring_axis`` names a mesh axis present at trace time (sequence
+parallelism), the core attention runs as ring attention over that axis
+(parallel/sequence_parallel.py); otherwise it is a fused dense
+flash-style attention that XLA maps onto the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import BaseRecurrentLayer
+from deeplearning4j_tpu.nn.conf.serde import register_bean
+from deeplearning4j_tpu.nn.layers.base import LayerImplBase
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+@register_bean("MultiHeadSelfAttention")
+@dataclasses.dataclass
+class MultiHeadSelfAttention(BaseRecurrentLayer):
+    """Conf bean: n_in = model width C, n_out = model width out; heads
+    must divide n_out."""
+
+    n_heads: int = 4
+    causal: bool = True
+    ring_axis: Optional[str] = None  # sequence-parallel mesh axis
+
+
+class AttentionImpl(LayerImplBase):
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        lc = conf.layer
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        scheme = conf.resolved("weight_init")
+        dist = conf.resolved("dist")
+        d_in, d = lc.n_in, lc.n_out
+        return {
+            "Wq": init_weights(kq, (d_in, d), scheme, dist, dtype),
+            "Wk": init_weights(kk, (d_in, d), scheme, dist, dtype),
+            "Wv": init_weights(kv, (d_in, d), scheme, dist, dtype),
+            "Wo": init_weights(ko, (d, d), scheme, dist, dtype),
+            "b": jnp.zeros((d,), dtype),
+        }
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None,
+              mask=None):
+        lc = conf.layer
+        h = lc.n_heads
+        d = lc.n_out
+        if d % h:
+            raise ValueError(f"n_out {d} not divisible by n_heads {h}")
+        dh = d // h
+        x = cls.maybe_dropout(conf, x, train, rng)
+        xt = jnp.transpose(x, (0, 2, 1))  # [N, T, C]
+
+        def split_heads(m):
+            y = xt @ m  # [N, T, D]
+            return jnp.transpose(
+                y.reshape(y.shape[0], y.shape[1], h, dh), (0, 2, 1, 3)
+            )  # [N, H, T, dh]
+
+        q = split_heads(params["Wq"])
+        k = split_heads(params["Wk"])
+        v = split_heads(params["Wv"])
+
+        if lc.ring_axis:
+            from deeplearning4j_tpu.parallel.sequence_parallel import (
+                ring_attention,
+            )
+
+            o = ring_attention(q, k, v, lc.ring_axis, causal=lc.causal)
+        else:
+            o = _dense_attention(q, k, v, lc.causal, mask)
+
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(
+            o.shape[0], o.shape[2], d
+        )  # [N, T, D]
+        out = o @ params["Wo"] + params["b"]
+        out = cls.activation_of(conf)(out)
+        out = jnp.transpose(out, (0, 2, 1))  # [N, D, T]
+        if mask is not None:
+            out = out * mask[:, None, :]
+        return out, state
+
+
+def _dense_attention(q, k, v, causal, mask):
+    t = q.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(q.shape[-1], q.dtype)
+    )
+    neg = jnp.asarray(-1e30, q.dtype)
+    if causal:
+        cm = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(cm, scores, neg)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :] > 0, scores, neg)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.updater.updaters import (
@@ -249,6 +249,6 @@ class ParallelTrainer:
             mesh=self.mesh,
             in_specs=(pspec, uspec, P(), P(), P(dp), P(dp), P(dp), P(dp)),
             out_specs=(pspec, uspec, P()),
-            check_rep=False,
+            check_vma=False,
         )
         return jax.jit(fn)

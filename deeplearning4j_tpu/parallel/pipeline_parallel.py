@@ -1,0 +1,124 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+NEW capability relative to the reference (SURVEY.md §2.7 "NOT present"
+list). Layers are partitioned into S stages laid out along the mesh's
+``pp`` axis; a batch is split into M microbatches that stream through the
+ring — stage s computes microbatch m while stage s-1 computes m+1 —
+activations hop stage-to-stage via ``lax.ppermute`` over ICI. The backward
+pass falls out of ``jax.grad`` through the loop: XLA reverses the
+collective permutes, giving the symmetric backward pipeline.
+
+Expressed entirely as shard_map + fori_loop: per-device FLOPs drop to 1/S
+of the model, bubble fraction = (S-1)/(M+S-1), exactly the GPipe schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+Array = jax.Array
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x: Array,
+    n_microbatches: int,
+    axis_name: str = "pp",
+):
+    """Run ``stage_fn`` as a pipeline INSIDE shard_map.
+
+    - ``stage_params``: this device's stage parameters (leading stage axis
+      already split by shard_map).
+    - ``x``: the full LOCAL batch [B, D]; it is cut into M microbatches.
+    - ``stage_fn(params, x_mb) -> y_mb`` with matching in/out widths
+      (homogeneous inter-stage interface, as in GPipe).
+
+    Returns [B, D_out] — the last stage's outputs, broadcast to the ring.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = n_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    mb = b // m
+    x_mbs = x.reshape((m, mb) + x.shape[1:])
+
+    y_probe = jax.eval_shape(stage_fn, stage_params, x_mbs[0])
+    buf0 = jnp.zeros(y_probe.shape, y_probe.dtype)
+    outs0 = jnp.zeros((m,) + y_probe.shape, y_probe.dtype)
+
+    def tick(t, carry):
+        buf, outs = carry
+        # Stage 0 ingests microbatch t (clamped; masked-out later stages
+        # simply compute garbage that is never written).
+        feed = x_mbs[jnp.minimum(t, m - 1)]
+        x_in = jnp.where(idx == 0, feed, buf)
+        y = stage_fn(stage_params, x_in)
+        # Last stage: tick t completes microbatch t-(n-1).
+        out_t = t - (n - 1)
+        write = (idx == n - 1) & (out_t >= 0)
+        outs = lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(
+                write,
+                y,
+                lax.dynamic_index_in_dim(outs, jnp.maximum(out_t, 0), 0,
+                                         keepdims=False),
+            ),
+            jnp.maximum(out_t, 0),
+            0,
+        )
+        # Activation hops to the next stage.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        buf = lax.ppermute(y, axis_name, perm)
+        return buf, outs
+
+    _, outs = lax.fori_loop(0, m + n - 1, tick, (buf0, outs0))
+    # Broadcast the last stage's outputs to every device.
+    outs = lax.psum(
+        jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)), axis_name
+    )
+    return outs.reshape((b,) + outs.shape[2:])
+
+
+def make_pipelined_mlp(
+    mesh: Mesh,
+    layers_per_stage_params,
+    n_microbatches: int,
+    axis_name: str = "pp",
+    activation: Callable = jax.nn.relu,
+):
+    """A pipelined homogeneous MLP: ``layers_per_stage_params`` is a pytree
+    whose leaves have a leading stage axis of size mesh.shape[axis_name]
+    (e.g. W [S, D, D], b [S, D]). Returns f(params, x) -> y jit-able with
+    the stage axis sharded over ``pp``."""
+
+    def stage_fn(params, x_mb):
+        w, b = params["W"], params["b"]
+        return activation(x_mb @ w + b)
+
+    def f(params, x):
+        local = jax.tree.map(lambda p: p[0], params)  # drop stage axis
+        return pipeline_apply(
+            stage_fn, local, x, n_microbatches, axis_name
+        )
+
+    pspec = jax.tree.map(
+        lambda _: P(axis_name), layers_per_stage_params,
+        is_leaf=lambda v: isinstance(v, (jnp.ndarray, jax.Array)),
+    )
+    return shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )

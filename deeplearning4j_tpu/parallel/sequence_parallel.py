@@ -1,0 +1,192 @@
+"""Sequence/context parallelism: ring attention + distributed scan.
+
+NEW capability relative to the reference (SURVEY.md §5.7: the 2015 codebase
+predates attention; its only sequence-length device is truncated BPTT).
+Mandated first-class here: shard the TIME axis of long sequences over the
+mesh's ``sp`` axis and exchange only boundary state over ICI.
+
+Two primitives:
+
+- :func:`ring_attention` — blockwise causal attention with the K/V block
+  rotating around the ring via ``lax.ppermute`` (one neighbor hop per
+  step, riding ICI), with online-softmax accumulation so no device ever
+  materializes the full [T, T] score matrix: O(T/P) memory per device,
+  compute overlapped with the rotation by XLA's async collective
+  scheduling. This is the Liu et al. ring-attention schedule expressed as
+  pure shard_map code.
+
+- :func:`sp_scan` — chunked recurrent scan: each device scans its local
+  time chunk, then the carry hops to the next device via ppermute; P
+  devices process a T-step sequence with O(T/P) activation memory (the
+  tBPTT memory story, but distributed and exact).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+Array = jax.Array
+
+
+def _online_softmax_block(q, k, v, m_prev, l_prev, o_prev, mask):
+    """One blockwise-attention accumulation step (flash-attention style).
+
+    q: [B, H, Tq, D]; k/v: [B, H, Tk, D]; mask: [Tq, Tk] additive
+    (0 / -inf); m/l/o are the running max, normalizer, and output.
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(q.shape[-1], q.dtype)
+    )
+    scores = scores + mask
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
+    # Guard fully-masked rows (max = -inf) against NaNs.
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    scale = jnp.where(
+        jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0
+    )
+    l_new = l_prev * scale + jnp.sum(p, axis=-1)
+    o_new = o_prev * scale[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> Array:
+    """Blockwise ring attention INSIDE shard_map.
+
+    q/k/v: the LOCAL time shard [B, H, T_local, D] on each device of the
+    ``axis_name`` ring. Returns the local output shard [B, H, T_local, D].
+    Device i owns query block i; K/V blocks rotate around the ring so each
+    device sees every K/V block once, accumulating via online softmax.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, t, d = q.shape
+
+    m0 = jnp.full((b, h, t), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((b, h, t), q.dtype)
+    o0 = jnp.zeros_like(q)
+
+    q_pos = idx * t + jnp.arange(t)  # global positions of local queries
+
+    def body(step, carry):
+        kv, m, l, o = carry
+        k_blk, v_blk = kv
+        # Which global block is visiting this device at this step?
+        src_block = (idx + step) % n
+        k_pos = src_block * t + jnp.arange(t)
+        if causal:
+            mask = jnp.where(
+                q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf
+            ).astype(q.dtype)
+        else:
+            mask = jnp.zeros((t, t), q.dtype)
+        m, l, o = _online_softmax_block(q, k_blk, v_blk, m, l, o, mask)
+        # Rotate K/V to the next device (neighbor hop over ICI).
+        perm = [(i, (i - 1) % n) for i in range(n)]
+        kv = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk)
+        )
+        return kv, m, l, o
+
+    (_, _), m, l, o = lax.fori_loop(
+        0, n, body, ((k, v), m0, l0, o0)
+    )
+    return o / jnp.maximum(l[..., None], 1e-20)
+
+
+def make_ring_attention(
+    mesh: Mesh, axis_name: str = "sp", causal: bool = True
+):
+    """shard_map-wrapped ring attention over global [B, H, T, D] arrays
+    time-sharded on ``axis_name``."""
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal
+    )
+    spec = P(None, None, axis_name, None)
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
+def sp_scan(
+    step_fn: Callable,
+    carry_init,
+    xs_local: Array,
+    axis_name: str = "sp",
+):
+    """Distributed sequential scan over a time-sharded sequence.
+
+    Each device holds xs_local [T_local, ...]. Device 0 scans its chunk
+    from ``carry_init``, hands its final carry to device 1 via ppermute,
+    and so on. Sequential across devices (latency n hops) but O(T/P)
+    activation memory per device — the SP analogue of tBPTT windows
+    (reference doTruncatedBPTT :1262) without gradient truncation.
+
+    Returns (final_carry_on_every_device, ys_local).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+
+    def body(dev, state):
+        carry, ys = state
+        # Only the active device scans; others pass through. Under SPMD
+        # every device executes the scan, but the carry is gated so the
+        # chain is causal across the ring.
+        new_carry, new_ys = lax.scan(step_fn, carry, xs_local)
+        active = idx == dev
+        carry_out = jax.tree.map(
+            lambda new, old: jnp.where(active, new, old), new_carry, carry
+        )
+        ys = jax.tree.map(
+            lambda new, old: jnp.where(active, new, old), new_ys, ys
+        )
+        # Hand the carry to the next device in the ring.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        carry_next = jax.tree.map(
+            lambda c: lax.ppermute(c, axis_name, perm), carry_out
+        )
+        # Devices beyond the active one adopt the received carry; the
+        # final iteration leaves every device with the global carry.
+        carry = jax.tree.map(
+            lambda recv, cur: jnp.where(idx == dev + 1, recv, cur),
+            carry_next,
+            carry_out,
+        )
+        return carry, ys
+
+    ys0 = jax.eval_shape(
+        lambda: lax.scan(step_fn, carry_init, xs_local)[1]
+    )
+    ys_init = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), ys0
+    )
+    carry, ys = lax.fori_loop(0, n, body, (carry_init, ys_init))
+    # After the loop the LAST device holds the global final carry;
+    # broadcast it to the whole ring.
+    carry = jax.tree.map(
+        lambda c: lax.psum(
+            jnp.where(idx == n - 1, c, jnp.zeros_like(c)), axis_name
+        ),
+        carry,
+    )
+    return carry, ys

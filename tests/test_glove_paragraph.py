@@ -76,3 +76,52 @@ class TestParagraphVectors:
         day_sim = pv.similarity_to_label("sun light noon day", "D0")
         night_sim = pv.similarity_to_label("sun light noon day", "N0")
         assert day_sim > night_sim, (day_sim, night_sim)
+
+
+class TestNlpRegressions:
+    def test_single_token_corpus_does_not_crash(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        from deeplearning4j_tpu.nlp.sentence_iterator import (
+            CollectionSentenceIterator,
+        )
+
+        v = (
+            Word2Vec.Builder()
+            .iterate(CollectionSentenceIterator(["hello"]))
+            .min_word_frequency(1)
+            .sampling(0)
+            .layer_size(4)
+            .epochs(1)
+            .build()
+        )
+        v.fit()  # no pairs to train; must not raise
+        assert v.has_word("hello")
+
+    def test_no_objective_raises(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        from deeplearning4j_tpu.nlp.sentence_iterator import (
+            CollectionSentenceIterator,
+        )
+
+        v = (
+            Word2Vec.Builder()
+            .iterate(CollectionSentenceIterator(["a b a b"]))
+            .use_hierarchic_softmax(False)
+            .min_word_frequency(1)
+            .build()
+        )
+        with pytest.raises(ValueError, match="objective"):
+            v.fit()
+
+    def test_infer_vector_with_negative_sampling(self):
+        rng = np.random.default_rng(4)
+        day = ["day", "sun", "light", "morning", "noon"]
+        docs = [" ".join(rng.choice(day, size=10)) for _ in range(8)]
+        pv = ParagraphVectors(
+            layer_size=8, epochs=5, use_hierarchic_softmax=False,
+            negative=3, seed=2,
+        )
+        pv.fit_documents(docs)
+        v = pv.infer_vector("sun day light")
+        assert v.shape == (8,)
+        assert np.isfinite(v).all()

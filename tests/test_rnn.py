@@ -188,3 +188,63 @@ class TestTBPTT:
         for _ in range(60):
             net.fit(ds)
         assert net.score(ds) < first * 0.6
+
+
+class TestAttention:
+    def test_attention_gradient_check(self):
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadSelfAttention,
+        )
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .activation("identity")
+            .list()
+            .layer(
+                0,
+                MultiHeadSelfAttention(
+                    n_in=6, n_out=8, n_heads=2, causal=True
+                ),
+            )
+            .layer(
+                1,
+                L.RnnOutputLayer(
+                    n_in=8, n_out=3, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+            )
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(
+            net, _seq_ds(n_in=6, n_out=3), max_params_to_check=50,
+            print_results=True,
+        )
+
+    def test_causal_masking_blocks_future(self):
+        """Changing future timesteps must not affect earlier outputs."""
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadSelfAttention,
+        )
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(1)
+            .activation("identity")
+            .list()
+            .layer(
+                0,
+                MultiHeadSelfAttention(n_in=4, n_out=4, n_heads=2),
+            )
+            .layer(1, L.RnnOutputLayer(n_in=4, n_out=2, activation="softmax"))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.normal(size=(2, 4, 6)).astype(np.float32)
+        out1 = np.asarray(net.output(x))
+        x2 = x.copy()
+        x2[:, :, -1] += 100.0  # perturb only the last timestep
+        out2 = np.asarray(net.output(x2))
+        np.testing.assert_allclose(out1[:, :, :-1], out2[:, :, :-1], atol=1e-5)
+        assert not np.allclose(out1[:, :, -1], out2[:, :, -1])
