@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+
+__all__ = ["CheckpointManager"]

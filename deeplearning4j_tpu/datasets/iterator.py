@@ -62,6 +62,14 @@ class DataSetIterator:
             ds = self.preprocessor(ds)
         return ds
 
+    # -- resumable position (improvement over the reference, which never
+    # checkpoints iterator position — SURVEY.md §5.4) -------------------
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, state: dict) -> None:
+        pass
+
 
 class BaseDataSetIterator(DataSetIterator):
     """Cursor-over-in-memory-arrays base (reference BaseDatasetIterator +
@@ -84,6 +92,12 @@ class BaseDataSetIterator(DataSetIterator):
 
     def reset(self) -> None:
         self._cursor = 0
+
+    def state_dict(self) -> dict:
+        return {"cursor": self._cursor}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._cursor = int(state["cursor"])
 
     def total_examples(self) -> int:
         return self._data.num_examples()
@@ -118,6 +132,12 @@ class ListDataSetIterator(DataSetIterator):
     def reset(self) -> None:
         self._idx = 0
 
+    def state_dict(self) -> dict:
+        return {"idx": self._idx}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._idx = int(state["idx"])
+
     def total_examples(self) -> int:
         return sum(d.num_examples() for d in self._list)
 
@@ -143,9 +163,10 @@ class AsyncDataSetIterator(DataSetIterator):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
 
-    def _start(self) -> None:
+    def _start(self, reset: bool = True) -> None:
         self._stop()
-        self._base.reset()
+        if reset:
+            self._base.reset()
         # The queue and stop-event are bound into the worker closure, so a
         # stale worker from before a reset() can never feed the new epoch's
         # queue. (It does still share self._base: a worker surviving the
@@ -214,6 +235,17 @@ class AsyncDataSetIterator(DataSetIterator):
     def reset(self) -> None:
         self._start()
 
+    def state_dict(self) -> dict:
+        # Prefetched-but-unconsumed batches count as consumed: resume
+        # position is the base cursor, which is at most queue_size batches
+        # ahead of the consumer.
+        return {"base": self._base.state_dict()}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._stop()
+        self._base.load_state_dict(state["base"])
+        self._start(reset=False)
+
     def total_examples(self) -> int:
         return self._base.total_examples()
 
@@ -246,6 +278,13 @@ class MultipleEpochsIterator(DataSetIterator):
     def reset(self) -> None:
         self._epoch = 0
         self._base.reset()
+
+    def state_dict(self) -> dict:
+        return {"epoch": self._epoch, "base": self._base.state_dict()}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._epoch = int(state["epoch"])
+        self._base.load_state_dict(state["base"])
 
     def total_examples(self) -> int:
         return self._base.total_examples() * self.num_epochs
@@ -285,6 +324,13 @@ class SamplingDataSetIterator(DataSetIterator):
     def reset(self) -> None:
         self._given = 0
 
+    def state_dict(self) -> dict:
+        return {"given": self._given, "rng": self._rng.bit_generator.state}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._given = int(state["given"])
+        self._rng.bit_generator.state = state["rng"]
+
     def total_examples(self) -> int:
         return self._total
 
@@ -312,6 +358,12 @@ class TestDataSetIterator(DataSetIterator):
     def reset(self) -> None:
         self.reset_calls += 1
         self._base.reset()
+
+    def state_dict(self) -> dict:
+        return self._base.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self._base.load_state_dict(state)
 
     def total_examples(self) -> int:
         return self._base.total_examples()

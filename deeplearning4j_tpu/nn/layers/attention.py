@@ -79,7 +79,9 @@ class AttentionImpl(LayerImplBase):
                 ring_attention,
             )
 
-            o = ring_attention(q, k, v, lc.ring_axis, causal=lc.causal)
+            o = ring_attention(
+                q, k, v, lc.ring_axis, causal=lc.causal, key_mask=mask
+            )
         else:
             o = _dense_attention(q, k, v, lc.causal, mask)
 

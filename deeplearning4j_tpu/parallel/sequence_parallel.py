@@ -38,8 +38,9 @@ Array = jax.Array
 def _online_softmax_block(q, k, v, m_prev, l_prev, o_prev, mask):
     """One blockwise-attention accumulation step (flash-attention style).
 
-    q: [B, H, Tq, D]; k/v: [B, H, Tk, D]; mask: [Tq, Tk] additive
-    (0 / -inf); m/l/o are the running max, normalizer, and output.
+    q: [B, H, Tq, D]; k/v: [B, H, Tk, D]; mask: additive (0 / -inf),
+    broadcastable to [B, H, Tq, Tk]; m/l/o are the running max,
+    normalizer, and output.
     """
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(q.shape[-1], q.dtype)
@@ -66,6 +67,7 @@ def ring_attention(
     v: Array,
     axis_name: str = "sp",
     causal: bool = True,
+    key_mask: Optional[Array] = None,
 ) -> Array:
     """Blockwise ring attention INSIDE shard_map.
 
@@ -73,6 +75,10 @@ def ring_attention(
     ``axis_name`` ring. Returns the local output shard [B, H, T_local, D].
     Device i owns query block i; K/V blocks rotate around the ring so each
     device sees every K/V block once, accumulating via online softmax.
+
+    ``key_mask`` [B, T_local] (1 = valid) marks padded timesteps of the
+    LOCAL key block; it rotates around the ring with its K/V block so
+    padded keys are excluded from every device's softmax.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -83,10 +89,14 @@ def ring_attention(
     o0 = jnp.zeros_like(q)
 
     q_pos = idx * t + jnp.arange(t)  # global positions of local queries
+    km = (
+        jnp.ones((b, t), q.dtype) if key_mask is None
+        else key_mask.astype(q.dtype)
+    )
 
     def body(step, carry):
         kv, m, l, o = carry
-        k_blk, v_blk = kv
+        k_blk, v_blk, km_blk = kv
         # Which global block is visiting this device at this step?
         src_block = (idx + step) % n
         k_pos = src_block * t + jnp.arange(t)
@@ -96,33 +106,48 @@ def ring_attention(
             ).astype(q.dtype)
         else:
             mask = jnp.zeros((t, t), q.dtype)
+        # Padded keys of the visiting block: -inf for every query.
+        mask = mask[None, None] + jnp.where(
+            km_blk > 0, 0.0, -jnp.inf
+        ).astype(q.dtype)[:, None, None, :]
         m, l, o = _online_softmax_block(q, k_blk, v_blk, m, l, o, mask)
-        # Rotate K/V to the next device (neighbor hop over ICI).
+        # Rotate K/V (+ their mask) to the next device (neighbor hop
+        # over ICI).
         perm = [(i, (i - 1) % n) for i in range(n)]
         kv = jax.tree.map(
-            lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk)
+            lambda x: lax.ppermute(x, axis_name, perm),
+            (k_blk, v_blk, km_blk),
         )
         return kv, m, l, o
 
-    (_, _), m, l, o = lax.fori_loop(
-        0, n, body, ((k, v), m0, l0, o0)
+    _, m, l, o = lax.fori_loop(
+        0, n, body, ((k, v, km), m0, l0, o0)
     )
     return o / jnp.maximum(l[..., None], 1e-20)
 
 
 def make_ring_attention(
-    mesh: Mesh, axis_name: str = "sp", causal: bool = True
+    mesh: Mesh, axis_name: str = "sp", causal: bool = True,
+    masked: bool = False,
 ):
     """shard_map-wrapped ring attention over global [B, H, T, D] arrays
-    time-sharded on ``axis_name``."""
-    fn = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal
-    )
+    time-sharded on ``axis_name``. With ``masked=True`` the returned fn
+    takes a fourth [B, T] key-validity mask (also time-sharded)."""
     spec = P(None, None, axis_name, None)
+    if masked:
+        fn = lambda q, k, v, m: ring_attention(  # noqa: E731
+            q, k, v, axis_name, causal=causal, key_mask=m
+        )
+        in_specs = (spec, spec, spec, P(None, axis_name))
+    else:
+        fn = functools.partial(
+            ring_attention, axis_name=axis_name, causal=causal
+        )
+        in_specs = (spec, spec, spec)
     return shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
     )

@@ -1,0 +1,127 @@
+"""Single-file model serialization.
+
+TPU-native counterpart of the reference checkpoint triple — (conf JSON,
+flat params, serialized updater) — written by
+earlystopping/saver/LocalFileModelSaver.java:76-86 and restored via the
+``MultiLayerNetwork(String conf, INDArray params)`` ctor
+(nn/multilayer/MultiLayerNetwork.java:107). Here the triple is packed into
+ONE zip archive so a model travels as a single artifact:
+
+    model.zip
+    ├── type                conf-class marker (multilayer | graph)
+    ├── conf.json           configuration (the wire format, SURVEY.md §5.6)
+    ├── params.npz          param pytree, keys "layer/name" flattened
+    └── extras.pkl          updater state + layer state + iteration
+
+Arrays go through numpy ``.npz`` (portable, no pickle needed for params);
+only updater/layer state uses pickle because its pytree structure is
+heterogeneous.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import zipfile
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_SEP = "␟"  # unit-separator-ish key joiner, never in param names
+
+
+def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}{_SEP}{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split(_SEP)
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(v)
+    return out
+
+
+def _merge_into(dst: Dict[str, Any], src: Dict[str, Any]) -> Dict[str, Any]:
+    """Overlay loaded leaves onto a freshly-init'd tree. Param-less layers
+    (e.g. Subsampling) have empty dicts that npz flattening drops; merging
+    keeps their keys so the forward pass still finds every layer."""
+    out = dict(dst)
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge_into(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def write_model(net, path: str) -> None:
+    """Serialize a MultiLayerNetwork or ComputationGraph to one zip file."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net.init()
+    kind = "multilayer" if isinstance(net, MultiLayerNetwork) else "graph"
+    params = _flatten(jax.tree.map(np.asarray, net.params))
+    buf = io.BytesIO()
+    np.savez(buf, **params)
+    extras = {
+        "updater_state": jax.tree.map(np.asarray, net.updater_state),
+        "state": jax.tree.map(np.asarray, net.state),
+        "iteration": net.iteration,
+    }
+    tmp = path + ".tmp"
+    with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("type", kind)
+        z.writestr("conf.json", net.conf.to_json())
+        z.writestr("params.npz", buf.getvalue())
+        z.writestr("extras.pkl", pickle.dumps(extras))
+    os.replace(tmp, path)  # atomic commit: no torn checkpoints on crash
+
+
+def restore_model(path: str):
+    """Load a model zip back into the right network class."""
+    with zipfile.ZipFile(path) as z:
+        kind = z.read("type").decode()
+        conf_json = z.read("conf.json").decode()
+        npz = np.load(io.BytesIO(z.read("params.npz")))
+        params = _unflatten({k: npz[k] for k in npz.files})
+        extras = pickle.loads(z.read("extras.pkl"))
+
+    if kind == "multilayer":
+        from deeplearning4j_tpu.nn.conf.multi_layer import (
+            MultiLayerConfiguration,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(conf_json)
+        ).init()
+    else:
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        net = ComputationGraph(
+            ComputationGraphConfiguration.from_json(conf_json)
+        ).init()
+
+    net.params = _merge_into(net.params, params)
+    net.updater_state = jax.tree.map(jnp.asarray, extras["updater_state"])
+    net.state = jax.tree.map(jnp.asarray, extras["state"])
+    net.iteration = int(extras["iteration"])
+    return net

@@ -1,0 +1,229 @@
+"""Checkpoint/resume + single-file model serde tests (SURVEY.md §5.4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.checkpoint import CheckpointManager
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    BaseDataSetIterator,
+    MultipleEpochsIterator,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.util.model_serializer import (
+    restore_model,
+    write_model,
+)
+
+
+def _net(seed=42):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(0.1)
+        .updater(Updater.ADAM)
+        .list()
+        .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(
+            1,
+            L.OutputLayer(
+                n_in=8, n_out=3, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.zeros((n, 3), np.float32)
+    y[np.arange(n), rng.integers(0, 3, n)] = 1.0
+    return DataSet(x, y)
+
+
+def test_model_serializer_roundtrip(tmp_path):
+    net = _net()
+    ds = _data()
+    net.fit(ds)
+    path = str(tmp_path / "model.zip")
+    write_model(net, path)
+    restored = restore_model(path)
+    x = np.asarray(ds.features)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)), np.asarray(restored.output(x)), rtol=1e-6
+    )
+    assert restored.iteration == net.iteration
+    # Updater state survives: further training matches step for step.
+    net.fit(ds)
+    restored.fit(ds)
+    np.testing.assert_allclose(
+        np.asarray(net.params_flat()),
+        np.asarray(restored.params_flat()),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_model_serializer_graph(tmp_path):
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(7)
+        .learning_rate(0.05)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer(
+            "dense", L.DenseLayer(n_in=4, n_out=6, activation="relu"), "in"
+        )
+        .add_layer(
+            "out",
+            L.OutputLayer(
+                n_in=6, n_out=3, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+            "dense",
+        )
+        .set_outputs("out")
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    ds = _data()
+    net.fit(ds)
+    path = str(tmp_path / "graph.zip")
+    write_model(net, path)
+    restored = restore_model(path)
+    x = np.asarray(ds.features)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)[0]),
+        np.asarray(restored.output(x)[0]),
+        rtol=1e-6,
+    )
+
+
+def test_checkpoint_manager_save_restore_resume(tmp_path):
+    net = _net()
+    data = _data(24)
+    it = MultipleEpochsIterator(3, BaseDataSetIterator(6, data))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_n=2)
+
+    # Train 2 batches, checkpoint with iterator state mid-epoch.
+    it.reset()
+    for _ in range(2):
+        net.fit(it.next())
+    mgr.save(net.iteration, net, iterator=it, score=float(net.score()))
+    mgr.wait_until_finished()
+
+    # Continue the original to the end.
+    saved_state = it.state_dict()
+    ds = it.next()
+    while ds is not None:
+        net.fit(ds)
+        ds = it.next()
+    final_orig = np.asarray(net.params_flat())
+
+    # Restore into a fresh net + fresh iterator; position must resume.
+    it2 = MultipleEpochsIterator(3, BaseDataSetIterator(6, data))
+    net2, meta = mgr.restore(iterator=it2)
+    assert it2.state_dict() == saved_state
+    ds = it2.next()
+    while ds is not None:
+        net2.fit(ds)
+        ds = it2.next()
+    np.testing.assert_allclose(
+        final_orig, np.asarray(net2.params_flat()), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_checkpoint_retention_and_best(tmp_path):
+    net = _net()
+    ds = _data()
+    mgr = CheckpointManager(
+        str(tmp_path / "ckpt"), keep_last_n=2, keep_best=True,
+        async_save=False,
+    )
+    scores = [5.0, 1.0, 3.0, 2.0]  # best (1.0) at step 1
+    for step, sc in enumerate(scores):
+        net.fit(ds)
+        mgr.save(step, net, score=sc)
+    steps = mgr.all_steps()
+    # last 2 (2,3) + best (1) survive; step 0 evicted
+    assert steps == [1, 2, 3]
+    assert mgr.best_step() == 1
+    assert mgr.latest_step() == 3
+    net_best, meta = mgr.restore(step=mgr.best_step())
+    assert meta["score"] == 1.0
+
+
+def test_async_save_error_surfaces(tmp_path):
+    net = _net()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(0, net)
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 0
+
+
+def test_serializer_paramless_layer_roundtrip(tmp_path):
+    """CNN with pooling (param-less Subsampling layer) must round-trip
+    (empty param dicts survive the npz flatten/unflatten)."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(3)
+        .learning_rate(0.05)
+        .list()
+        .layer(0, L.ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+        .layer(1, L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(
+            2,
+            L.OutputLayer(
+                n_out=3, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .set_input_type(InputType.convolutional(8, 8, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 1, 8, 8)).astype(np.float32)
+    path = str(tmp_path / "cnn.zip")
+    write_model(net, path)
+    restored = restore_model(path)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)), np.asarray(restored.output(x)), rtol=1e-5
+    )
+
+
+def test_async_and_test_iterator_state_delegation():
+    from deeplearning4j_tpu.datasets.iterator import (
+        AsyncDataSetIterator,
+        TestDataSetIterator,
+    )
+
+    data = _data(24)
+    ait = AsyncDataSetIterator(BaseDataSetIterator(6, data), queue_size=1)
+    first = ait.next()
+    st = ait.state_dict()
+    assert st["base"]["cursor"] >= 6  # at least the consumed batch
+
+    ait2 = AsyncDataSetIterator(BaseDataSetIterator(6, data), queue_size=1)
+    ait2.load_state_dict(st)
+    remaining = 0
+    while ait2.next() is not None:
+        remaining += 1
+    assert remaining == (24 - st["base"]["cursor"]) // 6
+
+    tit = TestDataSetIterator(BaseDataSetIterator(6, data))
+    tit.next()
+    assert tit.state_dict() == {"cursor": 6}
+    tit.load_state_dict({"cursor": 12})
+    assert tit.state_dict() == {"cursor": 12}

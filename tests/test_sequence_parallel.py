@@ -96,3 +96,43 @@ class TestSpScan:
         np.testing.assert_allclose(
             np.asarray(carry), np.asarray(expected_carry), atol=1e-5
         )
+
+
+class TestRingAttentionMask:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_key_mask_matches_dense(self, causal):
+        """Padded keys must be excluded from the ring softmax exactly as
+        the dense path excludes them."""
+        mesh = make_mesh(MeshSpec({"sp": 4}))
+        rng = np.random.default_rng(2)
+        b, h, t, d = 2, 2, 32, 8
+        q = jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.float32)
+        mask = np.ones((b, t), np.float32)
+        mask[0, 20:] = 0.0  # example 0: last 12 steps are padding
+        mask[1, 5:] = 0.0   # example 1: nearly all padding
+        mask = jnp.asarray(mask)
+
+        ring = jax.jit(
+            make_ring_attention(mesh, "sp", causal=causal, masked=True)
+        )
+        out = np.asarray(ring(q, k, v, mask))
+
+        dscores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32)
+        )
+        neg = -jnp.inf
+        if causal:
+            cm = jnp.tril(jnp.ones((t, t), bool))
+            dscores = jnp.where(cm, dscores, neg)
+        dscores = jnp.where(mask[:, None, None, :] > 0, dscores, neg)
+        w = jax.nn.softmax(dscores, axis=-1)
+        expected = np.asarray(jnp.einsum("bhqk,bhkd->bhqd", w, v))
+
+        valid_q = np.asarray(mask) > 0  # only compare non-padded queries
+        np.testing.assert_allclose(
+            out[valid_q[:, None, :].repeat(h, 1)],
+            expected[valid_q[:, None, :].repeat(h, 1)],
+            atol=2e-5,
+        )
