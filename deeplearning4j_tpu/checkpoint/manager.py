@@ -8,7 +8,9 @@ elasticity):
 
 - **Async save**: params are snapshotted to host (cheap device→host copy)
   on the training thread, then compressed/written on a background thread so
-  the accelerator never idles on disk IO.
+  the accelerator never idles on disk IO. The pending-save queue is
+  BOUNDED (default 2): if the writer falls behind, ``save()`` blocks —
+  backpressure instead of accumulating full model copies until OOM.
 - **Atomic commits**: write to ``step_N.tmp`` dirs, ``os.replace`` rename —
   a crash mid-save can never leave a torn "latest" checkpoint.
 - **Retention**: keep the last ``keep_last_n`` steps plus the best-scoring
@@ -16,6 +18,10 @@ elasticity):
   BaseEarlyStoppingTrainer).
 - **Iterator state**: dataset-iterator position is saved alongside the
   model (the reference restarts the epoch on resume; we don't).
+
+Model payload serde delegates to util/model_serializer (one format, one
+implementation); each step directory holds ``model.zip`` + ``meta.json``
+(+ ``iterator.pkl``).
 """
 
 from __future__ import annotations
@@ -29,8 +35,11 @@ import shutil
 import threading
 from typing import Any, Dict, Optional, Tuple
 
-import jax
-import numpy as np
+from deeplearning4j_tpu.util.model_serializer import (
+    restore_model,
+    snapshot,
+    write_snapshot,
+)
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
@@ -42,13 +51,14 @@ class CheckpointManager:
         keep_last_n: int = 3,
         keep_best: bool = True,
         async_save: bool = True,
+        max_pending: int = 2,
     ):
         self.directory = directory
         self.keep_last_n = keep_last_n
         self.keep_best = keep_best
         self.async_save = async_save
         os.makedirs(directory, exist_ok=True)
-        self._queue: "queue.Queue" = queue.Queue()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_pending)
         self._worker: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()
@@ -64,26 +74,22 @@ class CheckpointManager:
         score: Optional[float] = None,
         metadata: Optional[Dict[str, Any]] = None,
     ) -> None:
-        """Snapshot on the caller's thread, write on the background one."""
+        """Snapshot on the caller's thread, write on the background one.
+        Blocks if ``max_pending`` saves are already in flight."""
         self._check_error()
-        net.init()
-        snapshot = {
-            "params": jax.tree.map(np.asarray, net.params),
-            "updater_state": jax.tree.map(np.asarray, net.updater_state),
-            "state": jax.tree.map(np.asarray, net.state),
-            "iteration": net.iteration,
-            "conf_json": net.conf.to_json(),
-            "kind": type(net).__name__,
-            "iterator_state": iterator.state_dict() if iterator is not None
-            else None,
+        payload = {
+            "snap": snapshot(net),
+            "iterator_state": (
+                iterator.state_dict() if iterator is not None else None
+            ),
             "score": score,
             "metadata": metadata or {},
         }
         if self.async_save:
             self._ensure_worker()
-            self._queue.put((step, snapshot))
+            self._queue.put((step, payload))
         else:
-            self._write(step, snapshot)
+            self._write(step, payload)
 
     def wait_until_finished(self) -> None:
         self._queue.join()
@@ -96,9 +102,9 @@ class CheckpointManager:
 
     def _drain(self) -> None:
         while True:
-            step, snapshot = self._queue.get()
+            step, payload = self._queue.get()
             try:
-                self._write(step, snapshot)
+                self._write(step, payload)
             except BaseException as e:
                 self._error = e
             finally:
@@ -109,36 +115,26 @@ class CheckpointManager:
             err, self._error = self._error, None
             raise err
 
-    def _write(self, step: int, snapshot: Dict[str, Any]) -> None:
+    def _write(self, step: int, payload: Dict[str, Any]) -> None:
         with self._lock:
             final = os.path.join(self.directory, f"step_{step}")
             tmp = final + ".tmp"
             if os.path.exists(tmp):
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
-            with open(os.path.join(tmp, "conf.json"), "w") as f:
-                f.write(snapshot["conf_json"])
-            with open(os.path.join(tmp, "arrays.pkl"), "wb") as f:
-                pickle.dump(
-                    {
-                        "params": snapshot["params"],
-                        "updater_state": snapshot["updater_state"],
-                        "state": snapshot["state"],
-                    },
-                    f,
-                )
+            write_snapshot(payload["snap"], os.path.join(tmp, "model.zip"))
             meta = {
                 "step": step,
-                "iteration": snapshot["iteration"],
-                "kind": snapshot["kind"],
-                "score": snapshot["score"],
-                "metadata": snapshot["metadata"],
+                "iteration": payload["snap"]["iteration"],
+                "kind": payload["snap"]["kind"],
+                "score": payload["score"],
+                "metadata": payload["metadata"],
             }
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump(meta, f)
-            if snapshot["iterator_state"] is not None:
+            if payload["iterator_state"] is not None:
                 with open(os.path.join(tmp, "iterator.pkl"), "wb") as f:
-                    pickle.dump(snapshot["iterator_state"], f)
+                    pickle.dump(payload["iterator_state"], f)
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.replace(tmp, final)
@@ -170,10 +166,7 @@ class CheckpointManager:
             steps
         )
         if self.keep_best:
-            scored = [
-                (s, self._score_of(s))
-                for s in steps
-            ]
+            scored = [(s, self._score_of(s)) for s in steps]
             scored = [(s, sc) for s, sc in scored if sc is not None]
             if scored:
                 best = min(scored, key=lambda t: t[1])[0]
@@ -209,9 +202,7 @@ class CheckpointManager:
     ) -> Tuple[Any, Dict[str, Any]]:
         """Returns (net, meta). If ``iterator`` is given, its position is
         restored in place."""
-        import jax.numpy as jnp
-
-        self.wait_until_finished() if self.async_save else None
+        self.wait_until_finished()
         if step is None:
             step = self.latest_step()
         if step is None:
@@ -219,34 +210,7 @@ class CheckpointManager:
         path = os.path.join(self.directory, f"step_{step}")
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
-        with open(os.path.join(path, "conf.json")) as f:
-            conf_json = f.read()
-        if meta["kind"] == "MultiLayerNetwork":
-            from deeplearning4j_tpu.nn.conf.multi_layer import (
-                MultiLayerConfiguration,
-            )
-            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-
-            net = MultiLayerNetwork(
-                MultiLayerConfiguration.from_json(conf_json)
-            ).init()
-        else:
-            from deeplearning4j_tpu.nn.conf.graph_conf import (
-                ComputationGraphConfiguration,
-            )
-            from deeplearning4j_tpu.nn.graph import ComputationGraph
-
-            net = ComputationGraph(
-                ComputationGraphConfiguration.from_json(conf_json)
-            ).init()
-        with open(os.path.join(path, "arrays.pkl"), "rb") as f:
-            arrays = pickle.load(f)
-        net.params = jax.tree.map(jnp.asarray, arrays["params"])
-        net.updater_state = jax.tree.map(
-            jnp.asarray, arrays["updater_state"]
-        )
-        net.state = jax.tree.map(jnp.asarray, arrays["state"])
-        net.iteration = int(meta["iteration"])
+        net = restore_model(os.path.join(path, "model.zip"))
         ipath = os.path.join(path, "iterator.pkl")
         if iterator is not None and os.path.exists(ipath):
             with open(ipath, "rb") as f:

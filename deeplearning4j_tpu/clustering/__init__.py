@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+__all__ = ["KMeansClustering", "KDTree", "VPTree"]

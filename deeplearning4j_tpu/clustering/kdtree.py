@@ -1,0 +1,101 @@
+"""KD-tree for nearest-neighbor queries.
+
+Capability mirror of reference clustering/kdtree/KDTree.java. Host-side
+structure (tree walks are scalar control flow — the wrong shape for the
+MXU; the reference likewise runs it on the JVM heap, serving the UI's
+nearest-neighbors view). Bulk distance math inside each query still
+vectorizes over numpy.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("idx", "dim", "left", "right")
+
+    def __init__(self, idx: int, dim: int):
+        self.idx = idx
+        self.dim = dim
+        self.left: Optional["_Node"] = None
+        self.right: Optional["_Node"] = None
+
+
+class KDTree:
+    def __init__(self, points) -> None:
+        self.points = np.asarray(points, np.float64)
+        n, self.dims = self.points.shape
+        order = list(range(n))
+        self.root = self._build(order, 0)
+        self.size = n
+
+    def _build(self, idxs: List[int], depth: int) -> Optional[_Node]:
+        if not idxs:
+            return None
+        dim = depth % self.dims
+        idxs = sorted(idxs, key=lambda i: self.points[i, dim])
+        mid = len(idxs) // 2
+        node = _Node(idxs[mid], dim)
+        node.left = self._build(idxs[:mid], depth + 1)
+        node.right = self._build(idxs[mid + 1:], depth + 1)
+        return node
+
+    # ------------------------------------------------------------------
+    def nn(self, query) -> Tuple[float, np.ndarray]:
+        """Nearest neighbor: (distance, point) (reference KDTree.nn)."""
+        d, i = self.nn_index(query)
+        return d, self.points[i]
+
+    def nn_index(self, query) -> Tuple[float, int]:
+        q = np.asarray(query, np.float64)
+        best = [np.inf, -1]
+
+        def walk(node: Optional[_Node]):
+            if node is None:
+                return
+            p = self.points[node.idx]
+            d = float(np.sqrt(np.sum((p - q) ** 2)))
+            if d < best[0]:
+                best[0], best[1] = d, node.idx
+            delta = q[node.dim] - p[node.dim]
+            near, far = (
+                (node.left, node.right) if delta < 0
+                else (node.right, node.left)
+            )
+            walk(near)
+            if abs(delta) < best[0]:  # hypersphere crosses the plane
+                walk(far)
+
+        walk(self.root)
+        return best[0], best[1]
+
+    def knn(self, query, k: int) -> List[Tuple[float, int]]:
+        """k nearest (distance, index) pairs, ascending by distance."""
+        q = np.asarray(query, np.float64)
+        heap: List[Tuple[float, int]] = []  # max-heap by -distance
+
+        import heapq
+
+        def walk(node: Optional[_Node]):
+            if node is None:
+                return
+            p = self.points[node.idx]
+            d = float(np.sqrt(np.sum((p - q) ** 2)))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.idx))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, node.idx))
+            delta = q[node.dim] - p[node.dim]
+            near, far = (
+                (node.left, node.right) if delta < 0
+                else (node.right, node.left)
+            )
+            walk(near)
+            if len(heap) < k or abs(delta) < -heap[0][0]:
+                walk(far)
+
+        walk(self.root)
+        return sorted((-nd, i) for nd, i in heap)

@@ -1,0 +1,116 @@
+"""KMeans clustering.
+
+Capability mirror of reference clustering/kmeans/KMeansClustering.java:31
+(Lloyd iterations over a generic BaseClusteringAlgorithm with iteration
+strategies). TPU-native design: the reference loops point-by-point over
+INDArray rows; here one Lloyd step is a single jitted XLA computation —
+the [N, K] distance matrix is two matmuls on the MXU, assignment is an
+argmin reduction, and the centroid update is a segment-sum expressed as a
+one-hot matmul (again MXU). The whole iteration runs under ``lax.scan``
+with early-exit semantics folded into a convergence mask (no
+data-dependent Python control flow under jit).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _kmeans_fit(points, key, k: int, max_iter: int):
+    n, d = points.shape
+
+    # -- kmeans++ seeding (vectorized D² sampling) ----------------------
+    def seed_body(carry, key_i):
+        centroids, count = carry
+        d2 = _sq_dists(points, centroids)  # [N, K]
+        # Distance to the nearest already-chosen centroid; unchosen slots
+        # hold +inf so they never win the min.
+        mask = jnp.arange(k) < count
+        d2 = jnp.where(mask[None, :], d2, jnp.inf)
+        near = jnp.min(d2, axis=1)
+        probs = near / jnp.maximum(jnp.sum(near), 1e-12)
+        idx = jax.random.choice(key_i, n, p=probs)
+        centroids = centroids.at[count].set(points[idx])
+        return (centroids, count + 1), None
+
+    key, k0 = jax.random.split(key)
+    first = points[jax.random.randint(k0, (), 0, n)]
+    centroids0 = jnp.zeros((k, d), points.dtype).at[0].set(first)
+    (centroids, _), _ = jax.lax.scan(
+        seed_body, (centroids0, 1), jax.random.split(key, k - 1)
+    )
+
+    # -- Lloyd iterations -----------------------------------------------
+    def lloyd(carry, _):
+        centroids, done = carry
+        d2 = _sq_dists(points, centroids)
+        assign = jnp.argmin(d2, axis=1)  # [N]
+        onehot = jax.nn.one_hot(assign, k, dtype=points.dtype)  # [N, K]
+        counts = jnp.sum(onehot, axis=0)  # [K]
+        sums = onehot.T @ points  # [K, D] — MXU matmul segment-sum
+        new = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0),
+            centroids,
+        )
+        moved = jnp.max(jnp.sum((new - centroids) ** 2, axis=1))
+        done = done | (moved < 1e-10)
+        # Once converged, freeze (scan still runs, centroids stop moving).
+        out = jnp.where(done, centroids, new)
+        return (out, done), None
+
+    (centroids, _), _ = jax.lax.scan(
+        lloyd, (centroids, jnp.asarray(False)), None, length=max_iter
+    )
+    d2 = _sq_dists(points, centroids)
+    assign = jnp.argmin(d2, axis=1)
+    inertia = jnp.sum(jnp.min(d2, axis=1))
+    return centroids, assign, inertia
+
+
+def _sq_dists(x, c):
+    """[N, K] squared euclidean distances via the expansion
+    ||x||² - 2x·c + ||c||² — the cross term is one MXU matmul."""
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=1)
+    return jnp.maximum(x2 - 2.0 * (x @ c.T) + c2[None, :], 0.0)
+
+
+class KMeansClustering:
+    """``setup(k, max_iter)`` then ``apply_to(points)`` (reference
+    KMeansClustering.setup/applyTo naming)."""
+
+    def __init__(self, k: int, max_iter: int = 100, seed: int = 0):
+        self.k = k
+        self.max_iter = max_iter
+        self.seed = seed
+        self.centroids: Optional[np.ndarray] = None
+
+    @classmethod
+    def setup(cls, k: int, max_iter: int = 100, seed: int = 0):
+        return cls(k, max_iter, seed)
+
+    def apply_to(self, points) -> Tuple[np.ndarray, np.ndarray, float]:
+        """Cluster; returns (centroids [K,D], assignments [N], inertia)."""
+        pts = jnp.asarray(points, jnp.float32)
+        if pts.shape[0] < self.k:
+            raise ValueError(
+                f"need at least k={self.k} points, got {pts.shape[0]}"
+            )
+        centroids, assign, inertia = _kmeans_fit(
+            pts, jax.random.key(self.seed), self.k, self.max_iter
+        )
+        self.centroids = np.asarray(centroids)
+        return self.centroids, np.asarray(assign), float(inertia)
+
+    def predict(self, points) -> np.ndarray:
+        if self.centroids is None:
+            raise RuntimeError("call apply_to first")
+        pts = jnp.asarray(points, jnp.float32)
+        d2 = _sq_dists(pts, jnp.asarray(self.centroids))
+        return np.asarray(jnp.argmin(d2, axis=1))

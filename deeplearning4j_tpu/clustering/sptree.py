@@ -1,0 +1,145 @@
+"""Space-partitioning tree (SPTree) + 2-D QuadTree.
+
+Capability mirror of reference clustering/sptree/SpTree.java and
+clustering/quadtree/QuadTree.java — the Barnes-Hut acceleration
+structures used by plot/BarnesHutTsne.java:62. Host-side: tree insertion
+and traversal are pointer-chasing, which belongs on the CPU next to the
+rest of the t-SNE driver loop (the TPU path is the exact jitted t-SNE in
+plot/tsne.py, which beats Barnes-Hut up to tens of thousands of points by
+keeping the O(N²) math on the MXU).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class _Cell:
+    __slots__ = (
+        "center", "width", "dims", "n_points", "com",
+        "point_idx", "point", "children", "is_leaf",
+    )
+
+    def __init__(self, center, width, dims):
+        self.center = center
+        self.width = width
+        self.dims = dims
+        self.n_points = 0
+        self.com = np.zeros(dims)
+        self.point_idx: Optional[int] = None
+        self.point: Optional[np.ndarray] = None
+        self.children: Optional[List["_Cell"]] = None
+        self.is_leaf = True
+
+    def _contains(self, p) -> bool:
+        return bool(
+            np.all(p >= self.center - self.width)
+            and np.all(p <= self.center + self.width)
+        )
+
+    def insert(self, idx: int, p: np.ndarray) -> bool:
+        if not self._contains(p):
+            return False
+        self.n_points += 1
+        self.com += (p - self.com) / self.n_points
+        if self.is_leaf:
+            if self.point_idx is None:
+                self.point_idx = idx
+                self.point = p
+                return True
+            if np.array_equal(self.point, p):
+                # Exact duplicate: aggregate in count/COM only.
+                return True
+            self._subdivide()
+        for c in self.children:
+            if c.insert(idx, p):
+                return True
+        return False  # numerically outside every child; COM still counts
+
+    def _subdivide(self) -> None:
+        self.children = []
+        for mask in range(2 ** self.dims):
+            offs = np.array(
+                [1.0 if (mask >> b) & 1 else -1.0 for b in range(self.dims)]
+            )
+            self.children.append(
+                _Cell(
+                    self.center + offs * self.width / 2.0,
+                    self.width / 2.0,
+                    self.dims,
+                )
+            )
+        old_idx, old_p = self.point_idx, self.point
+        self.point_idx = None
+        self.point = None
+        self.is_leaf = False
+        # Re-insert the displaced point WITHOUT re-counting it (this
+        # cell's n_points/COM already include it).
+        for c in self.children:
+            if c.insert(old_idx, old_p):
+                break
+
+    def non_edge_forces(self, p, skip_idx, theta, neg_out) -> float:
+        """Barnes-Hut negative-force accumulation; returns the Σ q_ij
+        normalizer contribution."""
+        if self.n_points == 0:
+            return 0.0
+        if self.is_leaf and self.point_idx == skip_idx and self.n_points == 1:
+            return 0.0
+        diff = p - self.com
+        d2 = float(diff @ diff)
+        max_width = float(np.max(self.width) * 2.0)
+        if self.is_leaf or max_width / max(np.sqrt(d2), 1e-12) < theta:
+            cnt = self.n_points
+            if self.point_idx == skip_idx:
+                cnt -= 1  # exclude self from an aggregated duplicate cell
+                if cnt == 0:
+                    return 0.0
+            q = 1.0 / (1.0 + d2)
+            mult = cnt * q
+            neg_out += mult * q * diff
+            return mult
+        s = 0.0
+        for c in self.children:
+            s += c.non_edge_forces(p, skip_idx, theta, neg_out)
+        return s
+
+
+class SPTree:
+    """d-dimensional Barnes-Hut tree over a point set. Cells store center
+    of mass + cumulative size; ``compute_non_edge_forces`` walks cells,
+    cutting off when (cell width / distance) < theta."""
+
+    def __init__(self, data: np.ndarray):
+        data = np.asarray(data, np.float64)
+        self.data = data
+        n, d = data.shape
+        self.dims = d
+        center = (data.max(0) + data.min(0)) / 2.0
+        width = np.maximum((data.max(0) - data.min(0)) / 2.0, 1e-5)
+        self.root = _Cell(center, width * 1.0001, d)
+        for i in range(n):
+            self.root.insert(i, data[i])
+
+    def compute_non_edge_forces(self, point_index: int, theta: float):
+        """Returns (neg_force [d], Σ q_ij contribution) for one point."""
+        neg = np.zeros(self.dims)
+        sum_q = self.root.non_edge_forces(
+            self.data[point_index], point_index, theta, neg
+        )
+        return neg, sum_q
+
+    def size(self) -> int:
+        return self.root.n_points
+
+
+class QuadTree(SPTree):
+    """2-D specialization (reference clustering/quadtree/QuadTree.java)."""
+
+    def __init__(self, data: np.ndarray):
+        data = np.asarray(data, np.float64)
+        if data.shape[1] != 2:
+            raise ValueError("QuadTree requires 2-D points")
+        super().__init__(data)

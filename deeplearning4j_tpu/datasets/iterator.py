@@ -162,6 +162,9 @@ class AsyncDataSetIterator(DataSetIterator):
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        # Serializes base.next() against state_dict() snapshots so a
+        # checkpoint never observes the base iterator mid-advance.
+        self._base_lock = threading.Lock()
 
     def _start(self, reset: bool = True) -> None:
         self._stop()
@@ -182,7 +185,8 @@ class AsyncDataSetIterator(DataSetIterator):
         def worker():
             try:
                 while not stop.is_set():
-                    ds = self._base.next()
+                    with self._base_lock:
+                        ds = self._base.next()
                     if ds is None:
                         break
                     while not stop.is_set():
@@ -238,8 +242,10 @@ class AsyncDataSetIterator(DataSetIterator):
     def state_dict(self) -> dict:
         # Prefetched-but-unconsumed batches count as consumed: resume
         # position is the base cursor, which is at most queue_size batches
-        # ahead of the consumer.
-        return {"base": self._base.state_dict()}
+        # ahead of the consumer. The lock guarantees the snapshot is
+        # internally consistent (never mid-next()).
+        with self._base_lock:
+            return {"base": self._base.state_dict()}
 
     def load_state_dict(self, state: dict) -> None:
         self._stop()

@@ -60,7 +60,7 @@ class LocalFileModelSaver(ModelSaver):
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         path = self._path(which)
-        if not os.path.exists(os.path.join(path, "conf.json")):
+        if not os.path.exists(path):
             return None
         return MultiLayerNetwork.load(path)
 

@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.graph.api import Edge, Graph, NoEdgeHandling, Vertex
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+
+__all__ = ["Edge", "Graph", "NoEdgeHandling", "Vertex", "DeepWalk"]

@@ -387,34 +387,18 @@ class ComputationGraph:
         return int(self.params_flat().shape[0])
 
     def save(self, path: str) -> None:
-        self.init()
-        os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "conf.json"), "w") as f:
-            f.write(self.conf.to_json())
-        with open(os.path.join(path, "params.pkl"), "wb") as f:
-            pickle.dump(jax.tree.map(np.asarray, self.params), f)
-        extras = {
-            "updater_state": jax.tree.map(np.asarray, self.updater_state),
-            "state": jax.tree.map(np.asarray, self.state),
-            "iteration": self.iteration,
-        }
-        with open(os.path.join(path, "updater.pkl"), "wb") as f:
-            pickle.dump(extras, f)
+        """One-zip checkpoint (util/model_serializer format)."""
+        from deeplearning4j_tpu.util.model_serializer import write_model
+
+        write_model(self, path)
 
     @staticmethod
     def load(path: str) -> "ComputationGraph":
-        with open(os.path.join(path, "conf.json")) as f:
-            conf = ComputationGraphConfiguration.from_json(f.read())
-        net = ComputationGraph(conf).init()
-        with open(os.path.join(path, "params.pkl"), "rb") as f:
-            net.params = jax.tree.map(jnp.asarray, pickle.load(f))
-        upath = os.path.join(path, "updater.pkl")
-        if os.path.exists(upath):
-            with open(upath, "rb") as f:
-                extras = pickle.load(f)
-            net.updater_state = jax.tree.map(jnp.asarray, extras["updater_state"])
-            net.state = jax.tree.map(jnp.asarray, extras["state"])
-            net.iteration = int(extras["iteration"])
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+
+        net = restore_model(path)
+        if not isinstance(net, ComputationGraph):
+            raise TypeError(f"{path} holds a {type(net).__name__}")
         return net
 
 

@@ -495,37 +495,18 @@ class MultiLayerNetwork:
     # updater, SURVEY.md §5.4; here conf JSON + params npz + updater npz)
     # ------------------------------------------------------------------
     def save(self, path: str) -> None:
-        self.init()
-        os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "conf.json"), "w") as f:
-            f.write(self.conf.to_json())
-        np_params = jax.tree.map(np.asarray, self.params)
-        with open(os.path.join(path, "params.pkl"), "wb") as f:
-            pickle.dump(np_params, f)
-        extras = {
-            "updater_state": jax.tree.map(np.asarray, self.updater_state),
-            "state": jax.tree.map(np.asarray, self.state),
-            "iteration": self.iteration,
-        }
-        with open(os.path.join(path, "updater.pkl"), "wb") as f:
-            pickle.dump(extras, f)
+        """One-zip checkpoint (util/model_serializer format)."""
+        from deeplearning4j_tpu.util.model_serializer import write_model
+
+        write_model(self, path)
 
     @staticmethod
     def load(path: str) -> "MultiLayerNetwork":
-        with open(os.path.join(path, "conf.json")) as f:
-            conf = MultiLayerConfiguration.from_json(f.read())
-        net = MultiLayerNetwork(conf).init()
-        with open(os.path.join(path, "params.pkl"), "rb") as f:
-            net.params = jax.tree.map(jnp.asarray, pickle.load(f))
-        upath = os.path.join(path, "updater.pkl")
-        if os.path.exists(upath):
-            with open(upath, "rb") as f:
-                extras = pickle.load(f)
-            net.updater_state = jax.tree.map(
-                jnp.asarray, extras["updater_state"]
-            )
-            net.state = jax.tree.map(jnp.asarray, extras["state"])
-            net.iteration = int(extras["iteration"])
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+
+        net = restore_model(path)
+        if not isinstance(net, MultiLayerNetwork):
+            raise TypeError(f"{path} holds a {type(net).__name__}")
         return net
 
     def clone(self) -> "MultiLayerNetwork":

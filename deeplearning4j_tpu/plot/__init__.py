@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.plot.tsne import Tsne
+from deeplearning4j_tpu.plot.barnes_hut_tsne import BarnesHutTsne
+
+__all__ = ["Tsne", "BarnesHutTsne"]

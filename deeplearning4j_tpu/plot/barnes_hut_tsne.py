@@ -1,0 +1,146 @@
+"""Barnes-Hut t-SNE.
+
+Capability mirror of reference plot/BarnesHutTsne.java:62 (785 LoC,
+implements Model): O(N log N) approximate t-SNE using the SPTree for the
+repulsive forces and a kNN-sparsified P for the attractive ones.
+
+Split of labor: the kNN affinity construction is vectorized (full
+distance matrix, top-k) and the per-iteration attractive forces are dense
+sparse-matrix math in numpy; the repulsive pass walks the SPTree on the
+host. For TPU-resident embedding of moderate N, prefer
+:class:`deeplearning4j_tpu.plot.tsne.Tsne` (exact, fully jitted) — this
+class exists for capability parity and for N large enough that O(N²)
+memory is the binding constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.sptree import SPTree
+
+
+def _knn_affinities(x: np.ndarray, perplexity: float, k: int):
+    """Row-wise gaussian affinities over the k nearest neighbors with
+    binary-searched sigma (the sparse analogue of Tsne._x2p)."""
+    n = x.shape[0]
+    x2 = np.sum(x * x, axis=1)
+    d2 = np.maximum(x2[:, None] - 2.0 * x @ x.T + x2[None, :], 0.0)
+    np.fill_diagonal(d2, np.inf)
+    nn_idx = np.argpartition(d2, k, axis=1)[:, :k]
+    rows = np.repeat(np.arange(n), k)
+    cols = nn_idx.ravel()
+    nn_d2 = d2[np.arange(n)[:, None], nn_idx]  # [N, k]
+
+    log_u = np.log(perplexity)
+    beta = np.ones(n)
+    lo = np.full(n, -np.inf)
+    hi = np.full(n, np.inf)
+    for _ in range(50):
+        p = np.exp(-nn_d2 * beta[:, None])
+        sum_p = np.maximum(p.sum(1), 1e-12)
+        h = np.log(sum_p) + beta * (nn_d2 * p).sum(1) / sum_p
+        diff = h - log_u
+        done = np.abs(diff) < 1e-5
+        if done.all():
+            break
+        too_high = diff > 0
+        lo = np.where(too_high & ~done, beta, lo)
+        hi = np.where(~too_high & ~done, beta, hi)
+        beta = np.where(
+            too_high & ~done,
+            np.where(np.isinf(hi), beta * 2.0, (beta + hi) / 2.0),
+            np.where(
+                ~too_high & ~done,
+                np.where(np.isinf(lo), beta / 2.0, (beta + lo) / 2.0),
+                beta,
+            ),
+        )
+    p = np.exp(-nn_d2 * beta[:, None])
+    p = p / np.maximum(p.sum(1, keepdims=True), 1e-12)
+    return rows, cols, p.ravel()
+
+
+class BarnesHutTsne:
+    def __init__(
+        self,
+        n_components: int = 2,
+        theta: float = 0.5,
+        perplexity: float = 30.0,
+        max_iter: int = 300,
+        learning_rate: float = 200.0,
+        stop_lying_iteration: int = 100,
+        momentum_switch_iteration: int = 100,
+        seed: int = 42,
+    ):
+        if n_components != 2:
+            # SPTree handles d dims, but reference BH-tSNE targets 2-D.
+            pass
+        self.n_components = n_components
+        self.theta = theta
+        self.perplexity = perplexity
+        self.max_iter = max_iter
+        self.learning_rate = learning_rate
+        self.stop_lying_iteration = stop_lying_iteration
+        self.momentum_switch_iteration = momentum_switch_iteration
+        self.seed = seed
+        self.y: Optional[np.ndarray] = None
+
+    def gradient(self, rows, cols, vals, y, sum_scale=1.0):
+        """One BH gradient: sparse attractive + tree repulsive forces
+        (reference BarnesHutTsne.gradient)."""
+        n, d = y.shape
+        # Attractive: Σ_j p_ij q*_ij (y_i - y_j) over the kNN edges.
+        diff = y[rows] - y[cols]  # [E, d]
+        q_unnorm = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+        w = (vals * sum_scale) * q_unnorm
+        attr = np.zeros_like(y)
+        np.add.at(attr, rows, w[:, None] * diff)
+        # Repulsive via SPTree.
+        tree = SPTree(y)
+        neg = np.zeros_like(y)
+        sum_q = 0.0
+        for i in range(n):
+            f, sq = tree.compute_non_edge_forces(i, self.theta)
+            neg[i] = f
+            sum_q += sq
+        sum_q = max(sum_q, 1e-12)
+        return attr - neg / sum_q
+
+    def calculate(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        k = min(int(3 * self.perplexity), n - 1)
+        rows, cols, vals = _knn_affinities(x, self.perplexity, k)
+        # Symmetrize the sparse P.
+        import collections
+
+        sym = collections.defaultdict(float)
+        for r, c, v in zip(rows, cols, vals):
+            sym[(r, c)] += v / (2.0 * n)
+            sym[(c, r)] += v / (2.0 * n)
+        rows = np.array([rc[0] for rc in sym])
+        cols = np.array([rc[1] for rc in sym])
+        vals = np.array(list(sym.values()))
+
+        rng = np.random.default_rng(self.seed)
+        y = rng.normal(scale=1e-2, size=(n, self.n_components))
+        vel = np.zeros_like(y)
+        gains = np.ones_like(y)
+        for it in range(self.max_iter):
+            lying = 12.0 if it < self.stop_lying_iteration else 1.0
+            momentum = 0.5 if it < self.momentum_switch_iteration else 0.8
+            grad = self.gradient(rows, cols, vals, y, sum_scale=lying)
+            same = np.sign(grad) == np.sign(vel)
+            gains = np.clip(
+                np.where(same, gains * 0.8, gains + 0.2), 0.01, None
+            )
+            vel = momentum * vel - self.learning_rate * gains * grad
+            y = y + vel
+            y = y - y.mean(0, keepdims=True)
+        self.y = y
+        return y
+
+    fit_transform = calculate

@@ -10,22 +10,27 @@ ONE zip archive so a model travels as a single artifact:
     model.zip
     ├── type                conf-class marker (multilayer | graph)
     ├── conf.json           configuration (the wire format, SURVEY.md §5.6)
-    ├── params.npz          param pytree, keys "layer/name" flattened
+    ├── params.npz          param pytree, keys "layer␟name" flattened
     └── extras.pkl          updater state + layer state + iteration
 
 Arrays go through numpy ``.npz`` (portable, no pickle needed for params);
 only updater/layer state uses pickle because its pytree structure is
 heterogeneous.
+
+This module is the SINGLE serialization implementation: network
+``save/load`` methods and the CheckpointManager both delegate here
+(``snapshot``/``write_snapshot`` split the host-copy step from the disk
+write so async checkpointing can snapshot on the training thread and
+write on a background one).
 """
 
 from __future__ import annotations
 
 import io
-import json
 import os
 import pickle
 import zipfile
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
@@ -69,27 +74,46 @@ def _merge_into(dst: Dict[str, Any], src: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def write_model(net, path: str) -> None:
-    """Serialize a MultiLayerNetwork or ComputationGraph to one zip file."""
+def snapshot(net) -> Dict[str, Any]:
+    """Host-side copy of everything needed to reconstruct ``net``.
+    Cheap device→host transfer on the caller's thread; the result is
+    immutable w.r.t. further training steps."""
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     net.init()
-    kind = "multilayer" if isinstance(net, MultiLayerNetwork) else "graph"
-    params = _flatten(jax.tree.map(np.asarray, net.params))
-    buf = io.BytesIO()
-    np.savez(buf, **params)
-    extras = {
+    return {
+        "kind": (
+            "multilayer" if isinstance(net, MultiLayerNetwork) else "graph"
+        ),
+        "conf_json": net.conf.to_json(),
+        "params": jax.tree.map(np.asarray, net.params),
         "updater_state": jax.tree.map(np.asarray, net.updater_state),
         "state": jax.tree.map(np.asarray, net.state),
         "iteration": net.iteration,
     }
+
+
+def write_snapshot(snap: Dict[str, Any], path: str) -> None:
+    """Write a snapshot dict to ``path`` as one zip, atomically."""
+    buf = io.BytesIO()
+    np.savez(buf, **_flatten(snap["params"]))
+    extras = {
+        "updater_state": snap["updater_state"],
+        "state": snap["state"],
+        "iteration": snap["iteration"],
+    }
     tmp = path + ".tmp"
     with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("type", kind)
-        z.writestr("conf.json", net.conf.to_json())
+        z.writestr("type", snap["kind"])
+        z.writestr("conf.json", snap["conf_json"])
         z.writestr("params.npz", buf.getvalue())
         z.writestr("extras.pkl", pickle.dumps(extras))
     os.replace(tmp, path)  # atomic commit: no torn checkpoints on crash
+
+
+def write_model(net, path: str) -> None:
+    """Serialize a MultiLayerNetwork or ComputationGraph to one zip file."""
+    write_snapshot(snapshot(net), path)
 
 
 def restore_model(path: str):

@@ -170,6 +170,21 @@ def test_async_save_error_surfaces(tmp_path):
     mgr.wait_until_finished()
     assert mgr.latest_step() == 0
 
+    # Inject a write failure on the background thread: it must surface on
+    # the next wait_until_finished()/save(), not vanish.
+    def boom(step, payload):
+        raise OSError("disk full")
+
+    mgr._write = boom
+    mgr.save(1, net)
+    with pytest.raises(OSError, match="disk full"):
+        mgr.wait_until_finished()
+    # Error is consumed once; manager remains usable afterwards.
+    del mgr._write  # restore the real method
+    mgr.save(2, net)
+    mgr.wait_until_finished()
+    assert 2 in mgr.all_steps()
+
 
 def test_serializer_paramless_layer_roundtrip(tmp_path):
     """CNN with pooling (param-less Subsampling layer) must round-trip

@@ -1,0 +1,151 @@
+"""Clustering + t-SNE tests (SURVEY.md §2.6: kmeans, kdtree, vptree,
+quadtree/sptree, exact + Barnes-Hut t-SNE)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, VPTree
+from deeplearning4j_tpu.clustering.sptree import QuadTree, SPTree
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def _blobs(n_per=50, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array(
+        [[5.0] * d, [-5.0] * d, [5.0] * (d // 2) + [-5.0] * (d - d // 2)]
+    )
+    pts = np.concatenate(
+        [c + rng.normal(scale=0.5, size=(n_per, d)) for c in centers]
+    )
+    labels = np.repeat(np.arange(3), n_per)
+    return pts.astype(np.float32), labels
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        pts, labels = _blobs()
+        km = KMeansClustering.setup(3, max_iter=50, seed=1)
+        centroids, assign, inertia = km.apply_to(pts)
+        # Each true cluster maps to exactly one predicted cluster.
+        for c in range(3):
+            vals = assign[labels == c]
+            assert len(set(vals.tolist())) == 1
+        # Inertia is tight for well-separated blobs.
+        assert inertia / pts.shape[0] < 2.0
+
+    def test_predict_matches_assign(self):
+        pts, _ = _blobs(seed=3)
+        km = KMeansClustering(3, seed=2)
+        _, assign, _ = km.apply_to(pts)
+        np.testing.assert_array_equal(km.predict(pts), assign)
+
+    def test_k_greater_than_n_raises(self):
+        with pytest.raises(ValueError):
+            KMeansClustering(5).apply_to(np.zeros((3, 2), np.float32))
+
+
+class TestTrees:
+    def test_kdtree_nn_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(200, 5))
+        tree = KDTree(pts)
+        for _ in range(20):
+            q = rng.normal(size=5)
+            d, idx = tree.nn_index(q)
+            brute = np.sqrt(np.sum((pts - q) ** 2, axis=1))
+            assert idx == int(np.argmin(brute))
+            assert d == pytest.approx(float(np.min(brute)))
+
+    def test_kdtree_knn(self):
+        rng = np.random.default_rng(1)
+        pts = rng.normal(size=(100, 3))
+        tree = KDTree(pts)
+        q = rng.normal(size=3)
+        got = [i for _, i in tree.knn(q, 5)]
+        brute = np.sqrt(np.sum((pts - q) ** 2, axis=1))
+        expected = np.argsort(brute)[:5].tolist()
+        assert got == expected
+
+    def test_vptree_knn_matches_brute_force(self):
+        rng = np.random.default_rng(2)
+        pts = rng.normal(size=(150, 8))
+        tree = VPTree(pts)
+        q = rng.normal(size=8)
+        got = [i for _, i in tree.knn(q, 7)]
+        brute = np.sqrt(np.sum((pts - q) ** 2, axis=1))
+        assert got == np.argsort(brute)[:7].tolist()
+
+    def test_vptree_cosine_words_nearest(self):
+        rng = np.random.default_rng(3)
+        vecs = rng.normal(size=(50, 16))
+        labels = [f"w{i}" for i in range(50)]
+        tree = VPTree(vecs, labels=labels, similarity="cosine")
+        # The nearest word to w7's own vector is w7 itself.
+        assert tree.words_nearest(vecs[7], 1) == ["w7"]
+
+    def test_sptree_com_and_count(self):
+        rng = np.random.default_rng(4)
+        pts = rng.normal(size=(64, 3))
+        tree = SPTree(pts)
+        assert tree.size() == 64
+        np.testing.assert_allclose(tree.root.com, pts.mean(0), atol=1e-9)
+
+    def test_sptree_duplicates(self):
+        pts = np.ones((10, 2))
+        tree = SPTree(pts)
+        assert tree.size() == 10
+
+    def test_quadtree_requires_2d(self):
+        with pytest.raises(ValueError):
+            QuadTree(np.zeros((4, 3)))
+
+    def test_sptree_forces_approximate_exact(self):
+        """theta→0 tree forces must equal the exact repulsive forces."""
+        rng = np.random.default_rng(5)
+        y = rng.normal(size=(40, 2))
+        tree = SPTree(y)
+        i = 7
+        neg, sum_q = tree.compute_non_edge_forces(i, theta=0.0)
+        diff = y[i] - y  # [N, 2]
+        d2 = np.sum(diff * diff, axis=1)
+        q = 1.0 / (1.0 + d2)
+        q[i] = 0.0
+        exact_neg = np.sum((q**2)[:, None] * diff, axis=0)
+        np.testing.assert_allclose(neg, exact_neg, atol=1e-9)
+        assert sum_q == pytest.approx(float(np.sum(q)), abs=1e-9)
+
+
+class TestTsne:
+    def test_exact_tsne_separates_blobs(self):
+        pts, labels = _blobs(n_per=30)
+        ts = Tsne(max_iter=250, perplexity=10.0, seed=0)
+        y = ts.calculate(pts)
+        assert y.shape == (90, 2)
+        # KL decreased over training.
+        assert ts.kl_history[-1] < ts.kl_history[5]
+        # Cluster separation: mean intra-cluster distance well below
+        # mean inter-cluster distance.
+        intra, inter = [], []
+        for a in range(3):
+            ya = y[labels == a]
+            intra.append(
+                np.mean(np.linalg.norm(ya - ya.mean(0), axis=1))
+            )
+            for b_ in range(a + 1, 3):
+                yb = y[labels == b_]
+                inter.append(np.linalg.norm(ya.mean(0) - yb.mean(0)))
+        assert np.mean(intra) * 2 < np.mean(inter)
+
+    def test_barnes_hut_tsne_separates_blobs(self):
+        pts, labels = _blobs(n_per=25, seed=7)
+        bh = BarnesHutTsne(theta=0.5, max_iter=250, perplexity=10.0, seed=1)
+        y = bh.calculate(pts)
+        assert y.shape == (75, 2)
+        intra, inter = [], []
+        for a in range(3):
+            ya = y[labels == a]
+            intra.append(np.mean(np.linalg.norm(ya - ya.mean(0), axis=1)))
+            for b_ in range(a + 1, 3):
+                yb = y[labels == b_]
+                inter.append(np.linalg.norm(ya.mean(0) - yb.mean(0)))
+        assert np.mean(intra) * 2 < np.mean(inter)

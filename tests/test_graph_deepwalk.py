@@ -1,0 +1,161 @@
+"""Graph API + random walks + DeepWalk tests (SURVEY.md §2.8, reference
+deeplearning4j-graph test suite: TestGraph, TestDeepWalk,
+TestGraphHuffman, TestGraphLoading)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import DeepWalk, Graph, NoEdgeHandling
+from deeplearning4j_tpu.graph.api import NoEdgesException
+from deeplearning4j_tpu.graph.loader import (
+    load_undirected_graph,
+    load_weighted_edge_list,
+)
+from deeplearning4j_tpu.graph.walker import (
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+    generate_walks,
+)
+
+
+def _two_cliques(k=6):
+    """Two k-cliques joined by a single bridge edge."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    g.add_edge(0, k)  # bridge
+    return g
+
+
+class TestGraphApi:
+    def test_adjacency(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2, directed=True)
+        assert g.num_vertices() == 4
+        assert g.num_edges() == 2
+        assert g.get_connected_vertex_indices(0) == [1]
+        assert g.get_connected_vertex_indices(1) == [0, 2]
+        assert g.get_connected_vertex_indices(2) == []  # directed 1->2
+        assert g.get_vertex_degree(3) == 0
+
+    def test_out_of_range(self):
+        g = Graph(2)
+        with pytest.raises(IndexError):
+            g.add_edge(0, 5)
+
+    def test_neighbor_table(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        g.add_edge(0, 2, weight=2.0)
+        nbr, wgt, deg = g.neighbor_table()
+        assert deg.tolist() == [2, 1, 1]
+        assert set(nbr[0, :2].tolist()) == {1, 2}
+
+
+class TestWalks:
+    def test_walks_follow_edges(self):
+        g = _two_cliques()
+        walks = generate_walks(g, walk_length=10, walks_per_vertex=2, seed=1)
+        assert walks.shape == (24, 11)
+        nbrs = {
+            i: set(g.get_connected_vertex_indices(i))
+            for i in range(g.num_vertices())
+        }
+        for walk in walks:
+            for a, b in zip(walk[:-1], walk[1:]):
+                assert int(b) in nbrs[int(a)]
+
+    def test_disconnected_self_loop_vs_exception(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        walks = generate_walks(g, 5, seed=0)  # vertex 2 disconnected
+        row = walks[walks[:, 0] == 2][0]
+        assert (row == 2).all()  # self-loops forever
+        with pytest.raises(NoEdgesException):
+            generate_walks(
+                g, 5,
+                no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED,
+            )
+
+    def test_iterator_facade(self):
+        g = _two_cliques()
+        it = RandomWalkIterator(g, walk_length=5, seed=3)
+        walks = list(it)
+        assert len(walks) == g.num_vertices()
+        assert sorted(w[0] for w in walks) == list(range(12))
+        it.reset()
+        assert it.has_next()
+
+    def test_weighted_walks_prefer_heavy_edges(self):
+        g = Graph(3)
+        g.add_edge(0, 1, weight=100.0)
+        g.add_edge(0, 2, weight=0.01)
+        it = WeightedRandomWalkIterator(g, walk_length=1, seed=5)
+        # Over many walks from 0, the heavy edge dominates.
+        counts = {1: 0, 2: 0}
+        for seed in range(50):
+            walks = generate_walks(g, 1, weighted=True, seed=seed)
+            start0 = walks[walks[:, 0] == 0][0]
+            counts[int(start0[1])] += 1
+        assert counts[1] > 45
+
+    def test_loaders(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("# comment\n0,1\n1,2\n")
+        g = load_undirected_graph(str(p), 3)
+        assert g.num_edges() == 2
+        pw = tmp_path / "weighted.txt"
+        pw.write_text("0,1,0.5\n1,2,2.0\n")
+        gw = load_weighted_edge_list(str(pw), 3)
+        _, wgt, _ = gw.neighbor_table()
+        assert 0.5 in wgt[0]
+
+
+class TestDeepWalk:
+    def test_clique_structure_embedding(self):
+        """Vertices inside a clique embed closer than across the bridge
+        (reference TestDeepWalk basic-quality assertion)."""
+        g = _two_cliques(k=6)
+        dw = DeepWalk(
+            vector_size=32, window_size=4, walks_per_vertex=20,
+            epochs=2, seed=7, batch_size=512, learning_rate=0.05,
+        )
+        dw.initialize(g)
+        dw.fit(walk_length=20)
+        same, cross = [], []
+        for i in range(1, 6):
+            same.append(dw.similarity(1, i + 1) if i + 1 < 6 else None)
+        same = [dw.similarity(i, j) for i in range(6) for j in range(i + 1, 6)]
+        cross = [dw.similarity(i, j + 6) for i in range(1, 6)
+                 for j in range(1, 6)]
+        assert np.mean(same) > np.mean(cross)
+
+    def test_vertex_vectors_and_nearest(self):
+        g = _two_cliques(k=4)
+        dw = DeepWalk(vector_size=16, walks_per_vertex=10, seed=1,
+                      batch_size=256)
+        dw.initialize(g)
+        dw.fit(walk_length=10)
+        v = dw.get_vertex_vector(0)
+        assert v.shape == (16,)
+        near = dw.verts_nearest(1, top_n=3)
+        assert len(near) == 3
+        assert all(0 <= x < 8 for x in near)
+
+    def test_save_vectors(self, tmp_path):
+        g = _two_cliques(k=4)
+        dw = DeepWalk(vector_size=8, walks_per_vertex=5, seed=2,
+                      batch_size=128)
+        dw.initialize(g)
+        dw.fit(walk_length=8)
+        path = str(tmp_path / "gv.txt")
+        dw.save_vectors(path)
+        from deeplearning4j_tpu.nlp.serializer import load_txt_vectors
+
+        sv = load_txt_vectors(path)
+        assert sv.vocab.num_words() == 8
+        v0 = sv.get_word_vector("0")
+        np.testing.assert_allclose(v0, dw.get_vertex_vector(0), atol=1e-5)
