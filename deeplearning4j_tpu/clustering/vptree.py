@@ -43,19 +43,22 @@ class VPTree:
         self.root = self._build(list(range(self.items.shape[0])))
 
     # -- metric ---------------------------------------------------------
+    # Cosine mode searches with EUCLIDEAN distance on the pre-normalized
+    # vectors: 1-cos violates the triangle inequality (it is ||u-v||²/2 on
+    # unit vectors), which breaks the tau pruning, while euclidean on unit
+    # vectors is a true metric with the identical neighbor ordering.
+    # Reported distances are converted back to 1-cos in knn().
     def _dist(self, i: int, idxs) -> np.ndarray:
-        if self.similarity == "cosine":
-            # cosine DISTANCE = 1 - cosine similarity (still a metric-ish
-            # ordering, matching the reference's "distance" framing)
-            return 1.0 - self._unit[idxs] @ self._unit[i]
-        diff = self.items[idxs] - self.items[i]
+        base = self._unit if self.similarity == "cosine" else self.items
+        diff = base[idxs] - base[i]
         return np.sqrt(np.sum(diff * diff, axis=1))
 
     def _dist_q(self, q: np.ndarray, idxs) -> np.ndarray:
         if self.similarity == "cosine":
             qn = q / max(np.linalg.norm(q), 1e-12)
-            return 1.0 - self._unit[idxs] @ qn
-        diff = self.items[idxs] - q
+            diff = self._unit[idxs] - qn
+        else:
+            diff = self.items[idxs] - q
         return np.sqrt(np.sum(diff * diff, axis=1))
 
     # -- build ----------------------------------------------------------
@@ -102,7 +105,10 @@ class VPTree:
                     walk(node.inside)
 
         walk(self.root)
-        return sorted((-nd, i) for nd, i in heap)
+        out = sorted((-nd, i) for nd, i in heap)
+        if self.similarity == "cosine":
+            out = [(d * d / 2.0, i) for d, i in out]  # back to 1-cos
+        return out
 
     def words_nearest(self, query, k: int) -> List[str]:
         """Nearest labels (the UI nearest-neighbors use case)."""

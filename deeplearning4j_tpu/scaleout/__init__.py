@@ -1,0 +1,60 @@
+"""Scale-out runtime: job/worker SPI, control plane, elastic training.
+
+TPU-native re-design of the reference's scale-out stack (SURVEY.md §2.7,
+§5.3, §5.8):
+
+- ``api``: the SPI shared by all runtimes — Job / JobIterator /
+  WorkerPerformer / JobAggregator / StateTracker (reference
+  deeplearning4j-scaleout-api, StateTracker.java:45).
+- ``runner``: in-process master/worker runtime with heartbeats, stale-
+  worker eviction and job requeue — the Akka MasterActor/WorkerActor
+  semantics (MasterActor.java:61,:141-171) on threads; supports both
+  Hogwild (no barrier) and iterative-reduce (BSP) work routing
+  (HogWildWorkRouter vs IterativeReduceWorkRouter).
+- ``coordinator``: HTTP/JSON control-plane service + client — the
+  ZooKeeper/Hazelcast role (config registry, membership, heartbeats,
+  shared state) for multi-process deployments; the data plane stays XLA
+  collectives over ICI/DCN.
+- ``elastic``: checkpoint-restart elasticity for gang-scheduled TPU
+  meshes + fault injection hooks (reference has per-worker elasticity;
+  SURVEY.md §5.3 maps it to shrink/regrow-mesh + resume).
+"""
+
+from deeplearning4j_tpu.scaleout.api import (
+    Job,
+    JobAggregator,
+    JobIterator,
+    ListJobIterator,
+    ArrayAveragingAggregator,
+    StateTracker,
+    InMemoryStateTracker,
+    WorkerPerformer,
+)
+from deeplearning4j_tpu.scaleout.runner import DistributedRunner, WorkRouting
+from deeplearning4j_tpu.scaleout.coordinator import (
+    CoordinatorClient,
+    CoordinatorServer,
+)
+from deeplearning4j_tpu.scaleout.elastic import (
+    ElasticTrainer,
+    FaultInjector,
+    SimulatedDeviceFailure,
+)
+
+__all__ = [
+    "Job",
+    "JobAggregator",
+    "JobIterator",
+    "ListJobIterator",
+    "ArrayAveragingAggregator",
+    "StateTracker",
+    "InMemoryStateTracker",
+    "WorkerPerformer",
+    "DistributedRunner",
+    "WorkRouting",
+    "CoordinatorClient",
+    "CoordinatorServer",
+    "ElasticTrainer",
+    "FaultInjector",
+    "SimulatedDeviceFailure",
+]

@@ -1,0 +1,278 @@
+"""Scale-out SPI: the contracts shared by every distributed runtime.
+
+Mirrors the reference deeplearning4j-scaleout-api module (SURVEY.md §2.7):
+``Job`` (workerId + serializable work), ``JobIterator``, ``WorkerPerformer``
+(WorkerPerformer.java:29 perform/update), ``JobAggregator``, and
+``StateTracker`` (StateTracker.java:45 — jobs, heartbeats, done-flag,
+best-model storage). The reference backs StateTracker with Hazelcast
+distributed maps (BaseHazelCastStateTracker.java); here the in-process
+implementation is plain locked dicts, and the multi-process one lives in
+``coordinator`` behind the same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class Job:
+    """A unit of work dispatched to one worker (reference Job.java)."""
+
+    work: Any
+    worker_id: Optional[str] = None
+    job_id: int = -1
+
+
+class JobIterator:
+    """Source of jobs for the master (reference JobIterator)."""
+
+    def next(self, worker_id: Optional[str] = None) -> Optional[Job]:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class ListJobIterator(JobIterator):
+    def __init__(self, items: Sequence[Any]):
+        self._items = list(items)
+        self._pos = 0
+        self._lock = threading.Lock()
+
+    def next(self, worker_id: Optional[str] = None) -> Optional[Job]:
+        with self._lock:
+            if self._pos >= len(self._items):
+                return None
+            job = Job(work=self._items[self._pos], worker_id=worker_id,
+                      job_id=self._pos)
+            self._pos += 1
+            return job
+
+    def has_next(self) -> bool:
+        with self._lock:
+            return self._pos < len(self._items)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pos = 0
+
+
+class WorkerPerformer:
+    """Executes a job and can absorb a global update
+    (reference WorkerPerformer.java:29 perform/update)."""
+
+    def perform(self, job: Job) -> Any:
+        raise NotImplementedError
+
+    def update(self, value: Any) -> None:  # new aggregated state pushed down
+        pass
+
+
+class JobAggregator:
+    """Combines per-worker results (reference JobAggregator;
+    INDArrayAggregator averages parameter vectors)."""
+
+    def accumulate(self, result: Any) -> None:
+        raise NotImplementedError
+
+    def aggregate(self) -> Any:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class ArrayAveragingAggregator(JobAggregator):
+    """Average numpy/jax arrays or pytrees of them — the param-averaging
+    combine (reference INDArrayAggregator / Spark Adder :355-361)."""
+
+    def __init__(self) -> None:
+        self._acc: Any = None
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def accumulate(self, result: Any) -> None:
+        import jax
+
+        with self._lock:
+            if self._acc is None:
+                self._acc = jax.tree_util.tree_map(np.asarray, result)
+            else:
+                self._acc = jax.tree_util.tree_map(
+                    lambda a, b: a + np.asarray(b), self._acc, result)
+            self._count += 1
+
+    def aggregate(self) -> Any:
+        import jax
+
+        with self._lock:
+            if self._acc is None:
+                return None
+            n = float(self._count)
+            return jax.tree_util.tree_map(lambda a: a / n, self._acc)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._acc = None
+            self._count = 0
+
+
+class StateTracker:
+    """Shared training state: job queue, worker heartbeats, done flag,
+    best-model storage (reference StateTracker.java:45)."""
+
+    # -- worker membership / heartbeats --------------------------------
+    def add_worker(self, worker_id: str) -> None:
+        raise NotImplementedError
+
+    def remove_worker(self, worker_id: str) -> None:
+        raise NotImplementedError
+
+    def workers(self) -> List[str]:
+        raise NotImplementedError
+
+    def heartbeat(self, worker_id: str) -> None:
+        raise NotImplementedError
+
+    def last_heartbeat(self, worker_id: str) -> Optional[float]:
+        raise NotImplementedError
+
+    # -- job lifecycle --------------------------------------------------
+    def add_job(self, job: Job) -> None:
+        raise NotImplementedError
+
+    def request_job(self, worker_id: str) -> Optional[Job]:
+        raise NotImplementedError
+
+    def clear_job(self, job_id: int) -> None:
+        raise NotImplementedError
+
+    def requeue_jobs_of(self, worker_id: str) -> int:
+        raise NotImplementedError
+
+    def current_jobs(self) -> List[Job]:
+        raise NotImplementedError
+
+    def pending_count(self) -> int:
+        """Queued + in-flight jobs; the runner's wait condition."""
+        raise NotImplementedError
+
+    # -- results / best model ------------------------------------------
+    def set_best_model(self, model: Any, score: float) -> None:
+        raise NotImplementedError
+
+    def best_model(self) -> Optional[Any]:
+        raise NotImplementedError
+
+    def best_score(self) -> Optional[float]:
+        raise NotImplementedError
+
+    # -- done flag ------------------------------------------------------
+    def finish(self) -> None:
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+
+class InMemoryStateTracker(StateTracker):
+    """Thread-safe single-process tracker — the role Hazelcast maps play in
+    BaseHazelCastStateTracker.java:911, minus the network."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._workers: Dict[str, float] = {}
+        self._queue: List[Job] = []
+        self._in_flight: Dict[int, Job] = {}
+        self._best_model: Optional[Any] = None
+        self._best_score: Optional[float] = None
+        self._done = False
+        self._clock: Callable[[], float] = time.monotonic
+
+    def add_worker(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers[worker_id] = self._clock()
+
+    def remove_worker(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers.pop(worker_id, None)
+
+    def workers(self) -> List[str]:
+        with self._lock:
+            return list(self._workers)
+
+    def heartbeat(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers[worker_id] = self._clock()
+
+    def last_heartbeat(self, worker_id: str) -> Optional[float]:
+        with self._lock:
+            return self._workers.get(worker_id)
+
+    def add_job(self, job: Job) -> None:
+        with self._lock:
+            self._queue.append(job)
+
+    def request_job(self, worker_id: str) -> Optional[Job]:
+        with self._lock:
+            if not self._queue:
+                return None
+            job = self._queue.pop(0)
+            job.worker_id = worker_id
+            self._in_flight[job.job_id] = job
+            return job
+
+    def clear_job(self, job_id: int) -> None:
+        with self._lock:
+            self._in_flight.pop(job_id, None)
+
+    def requeue_jobs_of(self, worker_id: str) -> int:
+        """Put an evicted worker's unfinished jobs back on the queue
+        (reference MasterActor.java:117-133 reconciliation)."""
+        with self._lock:
+            stale = [j for j in self._in_flight.values()
+                     if j.worker_id == worker_id]
+            for job in stale:
+                self._in_flight.pop(job.job_id, None)
+                job.worker_id = None
+                self._queue.insert(0, job)
+            return len(stale)
+
+    def current_jobs(self) -> List[Job]:
+        with self._lock:
+            return list(self._in_flight.values())
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._in_flight)
+
+    def set_best_model(self, model: Any, score: float) -> None:
+        with self._lock:
+            if self._best_score is None or score < self._best_score:
+                self._best_score = score
+                self._best_model = model
+
+    def best_model(self) -> Optional[Any]:
+        with self._lock:
+            return self._best_model
+
+    def best_score(self) -> Optional[float]:
+        with self._lock:
+            return self._best_score
+
+    def finish(self) -> None:
+        with self._lock:
+            self._done = True
+
+    def is_done(self) -> bool:
+        with self._lock:
+            return self._done

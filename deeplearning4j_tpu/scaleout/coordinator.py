@@ -1,0 +1,302 @@
+"""HTTP/JSON control-plane service: config registry, membership, heartbeats.
+
+Multi-process replacement for the reference's three control-plane stores
+(SURVEY.md §5.8): ZooKeeper (conf registry/discovery,
+DeepLearning4jDistributed.java:258-264), Hazelcast distributed maps
+(heartbeats/jobs/best-model, BaseHazelCastStateTracker.java:911), and the
+Akka DistributedPubSub job pump. One small threaded HTTP server carries
+all three roles; the *data plane* (gradients/params) never touches it —
+that is XLA collectives over ICI/DCN (parallel/).
+
+Endpoints (JSON bodies):
+  POST /register    {worker_id}            → {ok}
+  POST /heartbeat   {worker_id}            → {ok}
+  GET  /members                            → {workers: {id: age_s}}
+  POST /config      {key, value}           → {ok}       (conf registry)
+  GET  /config?key=…                       → {value}
+  POST /job         {work}                 → {job_id}
+  POST /job/request {worker_id}            → {job_id, work} | {}
+  POST /job/done    {job_id}               → {ok}
+  POST /barrier     {name, n, worker_id}   → {released} (blocking poll)
+  POST /finish / GET /done                 → run-done flag
+
+Used by the elastic trainer for failure detection: a gang member that
+misses ``eviction_timeout`` of heartbeats marks the gang degraded, which
+triggers checkpoint-restart (elastic.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import pickle
+import threading
+import time
+import urllib.request
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.scaleout.api import Job, StateTracker
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.workers: Dict[str, float] = {}
+        self.config: Dict[str, Any] = {}
+        self.queue: List[Dict[str, Any]] = []
+        self.in_flight: Dict[int, Dict[str, Any]] = {}
+        self.next_job_id = 0
+        self.done = False
+        self.barriers: Dict[str, set] = {}
+        self.best_score: Optional[float] = None
+        self.best_model_b64: Optional[str] = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State  # set by server factory
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # silence
+        pass
+
+    def _reply(self, obj: Dict[str, Any], code: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length", 0))
+        if n == 0:
+            return {}
+        return json.loads(self.rfile.read(n))
+
+    def do_GET(self) -> None:
+        st = self.state
+        parsed = urllib.parse.urlparse(self.path)
+        with st.lock:
+            if parsed.path == "/members":
+                now = time.monotonic()
+                self._reply({"workers": {w: now - t
+                                         for w, t in st.workers.items()}})
+            elif parsed.path == "/config":
+                key = urllib.parse.parse_qs(parsed.query).get("key", [""])[0]
+                self._reply({"value": st.config.get(key)})
+            elif parsed.path == "/done":
+                self._reply({"done": st.done})
+            elif parsed.path == "/pending":
+                self._reply({"pending": len(st.queue) + len(st.in_flight)})
+            elif parsed.path == "/best":
+                self._reply({"score": st.best_score,
+                             "model_b64": st.best_model_b64})
+            else:
+                self._reply({"error": "not found"}, 404)
+
+    def do_POST(self) -> None:
+        st = self.state
+        body = self._body()
+        with st.lock:
+            if self.path == "/register":
+                st.workers[body["worker_id"]] = time.monotonic()
+                self._reply({"ok": True})
+            elif self.path == "/heartbeat":
+                st.workers[body["worker_id"]] = time.monotonic()
+                self._reply({"ok": True})
+            elif self.path == "/config":
+                st.config[body["key"]] = body["value"]
+                self._reply({"ok": True})
+            elif self.path == "/job":
+                jid = st.next_job_id
+                st.next_job_id += 1
+                st.queue.append({"job_id": jid, "work": body["work"]})
+                self._reply({"job_id": jid})
+            elif self.path == "/job/request":
+                if not st.queue:
+                    self._reply({})
+                else:
+                    job = st.queue.pop(0)
+                    job["worker_id"] = body.get("worker_id")
+                    st.in_flight[job["job_id"]] = job
+                    self._reply(job)
+            elif self.path == "/job/done":
+                st.in_flight.pop(body["job_id"], None)
+                self._reply({"ok": True})
+            elif self.path == "/barrier":
+                name, n = body["name"], int(body["n"])
+                members = st.barriers.setdefault(name, set())
+                members.add(body["worker_id"])
+                self._reply({"released": len(members) >= n})
+            elif self.path == "/best":
+                # atomic keep-the-minimum (reference StateTracker best-model)
+                score = float(body["score"])
+                if st.best_score is None or score < st.best_score:
+                    st.best_score = score
+                    st.best_model_b64 = body.get("model_b64")
+                    self._reply({"kept": True})
+                else:
+                    self._reply({"kept": False})
+            elif self.path == "/finish":
+                st.done = True
+                self._reply({"ok": True})
+            else:
+                self._reply({"error": "not found"}, 404)
+
+
+class CoordinatorServer:
+    """Threaded control-plane server; bind to 127.0.0.1 for tests, an
+    internal VIP in deployment."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        state = _State()
+        handler = type("Handler", (_Handler,), {"state": state})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.state = state
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "CoordinatorServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+    def evict_stale(self, timeout: float) -> List[str]:
+        """Drop workers silent ≥ timeout, return their ids (the reference
+        master sweep, MasterActor.java:141-171)."""
+        now = time.monotonic()
+        with self.state.lock:
+            stale = [w for w, t in self.state.workers.items()
+                     if now - t >= timeout]
+            for w in stale:
+                del self.state.workers[w]
+                for job in list(self.state.in_flight.values()):
+                    if job.get("worker_id") == w:
+                        del self.state.in_flight[job["job_id"]]
+                        job.pop("worker_id", None)
+                        self.state.queue.insert(0, job)
+        return stale
+
+
+class CoordinatorClient(StateTracker):
+    """Client bound to a CoordinatorServer; implements the StateTracker
+    SPI so runtimes are agnostic of in-process vs multi-process."""
+
+    def __init__(self, address: str, timeout: float = 10.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+        self._barrier_gens: Dict[str, int] = {}
+
+    def _call(self, path: str, payload: Optional[Dict[str, Any]] = None,
+              query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        url = self.address + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"} if data else {})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    # -- StateTracker SPI ----------------------------------------------
+    def add_worker(self, worker_id: str) -> None:
+        self._call("/register", {"worker_id": worker_id})
+
+    def remove_worker(self, worker_id: str) -> None:
+        pass  # eviction is server-side (evict_stale)
+
+    def workers(self) -> List[str]:
+        return list(self._call("/members")["workers"])
+
+    def heartbeat(self, worker_id: str) -> None:
+        self._call("/heartbeat", {"worker_id": worker_id})
+
+    def last_heartbeat(self, worker_id: str) -> Optional[float]:
+        ages = self._call("/members")["workers"]
+        if worker_id not in ages:
+            return None
+        return time.monotonic() - ages[worker_id]
+
+    def add_job(self, job: Job) -> None:
+        self._call("/job", {"work": job.work})
+
+    def request_job(self, worker_id: str) -> Optional[Job]:
+        got = self._call("/job/request", {"worker_id": worker_id})
+        if "job_id" not in got:
+            return None
+        return Job(work=got["work"], worker_id=worker_id,
+                   job_id=got["job_id"])
+
+    def clear_job(self, job_id: int) -> None:
+        self._call("/job/done", {"job_id": job_id})
+
+    def requeue_jobs_of(self, worker_id: str) -> int:
+        return 0  # handled server-side by evict_stale
+
+    def current_jobs(self) -> List[Job]:
+        return []
+
+    def pending_count(self) -> int:
+        return int(self._call("/pending")["pending"])
+
+    def set_best_model(self, model: Any, score: float) -> None:
+        """Atomic server-side keep-the-minimum; model shipped as
+        pickled base64 (control-plane sizes: confs/small host models —
+        big param trees go through checkpoints, not the coordinator)."""
+        blob = base64.b64encode(pickle.dumps(model)).decode()
+        self._call("/best", {"score": float(score), "model_b64": blob})
+
+    def best_model(self) -> Optional[Any]:
+        got = self._call("/best")
+        if not got.get("model_b64"):
+            return None
+        return pickle.loads(base64.b64decode(got["model_b64"]))
+
+    def best_score(self) -> Optional[float]:
+        return self._call("/best")["score"]
+
+    def finish(self) -> None:
+        self._call("/finish", {})
+
+    def is_done(self) -> bool:
+        return bool(self._call("/done")["done"])
+
+    # -- config registry (the ZooKeeper role) --------------------------
+    def set_config(self, key: str, value: Any) -> None:
+        self._call("/config", {"key": key, "value": value})
+
+    def get_config(self, key: str) -> Any:
+        return self._call("/config", query={"key": key})["value"]
+
+    # -- barrier --------------------------------------------------------
+    def barrier(self, name: str, n: int, worker_id: str,
+                timeout: float = 30.0, poll: float = 0.01) -> bool:
+        """Block until n distinct workers reach the barrier.
+
+        Each successful release advances this client's generation counter
+        for ``name``, so reusing one name per BSP round synchronizes every
+        round (server membership sets are generation-scoped)."""
+        gen = self._barrier_gens.get(name, 0)
+        scoped = f"{name}#{gen}"
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            out = self._call("/barrier",
+                             {"name": scoped, "n": n, "worker_id": worker_id})
+            if out["released"]:
+                self._barrier_gens[name] = gen + 1
+                return True
+            time.sleep(poll)
+        return False

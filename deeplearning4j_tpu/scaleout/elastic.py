@@ -1,0 +1,136 @@
+"""Checkpoint-restart elasticity + fault injection for gang-scheduled TPU.
+
+The reference's elasticity is per-worker: an Akka worker dying just means
+its jobs are requeued and the pool shrinks (MasterActor.java:141-171).
+Multi-host TPU is gang-scheduled — losing one host kills the whole step —
+so SURVEY.md §5.3 maps that capability to **checkpoint-restart**: detect
+the failure (missed heartbeats on the control plane), shrink (or regrow)
+the device mesh, restore the latest checkpoint, and resume. The reference
+has no fault-injection machinery at all; ``FaultInjector`` adds it.
+
+``ElasticTrainer`` drives a user train-step callback over epochs of a
+DataSetIterator, checkpointing every N steps via CheckpointManager
+(checkpoint/manager.py — async, iterator position included, which the
+reference never checkpoints) and transparently restarting on
+``SimulatedDeviceFailure`` (from the injector) or any XLA/runtime error
+matching ``retryable``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional
+
+from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+
+
+class SimulatedDeviceFailure(RuntimeError):
+    """Raised by FaultInjector to emulate a chip/host dropping out."""
+
+
+class FaultInjector:
+    """Deterministic fault-injection hooks (reference has none — new
+    capability, SURVEY.md §5.3 'add fault-injection hooks').
+
+    ``fail_at_steps``: raise SimulatedDeviceFailure the first time each
+    listed global step is reached. Each step fires at most once, so the
+    restarted run proceeds past it — modeling a transient failure.
+    """
+
+    def __init__(self, fail_at_steps: Optional[List[int]] = None):
+        self._pending = set(fail_at_steps or [])
+        self.fired: List[int] = []
+
+    def check(self, step: int) -> None:
+        if step in self._pending:
+            self._pending.discard(step)
+            self.fired.append(step)
+            raise SimulatedDeviceFailure(f"injected failure at step {step}")
+
+
+class ElasticTrainer:
+    """Train with automatic checkpoint-restart.
+
+    Parameters
+    ----------
+    net: the model (anything checkpoint/manager.snapshot supports).
+    train_step: callback ``(net, dataset) -> float`` returning the score;
+        runs ONE optimizer pass on one batch (typically net.fit on a
+        single DataSet, itself a jit'd XLA computation).
+    checkpoint_dir: where CheckpointManager writes.
+    checkpoint_every: global-step save period.
+    max_restarts: give up after this many restarts (a persistent failure
+        is not elastic-recoverable; surface it).
+    """
+
+    def __init__(
+        self,
+        net: Any,
+        train_step: Callable[[Any, Any], float],
+        checkpoint_dir: str,
+        checkpoint_every: int = 10,
+        injector: Optional[FaultInjector] = None,
+        max_restarts: int = 3,
+        retryable: tuple = (SimulatedDeviceFailure,),
+    ):
+        self.net = net
+        self.train_step = train_step
+        self.manager = CheckpointManager(checkpoint_dir, async_save=False)
+        self.checkpoint_every = checkpoint_every
+        self.injector = injector
+        self.max_restarts = max_restarts
+        self.retryable = retryable
+        self.restarts = 0
+        self.scores: List[float] = []
+
+    def fit(self, iterator, num_epochs: int = 1) -> Any:
+        """Run ``num_epochs`` over the iterator; returns the trained net."""
+        step = 0
+        epoch = 0
+        resuming = False
+        while epoch < num_epochs:
+            try:
+                if not resuming:
+                    iterator.reset()
+                resuming = False
+                while True:
+                    ds = iterator.next()
+                    if ds is None:
+                        break
+                    if self.injector is not None:
+                        self.injector.check(step)
+                    score = self.train_step(self.net, ds)
+                    self.scores.append(float(score))
+                    step += 1
+                    if step % self.checkpoint_every == 0:
+                        self.manager.save(step, self.net, iterator=iterator,
+                                          score=float(score),
+                                          metadata={"epoch": epoch,
+                                                    "step": step})
+                epoch += 1
+            except self.retryable:
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    raise
+                step, epoch = self._restore(iterator)
+                resuming = True
+        self.manager.save(step, self.net, iterator=iterator,
+                          score=self.scores[-1] if self.scores else None,
+                          metadata={"epoch": epoch, "step": step})
+        self.manager.wait_until_finished()
+        return self.net
+
+    def _restore(self, iterator) -> tuple:
+        """Reload the latest checkpoint (params + updater + iterator
+        position); returns (step, epoch) to resume from. If no checkpoint
+        exists yet, restart from scratch."""
+        latest = self.manager.latest_step()
+        if latest is None:
+            iterator.reset()
+            return 0, 0
+        net, meta = self.manager.restore(latest, iterator=iterator)
+        # Rebind restored state onto the live net object so callers keep
+        # their handle (mirrors reference MultiLayerNetwork.setParameters).
+        self.net.__dict__.update(net.__dict__)
+        md = meta.get("metadata", {})
+        return md.get("step", latest), md.get("epoch", 0)

@@ -1,0 +1,202 @@
+"""In-process master/worker runtime with heartbeats and eviction.
+
+Thread-based re-design of the Akka parameter-server runtime (SURVEY.md
+§3.5): ``MasterActor`` (MasterActor.java:61) becomes the dispatch loop,
+``WorkerActor`` (WorkerActor.java:52, 1 s heartbeat :168) becomes worker
+threads pulling jobs from the StateTracker, and the 60 s stale-worker sweep
+that evicts workers silent ≥120 s (MasterActor.java:141-171) becomes a
+configurable reaper that also requeues the evicted worker's unfinished
+jobs. Work routing matches the reference's two routers:
+
+- ``WorkRouting.HOGWILD``  — no barriers; every result is applied to the
+  shared state as it lands (HogWildWorkRouter).
+- ``WorkRouting.ITERATIVE_REDUCE`` — BSP rounds: dispatch a wave of jobs,
+  wait for all, aggregate once, push the aggregate back to performers
+  (IterativeReduceWorkRouter / Spark runIteration §3.4).
+
+On TPU the *data plane* for gradient math is XLA collectives
+(parallel/data_parallel.py); this runtime is the *control plane* pattern —
+used for embarrassingly-parallel host-side work (W2V vocab counting,
+co-occurrence counting, random-walk generation) and as the single-process
+test harness for the multi-process coordinator, exactly the role of the
+reference's BaseTestDistributed (testsupport/BaseTestDistributed.java:35-80).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from deeplearning4j_tpu.scaleout.api import (
+    InMemoryStateTracker,
+    Job,
+    JobAggregator,
+    JobIterator,
+    StateTracker,
+    WorkerPerformer,
+)
+
+
+class WorkRouting(enum.Enum):
+    HOGWILD = "hogwild"
+    ITERATIVE_REDUCE = "iterative_reduce"
+
+
+class _Worker(threading.Thread):
+    def __init__(self, worker_id: str, tracker: StateTracker,
+                 performer: WorkerPerformer, runner: "DistributedRunner"):
+        super().__init__(daemon=True, name=worker_id)
+        self.worker_id = worker_id
+        self.tracker = tracker
+        self.performer = performer
+        self.runner = runner
+        self.stop_flag = threading.Event()
+        # Fault-injection hook: when set, the worker stops heartbeating but
+        # (unlike a clean stop) leaves its in-flight job unfinished.
+        self.simulate_death = threading.Event()
+
+    def run(self) -> None:
+        tracker = self.tracker
+        tracker.add_worker(self.worker_id)
+        last_beat = 0.0
+        while not self.stop_flag.is_set() and not tracker.is_done():
+            if self.simulate_death.is_set():
+                return  # vanish without deregistering — reaper must catch it
+            now = time.monotonic()
+            if now - last_beat >= self.runner.heartbeat_interval:
+                tracker.heartbeat(self.worker_id)
+                last_beat = now
+            job = tracker.request_job(self.worker_id)
+            if job is None:
+                time.sleep(self.runner.idle_sleep)
+                continue
+            if self.simulate_death.is_set():
+                return  # died mid-job: job stays in-flight, gets requeued
+            result = self.performer.perform(job)
+            self.runner._on_result(self.worker_id, job, result)
+            tracker.clear_job(job.job_id)
+
+
+class DistributedRunner:
+    """Master loop: dispatch jobs to worker threads, aggregate results,
+    reap dead workers (reference DeepLearning4jDistributed.java:66 +
+    MasterActor)."""
+
+    def __init__(
+        self,
+        performer_factory: Callable[[], WorkerPerformer],
+        num_workers: int = 4,
+        aggregator: Optional[JobAggregator] = None,
+        routing: WorkRouting = WorkRouting.HOGWILD,
+        tracker: Optional[StateTracker] = None,
+        heartbeat_interval: float = 1.0,
+        eviction_timeout: float = 120.0,
+        reaper_interval: float = 60.0,
+        idle_sleep: float = 0.005,
+    ):
+        self.performers = [performer_factory() for _ in range(num_workers)]
+        self.aggregator = aggregator
+        self.routing = routing
+        self.tracker = tracker or InMemoryStateTracker()
+        self.heartbeat_interval = heartbeat_interval
+        self.eviction_timeout = eviction_timeout
+        self.reaper_interval = reaper_interval
+        self.idle_sleep = idle_sleep
+        self._workers: List[_Worker] = []
+        self._result_lock = threading.Lock()
+        self._results: List[Any] = []
+        self.evicted: List[str] = []
+
+    # -- result sink ----------------------------------------------------
+    def _on_result(self, worker_id: str, job: Job, result: Any) -> None:
+        with self._result_lock:
+            self._results.append(result)
+        if self.routing is WorkRouting.HOGWILD and self.aggregator:
+            self.aggregator.accumulate(result)
+
+    # -- reaper ---------------------------------------------------------
+    def _reap(self) -> None:
+        now = time.monotonic()
+        for wid in self.tracker.workers():
+            beat = self.tracker.last_heartbeat(wid)
+            if beat is not None and now - beat >= self.eviction_timeout:
+                requeued = self.tracker.requeue_jobs_of(wid)
+                self.tracker.remove_worker(wid)
+                self.evicted.append(wid)
+                del requeued  # count kept for symmetry with reference logs
+
+    # -- lifecycle ------------------------------------------------------
+    def _spawn(self) -> None:
+        self._workers = [
+            _Worker(f"worker-{i}", self.tracker, perf, self)
+            for i, perf in enumerate(self.performers)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def _join(self) -> None:
+        for w in self._workers:
+            w.stop_flag.set()
+        for w in self._workers:
+            w.join(timeout=5.0)
+
+    def run(self, jobs: JobIterator, max_wait: float = 300.0) -> Any:
+        """Drain the job iterator through the worker pool.
+
+        HOGWILD: one pass, results applied as they land. ITERATIVE_REDUCE:
+        repeated waves; after each wave the aggregate is pushed back into
+        every performer via ``update()`` before the next wave starts.
+        """
+        self._spawn()
+        last_reap = time.monotonic()
+        try:
+            final_aggregate = None
+            if self.routing is WorkRouting.HOGWILD:
+                while jobs.has_next():
+                    job = jobs.next()
+                    if job is None:
+                        break
+                    self.tracker.add_job(job)
+                self._wait_drained(max_wait, last_reap)
+                if self.aggregator is not None:
+                    final_aggregate = self.aggregator.aggregate()
+            else:
+                while jobs.has_next():
+                    # one wave = one job per live worker (BSP round)
+                    for _ in range(max(1, len(self.tracker.workers()))):
+                        job = jobs.next()
+                        if job is None:
+                            break
+                        self.tracker.add_job(job)
+                    last_reap = self._wait_drained(max_wait, last_reap)
+                    if self.aggregator is not None:
+                        for r in self.drain_results():
+                            self.aggregator.accumulate(r)
+                        final_aggregate = self.aggregator.aggregate()
+                        self.aggregator.reset()
+                        for perf in self.performers:
+                            perf.update(final_aggregate)
+            self.tracker.finish()
+        finally:
+            self._join()
+        return final_aggregate
+
+    def _wait_drained(self, max_wait: float, last_reap: float) -> float:
+        """Block until the tracker's queue + in-flight set is empty,
+        reaping stale workers along the way; returns last reap time."""
+        deadline = time.monotonic() + max_wait
+        while (self.tracker.pending_count() > 0
+               and time.monotonic() < deadline):
+            if time.monotonic() - last_reap >= self.reaper_interval:
+                self._reap()
+                last_reap = time.monotonic()
+            time.sleep(self.idle_sleep)
+        return last_reap
+
+    def drain_results(self) -> List[Any]:
+        with self._result_lock:
+            out = self._results
+            self._results = []
+        return out

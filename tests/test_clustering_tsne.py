@@ -83,6 +83,24 @@ class TestTrees:
         # The nearest word to w7's own vector is w7 itself.
         assert tree.words_nearest(vecs[7], 1) == ["w7"]
 
+    def test_vptree_cosine_knn_matches_brute_force(self):
+        # Regression: 1-cos is not a metric (triangle inequality fails),
+        # so pruning must run on euclidean-over-unit-vectors internally.
+        rng = np.random.default_rng(11)
+        for seed in range(20):
+            r = np.random.default_rng(seed)
+            angles = r.uniform(0, 2 * np.pi, size=30)
+            pts = np.stack([np.cos(angles), np.sin(angles)], axis=1)
+            tree = VPTree(pts, similarity="cosine", seed=seed)
+            q = rng.normal(size=2)
+            got = [i for _, i in tree.knn(q, 3)]
+            qn = q / np.linalg.norm(q)
+            brute = 1.0 - pts @ qn
+            assert set(got) == set(np.argsort(brute)[:3].tolist()), seed
+            # reported distances are 1-cos
+            dists = [d for d, _ in tree.knn(q, 3)]
+            assert np.allclose(sorted(dists), np.sort(brute)[:3], atol=1e-9)
+
     def test_sptree_com_and_count(self):
         rng = np.random.default_rng(4)
         pts = rng.normal(size=(64, 3))
