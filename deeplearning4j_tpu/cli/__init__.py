@@ -1,0 +1,12 @@
+"""Command-line interface: train / test / predict.
+
+Mirror of the reference deeplearning4j-cli module (SURVEY.md §2.8 —
+driver/CommandLineInterfaceDriver.java, subcommands/{Train,Test,Predict}
+.java, api/flags/*). args4j @Option flags become argparse; the URI-scheme
+input/output resolution (files/FileScheme.java) becomes the ``resolve_input``
+data-source registry (csv / npz / built-in dataset names).
+"""
+
+from deeplearning4j_tpu.cli.driver import main
+
+__all__ = ["main"]

@@ -1,0 +1,5 @@
+"""``python -m deeplearning4j_tpu.cli`` entry point."""
+
+from deeplearning4j_tpu.cli.driver import main
+
+raise SystemExit(main())

@@ -1,0 +1,246 @@
+"""CLI driver: ``dl4j-tpu {train,test,predict}``.
+
+Reference parity (deeplearning4j-cli, SURVEY.md §2.8 + §5.6 plane 4):
+- ``train``  — build a net from a conf JSON (the model-config-is-the-
+  wire-format property, §5.6) or a properties file, fit it on the input
+  source, save a model zip (util/model_serializer single-zip format).
+- ``test``   — load a model zip, evaluate on the input, print
+  Evaluation.stats() (reference subcommands/Test.java).
+- ``predict``— load a model zip, write argmax class predictions (or raw
+  probabilities with --raw) as CSV (reference subcommands/Predict.java).
+
+Input sources (reference FileScheme → RecordReader resolution):
+- ``mnist`` / ``mnist-test`` / ``iris``  — built-in datasets
+- ``path.csv``  — numeric CSV, last column = integer class label
+- ``path.npz``  — numpy archive with ``features`` [+ ``labels``] arrays
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# input resolution (the FileScheme / RecordReader role)
+# ---------------------------------------------------------------------------
+
+def load_csv(path: str, num_classes: Optional[int] = None,
+             label_column: int = -1) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Numeric CSV → (features, one-hot labels). ``label_column=None``
+    (via --no-labels) means feature-only input for predict."""
+    data = np.loadtxt(path, delimiter=",", dtype=np.float64, ndmin=2)
+    if label_column is None:
+        return data.astype(np.float32), None
+    labels_raw = data[:, label_column].astype(int)
+    feats = np.delete(data, label_column % data.shape[1], axis=1)
+    n_cls = num_classes or int(labels_raw.max()) + 1
+    labels = np.eye(n_cls, dtype=np.float32)[labels_raw]
+    return feats.astype(np.float32), labels
+
+
+def resolve_input(uri: str, num_classes: Optional[int] = None,
+                  with_labels: bool = True,
+                  num_examples: Optional[int] = None):
+    """URI/path → (features, labels|None)."""
+    if uri == "iris":
+        from deeplearning4j_tpu.datasets.iris import iris_dataset
+
+        ds = iris_dataset()
+        return np.asarray(ds.features), np.asarray(ds.labels)
+    if uri in ("mnist", "mnist-test"):
+        from deeplearning4j_tpu.datasets.mnist import mnist_dataset
+
+        ds = mnist_dataset(train=(uri == "mnist"),
+                           num_examples=num_examples)
+        return np.asarray(ds.features), np.asarray(ds.labels)
+    if not os.path.exists(uri):
+        raise FileNotFoundError(f"input not found: {uri}")
+    if uri.endswith(".npz"):
+        arc = np.load(uri)
+        feats = arc["features"].astype(np.float32)
+        labels = arc["labels"].astype(np.float32) if (
+            with_labels and "labels" in arc) else None
+        return feats, labels
+    return load_csv(uri, num_classes,
+                    label_column=-1 if with_labels else None)
+
+
+# ---------------------------------------------------------------------------
+# conf resolution (JSON conf or java-style properties file)
+# ---------------------------------------------------------------------------
+
+def _conf_from_properties(path: str):
+    """Minimal properties-file network spec (reference Train.java builds a
+    conf from a properties file): keys ``layers`` (comma sizes, e.g.
+    784,500,10), ``activation``, ``learning_rate``, ``updater``, ``seed``,
+    ``iterations``, ``loss``."""
+    props = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            key, _, value = line.partition("=")
+            props[key.strip()] = value.strip()
+
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    sizes = [int(s) for s in props["layers"].split(",")]
+    if len(sizes) < 2:
+        raise ValueError("properties 'layers' needs >=2 comma-separated sizes")
+    builder = (NeuralNetConfiguration.Builder()
+               .seed(int(props.get("seed", 12345)))
+               .iterations(int(props.get("iterations", 1)))
+               .learning_rate(float(props.get("learning_rate", 0.1)))
+               .updater(Updater[props.get("updater", "SGD").upper()])
+               .list())
+    act = props.get("activation", "relu")
+    loss = LossFunction[props.get("loss", "MCXENT").upper()]
+    for i in range(len(sizes) - 2):
+        builder.layer(i, L.DenseLayer(n_in=sizes[i], n_out=sizes[i + 1],
+                                      activation=act))
+    builder.layer(len(sizes) - 2,
+                  L.OutputLayer(n_in=sizes[-2], n_out=sizes[-1],
+                                activation="softmax", loss_function=loss))
+    return builder.build()
+
+
+def resolve_conf(path: str):
+    from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+
+    if path.endswith((".properties", ".props")):
+        return _conf_from_properties(path)
+    with open(path) as f:
+        return MultiLayerConfiguration.from_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+
+def _cmd_train(args) -> int:
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+    from deeplearning4j_tpu.util.model_serializer import write_model
+
+    conf = resolve_conf(args.conf)
+    net = MultiLayerNetwork(conf).init()
+    if args.verbose:
+        net.set_listeners(ScoreIterationListener(10))
+    feats, labels = resolve_input(args.input, num_classes=args.num_classes,
+                                  num_examples=args.num_examples)
+    if labels is None:
+        raise ValueError("training input must include labels")
+    batch = args.batch_size or len(feats)
+    sets = [DataSet(feats[i:i + batch], labels[i:i + batch])
+            for i in range(0, len(feats), batch)]
+    for _ in range(args.epochs):
+        net.fit(ListDataSetIterator(sets))
+    write_model(net, args.output)
+    score = net.score(DataSet(feats[:batch], labels[:batch]))
+    print(f"saved model to {args.output} (final score {score:.6f})")
+    return 0
+
+
+def _cmd_test(args) -> int:
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.util.model_serializer import restore_model
+
+    net = restore_model(args.model)
+    feats, labels = resolve_input(args.input, num_classes=args.num_classes,
+                                  num_examples=args.num_examples)
+    if labels is None:
+        raise ValueError("test input must include labels")
+    ev = Evaluation()
+    out = np.asarray(net.output(feats))
+    ev.eval(labels, out)
+    print(ev.stats())
+    return 0
+
+
+def _cmd_predict(args) -> int:
+    from deeplearning4j_tpu.util.model_serializer import restore_model
+
+    net = restore_model(args.model)
+    feats, _ = resolve_input(args.input, with_labels=args.has_labels,
+                             num_examples=args.num_examples)
+    out = np.asarray(net.output(feats))
+    if args.raw:
+        rows = out
+        fmt = "%.8f"
+    else:
+        rows = net.predict(feats).reshape(-1, 1)
+        fmt = "%d"
+    if args.output == "-":
+        np.savetxt(sys.stdout, rows, fmt=fmt, delimiter=",")
+    else:
+        np.savetxt(args.output, rows, fmt=fmt, delimiter=",")
+        print(f"wrote {rows.shape[0]} predictions to {args.output}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dl4j-tpu",
+        description="Train, test, and predict with deeplearning4j_tpu "
+                    "models (reference: dl4j CLI train/test/predict).")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp, model_in: bool):
+        sp.add_argument("--input", required=True,
+                        help="data source: mnist | mnist-test | iris | "
+                             "path.csv | path.npz")
+        sp.add_argument("--num-classes", type=int, default=None)
+        sp.add_argument("--num-examples", type=int, default=None,
+                        help="cap examples loaded from built-in datasets")
+        if model_in:
+            sp.add_argument("--model", required=True,
+                            help="model zip from train")
+
+    t = sub.add_parser("train", help="fit a network and save a model zip")
+    common(t, model_in=False)
+    t.add_argument("--conf", required=True,
+                   help="MultiLayerConfiguration JSON or .properties file")
+    t.add_argument("--output", required=True, help="model zip path")
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--batch-size", type=int, default=None)
+    t.add_argument("--verbose", action="store_true")
+    t.set_defaults(fn=_cmd_train)
+
+    e = sub.add_parser("test", help="evaluate a saved model")
+    common(e, model_in=True)
+    e.set_defaults(fn=_cmd_test)
+
+    r = sub.add_parser("predict", help="write predictions for an input")
+    common(r, model_in=True)
+    r.add_argument("--output", default="-",
+                   help="CSV path or '-' for stdout")
+    r.add_argument("--raw", action="store_true",
+                   help="write class probabilities instead of argmax")
+    r.add_argument("--has-labels", action="store_true",
+                   help="input CSV has a trailing label column to strip")
+    r.set_defaults(fn=_cmd_predict)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

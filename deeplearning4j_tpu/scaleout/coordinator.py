@@ -9,16 +9,26 @@ all three roles; the *data plane* (gradients/params) never touches it —
 that is XLA collectives over ICI/DCN (parallel/).
 
 Endpoints (JSON bodies):
-  POST /register    {worker_id}            → {ok}
-  POST /heartbeat   {worker_id}            → {ok}
-  GET  /members                            → {workers: {id: age_s}}
-  POST /config      {key, value}           → {ok}       (conf registry)
-  GET  /config?key=…                       → {value}
-  POST /job         {work}                 → {job_id}
-  POST /job/request {worker_id}            → {job_id, work} | {}
-  POST /job/done    {job_id}               → {ok}
-  POST /barrier     {name, n, worker_id}   → {released} (blocking poll)
-  POST /finish / GET /done                 → run-done flag
+  POST /register     {worker_id}            → {ok}
+  POST /heartbeat    {worker_id}            → {ok}
+  POST /worker/evict {worker_id}            → {requeued}
+  GET  /members                             → {workers: {id: age_s}}
+  POST /config       {key, value}           → {ok}      (conf registry)
+  GET  /config?key=…                        → {value}
+  POST /job          {work}                 → {job_id}
+  POST /job/request  {worker_id}            → {job_id, work} | {}
+  POST /job/done     {job_id}               → {ok}
+  GET  /pending                             → {pending}
+  POST /best         {score, model_b64}     → {kept}    (atomic min)
+  GET  /best                                → {score, model_b64}
+  POST /barrier      {name, n, worker_id[, gen]} → {gen, released}
+  POST /finish / GET /done                  → run-done flag
+
+Barriers are generation-scoped SERVER-side: the first poll enrolls the
+worker in the name's current generation and returns it; the worker polls
+with that generation until the release watermark passes it. A rebooted
+worker therefore enrolls in the CURRENT generation instead of matching a
+stale one, and memory stays bounded (one member set per name).
 
 Used by the elastic trainer for failure detection: a gang member that
 misses ``eviction_timeout`` of heartbeats marks the gang degraded, which
@@ -34,10 +44,19 @@ import threading
 import time
 import urllib.request
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.scaleout.api import Job, StateTracker
+from deeplearning4j_tpu.util.httpjson import HttpService, JsonHandler
+
+
+class _Barrier:
+    __slots__ = ("gen", "members", "released_gen")
+
+    def __init__(self) -> None:
+        self.gen = 0
+        self.members: set = set()
+        self.released_gen = -1
 
 
 class _State:
@@ -49,144 +68,127 @@ class _State:
         self.in_flight: Dict[int, Dict[str, Any]] = {}
         self.next_job_id = 0
         self.done = False
-        self.barriers: Dict[str, set] = {}
+        self.barriers: Dict[str, _Barrier] = {}
         self.best_score: Optional[float] = None
         self.best_model_b64: Optional[str] = None
 
+    def evict(self, worker_id: str) -> int:
+        """Remove a worker and requeue its in-flight jobs; returns the
+        requeue count (reference MasterActor.java:117-133,:141-171)."""
+        with self.lock:
+            self.workers.pop(worker_id, None)
+            requeued = 0
+            for job in list(self.in_flight.values()):
+                if job.get("worker_id") == worker_id:
+                    del self.in_flight[job["job_id"]]
+                    job.pop("worker_id", None)
+                    self.queue.insert(0, job)
+                    requeued += 1
+            return requeued
 
-class _Handler(BaseHTTPRequestHandler):
+
+class _Handler(JsonHandler):
     state: _State  # set by server factory
 
-    def log_message(self, fmt: str, *args: Any) -> None:  # silence
-        pass
-
-    def _reply(self, obj: Dict[str, Any], code: int = 200) -> None:
-        body = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _body(self) -> Dict[str, Any]:
-        n = int(self.headers.get("Content-Length", 0))
-        if n == 0:
-            return {}
-        return json.loads(self.rfile.read(n))
-
+    # Handlers compute (payload, code) under the lock, reply outside it.
     def do_GET(self) -> None:
         st = self.state
         parsed = urllib.parse.urlparse(self.path)
         with st.lock:
-            if parsed.path == "/members":
-                now = time.monotonic()
-                self._reply({"workers": {w: now - t
-                                         for w, t in st.workers.items()}})
-            elif parsed.path == "/config":
-                key = urllib.parse.parse_qs(parsed.query).get("key", [""])[0]
-                self._reply({"value": st.config.get(key)})
-            elif parsed.path == "/done":
-                self._reply({"done": st.done})
-            elif parsed.path == "/pending":
-                self._reply({"pending": len(st.queue) + len(st.in_flight)})
-            elif parsed.path == "/best":
-                self._reply({"score": st.best_score,
-                             "model_b64": st.best_model_b64})
-            else:
-                self._reply({"error": "not found"}, 404)
+            out = self._get_locked(st, parsed)
+        self.send_json(*out)
+
+    def _get_locked(self, st: _State, parsed) -> Tuple[Dict[str, Any], int]:
+        if parsed.path == "/members":
+            now = time.monotonic()
+            return {"workers": {w: now - t
+                                for w, t in st.workers.items()}}, 200
+        if parsed.path == "/config":
+            key = urllib.parse.parse_qs(parsed.query).get("key", [""])[0]
+            return {"value": st.config.get(key)}, 200
+        if parsed.path == "/done":
+            return {"done": st.done}, 200
+        if parsed.path == "/pending":
+            return {"pending": len(st.queue) + len(st.in_flight)}, 200
+        if parsed.path == "/best":
+            return {"score": st.best_score,
+                    "model_b64": st.best_model_b64}, 200
+        return {"error": "not found"}, 404
 
     def do_POST(self) -> None:
         st = self.state
-        body = self._body()
+        body = self.read_json()
         with st.lock:
-            if self.path == "/register":
-                st.workers[body["worker_id"]] = time.monotonic()
-                self._reply({"ok": True})
-            elif self.path == "/heartbeat":
-                st.workers[body["worker_id"]] = time.monotonic()
-                self._reply({"ok": True})
-            elif self.path == "/config":
-                st.config[body["key"]] = body["value"]
-                self._reply({"ok": True})
-            elif self.path == "/job":
-                jid = st.next_job_id
-                st.next_job_id += 1
-                st.queue.append({"job_id": jid, "work": body["work"]})
-                self._reply({"job_id": jid})
-            elif self.path == "/job/request":
-                if not st.queue:
-                    self._reply({})
-                else:
-                    job = st.queue.pop(0)
-                    job["worker_id"] = body.get("worker_id")
-                    st.in_flight[job["job_id"]] = job
-                    self._reply(job)
-            elif self.path == "/job/done":
-                st.in_flight.pop(body["job_id"], None)
-                self._reply({"ok": True})
-            elif self.path == "/barrier":
-                name, n = body["name"], int(body["n"])
-                members = st.barriers.setdefault(name, set())
-                members.add(body["worker_id"])
-                self._reply({"released": len(members) >= n})
-            elif self.path == "/best":
-                # atomic keep-the-minimum (reference StateTracker best-model)
-                score = float(body["score"])
-                if st.best_score is None or score < st.best_score:
-                    st.best_score = score
-                    st.best_model_b64 = body.get("model_b64")
-                    self._reply({"kept": True})
-                else:
-                    self._reply({"kept": False})
-            elif self.path == "/finish":
-                st.done = True
-                self._reply({"ok": True})
-            else:
-                self._reply({"error": "not found"}, 404)
+            out = self._post_locked(st, body)
+        self.send_json(*out)
+
+    def _post_locked(self, st: _State,
+                     body: Dict[str, Any]) -> Tuple[Dict[str, Any], int]:
+        if self.path in ("/register", "/heartbeat"):
+            st.workers[body["worker_id"]] = time.monotonic()
+            return {"ok": True}, 200
+        if self.path == "/worker/evict":
+            return {"requeued": st.evict(body["worker_id"])}, 200
+        if self.path == "/config":
+            st.config[body["key"]] = body["value"]
+            return {"ok": True}, 200
+        if self.path == "/job":
+            jid = st.next_job_id
+            st.next_job_id += 1
+            st.queue.append({"job_id": jid, "work": body["work"]})
+            return {"job_id": jid}, 200
+        if self.path == "/job/request":
+            if not st.queue:
+                return {}, 200
+            job = st.queue.pop(0)
+            job["worker_id"] = body.get("worker_id")
+            st.in_flight[job["job_id"]] = job
+            return job, 200
+        if self.path == "/job/done":
+            st.in_flight.pop(body["job_id"], None)
+            return {"ok": True}, 200
+        if self.path == "/barrier":
+            bar = st.barriers.setdefault(body["name"], _Barrier())
+            gen = body.get("gen")
+            if gen is None:  # enrollment
+                gen = bar.gen
+                bar.members.add(body["worker_id"])
+                if len(bar.members) >= int(body["n"]):
+                    bar.released_gen = bar.gen
+                    bar.gen += 1
+                    bar.members = set()
+            return {"gen": gen, "released": bar.released_gen >= gen}, 200
+        if self.path == "/best":
+            score = float(body["score"])
+            if st.best_score is None or score < st.best_score:
+                st.best_score = score
+                st.best_model_b64 = body.get("model_b64")
+                return {"kept": True}, 200
+            return {"kept": False}, 200
+        if self.path == "/finish":
+            st.done = True
+            return {"ok": True}, 200
+        return {"error": "not found"}, 404
 
 
-class CoordinatorServer:
+class CoordinatorServer(HttpService):
     """Threaded control-plane server; bind to 127.0.0.1 for tests, an
     internal VIP in deployment."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         state = _State()
-        handler = type("Handler", (_Handler,), {"state": state})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        super().__init__(_Handler, host, port, state=state)
         self.state = state
-        self.host, self.port = self._httpd.server_address[:2]
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def address(self) -> str:
-        return f"http://{self.host}:{self.port}"
-
-    def start(self) -> "CoordinatorServer":
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5.0)
 
     def evict_stale(self, timeout: float) -> List[str]:
-        """Drop workers silent ≥ timeout, return their ids (the reference
-        master sweep, MasterActor.java:141-171)."""
+        """Drop workers silent ≥ timeout, requeueing their jobs; returns
+        their ids (the reference master sweep, MasterActor.java:141-171)."""
         now = time.monotonic()
         with self.state.lock:
             stale = [w for w, t in self.state.workers.items()
                      if now - t >= timeout]
             for w in stale:
-                del self.state.workers[w]
-                for job in list(self.state.in_flight.values()):
-                    if job.get("worker_id") == w:
-                        del self.state.in_flight[job["job_id"]]
-                        job.pop("worker_id", None)
-                        self.state.queue.insert(0, job)
+                self.state.evict(w)
         return stale
 
 
@@ -197,7 +199,6 @@ class CoordinatorClient(StateTracker):
     def __init__(self, address: str, timeout: float = 10.0):
         self.address = address.rstrip("/")
         self.timeout = timeout
-        self._barrier_gens: Dict[str, int] = {}
 
     def _call(self, path: str, payload: Optional[Dict[str, Any]] = None,
               query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
@@ -216,7 +217,7 @@ class CoordinatorClient(StateTracker):
         self._call("/register", {"worker_id": worker_id})
 
     def remove_worker(self, worker_id: str) -> None:
-        pass  # eviction is server-side (evict_stale)
+        self._call("/worker/evict", {"worker_id": worker_id})
 
     def workers(self) -> List[str]:
         return list(self._call("/members")["workers"])
@@ -244,7 +245,8 @@ class CoordinatorClient(StateTracker):
         self._call("/job/done", {"job_id": job_id})
 
     def requeue_jobs_of(self, worker_id: str) -> int:
-        return 0  # handled server-side by evict_stale
+        return int(self._call("/worker/evict",
+                              {"worker_id": worker_id})["requeued"])
 
     def current_jobs(self) -> List[Job]:
         return []
@@ -284,19 +286,19 @@ class CoordinatorClient(StateTracker):
     # -- barrier --------------------------------------------------------
     def barrier(self, name: str, n: int, worker_id: str,
                 timeout: float = 30.0, poll: float = 0.01) -> bool:
-        """Block until n distinct workers reach the barrier.
-
-        Each successful release advances this client's generation counter
-        for ``name``, so reusing one name per BSP round synchronizes every
-        round (server membership sets are generation-scoped)."""
-        gen = self._barrier_gens.get(name, 0)
-        scoped = f"{name}#{gen}"
+        """Block until n distinct workers reach the barrier. Generations
+        live server-side: the first poll enrolls and returns the current
+        generation, so a restarted worker joins the live round instead of
+        matching a stale one."""
         deadline = time.monotonic() + timeout
+        payload = {"name": name, "n": n, "worker_id": worker_id}
+        gen: Optional[int] = None
         while time.monotonic() < deadline:
-            out = self._call("/barrier",
-                             {"name": scoped, "n": n, "worker_id": worker_id})
+            if gen is not None:
+                payload["gen"] = gen
+            out = self._call("/barrier", payload)
+            gen = out["gen"]
             if out["released"]:
-                self._barrier_gens[name] = gen + 1
                 return True
             time.sleep(poll)
         return False

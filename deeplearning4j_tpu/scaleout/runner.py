@@ -57,17 +57,24 @@ class _Worker(threading.Thread):
         # (unlike a clean stop) leaves its in-flight job unfinished.
         self.simulate_death = threading.Event()
 
+    def _heartbeat_loop(self) -> None:
+        # Independent schedule, like the reference's 1 s WorkerActor timer
+        # (WorkerActor.java:168) — a long-running perform() must NOT look
+        # like a dead worker, or its job gets requeued and double-counted.
+        while not self.stop_flag.is_set():
+            if self.simulate_death.is_set():
+                return
+            self.tracker.heartbeat(self.worker_id)
+            time.sleep(self.runner.heartbeat_interval)
+
     def run(self) -> None:
         tracker = self.tracker
         tracker.add_worker(self.worker_id)
-        last_beat = 0.0
+        beat = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        beat.start()
         while not self.stop_flag.is_set() and not tracker.is_done():
             if self.simulate_death.is_set():
                 return  # vanish without deregistering — reaper must catch it
-            now = time.monotonic()
-            if now - last_beat >= self.runner.heartbeat_interval:
-                tracker.heartbeat(self.worker_id)
-                last_beat = now
             job = tracker.request_job(self.worker_id)
             if job is None:
                 time.sleep(self.runner.idle_sleep)
@@ -107,11 +114,18 @@ class DistributedRunner:
         self._workers: List[_Worker] = []
         self._result_lock = threading.Lock()
         self._results: List[Any] = []
+        self._done_job_ids: set = set()
         self.evicted: List[str] = []
 
     # -- result sink ----------------------------------------------------
     def _on_result(self, worker_id: str, job: Job, result: Any) -> None:
         with self._result_lock:
+            # A job can be executed twice if its worker was (wrongly or
+            # rightly) evicted mid-run and the job requeued; first result
+            # wins so aggregates count each job exactly once.
+            if job.job_id in self._done_job_ids:
+                return
+            self._done_job_ids.add(job.job_id)
             self._results.append(result)
         if self.routing is WorkRouting.HOGWILD and self.aggregator:
             self.aggregator.accumulate(result)
@@ -185,7 +199,9 @@ class DistributedRunner:
 
     def _wait_drained(self, max_wait: float, last_reap: float) -> float:
         """Block until the tracker's queue + in-flight set is empty,
-        reaping stale workers along the way; returns last reap time."""
+        reaping stale workers along the way; returns last reap time.
+        Raises TimeoutError if jobs remain — a partial aggregate must
+        never be returned as if it covered every job."""
         deadline = time.monotonic() + max_wait
         while (self.tracker.pending_count() > 0
                and time.monotonic() < deadline):
@@ -193,6 +209,11 @@ class DistributedRunner:
                 self._reap()
                 last_reap = time.monotonic()
             time.sleep(self.idle_sleep)
+        remaining = self.tracker.pending_count()
+        if remaining > 0:
+            raise TimeoutError(
+                f"{remaining} job(s) still pending after {max_wait}s; "
+                "aggregate would be incomplete")
         return last_reap
 
     def drain_results(self) -> List[Any]:

@@ -1,0 +1,28 @@
+"""Training observability UI: history storage, HTTP server, listeners.
+
+Mirror of the reference deeplearning4j-ui module (SURVEY.md §2.8, §5.5):
+Dropwizard REST resources + views become a stdlib HTTP/JSON server with a
+minimal HTML dashboard; the listeners that POST model snapshots into it
+(HistogramIterationListener, FlowIterationListener,
+UpdateActivationIterationListener) become IterationListeners that write to
+a HistoryStorage either directly (in-process) or over HTTP (remote server),
+and the Word2Vec nearest-neighbors view (VPTree-backed) is the /nearest
+endpoint.
+"""
+
+from deeplearning4j_tpu.ui.storage import HistoryStorage
+from deeplearning4j_tpu.ui.server import UiServer, UiClient
+from deeplearning4j_tpu.ui.listeners import (
+    HistogramIterationListener,
+    FlowIterationListener,
+    ActivationIterationListener,
+)
+
+__all__ = [
+    "HistoryStorage",
+    "UiServer",
+    "UiClient",
+    "HistogramIterationListener",
+    "FlowIterationListener",
+    "ActivationIterationListener",
+]

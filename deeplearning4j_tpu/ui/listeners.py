@@ -1,0 +1,74 @@
+"""IterationListeners that feed the observability UI.
+
+Mirror of reference deeplearning4j-ui listeners (SURVEY.md §5.5):
+``HistogramIterationListener`` (weights/HistogramIterationListener.java —
+score + per-param/per-gradient histograms), ``FlowIterationListener``
+(flow/FlowIterationListener.java — model structure snapshot), and
+``ActivationIterationListener`` (activation render feed). Each writes to a
+``sink``: a HistoryStorage (in-process) or a UiClient (HTTP POST to a
+UiServer in another process) — both expose ``put(key, iteration, payload)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.ui.storage import histogram
+
+
+class HistogramIterationListener(IterationListener):
+    """Score series + parameter histograms every N iterations."""
+
+    def __init__(self, sink: Any, frequency: int = 1, bins: int = 20):
+        self.sink = sink
+        self.invoked_every = frequency
+        self.bins = bins
+
+    def iteration_done(self, model, iteration: int) -> None:
+        self.sink.put("score", iteration, float(model.score_value))
+        for key, p in model.param_table().items():
+            self.sink.put(f"histogram/{key}", iteration,
+                          histogram(np.asarray(p), bins=self.bins))
+
+
+class FlowIterationListener(IterationListener):
+    """Model-structure snapshot: layer names, shapes, param counts."""
+
+    def __init__(self, sink: Any, frequency: int = 1):
+        self.sink = sink
+        self.invoked_every = frequency
+
+    def iteration_done(self, model, iteration: int) -> None:
+        layers = []
+        for i, conf in enumerate(model.conf.confs):
+            bean = conf.layer
+            layers.append({
+                "index": i,
+                "type": type(bean).__name__,
+                "n_in": getattr(bean, "n_in", None),
+                "n_out": getattr(bean, "n_out", None),
+                "activation": getattr(bean, "activation", None),
+            })
+        n_params = int(sum(np.asarray(p).size
+                           for p in model.param_table().values()))
+        self.sink.put("flow", iteration,
+                      {"layers": layers, "num_params": n_params})
+
+
+class ActivationIterationListener(IterationListener):
+    """Mean |activation| per layer on a probe batch — the activations
+    render feed (reference UpdateActivationIterationListener)."""
+
+    def __init__(self, sink: Any, probe_features, frequency: int = 1):
+        self.sink = sink
+        self.probe = np.asarray(probe_features)
+        self.invoked_every = frequency
+
+    def iteration_done(self, model, iteration: int) -> None:
+        acts = model.feed_forward(self.probe, train=False)
+        self.sink.put(
+            "activations", iteration,
+            [float(np.mean(np.abs(np.asarray(a)))) for a in acts])

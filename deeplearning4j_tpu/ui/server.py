@@ -1,0 +1,160 @@
+"""HTTP observability server + client.
+
+Mirror of reference deeplearning4j-ui UiServer.java:63 (Dropwizard app,
+run :83) on the shared JSON-HTTP scaffolding (util/httpjson.py — also
+used by the scaleout coordinator). Listeners POST JSON records; browsers
+(or tests) GET them back; ``/`` serves a small self-contained HTML
+dashboard polling the JSON endpoints — replacing the reference's
+Dropwizard views + JS assets.
+
+Endpoints:
+  POST /update             {key, iteration, payload}     → {ok}
+  GET  /series?key=…&since=…                             → {points}
+  GET  /keys                                             → {keys}
+  POST /vectors            {labels, vectors}             → {ok}
+      (the Word2Vec nearest-neighbors upload; VPTree-indexed)
+  GET  /nearest?word=…&k=…                               → {neighbors}
+  GET  /                                                 → HTML dashboard
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.ui.storage import HistoryStorage
+from deeplearning4j_tpu.util.httpjson import HttpService, JsonHandler
+
+_DASHBOARD = """<!doctype html>
+<html><head><title>deeplearning4j_tpu</title>
+<style>body{font-family:monospace;margin:2em}pre{background:#f4f4f4;
+padding:1em}</style></head>
+<body><h1>deeplearning4j_tpu training dashboard</h1>
+<div id="keys"></div><pre id="latest"></pre>
+<script>
+async function tick(){
+  const ks = await (await fetch('/keys')).json();
+  document.getElementById('keys').textContent =
+      'series: ' + ks.keys.join(', ');
+  let out = '';
+  for (const k of ks.keys){
+    const s = await (await fetch('/series?key='+encodeURIComponent(k))).json();
+    const last = s.points[s.points.length-1];
+    if (last) out += k + ' @' + last[0] + ': ' +
+        JSON.stringify(last[1]).slice(0,200) + '\\n';
+  }
+  document.getElementById('latest').textContent = out;
+}
+setInterval(tick, 2000); tick();
+</script></body></html>"""
+
+
+class _Handler(JsonHandler):
+    storage: HistoryStorage
+    server_ref: "UiServer"
+
+    def do_GET(self) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        if parsed.path == "/":
+            self.send_bytes(_DASHBOARD.encode(), "text/html")
+        elif parsed.path == "/keys":
+            self.send_json({"keys": self.storage.keys()})
+        elif parsed.path == "/series":
+            key = qs.get("key", [""])[0]
+            since = int(qs.get("since", ["-1"])[0])
+            self.send_json({"points": self.storage.get(key, since)})
+        elif parsed.path == "/nearest":
+            word = qs.get("word", [""])[0]
+            k = int(qs.get("k", ["5"])[0])
+            try:
+                self.send_json(
+                    {"neighbors": self.server_ref.nearest(word, k)})
+            except KeyError:
+                self.send_json({"error": f"unknown word {word!r}"}, 404)
+        else:
+            self.send_json({"error": "not found"}, 404)
+
+    def do_POST(self) -> None:
+        body = self.read_json()
+        if self.path == "/update":
+            self.storage.put(body["key"], body["iteration"], body["payload"])
+            self.send_json({"ok": True})
+        elif self.path == "/vectors":
+            self.server_ref.set_vectors(body["labels"], body["vectors"])
+            self.send_json({"ok": True})
+        else:
+            self.send_json({"error": "not found"}, 404)
+
+
+class UiServer(HttpService):
+    """Threaded observability server over a HistoryStorage."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 storage: Optional[HistoryStorage] = None):
+        self.storage = storage or HistoryStorage()
+        super().__init__(_Handler, host, port,
+                         storage=self.storage, server_ref=self)
+        self._vec_lock = threading.Lock()
+        self._labels: List[str] = []
+        self._tree = None
+
+    # -- word2vec nearest neighbors (reference nearestneighbors/word2vec) --
+    def set_vectors(self, labels: List[str], vectors) -> None:
+        from deeplearning4j_tpu.clustering.vptree import VPTree
+
+        tree = VPTree(np.asarray(vectors, np.float64),
+                      labels=list(labels), similarity="cosine")
+        with self._vec_lock:
+            self._labels = list(labels)
+            self._tree = tree
+
+    def nearest(self, word: str, k: int = 5) -> List[str]:
+        with self._vec_lock:
+            if self._tree is None or word not in self._labels:
+                raise KeyError(word)
+            q = self._tree.items[self._labels.index(word)]
+            # k+1 then drop the word itself
+            out = [w for w in self._tree.words_nearest(q, k + 1)
+                   if w != word]
+            return out[:k]
+
+
+class UiClient:
+    """POSTs records to a remote UiServer — what a listener uses when the
+    server runs in another process (the reference's listener→REST path)."""
+
+    def __init__(self, address: str, timeout: float = 5.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, path: str, payload: Dict[str, Any]) -> None:
+        req = urllib.request.Request(
+            self.address + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=self.timeout).read()
+
+    def put(self, key: str, iteration: int, payload: Any) -> None:
+        self._post("/update", {"key": key, "iteration": iteration,
+                               "payload": payload})
+
+    def set_vectors(self, labels: List[str], vectors) -> None:
+        self._post("/vectors", {"labels": list(labels),
+                                "vectors": np.asarray(vectors).tolist()})
+
+    def get_series(self, key: str, since: int = -1) -> List[tuple]:
+        url = (f"{self.address}/series?"
+               + urllib.parse.urlencode({"key": key, "since": since}))
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            return [tuple(p) for p in json.loads(resp.read())["points"]]
+
+    def nearest(self, word: str, k: int = 5) -> List[str]:
+        url = (f"{self.address}/nearest?"
+               + urllib.parse.urlencode({"word": word, "k": k}))
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            return json.loads(resp.read())["neighbors"]

@@ -1,0 +1,57 @@
+"""In-memory time-series store for training observability.
+
+Mirror of reference ui/storage/HistoryStorage.java (SURVEY.md §5.5): keyed
+series of per-iteration records (scores, histograms, activations, t-SNE
+coordinates, model structure), thread-safe, with bounded retention so a
+long run cannot exhaust host memory (the reference keeps everything —
+bounding is an improvement, tunable via ``max_points``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def histogram(values, bins: int = 20) -> Dict[str, List[float]]:
+    """np.histogram → JSON-friendly {counts, edges}."""
+    arr = np.asarray(values).ravel()
+    counts, edges = np.histogram(arr, bins=bins)
+    return {"counts": counts.tolist(), "edges": edges.tolist()}
+
+
+class HistoryStorage:
+    """Keyed append-only series: key → [(iteration, payload), ...]."""
+
+    def __init__(self, max_points: int = 10_000):
+        self._lock = threading.RLock()
+        self._series: Dict[str, List[tuple]] = defaultdict(list)
+        self.max_points = max_points
+
+    def put(self, key: str, iteration: int, payload: Any) -> None:
+        with self._lock:
+            series = self._series[key]
+            series.append((int(iteration), payload))
+            if len(series) > self.max_points:
+                del series[: len(series) - self.max_points]
+
+    def get(self, key: str, since: int = -1) -> List[tuple]:
+        with self._lock:
+            return [(i, p) for i, p in self._series.get(key, [])
+                    if i > since]
+
+    def latest(self, key: str) -> Optional[tuple]:
+        with self._lock:
+            series = self._series.get(key)
+            return series[-1] if series else None
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()

@@ -1,0 +1,71 @@
+"""Shared JSON-over-HTTP scaffolding for control-plane services.
+
+One base for the coordinator (scaleout/coordinator.py) and the UI server
+(ui/server.py): a silenced BaseHTTPRequestHandler with JSON helpers and a
+threaded server lifecycle wrapper. Handlers must compute their response
+payload first (holding any state lock) and only then call ``send_json`` —
+never write the socket while holding a lock, or one slow-reading client
+stalls every other request (including heartbeats).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Request handler base: JSON body parsing + JSON/bytes replies."""
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # silence
+        pass
+
+    def read_json(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length", 0))
+        if n == 0:
+            return {}
+        return json.loads(self.rfile.read(n))
+
+    def send_json(self, obj: Dict[str, Any], code: int = 200) -> None:
+        self.send_bytes(json.dumps(obj).encode(), "application/json", code)
+
+    def send_bytes(self, body: bytes, content_type: str,
+                   code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class HttpService:
+    """Threaded HTTP server lifecycle: build, start, address, stop.
+
+    Subclasses (or callers) provide a concrete handler class; extra
+    attributes are attached to a per-instance handler subclass so one
+    process can run several services."""
+
+    def __init__(self, handler_cls, host: str = "127.0.0.1", port: int = 0,
+                 **handler_attrs: Any):
+        handler = type(handler_cls.__name__, (handler_cls,), handler_attrs)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5.0)

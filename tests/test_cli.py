@@ -1,0 +1,122 @@
+"""CLI train/test/predict tests (reference deeplearning4j-cli subcommands).
+
+Pattern: drive main() in-process on tiny CSV/properties fixtures, assert
+artifacts and output — the reference tests the CLI the same way
+(single-JVM, tiny inputs)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cli import main
+from deeplearning4j_tpu.cli.driver import load_csv, resolve_conf
+
+
+@pytest.fixture
+def toy_csv(tmp_path):
+    """Linearly separable 2-class problem, last column = label."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(120, 4)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    rows = np.column_stack([X, y])
+    path = tmp_path / "train.csv"
+    np.savetxt(path, rows, delimiter=",", fmt="%.6f")
+    return str(path)
+
+
+@pytest.fixture
+def conf_json(tmp_path):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (NeuralNetConfiguration.Builder().seed(9).learning_rate(0.2)
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(1, L.OutputLayer(n_in=16, n_out=2, activation="softmax",
+                                    loss_function=LossFunction.MCXENT))
+            .build())
+    path = tmp_path / "conf.json"
+    path.write_text(conf.to_json())
+    return str(path)
+
+
+class TestHelpers:
+    def test_load_csv_one_hot(self, toy_csv):
+        feats, labels = load_csv(toy_csv)
+        assert feats.shape == (120, 4)
+        assert labels.shape == (120, 2)
+        assert np.all(labels.sum(axis=1) == 1)
+
+    def test_load_csv_no_labels(self, toy_csv):
+        feats, labels = load_csv(toy_csv, label_column=None)
+        assert feats.shape == (120, 5)
+        assert labels is None
+
+    def test_resolve_conf_properties(self, tmp_path):
+        p = tmp_path / "net.properties"
+        p.write_text("# comment\nlayers=4,8,3\nactivation=tanh\n"
+                     "learning_rate=0.05\nupdater=adam\nseed=7\n")
+        conf = resolve_conf(str(p))
+        assert len(conf.confs) == 2
+        assert conf.confs[0].layer.n_in == 4
+        assert conf.confs[1].layer.n_out == 3
+
+
+class TestEndToEnd:
+    def test_train_test_predict_cycle(self, tmp_path, toy_csv, conf_json,
+                                      capsys):
+        model = str(tmp_path / "model.zip")
+        rc = main(["train", "--conf", conf_json, "--input", toy_csv,
+                   "--output", model, "--epochs", "30",
+                   "--batch-size", "40"])
+        assert rc == 0 and os.path.exists(model)
+
+        rc = main(["test", "--model", model, "--input", toy_csv])
+        assert rc == 0
+        stats = capsys.readouterr().out
+        assert "Accuracy" in stats
+        # the problem is separable: accuracy should be well above chance
+        acc = float([ln for ln in stats.splitlines()
+                     if "Accuracy" in ln][0].split()[-1])
+        assert acc > 0.8
+
+        preds_path = str(tmp_path / "preds.csv")
+        rc = main(["predict", "--model", model, "--input", toy_csv,
+                   "--has-labels", "--output", preds_path])
+        assert rc == 0
+        preds = np.loadtxt(preds_path, dtype=int, ndmin=1)
+        assert preds.shape == (120,)
+        assert set(np.unique(preds)) <= {0, 1}
+
+    def test_predict_raw_probabilities_to_stdout(self, tmp_path, toy_csv,
+                                                 conf_json, capsys):
+        model = str(tmp_path / "model.zip")
+        main(["train", "--conf", conf_json, "--input", toy_csv,
+              "--output", model, "--epochs", "2"])
+        capsys.readouterr()
+        rc = main(["predict", "--model", model, "--input", toy_csv,
+                   "--has-labels", "--raw"])
+        assert rc == 0
+        lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+        probs = np.array([[float(v) for v in ln.split(",")]
+                          for ln in lines])
+        assert probs.shape == (120, 2)
+        assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+    def test_train_on_properties_and_iris(self, tmp_path, capsys):
+        props = tmp_path / "net.properties"
+        props.write_text("layers=4,16,3\nactivation=tanh\n"
+                         "learning_rate=0.1\nupdater=nesterovs\n")
+        model = str(tmp_path / "iris.zip")
+        rc = main(["train", "--conf", str(props), "--input", "iris",
+                   "--output", model, "--epochs", "60"])
+        assert rc == 0
+        rc = main(["test", "--model", model, "--input", "iris"])
+        assert rc == 0
+        stats = capsys.readouterr().out
+        acc = float([ln for ln in stats.splitlines()
+                     if "Accuracy" in ln][0].split()[-1])
+        assert acc > 0.85

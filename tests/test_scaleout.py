@@ -187,6 +187,29 @@ class TestCoordinator:
         # a single re-arrival must NOT release instantly
         assert not self.client.barrier("round", 2, "a", timeout=0.3)
 
+    def test_restarted_client_joins_live_generation(self):
+        # Regression: generations are server-side, so a worker that
+        # reboots (fresh client object) enrolls in the CURRENT round
+        # instead of instantly releasing against a stale member set.
+        c2 = CoordinatorClient(self.server.address)
+        for _ in range(2):  # two completed rounds
+            t = threading.Thread(
+                target=lambda: c2.barrier("sync", 2, "b", timeout=10.0))
+            t.start()
+            assert self.client.barrier("sync", 2, "a", timeout=10.0)
+            t.join()
+        fresh = CoordinatorClient(self.server.address)  # rebooted worker
+        assert not fresh.barrier("sync", 2, "a-reborn", timeout=0.3)
+
+    def test_remove_worker_requeues_jobs(self):
+        self.client.add_worker("host-0")
+        self.client.add_job(Job(work=7))
+        assert self.client.request_job("host-0") is not None
+        assert self.client.requeue_jobs_of("host-0") == 1
+        assert "host-0" not in self.client.workers()
+        job = self.client.request_job("host-1")
+        assert job is not None and job.work == 7
+
     def test_best_model_roundtrip_keeps_minimum(self):
         self.client.set_best_model({"w": [1.0]}, 2.0)
         self.client.set_best_model({"w": [9.0]}, 5.0)  # worse, ignored

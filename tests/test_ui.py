@@ -1,0 +1,125 @@
+"""UI observability tests: storage, server endpoints, listeners.
+
+Reference pattern: deeplearning4j-ui is exercised via listener POSTs into
+the REST resources; here a live localhost server + in-process storage."""
+
+import numpy as np
+
+from deeplearning4j_tpu.ui import (
+    ActivationIterationListener,
+    FlowIterationListener,
+    HistogramIterationListener,
+    HistoryStorage,
+    UiClient,
+    UiServer,
+)
+from deeplearning4j_tpu.ui.storage import histogram
+
+
+def _tiny_net():
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+            .list()
+            .layer(0, L.DenseLayer(n_in=5, n_out=8, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                    loss_function=LossFunction.MCXENT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestHistoryStorage:
+    def test_put_get_since(self):
+        st = HistoryStorage()
+        for i in range(5):
+            st.put("score", i, float(i))
+        assert st.get("score") == [(i, float(i)) for i in range(5)]
+        assert st.get("score", since=2) == [(3, 3.0), (4, 4.0)]
+        assert st.latest("score") == (4, 4.0)
+        assert st.keys() == ["score"]
+
+    def test_retention_bound(self):
+        st = HistoryStorage(max_points=3)
+        for i in range(10):
+            st.put("k", i, i)
+        assert [i for i, _ in st.get("k")] == [7, 8, 9]
+
+    def test_histogram_shape(self):
+        h = histogram(np.random.default_rng(0).normal(size=100), bins=10)
+        assert len(h["counts"]) == 10
+        assert len(h["edges"]) == 11
+        assert sum(h["counts"]) == 100
+
+
+class TestListeners:
+    def test_histogram_listener_records_score_and_params(self):
+        st = HistoryStorage()
+        net = _tiny_net()
+        net.set_listeners(HistogramIterationListener(st))
+        X = np.random.default_rng(1).normal(size=(16, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.arange(16) % 2]
+        net.fit(X, y)
+        assert len(st.get("score")) >= 1
+        hist_keys = [k for k in st.keys() if k.startswith("histogram/")]
+        assert hist_keys  # one per param tensor
+        _, h = st.latest(hist_keys[0])
+        assert sum(h["counts"]) > 0
+
+    def test_flow_and_activation_listeners(self):
+        st = HistoryStorage()
+        net = _tiny_net()
+        X = np.random.default_rng(2).normal(size=(8, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+        net.set_listeners(FlowIterationListener(st),
+                          ActivationIterationListener(st, X))
+        net.fit(X, y)
+        _, flow = st.latest("flow")
+        assert [l["type"] for l in flow["layers"]] == [
+            "DenseLayer", "OutputLayer"]
+        assert flow["num_params"] == 5 * 8 + 8 + 8 * 2 + 2
+        _, acts = st.latest("activations")
+        assert len(acts) >= 2 and all(a >= 0 for a in acts)
+
+
+class TestUiServer:
+    def setup_method(self):
+        self.server = UiServer().start()
+        self.client = UiClient(self.server.address)
+
+    def teardown_method(self):
+        self.server.stop()
+
+    def test_update_and_series_roundtrip(self):
+        self.client.put("score", 1, 0.5)
+        self.client.put("score", 2, 0.25)
+        assert self.client.get_series("score") == [(1, 0.5), (2, 0.25)]
+        assert self.client.get_series("score", since=1) == [(2, 0.25)]
+
+    def test_remote_listener_feeds_server(self):
+        net = _tiny_net()
+        net.set_listeners(HistogramIterationListener(self.client))
+        X = np.random.default_rng(4).normal(size=(8, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+        net.fit(X, y)
+        assert len(self.client.get_series("score")) >= 1
+
+    def test_nearest_neighbors_endpoint(self):
+        rng = np.random.default_rng(5)
+        base = rng.normal(size=16)
+        vecs = [base + rng.normal(scale=0.01, size=16) for _ in range(3)]
+        vecs.append(-base)  # the odd one out
+        labels = ["king", "queen", "prince", "banana"]
+        self.client.set_vectors(labels, np.stack(vecs))
+        near = self.client.nearest("king", k=2)
+        assert "banana" not in near
+        assert set(near) <= {"queen", "prince"}
+
+    def test_dashboard_served(self):
+        import urllib.request
+
+        with urllib.request.urlopen(self.server.address + "/") as resp:
+            html = resp.read().decode()
+        assert "dashboard" in html
