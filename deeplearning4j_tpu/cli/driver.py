@@ -34,7 +34,9 @@ def load_csv(path: str, num_classes: Optional[int] = None,
              label_column: int = -1) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     """Numeric CSV → (features, one-hot labels). ``label_column=None``
     (via --no-labels) means feature-only input for predict."""
-    data = np.loadtxt(path, delimiter=",", dtype=np.float64, ndmin=2)
+    from deeplearning4j_tpu.native_rt import read_csv
+
+    data = read_csv(path)
     if label_column is None:
         return data.astype(np.float32), None
     labels_raw = data[:, label_column].astype(int)

@@ -15,9 +15,7 @@ stay meaningful offline.
 
 from __future__ import annotations
 
-import gzip
 import os
-import struct
 from typing import Optional, Tuple
 
 import numpy as np
@@ -37,19 +35,12 @@ def _data_dir() -> str:
 
 
 def read_idx(path: str) -> np.ndarray:
-    """Parse an IDX file (optionally gzipped) — reference MnistDbFile."""
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rb") as f:
-        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
-        if zero != 0:
-            raise ValueError(f"Bad IDX magic in {path}")
-        dtype = {
-            0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
-            0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64,
-        }[dtype_code]
-        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
-        data = np.frombuffer(f.read(), dtype=np.dtype(dtype).newbyteorder(">"))
-        return data.reshape(shape)
+    """Parse an IDX file (optionally gzipped) — reference MnistDbFile.
+    Delegates to native_rt.read_idx: native decode for plain uint8 files,
+    full Python parser (gzip + all element types) otherwise."""
+    from deeplearning4j_tpu.native_rt import read_idx as _read
+
+    return _read(path)
 
 
 def _find_idx(basenames) -> Optional[str]:

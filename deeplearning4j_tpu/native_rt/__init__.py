@@ -1,0 +1,33 @@
+"""Native runtime bindings (ctypes over native/libdl4j_native.so).
+
+The TPU-native equivalent of the reference's external native surface
+(SURVEY.md §2.9): tensor math lives in XLA, so the native layer owns the
+host-side data runtime — IDX/CSV decoding, ingest transforms, shuffling,
+and the prefetch ring buffer. Every entry point has a pure-Python/numpy
+fallback, used automatically when the .so is absent; ``native_available()``
+reports which path is live.
+"""
+
+from deeplearning4j_tpu.native_rt.lib import (
+    NativeLib,
+    native_available,
+    read_idx,
+    read_csv,
+    u8_to_f32,
+    one_hot,
+    shuffle_indices,
+    RingBuffer,
+)
+from deeplearning4j_tpu.native_rt.iterator import NativeAsyncDataSetIterator
+
+__all__ = [
+    "NativeLib",
+    "native_available",
+    "read_idx",
+    "read_csv",
+    "u8_to_f32",
+    "one_hot",
+    "shuffle_indices",
+    "RingBuffer",
+    "NativeAsyncDataSetIterator",
+]

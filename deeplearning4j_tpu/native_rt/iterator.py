@@ -1,0 +1,119 @@
+"""Async prefetch iterator over the native ring buffer.
+
+The reference's AsyncDataSetIterator (datasets/iterator/
+AsyncDataSetIterator.java) runs a producer thread pushing DataSets into a
+LinkedBlockingQueue. Here the blocking queue is the native MPMC ring
+(native_rt/lib.RingBuffer): the producer thread pulls batches from the
+base iterator, parks them in a token table, and pushes the token; the
+consumer pops tokens — so the queue discipline (bounded, blocking,
+close-wakes-waiters) runs in C++ while batch payloads stay in Python.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.native_rt.lib import RingBuffer
+
+
+class NativeAsyncDataSetIterator(DataSetIterator):
+    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+        super().__init__(batch_size=getattr(base, "batch", 0))
+        self.base = base
+        self.queue_size = queue_size
+        self._ring: Optional[RingBuffer] = None
+        self._table: Dict[int, object] = {}
+        self._table_lock = threading.Lock()
+        self._producer: Optional[threading.Thread] = None
+        self._producer_error: Optional[BaseException] = None
+        self._start()
+
+    # -- producer -------------------------------------------------------
+    def _start(self, reset: bool = True) -> None:
+        self._stop_producer()
+        if reset:
+            self.base.reset()
+        self._ring = RingBuffer(self.queue_size)
+        self._table = {}
+        self._producer_error = None
+
+        # The closure binds THIS generation's ring/table, so a stale
+        # producer that outlives a reset() (join timeout on a blocked
+        # base.next()) can only touch its own discarded generation —
+        # never the new ring/table.
+        ring, table = self._ring, self._table
+
+        def produce():
+            token = 0
+            try:
+                while True:
+                    ds = self.base.next()
+                    if ds is None:
+                        break
+                    with self._table_lock:
+                        table[token] = ds
+                    if not ring.push(token):  # closed underneath us
+                        with self._table_lock:
+                            table.pop(token, None)
+                        return
+                    token += 1
+            except BaseException as e:  # surfaced on next()
+                if ring is self._ring:
+                    self._producer_error = e
+            finally:
+                ring.close()
+
+        self._producer = threading.Thread(target=produce, daemon=True)
+        self._producer.start()
+
+    def _stop_producer(self) -> None:
+        if self._ring is not None:
+            self._ring.close()
+        producer_alive = False
+        if self._producer is not None:
+            self._producer.join(timeout=5.0)
+            producer_alive = self._producer.is_alive()
+        if self._ring is not None:
+            # drain so nothing is left referencing parked tokens
+            while self._ring.pop() is not None:
+                pass
+            if not producer_alive:
+                self._ring.destroy()
+            # else: the stale producer still holds the (closed) ring; its
+            # next push returns False and it exits, after which GC frees
+            # the native side — destroying now would be a use-after-free.
+            self._ring = None
+        self._producer = None
+
+    # -- DataSetIterator contract --------------------------------------
+    def next(self, num: Optional[int] = None):
+        token = self._ring.pop()
+        if token is None:
+            if self._producer_error is not None:
+                err, self._producer_error = self._producer_error, None
+                raise err
+            return None
+        with self._table_lock:
+            ds = self._table.pop(token)
+        return self._post(ds)
+
+    def reset(self) -> None:
+        self._start()
+
+    def total_examples(self) -> int:
+        return self.base.total_examples()
+
+    def input_columns(self) -> int:
+        return self.base.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.base.total_outcomes()
+
+    def state_dict(self) -> dict:
+        return self.base.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.base.load_state_dict(state)
+        self._start(reset=False)  # keep the restored position

@@ -1,0 +1,309 @@
+"""ctypes bindings for libdl4j_native.so with numpy fallbacks.
+
+Loading: first try the prebuilt .so next to native/dl4j_native.cpp; if
+missing and a toolchain exists, build it once with make (a few hundred
+ms); else run on the numpy fallbacks. No pip/pybind11 involved (neither
+is available in the image) — plain C ABI via ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdl4j_native.so")
+
+RING_CLOSED = -(2 ** 63)  # INT64_MIN sentinel from the C side
+
+
+class NativeLib:
+    """Lazily-loaded singleton around the shared library."""
+
+    _lock = threading.Lock()
+    _instance: Optional["NativeLib"] = None
+    _load_failed = False
+
+    def __init__(self, cdll: ctypes.CDLL):
+        self.lib = cdll
+        self._declare()
+
+    def _declare(self) -> None:
+        lib = self.lib
+        lib.dl4j_free.argtypes = [ctypes.c_void_p]
+        lib.dl4j_read_idx.restype = ctypes.c_void_p
+        lib.dl4j_read_idx.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)]
+        lib.dl4j_read_csv.restype = ctypes.c_void_p
+        lib.dl4j_read_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_char,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.dl4j_u8_to_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_float]
+        lib.dl4j_one_hot.restype = ctypes.c_int32
+        lib.dl4j_one_hot.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p]
+        lib.dl4j_shuffle_indices.argtypes = [
+            ctypes.c_int64, ctypes.c_uint64, ctypes.c_void_p]
+        lib.dl4j_ring_create.restype = ctypes.c_void_p
+        lib.dl4j_ring_create.argtypes = [ctypes.c_int32]
+        lib.dl4j_ring_push.restype = ctypes.c_int32
+        lib.dl4j_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.dl4j_ring_pop.restype = ctypes.c_int64
+        lib.dl4j_ring_pop.argtypes = [ctypes.c_void_p]
+        lib.dl4j_ring_size.restype = ctypes.c_int64
+        lib.dl4j_ring_size.argtypes = [ctypes.c_void_p]
+        lib.dl4j_ring_close.argtypes = [ctypes.c_void_p]
+        lib.dl4j_ring_destroy.argtypes = [ctypes.c_void_p]
+        lib.dl4j_native_abi_version.restype = ctypes.c_int32
+
+    @classmethod
+    def load(cls) -> Optional["NativeLib"]:
+        if os.environ.get("DL4J_TPU_DISABLE_NATIVE"):
+            return None
+        with cls._lock:
+            if cls._instance is not None:
+                return cls._instance
+            if cls._load_failed:
+                return None
+            cdll = cls._try_load()
+            if cdll is None:
+                cls._load_failed = True
+                return None
+            cls._instance = cls(cdll)
+            return cls._instance
+
+    @staticmethod
+    def _try_load() -> Optional[ctypes.CDLL]:
+        if not os.path.exists(_SO_PATH):
+            src = os.path.join(_NATIVE_DIR, "dl4j_native.cpp")
+            if not os.path.exists(src):
+                return None
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               check=True, capture_output=True, timeout=120)
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            return ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+
+
+def native_available() -> bool:
+    return NativeLib.load() is not None
+
+
+# ---------------------------------------------------------------------------
+# loaders / transforms with fallbacks
+# ---------------------------------------------------------------------------
+
+def read_idx(path: str) -> np.ndarray:
+    """IDX file → ndarray. Plain uint8 files (the MNIST hot path) decode
+    natively; gzipped or non-uint8 element types take the Python parser.
+    This is THE IDX entry point — datasets/mnist delegates here."""
+    nl = NativeLib.load()
+    if nl is not None and not path.endswith(".gz"):
+        ndim = ctypes.c_int32()
+        shape = (ctypes.c_int64 * 8)()
+        elem = ctypes.c_int32()
+        ptr = nl.lib.dl4j_read_idx(path.encode(), ctypes.byref(ndim), shape,
+                                   ctypes.byref(elem))
+        if ptr:
+            try:
+                dims = tuple(shape[i] for i in range(ndim.value))
+                n = int(np.prod(dims))
+                view = np.ctypeslib.as_array(
+                    ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+                    shape=(n,))
+                return view.reshape(dims).copy()  # one copy: view→owned
+            finally:
+                nl.lib.dl4j_free(ptr)
+        # native decode failed (non-uint8 dtype, truncation, bad magic…):
+        # the Python parser below produces the authoritative error/result
+    return _read_idx_py(path)
+
+
+def _read_idx_py(path: str) -> np.ndarray:
+    """Full IDX parser: optional gzip, all six element-type codes
+    (reference datasets/mnist/MnistDbFile.java)."""
+    import gzip
+    import struct
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        head = f.read(4)
+        if len(head) != 4:
+            raise ValueError(f"truncated IDX header in {path}")
+        zero, dtype_code, nd = struct.unpack(">HBB", head)
+        if zero != 0:
+            raise ValueError(f"bad IDX magic in {path}")
+        try:
+            dtype = {
+                0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+                0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64,
+            }[dtype_code]
+        except KeyError:
+            raise ValueError(
+                f"unknown IDX element type 0x{dtype_code:02x} in {path}")
+        dims = struct.unpack(">" + "I" * nd, f.read(4 * nd))
+        data = np.frombuffer(f.read(),
+                             dtype=np.dtype(dtype).newbyteorder(">"))
+        expected = int(np.prod(dims)) if dims else 0
+        if data.size != expected:
+            raise ValueError(
+                f"IDX payload has {data.size} elements, header promises "
+                f"{expected} in {path}")
+        return data.reshape(dims)
+
+
+def read_csv(path: str, delimiter: str = ",") -> np.ndarray:
+    """Numeric CSV → float64 [rows, cols]. '#' comment lines skipped,
+    space/tab padding tolerated (np.loadtxt parity)."""
+    nl = NativeLib.load()
+    if nl is None:
+        return np.loadtxt(path, delimiter=delimiter, dtype=np.float64,
+                          ndmin=2)
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    ptr = nl.lib.dl4j_read_csv(path.encode(), delimiter.encode(),
+                               ctypes.byref(rows), ctypes.byref(cols))
+    if not ptr:
+        raise ValueError(f"failed to parse CSV: {path}")
+    try:
+        n = rows.value * cols.value
+        view = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_double)), shape=(n,))
+        return view.reshape(rows.value, cols.value).copy()  # one copy
+    finally:
+        nl.lib.dl4j_free(ptr)
+
+
+def u8_to_f32(src: np.ndarray, scale: float = 1.0 / 255.0) -> np.ndarray:
+    """uint8 → float32 * scale (image normalization hot path)."""
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    nl = NativeLib.load()
+    if nl is None:
+        return src.astype(np.float32) * np.float32(scale)
+    out = np.empty(src.shape, dtype=np.float32)
+    nl.lib.dl4j_u8_to_f32(
+        src.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p), src.size,
+        ctypes.c_float(scale))
+    return out
+
+
+def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
+    """int labels [N] → one-hot float32 [N, num_classes]. Labels are
+    range-checked BEFORE any dtype narrowing so values like 300 or -1
+    raise instead of silently wrapping modulo 256."""
+    labels64 = np.ascontiguousarray(labels, dtype=np.int64)
+    if labels64.size and (labels64.min() < 0
+                          or labels64.max() >= num_classes):
+        raise ValueError(
+            f"labels outside [0, {num_classes}) for one_hot")
+    nl = NativeLib.load()
+    if nl is None or num_classes > 256:
+        return np.eye(num_classes, dtype=np.float32)[labels64]
+    u8 = labels64.astype(np.uint8)
+    out = np.empty((u8.size, num_classes), dtype=np.float32)
+    rc = nl.lib.dl4j_one_hot(
+        u8.ctypes.data_as(ctypes.c_void_p), u8.size,
+        num_classes, out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        raise ValueError("label out of range for one_hot")
+    return out
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    """Deterministic permutation of [0, n) (SplitMix64 Fisher-Yates)."""
+    nl = NativeLib.load()
+    out = np.empty(n, dtype=np.int64)
+    if nl is None:
+        # same algorithm in Python so native/fallback agree bit-for-bit
+        out[:] = np.arange(n)
+        x = (seed + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+        for i in range(n - 1, 0, -1):
+            x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+            z = x
+            z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+            z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+            z = z ^ (z >> 31)
+            j = z % (i + 1)
+            out[i], out[j] = out[j], out[i]
+        return out
+    nl.lib.dl4j_shuffle_indices(n, ctypes.c_uint64(seed),
+                                out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+class RingBuffer:
+    """Bounded blocking token queue backed by the native MPMC ring;
+    pure-Python queue fallback. Tokens are int64."""
+
+    def __init__(self, capacity: int = 4):
+        self._nl = NativeLib.load()
+        if self._nl is not None:
+            self._ring = self._nl.lib.dl4j_ring_create(capacity)
+            self._q = None
+        else:
+            import queue
+
+            self._ring = None
+            self._q = queue.Queue(maxsize=capacity)
+            self._closed = threading.Event()
+
+    def push(self, token: int) -> bool:
+        if self._ring is not None:
+            return self._nl.lib.dl4j_ring_push(self._ring, token) == 0
+        while not self._closed.is_set():
+            try:
+                self._q.put(token, timeout=0.05)
+                return True
+            except Exception:
+                continue
+        return False
+
+    def pop(self) -> Optional[int]:
+        """Blocking; None once closed and drained."""
+        if self._ring is not None:
+            v = self._nl.lib.dl4j_ring_pop(self._ring)
+            return None if v == RING_CLOSED else v
+        while True:
+            try:
+                return self._q.get(timeout=0.05)
+            except Exception:
+                if self._closed.is_set() and self._q.empty():
+                    return None
+
+    def size(self) -> int:
+        if self._ring is not None:
+            return int(self._nl.lib.dl4j_ring_size(self._ring))
+        return self._q.qsize()
+
+    def close(self) -> None:
+        if self._ring is not None:
+            self._nl.lib.dl4j_ring_close(self._ring)
+        else:
+            self._closed.set()
+
+    def destroy(self) -> None:
+        if self._ring is not None:
+            self._nl.lib.dl4j_ring_destroy(self._ring)
+            self._ring = None
+
+    def __del__(self):
+        try:
+            if getattr(self, "_ring", None) is not None:
+                self.close()
+                self.destroy()
+        except Exception:
+            pass

@@ -1,0 +1,275 @@
+// Native data-runtime for deeplearning4j_tpu.
+//
+// The reference delegates its native surface to external libraries
+// (ND4J JNI backends + Canova readers; SURVEY.md §2.9). The TPU build
+// keeps tensor math inside XLA, so the native layer owns what remains
+// host-side and hot: dataset decoding (IDX/CSV), ingest transforms
+// (u8→f32 normalize, one-hot), shuffling, and the prefetch ring buffer
+// behind the async iterator (reference AsyncDataSetIterator's
+// blocking-queue thread, datasets/iterator/AsyncDataSetIterator.java).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+// All buffers returned by dl4j_* loaders are malloc'd; free with
+// dl4j_free. Thread-safety: the ring buffer is internally locked;
+// loaders are reentrant.
+
+#include <atomic>
+#include <charconv>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// memory
+// ---------------------------------------------------------------------
+
+void dl4j_free(void* p) { std::free(p); }
+
+// ---------------------------------------------------------------------
+// IDX (MNIST) decoding — reference datasets/mnist/MnistDbFile.java
+// ---------------------------------------------------------------------
+// Returns malloc'd payload bytes (row-major), fills ndim, shape[0..ndim),
+// elem_size. NULL on error. Only the unsigned-byte (0x08) element type
+// used by MNIST is supported; magic = 0x00 0x00 0x08 <ndim>.
+
+void* dl4j_read_idx(const char* path, int32_t* ndim, int64_t* shape,
+                    int32_t* elem_size) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  unsigned char magic[4];
+  if (std::fread(magic, 1, 4, f) != 4 || magic[0] != 0 || magic[1] != 0 ||
+      magic[2] != 0x08) {
+    std::fclose(f);
+    return nullptr;
+  }
+  int nd = magic[3];
+  if (nd < 1 || nd > 8) {
+    std::fclose(f);
+    return nullptr;
+  }
+  int64_t total = 1;
+  for (int i = 0; i < nd; ++i) {
+    unsigned char dim[4];
+    if (std::fread(dim, 1, 4, f) != 4) {
+      std::fclose(f);
+      return nullptr;
+    }
+    int64_t d = (int64_t(dim[0]) << 24) | (int64_t(dim[1]) << 16) |
+                (int64_t(dim[2]) << 8) | int64_t(dim[3]);
+    shape[i] = d;
+    total *= d;
+  }
+  void* buf = std::malloc(size_t(total));
+  if (!buf) {
+    std::fclose(f);
+    return nullptr;
+  }
+  size_t got = std::fread(buf, 1, size_t(total), f);
+  std::fclose(f);
+  if (got != size_t(total)) {
+    std::free(buf);
+    return nullptr;
+  }
+  *ndim = nd;
+  *elem_size = 1;
+  return buf;
+}
+
+// ---------------------------------------------------------------------
+// CSV decoding — reference Canova CSVRecordReader role
+// ---------------------------------------------------------------------
+// Parses a numeric CSV into a malloc'd row-major double buffer; fills
+// rows/cols (cols = max fields seen on first data line; short rows
+// rejected -> returns NULL). Skips empty lines. strtod handles leading
+// whitespace; fields after the last delimiter on a line are included.
+
+double* dl4j_read_csv(const char* path, char delim, int64_t* rows,
+                      int64_t* cols) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  // slurp the whole file (fgetc-per-char is ~10x slower than one read)
+  std::fseek(f, 0, SEEK_END);
+  long fsize = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (fsize <= 0) {
+    std::fclose(f);
+    return nullptr;
+  }
+  std::vector<char> buf(size_t(fsize) + 1);
+  size_t got = std::fread(buf.data(), 1, size_t(fsize), f);
+  std::fclose(f);
+  if (got != size_t(fsize)) return nullptr;
+  buf[got] = '\0';
+
+  std::vector<double> data;
+  data.reserve(1024);
+  int64_t ncols = -1, nrows = 0;
+  char* p = buf.data();
+  char* file_end = buf.data() + got;
+  while (p < file_end) {
+    // find line end; treat \r\n and \n alike; skip blank lines
+    char* nl = (char*)std::memchr(p, '\n', size_t(file_end - p));
+    char* line_end = nl ? nl : file_end;
+    char* term = line_end;
+    if (term > p && term[-1] == '\r') --term;
+    // skip blank and '#'-comment lines (np.loadtxt parity)
+    const char* first = p;
+    while (first < term && (*first == ' ' || *first == '\t')) ++first;
+    if (first == term || *first == '#') {
+      p = nl ? nl + 1 : file_end;
+      continue;
+    }
+    int64_t fields = 0;
+    const char* q = first;
+    bool bad = false;
+    while (true) {
+      // from_chars skips no whitespace; spaces/tabs pad fields in the wild
+      while (q < term && (*q == ' ' || *q == '\t')) ++q;
+      double v;
+      auto res = std::from_chars(q, (const char*)term, v);
+      if (res.ec != std::errc()) {  // unparsable field
+        bad = true;
+        break;
+      }
+      data.push_back(v);
+      ++fields;
+      const char* end = res.ptr;
+      while (end < term && (*end == ' ' || *end == '\t')) ++end;
+      if (end < term && *end == delim) {
+        q = end + 1;
+      } else if (end == term) {
+        break;
+      } else {
+        bad = true;
+        break;
+      }
+    }
+    if (bad) return nullptr;
+    if (ncols < 0) ncols = fields;
+    if (fields != ncols) return nullptr;
+    ++nrows;
+    p = nl ? nl + 1 : file_end;
+  }
+  if (nrows == 0 || ncols <= 0) return nullptr;
+  double* out = (double*)std::malloc(sizeof(double) * size_t(nrows * ncols));
+  if (!out) return nullptr;
+  std::memcpy(out, data.data(), sizeof(double) * size_t(nrows * ncols));
+  *rows = nrows;
+  *cols = ncols;
+  return out;
+}
+
+// ---------------------------------------------------------------------
+// ingest transforms (the u8 image -> model input hot path)
+// ---------------------------------------------------------------------
+
+void dl4j_u8_to_f32(const uint8_t* src, float* dst, int64_t n, float scale) {
+  for (int64_t i = 0; i < n; ++i) dst[i] = float(src[i]) * scale;
+}
+
+// labels[i] in [0, num_classes) -> one-hot rows; out zeroed here.
+int32_t dl4j_one_hot(const uint8_t* labels, int64_t n, int32_t num_classes,
+                     float* out) {
+  std::memset(out, 0, sizeof(float) * size_t(n) * size_t(num_classes));
+  for (int64_t i = 0; i < n; ++i) {
+    if (labels[i] >= num_classes) return -1;
+    out[i * num_classes + labels[i]] = 1.0f;
+  }
+  return 0;
+}
+
+// Fisher-Yates permutation of [0, n) with SplitMix64 — deterministic
+// per seed (the shuffling batcher the reference gets from DataSet
+// .shuffle / SamplingDataSetIterator).
+void dl4j_shuffle_indices(int64_t n, uint64_t seed, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  uint64_t x = seed + 0x9E3779B97F4A7C15ULL;
+  for (int64_t i = n - 1; i > 0; --i) {
+    // splitmix64 step
+    x += 0x9E3779B97F4A7C15ULL;
+    uint64_t z = x;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    z = z ^ (z >> 31);
+    int64_t j = int64_t(z % uint64_t(i + 1));
+    int64_t t = out[i];
+    out[i] = out[j];
+    out[j] = t;
+  }
+}
+
+// ---------------------------------------------------------------------
+// prefetch ring buffer — reference AsyncDataSetIterator blocking queue
+// ---------------------------------------------------------------------
+// Bounded MPMC queue of int64 tokens (the Python side maps tokens to
+// batches). Blocking push/pop; close() wakes all waiters; pop returns
+// DL4J_RING_CLOSED once closed and drained.
+
+struct Ring {
+  std::mutex m;
+  std::condition_variable not_full, not_empty;
+  std::deque<int64_t> q;
+  size_t cap;
+  bool closed = false;
+};
+
+const int64_t DL4J_RING_CLOSED = INT64_MIN;
+
+void* dl4j_ring_create(int32_t capacity) {
+  Ring* r = new Ring();
+  r->cap = capacity > 0 ? size_t(capacity) : 1;
+  return r;
+}
+
+// 0 on success, -1 if closed.
+int32_t dl4j_ring_push(void* ring, int64_t token) {
+  Ring* r = (Ring*)ring;
+  std::unique_lock<std::mutex> lk(r->m);
+  r->not_full.wait(lk, [r] { return r->q.size() < r->cap || r->closed; });
+  if (r->closed) return -1;
+  r->q.push_back(token);
+  r->not_empty.notify_one();
+  return 0;
+}
+
+int64_t dl4j_ring_pop(void* ring) {
+  Ring* r = (Ring*)ring;
+  std::unique_lock<std::mutex> lk(r->m);
+  r->not_empty.wait(lk, [r] { return !r->q.empty() || r->closed; });
+  if (r->q.empty()) return DL4J_RING_CLOSED;
+  int64_t v = r->q.front();
+  r->q.pop_front();
+  r->not_full.notify_one();
+  return v;
+}
+
+int64_t dl4j_ring_size(void* ring) {
+  Ring* r = (Ring*)ring;
+  std::lock_guard<std::mutex> lk(r->m);
+  return int64_t(r->q.size());
+}
+
+void dl4j_ring_close(void* ring) {
+  Ring* r = (Ring*)ring;
+  std::lock_guard<std::mutex> lk(r->m);
+  r->closed = true;
+  r->not_full.notify_all();
+  r->not_empty.notify_all();
+}
+
+void dl4j_ring_destroy(void* ring) { delete (Ring*)ring; }
+
+// ---------------------------------------------------------------------
+// version / sanity
+// ---------------------------------------------------------------------
+
+int32_t dl4j_native_abi_version() { return 1; }
+
+}  // extern "C"
