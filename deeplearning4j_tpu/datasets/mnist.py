@@ -117,16 +117,17 @@ def mnist_dataset(
     as_image: bool = False,
     seed: Optional[int] = None,
 ) -> DataSet:
+    from deeplearning4j_tpu.native_rt import one_hot, u8_to_f32
+
     imgs, labels = load_mnist(train, num_examples)
-    x = imgs.astype(np.float32) / 255.0
+    x = u8_to_f32(imgs)
     if binarize:
         x = (x > 0.5).astype(np.float32)
     if as_image:
         x = x.reshape(-1, 1, 28, 28)  # [N, C, H, W]
     else:
         x = x.reshape(-1, 784)
-    y = np.zeros((len(labels), 10), np.float32)
-    y[np.arange(len(labels)), labels.astype(int)] = 1.0
+    y = one_hot(labels.astype(int), 10)
     ds = DataSet(x, y)
     if seed is not None:
         ds.shuffle(seed)

@@ -1,0 +1,272 @@
+"""Record readers: file → records → DataSet iterators.
+
+TPU-native stand-in for the external Canova library (SURVEY.md §2.9 —
+the reference bridges RecordReader→DataSet in datasets/canova/
+RecordReaderDataSetIterator.java and SequenceRecordReaderDataSetIterator
+.java). Readers yield records (lists of values); the adapter iterators
+batch them into DataSets, with sequence variants producing padded
+[N, T, F] tensors + masks so downstream jit sees static shapes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+
+class RecordReader:
+    """Canova RecordReader equivalent: iterate records, resettable."""
+
+    def next_record(self) -> Optional[List[str]]:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_record()
+
+
+class CSVRecordReader(RecordReader):
+    """One record per CSV line (reference Canova CSVRecordReader)."""
+
+    def __init__(self, path: str, skip_lines: int = 0,
+                 delimiter: str = ","):
+        self.path = path
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self._lines: List[List[str]] = []
+        self._pos = 0
+        self._load()
+
+    def _load(self) -> None:
+        with open(self.path) as f:
+            raw = [ln.strip() for ln in f]
+        rows = [ln.split(self.delimiter) for ln in raw[self.skip_lines:]
+                if ln and not ln.startswith("#")]
+        self._lines = [[v.strip() for v in row] for row in rows]
+        self._pos = 0
+
+    def next_record(self) -> Optional[List[str]]:
+        if self._pos >= len(self._lines):
+            return None
+        rec = self._lines[self._pos]
+        self._pos += 1
+        return rec
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._lines)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """One sequence per FILE, one timestep per line (reference
+    CSVSequenceRecordReader over csvsequence_*.txt fixtures). Records
+    returned by next_record() are whole sequences: List[List[str]]."""
+
+    def __init__(self, paths: Sequence[str], skip_lines: int = 0,
+                 delimiter: str = ","):
+        self.paths = list(paths)
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self._pos = 0
+
+    def next_record(self):
+        if self._pos >= len(self.paths):
+            return None
+        reader = CSVRecordReader(self.paths[self._pos], self.skip_lines,
+                                 self.delimiter)
+        self._pos += 1
+        return list(reader)
+
+    def has_next(self) -> bool:
+        return self._pos < len(self.paths)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class ImageRecordReader(RecordReader):
+    """Images under class-named subdirectories → (pixels..., label_idx)
+    records (reference Canova ImageRecordReader; labels from parent dir).
+    Decodes via PIL; grayscale [h, w] flattened row-major."""
+
+    def __init__(self, root: str, height: int, width: int,
+                 extensions: Sequence[str] = (".png", ".jpg", ".jpeg",
+                                              ".bmp")):
+        self.root = root
+        self.height = height
+        self.width = width
+        self.labels = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self._files: List[tuple] = []
+        for li, label in enumerate(self.labels):
+            folder = os.path.join(root, label)
+            for fn in sorted(os.listdir(folder)):
+                if os.path.splitext(fn)[1].lower() in extensions:
+                    self._files.append((os.path.join(folder, fn), li))
+        self._pos = 0
+
+    def next_record(self) -> Optional[List[str]]:
+        if self._pos >= len(self._files):
+            return None
+        path, label = self._files[self._pos]
+        self._pos += 1
+        from PIL import Image
+
+        img = Image.open(path).convert("L").resize(
+            (self.width, self.height))
+        pixels = np.asarray(img, np.float32).ravel() / 255.0
+        return [str(v) for v in pixels] + [str(label)]
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._files)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """records → batched DataSets (reference datasets/canova/
+    RecordReaderDataSetIterator.java). The ``label_index`` column (default
+    -1 = last) becomes a one-hot label; ``label_index=None`` yields
+    feature-only batches; ``regression=True`` keeps the raw label value
+    instead of one-hot encoding."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = -1,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False):
+        super().__init__(batch_size)
+        self.reader = reader
+        self.num_classes = num_classes
+        self.regression = regression
+        self._records = [
+            [float(v) for v in rec] for rec in reader]
+        ncol = len(self._records[0]) if self._records else 0
+        if label_index is not None and self._records:
+            if not -ncol <= label_index < ncol:
+                raise ValueError(
+                    f"label_index {label_index} out of range for "
+                    f"{ncol}-column records")
+            label_index %= ncol
+        self.label_index = label_index
+        if (label_index is not None and not regression
+                and num_classes is None):
+            self.num_classes = int(
+                max(r[label_index] for r in self._records)) + 1
+        self._pos = 0
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        n = num or self.batch
+        if self._pos >= len(self._records):
+            return None
+        chunk = self._records[self._pos:self._pos + n]
+        self._pos += len(chunk)
+        arr = np.asarray(chunk, np.float32)
+        if self.label_index is None:
+            return self._post(DataSet(arr, None))
+        col = self.label_index
+        feats = np.delete(arr, col, axis=1)
+        if self.regression:
+            labels = arr[:, col:col + 1]
+        else:
+            from deeplearning4j_tpu.native_rt import one_hot
+
+            labels = one_hot(arr[:, col].astype(int), self.num_classes)
+        return self._post(DataSet(feats, labels))
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def total_examples(self) -> int:
+        return len(self._records)
+
+    def input_columns(self) -> int:
+        ncol = len(self._records[0]) if self._records else 0
+        return ncol - (0 if self.label_index is None else 1)
+
+    def total_outcomes(self) -> int:
+        return self.num_classes or 0
+
+    def state_dict(self) -> dict:
+        return {"pos": self._pos}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._pos = state["pos"]
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Paired feature/label sequence readers → padded [N, T, F] DataSets
+    with masks (reference SequenceRecordReaderDataSetIterator; padding +
+    masks keep shapes static for jit, SURVEY.md §5.7)."""
+
+    def __init__(self, features_reader: CSVSequenceRecordReader,
+                 labels_reader: CSVSequenceRecordReader, batch_size: int,
+                 num_classes: int):
+        super().__init__(batch_size)
+        self.num_classes = num_classes
+        feats = [np.asarray([[float(v) for v in step] for step in seq],
+                            np.float32)
+                 for seq in features_reader]
+        labels = [np.asarray([[float(v) for v in step] for step in seq],
+                             np.float32)
+                  for seq in labels_reader]
+        if len(feats) != len(labels):
+            raise ValueError("feature/label sequence counts differ")
+        self._seqs = list(zip(feats, labels))
+        self._pos = 0
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        n = num or self.batch
+        if self._pos >= len(self._seqs):
+            return None
+        chunk = self._seqs[self._pos:self._pos + n]
+        self._pos += len(chunk)
+        max_t = max(f.shape[0] for f, _ in chunk)
+        nf = chunk[0][0].shape[1]
+        bf = np.zeros((len(chunk), max_t, nf), np.float32)
+        bl = np.zeros((len(chunk), max_t, self.num_classes), np.float32)
+        mask = np.zeros((len(chunk), max_t), np.float32)
+        for i, (f, l) in enumerate(chunk):
+            t = f.shape[0]
+            bf[i, :t] = f
+            cls = l[:, 0].astype(int) if l.shape[1] == 1 else None
+            if cls is not None:
+                bl[i, np.arange(t), cls] = 1.0
+            else:
+                bl[i, :t, :l.shape[1]] = l
+            mask[i, :t] = 1.0
+        return self._post(
+            DataSet(bf, bl, features_mask=mask, labels_mask=mask))
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def total_examples(self) -> int:
+        return len(self._seqs)
+
+    def input_columns(self) -> int:
+        return self._seqs[0][0].shape[1] if self._seqs else 0
+
+    def total_outcomes(self) -> int:
+        return self.num_classes
+
+    def state_dict(self) -> dict:
+        return {"pos": self._pos}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._pos = state["pos"]

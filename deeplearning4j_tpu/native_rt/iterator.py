@@ -26,6 +26,10 @@ class NativeAsyncDataSetIterator(DataSetIterator):
         self._ring: Optional[RingBuffer] = None
         self._table: Dict[int, object] = {}
         self._table_lock = threading.Lock()
+        # Guards base-iterator access: the producer thread advances it
+        # while checkpoint code snapshots/restores it (same role as
+        # AsyncDataSetIterator._base_lock, datasets/iterator.py).
+        self._base_lock = threading.Lock()
         self._producer: Optional[threading.Thread] = None
         self._producer_error: Optional[BaseException] = None
         self._start()
@@ -49,7 +53,8 @@ class NativeAsyncDataSetIterator(DataSetIterator):
             token = 0
             try:
                 while True:
-                    ds = self.base.next()
+                    with self._base_lock:
+                        ds = self.base.next()
                     if ds is None:
                         break
                     with self._table_lock:
@@ -112,8 +117,13 @@ class NativeAsyncDataSetIterator(DataSetIterator):
         return self.base.total_outcomes()
 
     def state_dict(self) -> dict:
-        return self.base.state_dict()
+        with self._base_lock:
+            return self.base.state_dict()
 
     def load_state_dict(self, state: dict) -> None:
-        self.base.load_state_dict(state)
+        # Stop the producer BEFORE touching base state so an in-flight
+        # next() cannot overwrite the restored cursor.
+        self._stop_producer()
+        with self._base_lock:
+            self.base.load_state_dict(state)
         self._start(reset=False)  # keep the restored position

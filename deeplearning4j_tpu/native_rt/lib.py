@@ -177,7 +177,14 @@ def read_csv(path: str, delimiter: str = ",") -> np.ndarray:
     ptr = nl.lib.dl4j_read_csv(path.encode(), delimiter.encode(),
                                ctypes.byref(rows), ctypes.byref(cols))
     if not ptr:
-        raise ValueError(f"failed to parse CSV: {path}")
+        # Native parser is stricter than loadtxt in corners (e.g. '+1.5'
+        # — from_chars takes no leading plus): the Python path is the
+        # authoritative accept/reject decision.
+        try:
+            return np.loadtxt(path, delimiter=delimiter, dtype=np.float64,
+                              ndmin=2)
+        except Exception as e:
+            raise ValueError(f"failed to parse CSV {path}: {e}") from e
     try:
         n = rows.value * cols.value
         view = np.ctypeslib.as_array(
@@ -212,15 +219,19 @@ def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
             f"labels outside [0, {num_classes}) for one_hot")
     nl = NativeLib.load()
     if nl is None or num_classes > 256:
-        return np.eye(num_classes, dtype=np.float32)[labels64]
-    u8 = labels64.astype(np.uint8)
+        # no np.eye: identity would be num_classes² (10 GB at vocab sizes)
+        flat = labels64.ravel()
+        out = np.zeros((flat.size, num_classes), dtype=np.float32)
+        out[np.arange(flat.size), flat] = 1.0
+        return out.reshape(*labels64.shape, num_classes)
+    u8 = np.ascontiguousarray(labels64.ravel().astype(np.uint8))
     out = np.empty((u8.size, num_classes), dtype=np.float32)
     rc = nl.lib.dl4j_one_hot(
         u8.ctypes.data_as(ctypes.c_void_p), u8.size,
         num_classes, out.ctypes.data_as(ctypes.c_void_p))
     if rc != 0:
         raise ValueError("label out of range for one_hot")
-    return out
+    return out.reshape(*labels64.shape, num_classes)
 
 
 def shuffle_indices(n: int, seed: int) -> np.ndarray:

@@ -64,6 +64,11 @@ void* dl4j_read_idx(const char* path, int32_t* ndim, int64_t* shape,
     int64_t d = (int64_t(dim[0]) << 24) | (int64_t(dim[1]) << 16) |
                 (int64_t(dim[2]) << 8) | int64_t(dim[3]);
     shape[i] = d;
+    // guard total*d overflow (corrupt/crafted headers): fail cleanly
+    if (d <= 0 || total > INT64_MAX / d) {
+      std::fclose(f);
+      return nullptr;
+    }
     total *= d;
   }
   void* buf = std::malloc(size_t(total));
