@@ -22,7 +22,9 @@ class DataSet:
         labels_mask=None,
     ):
         self.features = np.asarray(features)
-        self.labels = np.asarray(labels)
+        # feature-only datasets (e.g. predict inputs) carry labels=None;
+        # np.asarray(None) would silently make a 0-d object array
+        self.labels = None if labels is None else np.asarray(labels)
         self.features_mask = (
             None if features_mask is None else np.asarray(features_mask)
         )

@@ -75,11 +75,18 @@ def load_cifar(train: bool = True,
     names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
              else ["test_batch.bin"])
     paths = [os.path.join(root, n) for n in names]
-    if all(os.path.exists(p) for p in paths):
+    present = [p for p in paths if os.path.exists(p)]
+    if len(present) == len(paths):
         parts = [_read_cifar_bin(p) for p in paths]
         imgs = np.concatenate([p[0] for p in parts])
         labels = np.concatenate([p[1] for p in parts])
     else:
+        if present:  # partial real data is indistinguishable from success
+            raise FileNotFoundError(
+                f"CIFAR dir {root} is missing "
+                f"{sorted(set(paths) - set(present))} — refusing to "
+                "silently substitute synthetic data; delete the dir to "
+                "opt into the synthetic fallback")
         imgs, labels = _synthetic_images(
             num_examples or (50000 if train else 10000), CIFAR_SHAPE,
             CIFAR_CLASSES, seed=11, train=train)
@@ -137,6 +144,11 @@ def load_lfw(num_examples: Optional[int] = None, num_people: int = 5,
                     arr = arr.transpose(2, 0, 1)
                 img_list.append(arr)
                 lbl_list.append(li)
+        if not img_list:
+            raise FileNotFoundError(
+                f"LFW dir {root} exists but holds no readable images "
+                "(.png/.jpg/.jpeg/.bmp under class subdirectories); "
+                "delete the dir to opt into the synthetic fallback")
         imgs = np.stack(img_list)
         labels = np.asarray(lbl_list, np.uint8)
     else:

@@ -156,8 +156,11 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.regression = regression
         self._records = [
             [float(v) for v in rec] for rec in reader]
-        ncol = len(self._records[0]) if self._records else 0
-        if label_index is not None and self._records:
+        if not self._records:
+            raise ValueError(
+                "record reader produced no records (empty input?)")
+        ncol = len(self._records[0])
+        if label_index is not None:
             if not -ncol <= label_index < ncol:
                 raise ValueError(
                     f"label_index {label_index} out of range for "
@@ -244,8 +247,11 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         for i, (f, l) in enumerate(chunk):
             t = f.shape[0]
             bf[i, :t] = f
-            cls = l[:, 0].astype(int) if l.shape[1] == 1 else None
-            if cls is not None:
+            if l.shape[1] == 1:
+                cls = l[:, 0].astype(int)
+                if cls.min() < 0 or cls.max() >= self.num_classes:
+                    raise ValueError(
+                        f"sequence label outside [0, {self.num_classes})")
                 bl[i, np.arange(t), cls] = 1.0
             else:
                 bl[i, :t, :l.shape[1]] = l

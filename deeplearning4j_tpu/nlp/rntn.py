@@ -1,0 +1,334 @@
+"""Recursive Neural Tensor Network (Socher sentiment) — TPU-native.
+
+Capability mirror of reference nlp/.../models/rntn/RNTN.java:84 (1,489
+LoC, implements Layer; own AdaGrad) + RNTNEval + the Tree type
+(nn/layers/feedforward/autoencoder/recursive/Tree.java). Same math:
+for children (a, b), x = [a; b],
+    p = tanh(W x + b + x^T V x)        (V: d tensor slices over [2d, 2d])
+    y = softmax(W_s p)  at every node; loss = Σ node cross-entropy.
+
+TPU re-design: the reference recurses over Java tree objects, an XLA
+anti-pattern (dynamic control flow). Here each tree is LINEARIZED into a
+post-order array program — leaves load word vectors, internal nodes
+combine two earlier slots — executed with ``lax.scan`` over a fixed-size
+node buffer (dynamic_update_slice writes), padded/masked to a static
+``max_nodes`` so one jitted computation serves every tree in a batch via
+``vmap``. Training uses per-parameter AdaGrad like the reference.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# trees
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Tree:
+    """Binary parse tree with an integer label per node (sentiment
+    treebank convention)."""
+
+    label: int
+    word: Optional[str] = None
+    left: Optional["Tree"] = None
+    right: Optional["Tree"] = None
+
+    def is_leaf(self) -> bool:
+        return self.word is not None
+
+    @staticmethod
+    def parse(s: str) -> "Tree":
+        """Parse '(2 (1 bad) (0 movie))'-style s-expressions."""
+        tokens = re.findall(r"\(|\)|[^\s()]+", s)
+        pos = [0]
+
+        def rec() -> "Tree":
+            if tokens[pos[0]] != "(":
+                raise ValueError(f"expected '(' at {pos[0]}")
+            pos[0] += 1
+            label = int(tokens[pos[0]])
+            pos[0] += 1
+            if tokens[pos[0]] == "(":
+                left = rec()
+                right = rec()
+                node = Tree(label=label, left=left, right=right)
+            else:
+                node = Tree(label=label, word=tokens[pos[0]])
+                pos[0] += 1
+            if tokens[pos[0]] != ")":
+                raise ValueError(f"expected ')' at {pos[0]}")
+            pos[0] += 1
+            return node
+
+        out = rec()
+        if pos[0] != len(tokens):
+            raise ValueError("trailing tokens in tree string")
+        return out
+
+    def nodes(self) -> List["Tree"]:
+        """Post-order traversal (children before parents)."""
+        out: List[Tree] = []
+
+        def walk(t: "Tree"):
+            if t.left is not None:
+                walk(t.left)
+                walk(t.right)
+            out.append(t)
+
+        walk(self)
+        return out
+
+    def leaves(self) -> List["Tree"]:
+        return [n for n in self.nodes() if n.is_leaf()]
+
+
+# ---------------------------------------------------------------------------
+# linearization: tree -> fixed arrays
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Program:
+    """One tree as a static array program of length max_nodes."""
+
+    word_ids: np.ndarray    # [max_nodes] leaf word index (0 if internal)
+    left: np.ndarray        # [max_nodes] child slot (0 if leaf)
+    right: np.ndarray       # [max_nodes]
+    is_leaf: np.ndarray     # [max_nodes] 1.0/0.0
+    labels: np.ndarray      # [max_nodes] int
+    mask: np.ndarray        # [max_nodes] 1.0 for real nodes
+    root: int               # slot index of the root
+
+
+def linearize(tree: Tree, vocab: dict, max_nodes: int) -> _Program:
+    nodes = tree.nodes()
+    if len(nodes) > max_nodes:
+        raise ValueError(
+            f"tree has {len(nodes)} nodes > max_nodes={max_nodes}")
+    slot = {id(n): i for i, n in enumerate(nodes)}
+    p = _Program(
+        word_ids=np.zeros(max_nodes, np.int32),
+        left=np.zeros(max_nodes, np.int32),
+        right=np.zeros(max_nodes, np.int32),
+        is_leaf=np.zeros(max_nodes, np.float32),
+        labels=np.zeros(max_nodes, np.int32),
+        mask=np.zeros(max_nodes, np.float32),
+        root=len(nodes) - 1,
+    )
+    for i, n in enumerate(nodes):
+        p.labels[i] = n.label
+        p.mask[i] = 1.0
+        if n.is_leaf():
+            p.is_leaf[i] = 1.0
+            p.word_ids[i] = vocab.get(n.word, 0)  # 0 = UNK
+        else:
+            p.left[i] = slot[id(n.left)]
+            p.right[i] = slot[id(n.right)]
+    return p
+
+
+def _stack(programs: Sequence[_Program]):
+    import jax.numpy as jnp
+
+    return {
+        "word_ids": jnp.asarray(np.stack([p.word_ids for p in programs])),
+        "left": jnp.asarray(np.stack([p.left for p in programs])),
+        "right": jnp.asarray(np.stack([p.right for p in programs])),
+        "is_leaf": jnp.asarray(np.stack([p.is_leaf for p in programs])),
+        "labels": jnp.asarray(np.stack([p.labels for p in programs])),
+        "mask": jnp.asarray(np.stack([p.mask for p in programs])),
+        "root": jnp.asarray(np.asarray([p.root for p in programs],
+                                       np.int32)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# model
+# ---------------------------------------------------------------------------
+
+class RNTN:
+    """Train/predict over labeled binary trees.
+
+    Parameters follow the reference defaults (RNTN.java Builder):
+    ``num_hidden`` = d (reference numHidden=25), AdaGrad learning rate,
+    parameter init ~ U(-1/sqrt(2d), 1/sqrt(2d)).
+    """
+
+    def __init__(self, vocab: Sequence[str], num_hidden: int = 25,
+                 num_classes: int = 5, max_nodes: int = 64,
+                 learning_rate: float = 0.1, seed: int = 123,
+                 param_smoothing: float = 1e-8):
+        import jax
+
+        self.vocab = {w: i + 1 for i, w in enumerate(vocab)}  # 0 = UNK
+        self.num_hidden = int(num_hidden)
+        self.num_classes = int(num_classes)
+        self.max_nodes = int(max_nodes)
+        self.learning_rate = float(learning_rate)
+        self.param_smoothing = float(param_smoothing)
+        d = self.num_hidden
+        v = len(self.vocab) + 1
+        key = jax.random.key(seed)
+        ks = jax.random.split(key, 5)
+        scale = 1.0 / np.sqrt(2.0 * d)
+
+        def unif(k, shape, s=scale):
+            return jax.random.uniform(k, shape, minval=-s, maxval=s,
+                                      dtype=np.float32)
+
+        self.params = {
+            "E": unif(ks[0], (v, d), 0.1),            # word embeddings
+            "W": unif(ks[1], (2 * d, d)),             # composition matrix
+            "b": np.zeros((d,), np.float32),
+            "V": unif(ks[2], (d, 2 * d, 2 * d)),      # tensor slices
+            "Ws": unif(ks[3], (d, self.num_classes)),  # classifier
+            "bs": np.zeros((self.num_classes,), np.float32),
+        }
+        import jax.numpy as jnp
+
+        self.params = {k: jnp.asarray(val) for k, val in
+                       self.params.items()}
+        self._adagrad = {k: jnp.zeros_like(val) for k, val in
+                        self.params.items()}
+        self._loss_grad = None
+        self._forward = None
+
+    # -- core computation ----------------------------------------------
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        d = self.num_hidden
+
+        def run_tree(params, prog):
+            """Returns (node_vectors [max_nodes, d], logits, loss)."""
+            buf0 = jnp.zeros((self.max_nodes, d), jnp.float32)
+
+            def step(buf, idx):
+                leaf_vec = params["E"][prog["word_ids"][idx]]
+                a = buf[prog["left"][idx]]
+                bvec = buf[prog["right"][idx]]
+                x = jnp.concatenate([a, bvec])                 # [2d]
+                tensor = jnp.einsum("i,dij,j->d", x, params["V"], x)
+                comp = jnp.tanh(x @ params["W"] + params["b"] + tensor)
+                vec = jnp.where(prog["is_leaf"][idx] > 0, leaf_vec, comp)
+                buf = lax.dynamic_update_slice(buf, vec[None, :],
+                                               (idx, 0))
+                return buf, None
+
+            buf, _ = lax.scan(step, buf0,
+                              jnp.arange(self.max_nodes, dtype=jnp.int32))
+            logits = buf @ params["Ws"] + params["bs"]   # [max_nodes, C]
+            logp = jax.nn.log_softmax(logits)
+            node_nll = -logp[jnp.arange(self.max_nodes), prog["labels"]]
+            loss = jnp.sum(node_nll * prog["mask"])
+            return buf, logits, loss
+
+        def batch_loss(params, batch):
+            def one(word_ids, left, right, is_leaf, labels, mask, root):
+                prog = {"word_ids": word_ids, "left": left, "right": right,
+                        "is_leaf": is_leaf, "labels": labels, "mask": mask}
+                _, _, loss = run_tree(params, prog)
+                return loss
+
+            losses = jax.vmap(one)(
+                batch["word_ids"], batch["left"], batch["right"],
+                batch["is_leaf"], batch["labels"], batch["mask"],
+                batch["root"])
+            return jnp.sum(losses) / jnp.maximum(
+                jnp.sum(batch["mask"]), 1.0)
+
+        self._loss_grad = jax.jit(jax.value_and_grad(batch_loss))
+
+        def forward(params, batch):
+            def one(word_ids, left, right, is_leaf, labels, mask, root):
+                prog = {"word_ids": word_ids, "left": left, "right": right,
+                        "is_leaf": is_leaf, "labels": labels, "mask": mask}
+                _, logits, _ = run_tree(params, prog)
+                return logits
+
+            return jax.vmap(one)(
+                batch["word_ids"], batch["left"], batch["right"],
+                batch["is_leaf"], batch["labels"], batch["mask"],
+                batch["root"])
+
+        self._forward = jax.jit(forward)
+
+    # -- training -------------------------------------------------------
+    def fit(self, trees: Sequence[Tree], num_epochs: int = 1,
+            batch_size: int = 32) -> List[float]:
+        """AdaGrad over tree batches (the reference's own AdaGrad update,
+        RNTN.java getValueGradient/updateAdaGrad). Returns epoch losses."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._loss_grad is None:
+            self._build_fns()
+        programs = [linearize(t, self.vocab, self.max_nodes)
+                    for t in trees]
+        losses = []
+        for _ in range(num_epochs):
+            total = 0.0
+            for i in range(0, len(programs), batch_size):
+                batch = _stack(programs[i:i + batch_size])
+                loss, grads = self._loss_grad(self.params, batch)
+                total += float(loss)
+                # AdaGrad: g2 += g²; p -= lr * g / (sqrt(g2) + eps)
+                for k in self.params:
+                    self._adagrad[k] = self._adagrad[k] + grads[k] ** 2
+                    self.params[k] = self.params[k] - (
+                        self.learning_rate * grads[k]
+                        / (jnp.sqrt(self._adagrad[k])
+                           + self.param_smoothing))
+            losses.append(total)
+        return losses
+
+    # -- inference ------------------------------------------------------
+    def predict(self, tree: Tree) -> np.ndarray:
+        """Per-node predicted class, post-order (root last)."""
+        if self._forward is None:
+            self._build_fns()
+        prog = linearize(tree, self.vocab, self.max_nodes)
+        logits = np.asarray(self._forward(self.params, _stack([prog]))[0])
+        n = len(tree.nodes())
+        return logits[:n].argmax(axis=-1)
+
+    def predict_root(self, tree: Tree) -> int:
+        return int(self.predict(tree)[-1])
+
+
+class RNTNEval:
+    """Node-level and root-level accuracy (reference RNTNEval.java)."""
+
+    def __init__(self) -> None:
+        self.node_correct = 0
+        self.node_total = 0
+        self.root_correct = 0
+        self.root_total = 0
+
+    def eval(self, model: RNTN, trees: Sequence[Tree]) -> None:
+        for t in trees:
+            preds = model.predict(t)
+            labels = np.asarray([n.label for n in t.nodes()])
+            self.node_correct += int((preds == labels).sum())
+            self.node_total += len(labels)
+            self.root_correct += int(preds[-1] == labels[-1])
+            self.root_total += 1
+
+    def node_accuracy(self) -> float:
+        return self.node_correct / max(1, self.node_total)
+
+    def root_accuracy(self) -> float:
+        return self.root_correct / max(1, self.root_total)
+
+    def stats(self) -> str:
+        return (f"RNTN eval: node acc {self.node_accuracy():.4f} "
+                f"({self.node_correct}/{self.node_total}), root acc "
+                f"{self.root_accuracy():.4f} "
+                f"({self.root_correct}/{self.root_total})")

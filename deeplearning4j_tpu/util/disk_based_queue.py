@@ -1,0 +1,73 @@
+"""Disk-spilling FIFO queue (mirror of reference util/DiskBasedQueue.java).
+
+Items beyond ``memory_capacity`` are pickled to per-item files in a
+spill directory and transparently re-hydrated on dequeue; used by data
+pipelines whose working set exceeds host RAM. Thread-safe.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+import uuid
+from collections import deque
+from typing import Any, Optional
+
+
+class DiskBasedQueue:
+    def __init__(self, directory: Optional[str] = None,
+                 memory_capacity: int = 1000):
+        self._dir = directory or tempfile.mkdtemp(prefix="dl4j_queue_")
+        self._own_dir = directory is None
+        os.makedirs(self._dir, exist_ok=True)
+        self.memory_capacity = memory_capacity
+        self._lock = threading.Lock()
+        # FIFO of entries: ("mem", obj) or ("disk", path)
+        self._entries: deque = deque()
+        self._in_memory = 0
+
+    def add(self, item: Any) -> None:
+        with self._lock:
+            if self._in_memory < self.memory_capacity:
+                self._entries.append(("mem", item))
+                self._in_memory += 1
+            else:
+                path = os.path.join(self._dir, uuid.uuid4().hex + ".pkl")
+                with open(path, "wb") as f:
+                    pickle.dump(item, f)
+                self._entries.append(("disk", path))
+
+    def poll(self) -> Optional[Any]:
+        """Dequeue head or None if empty."""
+        with self._lock:
+            if not self._entries:
+                return None
+            kind, payload = self._entries.popleft()
+            if kind == "mem":
+                self._in_memory -= 1
+                return payload
+            with open(payload, "rb") as f:
+                item = pickle.load(f)
+            os.unlink(payload)
+            return item
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def is_empty(self) -> bool:
+        return len(self) == 0
+
+    def close(self) -> None:
+        """Drop remaining items and the spill dir (if owned)."""
+        with self._lock:
+            for kind, payload in self._entries:
+                if kind == "disk" and os.path.exists(payload):
+                    os.unlink(payload)
+            self._entries.clear()
+            self._in_memory = 0
+        if self._own_dir and os.path.isdir(self._dir):
+            shutil.rmtree(self._dir, ignore_errors=True)

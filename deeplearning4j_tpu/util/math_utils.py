@@ -1,0 +1,83 @@
+"""Scalar/stat helpers (capability mirror of reference util/MathUtils.java).
+
+Only the members with semantics beyond plain numpy are kept; callers
+use numpy directly for elementwise work (the reference predates that
+option on the JVM).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def entropy(probs: Sequence[float]) -> float:
+    """Shannon entropy in nats of a (possibly unnormalized) histogram."""
+    p = np.asarray(probs, np.float64)
+    p = p[p > 0]
+    p = p / p.sum()
+    return float(-(p * np.log(p)).sum())
+
+
+def information_gain(labels: Sequence[int],
+                     split: Sequence[int]) -> float:
+    """Entropy(labels) - Σ_v p(split=v) * Entropy(labels | split=v)."""
+    labels = np.asarray(labels)
+    split = np.asarray(split)
+    base = entropy(np.bincount(labels))
+    cond = 0.0
+    for v in np.unique(split):
+        sel = labels[split == v]
+        cond += (len(sel) / len(labels)) * entropy(np.bincount(sel))
+    return base - cond
+
+
+def euclidean_distance(a, b) -> float:
+    return float(np.linalg.norm(np.asarray(a, np.float64)
+                                - np.asarray(b, np.float64)))
+
+
+def manhattan_distance(a, b) -> float:
+    return float(np.abs(np.asarray(a, np.float64)
+                        - np.asarray(b, np.float64)).sum())
+
+
+def correlation(a, b) -> float:
+    """Pearson correlation coefficient."""
+    return float(np.corrcoef(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64))[0, 1])
+
+
+def normalize(values, new_min: float = 0.0,
+              new_max: float = 1.0) -> np.ndarray:
+    """Min-max rescale to [new_min, new_max]; constant input maps to
+    new_min (reference MathUtils.normalize)."""
+    v = np.asarray(values, np.float64)
+    span = v.max() - v.min()
+    if span == 0:
+        return np.full_like(v, new_min)
+    return (v - v.min()) / span * (new_max - new_min) + new_min
+
+
+def next_power_of_2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (int(n - 1).bit_length())
+
+
+def roulette_wheel(weights, rng: Optional[np.random.Generator] = None) -> int:
+    """Fitness-proportional random index selection."""
+    w = np.asarray(weights, np.float64)
+    if (w < 0).any() or w.sum() <= 0:
+        raise ValueError("weights must be non-negative with positive sum")
+    rng = rng or np.random.default_rng()
+    return int(rng.choice(len(w), p=w / w.sum()))
+
+
+def discretize(value: float, lo: float, hi: float, bins: int) -> int:
+    """Map a value in [lo, hi] to a bin index in [0, bins)."""
+    if hi <= lo:
+        raise ValueError("hi must exceed lo")
+    frac = (min(max(value, lo), hi) - lo) / (hi - lo)
+    return min(int(frac * bins), bins - 1)

@@ -1,0 +1,62 @@
+"""Viterbi decoding for label-sequence smoothing.
+
+Mirror of reference util/Viterbi.java: an HMM decode over a noisy
+sequence of observed labels, with a self-transition-favoring chain
+(``metastability`` on the diagonal) and an emission model where the
+observed label equals the true state with probability ``p_correct``.
+Used to clean up per-timestep classifier outputs. Also exposes the
+general log-space decode for arbitrary transition/emission matrices.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def viterbi_decode(log_init: np.ndarray, log_trans: np.ndarray,
+                   log_emit: np.ndarray) -> Tuple[float, np.ndarray]:
+    """General Viterbi: ``log_init`` [S], ``log_trans`` [S, S] (from→to),
+    ``log_emit`` [T, S] per-step observation log-likelihoods. Returns
+    (best path log-prob, state sequence [T])."""
+    T, S = log_emit.shape
+    delta = log_init + log_emit[0]
+    back = np.zeros((T, S), np.int64)
+    for t in range(1, T):
+        # scores[i, j] = delta[i] + log_trans[i, j]
+        scores = delta[:, None] + log_trans
+        back[t] = scores.argmax(axis=0)
+        delta = scores.max(axis=0) + log_emit[t]
+    path = np.zeros(T, np.int64)
+    path[-1] = int(delta.argmax())
+    for t in range(T - 2, -1, -1):
+        path[t] = back[t + 1, path[t + 1]]
+    return float(delta.max()), path
+
+
+class Viterbi:
+    """Label-sequence smoother (reference util/Viterbi.java semantics:
+    sticky self-transitions + mostly-correct observations)."""
+
+    def __init__(self, num_states: int, meta_stability: float = 0.9,
+                 p_correct: float = 0.99):
+        if num_states < 2:
+            raise ValueError("need >= 2 states")
+        self.num_states = num_states
+        s = num_states
+        off_t = (1.0 - meta_stability) / (s - 1)
+        self.log_trans = np.full((s, s), np.log(off_t))
+        np.fill_diagonal(self.log_trans, np.log(meta_stability))
+        off_e = (1.0 - p_correct) / (s - 1)
+        self._log_emit_correct = np.log(p_correct)
+        self._log_emit_wrong = np.log(off_e)
+        self.log_init = np.full(s, -np.log(s))
+
+    def decode(self, observed: Sequence[int]) -> Tuple[float, np.ndarray]:
+        """Observed label sequence → (log-prob, smoothed sequence)."""
+        obs = np.asarray(observed, np.int64)
+        T = len(obs)
+        log_emit = np.full((T, self.num_states), self._log_emit_wrong)
+        log_emit[np.arange(T), obs] = self._log_emit_correct
+        return viterbi_decode(self.log_init, self.log_trans, log_emit)

@@ -62,6 +62,31 @@ class TestRecordReaderDataSetIterator:
             total += nxt.num_examples()
         assert total == 30
 
+    def test_feature_only_mode_has_none_labels(self, iris_like_csv):
+        it = RecordReaderDataSetIterator(
+            CSVRecordReader(iris_like_csv), batch_size=8, label_index=None)
+        ds = it.next()
+        assert ds.labels is None
+        assert ds.features.shape == (8, 4)
+
+    def test_empty_reader_raises_clearly(self, tmp_path):
+        p = tmp_path / "empty.csv"
+        p.write_text("# only a comment\n")
+        with pytest.raises(ValueError, match="no records"):
+            RecordReaderDataSetIterator(CSVRecordReader(str(p)), 4)
+
+    def test_negative_sequence_label_raises(self, tmp_path):
+        fp = tmp_path / "f.csv"
+        lp = tmp_path / "l.csv"
+        fp.write_text("1.0,2.0\n3.0,4.0")
+        lp.write_text("-1\n1")
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader([str(fp)]),
+            CSVSequenceRecordReader([str(lp)]), batch_size=1,
+            num_classes=3)
+        with pytest.raises(ValueError, match="label outside"):
+            it.next()
+
     def test_label_index_out_of_range_raises(self, iris_like_csv):
         with pytest.raises(ValueError, match="label_index"):
             RecordReaderDataSetIterator(

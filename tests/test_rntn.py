@@ -1,0 +1,101 @@
+"""RNTN tests (reference nlp RNTN.java / RNTNEval) — tiny real trees,
+overfit check, tree parsing/linearization contracts."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.rntn import RNTN, RNTNEval, Tree, linearize
+
+
+class TestTree:
+    def test_parse_and_structure(self):
+        t = Tree.parse("(3 (1 very) (2 (1 good) (0 movie)))")
+        assert t.label == 3 and not t.is_leaf()
+        assert t.left.word == "very" and t.left.label == 1
+        assert t.right.right.word == "movie"
+        # post-order: children before parents, root last
+        nodes = t.nodes()
+        assert [n.word for n in nodes] == ["very", "good", "movie",
+                                           None, None]
+        assert nodes[-1] is t
+        assert len(t.leaves()) == 3
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            Tree.parse("(1 (2 a) (3 b)) trailing")
+
+    def test_linearize_slots(self):
+        t = Tree.parse("(2 (0 bad) (1 film))")
+        prog = linearize(t, {"bad": 1, "film": 2}, max_nodes=8)
+        np.testing.assert_array_equal(prog.is_leaf[:3], [1, 1, 0])
+        assert prog.word_ids[0] == 1 and prog.word_ids[1] == 2
+        assert prog.left[2] == 0 and prog.right[2] == 1
+        assert prog.root == 2
+        assert prog.mask.sum() == 3
+
+    def test_linearize_unknown_word_maps_to_unk(self):
+        t = Tree.parse("(1 unknownword)")
+        prog = linearize(t, {"known": 1}, max_nodes=4)
+        assert prog.word_ids[0] == 0
+
+    def test_too_many_nodes_raises(self):
+        t = Tree.parse("(1 (1 a) (1 b))")
+        with pytest.raises(ValueError):
+            linearize(t, {}, max_nodes=2)
+
+
+def _toy_corpus():
+    """Sentiment toy: label 1 iff 'good' in the tree, with per-node
+    labels consistent (leaves neutral=label of subtree)."""
+    pos = ["good", "great", "fine"]
+    neg = ["bad", "awful", "poor"]
+    nouns = ["movie", "film", "plot"]
+    trees = []
+    for adj_list, lbl in ((pos, 1), (neg, 0)):
+        for adj in adj_list:
+            for noun in nouns:
+                trees.append(Tree.parse(
+                    f"({lbl} ({lbl} {adj}) ({lbl} {noun}))"))
+    vocab = sorted(set(pos + neg + nouns))
+    return trees, vocab
+
+
+class TestRNTNTraining:
+    def test_overfits_toy_sentiment(self):
+        trees, vocab = _toy_corpus()
+        model = RNTN(vocab, num_hidden=8, num_classes=2, max_nodes=8,
+                     learning_rate=0.5, seed=7)
+        losses = model.fit(trees, num_epochs=30, batch_size=18)
+        assert losses[-1] < losses[0] * 0.5
+        ev = RNTNEval()
+        ev.eval(model, trees)
+        assert ev.root_accuracy() > 0.9
+        assert ev.node_accuracy() > 0.8
+        assert "root acc" in ev.stats()
+
+    def test_predict_shapes_and_root(self):
+        trees, vocab = _toy_corpus()
+        model = RNTN(vocab, num_hidden=4, num_classes=2, max_nodes=8,
+                     seed=1)
+        preds = model.predict(trees[0])
+        assert preds.shape == (3,)  # one class per node, post-order
+        assert model.predict_root(trees[0]) in (0, 1)
+
+    def test_deterministic_by_seed(self):
+        trees, vocab = _toy_corpus()
+        a = RNTN(vocab, num_hidden=4, num_classes=2, max_nodes=8, seed=3)
+        b = RNTN(vocab, num_hidden=4, num_classes=2, max_nodes=8, seed=3)
+        a.fit(trees[:6], num_epochs=2, batch_size=6)
+        b.fit(trees[:6], num_epochs=2, batch_size=6)
+        np.testing.assert_allclose(np.asarray(a.params["W"]),
+                                   np.asarray(b.params["W"]), atol=1e-6)
+
+    def test_deep_tree(self):
+        # unbalanced 4-leaf tree exercises multi-level composition
+        t = Tree.parse(
+            "(1 (1 (1 (0 not) (1 bad)) (1 at)) (1 all))")
+        model = RNTN(["not", "bad", "at", "all"], num_hidden=4,
+                     num_classes=2, max_nodes=16, seed=2)
+        losses = model.fit([t] * 4, num_epochs=20, batch_size=4)
+        assert losses[-1] < losses[0]
+        assert model.predict(t).shape == (7,)

@@ -1,0 +1,153 @@
+"""Misc util tests: Viterbi smoothing, MathUtils, DiskBasedQueue
+(reference util/{Viterbi,MathUtils,DiskBasedQueue}.java)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util.disk_based_queue import DiskBasedQueue
+from deeplearning4j_tpu.util.math_utils import (
+    correlation,
+    discretize,
+    entropy,
+    euclidean_distance,
+    information_gain,
+    manhattan_distance,
+    next_power_of_2,
+    normalize,
+    roulette_wheel,
+)
+from deeplearning4j_tpu.util.viterbi import Viterbi, viterbi_decode
+
+
+class TestViterbi:
+    def test_smooths_isolated_flips(self):
+        # a sticky chain with noisier emissions removes single-step
+        # label glitches (p_correct=0.99 would trust the observations)
+        observed = [0, 0, 0, 1, 0, 0, 1, 1, 1, 1, 0, 1, 1]
+        _, path = Viterbi(num_states=2, meta_stability=0.95,
+                          p_correct=0.9).decode(observed)
+        np.testing.assert_array_equal(
+            path, [0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1, 1])
+
+    def test_trusting_emissions_keeps_observations(self):
+        observed = [0, 0, 1, 0, 0]
+        _, path = Viterbi(num_states=2, meta_stability=0.9,
+                          p_correct=0.99).decode(observed)
+        np.testing.assert_array_equal(path, observed)
+
+    def test_clean_sequence_unchanged(self):
+        observed = [0, 0, 1, 1, 1, 2, 2]
+        _, path = Viterbi(num_states=3).decode(observed)
+        np.testing.assert_array_equal(path, observed)
+
+    def test_general_decode_prefers_likely_path(self):
+        # 2 states; emissions strongly favor state 1 at every step
+        log_init = np.log([0.5, 0.5])
+        log_trans = np.log([[0.5, 0.5], [0.5, 0.5]])
+        log_emit = np.log(np.array([[0.1, 0.9]] * 4))
+        score, path = viterbi_decode(log_init, log_trans, log_emit)
+        np.testing.assert_array_equal(path, [1, 1, 1, 1])
+        assert score < 0
+
+    def test_single_state_rejected(self):
+        with pytest.raises(ValueError):
+            Viterbi(num_states=1)
+
+
+class TestMathUtils:
+    def test_entropy(self):
+        assert entropy([1, 1]) == pytest.approx(np.log(2))
+        assert entropy([1, 0]) == pytest.approx(0.0)
+
+    def test_information_gain_perfect_split(self):
+        labels = [0, 0, 1, 1]
+        split = [0, 0, 1, 1]
+        assert information_gain(labels, split) == pytest.approx(np.log(2))
+        assert information_gain(labels, [0, 1, 0, 1]) == pytest.approx(0.0)
+
+    def test_distances(self):
+        assert euclidean_distance([0, 0], [3, 4]) == pytest.approx(5.0)
+        assert manhattan_distance([0, 0], [3, 4]) == pytest.approx(7.0)
+
+    def test_correlation(self):
+        x = np.arange(10.0)
+        assert correlation(x, 2 * x + 1) == pytest.approx(1.0)
+        assert correlation(x, -x) == pytest.approx(-1.0)
+
+    def test_normalize(self):
+        out = normalize([0, 5, 10], 0, 1)
+        np.testing.assert_allclose(out, [0, 0.5, 1.0])
+        np.testing.assert_allclose(normalize([3, 3, 3], 2, 4), [2, 2, 2])
+
+    def test_next_power_of_2(self):
+        assert [next_power_of_2(n) for n in (1, 2, 3, 8, 9)] == \
+            [1, 2, 4, 8, 16]
+
+    def test_roulette_wheel_distribution(self):
+        rng = np.random.default_rng(0)
+        picks = [roulette_wheel([1, 0, 9], rng) for _ in range(500)]
+        assert 1 not in picks
+        assert np.mean(np.asarray(picks) == 2) > 0.8
+
+    def test_discretize(self):
+        assert discretize(0.0, 0, 1, 4) == 0
+        assert discretize(0.99, 0, 1, 4) == 3
+        assert discretize(2.0, 0, 1, 4) == 3  # clamped
+
+
+class TestDiskBasedQueue:
+    def test_fifo_through_spill(self, tmp_path):
+        q = DiskBasedQueue(str(tmp_path), memory_capacity=2)
+        for i in range(6):
+            q.add({"i": i, "arr": np.full(3, i)})
+        assert len(q) == 6
+        # items 2..5 spilled to disk
+        assert len(os.listdir(tmp_path)) == 4
+        got = [q.poll()["i"] for _ in range(6)]
+        assert got == list(range(6))
+        assert q.poll() is None
+        assert len(os.listdir(tmp_path)) == 0
+
+    def test_threaded_producers_consumers(self, tmp_path):
+        q = DiskBasedQueue(str(tmp_path), memory_capacity=5)
+        seen = []
+        lock = threading.Lock()
+
+        def produce(start):
+            for i in range(start, start + 25):
+                q.add(i)
+
+        def consume():
+            while True:
+                v = q.poll()
+                if v is None:
+                    if not producers_alive():
+                        return
+                    continue
+                with lock:
+                    seen.append(v)
+
+        producers = [threading.Thread(target=produce, args=(s,))
+                     for s in (0, 100)]
+
+        def producers_alive():
+            return any(p.is_alive() for p in producers)
+
+        consumers = [threading.Thread(target=consume) for _ in range(2)]
+        for t in producers + consumers:
+            t.start()
+        for t in producers + consumers:
+            t.join(timeout=10.0)
+        assert sorted(seen) == sorted(list(range(25))
+                                      + list(range(100, 125)))
+
+    def test_close_cleans_owned_dir(self):
+        q = DiskBasedQueue(memory_capacity=0)
+        q.add("x")
+        d = q._dir
+        assert os.path.isdir(d)
+        q.close()
+        assert not os.path.isdir(d)
