@@ -67,7 +67,7 @@ class DataSet:
         sl = slice(start, end)
         return DataSet(
             self.features[sl],
-            self.labels[sl],
+            None if self.labels is None else self.labels[sl],
             None if self.features_mask is None else self.features_mask[sl],
             None if self.labels_mask is None else self.labels_mask[sl],
         )
@@ -80,7 +80,7 @@ class DataSet:
     def get_examples(self, idx) -> "DataSet":
         return DataSet(
             self.features[idx],
-            self.labels[idx],
+            None if self.labels is None else self.labels[idx],
             None if self.features_mask is None else self.features_mask[idx],
             None if self.labels_mask is None else self.labels_mask[idx],
         )
@@ -89,7 +89,8 @@ class DataSet:
         rng = np.random.default_rng(seed)
         idx = rng.permutation(self.num_examples())
         self.features = self.features[idx]
-        self.labels = self.labels[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
         if self.features_mask is not None:
             self.features_mask = self.features_mask[idx]
         if self.labels_mask is not None:
@@ -112,7 +113,7 @@ class DataSet:
         self.features = (self.features - mu) / sd
 
     def __repr__(self) -> str:
+        labels = None if self.labels is None else self.labels.shape
         return (
-            f"DataSet(features={self.features.shape}, "
-            f"labels={self.labels.shape})"
+            f"DataSet(features={self.features.shape}, labels={labels})"
         )

@@ -1,0 +1,32 @@
+"""ML pipeline API: Estimator / Transformer / Pipeline.
+
+Mirror of the reference dl4j-spark-ml Scala module (SURVEY.md §2.7.7 —
+MultiLayerNetworkClassification.scala:46, MultiLayerNetworkReconstruction,
+ParameterAveragingTrainingStrategy): the Spark-ML Estimator/Transformer
+pattern over DataSets instead of DataFrames, with the training strategy
+pluggable (single-chip fit or the mesh data-parallel trainer).
+"""
+
+from deeplearning4j_tpu.ml.pipeline import (
+    Estimator,
+    MinMaxScaler,
+    NeuralNetworkClassification,
+    NeuralNetworkClassificationModel,
+    NeuralNetworkReconstruction,
+    NeuralNetworkReconstructionModel,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+)
+
+__all__ = [
+    "Estimator",
+    "MinMaxScaler",
+    "NeuralNetworkClassification",
+    "NeuralNetworkClassificationModel",
+    "NeuralNetworkReconstruction",
+    "NeuralNetworkReconstructionModel",
+    "Pipeline",
+    "PipelineModel",
+    "Transformer",
+]

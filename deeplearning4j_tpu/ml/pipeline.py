@@ -1,0 +1,201 @@
+"""Estimator/Transformer pipeline over DataSets.
+
+Reference parity (dl4j-spark-ml, SURVEY.md §2.7.7):
+- ``NeuralNetworkClassification`` ≙ MultiLayerNetworkClassification.scala
+  :46 (train :77): fit a MultiLayerNetwork from a conf, yielding a model
+  Transformer that appends predictions.
+- ``NeuralNetworkReconstruction`` ≙ MultiLayerNetworkReconstruction:
+  unsupervised fit; transform yields layer-activations (codes).
+- ``Pipeline``/``PipelineModel`` ≙ Spark ML Pipeline: stages fit in
+  order, each transforming the data for the next.
+- The training strategy object (ParameterAveragingTrainingStrategy) maps
+  to the ``trainer`` hook: default local fit; pass a ParallelTrainer
+  factory to train data-parallel over a mesh (parallel/data_parallel.py).
+
+Transformers return NEW DataSet objects; inputs are never mutated.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class Transformer:
+    """transform(DataSet) -> DataSet."""
+
+    def transform(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+
+class Estimator:
+    """fit(DataSet) -> Transformer (the fitted model)."""
+
+    def fit(self, ds: DataSet) -> Transformer:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# feature transformers
+# ---------------------------------------------------------------------------
+
+class MinMaxScaler(Estimator, Transformer):
+    """Column-wise min-max scaling; Estimator AND Transformer (fit learns
+    bounds, transform applies them) like Spark ML feature scalers."""
+
+    def __init__(self) -> None:
+        self._min: Optional[np.ndarray] = None
+        self._span: Optional[np.ndarray] = None
+
+    def fit(self, ds: DataSet) -> "MinMaxScaler":
+        feats = np.asarray(ds.features, np.float64)
+        self._min = feats.min(axis=0)
+        span = feats.max(axis=0) - self._min
+        self._span = np.where(span == 0, 1.0, span)
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        if self._min is None:
+            raise RuntimeError("MinMaxScaler.transform before fit")
+        feats = (np.asarray(ds.features, np.float64) - self._min) \
+            / self._span
+        return DataSet(feats.astype(np.float32), ds.labels,
+                       features_mask=ds.features_mask,
+                       labels_mask=ds.labels_mask)
+
+
+# ---------------------------------------------------------------------------
+# network estimators
+# ---------------------------------------------------------------------------
+
+def _default_trainer(net, ds: DataSet, epochs: int, batch_size: int):
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+
+    n = ds.num_examples()
+    b = batch_size or n
+    sets = [ds.get_range(i, min(i + b, n)) for i in range(0, n, b)]
+    for _ in range(epochs):
+        net.fit(ListDataSetIterator(sets))
+    return net
+
+
+class NeuralNetworkClassification(Estimator):
+    """Fit a classifier network from a MultiLayerConfiguration
+    (reference MultiLayerNetworkClassification.train :77 — conf JSON is
+    the wire format; the training strategy is pluggable)."""
+
+    def __init__(self, conf, epochs: int = 1, batch_size: int = 0,
+                 trainer: Optional[Callable] = None):
+        self.conf = conf
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.trainer = trainer or _default_trainer
+
+    def fit(self, ds: DataSet) -> "NeuralNetworkClassificationModel":
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(self.conf.clone()).init()
+        net = self.trainer(net, ds, self.epochs, self.batch_size)
+        return NeuralNetworkClassificationModel(net)
+
+
+class NeuralNetworkClassificationModel(Transformer):
+    """Appends argmax predictions; probabilities via ``predict_proba``."""
+
+    def __init__(self, network):
+        self.network = network
+
+    def transform(self, ds: DataSet) -> DataSet:
+        preds = self.network.predict(np.asarray(ds.features))
+        out = DataSet(ds.features, ds.labels,
+                      features_mask=ds.features_mask,
+                      labels_mask=ds.labels_mask)
+        out.predictions = np.asarray(preds)
+        return out
+
+    def predict_proba(self, features) -> np.ndarray:
+        return np.asarray(self.network.output(np.asarray(features)))
+
+
+class NeuralNetworkReconstruction(Estimator):
+    """Unsupervised fit (labels ignored; pretrain path when the conf
+    requests it); transform yields the chosen layer's activations
+    (reference MultiLayerNetworkReconstruction)."""
+
+    def __init__(self, conf, epochs: int = 1, batch_size: int = 0,
+                 layer_index: int = -1,
+                 trainer: Optional[Callable] = None):
+        self.conf = conf
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.layer_index = layer_index
+        self.trainer = trainer or _default_trainer
+
+    def fit(self, ds: DataSet) -> "NeuralNetworkReconstructionModel":
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(self.conf.clone()).init()
+        feats = np.asarray(ds.features)
+        target = (np.asarray(ds.labels)
+                  if ds.labels is not None else feats)
+        net = self.trainer(net, DataSet(feats, target), self.epochs,
+                           self.batch_size)
+        return NeuralNetworkReconstructionModel(net, self.layer_index)
+
+
+class NeuralNetworkReconstructionModel(Transformer):
+    def __init__(self, network, layer_index: int = -1):
+        self.network = network
+        self.layer_index = layer_index
+
+    def transform(self, ds: DataSet) -> DataSet:
+        acts = self.network.feed_forward(np.asarray(ds.features),
+                                         train=False)
+        code = np.asarray(acts[self.layer_index])
+        out = DataSet(ds.features, ds.labels,
+                      features_mask=ds.features_mask,
+                      labels_mask=ds.labels_mask)
+        out.reconstruction = code
+        return out
+
+
+# ---------------------------------------------------------------------------
+# pipeline
+# ---------------------------------------------------------------------------
+
+class Pipeline(Estimator):
+    """Sequential stages; Estimators are fit on the running transform of
+    the data, Transformers pass through (Spark ML Pipeline semantics)."""
+
+    def __init__(self, stages: Sequence):
+        self.stages = list(stages)
+
+    def fit(self, ds: DataSet) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        current = ds
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+            elif isinstance(stage, Transformer):
+                model = stage
+            else:
+                raise TypeError(f"stage {stage!r} is neither Estimator "
+                                "nor Transformer")
+            fitted.append(model)
+            if i < len(self.stages) - 1:  # last stage's transform is
+                current = model.transform(current)  # only needed downstream
+        return PipelineModel(fitted)
+
+
+class PipelineModel(Transformer):
+    def __init__(self, stages: Sequence[Transformer]):
+        self.stages = list(stages)
+
+    def transform(self, ds: DataSet) -> DataSet:
+        current = ds
+        for stage in self.stages:
+            current = stage.transform(current)
+        return current

@@ -272,11 +272,13 @@ class RNTN:
             self._build_fns()
         programs = [linearize(t, self.vocab, self.max_nodes)
                     for t in trees]
+        # stack + upload each batch ONCE, not once per epoch
+        batches = [_stack(programs[i:i + batch_size])
+                   for i in range(0, len(programs), batch_size)]
         losses = []
         for _ in range(num_epochs):
             total = 0.0
-            for i in range(0, len(programs), batch_size):
-                batch = _stack(programs[i:i + batch_size])
+            for batch in batches:
                 loss, grads = self._loss_grad(self.params, batch)
                 total += float(loss)
                 # AdaGrad: g2 += g²; p -= lr * g / (sqrt(g2) + eps)

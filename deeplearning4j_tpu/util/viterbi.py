@@ -56,6 +56,9 @@ class Viterbi:
     def decode(self, observed: Sequence[int]) -> Tuple[float, np.ndarray]:
         """Observed label sequence → (log-prob, smoothed sequence)."""
         obs = np.asarray(observed, np.int64)
+        if len(obs) and (obs.min() < 0 or obs.max() >= self.num_states):
+            raise ValueError(
+                f"observed labels outside [0, {self.num_states})")
         T = len(obs)
         log_emit = np.full((T, self.num_states), self._log_emit_wrong)
         log_emit[np.arange(T), obs] = self._log_emit_correct

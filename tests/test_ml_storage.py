@@ -1,0 +1,194 @@
+"""ML pipeline + storage backend tests (reference dl4j-spark-ml Scala
+module + aws/hadoop storage savers)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.ml import (
+    MinMaxScaler,
+    NeuralNetworkClassification,
+    NeuralNetworkReconstruction,
+    Pipeline,
+)
+from deeplearning4j_tpu.storage import (
+    LocalStorage,
+    S3Storage,
+    StorageModelSaver,
+    resolve_backend,
+)
+
+
+def _clf_conf(n_in=4, n_out=3):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    return (NeuralNetConfiguration.Builder().seed(5).learning_rate(0.1)
+            .list()
+            .layer(0, L.DenseLayer(n_in=n_in, n_out=16, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=16, n_out=n_out,
+                                    activation="softmax",
+                                    loss_function=LossFunction.MCXENT))
+            .build())
+
+
+def _iris_ds():
+    from deeplearning4j_tpu.datasets.iris import iris_dataset
+
+    return iris_dataset()
+
+
+class TestPipeline:
+    def test_classification_pipeline_learns_iris(self):
+        ds = _iris_ds()
+        pipe = Pipeline([
+            MinMaxScaler(),
+            NeuralNetworkClassification(_clf_conf(), epochs=60,
+                                        batch_size=50),
+        ])
+        model = pipe.fit(ds)
+        out = model.transform(ds)
+        truth = np.asarray(ds.labels).argmax(axis=1)
+        acc = float((out.predictions == truth).mean())
+        assert acc > 0.9
+        # input not mutated, features scaled into [0, 1]
+        assert np.asarray(ds.features).max() > 1.0
+        assert 0.0 <= np.asarray(out.features).min() \
+            and np.asarray(out.features).max() <= 1.0 + 1e-6
+
+    def test_scaler_constant_column(self):
+        ds = DataSet(np.array([[1.0, 5.0], [1.0, 7.0]]), None)
+        out = MinMaxScaler().fit(ds).transform(ds)
+        np.testing.assert_allclose(out.features[:, 0], [0.0, 0.0])
+        np.testing.assert_allclose(out.features[:, 1], [0.0, 1.0])
+
+    def test_scaler_before_fit_raises(self):
+        with pytest.raises(RuntimeError):
+            MinMaxScaler().transform(DataSet(np.zeros((2, 2)), None))
+
+    def test_reconstruction_pipeline_codes(self):
+        ds = _iris_ds()
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        conf = (NeuralNetConfiguration.Builder().seed(2).learning_rate(0.05)
+                .list()
+                .layer(0, L.DenseLayer(n_in=4, n_out=2, activation="tanh"))
+                .layer(1, L.OutputLayer(n_in=2, n_out=4,
+                                        activation="identity",
+                                        loss_function=LossFunction.MSE))
+                .build())
+        est = NeuralNetworkReconstruction(conf, epochs=5, batch_size=50,
+                                          layer_index=1)
+        feats_only = DataSet(ds.features, None)
+        model = est.fit(feats_only)
+        out = model.transform(feats_only)
+        assert out.reconstruction.shape == (150, 2)  # bottleneck codes
+
+    def test_bad_stage_type_raises(self):
+        with pytest.raises(TypeError):
+            Pipeline(["not a stage"]).fit(_iris_ds())
+
+    def test_fit_skips_final_stage_transform(self):
+        class CountingModel(NeuralNetworkClassification):
+            pass
+
+        from deeplearning4j_tpu.ml.pipeline import Transformer
+
+        class Spy(Transformer):
+            def __init__(self):
+                self.calls = 0
+
+            def transform(self, ds):
+                self.calls += 1
+                return ds
+
+        spy_mid, spy_last = Spy(), Spy()
+        Pipeline([spy_mid, spy_last]).fit(_iris_ds())
+        assert spy_mid.calls == 1   # feeds the next stage
+        assert spy_last.calls == 0  # final transform is deferred
+
+    def test_feature_only_dataset_api(self):
+        ds = DataSet(np.random.default_rng(0).normal(size=(10, 4)), None)
+        assert "labels=None" in repr(ds)
+        sub = ds.get_range(0, 4)
+        assert sub.labels is None and sub.num_examples() == 4
+        ds.shuffle(seed=1)
+        assert ds.sample(3).labels is None
+        train, test = ds.split_test_and_train(6)
+        assert train.num_examples() == 6 and test.labels is None
+
+    def test_pluggable_trainer_hook(self):
+        calls = []
+
+        def spy_trainer(net, ds, epochs, batch):
+            calls.append((epochs, batch))
+            return net
+
+        est = NeuralNetworkClassification(_clf_conf(), epochs=3,
+                                          batch_size=25,
+                                          trainer=spy_trainer)
+        est.fit(_iris_ds())
+        assert calls == [(3, 25)]
+
+
+class TestStorage:
+    def test_local_roundtrip(self, tmp_path):
+        store = LocalStorage(str(tmp_path / "store"))
+        src = tmp_path / "a.txt"
+        src.write_text("payload")
+        store.put(str(src), "models/a.txt")
+        assert store.exists("models/a.txt")
+        assert store.list("models/") == ["models/a.txt"]
+        dst = tmp_path / "back.txt"
+        store.get("models/a.txt", str(dst))
+        assert dst.read_text() == "payload"
+        store.delete("models/a.txt")
+        assert not store.exists("models/a.txt")
+
+    def test_init_does_not_mkdir(self, tmp_path):
+        root = tmp_path / "never" / "made"
+        LocalStorage(str(root))
+        assert not root.exists()  # only put() creates it
+        backend, _ = resolve_backend(str(root / "m.zip"))
+        assert not root.exists()
+
+    def test_key_escape_rejected(self, tmp_path):
+        store = LocalStorage(str(tmp_path / "store"))
+        with pytest.raises(ValueError):
+            store.put(__file__, "../escape.txt")
+
+    def test_missing_key_raises(self, tmp_path):
+        store = LocalStorage(str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            store.get("ghost", str(tmp_path / "out"))
+
+    def test_resolve_backend_local(self, tmp_path):
+        p = tmp_path / "m.zip"
+        backend, key = resolve_backend(str(p))
+        assert isinstance(backend, LocalStorage)
+        assert key == "m.zip"
+
+    def test_remote_backends_gated(self):
+        with pytest.raises(RuntimeError, match="boto3"):
+            S3Storage("bucket")
+        with pytest.raises(ValueError, match="scheme"):
+            resolve_backend("ftp://host/x")
+
+    def test_model_saver_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(_clf_conf()).init()
+        ds = _iris_ds()
+        net.fit(ds.get_range(0, 50))
+        saver = StorageModelSaver(LocalStorage(str(tmp_path)),
+                                  "ckpt/model.zip")
+        saver.save(net)
+        restored = saver.load()
+        np.testing.assert_allclose(
+            np.asarray(net.output(ds.features[:5])),
+            np.asarray(restored.output(ds.features[:5])), atol=1e-6)

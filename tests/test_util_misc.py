@@ -56,6 +56,13 @@ class TestViterbi:
         with pytest.raises(ValueError):
             Viterbi(num_states=1)
 
+    def test_out_of_range_labels_rejected(self):
+        v = Viterbi(num_states=3)
+        with pytest.raises(ValueError, match="outside"):
+            v.decode([0, -1, 0])  # no silent wrap to state 2
+        with pytest.raises(ValueError, match="outside"):
+            v.decode([0, 3, 0])
+
 
 class TestMathUtils:
     def test_entropy(self):
