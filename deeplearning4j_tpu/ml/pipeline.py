@@ -74,9 +74,7 @@ class MinMaxScaler(Estimator, Transformer):
 def _default_trainer(net, ds: DataSet, epochs: int, batch_size: int):
     from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
 
-    n = ds.num_examples()
-    b = batch_size or n
-    sets = [ds.get_range(i, min(i + b, n)) for i in range(0, n, b)]
+    sets = ds.batch_by(batch_size or ds.num_examples())
     for _ in range(epochs):
         net.fit(ListDataSetIterator(sets))
     return net

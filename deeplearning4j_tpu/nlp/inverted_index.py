@@ -1,0 +1,101 @@
+"""In-memory inverted index for the text pipeline.
+
+Capability mirror of reference text/invertedindex/LuceneInvertedIndex
+(SURVEY.md §2.8): word → document postings over tokenized docs, document
+retrieval, mini-batch sampling for embedding training, and TF-IDF
+scoring — without the Lucene dependency (host-side dict/array store; the
+tensor work stays in XLA).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class InvertedIndex:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._docs: List[List[str]] = []
+        self._labels: List[Optional[str]] = []
+        self._postings: Dict[str, List[int]] = defaultdict(list)
+
+    # -- building -------------------------------------------------------
+    def add_doc(self, tokens: Sequence[str],
+                label: Optional[str] = None) -> int:
+        """Add a tokenized document; returns its doc id."""
+        with self._lock:
+            doc_id = len(self._docs)
+            toks = list(tokens)
+            self._docs.append(toks)
+            self._labels.append(label)
+            for w in set(toks):
+                self._postings[w].append(doc_id)
+            return doc_id
+
+    # -- retrieval ------------------------------------------------------
+    def num_documents(self) -> int:
+        with self._lock:
+            return len(self._docs)
+
+    def document(self, doc_id: int) -> List[str]:
+        with self._lock:
+            return list(self._docs[doc_id])
+
+    def label(self, doc_id: int) -> Optional[str]:
+        with self._lock:
+            return self._labels[doc_id]
+
+    def documents_containing(self, word: str) -> List[int]:
+        with self._lock:
+            return list(self._postings.get(word, []))
+
+    def document_frequency(self, word: str) -> int:
+        return len(self.documents_containing(word))
+
+    def vocab(self) -> List[str]:
+        with self._lock:
+            return sorted(self._postings)
+
+    # -- scoring --------------------------------------------------------
+    def tfidf(self, word: str, doc_id: int) -> float:
+        """tf * log(N / df) (the reference's TfidfVectorizer weighting)."""
+        doc = self.document(doc_id)
+        if not doc:
+            return 0.0
+        tf = doc.count(word) / len(doc)
+        df = self.document_frequency(word)
+        if df == 0:
+            return 0.0
+        return tf * math.log(self.num_documents() / df)
+
+    def search(self, query: Sequence[str], top_k: int = 10
+               ) -> List[Tuple[int, float]]:
+        """Rank documents by summed TF-IDF over query terms."""
+        scores: Dict[int, float] = defaultdict(float)
+        for w in query:
+            for doc_id in self.documents_containing(w):
+                scores[doc_id] += self.tfidf(w, doc_id)
+        ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
+        return ranked[:top_k]
+
+    # -- training support ----------------------------------------------
+    def sample_batch(self, batch_size: int,
+                     rng: Optional[np.random.Generator] = None
+                     ) -> List[List[str]]:
+        """Random mini-batch of documents (the reference feeds W2V
+        workers by sampling the index)."""
+        rng = rng or np.random.default_rng()
+        n = self.num_documents()
+        if n == 0:
+            return []
+        idx = rng.integers(0, n, size=min(batch_size, n))
+        return [self.document(int(i)) for i in idx]
+
+    def all_documents(self) -> List[List[str]]:
+        with self._lock:
+            return [list(d) for d in self._docs]

@@ -76,17 +76,30 @@ class FlatProblem:
                 )
                 return score
 
+            def hvp(flat, v, state, feats, labels):
+                # Hessian-vector product by forward-over-reverse autodiff
+                # — the jax-native form of the reference's R-op
+                # (MultiLayerNetwork.computeDeltasR :728 used by
+                # StochasticHessianFree.java)
+                g = lambda f: jax.grad(loss_flat)(f, state, feats, labels)
+                return jax.jvp(g, (flat,), (v,))[1]
+
             net._flat_loss_cache = (
                 jax.jit(jax.value_and_grad(loss_flat)),
                 jax.jit(loss_flat),
+                jax.jit(hvp),
             )
-        self._vag, self._val = net._flat_loss_cache
+        self._vag, self._val, self._hvp = net._flat_loss_cache
 
     def value_and_grad(self, flat):
         return self._vag(flat, self._net.state, self._feats, self._labels)
 
     def value(self, flat):
         return self._val(flat, self._net.state, self._feats, self._labels)
+
+    def hessian_vector_product(self, flat, v):
+        return self._hvp(flat, v, self._net.state, self._feats,
+                         self._labels)
 
     def write_back(self, flat: Array) -> None:
         self._net.params = self._unravel(flat)
@@ -113,6 +126,7 @@ class BaseOptimizer:
 
     def optimize(self, ds) -> float:
         problem = FlatProblem(self.net, ds)
+        self._problem = problem  # direction() hooks may need hvp access
         x = problem.x0
         score = None
         self.reset()
@@ -125,6 +139,7 @@ class BaseOptimizer:
                 self.max_ls_iterations,
             )
             x = x + step * direction
+            self._ls_scores = (score, new_score)  # for adaptive hooks
             self._post_step(x, grad, direction, step)
             problem.write_back(x)
             self.net.score_value = new_score
@@ -217,10 +232,79 @@ class LBFGS(BaseOptimizer):
         return -q
 
 
+class StochasticHessianFree(BaseOptimizer):
+    """Hessian-free (truncated-Newton) optimization: the search direction
+    solves (H + λI) d = -grad by conjugate gradient using only
+    Hessian-vector products (reference solvers/StochasticHessianFree.java,
+    261 LoC, R-op via MultiLayerNetwork.computeDeltasR :728 — here the
+    R-op is jax.jvp over the gradient, one extra forward-mode pass).
+    λ adapts Levenberg-Marquardt-style on the reduction ratio."""
+
+    def __init__(self, net, max_iterations: Optional[int] = None,
+                 terminations=DEFAULT_CONDITIONS, cg_iterations: int = 50,
+                 initial_lambda: float = 0.01):
+        super().__init__(net, max_iterations, terminations)
+        self.cg_iterations = cg_iterations
+        self.lam = initial_lambda
+        self._last_quad = 0.0
+
+    def direction(self, x, grad, it):
+        lam = self.lam
+        hvp = self._problem.hessian_vector_product
+
+        def av(v):
+            return hvp(x, v) + lam * v
+
+        # CG on A d = -grad starting from 0
+        d = jnp.zeros_like(x)
+        r = -grad  # residual = b - A d with d = 0
+        p = r
+        rs = jnp.vdot(r, r)
+        for _ in range(self.cg_iterations):
+            ap = av(p)
+            denom = float(jnp.vdot(p, ap))
+            if denom <= 0:
+                # nonpositive curvature: truncated-Newton CG stops here;
+                # further iterations would burn full-batch HVPs for
+                # nothing. Fall back to steepest descent if no progress.
+                if float(jnp.vdot(d, d)) == 0.0:
+                    d = -grad
+                break
+            alpha = rs / denom
+            d = d + alpha * p
+            r = r - alpha * ap
+            rs_new = jnp.vdot(r, r)
+            if float(rs_new) < 1e-10:
+                break
+            p = r + (rs_new / rs) * p
+            rs = rs_new
+        # quadratic-model reduction for the λ update in _post_step
+        self._last_quad = float(
+            jnp.vdot(grad, d) + 0.5 * jnp.vdot(d, av(d)))
+        return d
+
+    def _post_step(self, x, grad, direction, step) -> None:
+        # Levenberg-Marquardt: compare ACTUAL score reduction (from the
+        # line-search evaluation) to the CG quadratic model's prediction
+        # (Martens 2010; the reference's damping role). rho near 1 ⇒
+        # model trusted, relax damping; small/negative rho ⇒ re-damp.
+        before, after = self._ls_scores
+        predicted = self._last_quad  # <= 0 when CG made progress
+        if predicted >= -1e-12:
+            self.lam = min(1e6, self.lam * 1.5)
+            return
+        rho = (after - before) / predicted
+        if rho > 0.75:
+            self.lam = max(1e-6, self.lam * (2 / 3))
+        elif rho < 0.25:
+            self.lam = min(1e6, self.lam * 1.5)
+
+
 _OPTIMIZERS = {
     OptimizationAlgorithm.LINE_GRADIENT_DESCENT: LineGradientDescent,
     OptimizationAlgorithm.CONJUGATE_GRADIENT: ConjugateGradient,
     OptimizationAlgorithm.LBFGS: LBFGS,
+    OptimizationAlgorithm.HESSIAN_FREE: StochasticHessianFree,
 }
 
 

@@ -1,0 +1,80 @@
+"""Plot renderers: scatter plots + weight-grid images.
+
+Mirror of reference plot/ renderers + PlotFilters (SURVEY.md §2.6): the
+t-SNE scatter renderer and the filter-grid image used by the UI's weight
+visualizations. Matplotlib (Agg) for scatter; raw PIL for filter grids.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def render_scatter(coords, labels: Optional[Sequence] = None,
+                   path: str = "tsne.png", point_size: float = 8.0,
+                   title: str = "") -> str:
+    """2-D embedding scatter (e.g. BarnesHutTsne output) → PNG."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    coords = np.asarray(coords)
+    if coords.ndim != 2 or coords.shape[1] < 2:
+        raise ValueError("coords must be [N, >=2]")
+    fig, ax = plt.subplots(figsize=(6, 6), dpi=100)
+    if labels is not None:
+        labels = np.asarray(labels)
+        classes = np.unique(labels)
+        for c in classes:
+            sel = labels == c
+            ax.scatter(coords[sel, 0], coords[sel, 1], s=point_size,
+                       label=str(c))
+        if len(classes) <= 20:
+            ax.legend(markerscale=2, fontsize=7)
+    else:
+        ax.scatter(coords[:, 0], coords[:, 1], s=point_size)
+    if title:
+        ax.set_title(title)
+    fig.tight_layout()
+    fig.savefig(path)
+    plt.close(fig)
+    return path
+
+
+class PlotFilters:
+    """Tile weight vectors into one normalized grayscale grid image
+    (reference plot/PlotFilters.java — the 'filters' views of the UI)."""
+
+    def __init__(self, patch_shape, grid_pad: int = 1):
+        self.patch_shape = tuple(patch_shape)
+        self.grid_pad = grid_pad
+
+    def render(self, weights, path: str) -> str:
+        """weights [num_filters, h*w] → PNG grid, each tile min-max
+        normalized like the reference's scale()."""
+        from PIL import Image
+
+        w = np.asarray(weights, np.float64)
+        h, wd = self.patch_shape
+        if w.ndim != 2 or w.shape[1] != h * wd:
+            raise ValueError(
+                f"weights must be [n, {h * wd}] for patch {h}x{wd}")
+        n = w.shape[0]
+        cols = int(np.ceil(np.sqrt(n)))
+        rows = int(np.ceil(n / cols))
+        pad = self.grid_pad
+        canvas = np.zeros((rows * (h + pad) + pad,
+                           cols * (wd + pad) + pad), np.uint8)
+        for i in range(n):
+            patch = w[i].reshape(h, wd)
+            span = patch.max() - patch.min()
+            norm = (patch - patch.min()) / (span if span > 0 else 1.0)
+            r, c = divmod(i, cols)
+            y = pad + r * (h + pad)
+            x = pad + c * (wd + pad)
+            canvas[y:y + h, x:x + wd] = (norm * 255).astype(np.uint8)
+        Image.fromarray(canvas, "L").save(path)
+        return path

@@ -1,0 +1,21 @@
+"""Tracing / profiling subsystem.
+
+The reference has NO dedicated tracer (SURVEY.md §5.1) — observability
+rides on IterationListener. This module keeps that listener SPI and adds
+what a TPU framework actually needs:
+
+- ``Tracer``: host-side span recorder emitting Chrome trace-event JSON
+  (load into chrome://tracing or Perfetto), thread-aware.
+- ``ProfilerIterationListener``: per-iteration spans + score counters
+  through the standard listener hook.
+- ``device_trace``: context manager around ``jax.profiler.trace`` for
+  XLA/TPU-level traces (op timing, HBM) viewable in TensorBoard.
+"""
+
+from deeplearning4j_tpu.profiler.tracer import (
+    ProfilerIterationListener,
+    Tracer,
+    device_trace,
+)
+
+__all__ = ["Tracer", "ProfilerIterationListener", "device_trace"]

@@ -1,0 +1,135 @@
+"""Host-side span tracer (Chrome trace format) + device trace hook."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+class Tracer:
+    """Record named spans/counters; dump Chrome trace-event JSON.
+
+    Usage::
+
+        tracer = Tracer()
+        with tracer.span("load_batch"):
+            ...
+        tracer.counter("score", 0.42)
+        tracer.save("trace.json")
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._t0 = time.perf_counter()
+
+    def _us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def now_us(self) -> float:
+        return self._us()
+
+    def complete(self, name: str, start_us: float, duration_us: float,
+                 **args: Any) -> None:
+        """Append a completed span recorded by the caller."""
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "X", "ts": start_us,
+                "dur": duration_us, "pid": os.getpid(),
+                "tid": threading.get_ident() % 2 ** 31, "args": args,
+            })
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args: Any):
+        start = self._us()
+        try:
+            yield
+        finally:
+            end = self._us()
+            with self._lock:
+                self._events.append({
+                    "name": name, "ph": "X", "ts": start,
+                    "dur": end - start, "pid": os.getpid(),
+                    "tid": threading.get_ident() % 2 ** 31,
+                    "args": args,
+                })
+
+    def instant(self, name: str, **args: Any) -> None:
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "i", "ts": self._us(),
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 2 ** 31, "s": "t",
+                "args": args,
+            })
+
+    def counter(self, name: str, value: float) -> None:
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "C", "ts": self._us(),
+                "pid": os.getpid(), "args": {name: value},
+            })
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def spans(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
+        return [e for e in self.events()
+                if e["ph"] == "X" and (name is None or e["name"] == name)]
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events()}, f)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+class ProfilerIterationListener(IterationListener):
+    """Feeds iteration timing + score into a Tracer via the standard
+    listener hook (the reference's only observability channel,
+    BaseOptimizer.java:218)."""
+
+    def __init__(self, tracer: Tracer, frequency: int = 1):
+        self.tracer = tracer
+        self.invoked_every = frequency
+        self._last_ts: Optional[float] = None
+
+    def iteration_done(self, model, iteration: int) -> None:
+        now = self.tracer.now_us()
+        if self._last_ts is not None:
+            self.tracer.complete("iteration", self._last_ts,
+                                 now - self._last_ts, iteration=iteration)
+        self._last_ts = now
+        self.tracer.counter("score", float(model.score_value))
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """XLA/TPU-level profiling via jax.profiler (TensorBoard format).
+    No-ops with a warning attribute when the profiler backend is
+    unavailable (e.g. CPU test environments without profiling support)."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

@@ -94,9 +94,6 @@ class TestPipeline:
             Pipeline(["not a stage"]).fit(_iris_ds())
 
     def test_fit_skips_final_stage_transform(self):
-        class CountingModel(NeuralNetworkClassification):
-            pass
-
         from deeplearning4j_tpu.ml.pipeline import Transformer
 
         class Spy(Transformer):
