@@ -18,6 +18,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.nn.conf.layers import BaseRecurrentLayer
 from deeplearning4j_tpu.nn.conf.serde import register_bean
@@ -34,6 +35,9 @@ class MultiHeadSelfAttention(BaseRecurrentLayer):
     n_heads: int = 4
     causal: bool = True
     ring_axis: Optional[str] = None  # sequence-parallel mesh axis
+    # pallas flash-attention fast path: True/False force, None = auto
+    # (TPU backend, no mask, T multiple of 128 and >= 256)
+    use_flash: Optional[bool] = None
 
 
 class AttentionImpl(LayerImplBase):
@@ -82,6 +86,8 @@ class AttentionImpl(LayerImplBase):
             o = ring_attention(
                 q, k, v, lc.ring_axis, causal=lc.causal, key_mask=mask
             )
+        elif _should_use_flash(lc.use_flash, q, mask):
+            o = _flash_attention(q, k, v, lc.causal)
         else:
             o = _dense_attention(q, k, v, lc.causal, mask)
 
@@ -94,6 +100,32 @@ class AttentionImpl(LayerImplBase):
         if mask is not None:
             out = out * mask[:, None, :]
         return out, state
+
+
+def _should_use_flash(use_flash, q, mask) -> bool:
+    if use_flash is False:
+        return False
+    t = q.shape[2]
+    kernel_ok = (jax.default_backend() == "tpu" and mask is None
+                 and t >= 256 and t % 128 == 0)
+    if use_flash and not kernel_ok:
+        raise ValueError(
+            "use_flash=True requires the TPU backend, no mask, and a "
+            "sequence length >= 256 divisible by 128")
+    return kernel_ok if use_flash is None else bool(use_flash)
+
+
+def _flash_attention(q, k, v, causal):
+    """Pallas TPU flash-attention kernel: O(T) memory instead of the
+    dense O(T²) score matrix (pallas_guide.md; long-context fast path —
+    SURVEY.md §5.7)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention,
+    )
+
+    return flash_attention(
+        q, k, v, causal=causal,
+        sm_scale=float(1.0 / np.sqrt(q.shape[-1])))
 
 
 def _dense_attention(q, k, v, causal, mask):
