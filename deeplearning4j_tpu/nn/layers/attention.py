@@ -18,7 +18,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from deeplearning4j_tpu.nn.conf.layers import BaseRecurrentLayer
 from deeplearning4j_tpu.nn.conf.serde import register_bean
@@ -105,13 +104,15 @@ class AttentionImpl(LayerImplBase):
 def _should_use_flash(use_flash, q, mask) -> bool:
     if use_flash is False:
         return False
-    t = q.shape[2]
+    t, dh = q.shape[2], q.shape[3]
     kernel_ok = (jax.default_backend() == "tpu" and mask is None
-                 and t >= 256 and t % 128 == 0)
+                 and t >= 256 and t % 128 == 0
+                 and (dh <= 128 or dh % 128 == 0))
     if use_flash and not kernel_ok:
         raise ValueError(
-            "use_flash=True requires the TPU backend, no mask, and a "
-            "sequence length >= 256 divisible by 128")
+            "use_flash=True requires the TPU backend, no mask, a "
+            "sequence length >= 256 divisible by 128, and head dim "
+            "<= 128 or divisible by 128")
     return kernel_ok if use_flash is None else bool(use_flash)
 
 
@@ -124,8 +125,7 @@ def _flash_attention(q, k, v, causal):
     )
 
     return flash_attention(
-        q, k, v, causal=causal,
-        sm_scale=float(1.0 / np.sqrt(q.shape[-1])))
+        q, k, v, causal=causal, sm_scale=q.shape[-1] ** -0.5)
 
 
 def _dense_attention(q, k, v, causal, mask):
