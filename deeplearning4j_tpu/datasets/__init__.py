@@ -6,12 +6,17 @@ device transfers; the AsyncDataSetIterator overlaps host prep with device
 compute exactly like the reference's prefetch thread.
 """
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterator import (
     AsyncDataSetIterator,
     DataSetIterator,
     ListDataSetIterator,
+    MovingWindowDataSetIterator,
     MultipleEpochsIterator,
+    ReconstructionDataSetIterator,
     SamplingDataSetIterator,
     TestDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.rearrange import (
+    LocalUnstructuredDataFormatter,
 )

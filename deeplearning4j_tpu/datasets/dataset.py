@@ -117,3 +117,111 @@ class DataSet:
         return (
             f"DataSet(features={self.features.shape}, labels={labels})"
         )
+
+
+class MultiDataSet:
+    """Multi-input / multi-output example container for ComputationGraph
+    training (reference: nd4j MultiDataSet as consumed by
+    ComputationGraph.fit, produced by
+    datasets/canova/RecordReaderMultiDataSetIterator.java).
+
+    ``features`` / ``labels`` are lists of arrays ordered like the graph's
+    ``network_inputs`` / ``network_outputs``; masks are parallel lists
+    (entries may be None).
+    """
+
+    def __init__(self, features, labels, features_masks=None,
+                 labels_masks=None):
+        as_list = lambda xs: [np.asarray(x) for x in xs]
+        self.features = as_list(features)
+        self.labels = as_list(labels)
+        self.features_masks = (
+            None if features_masks is None
+            else [None if m is None else np.asarray(m)
+                  for m in features_masks]
+        )
+        self.labels_masks = (
+            None if labels_masks is None
+            else [None if m is None else np.asarray(m)
+                  for m in labels_masks]
+        )
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+    def num_feature_arrays(self) -> int:
+        return len(self.features)
+
+    def num_labels_arrays(self) -> int:
+        return len(self.labels)
+
+    def get_range(self, start: int, end: int) -> "MultiDataSet":
+        sl = slice(start, end)
+        cut = lambda ms: (
+            None if ms is None
+            else [None if m is None else m[sl] for m in ms]
+        )
+        return MultiDataSet(
+            [f[sl] for f in self.features],
+            [y[sl] for y in self.labels],
+            cut(self.features_masks),
+            cut(self.labels_masks),
+        )
+
+    @staticmethod
+    def merge(datasets: Sequence["MultiDataSet"]) -> "MultiDataSet":
+        first = datasets[0]
+
+        def cat_arrays(get, n):
+            return [
+                np.concatenate([get(d)[i] for d in datasets], axis=0)
+                for i in range(n)
+            ]
+
+        def cat_masks(get, ref_get, n):
+            # A dataset without masks means "all timesteps valid": mixing
+            # masked and unmasked datasets must not drop the masks
+            # (padded steps would train as real data), so absent masks
+            # are expanded to ones of the matching shape.
+            if all(get(d) is None for d in datasets):
+                return None
+            out = []
+            for i in range(n):
+                protos = [
+                    get(d)[i] for d in datasets
+                    if get(d) is not None and get(d)[i] is not None
+                ]
+                if not protos:
+                    out.append(None)
+                    continue
+                proto = protos[0]
+                cols = []
+                for d in datasets:
+                    ms = get(d)
+                    m = None if ms is None else ms[i]
+                    if m is None:
+                        n_ex = ref_get(d)[i].shape[0]
+                        m = np.ones((n_ex,) + proto.shape[1:],
+                                    proto.dtype)
+                    cols.append(m)
+                out.append(np.concatenate(cols, axis=0))
+            return out
+
+        n_f, n_l = len(first.features), len(first.labels)
+        for d in datasets[1:]:
+            if len(d.features) != n_f or len(d.labels) != n_l:
+                raise ValueError(
+                    "cannot merge MultiDataSets with differing array counts"
+                )
+        return MultiDataSet(
+            cat_arrays(lambda d: d.features, n_f),
+            cat_arrays(lambda d: d.labels, n_l),
+            cat_masks(lambda d: d.features_masks, lambda d: d.features, n_f),
+            cat_masks(lambda d: d.labels_masks, lambda d: d.labels, n_l),
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"MultiDataSet(features={[f.shape for f in self.features]}, "
+            f"labels={[y.shape for y in self.labels]})"
+        )

@@ -379,3 +379,85 @@ class TestDataSetIterator(DataSetIterator):
 
     def total_outcomes(self) -> int:
         return self._base.total_outcomes()
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Wraps an iterator, replacing labels with the features themselves —
+    autoencoder/reconstruction training (reference datasets/iterator/
+    ReconstructionDataSetIterator.java)."""
+
+    def __init__(self, base: DataSetIterator):
+        super().__init__(base.batch)
+        self.base = base
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        ds = self.base.next(num)
+        if ds is None:
+            return None
+        return self._post(
+            DataSet(ds.features, ds.features,
+                    ds.features_mask, ds.features_mask)
+        )
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def total_examples(self) -> int:
+        return self.base.total_examples()
+
+    def input_columns(self) -> int:
+        return self.base.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.base.input_columns()
+
+    def state_dict(self) -> dict:
+        return self.base.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.base.load_state_dict(state)
+
+
+class MovingWindowDataSetIterator(BaseDataSetIterator):
+    """Slides a (rows x cols) window over each example matrix, emitting
+    each window as one flattened feature row (reference
+    datasets/iterator/MovingWindowBaseDataSetIterator.java backed by
+    util/MovingWindowMatrix)."""
+
+    def __init__(self, data: DataSet, window_rows: int, window_cols: int,
+                 batch_size: int = 10, rotate: int = 0):
+        from deeplearning4j_tpu.util.moving_window import (
+            moving_window_matrices,
+        )
+
+        rows = []
+        labels = []
+        for i in range(data.num_examples()):
+            mat = np.asarray(data.features[i])
+            if mat.ndim == 1:
+                side = int(np.sqrt(mat.shape[0]))
+                if side * side != mat.shape[0]:
+                    raise ValueError(
+                        f"1-D feature rows must have square length to "
+                        f"window over; got {mat.shape[0]}"
+                    )
+                mat = mat.reshape(side, side)
+            elif mat.ndim != 2:
+                raise ValueError(
+                    f"windowing needs [rows, cols] examples; got "
+                    f"shape {mat.shape}"
+                )
+            for w in moving_window_matrices(
+                mat, window_rows, window_cols, rotate=rotate
+            ):
+                rows.append(w.reshape(-1))
+                if data.labels is not None:
+                    labels.append(data.labels[i])
+        feats = np.asarray(rows, np.float32)
+        labs = np.asarray(labels, np.float32) if labels else None
+        super().__init__(batch_size, DataSet(feats, labs))
+
+    def total_outcomes(self) -> int:
+        return (
+            0 if self._data.labels is None else self._data.num_outcomes()
+        )

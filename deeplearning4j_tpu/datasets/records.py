@@ -31,6 +31,9 @@ class RecordReader:
     def reset(self) -> None:
         raise NotImplementedError
 
+    def num_records(self) -> int:
+        raise NotImplementedError
+
     def __iter__(self):
         self.reset()
         while self.has_next():
@@ -64,6 +67,9 @@ class CSVRecordReader(RecordReader):
         self._pos += 1
         return rec
 
+    def num_records(self) -> int:
+        return len(self._lines)
+
     def has_next(self) -> bool:
         return self._pos < len(self._lines)
 
@@ -90,6 +96,9 @@ class CSVSequenceRecordReader(RecordReader):
                                  self.delimiter)
         self._pos += 1
         return list(reader)
+
+    def num_records(self) -> int:
+        return len(self.paths)
 
     def has_next(self) -> bool:
         return self._pos < len(self.paths)
@@ -131,6 +140,9 @@ class ImageRecordReader(RecordReader):
             (self.width, self.height))
         pixels = np.asarray(img, np.float32).ravel() / 255.0
         return [str(v) for v in pixels] + [str(label)]
+
+    def num_records(self) -> int:
+        return len(self._files)
 
     def has_next(self) -> bool:
         return self._pos < len(self._files)
@@ -276,3 +288,109 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
 
     def load_state_dict(self, state: dict) -> None:
         self._pos = state["pos"]
+
+
+class RecordReaderMultiDataSetIterator(DataSetIterator):
+    """Multi-reader → MultiDataSet adapter (reference datasets/canova/
+    RecordReaderMultiDataSetIterator.java): named readers supply columns,
+    declared input/output slices assemble each MultiDataSet batch.
+
+    Builder-style use::
+
+        it = (RecordReaderMultiDataSetIterator.Builder(batch_size=32)
+              .add_reader("csv", reader)
+              .add_input("csv", 0, 3)
+              .add_output_one_hot("csv", 4, num_classes=3)
+              .build())
+    """
+
+    class Builder:
+        def __init__(self, batch_size: int):
+            self.batch_size = batch_size
+            self.readers: dict = {}
+            self.inputs: list = []    # (reader, col_from, col_to)
+            self.outputs: list = []   # (reader, col_from, col_to, n_cls)
+
+        def add_reader(self, name: str, reader: RecordReader):
+            self.readers[name] = reader
+            return self
+
+        def add_input(self, name: str, col_from: int, col_to: int):
+            self.inputs.append((name, col_from, col_to, None))
+            return self
+
+        def add_output(self, name: str, col_from: int, col_to: int):
+            self.outputs.append((name, col_from, col_to, None))
+            return self
+
+        def add_output_one_hot(self, name: str, col: int,
+                               num_classes: int):
+            self.outputs.append((name, col, col, num_classes))
+            return self
+
+        def build(self) -> "RecordReaderMultiDataSetIterator":
+            return RecordReaderMultiDataSetIterator(self)
+
+    def __init__(self, builder: "RecordReaderMultiDataSetIterator.Builder"):
+        super().__init__(builder.batch_size)
+        self._b = builder
+        if not builder.readers or not builder.inputs:
+            raise ValueError("need at least one reader and one input")
+
+    def _slice(self, rows: np.ndarray, col_from: int, col_to: int,
+               n_cls: Optional[int]) -> np.ndarray:
+        block = rows[:, col_from:col_to + 1].astype(np.float32)
+        if n_cls is not None:
+            from deeplearning4j_tpu.native_rt import one_hot
+
+            return one_hot(block[:, 0].astype(int), n_cls)
+        return block
+
+    def next(self, num: Optional[int] = None):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+        n = num or self.batch
+        per_reader = {}
+        for name, reader in self._b.readers.items():
+            rows = []
+            while len(rows) < n and reader.has_next():
+                rows.append([float(v) for v in reader.next_record()])
+            per_reader[name] = np.asarray(rows, np.float32)
+        counts = {v.shape[0] for v in per_reader.values()}
+        if counts == {0}:
+            return None
+        if len(counts) > 1:
+            # Readers of unequal length would silently lose the rows
+            # already consumed from the longer ones; refuse instead.
+            raise ValueError(
+                "readers returned unequal row counts "
+                + str({k: int(v.shape[0]) for k, v in per_reader.items()})
+                + " — all readers must cover the same examples"
+            )
+        feats = [
+            self._slice(per_reader[r], cf, ct, nc)
+            for r, cf, ct, nc in self._b.inputs
+        ]
+        labels = [
+            self._slice(per_reader[r], cf, ct, nc)
+            for r, cf, ct, nc in self._b.outputs
+        ]
+        return self._post(MultiDataSet(feats, labels))
+
+    def reset(self) -> None:
+        for reader in self._b.readers.values():
+            reader.reset()
+
+    def total_examples(self) -> int:
+        return min(
+            r.num_records() for r in self._b.readers.values()
+        )
+
+    def input_columns(self) -> int:
+        return sum(ct - cf + 1 for _, cf, ct, _ in self._b.inputs)
+
+    def total_outcomes(self) -> int:
+        return sum(
+            (nc if nc is not None else ct - cf + 1)
+            for _, cf, ct, nc in self._b.outputs
+        )

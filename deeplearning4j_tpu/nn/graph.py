@@ -257,9 +257,47 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
     def _coerce_multi(self, data) -> Tuple[Dict[str, Array], List[Array], Optional[Dict], Optional[Dict]]:
-        """Accept DataSet (single in/out) or MultiDataSet-style tuples."""
-        from deeplearning4j_tpu.datasets.dataset import DataSet
+        """Accept DataSet (single in/out), MultiDataSet, or
+        (features-list, labels-list) tuples."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
+        if isinstance(data, MultiDataSet):
+            if len(data.features) != len(self.conf.network_inputs):
+                raise ValueError(
+                    f"MultiDataSet has {len(data.features)} feature "
+                    f"arrays but graph has "
+                    f"{len(self.conf.network_inputs)} inputs"
+                )
+            if len(data.labels) != len(self.conf.network_outputs):
+                raise ValueError(
+                    f"MultiDataSet has {len(data.labels)} label arrays "
+                    f"but graph has {len(self.conf.network_outputs)} "
+                    f"outputs"
+                )
+            inputs = {
+                n: jnp.asarray(f, self._dtype)
+                for n, f in zip(self.conf.network_inputs, data.features)
+            }
+            labels = [jnp.asarray(y, self._dtype) for y in data.labels]
+            masks = None
+            if data.features_masks is not None:
+                masks = {
+                    n: jnp.asarray(m)
+                    for n, m in zip(
+                        self.conf.network_inputs, data.features_masks
+                    )
+                    if m is not None
+                } or None
+            lmasks = None
+            if data.labels_masks is not None:
+                lmasks = {
+                    n: jnp.asarray(m)
+                    for n, m in zip(
+                        self.conf.network_outputs, data.labels_masks
+                    )
+                    if m is not None
+                } or None
+            return inputs, labels, masks, lmasks
         if isinstance(data, DataSet):
             inputs = {
                 self.conf.network_inputs[0]: jnp.asarray(

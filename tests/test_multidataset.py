@@ -1,0 +1,254 @@
+"""MultiDataSet + multi-reader iterator + misc dataset utilities
+(reference datasets/canova/RecordReaderMultiDataSetIterator.java,
+datasets/iterator/ReconstructionDataSetIterator.java,
+util/MovingWindowMatrix.java, rearrange/LocalUnstructuredDataFormatter.java).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    DataSet,
+    ListDataSetIterator,
+    LocalUnstructuredDataFormatter,
+    MovingWindowDataSetIterator,
+    MultiDataSet,
+    ReconstructionDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.records import (
+    CSVRecordReader,
+    RecordReaderMultiDataSetIterator,
+)
+from deeplearning4j_tpu.util.moving_window import moving_window_matrices
+
+
+def _write_csv(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(",".join(str(v) for v in r) + "\n")
+
+
+class TestMultiDataSet:
+    def test_merge_and_range(self):
+        a = MultiDataSet([np.ones((2, 3)), np.ones((2, 5))],
+                         [np.zeros((2, 4))])
+        b = MultiDataSet([2 * np.ones((3, 3)), np.ones((3, 5))],
+                         [np.ones((3, 4))])
+        m = MultiDataSet.merge([a, b])
+        assert m.num_examples() == 5
+        assert m.num_feature_arrays() == 2
+        assert m.features[0].shape == (5, 3)
+        tail = m.get_range(2, 5)
+        assert np.allclose(tail.features[0], 2.0)
+
+    def test_graph_fit_multidataset(self):
+        # two inputs merged into one output — the reference's flagship
+        # ComputationGraph multi-input scenario
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, MergeVertex
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(7)
+            .learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in1", "in2")
+            .add_layer("d1", L.DenseLayer(n_in=4, n_out=8,
+                                          activation="tanh"), "in1")
+            .add_layer("d2", L.DenseLayer(n_in=3, n_out=8,
+                                          activation="tanh"), "in2")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_layer(
+                "out",
+                L.OutputLayer(n_in=16, n_out=2, activation="softmax",
+                              loss_function=LossFunction.MCXENT),
+                "merge",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        n = 16
+        mds = MultiDataSet(
+            [rng.normal(size=(n, 4)).astype(np.float32),
+             rng.normal(size=(n, 3)).astype(np.float32)],
+            [np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]],
+        )
+        s0 = net.score(mds)
+        for _ in range(20):
+            net.fit(mds)
+        assert net.score(mds) < s0
+
+    def test_multi_reader_iterator(self, tmp_path):
+        f1 = str(tmp_path / "a.csv")
+        f2 = str(tmp_path / "b.csv")
+        _write_csv(f1, [[i, i + 1, i % 3] for i in range(10)])
+        _write_csv(f2, [[10 * i, i % 2] for i in range(10)])
+        it = (
+            RecordReaderMultiDataSetIterator.Builder(batch_size=4)
+            .add_reader("a", CSVRecordReader(f1))
+            .add_reader("b", CSVRecordReader(f2))
+            .add_input("a", 0, 1)
+            .add_input("b", 0, 0)
+            .add_output_one_hot("a", 2, num_classes=3)
+            .add_output("b", 1, 1)
+            .build()
+        )
+        mds = it.next()
+        assert isinstance(mds, MultiDataSet)
+        assert mds.features[0].shape == (4, 2)
+        assert mds.features[1].shape == (4, 1)
+        assert mds.labels[0].shape == (4, 3)  # one-hot of col 2
+        assert mds.labels[1].shape == (4, 1)
+        assert np.allclose(mds.labels[0].sum(axis=1), 1.0)
+        assert it.input_columns() == 3
+        assert it.total_outcomes() == 4
+        n_batches = 1 + sum(1 for _ in iter(lambda: it.next(), None))
+        assert n_batches == 3  # 10 rows @ 4 = 3 batches (last short)
+        it.reset()
+        again = it.next()
+        assert np.allclose(again.features[0], mds.features[0])
+
+
+class TestReconstructionIterator:
+    def test_labels_are_features(self):
+        ds = DataSet(np.arange(12, dtype=np.float32).reshape(4, 3),
+                     np.eye(4, dtype=np.float32))
+        base = ListDataSetIterator(ds.batch_by(2), batch_size=2)
+        it = ReconstructionDataSetIterator(base)
+        b = it.next()
+        assert np.allclose(b.labels, b.features)
+        assert it.total_outcomes() == 3
+        it.reset()
+        assert it.next() is not None
+
+
+class TestMovingWindow:
+    def test_matrices(self):
+        mat = np.arange(16).reshape(4, 4)
+        wins = moving_window_matrices(mat, 2, 2)
+        assert len(wins) == 4
+        assert np.array_equal(wins[0], [[0, 1], [4, 5]])
+        rot = moving_window_matrices(mat, 2, 2, rotate=1)
+        assert len(rot) == 8
+
+    def test_window_too_large(self):
+        with pytest.raises(ValueError):
+            moving_window_matrices(np.ones((2, 2)), 3, 3)
+
+    def test_iterator(self):
+        feats = np.arange(2 * 16, dtype=np.float32).reshape(2, 16)
+        labels = np.eye(2, dtype=np.float32)
+        it = MovingWindowDataSetIterator(
+            DataSet(feats, labels), 2, 2, batch_size=3
+        )
+        # each 4x4 image -> 4 windows; 2 examples -> 8 rows
+        assert it.total_examples() == 8
+        assert it.input_columns() == 4
+        total = 0
+        while (b := it.next()) is not None:
+            total += b.num_examples()
+            assert b.features.shape[1] == 4
+        assert total == 8
+
+
+class TestLocalUnstructuredDataFormatter:
+    def test_split(self, tmp_path):
+        src = tmp_path / "raw"
+        for cls in ("cats", "dogs"):
+            os.makedirs(src / cls)
+            for i in range(10):
+                (src / cls / f"{i}.txt").write_text(f"{cls}{i}")
+        fmt = LocalUnstructuredDataFormatter(
+            str(tmp_path / "out"), str(src), percent_train=0.8, seed=5
+        )
+        fmt.rearrange()
+        assert fmt.num_examples_total() == 20
+        assert fmt.num_test_examples() == 4
+        train_cats = os.listdir(
+            os.path.join(fmt.get_train_dir(), "cats"))
+        test_cats = os.listdir(os.path.join(fmt.get_test_dir(), "cats"))
+        assert len(train_cats) == 8 and len(test_cats) == 2
+        assert not set(train_cats) & set(test_cats)
+        # source untouched (copy mode)
+        assert len(os.listdir(src / "cats")) == 10
+
+
+class TestReviewRegressions:
+    def test_merge_mixed_masks(self):
+        t, f = 4, 3
+        seq = lambda n: np.ones((n, t, f), np.float32)
+        with_mask = MultiDataSet(
+            [seq(2)], [seq(2)],
+            [np.array([[1, 1, 0, 0], [1, 1, 1, 0]], np.float32)],
+            [np.array([[1, 1, 0, 0], [1, 1, 1, 0]], np.float32)],
+        )
+        without = MultiDataSet([seq(3)], [seq(3)])
+        m = MultiDataSet.merge([without, with_mask])
+        # masks survive and absent ones expand to all-ones
+        assert m.features_masks[0].shape == (5, t)
+        assert np.allclose(m.features_masks[0][:3], 1.0)
+        assert m.features_masks[0][3, 3] == 0.0
+        # no masks anywhere -> None
+        assert MultiDataSet.merge([without, without]).features_masks is None
+
+    def test_merge_count_mismatch(self):
+        a = MultiDataSet([np.ones((2, 3))], [np.ones((2, 2))])
+        b = MultiDataSet([np.ones((2, 3)), np.ones((2, 3))],
+                         [np.ones((2, 2))])
+        with pytest.raises(ValueError, match="differing array counts"):
+            MultiDataSet.merge([a, b])
+
+    def test_unequal_readers_raise(self, tmp_path):
+        f1 = str(tmp_path / "long.csv")
+        f2 = str(tmp_path / "short.csv")
+        _write_csv(f1, [[i, i % 2] for i in range(8)])
+        _write_csv(f2, [[i] for i in range(5)])
+        it = (
+            RecordReaderMultiDataSetIterator.Builder(batch_size=4)
+            .add_reader("l", CSVRecordReader(f1))
+            .add_reader("s", CSVRecordReader(f2))
+            .add_input("l", 0, 0)
+            .add_input("s", 0, 0)
+            .add_output_one_hot("l", 1, num_classes=2)
+            .build()
+        )
+        assert it.total_examples() == 5
+        assert it.next() is not None  # both supply 4
+        with pytest.raises(ValueError, match="unequal row counts"):
+            it.next()  # long has 4 left, short has 1
+
+    def test_graph_rejects_wrong_arity(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+            .graph_builder().add_inputs("in")
+            .add_layer(
+                "out",
+                L.OutputLayer(n_in=4, n_out=2, activation="softmax",
+                              loss_function=LossFunction.MCXENT),
+                "in",
+            )
+            .set_outputs("out").build()
+        )
+        net = ComputationGraph(conf).init()
+        bad = MultiDataSet(
+            [np.ones((2, 4), np.float32), np.ones((2, 4), np.float32)],
+            [np.ones((2, 2), np.float32)],
+        )
+        with pytest.raises(ValueError, match="feature arrays"):
+            net.fit(bad)
+
+    def test_moving_window_bad_shapes(self):
+        ds = DataSet(np.ones((2, 20), np.float32), None)  # not square
+        with pytest.raises(ValueError, match="square length"):
+            MovingWindowDataSetIterator(ds, 2, 2)
