@@ -42,6 +42,7 @@ class LocalUnstructuredDataFormatter:
         self._counts: Dict[str, int] = {}
 
     def rearrange(self) -> None:
+        self._counts = {}
         rng = np.random.default_rng(self.seed)
         classes = sorted(
             d for d in os.listdir(self.src_dir)

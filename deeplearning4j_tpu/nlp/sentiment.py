@@ -1,0 +1,98 @@
+"""Lexicon-based sentiment scoring.
+
+Capability mirror of the reference's SentiWordNet support
+(nlp text/corpora/sentiwordnet/SentiWordNet.java): load a word ->
+(positivity, negativity) lexicon, score token sequences, classify
+documents by aggregate polarity. The reference ships the SentiWordNet
+TSV in its resources; redistribution terms differ, so a compact builtin
+seed lexicon is embedded and ``load_lexicon`` accepts the standard
+SentiWordNet 3.0 TSV format for users who supply their own copy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+# word -> (pos_score, neg_score); seed list so the API is usable
+# out-of-the-box (the reference bundles the full 117k-entry file).
+_SEED_LEXICON: Dict[str, Tuple[float, float]] = {
+    "good": (0.75, 0.0), "great": (0.8, 0.0), "excellent": (0.9, 0.0),
+    "happy": (0.8, 0.0), "love": (0.85, 0.0), "wonderful": (0.9, 0.0),
+    "best": (0.85, 0.0), "amazing": (0.85, 0.0), "nice": (0.6, 0.0),
+    "awesome": (0.85, 0.0), "fantastic": (0.9, 0.0), "like": (0.5, 0.0),
+    "enjoy": (0.7, 0.0), "perfect": (0.9, 0.0), "beautiful": (0.8, 0.0),
+    "win": (0.6, 0.0), "better": (0.5, 0.0), "positive": (0.7, 0.0),
+    "bad": (0.0, 0.75), "terrible": (0.0, 0.9), "awful": (0.0, 0.9),
+    "sad": (0.0, 0.8), "hate": (0.0, 0.85), "horrible": (0.0, 0.9),
+    "worst": (0.0, 0.9), "poor": (0.0, 0.6), "wrong": (0.0, 0.6),
+    "fail": (0.0, 0.7), "failure": (0.0, 0.75), "negative": (0.0, 0.7),
+    "ugly": (0.0, 0.7), "broken": (0.0, 0.6), "lose": (0.0, 0.6),
+    "angry": (0.0, 0.8), "disappointing": (0.0, 0.8),
+}
+
+_NEGATORS = {"not", "no", "never", "n't", "dont", "don't", "cannot",
+             "can't", "isn't", "wasn't", "won't"}
+
+
+def load_lexicon(path: str) -> Dict[str, Tuple[float, float]]:
+    """Parse a SentiWordNet 3.0 TSV (# comments; POS\\tID\\tPos\\tNeg\\t
+    term#rank ... columns). Multiple senses of a term average."""
+    sums: Dict[str, Tuple[float, float, int]] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.strip() or line.startswith("#"):
+                continue
+            cols = line.rstrip("\n").split("\t")
+            if len(cols) < 5:
+                continue
+            try:
+                pos_s, neg_s = float(cols[2]), float(cols[3])
+            except ValueError:
+                continue
+            for term in cols[4].split():
+                word = term.split("#")[0].replace("_", " ").lower()
+                p, n, c = sums.get(word, (0.0, 0.0, 0))
+                sums[word] = (p + pos_s, n + neg_s, c + 1)
+    return {w: (p / c, n / c) for w, (p, n, c) in sums.items()}
+
+
+class SentiWordNet:
+    """Word-polarity lookup + document classification."""
+
+    def __init__(self,
+                 lexicon: Optional[Dict[str, Tuple[float, float]]] = None):
+        self.lexicon = dict(_SEED_LEXICON if lexicon is None else lexicon)
+
+    @classmethod
+    def from_file(cls, path: str) -> "SentiWordNet":
+        return cls(load_lexicon(path))
+
+    def score_word(self, word: str) -> float:
+        """Signed polarity in [-1, 1]: positivity - negativity."""
+        p, n = self.lexicon.get(word.lower(), (0.0, 0.0))
+        return p - n
+
+    def score(self, tokens: Iterable[str]) -> float:
+        """Mean signed polarity with single-token negation flips
+        ("not good" scores as negative)."""
+        total, count, negate = 0.0, 0, False
+        for tok in tokens:
+            w = tok.lower()
+            if w in _NEGATORS:
+                negate = True
+                continue
+            s = self.score_word(w)
+            if s != 0.0:
+                total += -s if negate else s
+                count += 1
+            negate = False
+        return total / count if count else 0.0
+
+    def classify(self, tokens: Iterable[str],
+                 threshold: float = 0.0) -> str:
+        s = self.score(tokens)
+        if s > threshold:
+            return "positive"
+        if s < -threshold:
+            return "negative"
+        return "neutral"

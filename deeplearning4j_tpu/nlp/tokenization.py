@@ -1,7 +1,12 @@
 """Tokenizers + factories + preprocessors.
 
 Mirror of reference nlp text/tokenization/** (DefaultTokenizer,
-NGramTokenizer, factories, CommonPreprocessor/EndingPreProcessor).
+NGramTokenizer, PosUimaTokenizer, factories, CommonPreprocessor/
+EndingPreProcessor). The reference's UIMA-backed tokenizers ride a
+ClearTK POS-tagger pipeline; UIMA is a JVM-only stack, so the
+POS-filtered tokenizer here uses a self-contained rule tagger with the
+same observable contract: tokens whose POS is outside the allowed set
+collapse to a placeholder.
 """
 
 from __future__ import annotations
@@ -76,6 +81,74 @@ class DefaultTokenizerFactory(TokenizerFactory):
 
     def create(self, text: str) -> Tokenizer:
         return Tokenizer(text.split(), self.preprocessor)
+
+
+class RuleBasedPosTagger:
+    """Tiny deterministic POS tagger (closed-class lexicon + suffix
+    rules). Stands in for the reference's UIMA/ClearTK tagger behind
+    PosUimaTokenizer (text/tokenization/tokenizer/PosUimaTokenizer.java);
+    intentionally coarse — callers only branch on the tag class."""
+
+    _DETERMINERS = {"the", "a", "an", "this", "that", "these", "those"}
+    _PRONOUNS = {"i", "you", "he", "she", "it", "we", "they", "me",
+                 "him", "her", "us", "them", "its", "his", "their", "my",
+                 "your", "our"}
+    _PREPOSITIONS = {"in", "on", "at", "by", "for", "with", "about",
+                     "against", "between", "into", "through", "during",
+                     "of", "to", "from", "up", "down", "over", "under"}
+    _CONJUNCTIONS = {"and", "or", "but", "nor", "so", "yet", "because",
+                     "although", "while", "if"}
+    _MODALS = {"can", "could", "will", "would", "shall", "should", "may",
+               "might", "must"}
+    _BE_VERBS = {"is", "am", "are", "was", "were", "be", "been", "being",
+                 "has", "have", "had", "do", "does", "did"}
+
+    def tag(self, token: str) -> str:
+        w = token.lower()
+        if not w:
+            return "NONE"
+        if w in self._DETERMINERS:
+            return "DT"
+        if w in self._PRONOUNS:
+            return "PRP"
+        if w in self._PREPOSITIONS:
+            return "IN"
+        if w in self._CONJUNCTIONS:
+            return "CC"
+        if w in self._MODALS:
+            return "MD"
+        if w in self._BE_VERBS:
+            return "VB"
+        if w[0].isdigit():
+            return "CD"
+        if w.endswith("ly"):
+            return "RB"
+        if w.endswith(("ing", "ed")) and len(w) > 4:
+            return "VB"
+        if w.endswith(("ous", "ful", "ive", "able", "ible", "al", "ic")):
+            return "JJ"
+        return "NN"
+
+
+class PosTokenizerFactory(TokenizerFactory):
+    """Keeps tokens whose POS tag is in ``allowed_pos``; others become
+    a placeholder so window offsets are preserved — the reference
+    PosUimaTokenizer's behavior for its moving-window features."""
+
+    PLACEHOLDER = "NONE"
+
+    def __init__(self, allowed_pos: List[str],
+                 tagger: Optional[RuleBasedPosTagger] = None):
+        super().__init__()
+        self.allowed_pos = set(allowed_pos)
+        self.tagger = tagger or RuleBasedPosTagger()
+
+    def create(self, text: str) -> Tokenizer:
+        kept = []
+        for w in text.split():
+            tag = self.tagger.tag(w)
+            kept.append(w if tag in self.allowed_pos else self.PLACEHOLDER)
+        return Tokenizer(kept, self.preprocessor)
 
 
 class NGramTokenizerFactory(TokenizerFactory):

@@ -1,0 +1,175 @@
+"""Cluster provisioning: TPU-pod equivalent of the reference AWS module.
+
+The reference's deeplearning4j-aws module (SURVEY.md §2.7: Ec2BoxCreator,
+ClusterSetup, HostProvisioner, DistributedDeepLearningTrainer) creates
+EC2 boxes, provisions them over SSH in parallel, and launches the Akka
+runtime across them. The TPU-native shape of that capability: describe a
+TPU slice/VM fleet, emit the exact `gcloud` command plan to create it,
+push the framework + coordinator config to every host in parallel, and
+launch the distributed runner. Cloud CLIs and SSH may be absent in the
+build image, so every step is a *plan object* first — inspectable and
+unit-testable — with execution gated on the binaries existing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.util.collections import iterate_in_parallel
+
+
+@dataclasses.dataclass
+class TpuPodSpec:
+    """What to create (reference Ec2BoxCreator's AMI/size/#instances)."""
+
+    name: str = "dl4j-tpu"
+    accelerator_type: str = "v5litepod-8"
+    zone: str = "us-central1-a"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    project: Optional[str] = None
+    preemptible: bool = False
+
+
+@dataclasses.dataclass
+class CommandPlan:
+    """One host-level action: argv + description. ``execute`` runs it
+    for real; tests assert on argv."""
+
+    argv: List[str]
+    description: str
+
+    def execute(self, check: bool = True) -> subprocess.CompletedProcess:
+        return subprocess.run(
+            self.argv, check=check, capture_output=True, text=True)
+
+
+class TpuPodProvisioner:
+    """Builds create/delete/list plans for a TPU pod slice
+    (Ec2BoxCreator.create equivalent)."""
+
+    def __init__(self, spec: TpuPodSpec):
+        self.spec = spec
+
+    def _base(self) -> List[str]:
+        argv = ["gcloud", "compute", "tpus", "tpu-vm"]
+        return argv
+
+    def _common_flags(self) -> List[str]:
+        flags = [f"--zone={self.spec.zone}"]
+        if self.spec.project:
+            flags.append(f"--project={self.spec.project}")
+        return flags
+
+    def create_plan(self) -> CommandPlan:
+        argv = self._base() + ["create", self.spec.name] + self._common_flags()
+        argv += [
+            f"--accelerator-type={self.spec.accelerator_type}",
+            f"--version={self.spec.runtime_version}",
+        ]
+        if self.spec.preemptible:
+            argv.append("--preemptible")
+        return CommandPlan(argv, f"create TPU pod {self.spec.name}")
+
+    def delete_plan(self) -> CommandPlan:
+        argv = self._base() + ["delete", self.spec.name, "--quiet"]
+        argv += self._common_flags()
+        return CommandPlan(argv, f"delete TPU pod {self.spec.name}")
+
+    def list_plan(self) -> CommandPlan:
+        return CommandPlan(
+            self._base() + ["list"] + self._common_flags(),
+            "list TPU pods")
+
+    def available(self) -> bool:
+        return shutil.which("gcloud") is not None
+
+
+class HostProvisioner:
+    """Runs commands / pushes files on a remote host (reference
+    HostProvisioner's jsch SSH wrapper → ssh/scp argv plans)."""
+
+    def __init__(self, host: str, user: Optional[str] = None,
+                 key_file: Optional[str] = None):
+        self.target = f"{user}@{host}" if user else host
+        self.key_file = key_file
+
+    def _ssh_base(self) -> List[str]:
+        argv = ["ssh", "-o", "StrictHostKeyChecking=no"]
+        if self.key_file:
+            argv += ["-i", self.key_file]
+        return argv
+
+    def run_plan(self, command: str) -> CommandPlan:
+        return CommandPlan(self._ssh_base() + [self.target, command],
+                           f"run on {self.target}: {command}")
+
+    def upload_plan(self, local: str, remote: str) -> CommandPlan:
+        argv = ["scp", "-r", "-o", "StrictHostKeyChecking=no"]
+        if self.key_file:
+            argv += ["-i", self.key_file]
+        argv += [local, f"{self.target}:{remote}"]
+        return CommandPlan(argv, f"upload {local} -> {self.target}:{remote}")
+
+    @staticmethod
+    def available() -> bool:
+        return shutil.which("ssh") is not None
+
+
+@dataclasses.dataclass
+class ClusterSetup:
+    """End-to-end bring-up orchestration (reference ClusterSetup +
+    DistributedDeepLearningTrainer): create the slice, provision every
+    host in parallel, emit the launch command wiring workers to the
+    coordinator (scaleout/coordinator.py control plane)."""
+
+    pod: TpuPodSpec
+    hosts: Sequence[str] = ()
+    user: Optional[str] = None
+    key_file: Optional[str] = None
+    coordinator_address: str = "10.0.0.2:9898"
+    wheel_path: str = "deeplearning4j_tpu"
+
+    def provision_plans(self) -> Dict[str, List[CommandPlan]]:
+        """Per-host plan: push the package, start the worker runner."""
+        plans: Dict[str, List[CommandPlan]] = {}
+        for i, host in enumerate(self.hosts):
+            hp = HostProvisioner(host, self.user, self.key_file)
+            # nohup + background so execute() returns once the worker is
+            # launched instead of blocking on its (long-running) lifetime.
+            launch = (
+                "nohup python -m deeplearning4j_tpu.cli worker"
+                f" --coordinator {self.coordinator_address}"
+                f" --worker-id {i} --num-workers {len(self.hosts)}"
+                " > worker.log 2>&1 &")
+            plans[host] = [
+                hp.upload_plan(self.wheel_path, "~/deeplearning4j_tpu"),
+                hp.run_plan(launch),
+            ]
+        return plans
+
+    def full_plan(self) -> List[CommandPlan]:
+        plans = [TpuPodProvisioner(self.pod).create_plan()]
+        for host_plans in self.provision_plans().values():
+            plans.extend(host_plans)
+        return plans
+
+    def execute(self, check: bool = True) -> List[subprocess.CompletedProcess]:
+        """Create + provision for real. Pod creation is serial; host
+        provisioning fans out on a thread pool (the reference provisions
+        hosts in parallel via Parallelization.runInParallel)."""
+        if not TpuPodProvisioner(self.pod).available():
+            raise RuntimeError(
+                "gcloud not found: cannot execute provisioning plan "
+                "(inspect .full_plan() instead)")
+        results = [TpuPodProvisioner(self.pod).create_plan().execute(check)]
+        host_plans = list(self.provision_plans().values())
+
+        def _run_host(plans: List[CommandPlan]):
+            return [p.execute(check) for p in plans]
+
+        for host_result in iterate_in_parallel(host_plans, _run_host):
+            results.extend(host_result)
+        return results

@@ -1,0 +1,274 @@
+"""Counting/priority collections + thread-parallel helpers.
+
+Capability mirror of the reference's vendored Berkeley-NLP utilities
+(berkeley/{Counter,CounterMap,PriorityQueue,Pair,Triple}.java, SURVEY.md
+§2.6) and the Akka thread-parallelism helper
+(scaleout-akka/.../parallel/Parallelization.java:37). Python has stdlib
+near-equivalents (collections.Counter, heapq, tuples); these classes keep
+the reference's richer API surface — argmax, normalization, conditional
+counts, peek/priority introspection — that callers like vocab
+construction, GloVe co-occurrence and DeepWalk rely on, without forcing
+each call site to re-derive it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import (Callable, Dict, Generic, Iterable, Iterator, List,
+                    Mapping, NamedTuple, Optional, Sequence, Tuple,
+                    TypeVar)
+
+K = TypeVar("K")
+K2 = TypeVar("K2")
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+class Pair(NamedTuple):
+    first: object
+    second: object
+
+
+class Triple(NamedTuple):
+    first: object
+    second: object
+    third: object
+
+
+class Counter(Generic[K]):
+    """Map key -> float count with argmax/normalize/sample conveniences."""
+
+    def __init__(self, initial: Optional[Iterable[K]] = None):
+        self._counts: Dict[K, float] = {}
+        if isinstance(initial, Mapping):
+            for k, v in initial.items():
+                self.increment_count(k, float(v))
+        elif initial is not None:
+            for k in initial:
+                self.increment_count(k, 1.0)
+
+    def get_count(self, key: K) -> float:
+        return self._counts.get(key, 0.0)
+
+    def set_count(self, key: K, count: float) -> None:
+        self._counts[key] = float(count)
+
+    def increment_count(self, key: K, amount: float = 1.0) -> float:
+        c = self._counts.get(key, 0.0) + amount
+        self._counts[key] = c
+        return c
+
+    def increment_all(self, other: "Counter[K]", scale: float = 1.0) -> None:
+        for k, v in other.items():
+            self.increment_count(k, v * scale)
+
+    def remove_key(self, key: K) -> float:
+        return self._counts.pop(key, 0.0)
+
+    def contains_key(self, key: K) -> bool:
+        return key in self._counts
+
+    def key_set(self):
+        return self._counts.keys()
+
+    def items(self):
+        return self._counts.items()
+
+    def size(self) -> int:
+        return len(self._counts)
+
+    def is_empty(self) -> bool:
+        return not self._counts
+
+    def total_count(self) -> float:
+        return float(sum(self._counts.values()))
+
+    def arg_max(self) -> Optional[K]:
+        if not self._counts:
+            return None
+        return max(self._counts, key=self._counts.get)
+
+    def max_count(self) -> float:
+        return max(self._counts.values()) if self._counts else 0.0
+
+    def normalize(self) -> None:
+        total = self.total_count()
+        if total != 0.0:
+            for k in self._counts:
+                self._counts[k] /= total
+
+    def scale(self, factor: float) -> None:
+        for k in self._counts:
+            self._counts[k] *= factor
+
+    def keep_top_n_keys(self, n: int) -> None:
+        if len(self._counts) <= n:
+            return
+        keep = heapq.nlargest(n, self._counts, key=self._counts.get)
+        self._counts = {k: self._counts[k] for k in keep}
+
+    def sorted_keys(self, descending: bool = True) -> List[K]:
+        return sorted(self._counts, key=self._counts.get,
+                      reverse=descending)
+
+    def as_priority_queue(self) -> "PriorityQueue[K]":
+        pq: PriorityQueue[K] = PriorityQueue()
+        for k, v in self._counts.items():
+            pq.put(k, v)
+        return pq
+
+    def __iter__(self) -> Iterator[K]:
+        return iter(self._counts)
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+    def __repr__(self) -> str:
+        top = ", ".join(
+            f"{k}:{self._counts[k]:g}" for k in self.sorted_keys()[:10])
+        return f"Counter[{top}]"
+
+
+class CounterMap(Generic[K, K2]):
+    """Two-level conditional counts: (key, sub-key) -> float."""
+
+    def __init__(self):
+        self._maps: Dict[K, Counter[K2]] = {}
+
+    def get_counter(self, key: K) -> Counter[K2]:
+        c = self._maps.get(key)
+        if c is None:
+            c = Counter()
+            self._maps[key] = c
+        return c
+
+    def get_count(self, key: K, sub: K2) -> float:
+        c = self._maps.get(key)
+        return c.get_count(sub) if c is not None else 0.0
+
+    def set_count(self, key: K, sub: K2, count: float) -> None:
+        self.get_counter(key).set_count(sub, count)
+
+    def increment_count(self, key: K, sub: K2,
+                        amount: float = 1.0) -> None:
+        self.get_counter(key).increment_count(sub, amount)
+
+    def contains_key(self, key: K) -> bool:
+        return key in self._maps
+
+    def key_set(self):
+        return self._maps.keys()
+
+    def total_count(self) -> float:
+        return float(sum(c.total_count() for c in self._maps.values()))
+
+    def total_size(self) -> int:
+        return sum(c.size() for c in self._maps.values())
+
+    def normalize(self) -> None:
+        """Row-normalize: each inner counter becomes a distribution."""
+        for c in self._maps.values():
+            c.normalize()
+
+    def __iter__(self) -> Iterator[K]:
+        return iter(self._maps)
+
+    def __len__(self) -> int:
+        return len(self._maps)
+
+
+class PriorityQueue(Generic[T]):
+    """Max-priority queue with stable ordering and lazy deletion.
+
+    Mirrors berkeley/PriorityQueue.java (peek/getPriority/put/next);
+    built on heapq with negated priorities.
+    """
+
+    def __init__(self):
+        self._heap: List[Tuple[float, int, T]] = []
+        self._tie = itertools.count()
+
+    def put(self, item: T, priority: float) -> None:
+        heapq.heappush(self._heap, (-priority, next(self._tie), item))
+
+    def peek(self) -> T:
+        if not self._heap:
+            raise IndexError("empty priority queue")
+        return self._heap[0][2]
+
+    def get_priority(self) -> float:
+        if not self._heap:
+            raise IndexError("empty priority queue")
+        return -self._heap[0][0]
+
+    def next(self) -> T:
+        if not self._heap:
+            raise IndexError("empty priority queue")
+        return heapq.heappop(self._heap)[2]
+
+    def is_empty(self) -> bool:
+        return not self._heap
+
+    def size(self) -> int:
+        return len(self._heap)
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __iter__(self) -> Iterator[T]:
+        """Drains in priority order (like the reference's iterator)."""
+        while self._heap:
+            yield self.next()
+
+
+# ---------------------------------------------------------------------
+# Thread-level parallelism helper (Parallelization.java equivalent).
+# Used host-side only — device math goes through jit/pjit, but vocab
+# scans, random-walk generation and co-occurrence counting are
+# CPU-bound iterator work where a thread pool is the right tool.
+# ---------------------------------------------------------------------
+
+def run_in_parallel(tasks: Sequence[Callable[[], R]],
+                    max_workers: Optional[int] = None) -> List[R]:
+    """Run independent thunks on a thread pool; results in input order.
+
+    Reference ``Parallelization.runInParallel`` (Parallelization.java:37)
+    dispatched Runnables on an Akka dispatcher; here a plain executor.
+    Raises the first exception encountered, like the reference's
+    fail-fast await.
+    """
+    if not tasks:
+        return []
+    with ThreadPoolExecutor(max_workers=max_workers) as ex:
+        return list(ex.map(lambda f: f(), tasks))
+
+
+def iterate_in_parallel(items: Iterable[T], fn: Callable[[T], R],
+                        max_workers: Optional[int] = None) -> List[R]:
+    """Apply ``fn`` to each item concurrently; results in input order."""
+    with ThreadPoolExecutor(max_workers=max_workers) as ex:
+        return list(ex.map(fn, items))
+
+
+class AtomicDouble:
+    """Lock-guarded accumulator for cross-thread score/count merging."""
+
+    def __init__(self, value: float = 0.0):
+        self._value = float(value)
+        self._lock = threading.Lock()
+
+    def add_and_get(self, delta: float) -> float:
+        with self._lock:
+            self._value += delta
+            return self._value
+
+    def get(self) -> float:
+        with self._lock:
+            return self._value
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)

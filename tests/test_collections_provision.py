@@ -1,0 +1,153 @@
+"""Tests: berkeley-style collections, parallel helpers, POS tokenizer,
+sentiment lexicon, cluster provisioning plans."""
+
+import os
+import tempfile
+
+from deeplearning4j_tpu.util.collections import (
+    AtomicDouble, Counter, CounterMap, Pair, PriorityQueue, Triple,
+    iterate_in_parallel, run_in_parallel)
+from deeplearning4j_tpu.nlp.tokenization import (
+    PosTokenizerFactory, RuleBasedPosTagger)
+from deeplearning4j_tpu.nlp.sentiment import SentiWordNet, load_lexicon
+from deeplearning4j_tpu.scaleout.provision import (
+    ClusterSetup, HostProvisioner, TpuPodProvisioner, TpuPodSpec)
+
+
+def test_counter_basics():
+    c = Counter(["a", "b", "a", "a"])
+    assert c.get_count("a") == 3 and c.get_count("b") == 1
+    assert c.arg_max() == "a" and c.max_count() == 3
+    assert c.total_count() == 4
+    c.increment_count("b", 5)
+    assert c.arg_max() == "b"
+    c.normalize()
+    assert abs(c.total_count() - 1.0) < 1e-9
+    assert c.sorted_keys()[0] == "b"
+
+
+def test_counter_top_n_and_merge():
+    c = Counter()
+    for i in range(10):
+        c.set_count(f"w{i}", i)
+    c.keep_top_n_keys(3)
+    assert set(c.key_set()) == {"w9", "w8", "w7"}
+    other = Counter()
+    other.set_count("w9", 1.0)
+    c.increment_all(other, scale=2.0)
+    assert c.get_count("w9") == 11.0
+
+
+def test_counter_map():
+    cm = CounterMap()
+    cm.increment_count("the", "cat")
+    cm.increment_count("the", "cat")
+    cm.increment_count("the", "dog")
+    cm.increment_count("a", "dog")
+    assert cm.get_count("the", "cat") == 2
+    assert cm.total_count() == 4 and cm.total_size() == 3
+    cm.normalize()
+    assert abs(cm.get_count("the", "cat") - 2 / 3) < 1e-9
+    assert cm.get_count("missing", "x") == 0.0
+
+
+def test_priority_queue_order_and_counter_bridge():
+    pq = PriorityQueue()
+    pq.put("low", 1.0)
+    pq.put("high", 9.0)
+    pq.put("mid", 5.0)
+    assert pq.peek() == "high" and pq.get_priority() == 9.0
+    assert list(pq) == ["high", "mid", "low"]
+    assert pq.is_empty()
+
+    c = Counter({"x": 1})
+    c.set_count("y", 7)
+    assert c.as_priority_queue().next() == "y"
+
+
+def test_parallel_helpers():
+    results = run_in_parallel([lambda i=i: i * i for i in range(8)])
+    assert results == [i * i for i in range(8)]
+    assert iterate_in_parallel(range(5), lambda x: x + 1) == [1, 2, 3, 4, 5]
+
+    acc = AtomicDouble()
+    iterate_in_parallel(range(100), lambda _: acc.add_and_get(1.0))
+    assert acc.get() == 100.0
+
+
+def test_pair_triple():
+    p = Pair(1, "a")
+    assert p.first == 1 and p.second == "a"
+    t = Triple(1, 2, 3)
+    assert (t.first, t.second, t.third) == (1, 2, 3)
+
+
+def test_pos_tagger_and_filter():
+    tagger = RuleBasedPosTagger()
+    assert tagger.tag("the") == "DT"
+    assert tagger.tag("quickly") == "RB"
+    assert tagger.tag("running") == "VB"
+    assert tagger.tag("cat") == "NN"
+    fac = PosTokenizerFactory(["NN"])
+    toks = fac.create("the cat jumped quickly").get_tokens()
+    assert toks == ["NONE", "cat", "NONE", "NONE"]
+
+
+def test_sentiment_seed_and_negation():
+    swn = SentiWordNet()
+    assert swn.score_word("good") > 0 > swn.score_word("terrible")
+    assert swn.classify("this movie was great and wonderful".split()) \
+        == "positive"
+    assert swn.classify("the worst awful film".split()) == "negative"
+    assert swn.score("not good".split()) < 0
+
+
+def test_sentiment_tsv_loading():
+    tsv = ("# comment line\n"
+           "a\t00001\t0.75\t0.0\tgood#1\n"
+           "a\t00002\t0.25\t0.5\tgood#2\n"
+           "n\t00003\t0.0\t0.875\tdreadful#1\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write(tsv)
+        path = f.name
+    try:
+        lex = load_lexicon(path)
+        assert abs(lex["good"][0] - 0.5) < 1e-9     # senses averaged
+        assert abs(lex["dreadful"][1] - 0.875) < 1e-9
+        swn = SentiWordNet.from_file(path)
+        assert swn.classify(["dreadful"]) == "negative"
+    finally:
+        os.unlink(path)
+
+
+def test_tpu_pod_plans():
+    spec = TpuPodSpec(name="pod1", accelerator_type="v5litepod-16",
+                      zone="us-east5-a", project="proj", preemptible=True)
+    prov = TpuPodProvisioner(spec)
+    argv = prov.create_plan().argv
+    assert argv[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "pod1" in argv and "--accelerator-type=v5litepod-16" in argv
+    assert "--project=proj" in argv and "--preemptible" in argv
+    assert "delete" in prov.delete_plan().argv
+    assert "list" in prov.list_plan().argv
+
+
+def test_cluster_setup_plans():
+    setup = ClusterSetup(
+        pod=TpuPodSpec(name="c1"), hosts=["h0", "h1"], user="tpu",
+        coordinator_address="h0:9898")
+    plans = setup.provision_plans()
+    assert set(plans) == {"h0", "h1"}
+    upload, launch = plans["h1"]
+    assert upload.argv[0] == "scp" and "tpu@h1" in upload.argv[-1]
+    assert launch.argv[0] == "ssh"
+    assert "--worker-id 1" in launch.argv[-1]
+    full = setup.full_plan()
+    assert full[0].argv[4] == "create" and len(full) == 5
+
+
+def test_host_provisioner_key_file():
+    hp = HostProvisioner("h2", user="u", key_file="/tmp/k")
+    argv = hp.run_plan("echo hi").argv
+    assert "-i" in argv and "/tmp/k" in argv and argv[-1] == "echo hi"
