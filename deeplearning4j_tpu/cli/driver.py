@@ -191,6 +191,78 @@ def _cmd_predict(args) -> int:
     return 0
 
 
+def _cmd_worker(args) -> int:
+    """Long-running cluster worker: register with the coordinator,
+    heartbeat, pull jobs, perform, repeat until the run is marked done
+    (reference WorkerActor pull loop, MasterActor.java:106-139). The
+    performer class is read from the coordinator's config registry
+    (key ``worker.performer`` = "module:ClassName"), mirroring the
+    reference's reflective WorkerPerformerFactory."""
+    import importlib
+    import threading
+    import time as _time
+
+    from deeplearning4j_tpu.scaleout.coordinator import CoordinatorClient
+
+    addr = args.coordinator
+    if "://" not in addr:
+        addr = "http://" + addr
+    tracker = CoordinatorClient(addr)
+    worker_id = f"worker-{args.worker_id}"
+    tracker.add_worker(worker_id)
+
+    # Dedicated 1s heartbeat thread (WorkerActor.java:168): a
+    # long-running perform() must NOT look like a dead worker, or its
+    # in-flight job gets requeued and double-counted (same guard as
+    # runner.py's in-process _Worker).
+    stop = threading.Event()
+
+    def _beat() -> None:
+        while not stop.is_set():
+            try:
+                tracker.heartbeat(worker_id)
+            except OSError:
+                pass  # transient coordinator hiccup; keep beating
+            stop.wait(1.0)
+
+    beat_thread = threading.Thread(target=_beat, daemon=True)
+    beat_thread.start()
+
+    try:
+        # Workers may start before the master registers the performer
+        # (ClusterSetup launches them right after upload) — wait for it.
+        spec = None
+        while spec is None and not tracker.is_done():
+            spec = tracker.get_config("worker.performer")
+            if spec is None:
+                _time.sleep(args.poll_interval)
+        if spec is None:
+            return 0
+        mod_name, _, cls_name = str(spec).partition(":")
+        performer = getattr(importlib.import_module(mod_name), cls_name)()
+
+        seen_version = -1
+        while not tracker.is_done():
+            # Pull the latest aggregated state down before training
+            # (the broadcast leg of the iterative-reduce round).
+            version, value = tracker.poll_update(seen_version)
+            if value is not None:
+                performer.update(value)
+            seen_version = version
+            job = tracker.request_job(worker_id)
+            if job is None:
+                _time.sleep(args.poll_interval)
+                continue
+            result = performer.perform(job)
+            if result is not None:
+                tracker.submit_result(job.job_id, result)
+            tracker.clear_job(job.job_id)
+    finally:
+        stop.set()
+        beat_thread.join(timeout=2.0)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
@@ -236,6 +308,15 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--has-labels", action="store_true",
                    help="input CSV has a trailing label column to strip")
     r.set_defaults(fn=_cmd_predict)
+
+    w = sub.add_parser(
+        "worker",
+        help="run a cluster worker against a coordinator control plane")
+    w.add_argument("--coordinator", required=True,
+                   help="coordinator address host:port")
+    w.add_argument("--worker-id", type=int, default=0)
+    w.add_argument("--poll-interval", type=float, default=0.5)
+    w.set_defaults(fn=_cmd_worker)
     return p
 
 

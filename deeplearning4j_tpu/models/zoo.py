@@ -132,6 +132,58 @@ def lstm_classifier(
     )
 
 
+def transformer_lm(
+    n_in: int = 64,
+    width: int = 128,
+    n_layers: int = 4,
+    n_heads: int = 4,
+    n_classes: int = 64,
+    lr: float = 1e-3,
+    seed: int = 12345,
+    ring_axis=None,
+    remat: bool = False,
+):
+    """Causal transformer over [N, C, T] sequences — the long-context
+    flagship. NEW capability vs the reference (2015, pre-attention;
+    SURVEY.md §5.7 mandates first-class long-context): stacked causal
+    multi-head self-attention; ``ring_axis`` turns every attention core
+    into ring attention over that mesh axis (sequence parallelism over
+    ICI), and ``remat`` rematerializes per-layer activations so depth x
+    sequence-length activation memory stays within HBM."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        MultiHeadSelfAttention,
+    )
+
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(Updater.ADAM)
+        .activation("identity")
+        .weight_init(WeightInit.XAVIER)
+        .list()
+    )
+    for i in range(n_layers):
+        b.layer(
+            i,
+            MultiHeadSelfAttention(
+                n_in=n_in if i == 0 else width,
+                n_out=width,
+                n_heads=n_heads,
+                causal=True,
+                ring_axis=ring_axis,
+            ),
+        )
+    b.layer(
+        n_layers,
+        L.RnnOutputLayer(
+            n_in=width, n_out=n_classes, activation="softmax",
+            loss_function=LossFunction.MCXENT,
+        ),
+    )
+    return b.remat(remat).build()
+
+
 def dbn(
     sizes: Sequence[int] = (784, 500, 250, 10),
     lr: float = 0.05,

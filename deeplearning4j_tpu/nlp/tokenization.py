@@ -144,11 +144,19 @@ class PosTokenizerFactory(TokenizerFactory):
         self.tagger = tagger or RuleBasedPosTagger()
 
     def create(self, text: str) -> Tokenizer:
+        # Preprocess/tag first; placeholders are exempt from further
+        # preprocessing so window offsets survive intact (a preprocessed
+        # token that becomes empty also collapses to the placeholder
+        # rather than being dropped).
         kept = []
         for w in text.split():
-            tag = self.tagger.tag(w)
-            kept.append(w if tag in self.allowed_pos else self.PLACEHOLDER)
-        return Tokenizer(kept, self.preprocessor)
+            token = (self.preprocessor.pre_process(w)
+                     if self.preprocessor else w)
+            if token and self.tagger.tag(token) in self.allowed_pos:
+                kept.append(token)
+            else:
+                kept.append(self.PLACEHOLDER)
+        return Tokenizer(kept, None)
 
 
 class NGramTokenizerFactory(TokenizerFactory):

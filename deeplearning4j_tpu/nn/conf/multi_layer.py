@@ -33,6 +33,10 @@ class MultiLayerConfiguration:
     backprop_type: BackpropType = BackpropType.STANDARD
     tbptt_fwd_length: int = 20
     tbptt_bwd_length: int = 20
+    # Rematerialize per-layer activations in backward (jax.checkpoint):
+    # trades recompute FLOPs for HBM — the TPU answer to deep stacks /
+    # long sequences whose activation footprint exceeds HBM.
+    remat: bool = False
 
     def __post_init__(self):
         # JSON object keys are strings; keep them that way internally and
@@ -83,6 +87,7 @@ class ListBuilder:
         self._tbptt_fwd = 20
         self._tbptt_bwd = 20
         self._input_type = None
+        self._remat = False
 
     def layer(self, index: int, layer_bean: L.Layer) -> "ListBuilder":
         self._layers[index] = layer_bean
@@ -112,6 +117,10 @@ class ListBuilder:
 
     def t_bptt_backward_length(self, n: int) -> "ListBuilder":
         self._tbptt_bwd = n
+        return self
+
+    def remat(self, flag: bool = True) -> "ListBuilder":
+        self._remat = flag
         return self
 
     def set_input_type(self, input_type) -> "ListBuilder":
@@ -149,6 +158,7 @@ class ListBuilder:
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_bwd_length=self._tbptt_bwd,
+            remat=self._remat,
         )
         if self._input_type is not None:
             from deeplearning4j_tpu.nn.conf.inputs import setup_shapes

@@ -129,14 +129,18 @@ class MultiLayerNetwork:
                 layer_state = rnn_state[si]
             is_recurrent = isinstance(c.layer, L.RECURRENT_LAYER_TYPES)
             mask = feature_mask if is_recurrent else None
-            x, st = impl.apply(
-                c,
-                params[si],
-                x,
-                state=layer_state,
-                train=train,
-                rng=rngs[i] if train else None,
-                mask=mask,
+
+            def _apply(p, xin, lst, lrng, lmask, _c=c, _impl=impl):
+                return _impl.apply(
+                    _c, p, xin, state=lst, train=train, rng=lrng,
+                    mask=lmask,
+                )
+
+            if self.conf.remat:
+                _apply = jax.checkpoint(_apply)
+            x, st = _apply(
+                params[si], x, layer_state,
+                rngs[i] if train else None, mask,
             )
             if st is not None:
                 if state and si in state:

@@ -71,6 +71,10 @@ class _State:
         self.barriers: Dict[str, _Barrier] = {}
         self.best_score: Optional[float] = None
         self.best_model_b64: Optional[str] = None
+        self.results: List[Dict[str, Any]] = []
+        self.next_result_id = 0
+        self.update_version = 0
+        self.update_b64: Optional[str] = None
 
     def evict(self, worker_id: str) -> int:
         """Remove a worker and requeue its in-flight jobs; returns the
@@ -113,6 +117,17 @@ class _Handler(JsonHandler):
         if parsed.path == "/best":
             return {"score": st.best_score,
                     "model_b64": st.best_model_b64}, 200
+        if parsed.path == "/results":
+            # Non-destructive read; removal happens on POST /results/ack
+            # so a dropped response never loses results.
+            return {"results": list(st.results)}, 200
+        if parsed.path == "/update":
+            since = int(urllib.parse.parse_qs(parsed.query)
+                        .get("since", ["-1"])[0])
+            if st.update_b64 is not None and st.update_version > since:
+                return {"version": st.update_version,
+                        "value_b64": st.update_b64}, 200
+            return {"version": st.update_version}, 200
         return {"error": "not found"}, 404
 
     def do_POST(self) -> None:
@@ -147,6 +162,25 @@ class _Handler(JsonHandler):
         if self.path == "/job/done":
             st.in_flight.pop(body["job_id"], None)
             return {"ok": True}, 200
+        if self.path == "/result":
+            rid = st.next_result_id
+            st.next_result_id += 1
+            st.results.append({"result_id": rid,
+                               "job_id": body["job_id"],
+                               "result_b64": body["result_b64"]})
+            return {"result_id": rid}, 200
+        if self.path == "/results/ack":
+            acked = set(body["result_ids"])
+            st.results = [r for r in st.results
+                          if r["result_id"] not in acked]
+            return {"ok": True}, 200
+        if self.path == "/update":
+            # Aggregated state pushed down by the master; workers poll
+            # GET /update?since=<version> (the WorkerPerformer.update
+            # downlink of the reference's iterative-reduce round).
+            st.update_version += 1
+            st.update_b64 = body["value_b64"]
+            return {"version": st.update_version}, 200
         if self.path == "/barrier":
             bar = st.barriers.setdefault(body["name"], _Barrier())
             gen = body.get("gen")
@@ -243,6 +277,41 @@ class CoordinatorClient(StateTracker):
 
     def clear_job(self, job_id: int) -> None:
         self._call("/job/done", {"job_id": job_id})
+
+    def submit_result(self, job_id: int, result: Any) -> None:
+        """Ship a per-job result (e.g. trained params) back to the
+        master for aggregation (the executor→driver leg of the
+        reference's param-averaging round, SparkDl4jMultiLayer :355)."""
+        blob = base64.b64encode(pickle.dumps(result)).decode()
+        self._call("/result", {"job_id": job_id, "result_b64": blob})
+
+    def drain_results(self) -> List[Tuple[int, Any]]:
+        """Master side: read-then-ack all accumulated (job_id, result)
+        pairs. Results are only removed server-side after this client
+        has decoded them, so a dropped response is retryable."""
+        got = self._call("/results")["results"]
+        out = [(r["job_id"],
+                pickle.loads(base64.b64decode(r["result_b64"])))
+               for r in got]
+        if got:
+            self._call("/results/ack",
+                       {"result_ids": [r["result_id"] for r in got]})
+        return out
+
+    def push_update(self, value: Any) -> int:
+        """Master side: publish aggregated state for workers to pull
+        (the params-fan-out leg, reference broadcast :307)."""
+        blob = base64.b64encode(pickle.dumps(value)).decode()
+        return int(self._call("/update", {"value_b64": blob})["version"])
+
+    def poll_update(self, since: int) -> Tuple[int, Any]:
+        """Worker side: fetch the aggregated state newer than
+        ``since``; returns (version, value|None)."""
+        got = self._call("/update", query={"since": str(since)})
+        if "value_b64" in got:
+            return int(got["version"]), pickle.loads(
+                base64.b64decode(got["value_b64"]))
+        return int(got["version"]), None
 
     def requeue_jobs_of(self, worker_id: str) -> int:
         return int(self._call("/worker/evict",

@@ -1,0 +1,64 @@
+"""Concrete WorkerPerformers.
+
+Mirror of the reference's BaseMultiLayerNetworkWorkPerformer /
+NeuralNetWorkPerformer (scaleout-akka testsupport + akka work
+performers, SURVEY.md §2.7): a job carries (conf JSON, minibatch); the
+performer rebuilds the network from JSON — conf-as-wire-format, exactly
+how Spark executors do it (IterativeReduceFlatMap.call :75-102) — fits
+it, and returns the trained params for master-side averaging. ``update``
+absorbs the aggregated params pushed back down.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.scaleout.api import Job, WorkerPerformer
+
+
+class NeuralNetWorkPerformer(WorkerPerformer):
+    """job.work = {"conf": <MultiLayerConfiguration JSON>,
+                   "features": array-like, "labels": array-like}.
+    Returns {"params": pytree, "score": float}."""
+
+    def __init__(self, conf_json: Optional[str] = None):
+        self._conf_json = conf_json
+        self._net = None
+        self._pending_params: Optional[Dict[str, Any]] = None
+
+    def _network(self, conf_json: str):
+        from deeplearning4j_tpu.nn.conf.multi_layer import (
+            MultiLayerConfiguration,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        if self._net is None or conf_json != self._conf_json:
+            self._conf_json = conf_json
+            self._net = MultiLayerNetwork(
+                MultiLayerConfiguration.from_json(conf_json)).init()
+        if self._pending_params is not None:
+            self._net.params = self._pending_params
+            self._pending_params = None
+        return self._net
+
+    def perform(self, job: Job) -> Dict[str, Any]:
+        work = job.work
+        net = self._network(work["conf"])
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        ds = DataSet(np.asarray(work["features"], np.float32),
+                     np.asarray(work["labels"], np.float32))
+        net.fit(ds)
+        return {"params": net.params, "score": float(net.score_value)}
+
+    def update(self, value: Any) -> None:
+        """Aggregated params pushed down (reference WorkerPerformer
+        .update): applied lazily before the next perform()."""
+        if isinstance(value, dict) and "params" in value:
+            value = value["params"]
+        if self._net is not None:
+            self._net.params = value
+        else:
+            self._pending_params = value

@@ -142,7 +142,7 @@ class ClusterSetup:
             launch = (
                 "nohup python -m deeplearning4j_tpu.cli worker"
                 f" --coordinator {self.coordinator_address}"
-                f" --worker-id {i} --num-workers {len(self.hosts)}"
+                f" --worker-id {i}"
                 " > worker.log 2>&1 &")
             plans[host] = [
                 hp.upload_plan(self.wheel_path, "~/deeplearning4j_tpu"),
@@ -164,6 +164,10 @@ class ClusterSetup:
             raise RuntimeError(
                 "gcloud not found: cannot execute provisioning plan "
                 "(inspect .full_plan() instead)")
+        if self.hosts and not HostProvisioner.available():
+            raise RuntimeError(
+                "ssh/scp not found: cannot provision hosts "
+                "(inspect .provision_plans() instead)")
         results = [TpuPodProvisioner(self.pod).create_plan().execute(check)]
         host_plans = list(self.provision_plans().values())
 

@@ -1,0 +1,127 @@
+"""CLI `worker` subcommand end-to-end: coordinator + worker thread
+perform real conf-JSON training jobs and ship params back (the
+multi-process face of the param-averaging round)."""
+
+import threading
+
+import numpy as np
+import jax
+
+from deeplearning4j_tpu.cli.driver import build_parser, main as cli_main
+from deeplearning4j_tpu.models.zoo import mlp
+from deeplearning4j_tpu.scaleout.coordinator import (
+    CoordinatorClient, CoordinatorServer)
+from deeplearning4j_tpu.scaleout.performers import NeuralNetWorkPerformer
+from deeplearning4j_tpu.scaleout.api import Job
+
+
+def _batch(seed, n=16):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 20)).astype(np.float32)
+    y = np.zeros((n, 3), np.float32)
+    y[np.arange(n), rng.integers(0, 3, n)] = 1.0
+    return x.tolist(), y.tolist()
+
+
+def test_worker_subcommand_parses():
+    args = build_parser().parse_args(
+        ["worker", "--coordinator", "127.0.0.1:9", "--worker-id", "3"])
+    assert args.worker_id == 3 and args.fn is not None
+
+
+def test_results_survive_dropped_response_and_update_roundtrip():
+    """Results are removed only on ack; /update fans aggregated state
+    back down."""
+    server = CoordinatorServer()
+    server.start()
+    try:
+        c = CoordinatorClient(server.address)
+        c.submit_result(7, {"w": 1.5})
+        # a first (hypothetically dropped) read does not lose results
+        assert len(c._call("/results")["results"]) == 1
+        got = c.drain_results()
+        assert got == [(7, {"w": 1.5})]
+        assert c.drain_results() == []  # acked away
+
+        v1 = c.push_update({"params": [1, 2]})
+        version, value = c.poll_update(since=-1)
+        assert version == v1 and value == {"params": [1, 2]}
+        version2, value2 = c.poll_update(since=v1)
+        assert version2 == v1 and value2 is None  # nothing newer
+    finally:
+        server.stop()
+
+
+def test_cli_worker_end_to_end():
+    server = CoordinatorServer()
+    server.start()
+    try:
+        addr = server.address  # already "http://host:port"
+        master = CoordinatorClient(addr)
+        conf_json = mlp((20, 8, 3)).to_json()
+        master.set_config(
+            "worker.performer",
+            "deeplearning4j_tpu.scaleout.performers:NeuralNetWorkPerformer")
+        for seed in range(3):
+            x, y = _batch(seed)
+            master.add_job(Job(work={"conf": conf_json,
+                                    "features": x, "labels": y}))
+
+        worker = threading.Thread(
+            target=cli_main,
+            args=(["worker", "--coordinator", addr,
+                   "--worker-id", "0", "--poll-interval", "0.05"],),
+            daemon=True)
+        worker.start()
+
+        import time
+        deadline = time.monotonic() + 60
+        results = []
+        while len(results) < 3 and time.monotonic() < deadline:
+            results.extend(master.drain_results())
+            time.sleep(0.1)
+        assert len(results) == 3
+        for _, r in results:
+            assert np.isfinite(r["score"])
+            assert "0" in r["params"]
+
+        # driver-side param averaging over returned results, pushed back
+        # down the /update leg (the full iterative-reduce round)
+        mean = jax.tree.map(
+            lambda *ps: sum(np.asarray(p) for p in ps) / len(ps),
+            *[r["params"] for _, r in results])
+        assert np.all(np.isfinite(np.asarray(mean["0"]["W"])))
+        master.push_update({"params": mean})
+
+        # next job trains FROM the averaged params the worker pulled
+        x, y = _batch(99)
+        master.add_job(Job(work={"conf": conf_json,
+                                 "features": x, "labels": y}))
+        deadline = time.monotonic() + 60
+        round2 = []
+        while not round2 and time.monotonic() < deadline:
+            round2.extend(master.drain_results())
+            time.sleep(0.1)
+        assert len(round2) == 1
+
+        master.finish()
+        worker.join(timeout=10)
+        assert not worker.is_alive()
+        assert "worker-0" in master.workers()
+    finally:
+        server.stop()
+
+
+def test_performer_update_applies_params():
+    perf = NeuralNetWorkPerformer()
+    x, y = _batch(0)
+    conf_json = mlp((20, 8, 3)).to_json()
+    out = perf.perform(Job(work={"conf": conf_json,
+                                 "features": x, "labels": y}))
+    new_params = jax.tree.map(lambda p: p * 0, out["params"])
+    perf.update({"params": new_params})
+    out2 = perf.perform(Job(work={"conf": conf_json,
+                                  "features": x, "labels": y}))
+    # starting from zero params, one step leaves small-magnitude weights
+    assert float(np.abs(np.asarray(out2["params"]["1"]["W"])).max()) < \
+        float(np.abs(np.asarray(out["params"]["1"]["W"])).max()) + 1.0

@@ -1,0 +1,87 @@
+"""Remat (jax.checkpoint) equivalence + transformer LM zoo entry."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.zoo import mlp, transformer_lm
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _mnist_like(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 784)).astype(np.float32)
+    y = np.zeros((n, 10), np.float32)
+    y[np.arange(n), rng.integers(0, 10, n)] = 1.0
+    return DataSet(x, y)
+
+
+def _seq_data(n=8, c=16, t=12, k=8, seed=1):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, c, t)).astype(np.float32)
+    y = np.zeros((n, k, t), np.float32)
+    idx = rng.integers(0, k, (n, t))
+    for i in range(n):
+        y[i, idx[i], np.arange(t)] = 1.0
+    return DataSet(x, y)
+
+
+def test_remat_matches_standard_training():
+    """remat=True must be numerically identical — it only changes the
+    backward-pass memory/recompute schedule, not the math."""
+    ds = _mnist_like()
+    conf_a = mlp((784, 64, 10))
+    conf_b = mlp((784, 64, 10))
+    conf_b.remat = True
+    assert conf_b.to_json() != conf_a.to_json()  # field serializes
+
+    net_a = MultiLayerNetwork(conf_a).init()
+    net_b = MultiLayerNetwork(conf_b).init()
+    for _ in range(3):
+        net_a.fit(ds)
+        net_b.fit(ds)
+    for k in net_a.params:
+        for name in net_a.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(net_a.params[k][name]),
+                np.asarray(net_b.params[k][name]),
+                rtol=1e-5, atol=1e-6,
+            )
+
+
+def test_remat_json_roundtrip():
+    from deeplearning4j_tpu.nn.conf.multi_layer import (
+        MultiLayerConfiguration,
+    )
+
+    conf = transformer_lm(n_in=8, width=16, n_layers=2, n_heads=2,
+                          n_classes=4, remat=True)
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.remat is True
+
+
+def test_transformer_lm_trains():
+    ds = _seq_data(c=16, k=8)
+    conf = transformer_lm(n_in=16, width=32, n_layers=2, n_heads=2,
+                          n_classes=8, lr=3e-3, seed=7)
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ds)
+    first = net.score_value
+    for _ in range(30):
+        net.fit(ds)
+    assert net.score_value < first * 0.7
+    out = net.output(jnp.asarray(ds.features))
+    assert out.shape == (8, 8, 12)
+    # rows are distributions over classes at each timestep
+    np.testing.assert_allclose(
+        np.asarray(out).sum(axis=1), np.ones((8, 12)), rtol=1e-4)
+
+
+def test_transformer_lm_remat_trains():
+    ds = _seq_data(c=16, k=8)
+    conf = transformer_lm(n_in=16, width=32, n_layers=2, n_heads=2,
+                          n_classes=8, lr=3e-3, seed=7, remat=True)
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(5):
+        net.fit(ds)
+    assert np.isfinite(net.score_value)
