@@ -1,0 +1,216 @@
+"""Distributed-style text → vocab pipeline and partitioned cumulative sums.
+
+TPU-native equivalent of the Spark NLP driver pipeline (reference
+dl4j-spark-nlp/.../text/functions/TextPipeline.java and CountCumSum.java):
+the corpus is a list of partitions (the RDD analogue), tokenization and
+word-frequency counting run per-partition on a thread pool (the
+accumulator analogue is a merged Counter), low-frequency words collapse to
+UNK, and the resulting VocabCache gets Huffman codes assigned before any
+worker consumes it — the same order the reference enforces ("huffman tree
+should be built BEFORE vocab broadcast").
+
+``CountCumSum`` mirrors the reference's two-phase partition scan: fold
+within each partition, broadcast per-partition maxima, then offset between
+partitions — the shape an XLA ``associative_scan`` would take over a mesh
+axis; here partitions are host shards so the fold runs on host threads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..util.collections import Counter, run_in_parallel
+from .tokenization import DefaultTokenizerFactory, NGramTokenizerFactory
+from .vocab import VocabCache, assign_huffman_codes
+
+UNK = "UNK"
+
+
+def _as_partitions(corpus) -> List[List[str]]:
+    """Accept either a flat list of sentences or a list of partitions."""
+    if not corpus:
+        return []
+    if isinstance(corpus[0], (list, tuple)):
+        return [list(p) for p in corpus]
+    return [list(corpus)]
+
+
+class TextPipeline:
+    """Corpus partitions → tokenized sentences, word frequencies, VocabCache
+    with Huffman codes, vocab-word-index lists and per-sentence counts.
+
+    Config knobs mirror the reference's broadcast tokenizer var map
+    (TextPipeline.java setRDDVarMap): ``num_words`` (min frequency),
+    ``n_grams``, ``use_unk``, ``stop_words``. Stop words count under (and
+    index to) the shared STOP marker, as in the reference accumulator.
+    """
+
+    def __init__(
+        self,
+        corpus,
+        num_words: int = 1,
+        n_grams: int = 1,
+        tokenizer_factory=None,
+        stop_words: Optional[Sequence[str]] = None,
+        use_unk: bool = True,
+        max_workers: Optional[int] = None,
+    ):
+        self.partitions = _as_partitions(corpus)
+        self.num_words = num_words
+        self.use_unk = use_unk
+        self.stop_words = set(stop_words or [])
+        self.max_workers = max_workers
+        if tokenizer_factory is None:
+            tokenizer_factory = (
+                NGramTokenizerFactory(n_min=1, n_max=n_grams) if n_grams > 1
+                else DefaultTokenizerFactory()
+            )
+        self.tokenizer_factory = tokenizer_factory
+
+        self.word_freq: Counter[str] = Counter()
+        self.vocab_cache = VocabCache()
+        self._tokenized: Optional[List[List[List[str]]]] = None
+        self._sentence_word_counts: Optional[List[List[int]]] = None
+        self.total_word_count = 0
+
+    # -- stage 1: tokenize (per partition, in parallel) ------------------
+    def tokenize(self) -> List[List[List[str]]]:
+        if self._tokenized is None:
+            def run(part: List[str]) -> List[List[str]]:
+                tf = self.tokenizer_factory
+                return [tf.create(s).get_tokens() for s in part]
+
+            self._tokenized = run_in_parallel(
+                [lambda p=p: run(p) for p in self.partitions],
+                max_workers=self.max_workers,
+            )
+        return self._tokenized
+
+    # -- stage 2: word-frequency "accumulator" ---------------------------
+    def update_word_freq_accumulator(self) -> Counter:
+        """Per-partition counts merged into one Counter; stop words count
+        as the STOP marker like the reference accumulator function."""
+        tokenized = self.tokenize()
+
+        def count(part: List[List[str]]) -> Counter:
+            c: Counter[str] = Counter()
+            for tokens in part:
+                for tok in tokens:
+                    c.increment_count("STOP" if tok in self.stop_words else tok)
+            return c
+
+        partials = run_in_parallel(
+            [lambda p=p: count(p) for p in tokenized],
+            max_workers=self.max_workers,
+        )
+        self.word_freq = Counter()
+        for c in partials:
+            self.word_freq.increment_all(c)
+        self._sentence_word_counts = [
+            [len(tokens) for tokens in part] for part in tokenized
+        ]
+        return self.word_freq
+
+    def filter_min_word_add_vocab(self, word_freq: Counter) -> None:
+        if word_freq.is_empty():
+            raise ValueError(
+                "word frequency counter is empty — run "
+                "update_word_freq_accumulator() on a non-empty corpus first"
+            )
+        for word in word_freq.key_set():
+            count = int(word_freq.get_count(word))
+            token = UNK if count < self.num_words else word
+            if token == UNK and not self.use_unk:
+                continue
+            self.vocab_cache.add_token(token, count)
+        self.vocab_cache.finalize_indices()
+
+    # -- stage 3: vocab + Huffman ----------------------------------------
+    def build_vocab_cache(self) -> VocabCache:
+        self.filter_min_word_add_vocab(self.update_word_freq_accumulator())
+        assign_huffman_codes(self.vocab_cache)
+        return self.vocab_cache
+
+    # -- stage 4: sentence → vocab-index lists ---------------------------
+    def build_vocab_word_list(self) -> List[List[List[int]]]:
+        """Per partition, per sentence: vocab indices (OOV → UNK index when
+        available, else dropped) — the vocabWordListRDD analogue."""
+        if self.vocab_cache.num_words() == 0:
+            self.build_vocab_cache()
+        unk_idx = self.vocab_cache.index_of(UNK)
+        stop_idx = self.vocab_cache.index_of("STOP")
+        out = []
+        for part in self.tokenize():
+            rows = []
+            for tokens in part:
+                idxs = []
+                for tok in tokens:
+                    if tok in self.stop_words:
+                        i = stop_idx
+                    else:
+                        i = self.vocab_cache.index_of(tok)
+                    if i < 0:
+                        i = unk_idx
+                    if i >= 0:
+                        idxs.append(i)
+                rows.append(idxs)
+            out.append(rows)
+        self.total_word_count = sum(
+            sum(counts) for counts in (self._sentence_word_counts or [])
+        )
+        return out
+
+    def sentence_count_partitions(self) -> List[List[int]]:
+        if self._sentence_word_counts is None:
+            self.update_word_freq_accumulator()
+        return list(self._sentence_word_counts or [])
+
+
+class CountCumSum:
+    """Exclusive-prefix offsets of per-sentence word counts across
+    partitions (reference CountCumSum.java): the cumulative word count at
+    each sentence is what anneals the skip-gram learning rate.
+
+    Phase 1 folds within each partition (parallel); phase 2 adds the
+    broadcast per-partition totals as offsets. Returns inclusive sums per
+    sentence, flattened in partition order like the reference's cumSumRDD.
+    """
+
+    def __init__(self, sentence_count_partitions: Sequence[Sequence[int]],
+                 max_workers: Optional[int] = None):
+        self.partitions = [list(p) for p in sentence_count_partitions]
+        self.max_workers = max_workers
+        self._within: Optional[List[List[int]]] = None
+        self._max_per_partition: Dict[int, int] = {}
+
+    def cum_sum_within_partition(self) -> List[List[int]]:
+        def fold(part: List[int]) -> List[int]:
+            acc, out = 0, []
+            for c in part:
+                acc += c
+                out.append(acc)
+            return out
+
+        self._within = run_in_parallel(
+            [lambda p=p: fold(p) for p in self.partitions],
+            max_workers=self.max_workers,
+        )
+        self._max_per_partition = {
+            i: (folded[-1] if folded else 0)
+            for i, folded in enumerate(self._within)
+        }
+        return self._within
+
+    def cum_sum_between_partition(self) -> List[int]:
+        if self._within is None:
+            self.cum_sum_within_partition()
+        out: List[int] = []
+        offset = 0
+        for i, folded in enumerate(self._within or []):
+            out.extend(v + offset for v in folded)
+            offset += self._max_per_partition.get(i, 0)
+        return out
+
+    def build_cum_sum(self) -> List[int]:
+        self.cum_sum_within_partition()
+        return self.cum_sum_between_partition()

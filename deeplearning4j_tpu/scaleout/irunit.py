@@ -1,0 +1,221 @@
+"""In-process simulation of the BSP iterative-reduce runtime.
+
+TPU-native equivalent of the reference YARN IRUnit harness (reference
+hadoop-yarn/cdh4/.../iterativereduce/irunit/IRUnitDriver.java and
+runtime/{ComputableMaster,ComputableWorker}.java): a driver that loads a
+properties config, splits the input among N workers, and runs
+master/worker BSP rounds entirely in one process — the pattern the
+reference uses to test its cluster runtime without YARN containers, and
+the pattern our tests use to validate multi-worker training without a
+multi-host TPU mesh. Worker/master classes resolve from dotted import
+paths, mirroring the reference's ``yarn.master.main``/``yarn.worker.main``
+reflective construction.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, Optional, Sequence
+
+# Property keys, matching the reference IRUnitDriver constants.
+APP_OUTPUT_PATH = "app.output.path"
+APP_NUM_ITERATIONS = "app.iteration.count"
+APP_MAIN = "yarn.worker.main"
+MASTER_MAIN = "yarn.master.main"
+APP_INPUT_PATH = "app.input.path"
+
+
+class ComputableMaster:
+    """Master side of the BSP round (reference ComputableMaster.java)."""
+
+    def setup(self, conf: Dict[str, str]) -> None:
+        pass
+
+    def compute(self, worker_updates: List[Any],
+                master_updates: List[Any]) -> Any:
+        raise NotImplementedError
+
+    def get_results(self) -> Any:
+        raise NotImplementedError
+
+    def complete(self, out_path: str) -> None:
+        """Write the final model/update to ``out_path``."""
+        with open(out_path, "w") as f:
+            f.write(repr(self.get_results()))
+
+
+class ComputableWorker:
+    """Worker side of the BSP round (reference ComputableWorker.java)."""
+
+    def setup(self, conf: Dict[str, str]) -> None:
+        pass
+
+    def set_records(self, records: Sequence[Any]) -> None:
+        """The split assigned to this worker (replaces setRecordReader)."""
+        self.records = list(records)
+
+    def compute(self) -> Any:
+        raise NotImplementedError
+
+    def update(self, master_result: Any) -> None:
+        pass
+
+    def get_results(self) -> Any:
+        raise NotImplementedError
+
+
+def _resolve(dotted: str):
+    module, _, name = dotted.rpartition(".")
+    return getattr(importlib.import_module(module), name)
+
+
+def _load_properties(path: str) -> Dict[str, str]:
+    props: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            key, _, value = line.partition("=")
+            props[key.strip()] = value.strip()
+    return props
+
+
+class IRUnitDriver:
+    """Simulate an iterative-reduce run in one process.
+
+    ``props`` is either a path to a Java-style properties file or a dict
+    with the APP_*/MASTER_MAIN keys above. Input records are the lines of
+    ``app.input.path`` (or ``records`` passed directly), dealt into
+    ``num_splits`` contiguous splits — one worker per split, like the
+    reference's one-worker-per-InputSplit setup.
+    """
+
+    def __init__(self, props, records: Optional[Sequence[Any]] = None,
+                 num_splits: int = 1):
+        self.props: Dict[str, str] = (
+            _load_properties(props) if isinstance(props, str) else dict(props)
+        )
+        self._records = list(records) if records is not None else None
+        self.num_splits = max(1, int(num_splits))
+        self.master: Optional[ComputableMaster] = None
+        self.workers: List[ComputableWorker] = []
+
+    def _input_records(self) -> List[Any]:
+        if self._records is not None:
+            return self._records
+        path = self.props.get(APP_INPUT_PATH)
+        if not path:
+            raise ValueError(f"no records given and no {APP_INPUT_PATH} set")
+        with open(path) as f:
+            return [line.rstrip("\n") for line in f if line.strip()]
+
+    def setup(self) -> None:
+        records = self._input_records()
+        conf = dict(self.props)
+
+        self.master = _resolve(self.props[MASTER_MAIN])()
+        self.master.setup(conf)
+
+        worker_cls = _resolve(self.props[APP_MAIN])
+        n = min(self.num_splits, max(1, len(records)))
+        # balanced contiguous splits — never an empty trailing split
+        base, extra = divmod(len(records), n)
+        self.workers = []
+        start = 0
+        for x in range(n):
+            size = base + (1 if x < extra else 0)
+            worker = worker_cls()
+            worker.setup(conf)
+            worker.set_records(records[start:start + size])
+            start += size
+            self.workers.append(worker)
+
+    def simulate_run(self) -> Any:
+        """Run the BSP rounds; returns the master's final result."""
+        if self.master is None:
+            self.setup()
+        assert self.master is not None
+        master_results: List[Any] = []
+        iterations = int(self.props.get(APP_NUM_ITERATIONS, "1"))
+        master_result: Any = None
+        for _ in range(iterations):
+            worker_results = [w.compute() for w in self.workers]
+            master_result = self.master.compute(worker_results, master_results)
+            master_results.append(master_result)
+            for w in self.workers:
+                w.update(master_result)
+        out = self.props.get(APP_OUTPUT_PATH)
+        if out:
+            self.master.complete(out)
+        return master_result
+
+
+class ParameterAveragingMaster(ComputableMaster):
+    """Average worker parameter vectors (reference
+    iterativereduce/impl/multilayer/Master.java ParameterVectorUpdateable
+    averaging)."""
+
+    def compute(self, worker_updates, master_updates):
+        import numpy as np
+
+        stacked = np.stack([np.asarray(u) for u in worker_updates])
+        worker_updates.clear()
+        self._result = stacked.mean(axis=0)
+        return self._result
+
+    def get_results(self):
+        return self._result
+
+    def complete(self, out_path: str) -> None:
+        import numpy as np
+
+        np.save(out_path if out_path.endswith(".npy") else out_path + ".npy",
+                self._result)
+
+
+class ParameterAveragingWorker(ComputableWorker):
+    """Train a MultiLayerNetwork on this worker's CSV split, return its
+    flat parameter vector (reference impl/multilayer/WorkerNode.java).
+
+    Conf JSON arrives via the ``app.conf.json`` property — the same
+    model-config-is-the-wire-format rule the Spark/YARN runtimes use.
+    """
+
+    CONF_KEY = "app.conf.json"
+
+    def setup(self, conf: Dict[str, str]) -> None:
+        from ..nn.conf.multi_layer import MultiLayerConfiguration
+        from ..nn.multilayer import MultiLayerNetwork
+
+        mlc = MultiLayerConfiguration.from_json(conf[self.CONF_KEY])
+        self.net = MultiLayerNetwork(mlc).init()
+        self._n_out = int(mlc.confs[-1].layer.n_out)
+        self._x = self._y = None
+
+    def set_records(self, records: Sequence[Any]) -> None:
+        import numpy as np
+
+        super().set_records(records)
+        feats, labels = [], []
+        for rec in self.records:
+            cols = [float(c) for c in str(rec).split(",")]
+            feats.append(cols[:-1])
+            labels.append(int(cols[-1]))
+        self._x = np.asarray(feats, dtype=np.float32)
+        self._y = np.zeros((len(labels), self._n_out), dtype=np.float32)
+        if labels:
+            self._y[np.arange(len(labels)), labels] = 1.0
+
+    def compute(self):
+        import numpy as np
+
+        if self._x is not None and len(self._x):
+            self.net.fit(self._x, self._y)
+        return np.asarray(self.net.params_flat())
+
+    def update(self, master_result) -> None:
+        self.net.set_params_flat(master_result)
+
+    def get_results(self):
+        return self.net.params_flat()

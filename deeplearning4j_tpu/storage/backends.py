@@ -223,3 +223,59 @@ class StorageModelSaver:
             tmp = os.path.join(d, "model.zip")
             self.backend.get(self.key, tmp)
             return restore_model(tmp)
+
+
+class StorageLock:
+    """Dataset-paths lock over any storage backend.
+
+    TPU-native equivalent of the reference HdfsLock (reference
+    deeplearning4j-hadoop/.../util/HdfsLock.java): a lock node records the
+    list of artifact keys it guards; ``is_locked`` auto-clears the lock
+    when any guarded key has disappeared (the reference's "paths found to
+    be inconsistent" sweep), so a crashed writer never wedges the dataset.
+    The ZooKeeper node becomes a lock key in the backend itself.
+    """
+
+    def __init__(self, backend: StorageBackend, lock_key: str = "hdfslock2"):
+        self.backend = backend
+        self.lock_key = lock_key
+
+    def create(self, keys: List[str]) -> None:
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".lock",
+                                         delete=False) as f:
+            f.write("\n".join(keys) + "\n")
+            tmp = f.name
+        try:
+            self.backend.put(tmp, self.lock_key)
+        finally:
+            os.unlink(tmp)
+
+    def get_paths(self) -> List[str]:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            local = self.backend.get(self.lock_key, os.path.join(d, "lock"))
+            with open(local) as f:
+                return [line.strip() for line in f if line.strip()]
+
+    def is_locked(self) -> bool:
+        if not self.backend.exists(self.lock_key):
+            return False
+        try:
+            for key in self.get_paths():
+                if not self.backend.exists(key):
+                    self.delete()
+                    return False
+        except FileNotFoundError:
+            # lock node vanished between exists() and get(): unlocked
+            return False
+        return True
+
+    def delete(self) -> None:
+        if self.backend.exists(self.lock_key):
+            self.backend.delete(self.lock_key)
+
+    def close(self) -> None:
+        self.delete()

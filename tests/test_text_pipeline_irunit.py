@@ -1,0 +1,292 @@
+"""Tests for the spark-nlp-style TextPipeline/CountCumSum, word-window
+iterators, the IRUnit BSP simulation driver, and the storage lock.
+
+Mirrors the reference's test approach for these modules: tiny real
+corpora/CSVs in-process (TextPipelineTest, IRUnitIrisDBNWorkerTests,
+Word2VecDataSetIteratorTest; SURVEY.md §4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.moving_window import (
+    PAD_END,
+    PAD_START,
+    Window,
+    WindowConverter,
+    context_label_retriever,
+    input_homogenization,
+    windows,
+)
+from deeplearning4j_tpu.nlp.text_pipeline import (
+    UNK,
+    CountCumSum,
+    TextPipeline,
+)
+from deeplearning4j_tpu.scaleout.irunit import (
+    APP_MAIN,
+    APP_NUM_ITERATIONS,
+    MASTER_MAIN,
+    IRUnitDriver,
+)
+from deeplearning4j_tpu.storage.backends import LocalStorage, StorageLock
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick red fox runs",
+    "a lazy dog sleeps",
+]
+
+
+class TestTextPipeline:
+    def test_vocab_build_counts_and_huffman(self):
+        tp = TextPipeline(CORPUS, num_words=1)
+        cache = tp.build_vocab_cache()
+        assert cache.contains_word("the")
+        assert cache.word_for("the").count == 3
+        assert cache.word_for("fox").count == 2
+        # huffman codes assigned before any consumer sees the vocab
+        assert all(w.codes is not None for w in cache.vocab_words())
+
+    def test_min_word_frequency_unk(self):
+        tp = TextPipeline(CORPUS, num_words=2)
+        cache = tp.build_vocab_cache()
+        # words below min frequency collapse into UNK
+        assert not cache.contains_word("jumps")
+        assert cache.contains_word(UNK)
+        assert cache.contains_word("quick")
+
+    def test_no_unk_when_disabled(self):
+        tp = TextPipeline(CORPUS, num_words=2, use_unk=False)
+        cache = tp.build_vocab_cache()
+        assert not cache.contains_word(UNK)
+
+    def test_stop_words_become_stop_marker(self):
+        tp = TextPipeline(CORPUS, num_words=1, stop_words=["the", "a"])
+        freq = tp.update_word_freq_accumulator()
+        assert freq.get_count("STOP") == 4.0
+        assert freq.get_count("the") == 0.0
+
+    def test_partitioned_corpus_matches_flat(self):
+        flat = TextPipeline(CORPUS, num_words=1).build_vocab_cache()
+        parts = TextPipeline([CORPUS[:2], CORPUS[2:]],
+                             num_words=1).build_vocab_cache()
+        assert {w.word: w.count for w in flat.vocab_words()} == \
+            {w.word: w.count for w in parts.vocab_words()}
+
+    def test_stop_words_index_to_stop_marker(self):
+        tp = TextPipeline(CORPUS, num_words=1, stop_words=["the", "a"])
+        idx_parts = tp.build_vocab_word_list()
+        stop_idx = tp.vocab_cache.index_of("STOP")
+        assert stop_idx >= 0
+        # "the quick brown fox ..." starts with a stop word
+        assert idx_parts[0][0][0] == stop_idx
+
+    def test_vocab_word_list_indices(self):
+        tp = TextPipeline(CORPUS, num_words=1)
+        idx_parts = tp.build_vocab_word_list()
+        assert len(idx_parts) == 1
+        sentences = idx_parts[0]
+        assert len(sentences) == len(CORPUS)
+        # every word resolves to a valid vocab index
+        n = tp.vocab_cache.num_words()
+        assert all(0 <= i < n for s in sentences for i in s)
+        assert tp.total_word_count == sum(len(s.split()) for s in CORPUS)
+
+    def test_empty_corpus_raises(self):
+        with pytest.raises(ValueError):
+            TextPipeline([], num_words=1).build_vocab_cache()
+
+
+class TestCountCumSum:
+    def test_matches_numpy_cumsum(self):
+        parts = [[9, 5, 6], [4, 7], [2, 1, 1]]
+        got = CountCumSum(parts).build_cum_sum()
+        flat = [c for p in parts for c in p]
+        assert got == list(np.cumsum(flat))
+
+    def test_empty_partitions(self):
+        assert CountCumSum([[], [3], []]).build_cum_sum() == [3]
+
+
+class TestMovingWindow:
+    def test_windows_padding_and_focus(self):
+        ws = windows("hello brave new world", window_size=5)
+        assert len(ws) == 4
+        assert ws[0].as_tokens() == [PAD_START, PAD_START, "hello", "brave",
+                                     "new"]
+        assert ws[0].focus_word() == "hello"
+        assert ws[-1].as_tokens() == ["brave", "new", "world", PAD_END,
+                                      PAD_END]
+        assert ws[-1].focus_word() == "world"
+
+    def test_input_homogenization(self):
+        assert input_homogenization("Hello, World!") == "hello world"
+        # label tags survive homogenization
+        assert "<POS>" in input_homogenization("<POS> Great stuff! </POS>")
+
+    def test_context_label_retriever(self):
+        plain, pairs = context_label_retriever(
+            "<NEG> terrible </NEG> but <POS> nice </POS>")
+        assert plain == "terrible but nice"
+        assert pairs == [("terrible", "NEG"), ("but", "NONE"),
+                         ("nice", "POS")]
+
+    def test_window_converter_shapes(self):
+        class FakeVec:
+            layer_size = 4
+            window = 3
+
+            def get_word_vector(self, word):
+                return np.full(4, float(len(word)))
+
+        ws = windows("a bb ccc", window_size=3)
+        mat = WindowConverter.as_example_matrix(ws, FakeVec())
+        assert mat.shape == (3, 12)
+        # middle window is [a, bb, ccc]
+        assert list(mat[1][:4]) == [1.0] * 4
+        assert list(mat[1][4:8]) == [2.0] * 4
+        assert list(mat[1][8:]) == [3.0] * 4
+
+
+class TestWord2VecDataSetIterator:
+    def test_batches_shapes_and_labels(self):
+        from deeplearning4j_tpu.nlp.sentence_iterator import (
+            LabelledCollectionSentenceIterator,
+        )
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        from deeplearning4j_tpu.nlp.word2vec_iterator import (
+            Word2VecDataSetIterator,
+        )
+
+        sentences = ["the cat sat", "dogs run fast", "the dog barks"]
+        vec = (
+            Word2Vec.Builder()
+            .layer_size(8)
+            .window_size(3)
+            .min_word_frequency(1)
+            .epochs(1)
+            .seed(42)
+            .build()
+        )
+        vec.build_vocab_from([s.split() for s in sentences])
+        vec.fit(lambda: iter([s.split() for s in sentences]))
+
+        labels = ["A", "B"]
+        it = Word2VecDataSetIterator(
+            vec,
+            LabelledCollectionSentenceIterator(sentences, ["A", "B", "A"]),
+            labels,
+            batch=4,
+        )
+        total_rows = 0
+        seen_label_rows = 0
+        while True:
+            ds = it.next()
+            if ds is None:
+                break
+            assert ds.features.shape[1] == vec.layer_size * vec.window
+            assert ds.labels.shape[1] == 2
+            total_rows += ds.features.shape[0]
+            seen_label_rows += int(ds.labels.sum())
+        assert total_rows == sum(len(s.split()) for s in sentences)
+        assert seen_label_rows == total_rows
+        # reset restarts cleanly
+        it.reset()
+        assert it.next() is not None
+
+
+def _iris_csv_lines(n=30, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        cls = int(rng.integers(0, 3))
+        feats = rng.normal(loc=cls, scale=0.3, size=4)
+        lines.append(",".join(f"{v:.4f}" for v in feats) + f",{cls}")
+    return lines
+
+
+class TestIRUnitDriver:
+    def _conf_json(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        return (
+            NeuralNetConfiguration.Builder()
+            .seed(7)
+            .learning_rate(0.1)
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                    loss_function=LossFunction.MCXENT))
+            .build()
+            .to_json()
+        )
+
+    def test_simulated_parameter_averaging_run(self, tmp_path):
+        props = {
+            MASTER_MAIN:
+                "deeplearning4j_tpu.scaleout.irunit.ParameterAveragingMaster",
+            APP_MAIN:
+                "deeplearning4j_tpu.scaleout.irunit.ParameterAveragingWorker",
+            APP_NUM_ITERATIONS: "2",
+            "app.conf.json": self._conf_json(),
+        }
+        driver = IRUnitDriver(props, records=_iris_csv_lines(), num_splits=3)
+        driver.setup()
+        assert len(driver.workers) == 3
+        result = driver.simulate_run()
+        assert result is not None
+        n = driver.workers[0].net.num_params()
+        assert result.shape == (n,)
+        # the averaged vector was pushed back down to every worker
+        for w in driver.workers:
+            np.testing.assert_allclose(
+                np.asarray(w.net.params_flat()), result, rtol=1e-6)
+
+    def test_properties_file_and_input_path(self, tmp_path):
+        data = tmp_path / "iris.csv"
+        data.write_text("\n".join(_iris_csv_lines(12)) + "\n")
+        prop_file = tmp_path / "app.properties"
+        prop_file.write_text(
+            "# IRUnit test app\n"
+            f"{MASTER_MAIN}=deeplearning4j_tpu.scaleout.irunit."
+            "ParameterAveragingMaster\n"
+            f"{APP_MAIN}=deeplearning4j_tpu.scaleout.irunit."
+            "ParameterAveragingWorker\n"
+            f"{APP_NUM_ITERATIONS}=1\n"
+            f"app.input.path={data}\n"
+            f"app.output.path={tmp_path / 'model.npy'}\n"
+            "app.conf.json=" + self._conf_json().replace("\n", "") + "\n"
+        )
+        driver = IRUnitDriver(str(prop_file), num_splits=2)
+        result = driver.simulate_run()
+        saved = np.load(tmp_path / "model.npy")
+        np.testing.assert_allclose(saved, result, rtol=1e-6)
+
+
+class TestStorageLock:
+    def test_lock_lifecycle(self, tmp_path):
+        backend = LocalStorage(str(tmp_path / "store"))
+        lock = StorageLock(backend)
+        assert not lock.is_locked()
+
+        artifact = tmp_path / "part0.bin"
+        artifact.write_bytes(b"data")
+        backend.put(str(artifact), "data/part0.bin")
+        lock.create(["data/part0.bin"])
+        assert lock.is_locked()
+        assert lock.get_paths() == ["data/part0.bin"]
+
+        lock.delete()
+        assert not lock.is_locked()
+
+    def test_auto_clear_on_missing_paths(self, tmp_path):
+        backend = LocalStorage(str(tmp_path / "store"))
+        lock = StorageLock(backend)
+        lock.create(["data/gone.bin"])  # guarded artifact never written
+        assert not lock.is_locked()  # inconsistency auto-clears the lock
+        assert not backend.exists(lock.lock_key)
