@@ -1,5 +1,29 @@
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
 from deeplearning4j_tpu.clustering.kdtree import KDTree
 from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.strategy import (
+    BaseClusteringAlgorithm,
+    ClusteringAlgorithmCondition,
+    ClusteringOptimizationType,
+    ClusteringStrategy,
+    ClusteringStrategyType,
+    ClusterSetInfo,
+    ConvergenceCondition,
+    FixedClusterCountStrategy,
+    FixedIterationCountCondition,
+    IterationHistory,
+    IterationInfo,
+    OptimisationStrategy,
+    PointClassification,
+    VarianceVariationCondition,
+)
 
-__all__ = ["KMeansClustering", "KDTree", "VPTree"]
+__all__ = [
+    "KMeansClustering", "KDTree", "VPTree",
+    "BaseClusteringAlgorithm", "ClusteringAlgorithmCondition",
+    "ClusteringOptimizationType", "ClusteringStrategy",
+    "ClusteringStrategyType", "ClusterSetInfo", "ConvergenceCondition",
+    "FixedClusterCountStrategy", "FixedIterationCountCondition",
+    "IterationHistory", "IterationInfo", "OptimisationStrategy",
+    "PointClassification", "VarianceVariationCondition",
+]

@@ -1,0 +1,359 @@
+"""Clustering strategies, iteration conditions, and cluster-set info.
+
+TPU-native equivalent of the reference generic clustering engine
+(reference clustering/algorithm/BaseClusteringAlgorithm.java,
+strategy/{ClusteringStrategy,BaseClusteringStrategy,
+FixedClusterCountStrategy,OptimisationStrategy}.java,
+condition/{ConvergenceCondition,FixedIterationCountCondition,
+VarianceVariationCondition}.java, optimisation/ClusteringOptimization.java,
+iteration/{IterationHistory,IterationInfo}.java, cluster/ClusterSetInfo
+and PointClassification): strategies declare *when to stop* and *what to
+optimize*; the engine loops a jitted Lloyd step (one XLA computation per
+iteration — distances, argmin assignment, one-hot matmul segment-sum) and
+evaluates the host-side conditions on each iteration's distortion stats,
+instead of the reference's per-point Java loops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kmeans import _sq_dists
+
+
+class ClusteringStrategyType(str, enum.Enum):
+    FIXED_CLUSTER_COUNT = "fixed_cluster_count"
+    OPTIMIZATION = "optimization"
+
+
+class ClusteringOptimizationType(str, enum.Enum):
+    MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE = "avg_point_to_center"
+    MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE = "max_point_to_center"
+
+
+# ---------------------------------------------------------------------------
+# Iteration bookkeeping
+
+
+@dataclasses.dataclass
+class IterationInfo:
+    """Stats for one engine iteration (reference iteration/IterationInfo)."""
+
+    index: int
+    average_point_distance: float
+    max_point_distance: float
+    distortion: float
+
+
+class IterationHistory:
+    """All iterations so far (reference iteration/IterationHistory)."""
+
+    def __init__(self):
+        self.iterations: List[IterationInfo] = []
+
+    def add(self, info: IterationInfo) -> None:
+        self.iterations.append(info)
+
+    def most_recent(self) -> Optional[IterationInfo]:
+        return self.iterations[-1] if self.iterations else None
+
+    def iteration_count(self) -> int:
+        return len(self.iterations)
+
+
+# ---------------------------------------------------------------------------
+# Conditions
+
+
+class ClusteringAlgorithmCondition:
+    """``is_satisfied(history) -> bool`` (reference SPI of the same name)."""
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        raise NotImplementedError
+
+
+class FixedIterationCountCondition(ClusteringAlgorithmCondition):
+    def __init__(self, iteration_count: int):
+        self.iteration_count = int(iteration_count)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        return history.iteration_count() >= self.iteration_count
+
+
+class ConvergenceCondition(ClusteringAlgorithmCondition):
+    """Distortion improvement rate dropped below the threshold."""
+
+    def __init__(self, distribution_variation_rate: float = 1e-4):
+        self.rate = float(distribution_variation_rate)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        if history.iteration_count() < 2:
+            return False
+        prev = history.iterations[-2].distortion
+        cur = history.iterations[-1].distortion
+        if prev <= 0:
+            return True
+        return abs(prev - cur) / prev < self.rate
+
+    # reference factory-style alias
+    @classmethod
+    def distribution_variation_rate_less_than(cls, rate: float):
+        return cls(rate)
+
+
+class VarianceVariationCondition(ClusteringAlgorithmCondition):
+    """Variance (distortion) varied less than ``rate`` for ``period``
+    consecutive iterations (reference VarianceVariationCondition)."""
+
+    def __init__(self, rate: float, period: int):
+        self.rate = float(rate)
+        self.period = int(period)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        if history.iteration_count() <= self.period:
+            return False
+        recent = history.iterations[-(self.period + 1):]
+        for a, b in zip(recent, recent[1:]):
+            base = abs(a.distortion) if a.distortion else 1.0
+            if abs(a.distortion - b.distortion) / base >= self.rate:
+                return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Strategies
+
+
+class ClusteringStrategy:
+    """What to build and when to stop (reference strategy SPI)."""
+
+    def __init__(self, strategy_type: ClusteringStrategyType,
+                 initial_cluster_count: int):
+        self.type = strategy_type
+        self.initial_cluster_count = int(initial_cluster_count)
+        self.termination_conditions: List[ClusteringAlgorithmCondition] = []
+        self.allow_empty_clusters = False
+
+    # builder-style condition attachment (reference BaseClusteringStrategy)
+    def end_when_iteration_count_equals(self, n: int) -> "ClusteringStrategy":
+        self.termination_conditions.append(FixedIterationCountCondition(n))
+        return self
+
+    def end_when_distribution_variation_rate_less_than(
+            self, rate: float) -> "ClusteringStrategy":
+        self.termination_conditions.append(ConvergenceCondition(rate))
+        return self
+
+    def end_when(self, condition: ClusteringAlgorithmCondition):
+        self.termination_conditions.append(condition)
+        return self
+
+    def is_done(self, history: IterationHistory) -> bool:
+        if not self.termination_conditions:
+            return history.iteration_count() >= 100
+        return any(c.is_satisfied(history)
+                   for c in self.termination_conditions)
+
+
+class FixedClusterCountStrategy(ClusteringStrategy):
+    @classmethod
+    def setup(cls, cluster_count: int) -> "FixedClusterCountStrategy":
+        return cls(ClusteringStrategyType.FIXED_CLUSTER_COUNT, cluster_count)
+
+    def __init__(self, strategy_type, cluster_count):
+        super().__init__(strategy_type, cluster_count)
+
+
+class OptimisationStrategy(ClusteringStrategy):
+    """Optimize a cluster-quality objective between rounds (reference
+    OptimisationStrategy + ClusteringOptimization): after the base rounds
+    converge, the point farthest from its center re-seeds the emptiest
+    cluster when the objective still improves."""
+
+    @classmethod
+    def setup(cls, cluster_count: int,
+              optimization: ClusteringOptimizationType,
+              value: float = 0.0) -> "OptimisationStrategy":
+        s = cls(ClusteringStrategyType.OPTIMIZATION, cluster_count)
+        s.optimization = optimization
+        s.optimization_value = value
+        return s
+
+    def __init__(self, strategy_type, cluster_count):
+        super().__init__(strategy_type, cluster_count)
+        self.optimization: Optional[ClusteringOptimizationType] = None
+        self.optimization_value = 0.0
+        self.optimization_period = 3
+
+    def optimize_when_iteration_count_multiple_of(self, n: int):
+        self.optimization_period = int(n)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Result types
+
+
+@dataclasses.dataclass
+class PointClassification:
+    """Nearest-cluster classification of one point (reference
+    cluster/PointClassification)."""
+
+    cluster_index: int
+    distance: float
+    new_location: bool = False
+
+
+class ClusterSetInfo:
+    """Per-cluster stats of a finished clustering (reference
+    cluster/ClusterSetInfo)."""
+
+    def __init__(self, centroids: np.ndarray, assignments: np.ndarray,
+                 distances: np.ndarray):
+        self.centroids = centroids
+        self.assignments = assignments
+        self.distances = distances
+        k = centroids.shape[0]
+        self.point_counts: Dict[int, int] = {
+            i: int((assignments == i).sum()) for i in range(k)
+        }
+
+    def average_point_distance_from_center(self, cluster: int) -> float:
+        mask = self.assignments == cluster
+        if not mask.any():
+            return 0.0
+        return float(self.distances[mask].mean())
+
+    def max_point_distance_from_center(self, cluster: int) -> float:
+        mask = self.assignments == cluster
+        if not mask.any():
+            return 0.0
+        return float(self.distances[mask].max())
+
+    def total_distortion(self) -> float:
+        return float((self.distances ** 2).sum())
+
+
+# ---------------------------------------------------------------------------
+# Engine
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _lloyd_step(points, centroids, k: int):
+    """One Lloyd iteration + stats as a single XLA computation."""
+    d2 = _sq_dists(points, centroids)
+    assign = jnp.argmin(d2, axis=1)
+    near = jnp.min(d2, axis=1)
+    onehot = jax.nn.one_hot(assign, k, dtype=points.dtype)
+    counts = jnp.sum(onehot, axis=0)
+    sums = onehot.T @ points
+    new = jnp.where(counts[:, None] > 0,
+                    sums / jnp.maximum(counts[:, None], 1.0), centroids)
+    dist = jnp.sqrt(near)
+    return new, assign, dist, jnp.sum(near), jnp.mean(dist), jnp.max(dist)
+
+
+class BaseClusteringAlgorithm:
+    """Strategy-driven clustering engine (reference
+    BaseClusteringAlgorithm.applyTo): random-sample initial centers, then
+    Lloyd rounds — each round one jitted step — until the strategy's
+    conditions fire; OPTIMIZATION strategies periodically re-seed the
+    emptiest cluster from the farthest point."""
+
+    def __init__(self, strategy: ClusteringStrategy, seed: int = 0):
+        self.strategy = strategy
+        self.seed = seed
+        self.history = IterationHistory()
+        self.cluster_set_info: Optional[ClusterSetInfo] = None
+        self.centroids: Optional[np.ndarray] = None
+
+    @classmethod
+    def setup(cls, strategy: ClusteringStrategy, seed: int = 0):
+        return cls(strategy, seed)
+
+    def apply_to(self, points) -> ClusterSetInfo:
+        pts = jnp.asarray(points, jnp.float32)
+        k = self.strategy.initial_cluster_count
+        if pts.shape[0] < k:
+            raise ValueError(f"need at least k={k} points")
+        centroids = self._kmeanspp_seed(np.asarray(pts), k)
+
+        self.history = IterationHistory()
+        i = 0
+        while True:
+            centroids, assign, dist, distortion, avg_d, max_d = _lloyd_step(
+                pts, centroids, k)
+            self.history.add(IterationInfo(
+                index=i,
+                average_point_distance=float(avg_d),
+                max_point_distance=float(max_d),
+                distortion=float(distortion),
+            ))
+            if self.strategy.is_done(self.history):
+                break
+            if (isinstance(self.strategy, OptimisationStrategy)
+                    and self.strategy.optimization is not None
+                    and (i + 1) % self.strategy.optimization_period == 0
+                    and self._objective_violated(float(avg_d),
+                                                 float(max_d))):
+                centroids = self._reseed_emptiest(
+                    pts, np.array(centroids), np.asarray(assign),
+                    np.asarray(dist))
+            i += 1
+
+        self.centroids = np.asarray(centroids)
+        # final assignment against the FINAL centroids (the loop's assign
+        # was computed against the previous generation)
+        d2 = np.asarray(_sq_dists(pts, jnp.asarray(self.centroids)))
+        final_assign = d2.argmin(axis=1)
+        final_dist = np.sqrt(d2.min(axis=1))
+        self.cluster_set_info = ClusterSetInfo(
+            self.centroids, final_assign, final_dist)
+        return self.cluster_set_info
+
+    def _kmeanspp_seed(self, pts: np.ndarray, k: int):
+        """D²-weighted seeding (kmeans++), same scheme the jitted
+        ``_kmeans_fit`` uses — random-sample init hits Lloyd local optima
+        on well-separated blobs."""
+        rng = np.random.default_rng(self.seed)
+        centers = [pts[rng.integers(pts.shape[0])]]
+        for _ in range(k - 1):
+            d2 = np.min(
+                [((pts - c) ** 2).sum(axis=1) for c in centers], axis=0)
+            total = d2.sum()
+            if total <= 0:
+                centers.append(pts[rng.integers(pts.shape[0])])
+                continue
+            centers.append(pts[rng.choice(pts.shape[0], p=d2 / total)])
+        return jnp.asarray(np.stack(centers))
+
+    def _objective_violated(self, avg_d: float, max_d: float) -> bool:
+        """Re-seed only while the optimization target is missed."""
+        s = self.strategy
+        if s.optimization is ClusteringOptimizationType\
+                .MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE:
+            return max_d > s.optimization_value
+        return avg_d > s.optimization_value
+
+    def _reseed_emptiest(self, pts, centroids, assign, dist):
+        counts = np.bincount(assign, minlength=centroids.shape[0])
+        emptiest = int(counts.argmin())
+        farthest = int(dist.argmax())
+        centroids[emptiest] = np.asarray(pts)[farthest]
+        return jnp.asarray(centroids)
+
+    def classify_point(self, point) -> PointClassification:
+        if self.centroids is None:
+            raise RuntimeError("call apply_to first")
+        p = jnp.asarray(point, jnp.float32)[None, :]
+        d2 = np.asarray(_sq_dists(p, jnp.asarray(self.centroids)))[0]
+        ci = int(d2.argmin())
+        return PointClassification(cluster_index=ci,
+                                   distance=float(np.sqrt(d2[ci])))

@@ -208,3 +208,41 @@ class CurvesDataSetIterator(BaseDataSetIterator):
 
     def __init__(self, batch_size: int, num_examples: int = 1000):
         super().__init__(batch_size, curves_dataset(num_examples))
+
+
+class MovingWindowDataSetFetcher:
+    """Slide a window over each image of a DataSet, every window becoming
+    one example with its source image's label (reference
+    datasets/iterator/impl/MovingWindowDataSetFetcher.java over
+    MovingWindowMatrix).
+    """
+
+    def __init__(self, data, window_rows: int, window_cols: int,
+                 rotate: int = 0):
+        from deeplearning4j_tpu.util.moving_window import (
+            moving_window_matrices,
+        )
+
+        feats, labels = [], []
+        x = np.asarray(data.features)
+        y = np.asarray(data.labels)
+        if x.ndim == 2:  # flat rows: assume square images
+            side = int(np.sqrt(x.shape[1]))
+            x = x.reshape(x.shape[0], side, side)
+        elif x.ndim == 4:  # NCHW: first channel
+            x = x[:, 0]
+        for i in range(x.shape[0]):
+            for w in moving_window_matrices(x[i], window_rows, window_cols,
+                                            rotate):
+                feats.append(w.ravel())
+                labels.append(y[i])
+        self.features = np.asarray(feats, dtype=np.float32)
+        self.labels = np.asarray(labels, dtype=np.float32)
+
+    def fetch(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        return DataSet(self.features, self.labels)
+
+    def iterator(self, batch_size: int):
+        return BaseDataSetIterator(batch_size, self.fetch())

@@ -10,7 +10,15 @@ from deeplearning4j_tpu.earlystopping.config import (
     EarlyStoppingConfiguration,
     EarlyStoppingResult,
 )
-from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+from deeplearning4j_tpu.earlystopping.trainer import (
+    EarlyStoppingTrainer,
+    ParallelEarlyStoppingTrainer,
+)
+from deeplearning4j_tpu.earlystopping.listener import (
+    ComposableEarlyStoppingListener,
+    EarlyStoppingListener,
+    LoggingEarlyStoppingListener,
+)
 from deeplearning4j_tpu.earlystopping.savers import (
     InMemoryModelSaver,
     LocalFileModelSaver,

@@ -28,13 +28,28 @@ class EarlyStoppingTrainer:
         config: EarlyStoppingConfiguration,
         net,
         train_iterator,
+        listener=None,
     ):
         self.config = config
         self.net = net
         self.train_iterator = train_iterator
+        self.listener = listener
+
+    def set_listener(self, listener) -> None:
+        """Lifecycle callbacks (reference EarlyStoppingListener SPI)."""
+        self.listener = listener
+
+    def _fit_batch(self, ds) -> None:
+        """One training call; distributed trainers override this."""
+        self.net.fit(ds)
+
+    def _train_score(self) -> float:
+        return float(self.net.score_value)
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
+        if self.listener is not None:
+            self.listener.on_start(cfg, self.net)
         for cond in cfg.epoch_terminations:
             cond.initialize()
         for cond in cfg.iteration_terminations:
@@ -53,11 +68,11 @@ class EarlyStoppingTrainer:
             while reason is None:
                 self.train_iterator.reset()
                 for ds in self.train_iterator:
-                    self.net.fit(ds)
+                    self._fit_batch(ds)
                     if not cfg.iteration_terminations:
                         continue  # keep device dispatch asynchronous
                     elapsed = time.time() * 1000.0 - start_ms
-                    score = float(self.net.score_value)
+                    score = self._train_score()
                     for cond in cfg.iteration_terminations:
                         if cond.terminate(elapsed, score):
                             reason = (
@@ -73,7 +88,7 @@ class EarlyStoppingTrainer:
                     # condition fires (BaseEarlyStoppingTrainer.java:147-154).
                     if cfg.save_last_model:
                         cfg.model_saver.save_latest_model(
-                            self.net, float(self.net.score_value)
+                            self.net, self._train_score()
                         )
                     break
 
@@ -83,8 +98,11 @@ class EarlyStoppingTrainer:
                             self.net
                         )
                     else:
-                        last_score = float(self.net.score_value)
+                        last_score = self._train_score()
                     score_vs_epoch[epoch] = last_score
+                    if self.listener is not None:
+                        self.listener.on_epoch(epoch, last_score, cfg,
+                                               self.net)
                     if last_score < best_score:
                         best_score = last_score
                         best_epoch = epoch
@@ -109,7 +127,7 @@ class EarlyStoppingTrainer:
         best = cfg.model_saver.get_best_model()
         if best is None:
             best = self.net
-        return EarlyStoppingResult(
+        result = EarlyStoppingResult(
             termination_reason=reason,
             termination_details=details,
             total_epochs=epoch + 1,
@@ -118,3 +136,39 @@ class EarlyStoppingTrainer:
             score_vs_epoch=score_vs_epoch,
             best_model=best,
         )
+        if self.listener is not None:
+            self.listener.on_completion(result)
+        return result
+
+
+class ParallelEarlyStoppingTrainer(EarlyStoppingTrainer):
+    """Early stopping over the data-parallel trainer.
+
+    TPU-native equivalent of the reference Spark early stopping (reference
+    dl4j-spark/.../earlystopping/SparkEarlyStoppingTrainer.java +
+    SparkDataSetLossCalculator): each epoch's batches run through
+    ``ParallelTrainer.fit`` — one compiled psum all-reduce step over the
+    mesh instead of a broadcast/train/driver-average Spark round — while
+    the same config/saver/termination/listener machinery decides when to
+    stop. Scoring uses the calculator against the replicated net, whose
+    merged loss plays the role of the reference's RDD score reduction.
+    """
+
+    def __init__(self, config, parallel_trainer, train_iterator,
+                 listener=None):
+        super().__init__(config, parallel_trainer.net, train_iterator,
+                         listener=listener)
+        self.trainer = parallel_trainer
+        self._has_fit = False
+        self._last_fit_score = float("nan")
+
+    def _fit_batch(self, ds) -> None:
+        self._last_fit_score = float(self.trainer.fit(ds))
+        self._has_fit = True
+
+    def _train_score(self) -> float:
+        # NaN from a diverged fit must pass through so
+        # InvalidScoreIterationTerminationCondition can fire on it.
+        if not self._has_fit:
+            return float(self.net.score_value)
+        return self._last_fit_score

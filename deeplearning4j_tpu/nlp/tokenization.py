@@ -102,6 +102,29 @@ class RuleBasedPosTagger:
                "might", "must"}
     _BE_VERBS = {"is", "am", "are", "was", "were", "be", "been", "being",
                  "has", "have", "had", "do", "does", "did"}
+    _COMMON_VERBS = {"run", "runs", "ran", "go", "goes", "went", "sleep",
+                     "sleeps", "sit", "sits", "sat", "eat", "eats", "ate",
+                     "jump", "jumps", "bark", "barks", "say", "says",
+                     "said", "make", "makes", "made", "take", "takes",
+                     "took", "see", "sees", "saw", "come", "comes",
+                     "came", "get", "gets", "got", "know", "knows",
+                     "knew", "think", "thinks", "look", "looks", "want",
+                     "wants", "give", "gives", "gave", "find", "finds",
+                     "found", "tell", "tells", "told", "work", "works",
+                     "seem", "seems", "feel", "feels", "felt", "leave",
+                     "leaves", "left", "keep", "keeps", "kept", "let",
+                     "lets", "begin", "begins", "began", "show", "shows",
+                     "hear", "hears", "heard", "play", "plays", "move",
+                     "moves", "like", "likes", "live", "lives", "hold",
+                     "holds", "held", "write", "writes", "wrote", "read",
+                     "reads", "speak", "speaks", "spoke", "grow", "grows",
+                     "grew", "walk", "walks", "win", "wins", "won",
+                     "love", "loves", "hate", "hates", "buy", "buys",
+                     "bought", "build", "builds", "built", "fall",
+                     "falls", "fell"}
+    _COMMON_ADVERBS = {"fast", "very", "quite", "too", "also", "now",
+                       "then", "here", "there", "well", "often", "never",
+                       "always", "soon", "again", "still", "just", "not"}
 
     def tag(self, token: str) -> str:
         w = token.lower()
@@ -117,11 +140,11 @@ class RuleBasedPosTagger:
             return "CC"
         if w in self._MODALS:
             return "MD"
-        if w in self._BE_VERBS:
+        if w in self._BE_VERBS or w in self._COMMON_VERBS:
             return "VB"
         if w[0].isdigit():
             return "CD"
-        if w.endswith("ly"):
+        if w.endswith("ly") or w in self._COMMON_ADVERBS:
             return "RB"
         if w.endswith(("ing", "ed")) and len(w) > 4:
             return "VB"

@@ -1,0 +1,205 @@
+"""Constituency-style tree building, transforms, and vectorization.
+
+TPU-native equivalent of the reference RNTN tree pipeline (reference
+deeplearning4j-nlp/.../text/corpora/treeparser/{TreeParser,TreeVectorizer,
+BinarizeTreeTransformer,CollapseUnaries,HeadWordFinder}.java): sentence →
+parse tree → binarized, unary-collapsed tree whose nodes carry sentiment
+labels — the input format RNTN trains on (nlp/rntn.py scan-linearizes the
+result). The reference leans on a UIMA/ClearTK parser; here a
+deterministic rule-based chunker (the same POS tagger the tokenizers use)
+builds shallow constituents, so the pipeline is self-contained and
+reproducible — swap ``TreeParser.chunk`` for a real parser when one is
+available.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .rntn import Tree as RntnTree
+from .sentiment import SentiWordNet
+from .tokenization import RuleBasedPosTagger
+
+
+@dataclass
+class ParseTree:
+    """N-ary labelled parse tree (reference treeparser Tree form)."""
+
+    label: str
+    word: Optional[str] = None
+    children: List["ParseTree"] = field(default_factory=list)
+
+    def is_leaf(self) -> bool:
+        return not self.children
+
+    def is_pre_terminal(self) -> bool:
+        return len(self.children) == 1 and self.children[0].is_leaf()
+
+    def yield_words(self) -> List[str]:
+        if self.is_leaf():
+            return [self.word] if self.word is not None else []
+        out: List[str] = []
+        for c in self.children:
+            out.extend(c.yield_words())
+        return out
+
+    def depth(self) -> int:
+        if self.is_leaf():
+            return 0
+        return 1 + max(c.depth() for c in self.children)
+
+    def __repr__(self) -> str:
+        if self.is_leaf():
+            return self.word or ""
+        kids = " ".join(repr(c) for c in self.children)
+        return f"({self.label} {kids})"
+
+
+class TreeParser:
+    """Sentence → shallow constituency ParseTree.
+
+    POS-tags every token, groups maximal runs into NP/VP/PP chunks
+    (determiner/adjective/noun runs → NP, modal/verb/adverb runs → VP,
+    preposition-led runs → PP), and hangs the chunks under S — a
+    deterministic stand-in for the reference's UIMA TreeParser.
+    """
+
+    _NP_TAGS = {"DT", "JJ", "NN", "PRP", "CD"}
+    _VP_TAGS = {"VB", "MD", "RB"}
+
+    def __init__(self, tagger: Optional[RuleBasedPosTagger] = None):
+        self.tagger = tagger or RuleBasedPosTagger()
+
+    def _chunk_label(self, tag: str) -> str:
+        if tag in self._NP_TAGS:
+            return "NP"
+        if tag in self._VP_TAGS:
+            return "VP"
+        if tag == "IN":
+            return "PP"
+        return "X"
+
+    def parse(self, sentence: str) -> ParseTree:
+        tokens = [t for t in sentence.split() if t]
+        if not tokens:
+            return ParseTree(label="S")
+        chunks: List[ParseTree] = []
+        cur_label: Optional[str] = None
+        cur_children: List[ParseTree] = []
+        for tok in tokens:
+            tag = self.tagger.tag(tok)
+            label = self._chunk_label(tag)
+            pre = ParseTree(label=tag,
+                            children=[ParseTree(label=tag, word=tok)])
+            # PP chunks absorb the following NP run (preposition-led)
+            if cur_label == "PP" and label == "NP":
+                cur_children.append(pre)
+                continue
+            if label != cur_label and cur_children:
+                chunks.append(ParseTree(label=cur_label,
+                                        children=cur_children))
+                cur_children = []
+            cur_label = label
+            cur_children.append(pre)
+        if cur_children:
+            chunks.append(ParseTree(label=cur_label, children=cur_children))
+        return ParseTree(label="S", children=chunks)
+
+    def get_trees(self, text: str) -> List[ParseTree]:
+        """One tree per sentence ('.'-split, reference getTrees)."""
+        return [self.parse(s) for s in text.split(".") if s.strip()]
+
+
+class CollapseUnaries:
+    """Collapse unary chains X→Y→... to the bottom node (reference
+    CollapseUnaries transformer)."""
+
+    def transform(self, tree: ParseTree) -> ParseTree:
+        if tree.is_leaf():
+            return tree
+        node = tree
+        while len(node.children) == 1 and not node.children[0].is_leaf():
+            node = node.children[0]
+        if node.is_leaf():
+            return node
+        return ParseTree(
+            label=tree.label, word=node.word,
+            children=[self.transform(c) for c in node.children])
+
+
+class BinarizeTreeTransformer:
+    """Left-factored binarization: n-ary nodes become right-leaning
+    chains of @label intermediates (reference BinarizeTreeTransformer)."""
+
+    def transform(self, tree: ParseTree) -> ParseTree:
+        if tree.is_leaf():
+            return tree
+        kids = [self.transform(c) for c in tree.children]
+        if len(kids) == 1:
+            return ParseTree(label=tree.label, children=kids)
+        while len(kids) > 2:
+            right = ParseTree(label="@" + tree.label, children=kids[-2:])
+            kids = kids[:-2] + [right]
+        return ParseTree(label=tree.label, children=kids)
+
+
+class HeadWordFinder:
+    """Head word per constituent (reference HeadWordFinder, Collins-style
+    simplification): NPs head on their rightmost noun, VPs on their
+    leftmost verb, else the rightmost child's head."""
+
+    def find_head(self, tree: ParseTree) -> Optional[str]:
+        if tree.is_leaf():
+            return tree.word
+        if tree.label == "NP":
+            for c in reversed(tree.children):
+                if c.label.startswith(("NN", "PRP", "CD")):
+                    return self.find_head(c)
+        if tree.label == "VP":
+            for c in tree.children:
+                if c.label.startswith(("VB", "MD")):
+                    return self.find_head(c)
+        return self.find_head(tree.children[-1])
+
+
+class TreeVectorizer:
+    """Sentence → binary sentiment-labelled RNTN trees (reference
+    TreeVectorizer.getTreesWithLabels): parse, collapse unaries, binarize,
+    then label every node from the polarity of its span (SentiWordNet
+    scores, 0=negative 1=neutral 2=positive)."""
+
+    def __init__(self, parser: Optional[TreeParser] = None,
+                 sentiment: Optional[SentiWordNet] = None):
+        self.parser = parser or TreeParser()
+        self.sentiment = sentiment or SentiWordNet()
+        self.collapse = CollapseUnaries()
+        self.binarize = BinarizeTreeTransformer()
+
+    def _label_of(self, words: List[str]) -> int:
+        s = self.sentiment.score(words)
+        if s > 0:
+            return 2
+        if s < 0:
+            return 0
+        return 1
+
+    def _to_rntn(self, tree: ParseTree) -> RntnTree:
+        words = tree.yield_words()
+        label = self._label_of(words)
+        if tree.is_leaf() or tree.is_pre_terminal():
+            return RntnTree(label=label, word=words[0] if words else "")
+        kids = tree.children
+        if len(kids) == 1:
+            return self._to_rntn(kids[0])
+        return RntnTree(label=label, left=self._to_rntn(kids[0]),
+                        right=self._to_rntn(kids[1]))
+
+    def get_trees_with_labels(self, text: str) -> List[RntnTree]:
+        out = []
+        for parse in self.parser.get_trees(text):
+            if not parse.children:
+                continue
+            t = self.binarize.transform(self.collapse.transform(parse))
+            out.append(self._to_rntn(t))
+        return out

@@ -13,3 +13,10 @@ from deeplearning4j_tpu.optimize.listeners import (
     IterationListener,
     ScoreIterationListener,
 )
+from deeplearning4j_tpu.optimize.stepfunctions import (
+    DefaultStepFunction,
+    GradientStepFunction,
+    NegativeDefaultStepFunction,
+    NegativeGradientStepFunction,
+    StepFunction,
+)

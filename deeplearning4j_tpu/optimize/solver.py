@@ -111,12 +111,18 @@ class BaseOptimizer:
     termination."""
 
     def __init__(self, net, max_iterations: Optional[int] = None,
-                 terminations=DEFAULT_CONDITIONS):
+                 terminations=DEFAULT_CONDITIONS, step_function=None):
+        from deeplearning4j_tpu.optimize import stepfunctions
+
         self.net = net
         conf = net.conf.confs[0]
         self.max_iterations = max_iterations or conf.num_iterations
         self.max_ls_iterations = conf.max_num_line_search_iterations
         self.terminations = list(terminations)
+        self.step_function = (
+            stepfunctions.from_name(step_function) if step_function
+            else stepfunctions.DefaultStepFunction()
+        )
 
     def direction(self, x, grad, it: int) -> Array:
         raise NotImplementedError
@@ -138,7 +144,7 @@ class BaseOptimizer:
                 problem.value, x, score, grad, direction,
                 self.max_ls_iterations,
             )
-            x = x + step * direction
+            x = self.step_function.step(x, direction, step)
             self._ls_scores = (score, new_score)  # for adaptive hooks
             self._post_step(x, grad, direction, step)
             problem.write_back(x)

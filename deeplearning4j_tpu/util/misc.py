@@ -1,0 +1,291 @@
+"""Small utility classes completing the reference util/berkeley surface.
+
+TPU-native equivalents of reference utilities (reference
+deeplearning4j-core/.../util/{SetUtils,ArchiveUtils,SummaryStatistics,
+FingerPrintKeyer,StringCluster,StringGrid}.java, berkeley/SloppyMath.java,
+rbm/MultiDimensionalMap-style keyed maps used by the recursive nets).
+Host-side helpers — no device work.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import unicodedata
+from collections import defaultdict
+from typing import Dict, Hashable, Iterable, List, Optional, Set, Tuple
+
+import numpy as np
+
+
+class SetUtils:
+    """Set algebra helpers (reference SetUtils.java)."""
+
+    @staticmethod
+    def intersection(a: Iterable, b: Iterable) -> Set:
+        return set(a) & set(b)
+
+    @staticmethod
+    def union(a: Iterable, b: Iterable) -> Set:
+        return set(a) | set(b)
+
+    @staticmethod
+    def difference(a: Iterable, b: Iterable) -> Set:
+        return set(a) - set(b)
+
+    @staticmethod
+    def intersection_p(a: Set, b: Iterable) -> bool:
+        return any(x in a for x in b)
+
+
+class SloppyMath:
+    """Numerically-safe log-space arithmetic (reference berkeley
+    SloppyMath.java)."""
+
+    LOG_TOLERANCE = 30.0
+
+    @staticmethod
+    def log_add(lx: float, ly: float) -> float:
+        if lx == -math.inf:
+            return ly
+        if ly == -math.inf:
+            return lx
+        hi, lo = (lx, ly) if lx > ly else (ly, lx)
+        if hi - lo > SloppyMath.LOG_TOLERANCE:
+            return hi
+        return hi + math.log1p(math.exp(lo - hi))
+
+    @staticmethod
+    def log_add_all(values: Iterable[float]) -> float:
+        out = -math.inf
+        for v in values:
+            out = SloppyMath.log_add(out, v)
+        return out
+
+    @staticmethod
+    def sloppy_exp(x: float) -> float:
+        if x > 50.0:
+            return math.inf
+        if x < -50.0:
+            return 0.0
+        return math.exp(x)
+
+
+class SummaryStatistics:
+    """Streaming min/max/mean/variance (reference SummaryStatistics.java,
+    Welford accumulation instead of sum-of-squares)."""
+
+    def __init__(self):
+        self.n = 0
+        self._mean = 0.0
+        self._m2 = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        self.n += 1
+        d = v - self._mean
+        self._mean += d / self.n
+        self._m2 += d * (v - self._mean)
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def add_all(self, values) -> "SummaryStatistics":
+        for v in np.asarray(values).ravel():
+            self.add(float(v))
+        return self
+
+    @property
+    def mean(self) -> float:
+        return self._mean if self.n else float("nan")
+
+    @property
+    def variance(self) -> float:
+        return self._m2 / (self.n - 1) if self.n > 1 else 0.0
+
+    @property
+    def standard_deviation(self) -> float:
+        return math.sqrt(self.variance)
+
+    @staticmethod
+    def summary_stats(values) -> "SummaryStatistics":
+        return SummaryStatistics().add_all(values)
+
+    def __repr__(self) -> str:
+        return (f"SummaryStatistics(n={self.n}, mean={self.mean:.6g}, "
+                f"min={self.min:.6g}, max={self.max:.6g}, "
+                f"std={self.standard_deviation:.6g})")
+
+
+class MultiDimensionalMap:
+    """Pair-keyed map (reference rnn MultiDimensionalMap<K1,K2,V>)."""
+
+    def __init__(self):
+        self._d: Dict[Tuple[Hashable, Hashable], object] = {}
+
+    def put(self, k1, k2, value) -> None:
+        self._d[(k1, k2)] = value
+
+    def get(self, k1, k2, default=None):
+        return self._d.get((k1, k2), default)
+
+    def contains(self, k1, k2) -> bool:
+        return (k1, k2) in self._d
+
+    def remove(self, k1, k2):
+        return self._d.pop((k1, k2), None)
+
+    def key_set(self) -> Set[Tuple[Hashable, Hashable]]:
+        return set(self._d)
+
+    def values(self):
+        return list(self._d.values())
+
+    def size(self) -> int:
+        return len(self._d)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class MultiDimensionalSet:
+    """Pair set (reference MultiDimensionalSet<K1,K2>)."""
+
+    def __init__(self):
+        self._s: Set[Tuple[Hashable, Hashable]] = set()
+
+    def add(self, k1, k2) -> None:
+        self._s.add((k1, k2))
+
+    def contains(self, k1, k2) -> bool:
+        return (k1, k2) in self._s
+
+    def remove(self, k1, k2) -> None:
+        self._s.discard((k1, k2))
+
+    def size(self) -> int:
+        return len(self._s)
+
+    def __len__(self) -> int:
+        return len(self._s)
+
+
+class FingerPrintKeyer:
+    """Canonical key for fuzzy string matching (reference
+    FingerPrintKeyer.java, OpenRefine fingerprint): strip accents and
+    punctuation, lowercase, sort unique tokens."""
+
+    def key(self, s: str) -> str:
+        s = unicodedata.normalize("NFKD", s)
+        s = "".join(c for c in s if not unicodedata.combining(c))
+        s = re.sub(r"[^\w\s]", "", s.lower()).strip()
+        return " ".join(sorted(set(s.split())))
+
+
+class StringCluster:
+    """Cluster strings by fingerprint key; clusters sorted by size
+    (reference StringCluster.java)."""
+
+    def __init__(self, items: Iterable[str]):
+        keyer = FingerPrintKeyer()
+        groups: Dict[str, Dict[str, int]] = defaultdict(dict)
+        for s in items:
+            k = keyer.key(s)
+            groups[k][s] = groups[k].get(s, 0) + 1
+        self.clusters: List[Dict[str, int]] = sorted(
+            groups.values(),
+            key=lambda g: (-sum(g.values()), sorted(g)),
+        )
+
+    def get_clusters(self) -> List[Dict[str, int]]:
+        return self.clusters
+
+
+class StringGrid:
+    """Grid of string rows with fuzzy row dedup by column fingerprint
+    (reference StringGrid.java)."""
+
+    def __init__(self, sep: str, rows: Iterable[List[str]] = ()):
+        self.sep = sep
+        self.rows: List[List[str]] = [list(r) for r in rows]
+        if self.rows:
+            n = len(self.rows[0])
+            if any(len(r) != n for r in self.rows):
+                raise ValueError("ragged rows")
+
+    @classmethod
+    def from_lines(cls, sep: str, lines: Iterable[str]) -> "StringGrid":
+        return cls(sep, [line.split(sep) for line in lines])
+
+    def num_rows(self) -> int:
+        return len(self.rows)
+
+    def get_column(self, col: int) -> List[str]:
+        return [r[col] for r in self.rows]
+
+    def get_row(self, i: int) -> List[str]:
+        return list(self.rows[i])
+
+    def filter_rows_by_column(self, col: int,
+                              allowed: Iterable[str]) -> "StringGrid":
+        ok = set(allowed)
+        return StringGrid(self.sep,
+                          [r for r in self.rows if r[col] in ok])
+
+    def dedup_by_column_fingerprint(self, col: int) -> None:
+        keyer = FingerPrintKeyer()
+        seen: Set[str] = set()
+        kept = []
+        for r in self.rows:
+            k = keyer.key(r[col])
+            if k in seen:
+                continue
+            seen.add(k)
+            kept.append(r)
+        self.rows = kept
+
+
+class ArchiveUtils:
+    """Extract .zip/.tar.gz/.tgz/.tar/.gz archives (reference
+    ArchiveUtils.java, used by dataset fetchers)."""
+
+    @staticmethod
+    def unzip_file_to(archive: str, dest: str) -> None:
+        os.makedirs(dest, exist_ok=True)
+        root = os.path.realpath(dest)
+
+        def _check(member: str) -> None:
+            target = os.path.realpath(os.path.join(dest, member))
+            if target != root and not target.startswith(root + os.sep):
+                raise ValueError(f"unsafe archive member path: {member}")
+
+        if archive.endswith(".zip"):
+            import zipfile
+
+            with zipfile.ZipFile(archive) as z:
+                for m in z.namelist():
+                    _check(m)
+                z.extractall(dest)
+        elif archive.endswith((".tar.gz", ".tgz", ".tar")):
+            import tarfile
+
+            mode = "r:gz" if archive.endswith(("gz", "tgz")) else "r"
+            with tarfile.open(archive, mode) as t:
+                for m in t.getmembers():
+                    _check(m.name)
+                try:
+                    t.extractall(dest, filter="data")
+                except TypeError:  # Python < 3.12 without filter=
+                    t.extractall(dest)
+        elif archive.endswith(".gz"):
+            import gzip
+            import shutil
+
+            out = os.path.join(
+                dest, os.path.basename(archive)[:-3] or "out")
+            with gzip.open(archive, "rb") as fin, open(out, "wb") as fout:
+                shutil.copyfileobj(fin, fout)
+        else:
+            raise ValueError(f"unknown archive format: {archive}")

@@ -1,0 +1,333 @@
+"""Tests: label-aware document iterators, sentence-iterator combinators,
+word-vector ModelUtils, tree parser pipeline, util leftovers, moving-window
+fetcher.
+
+Reference test models: documentiterator/sentenceiterator tests,
+BasicModelUtils usage in Word2VecTests, treeparser tests (SURVEY.md §4).
+"""
+
+import io
+import math
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.labels import (
+    BasicLabelAwareIterator,
+    FileLabelAwareIterator,
+    FilenamesLabelAwareIterator,
+    LabelsSource,
+)
+from deeplearning4j_tpu.nlp.model_utils import (
+    BasicModelUtils,
+    FlatModelUtils,
+    TreeModelUtils,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    AggregatingSentenceIterator,
+    CollectionSentenceIterator,
+    PrefetchingSentenceIterator,
+    StreamLineIterator,
+    SynchronizedSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.tree_parser import (
+    BinarizeTreeTransformer,
+    CollapseUnaries,
+    HeadWordFinder,
+    ParseTree,
+    TreeParser,
+    TreeVectorizer,
+)
+from deeplearning4j_tpu.util.misc import (
+    ArchiveUtils,
+    FingerPrintKeyer,
+    MultiDimensionalMap,
+    MultiDimensionalSet,
+    SetUtils,
+    SloppyMath,
+    StringCluster,
+    StringGrid,
+    SummaryStatistics,
+)
+
+
+class TestLabelAwareIterators:
+    def test_basic_generates_labels(self):
+        it = BasicLabelAwareIterator(
+            CollectionSentenceIterator(["a b", "c d", "e f"]))
+        docs = list(it)
+        assert [d.content for d in docs] == ["a b", "c d", "e f"]
+        assert [d.label for d in docs] == ["DOC_0", "DOC_1", "DOC_2"]
+        assert it.get_labels_source().get_labels() == ["DOC_0", "DOC_1",
+                                                       "DOC_2"]
+
+    def test_file_label_aware(self, tmp_path):
+        for label, text in [("pos", "good great"), ("neg", "bad awful")]:
+            d = tmp_path / label
+            d.mkdir()
+            (d / "doc1.txt").write_text(text)
+        it = FileLabelAwareIterator(str(tmp_path))
+        docs = list(it)
+        assert {d.label for d in docs} == {"pos", "neg"}
+        assert sorted(it.get_labels_source().get_labels()) == ["neg", "pos"]
+
+    def test_filenames_label_aware(self, tmp_path):
+        (tmp_path / "a.txt").write_text("alpha")
+        (tmp_path / "b.txt").write_text("beta")
+        it = FilenamesLabelAwareIterator(str(tmp_path))
+        docs = list(it)
+        assert [d.label for d in docs] == ["a.txt", "b.txt"]
+        assert [d.content for d in docs] == ["alpha", "beta"]
+
+    def test_labels_source_fixed(self):
+        src = LabelsSource(labels=["X", "Y"])
+        assert [src.next_label() for _ in range(4)] == ["X", "Y", "X", "Y"]
+
+
+class TestSentenceIteratorCombinators:
+    def test_aggregating(self):
+        it = AggregatingSentenceIterator(
+            CollectionSentenceIterator(["a", "b"]),
+            CollectionSentenceIterator([]),
+            CollectionSentenceIterator(["c"]),
+        )
+        assert list(it) == ["a", "b", "c"]
+        it.reset()
+        assert list(it) == ["a", "b", "c"]
+
+    def test_stream_line_iterator(self):
+        stream = io.StringIO("one\ntwo\nthree\nfour\n")
+        it = StreamLineIterator(stream, batch_of=2)
+        assert it.next_sentence() == "one two"
+        assert it.next_sentence() == "three four"
+        assert not it.has_next()
+
+    def test_prefetching(self):
+        base = CollectionSentenceIterator([f"s{i}" for i in range(50)])
+        it = PrefetchingSentenceIterator(base, fetch_size=8)
+        got = list(it)
+        assert got == [f"s{i}" for i in range(50)]
+        it.reset()
+        assert it.next_sentence() == "s0"
+
+    def test_prefetching_reset_while_producer_blocked(self):
+        # fetch_size far smaller than the corpus: the worker is blocked on
+        # a full queue when reset() arrives; the old producer must not
+        # leak items (or its sentinel) into the restarted stream
+        base = CollectionSentenceIterator([f"s{i}" for i in range(100)])
+        it = PrefetchingSentenceIterator(base, fetch_size=2)
+        assert it.next_sentence() == "s0"
+        it.reset()
+        got = list(it)
+        assert got == [f"s{i}" for i in range(100)]
+        assert all(isinstance(s, str) for s in got)
+
+    def test_synchronized(self):
+        import threading
+
+        it = SynchronizedSentenceIterator(
+            CollectionSentenceIterator([str(i) for i in range(200)]))
+        seen = []
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                s = it.next_sentence_if_any()
+                if s is None:
+                    return
+                with lock:
+                    seen.append(s)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(seen, key=int) == [str(i) for i in range(200)]
+
+
+class _FakeModel:
+    """Deterministic embedding table: word i -> e_i-ish direction."""
+
+    def __init__(self):
+        from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+        self.vocab = VocabCache()
+        words = ["king", "queen", "man", "woman", "apple"]
+        for i, w in enumerate(words):
+            self.vocab.add_token(w, count=10 - i)
+        self.vocab.finalize_indices()
+        rng = np.random.default_rng(0)
+        base = rng.normal(size=(len(words), 8))
+        # make king/queen near-identical, apple far away
+        base[self.vocab.index_of("queen")] = \
+            base[self.vocab.index_of("king")] + 0.01
+        self.syn0 = base
+
+    @property
+    def layer_size(self):
+        return 8
+
+    def get_word_vector(self, word):
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self.syn0[i]
+
+
+class TestModelUtils:
+    @pytest.mark.parametrize("cls", [BasicModelUtils, FlatModelUtils,
+                                     TreeModelUtils])
+    def test_words_nearest_agree(self, cls):
+        model = _FakeModel()
+        utils = cls().init(model)
+        nearest = utils.words_nearest("king", top_n=1)
+        assert nearest == ["queen"]
+        sim = utils.similarity("king", "queen")
+        assert sim > 0.99
+
+    def test_basic_positive_negative(self):
+        model = _FakeModel()
+        utils = BasicModelUtils().init(model)
+        res = utils.words_nearest(["king", "woman"], top_n=2,
+                                  negative=["man"])
+        assert "queen" in res
+
+    def test_unknown_word(self):
+        utils = FlatModelUtils().init(_FakeModel())
+        assert utils.words_nearest("zzz") == []
+        assert math.isnan(utils.similarity("zzz", "king"))
+
+
+class TestTreePipeline:
+    def test_parse_structure(self):
+        t = TreeParser().parse("the quick dog runs fast")
+        assert t.label == "S"
+        assert t.yield_words() == ["the", "quick", "dog", "runs", "fast"]
+        labels = [c.label for c in t.children]
+        assert "NP" in labels and "VP" in labels
+
+    def test_collapse_unaries(self):
+        inner = ParseTree(label="NN",
+                          children=[ParseTree(label="NN", word="dog")])
+        chain = ParseTree(label="NP", children=[
+            ParseTree(label="X", children=[inner])])
+        out = CollapseUnaries().transform(chain)
+        # the X link is gone; NP directly dominates the preterminal
+        assert out.label == "NP"
+        assert out.children[0].is_leaf() or out.children[0].is_pre_terminal()
+
+    def test_binarize(self):
+        t = ParseTree(label="NP", children=[
+            ParseTree(label="DT", word="the"),
+            ParseTree(label="JJ", word="big"),
+            ParseTree(label="JJ", word="red"),
+            ParseTree(label="NN", word="dog"),
+        ])
+        b = BinarizeTreeTransformer().transform(t)
+
+        def check(n):
+            assert len(n.children) <= 2
+            for c in n.children:
+                check(c)
+
+        check(b)
+        assert b.yield_words() == ["the", "big", "red", "dog"]
+
+    def test_head_word(self):
+        t = TreeParser().parse("the quick dog runs")
+        np_chunk = next(c for c in t.children if c.label == "NP")
+        assert HeadWordFinder().find_head(np_chunk) == "dog"
+
+    def test_vectorizer_sentiment_labels(self):
+        trees = TreeVectorizer().get_trees_with_labels(
+            "the movie was great. the movie was awful.")
+        assert len(trees) == 2
+        assert trees[0].label == 2  # positive
+        assert trees[1].label == 0  # negative
+        # binary rntn trees
+        def binary(n):
+            if n.is_leaf():
+                return True
+            return (n.left is not None and n.right is not None
+                    and binary(n.left) and binary(n.right))
+        assert all(binary(t) for t in trees)
+
+
+class TestUtilMisc:
+    def test_set_utils(self):
+        assert SetUtils.intersection([1, 2], [2, 3]) == {2}
+        assert SetUtils.union([1], [2]) == {1, 2}
+        assert SetUtils.difference([1, 2], [2]) == {1}
+
+    def test_sloppy_math_log_add(self):
+        a, b = math.log(0.25), math.log(0.75)
+        assert abs(SloppyMath.log_add(a, b) - 0.0) < 1e-12
+        assert SloppyMath.log_add(-math.inf, a) == a
+        vals = [math.log(x) for x in [0.1, 0.2, 0.3, 0.4]]
+        assert abs(SloppyMath.log_add_all(vals)) < 1e-12
+
+    def test_summary_statistics(self):
+        s = SummaryStatistics.summary_stats([1.0, 2.0, 3.0, 4.0])
+        assert s.mean == 2.5
+        assert s.min == 1.0 and s.max == 4.0
+        assert abs(s.variance - np.var([1, 2, 3, 4], ddof=1)) < 1e-12
+
+    def test_multi_dimensional(self):
+        m = MultiDimensionalMap()
+        m.put("a", "b", 1)
+        assert m.get("a", "b") == 1
+        assert m.contains("a", "b") and not m.contains("b", "a")
+        s = MultiDimensionalSet()
+        s.add(1, 2)
+        assert s.contains(1, 2) and len(s) == 1
+
+    def test_fingerprint_and_cluster(self):
+        k = FingerPrintKeyer()
+        assert k.key("  Héllo,  World! ") == k.key("world hello")
+        clusters = StringCluster(
+            ["New York", "new york", "York New", "Boston"]).get_clusters()
+        assert len(clusters) == 2
+        assert sum(clusters[0].values()) == 3
+
+    def test_string_grid(self):
+        g = StringGrid.from_lines(",", ["a,1", "A ,1", "b,2"])
+        assert g.num_rows() == 3
+        g.dedup_by_column_fingerprint(0)
+        assert g.num_rows() == 2
+        assert g.filter_rows_by_column(1, {"2"}).num_rows() == 1
+
+    def test_archive_utils_zip_tar(self, tmp_path):
+        import tarfile
+        import zipfile
+
+        src = tmp_path / "f.txt"
+        src.write_text("payload")
+        z = tmp_path / "a.zip"
+        with zipfile.ZipFile(z, "w") as zf:
+            zf.write(src, "f.txt")
+        ArchiveUtils.unzip_file_to(str(z), str(tmp_path / "outz"))
+        assert (tmp_path / "outz" / "f.txt").read_text() == "payload"
+
+        t = tmp_path / "a.tar.gz"
+        with tarfile.open(t, "w:gz") as tf:
+            tf.add(src, "f.txt")
+        ArchiveUtils.unzip_file_to(str(t), str(tmp_path / "outt"))
+        assert (tmp_path / "outt" / "f.txt").read_text() == "payload"
+
+
+class TestMovingWindowFetcher:
+    def test_windows_and_labels(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.fetchers import (
+            MovingWindowDataSetFetcher,
+        )
+
+        x = np.arange(2 * 16, dtype=np.float32).reshape(2, 16)  # 4x4 imgs
+        y = np.eye(2, dtype=np.float32)
+        f = MovingWindowDataSetFetcher(DataSet(x, y), 2, 2)
+        ds = f.fetch()
+        assert ds.features.shape == (2 * 4, 4)  # 4 windows per 4x4 image
+        np.testing.assert_array_equal(ds.labels[:4],
+                                      np.tile(y[0], (4, 1)))
+        it = f.iterator(batch_size=3)
+        assert it.next().features.shape[0] == 3

@@ -1,0 +1,233 @@
+"""Tests: step functions, early-stopping listener + parallel trainer,
+clustering strategy engine.
+
+Reference test models: nn/conf/stepfunctions defaults, earlystopping/
+TestEarlyStopping listener assertions, clustering strategy conditions
+(SURVEY.md §2.3/§2.5/§2.6)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    BaseClusteringAlgorithm,
+    ClusteringOptimizationType,
+    ConvergenceCondition,
+    FixedClusterCountStrategy,
+    FixedIterationCountCondition,
+    IterationHistory,
+    IterationInfo,
+    OptimisationStrategy,
+    VarianceVariationCondition,
+)
+from deeplearning4j_tpu.earlystopping import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingListener,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    MaxEpochsTerminationCondition,
+    ParallelEarlyStoppingTrainer,
+)
+from deeplearning4j_tpu.optimize.stepfunctions import (
+    DefaultStepFunction,
+    GradientStepFunction,
+    NegativeDefaultStepFunction,
+    NegativeGradientStepFunction,
+    from_name,
+)
+
+
+class TestStepFunctions:
+    def test_all_four_variants(self):
+        x = np.array([1.0, 2.0])
+        d = np.array([0.5, -0.5])
+        np.testing.assert_allclose(
+            DefaultStepFunction().step(x, d, 2.0), [2.0, 1.0])
+        np.testing.assert_allclose(
+            GradientStepFunction().step(x, d, 2.0), [1.5, 1.5])
+        np.testing.assert_allclose(
+            NegativeDefaultStepFunction().step(x, d, 2.0), [0.0, 3.0])
+        np.testing.assert_allclose(
+            NegativeGradientStepFunction().step(x, d, 2.0), [0.5, 2.5])
+
+    def test_from_name(self):
+        assert isinstance(from_name("default"), DefaultStepFunction)
+        assert isinstance(from_name("NegativeDefaultStepFunction"),
+                          NegativeDefaultStepFunction)
+        with pytest.raises(ValueError):
+            from_name("bogus")
+
+    def test_solver_accepts_step_function(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.ops.losses import LossFunction
+        from deeplearning4j_tpu.optimize.solver import LineGradientDescent
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+            .list()
+            .layer(0, L.DenseLayer(n_in=4, n_out=4, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                    loss_function=LossFunction.MCXENT))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.normal(size=(16, 4)).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)])
+        before = net.score(ds)
+        opt = LineGradientDescent(net, max_iterations=5,
+                                  step_function="default")
+        after = opt.optimize(ds)
+        assert after < before
+
+
+def _small_net():
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+        .list()
+        .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(1, L.OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                loss_function=LossFunction.MCXENT))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _iris_like_iter(n=60, batch=20, seed=0):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+
+    rng = np.random.default_rng(seed)
+    cls = rng.integers(0, 3, n)
+    x = rng.normal(loc=cls[:, None], scale=0.3, size=(n, 4)).astype(
+        np.float32)
+    y = np.eye(3, dtype=np.float32)[cls]
+    sets = [DataSet(x[i:i + batch], y[i:i + batch])
+            for i in range(0, n, batch)]
+    return ListDataSetIterator(sets)
+
+
+class RecordingListener(EarlyStoppingListener):
+    def __init__(self):
+        self.started = False
+        self.epochs = []
+        self.completed = None
+
+    def on_start(self, config, net):
+        self.started = True
+
+    def on_epoch(self, epoch, score, config, net):
+        self.epochs.append((epoch, score))
+
+    def on_completion(self, result):
+        self.completed = result
+
+
+class TestEarlyStoppingExtensions:
+    def test_listener_lifecycle(self):
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .model_saver(InMemoryModelSaver())
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+            .build()
+        )
+        listener = RecordingListener()
+        trainer = EarlyStoppingTrainer(cfg, _small_net(), _iris_like_iter(),
+                                       listener=listener)
+        result = trainer.fit()
+        assert listener.started
+        assert len(listener.epochs) >= 3
+        assert listener.completed is result
+
+    def test_parallel_early_stopping_trainer(self):
+        import jax
+
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"dp": len(jax.devices())})
+        pt = ParallelTrainer(_small_net(), mesh=mesh)
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .model_saver(InMemoryModelSaver())
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+            .build()
+        )
+        listener = RecordingListener()
+        trainer = ParallelEarlyStoppingTrainer(
+            cfg, pt, _iris_like_iter(n=64, batch=16), listener=listener)
+        result = trainer.fit()
+        assert result.total_epochs >= 2
+        assert result.best_model is not None
+        assert listener.completed is result
+        # training actually reduced the loss
+        scores = [s for _, s in sorted(result.score_vs_epoch.items())]
+        assert scores[-1] <= scores[0]
+
+
+def _blobs(k=3, per=40, seed=0):
+    rng = np.random.default_rng(seed)
+    pts = np.concatenate([
+        rng.normal(loc=c * 5.0, scale=0.4, size=(per, 2))
+        for c in range(k)
+    ]).astype(np.float32)
+    return pts
+
+
+class TestClusteringStrategies:
+    def test_fixed_count_iteration_condition(self):
+        strat = (FixedClusterCountStrategy.setup(3)
+                 .end_when_iteration_count_equals(10))
+        algo = BaseClusteringAlgorithm.setup(strat, seed=1)
+        info = algo.apply_to(_blobs())
+        assert algo.history.iteration_count() == 10
+        assert sum(info.point_counts.values()) == 120
+        # 3 tight blobs -> every cluster non-empty, small avg distance
+        assert all(v > 0 for v in info.point_counts.values())
+        assert max(info.average_point_distance_from_center(i)
+                   for i in range(3)) < 2.0
+
+    def test_convergence_condition_stops_early(self):
+        strat = (FixedClusterCountStrategy.setup(3)
+                 .end_when_distribution_variation_rate_less_than(1e-3)
+                 .end_when_iteration_count_equals(100))
+        algo = BaseClusteringAlgorithm.setup(strat, seed=1)
+        algo.apply_to(_blobs())
+        assert algo.history.iteration_count() < 100
+
+    def test_variance_variation_condition(self):
+        h = IterationHistory()
+        cond = VarianceVariationCondition(rate=0.01, period=2)
+        for i, d in enumerate([100.0, 50.0, 49.9, 49.9, 49.9]):
+            h.add(IterationInfo(i, 0.0, 0.0, d))
+        assert cond.is_satisfied(h)
+        h2 = IterationHistory()
+        for i, d in enumerate([100.0, 50.0, 25.0]):
+            h2.add(IterationInfo(i, 0.0, 0.0, d))
+        assert not cond.is_satisfied(h2)
+
+    def test_convergence_condition_unit(self):
+        h = IterationHistory()
+        cond = ConvergenceCondition(0.01)
+        h.add(IterationInfo(0, 0, 0, 100.0))
+        assert not cond.is_satisfied(h)
+        h.add(IterationInfo(1, 0, 0, 99.99))
+        assert cond.is_satisfied(h)
+
+    def test_optimisation_strategy_and_classify(self):
+        strat = OptimisationStrategy.setup(
+            3, ClusteringOptimizationType
+            .MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE, value=1.0)
+        strat.end_when_iteration_count_equals(12)
+        algo = BaseClusteringAlgorithm.setup(strat, seed=0)
+        algo.apply_to(_blobs())
+        pc = algo.classify_point(np.array([0.0, 0.0]))
+        assert 0 <= pc.cluster_index < 3
+        assert pc.distance < 2.0
