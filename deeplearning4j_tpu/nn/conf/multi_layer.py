@@ -59,6 +59,10 @@ class MultiLayerConfiguration:
     def dtype(self) -> str:
         return self.confs[0].dtype if self.confs else "float32"
 
+    @property
+    def compute_dtype(self):
+        return self.confs[0].compute_dtype if self.confs else None
+
     def to_json(self) -> str:
         return _to_json(self)
 

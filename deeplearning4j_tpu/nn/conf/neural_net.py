@@ -73,6 +73,11 @@ class NeuralNetConfiguration:
     # Determinism / numerics (TPU-native additions).
     seed: int = 12345
     dtype: str = "float32"
+    # Mixed precision: run forward/backward math in this dtype while
+    # params/updater state stay in ``dtype`` (f32 master weights). The
+    # TPU-idiomatic setting is "bfloat16" — matmuls/convs hit the MXU at
+    # 2x f32 rate; grads accumulate in f32 through the cast transpose.
+    compute_dtype: Optional[str] = None
 
     # ------------------------------------------------------------------
     # Per-layer hyperparameter resolution (layer override -> global).

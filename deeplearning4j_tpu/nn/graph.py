@@ -34,7 +34,11 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
 )
 from deeplearning4j_tpu.nn.gradient import Gradient
 from deeplearning4j_tpu.nn.layers import get_impl
-from deeplearning4j_tpu.nn.multilayer import _dtype_of, _REGULARIZED_KEYS
+from deeplearning4j_tpu.nn.multilayer import (
+    _REGULARIZED_KEYS,
+    _cast_floating,
+    _dtype_of,
+)
 from deeplearning4j_tpu.nn.updater.updaters import (
     make_layer_updater,
     normalize_gradients,
@@ -80,6 +84,10 @@ class ComputationGraph:
         }
         first = next(iter(self._layer_vertices.values()), None)
         self._dtype = _dtype_of(first.conf.dtype if first else "float32")
+        cd = first.conf.compute_dtype if first else None
+        self._compute_dtype = (
+            _dtype_of(cd) if cd and _dtype_of(cd) != self._dtype else None
+        )
         seed = first.conf.seed if first else 12345
         self._key = jax.random.key(seed)
         self._seed = seed
@@ -116,6 +124,13 @@ class ComputationGraph:
         masks: Optional[Dict[str, Array]] = None,
     ):
         """Topological-order forward. Returns (activation dict, new_state)."""
+        if self._compute_dtype is not None:
+            # Mixed precision: bf16 compute, f32 master params (same
+            # scheme as MultiLayerNetwork._forward_fn)
+            cast = functools.partial(
+                _cast_floating, dtype=self._compute_dtype)
+            params = jax.tree_util.tree_map(cast, params)
+            inputs = {k: cast(v) for k, v in inputs.items()}
         acts: Dict[str, Array] = dict(inputs)
         new_state = dict(state) if state else {}
         # Masks propagate along edges: a vertex inherits its first input's
@@ -164,6 +179,12 @@ class ComputationGraph:
                     mask=mask,
                 )
                 if st is not None and name in new_state:
+                    if self._compute_dtype is not None:
+                        # carried state stays at master dtype so repeated
+                        # steps see stable input dtypes (no recompiles)
+                        st = jax.tree_util.tree_map(
+                            functools.partial(_cast_floating,
+                                              dtype=self._dtype), st)
                     new_state[name] = st
                 acts[name] = out
             elif isinstance(vertex, MergeVertex):
@@ -197,7 +218,10 @@ class ComputationGraph:
             impl = self._impls[out_name]
             v = self._layer_vertices[out_name]
             lm = None if label_masks is None else label_masks.get(out_name)
-            score = score + impl.loss(v.conf, acts[out_name], y, lm)
+            out = acts[out_name]
+            if self._compute_dtype is not None:
+                out = _cast_floating(out, dtype=self._dtype)  # loss in f32
+            score = score + impl.loss(v.conf, out, y, lm)
         score = score + self._reg_score(params)
         return score, new_state
 

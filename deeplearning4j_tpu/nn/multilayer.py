@@ -41,9 +41,23 @@ from deeplearning4j_tpu.nn.updater.updaters import (
 Array = jax.Array
 
 
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+           "float16": jnp.float16, "float64": jnp.float64}
+
+
 def _dtype_of(name: str):
-    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-            "float16": jnp.float16, "float64": jnp.float64}[name]
+    if name not in _DTYPES:
+        raise ValueError(
+            f"unknown dtype {name!r} (dtype/compute_dtype accepts "
+            f"{sorted(_DTYPES)})")
+    return _DTYPES[name]
+
+
+def _cast_floating(a, dtype):
+    """Cast floating arrays, leave ints/bools (masks, indices) alone."""
+    if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+        return a.astype(dtype)
+    return a
 
 
 _REGULARIZED_KEYS = ("W", "RW", "W_bwd", "RW_bwd")
@@ -69,6 +83,10 @@ class MultiLayerNetwork:
         self._rnn_state: Dict[str, Any] = {}
         self._initialized = False
         self._dtype = _dtype_of(conf.dtype)
+        cd = conf.compute_dtype
+        self._compute_dtype = (
+            _dtype_of(cd) if cd and _dtype_of(cd) != self._dtype else None
+        )
         self._key = jax.random.key(conf.seed)
 
     # ------------------------------------------------------------------
@@ -109,6 +127,14 @@ class MultiLayerNetwork:
         collect: bool = False,
     ):
         """Returns (final_or_all_activations, new_state, new_rnn_state)."""
+        cd = self._compute_dtype
+        if cd is not None:
+            # Mixed precision: compute in cd (bf16 on the MXU), master
+            # params stay f32 — the cast's transpose accumulates grads
+            # back in f32.
+            cast = functools.partial(_cast_floating, dtype=cd)
+            params = jax.tree_util.tree_map(cast, params)
+            x = cast(x)
         acts = []
         new_state = dict(state) if state else {}
         new_rnn = {}
@@ -143,6 +169,12 @@ class MultiLayerNetwork:
                 rngs[i] if train else None, mask,
             )
             if st is not None:
+                if cd is not None:
+                    # keep carried state at the master dtype so repeated
+                    # steps see stable input dtypes (no recompiles)
+                    st = jax.tree_util.tree_map(
+                        functools.partial(_cast_floating,
+                                          dtype=self._dtype), st)
                 if state and si in state:
                     new_state[si] = st
                 else:
@@ -163,6 +195,8 @@ class MultiLayerNetwork:
             raise ValueError(
                 "Last layer must be an output layer to compute a score"
             )
+        if self._compute_dtype is not None:
+            out = _cast_floating(out, dtype=self._dtype)  # loss in f32
         score = impl.loss(out_conf, out, labels, label_mask)
         score = score + self._reg_score(params)
         return score, new_state
@@ -188,32 +222,78 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # The jitted train step (whole §3.1 stack as one XLA computation)
     # ------------------------------------------------------------------
+    def _step_body(self, params, state, upd_state, iteration, rng, features,
+                   labels, feature_mask, label_mask):
+        (score, new_state), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True
+        )(params, state, rng, features, labels, feature_mask, label_mask)
+        new_params = {}
+        new_upd = {}
+        for i, (c, upd) in enumerate(zip(self.conf.confs, self._updaters)):
+            si = str(i)
+            g = normalize_gradients(
+                c.resolved("gradient_normalization"),
+                grads[si],
+                float(c.resolved("gradient_normalization_threshold")),
+            )
+            lr = resolve_lr(c, iteration)
+            updates, new_upd[si] = upd.update(
+                g, upd_state[si], lr, iteration
+            )
+            new_params[si] = jax.tree.map(
+                lambda p, u: p - u, params[si], updates
+            )
+        return new_params, new_state, new_upd, score
+
     @functools.cached_property
     def _train_step(self):
-        def step(params, state, upd_state, iteration, rng, features, labels,
-                 feature_mask, label_mask):
-            (score, new_state), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True
-            )(params, state, rng, features, labels, feature_mask, label_mask)
-            new_params = {}
-            new_upd = {}
-            for i, (c, upd) in enumerate(zip(self.conf.confs, self._updaters)):
-                si = str(i)
-                g = normalize_gradients(
-                    c.resolved("gradient_normalization"),
-                    grads[si],
-                    float(c.resolved("gradient_normalization_threshold")),
-                )
-                lr = resolve_lr(c, iteration)
-                updates, new_upd[si] = upd.update(
-                    g, upd_state[si], lr, iteration
-                )
-                new_params[si] = jax.tree.map(
-                    lambda p, u: p - u, params[si], updates
-                )
-            return new_params, new_state, new_upd, score
+        return jax.jit(self._step_body, donate_argnums=(0, 1, 2))
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+    @functools.cached_property
+    def _train_steps_scan(self):
+        """K train steps as ONE XLA computation via ``lax.scan`` — one
+        host dispatch per K batches instead of per batch. This is the
+        dispatch-latency killer for small models: per-step launches over
+        PCIe/tunnel otherwise dominate sub-millisecond step times."""
+
+        def steps(params, state, upd_state, iteration, rng, feats, labels):
+            def body(carry, inp):
+                p, s, u, it, key = carry
+                key, sub = jax.random.split(key)
+                f, y = inp
+                p, s, u, score = self._step_body(
+                    p, s, u, it, sub, f, y, None, None)
+                return (p, s, u, it + 1, key), score
+
+            (p, s, u, it, _), scores = jax.lax.scan(
+                body, (params, state, upd_state, iteration, rng),
+                (feats, labels))
+            return p, s, u, scores
+
+        return jax.jit(steps, donate_argnums=(0, 1, 2))
+
+    def fit_scan(self, features_stacked, labels_stacked):
+        """Run one scanned pass over pre-stacked batches
+        ([K, B, ...], [K, B, n_out]); returns the K per-step scores as a
+        device array (convert with np.asarray to force a sync — kept lazy
+        here so chained calls pipeline without a host round-trip each).
+        Unmasked fast path — use fit() when masks are needed."""
+        self.init()
+        feats = jnp.asarray(features_stacked, self._dtype)
+        labels = jnp.asarray(labels_stacked, self._dtype)
+        self._key, sub = jax.random.split(self._key)
+        self.params, self.state, self.updater_state, scores = (
+            self._train_steps_scan(
+                self.params, self.state, self.updater_state,
+                self.iteration, sub, feats, labels))
+        self.iteration += int(feats.shape[0])
+        self.score_value = scores[-1]  # lazy device scalar, like _fit_batch
+        for listener in self.listeners:
+            if listener.invoked_every <= 1 or (
+                self.iteration % listener.invoked_every == 0
+            ):
+                listener.iteration_done(self, self.iteration)
+        return scores
 
     @functools.cached_property
     def _grad_and_score(self):
@@ -327,6 +407,8 @@ class MultiLayerNetwork:
             out, _, new_rnn = self._forward_fn(
                 params, self.state, f, rng, True, fm, rnn_state=rnn_state
             )
+            if self._compute_dtype is not None:
+                out = _cast_floating(out, dtype=self._dtype)  # loss in f32
             impl = self._impls[-1]
             score = impl.loss(self.conf.confs[-1], out, y, lm)
             score = score + self._reg_score(params)
@@ -403,6 +485,8 @@ class MultiLayerNetwork:
     def _loss_eval(self):
         def f(params, state, x, y, fm, lm):
             out, _, _ = self._forward_fn(params, state, x, None, False, fm)
+            if self._compute_dtype is not None:
+                out = _cast_floating(out, dtype=self._dtype)  # loss in f32
             impl = self._impls[-1]
             score = impl.loss(self.conf.confs[-1], out, y, lm)
             return score + self._reg_score(params), out
