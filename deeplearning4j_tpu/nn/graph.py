@@ -38,6 +38,7 @@ from deeplearning4j_tpu.nn.multilayer import (
     _REGULARIZED_KEYS,
     _cast_floating,
     _dtype_of,
+    _resolve_compute_dtype,
 )
 from deeplearning4j_tpu.nn.updater.updaters import (
     make_layer_updater,
@@ -84,10 +85,8 @@ class ComputationGraph:
         }
         first = next(iter(self._layer_vertices.values()), None)
         self._dtype = _dtype_of(first.conf.dtype if first else "float32")
-        cd = first.conf.compute_dtype if first else None
-        self._compute_dtype = (
-            _dtype_of(cd) if cd and _dtype_of(cd) != self._dtype else None
-        )
+        self._compute_dtype = _resolve_compute_dtype(
+            self._dtype, first.conf.compute_dtype if first else None)
         seed = first.conf.seed if first else 12345
         self._key = jax.random.key(seed)
         self._seed = seed

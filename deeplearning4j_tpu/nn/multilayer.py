@@ -60,6 +60,14 @@ def _cast_floating(a, dtype):
     return a
 
 
+def _resolve_compute_dtype(master_dtype, compute_dtype_name):
+    """Mixed-precision compute dtype, or None when it matches master."""
+    if not compute_dtype_name:
+        return None
+    cd = _dtype_of(compute_dtype_name)
+    return cd if cd != master_dtype else None
+
+
 _REGULARIZED_KEYS = ("W", "RW", "W_bwd", "RW_bwd")
 
 
@@ -83,10 +91,8 @@ class MultiLayerNetwork:
         self._rnn_state: Dict[str, Any] = {}
         self._initialized = False
         self._dtype = _dtype_of(conf.dtype)
-        cd = conf.compute_dtype
-        self._compute_dtype = (
-            _dtype_of(cd) if cd and _dtype_of(cd) != self._dtype else None
-        )
+        self._compute_dtype = _resolve_compute_dtype(
+            self._dtype, conf.compute_dtype)
         self._key = jax.random.key(conf.seed)
 
     # ------------------------------------------------------------------
@@ -277,11 +283,21 @@ class MultiLayerNetwork:
         ([K, B, ...], [K, B, n_out]); returns the K per-step scores as a
         device array (convert with np.asarray to force a sync — kept lazy
         here so chained calls pipeline without a host round-trip each).
-        Unmasked fast path — use fit() when masks are needed."""
+        Unmasked plain-SGD fast path — use fit() when masks, tBPTT, or a
+        second-order solver are configured."""
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            raise ValueError(
+                "fit_scan is the full-BPTT SGD fast path; truncated-BPTT "
+                "configs must train via fit()")
+        algo = self.conf.confs[0].optimization_algo
+        if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            raise ValueError(
+                f"fit_scan only supports SGD, not {algo}; use fit()")
         self.init()
         feats = jnp.asarray(features_stacked, self._dtype)
         labels = jnp.asarray(labels_stacked, self._dtype)
         self._key, sub = jax.random.split(self._key)
+        start = self.iteration
         self.params, self.state, self.updater_state, scores = (
             self._train_steps_scan(
                 self.params, self.state, self.updater_state,
@@ -289,9 +305,10 @@ class MultiLayerNetwork:
         self.iteration += int(feats.shape[0])
         self.score_value = scores[-1]  # lazy device scalar, like _fit_batch
         for listener in self.listeners:
-            if listener.invoked_every <= 1 or (
-                self.iteration % listener.invoked_every == 0
-            ):
+            n = max(1, listener.invoked_every)
+            # fire once per call iff the K-step window crossed a multiple
+            # of n (same cadence fit() would show, coalesced per call)
+            if self.iteration // n > start // n:
                 listener.iteration_done(self, self.iteration)
         return scores
 

@@ -165,7 +165,51 @@ class TestFitScan:
         assert scores[-1] < before
         assert scores[-1] < scores[0]
 
-    def test_chained_calls_stay_lazy_and_finite(self):
+    def test_rejects_tbptt_and_second_order(self):
+        import pytest
+
+        from deeplearning4j_tpu.nn.conf.enums import (
+            BackpropType,
+            OptimizationAlgorithm,
+        )
+
+        x, y = _data(32)
+        feats, labels = np.stack([x]), np.stack([y])
+
+        lb = (NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+              .list())
+        lb.layer(0, L.DenseLayer(n_in=8, n_out=8, activation="tanh"))
+        lb.layer(1, L.OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss_function=LossFunction.MCXENT))
+        tb = lb.backprop_type(BackpropType.TRUNCATED_BPTT).build()
+        with pytest.raises(ValueError, match="truncated"):
+            MultiLayerNetwork(tb).init().fit_scan(feats, labels)
+
+        lb2 = (NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+               .optimization_algo(OptimizationAlgorithm.LBFGS).list())
+        lb2.layer(0, L.DenseLayer(n_in=8, n_out=8, activation="tanh"))
+        lb2.layer(1, L.OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss_function=LossFunction.MCXENT))
+        with pytest.raises(ValueError, match="SGD"):
+            MultiLayerNetwork(lb2.build()).init().fit_scan(feats, labels)
+
+    def test_listener_cadence_matches_fit(self):
+        from deeplearning4j_tpu.optimize.listeners import (
+            ScoreIterationListener,
+        )
+
+        x, y = _data(64)
+        feats = np.stack([x[:32], x[32:]] * 8)  # K=16 steps per call
+        labels = np.stack([y[:32], y[32:]] * 8)
+        net = MultiLayerNetwork(_conf()).init()
+        fired = []
+
+        listener = ScoreIterationListener(10)
+        listener.iteration_done = lambda model, it: fired.append(it)
+        net.listeners = [listener]
+        net.fit_scan(feats, labels)  # iterations 0 -> 16: crosses 10
+        net.fit_scan(feats, labels)  # 16 -> 32: crosses 20 and 30
+        assert fired == [16, 32]
         x, y = _data(n=64)
         feats = np.stack([x[:32], x[32:]])
         labels = np.stack([y[:32], y[32:]])

@@ -1,0 +1,108 @@
+"""Orbax sharded-checkpoint backend: save/restore triple, retention,
+latest-step selection (SURVEY.md §5.4 — the pod-scale complement to the
+single-zip ModelSerializer)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint")
+
+from deeplearning4j_tpu.checkpoint.orbax_io import OrbaxCheckpointer
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def _net():
+    conf = (
+        NeuralNetConfiguration.Builder().seed(2).learning_rate(0.1).list()
+        .layer(0, L.DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(1, L.OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                loss_function=LossFunction.MCXENT))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    return x, np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+
+
+class TestOrbaxCheckpointer:
+    def test_round_trip_and_latest(self, tmp_path):
+        net = _net()
+        x, y = _data()
+        ck = OrbaxCheckpointer(str(tmp_path / "ckpt"))
+        for step in range(3):
+            for _ in range(3):
+                net.fit(x, y)
+            ck.save(step, net, wait=True)
+        assert ck.all_steps() == [0, 1, 2]
+        assert ck.latest_step() == 2
+
+        restored = ck.restore()  # latest
+        np.testing.assert_allclose(
+            np.asarray(net.params_flat()),
+            np.asarray(restored.params_flat()), rtol=1e-6)
+        assert restored.iteration == net.iteration
+        # restored net keeps training
+        restored.fit(x, y)
+        assert np.isfinite(float(restored.score_value))
+        ck.close()
+
+    def test_retention(self, tmp_path):
+        net = _net()
+        x, y = _data()
+        ck = OrbaxCheckpointer(str(tmp_path / "ckpt"), max_to_keep=2)
+        for step in range(4):
+            net.fit(x, y)
+            ck.save(step, net, wait=True)
+        ck.wait_until_finished()
+        assert len(ck.all_steps()) <= 2
+        assert ck.latest_step() == 3
+        ck.close()
+
+    def test_restore_empty_raises(self, tmp_path):
+        ck = OrbaxCheckpointer(str(tmp_path / "empty"))
+        with pytest.raises(FileNotFoundError):
+            ck.restore()
+        ck.close()
+
+    def test_graph_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(4).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("h", L.DenseLayer(n_in=4, n_out=8,
+                                         activation="tanh"), "in")
+            .add_layer("out", L.OutputLayer(
+                n_in=8, n_out=3, activation="softmax",
+                loss_function=LossFunction.MCXENT), "h")
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        x, y = _data()
+        for _ in range(3):
+            net.fit(x, y)
+        ck = OrbaxCheckpointer(str(tmp_path / "g"))
+        ck.save(0, net, wait=True)
+        restored = ck.restore()
+        assert isinstance(restored, ComputationGraph)
+        for name in net.params:
+            for k in net.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(net.params[name][k]),
+                    np.asarray(restored.params[name][k]), rtol=1e-6)
+        ck.close()
+
+    def test_save_rejects_unknown_model(self, tmp_path):
+        ck = OrbaxCheckpointer(str(tmp_path / "bad"))
+        with pytest.raises(TypeError):
+            ck.save(0, object())
+        ck.close()
