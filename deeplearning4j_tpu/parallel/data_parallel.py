@@ -134,6 +134,32 @@ class ParallelTrainer:
             NamedSharding(self.mesh, P(self.dp_axis)),
         )
 
+    def _shard_stacked(self, arr):
+        """[K, B, ...] pre-stacked batches: shard B over dp, K stays on
+        every device (it is the scan axis)."""
+        return jax.device_put(
+            jnp.asarray(arr, self.net._dtype),
+            NamedSharding(self.mesh, P(None, self.dp_axis)),
+        )
+
+    def fit_scan(self, features_stacked, labels_stacked):
+        """K fused global steps: ``lax.scan`` over pre-stacked sharded
+        batches ([K, B, ...] with B split over the dp axis) — one host
+        dispatch per K synchronous all-reduced steps. The pod-scale
+        composition of MultiLayerNetwork.fit_scan: XLA inserts the
+        gradient all-reduce inside the scan body, so the ICI collective
+        pipelines with compute across all K steps."""
+        if not self.average_each_iteration:
+            raise ValueError(
+                "fit_scan is the per-step-synchronous path; "
+                "K-local-steps mode already fuses via local_steps")
+        # Shard then delegate: jnp.asarray inside net.fit_scan preserves
+        # the placement, and the net-level guards (tBPTT, non-SGD) and
+        # listener cadence apply identically here.
+        return self.net.fit_scan(
+            self._shard_stacked(features_stacked),
+            self._shard_stacked(labels_stacked))
+
     # ------------------------------------------------------------------
     def fit(self, data, labels=None) -> float:
         """One (or more) global synchronous steps on the given batch."""

@@ -276,3 +276,82 @@ class InMemoryStateTracker(StateTracker):
     def is_done(self) -> bool:
         with self._lock:
             return self._done
+
+
+class JobIteratorFactory:
+    """Conf-driven JobIterator construction (reference scaleout-api
+    JobIteratorFactory / CollectionJobIteratorFactory /
+    DataSetIteratorFactory: workers instantiate their job sources
+    reflectively from the cluster configuration)."""
+
+    def create(self) -> JobIterator:
+        raise NotImplementedError
+
+
+class CollectionJobIteratorFactory(JobIteratorFactory):
+    def __init__(self, items: Sequence[Any]):
+        self.items = list(items)
+
+    def create(self) -> JobIterator:
+        return ListJobIterator(self.items)
+
+
+class DataSetJobIterator(JobIterator):
+    """Jobs drawn from a DataSetIterator — one DataSet batch per job
+    (reference DataSetIteratorJobIterator)."""
+
+    def __init__(self, iterator):
+        import threading
+
+        self.iterator = iterator
+        self._n = 0
+        self._peek = None
+        # the master hands jobs to workers concurrently (same contract as
+        # the lock-guarded ListJobIterator above)
+        self._lock = threading.Lock()
+
+    def next(self, worker_id: Optional[str] = None) -> Optional[Job]:
+        with self._lock:
+            ds = self._peek if self._peek is not None else \
+                self.iterator.next()
+            self._peek = None
+            if ds is None:
+                return None
+            job = Job(work=ds, worker_id=worker_id, job_id=self._n)
+            self._n += 1
+            return job
+
+    def has_next(self) -> bool:
+        with self._lock:
+            if self._peek is None:
+                self._peek = self.iterator.next()
+            return self._peek is not None
+
+    def reset(self) -> None:
+        with self._lock:
+            self.iterator.reset()
+            self._n = 0
+            self._peek = None
+
+
+class DataSetIteratorFactory:
+    """Conf-driven DataSetIterator construction (reference
+    canova/DataSetIteratorFactory): resolve a dotted factory path from
+    cluster config so every worker builds an identical local pipeline."""
+
+    KEY = "org.deeplearning4j.scaleout.dataset_iterator_factory"
+
+    def create(self):
+        raise NotImplementedError
+
+    @staticmethod
+    def from_conf(conf: dict) -> "DataSetIteratorFactory":
+        import importlib
+
+        dotted = conf[DataSetIteratorFactory.KEY]
+        module, _, name = dotted.rpartition(".")
+        cls = getattr(importlib.import_module(module), name)
+        inst = cls()
+        if not isinstance(inst, DataSetIteratorFactory):
+            raise TypeError(f"{dotted} is not a DataSetIteratorFactory")
+        return inst

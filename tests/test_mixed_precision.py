@@ -210,6 +210,8 @@ class TestFitScan:
         net.fit_scan(feats, labels)  # iterations 0 -> 16: crosses 10
         net.fit_scan(feats, labels)  # 16 -> 32: crosses 20 and 30
         assert fired == [16, 32]
+
+    def test_chained_calls_stay_lazy_and_finite(self):
         x, y = _data(n=64)
         feats = np.stack([x[:32], x[32:]])
         labels = np.stack([y[:32], y[32:]])

@@ -1,0 +1,114 @@
+"""Pod-scale fused training (ParallelTrainer.fit_scan over the dp mesh)
+and conf-driven iterator factory SPIs."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.scaleout.api import (
+    CollectionJobIteratorFactory,
+    DataSetIteratorFactory,
+    DataSetJobIterator,
+)
+
+
+def _net(compute_dtype=None):
+    b = NeuralNetConfiguration.Builder().seed(5).learning_rate(0.1)
+    if compute_dtype:
+        b = b.compute_dtype(compute_dtype)
+    conf = (b.list()
+            .layer(0, L.DenseLayer(n_in=8, n_out=16, activation="tanh"))
+            .layer(1, L.OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                    loss_function=LossFunction.MCXENT))
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+def _stacked(k=6, batch=32, seed=0):
+    rng = np.random.default_rng(seed)
+    cls = rng.integers(0, 3, k * batch)
+    x = rng.normal(loc=cls[:, None] * 0.7,
+                   size=(k * batch, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[cls]
+    return (x.reshape(k, batch, 8), y.reshape(k, batch, 3), x, cls)
+
+
+class TestParallelFitScan:
+    def test_scanned_global_steps_converge(self):
+        mesh = make_mesh(MeshSpec({"dp": len(jax.devices())}))
+        trainer = ParallelTrainer(_net("bfloat16"), mesh=mesh)
+        feats, labels, x, cls = _stacked()
+        first = None
+        for _ in range(20):
+            scores = trainer.fit_scan(feats, labels)
+            if first is None:
+                first = float(np.asarray(scores[0]))
+        last = float(np.asarray(scores[-1]))
+        assert last < first
+        acc = (trainer.net.predict(x) == cls).mean()
+        assert acc > 0.8
+        assert trainer.net.iteration == 20 * feats.shape[0]
+
+    def test_rejects_local_steps_mode(self):
+        mesh = make_mesh(MeshSpec({"dp": len(jax.devices())}))
+        trainer = ParallelTrainer(_net(), mesh=mesh,
+                                  average_each_iteration=False,
+                                  local_steps=2)
+        feats, labels, _, _ = _stacked(k=2)
+        with pytest.raises(ValueError, match="local_steps"):
+            trainer.fit_scan(feats, labels)
+
+
+class _IrisLikeFactory(DataSetIteratorFactory):
+    def create(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(12, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 12)]
+        return ListDataSetIterator(
+            [DataSet(x[i:i + 4], y[i:i + 4]) for i in range(0, 12, 4)])
+
+
+class TestIteratorFactories:
+    def test_collection_job_iterator_factory(self):
+        it = CollectionJobIteratorFactory([1, 2, 3]).create()
+        jobs = []
+        while it.has_next():
+            jobs.append(it.next("w0"))
+        assert [j.work for j in jobs] == [1, 2, 3]
+        it.reset()
+        assert it.has_next()
+
+    def test_dataset_job_iterator(self):
+        ds_iter = _IrisLikeFactory().create()
+        jobs = DataSetJobIterator(ds_iter)
+        seen = 0
+        while jobs.has_next():
+            job = jobs.next("w1")
+            assert job.work.features.shape == (4, 4)
+            assert job.job_id == seen
+            seen += 1
+        assert seen == 3
+        jobs.reset()
+        assert jobs.has_next()
+        assert jobs.next().job_id == 0
+
+    def test_factory_from_conf(self):
+        conf = {DataSetIteratorFactory.KEY:
+                f"{__name__}._IrisLikeFactory"}
+        factory = DataSetIteratorFactory.from_conf(conf)
+        assert isinstance(factory, _IrisLikeFactory)
+        it = factory.create()
+        assert it.next().num_examples() == 4
+
+    def test_factory_from_conf_rejects_wrong_type(self):
+        conf = {DataSetIteratorFactory.KEY: "builtins.dict"}
+        with pytest.raises(TypeError):
+            DataSetIteratorFactory.from_conf(conf)
