@@ -301,8 +301,6 @@ class DataSetJobIterator(JobIterator):
     (reference DataSetIteratorJobIterator)."""
 
     def __init__(self, iterator):
-        import threading
-
         self.iterator = iterator
         self._n = 0
         self._peek = None
