@@ -323,44 +323,77 @@ class SequenceVectors:
             1,
             self.vocab.total_word_occurrences() * self.window * self.epochs,
         )
-        CHUNK = 64  # batches per device dispatch (see _hs_step docstring)
+        def annealed_lrs(done, s, bsize):
+            fracs = (done + np.arange(s) * bsize) / denom
+            return np.maximum(
+                self.min_learning_rate,
+                self.learning_rate * (1.0 - np.minimum(1.0, fracs)),
+            ).astype(np.float32)
+
+        key_box = [key]
         for epoch in range(self.epochs):
             seqs = (
                 sequences_factory()
                 if callable(sequences_factory)
                 else sequences_factory
             )
-            batches = list(self._mine_pairs(seqs, rng))
-            groups: dict = {}
-            for c, x in batches:
-                groups.setdefault(len(c), []).append((c, x))
-            for bsize, group in groups.items():
-                for start in range(0, len(group), CHUNK):
-                    chunk = group[start : start + CHUNK]
-                    s = len(chunk)
-                    cen = np.stack([c for c, _ in chunk])
-                    ctx = np.stack([x for _, x in chunk])
-                    fracs = (
-                        pairs_done + np.arange(s) * bsize
-                    ) / denom
-                    lrs = np.maximum(
-                        self.min_learning_rate,
-                        self.learning_rate * (1.0 - np.minimum(1.0, fracs)),
-                    ).astype(np.float32)
-                    cen_d = jnp.asarray(cen)
-                    ctx_d = jnp.asarray(ctx)
-                    lrs_d = jnp.asarray(lrs)
-                    if self.use_hs:
-                        self.syn0, self.syn1, loss = self._hs_step(
-                            self.syn0, self.syn1, cen_d, ctx_d, lrs_d
-                        )
-                    if self.negative > 0:
-                        key, sub = jax.random.split(key)
-                        self.syn0, self.syn1neg, loss = self._ns_step(
-                            self.syn0, self.syn1neg, cen_d, ctx_d, lrs_d, sub
-                        )
-                    pairs_done += s * bsize
+            pairs_done = self._dispatch_chunks(
+                self._mine_pairs(seqs, rng), annealed_lrs, key_box,
+                pairs_done)
         self._pairs_trained = pairs_done
+
+    # batches per device dispatch (see _hs_step docstring)
+    _DISPATCH_CHUNK = 64
+
+    def _dispatch_chunks(self, batches, lr_fn, key_box, pairs_done=0) -> int:
+        """Group mined (centers, contexts) batches by size, stack chunks,
+        run the scanned jitted updates. ``lr_fn(pairs_done, s, bsize)``
+        builds the per-batch learning rates; ``key_box`` is a 1-element
+        list holding the RNG key (advanced in place). Returns the updated
+        pair count. Shared by fit() and train_sequences()."""
+        groups: dict = {}
+        for c, x in batches:
+            groups.setdefault(len(c), []).append((c, x))
+        for bsize, group in groups.items():
+            for start in range(0, len(group), self._DISPATCH_CHUNK):
+                chunk = group[start:start + self._DISPATCH_CHUNK]
+                s = len(chunk)
+                cen_d = jnp.asarray(np.stack([c for c, _ in chunk]))
+                ctx_d = jnp.asarray(np.stack([x for _, x in chunk]))
+                lrs_d = jnp.asarray(lr_fn(pairs_done, s, bsize))
+                if self.use_hs:
+                    self.syn0, self.syn1, _ = self._hs_step(
+                        self.syn0, self.syn1, cen_d, ctx_d, lrs_d
+                    )
+                if self.negative > 0:
+                    key_box[0], sub = jax.random.split(key_box[0])
+                    self.syn0, self.syn1neg, _ = self._ns_step(
+                        self.syn0, self.syn1neg, cen_d, ctx_d, lrs_d, sub
+                    )
+                pairs_done += s * bsize
+        return pairs_done
+
+    def train_sequences(self, sequences, learning_rate=None) -> int:
+        """One incremental pass over the given token sequences at a fixed
+        learning rate — the ``trainSentence`` granularity the param-server
+        performers dispatch at (reference scaleout/perform/.../
+        Word2VecPerformer.java:232), vs ``fit``'s full annealed epochs.
+        Returns the number of (center, context) pairs trained."""
+        if self.vocab is None:
+            raise ValueError("build_vocab_from must run before training")
+        lr = float(learning_rate if learning_rate is not None
+                   else self.learning_rate)
+        if not hasattr(self, "_stream_rng"):
+            self._stream_rng = np.random.default_rng(self.seed + 7)
+            self._stream_key = jax.random.key(self.seed + 11)
+        key_box = [self._stream_key]
+        done = self._dispatch_chunks(
+            self._mine_pairs(sequences, self._stream_rng),
+            lambda _done, s, _bsize: np.full((s,), lr, np.float32),
+            key_box,
+        )
+        self._stream_key = key_box[0]
+        return done
 
     # ------------------------------------------------------------------
     # WordVectors API (reference wordvectors/WordVectors.java)

@@ -15,7 +15,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.scaleout.api import Job, WorkerPerformer
+from deeplearning4j_tpu.scaleout.api import Job, JobAggregator, WorkerPerformer
 
 
 class NeuralNetWorkPerformer(WorkerPerformer):
@@ -62,3 +62,96 @@ class NeuralNetWorkPerformer(WorkerPerformer):
             self._net.params = value
         else:
             self._pending_params = value
+
+
+class Word2VecWorkPerformer(WorkerPerformer):
+    """Distributed Word2Vec over the runner (reference nlp
+    scaleout/perform/models/word2vec/Word2VecPerformer.java: workers pull
+    sentence jobs, train skip-gram on their local tables, the aggregator
+    averages — the Hogwild races become deterministic batched steps).
+
+    job.work = list of token sequences (or {"sentences": [...],
+    "learning_rate": f}). Returns the worker's updated lookup tables.
+
+    Each performer trains a LOCAL copy of the model's tables (reference
+    workers own their tables too; sharing them across threads would race
+    the very updates the aggregator is supposed to merge) — the shared
+    model only changes through ``update`` pushes or ``apply_update``.
+    """
+
+    def __init__(self, vec):
+        import copy
+
+        self.vec = copy.copy(vec)  # local tables; vocab/config shared
+        for attr in ("_stream_rng", "_stream_key"):
+            if hasattr(self.vec, attr):
+                delattr(self.vec, attr)
+
+    @staticmethod
+    def apply_update(vec, aggregated: Dict[str, Any]) -> None:
+        """Push aggregated tables into a model (master side)."""
+        import jax.numpy as jnp
+
+        for name in ("syn0", "syn1", "syn1neg"):
+            if name in aggregated:
+                setattr(vec, name, jnp.asarray(aggregated[name]))
+
+    def perform(self, job: Job) -> Dict[str, Any]:
+        work = job.work
+        if isinstance(work, dict):
+            sentences = work["sentences"]
+            lr = work.get("learning_rate")
+        else:
+            sentences, lr = work, None
+        trained = self.vec.train_sequences(sentences, learning_rate=lr)
+        out = {"syn0": np.asarray(self.vec.syn0), "pairs": trained}
+        if getattr(self.vec, "use_hs", False):
+            out["syn1"] = np.asarray(self.vec.syn1)
+        if getattr(self.vec, "negative", 0) > 0:
+            out["syn1neg"] = np.asarray(self.vec.syn1neg)
+        return out
+
+    def update(self, value: Any) -> None:
+        """Averaged tables pushed back down (reference
+        Word2VecPerformer.update via the state tracker)."""
+        if isinstance(value, dict):
+            self.apply_update(self.vec, value)
+
+
+class Word2VecJobAggregator(JobAggregator):
+    """Average worker lookup tables elementwise (reference nlp
+    Word2VecJobAggregator / INDArrayAggregator)."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._sums: Dict[str, np.ndarray] = {}
+        self._count = 0
+        # worker result callbacks accumulate concurrently (same contract
+        # as the lock-guarded ArrayAveragingAggregator)
+        self._lock = threading.Lock()
+
+    def accumulate(self, result: Any) -> None:
+        if not isinstance(result, dict):
+            return
+        with self._lock:
+            for name in ("syn0", "syn1", "syn1neg"):
+                if name in result:
+                    arr = np.asarray(result[name], np.float64)
+                    if name in self._sums:
+                        self._sums[name] += arr
+                    else:
+                        self._sums[name] = arr.copy()
+            self._count += 1
+
+    def aggregate(self) -> Any:
+        with self._lock:
+            if not self._count:
+                return {}
+            return {name: (s / self._count).astype(np.float32)
+                    for name, s in self._sums.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sums = {}
+            self._count = 0
