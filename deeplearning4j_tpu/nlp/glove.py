@@ -107,6 +107,59 @@ class Glove(SequenceVectors):
         return step
 
     # ------------------------------------------------------------------
+    TABLE_NAMES = ("w", "wt", "b", "bt", "gw", "gwt", "gb", "gbt")
+
+    def init_tables(self) -> None:
+        """Allocate factorization tables + AdaGrad accumulators on the
+        model so training can proceed incrementally (the distributed
+        performer trains co-occurrence shards between table averages)."""
+        v, d = self.vocab.num_words(), self.layer_size
+        key = jax.random.key(self.seed)
+        k1, k2 = jax.random.split(key)
+        self.w = (jax.random.uniform(k1, (v, d)) - 0.5) / d
+        self.wt = (jax.random.uniform(k2, (v, d)) - 0.5) / d
+        self.b = jnp.zeros((v,))
+        self.bt = jnp.zeros((v,))
+        self.gw = jnp.zeros((v, d))
+        self.gwt = jnp.zeros((v, d))
+        self.gb = jnp.zeros((v,))
+        self.gbt = jnp.zeros((v,))
+        self.losses: List[float] = []
+        # fresh shuffle stream: repeated fit() runs stay seed-reproducible
+        self._glove_rng = np.random.default_rng(self.seed)
+
+    def train_cooccurrences(self, rows, cols, xij,
+                            learning_rate=None) -> float:
+        """One shuffled pass over the given co-occurrence triples at a
+        fixed lr; returns the last batch loss — the incremental
+        granularity the distributed GlovePerformer dispatches at
+        (reference scaleout/perform/models/glove/GlovePerformer.java)."""
+        if not hasattr(self, "w"):
+            raise ValueError("init_tables() (or fit) must run first")
+        lr = float(learning_rate if learning_rate is not None
+                   else self.learning_rate)
+        rows = np.asarray(rows, np.int32)
+        cols = np.asarray(cols, np.int32)
+        xij = np.asarray(xij, np.float32)
+        if len(rows) == 0:
+            return 0.0  # empty shard: no work, a real (non-NaN) loss
+        if not hasattr(self, "_glove_rng"):
+            self._glove_rng = np.random.default_rng(self.seed)
+        order = self._glove_rng.permutation(len(rows))
+        loss = float("nan")
+        for start in range(0, len(rows), self.batch_size):
+            sel = order[start : start + self.batch_size]
+            (self.w, self.wt, self.b, self.bt, self.gw, self.gwt,
+             self.gb, self.gbt, loss) = self._glove_step(
+                self.w, self.wt, self.b, self.bt,
+                self.gw, self.gwt, self.gb, self.gbt,
+                jnp.asarray(rows[sel]), jnp.asarray(cols[sel]),
+                jnp.asarray(xij[sel]), lr,
+            )
+        # Final embedding = w + wt (standard GloVe practice).
+        self.syn0 = self.w + self.wt
+        return float(loss)
+
     def fit(self, sequences_factory) -> None:
         seqs = (
             sequences_factory()
@@ -116,31 +169,7 @@ class Glove(SequenceVectors):
         seqs = list(seqs)
         if self.vocab is None:
             self.vocab = build_vocab(seqs, self.min_word_frequency)
-        v, d = self.vocab.num_words(), self.layer_size
-        key = jax.random.key(self.seed)
-        k1, k2 = jax.random.split(key)
-        w = (jax.random.uniform(k1, (v, d)) - 0.5) / d
-        wt = (jax.random.uniform(k2, (v, d)) - 0.5) / d
-        b = jnp.zeros((v,))
-        bt = jnp.zeros((v,))
-        gw = jnp.zeros((v, d))
-        gwt = jnp.zeros((v, d))
-        gb = jnp.zeros((v,))
-        gbt = jnp.zeros((v,))
-
+        self.init_tables()
         rows, cols, xij = self._count_cooccurrences(seqs)
-        rng = np.random.default_rng(self.seed)
-        n = len(rows)
-        self.losses: List[float] = []
         for _ in range(self.epochs):
-            order = rng.permutation(n)
-            for start in range(0, n, self.batch_size):
-                sel = order[start : start + self.batch_size]
-                (w, wt, b, bt, gw, gwt, gb, gbt, loss) = self._glove_step(
-                    w, wt, b, bt, gw, gwt, gb, gbt,
-                    jnp.asarray(rows[sel]), jnp.asarray(cols[sel]),
-                    jnp.asarray(xij[sel]), self.learning_rate,
-                )
-            self.losses.append(float(loss))
-        # Final embedding = w + wt (standard GloVe practice).
-        self.syn0 = w + wt
+            self.losses.append(self.train_cooccurrences(rows, cols, xij))

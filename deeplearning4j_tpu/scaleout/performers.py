@@ -11,6 +11,7 @@ absorbs the aggregated params pushed back down.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -118,24 +119,24 @@ class Word2VecWorkPerformer(WorkerPerformer):
             self.apply_update(self.vec, value)
 
 
-class Word2VecJobAggregator(JobAggregator):
-    """Average worker lookup tables elementwise (reference nlp
-    Word2VecJobAggregator / INDArrayAggregator)."""
+class TableAveragingAggregator(JobAggregator):
+    """Average named arrays elementwise across worker results; drops
+    non-array keys (loss/pairs). Backs the Word2Vec/GloVe aggregators and
+    any performer that returns a dict of tables. Lock-guarded: worker
+    result callbacks accumulate concurrently (same contract as
+    ArrayAveragingAggregator)."""
 
-    def __init__(self) -> None:
-        import threading
-
+    def __init__(self, names) -> None:
+        self.names = tuple(names)
         self._sums: Dict[str, np.ndarray] = {}
         self._count = 0
-        # worker result callbacks accumulate concurrently (same contract
-        # as the lock-guarded ArrayAveragingAggregator)
         self._lock = threading.Lock()
 
     def accumulate(self, result: Any) -> None:
         if not isinstance(result, dict):
             return
         with self._lock:
-            for name in ("syn0", "syn1", "syn1neg"):
+            for name in self.names:
                 if name in result:
                     arr = np.asarray(result[name], np.float64)
                     if name in self._sums:
@@ -155,3 +156,62 @@ class Word2VecJobAggregator(JobAggregator):
         with self._lock:
             self._sums = {}
             self._count = 0
+
+
+class Word2VecJobAggregator(TableAveragingAggregator):
+    """Average worker lookup tables elementwise (reference nlp
+    Word2VecJobAggregator / INDArrayAggregator)."""
+
+    def __init__(self) -> None:
+        super().__init__(("syn0", "syn1", "syn1neg"))
+
+
+class GloveWorkPerformer(WorkerPerformer):
+    """Distributed GloVe over the runner (reference nlp
+    scaleout/perform/models/glove/GlovePerformer.java + GloveWork):
+    workers AdaGrad-factorize their co-occurrence shard on local tables;
+    the aggregator averages tables AND AdaGrad state (the
+    UpdaterAggregator rule applied to GloVe's accumulators).
+
+    job.work = {"rows": [...], "cols": [...], "xij": [...],
+    "learning_rate": f (optional)}.
+    """
+
+    def __init__(self, glove):
+        import copy
+
+        self.glove = copy.copy(glove)  # local tables; vocab/config shared
+        if hasattr(self.glove, "_glove_rng"):
+            delattr(self.glove, "_glove_rng")
+
+    @staticmethod
+    def apply_update(glove, aggregated: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+
+        for name in type(glove).TABLE_NAMES:
+            if name in aggregated:
+                setattr(glove, name, jnp.asarray(aggregated[name]))
+        if "w" in aggregated and "wt" in aggregated:
+            glove.syn0 = glove.w + glove.wt
+
+    def perform(self, job: Job) -> Dict[str, Any]:
+        work = job.work
+        loss = self.glove.train_cooccurrences(
+            work["rows"], work["cols"], work["xij"],
+            learning_rate=work.get("learning_rate"))
+        out = {name: np.asarray(getattr(self.glove, name))
+               for name in type(self.glove).TABLE_NAMES}
+        out["loss"] = loss
+        return out
+
+    def update(self, value: Any) -> None:
+        if isinstance(value, dict):
+            self.apply_update(self.glove, value)
+
+
+def glove_job_aggregator() -> TableAveragingAggregator:
+    """Aggregator for GloveWorkPerformer results (reference
+    GloveJobAggregator)."""
+    from deeplearning4j_tpu.nlp.glove import Glove
+
+    return TableAveragingAggregator(Glove.TABLE_NAMES)

@@ -119,3 +119,82 @@ class TestGruTorchParity:
         theirs, _ = gru(xt)
         theirs = np.transpose(theirs.detach().numpy(), (0, 2, 1))
         np.testing.assert_allclose(ours, theirs, rtol=2e-5, atol=2e-5)
+
+
+class TestDistributedGlove:
+    def _glove(self):
+        from deeplearning4j_tpu.nlp.glove import Glove
+        from deeplearning4j_tpu.nlp.vocab import build_vocab
+
+        g = Glove(layer_size=8, window=3, min_word_frequency=1,
+                  epochs=1, batch_size=512, seed=5)
+        g.vocab = build_vocab(SENTS, 1)
+        g.init_tables()
+        return g
+
+    def test_incremental_cooccurrence_training(self):
+        g = self._glove()
+        rows, cols, xij = g._count_cooccurrences(SENTS)
+        before = np.asarray(g.w).copy()
+        loss1 = g.train_cooccurrences(rows, cols, xij, learning_rate=0.05)
+        assert np.isfinite(loss1)
+        assert not np.allclose(before, np.asarray(g.w))
+        # repeated passes reduce the weighted least-squares loss
+        for _ in range(10):
+            loss = g.train_cooccurrences(rows, cols, xij,
+                                         learning_rate=0.05)
+        assert loss < loss1
+
+    def test_runner_performer_aggregator(self):
+        from deeplearning4j_tpu.scaleout.performers import (
+            GloveWorkPerformer,
+            glove_job_aggregator,
+        )
+
+        g = self._glove()
+        rows, cols, xij = g._count_cooccurrences(SENTS)
+        third = len(rows) // 3 or 1
+        jobs = ListJobIterator([
+            {"rows": rows[i * third:(i + 1) * third],
+             "cols": cols[i * third:(i + 1) * third],
+             "xij": xij[i * third:(i + 1) * third],
+             "learning_rate": 0.05}
+            for i in range(3)
+        ])
+        runner = DistributedRunner(
+            performer_factory=lambda: GloveWorkPerformer(g),
+            aggregator=glove_job_aggregator(),
+            num_workers=2,
+            routing=WorkRouting.ITERATIVE_REDUCE,
+        )
+        result = runner.run(jobs)
+        assert set(result) >= {"w", "wt", "b", "bt"}
+        before = np.asarray(g.w).copy()
+        GloveWorkPerformer.apply_update(g, result)
+        assert not np.allclose(before, np.asarray(g.w))
+        assert g.syn0.shape == (g.vocab.num_words(), 8)
+
+    def test_fit_still_trains_end_to_end(self):
+        from deeplearning4j_tpu.nlp.glove import Glove
+
+        g = Glove(layer_size=8, window=3, min_word_frequency=1,
+                  epochs=30, batch_size=512, seed=5)
+        g.fit(SENTS)
+        assert len(g.losses) == 30
+        assert g.losses[-1] < g.losses[0]
+        # shared-context words end up closer than cross-context ones
+        assert g.similarity("king", "queen") > g.similarity("king", "night")
+
+    def test_refit_is_seed_reproducible(self):
+        from deeplearning4j_tpu.nlp.glove import Glove
+
+        g = Glove(layer_size=8, window=3, min_word_frequency=1,
+                  epochs=4, batch_size=512, seed=5)
+        g.fit(SENTS)
+        first = np.asarray(g.syn0).copy()
+        g.fit(SENTS)
+        np.testing.assert_allclose(first, np.asarray(g.syn0), rtol=1e-6)
+
+    def test_empty_shard_returns_zero_loss(self):
+        g = self._glove()
+        assert g.train_cooccurrences([], [], []) == 0.0
