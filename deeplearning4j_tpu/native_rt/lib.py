@@ -64,6 +64,16 @@ class NativeLib:
         lib.dl4j_ring_close.argtypes = [ctypes.c_void_p]
         lib.dl4j_ring_destroy.argtypes = [ctypes.c_void_p]
         lib.dl4j_native_abi_version.restype = ctypes.c_int32
+        try:  # ABI v2+: skip-gram pair mining
+            lib.dl4j_mine_pairs.restype = ctypes.c_int64
+            lib.dl4j_mine_pairs.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int32))]
+            self.has_mine_pairs = True
+        except AttributeError:  # older prebuilt .so
+            self.has_mine_pairs = False
 
     @classmethod
     def load(cls) -> Optional["NativeLib"]:
@@ -83,15 +93,18 @@ class NativeLib:
 
     @staticmethod
     def _try_load() -> Optional[ctypes.CDLL]:
-        if not os.path.exists(_SO_PATH):
-            src = os.path.join(_NATIVE_DIR, "dl4j_native.cpp")
-            if not os.path.exists(src):
-                return None
+        src = os.path.join(_NATIVE_DIR, "dl4j_native.cpp")
+        if os.path.exists(src):
+            # Always invoke make: it is a no-op when the .so is newer
+            # than the source, and rebuilds a STALE prebuilt .so so new
+            # ABI entry points (e.g. dl4j_mine_pairs) actually load.
             try:
                 subprocess.run(["make", "-C", _NATIVE_DIR],
                                check=True, capture_output=True, timeout=120)
             except (OSError, subprocess.SubprocessError):
-                return None
+                pass  # fall through to whatever .so already exists
+        if not os.path.exists(_SO_PATH):
+            return None
         try:
             return ctypes.CDLL(_SO_PATH)
         except OSError:
@@ -232,6 +245,42 @@ def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
     if rc != 0:
         raise ValueError("label out of range for one_hot")
     return out.reshape(*labels64.shape, num_classes)
+
+
+def mine_pairs(flat: np.ndarray, seq_id: np.ndarray, window: int,
+               keep_prob: Optional[np.ndarray], seed: int
+               ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Skip-gram (center, context) pair mining in C++ (subsampling,
+    random window shrink, cross-sequence fencing, shuffle). Returns None
+    when the native library is unavailable — callers fall back to the
+    vectorized numpy miner."""
+    nl = NativeLib.load()
+    if nl is None or not getattr(nl, "has_mine_pairs", False):
+        return None
+    flat = np.ascontiguousarray(flat, np.int32)
+    seq_id = np.ascontiguousarray(seq_id, np.int32)
+    kp = (None if keep_prob is None
+          else np.ascontiguousarray(keep_prob, np.float32))
+    cen = ctypes.POINTER(ctypes.c_int32)()
+    ctx = ctypes.POINTER(ctypes.c_int32)()
+    n = nl.lib.dl4j_mine_pairs(
+        flat.ctypes.data_as(ctypes.c_void_p),
+        seq_id.ctypes.data_as(ctypes.c_void_p),
+        len(flat), int(window),
+        None if kp is None else kp.ctypes.data_as(ctypes.c_void_p),
+        int(seed) & (2 ** 64 - 1),
+        ctypes.byref(cen), ctypes.byref(ctx))
+    if n < 0:
+        return None
+    if n == 0:
+        nl.lib.dl4j_free(cen)  # malloc(0) chunks still need freeing
+        nl.lib.dl4j_free(ctx)
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    centers = np.ctypeslib.as_array(cen, (n,)).copy()
+    contexts = np.ctypeslib.as_array(ctx, (n,)).copy()
+    nl.lib.dl4j_free(cen)
+    nl.lib.dl4j_free(ctx)
+    return centers, contexts
 
 
 def shuffle_indices(n: int, seed: int) -> np.ndarray:

@@ -141,9 +141,29 @@ class SequenceVectors:
             return
         flat = np.concatenate(flat_parts)
         seq_id = np.concatenate(seq_parts)
+        # Native C++ fast path: subsample + window walk + shuffle in one
+        # call (native/dl4j_native.cpp dl4j_mine_pairs); numpy below is
+        # the portable fallback with identical semantics.
+        from deeplearning4j_tpu.native_rt.lib import (
+            mine_pairs as _native,
+            native_available,
+        )
+
+        kp_tok = keep_prob[flat]  # one O(corpus) gather, shared below
+        if native_available():
+            native = _native(
+                flat, seq_id, self.window,
+                kp_tok.astype(np.float32) if self.subsampling > 0 else None,
+                int(rng.integers(2 ** 63)))
+            if native is not None:
+                centers, contexts = native
+                if len(centers) == 0:
+                    return
+                yield from self._pad_and_batch(centers, contexts, rng)
+                return
         # Subsample frequent words (removal shortens the effective window
         # distance, as in word2vec).
-        keep = rng.random(len(flat)) < keep_prob[flat]
+        keep = rng.random(len(flat)) < kp_tok
         flat, seq_id = flat[keep], seq_id[keep]
         if len(flat) == 0:
             return
@@ -171,8 +191,11 @@ class SequenceVectors:
         # Shuffle so batches mix offsets/sequences (SGD quality).
         order = rng.permutation(len(centers))
         centers, contexts = centers[order], contexts[order]
-        # Pad the tail to a full batch by resampling existing pairs, so
-        # every jitted step sees one static shape (no tail recompiles).
+        yield from self._pad_and_batch(centers, contexts, rng)
+
+    def _pad_and_batch(self, centers, contexts, rng):
+        """Pad the tail to a full batch by resampling existing pairs, so
+        every jitted step sees one static shape (no tail recompiles)."""
         n = len(centers)
         rem = n % self.batch_size
         if rem and n > self.batch_size:

@@ -275,6 +275,91 @@ void dl4j_ring_destroy(void* ring) { delete (Ring*)ring; }
 // version / sanity
 // ---------------------------------------------------------------------
 
-int32_t dl4j_native_abi_version() { return 1; }
+// ---------------------------------------------------------------------
+// skip-gram pair mining — the words/sec host hot path (reference
+// InMemoryLookupTable.iterateSample's window walk, vectorized here)
+// ---------------------------------------------------------------------
+// flat: token vocab indices, seq_id: sequence id per token (pairs never
+// cross sequences), keep_prob: per-token subsampling keep probability.
+// Emits (center, context) pairs for both directions with the word2vec
+// per-center random window shrink b in [1, window], then Fisher-Yates
+// shuffles them. Outputs are malloc'd (free with dl4j_free); returns the
+// pair count, or -1 on allocation failure.
+int64_t dl4j_mine_pairs(const int32_t* flat, const int32_t* seq_id,
+                        int64_t n, int32_t window,
+                        const float* keep_prob, uint64_t seed,
+                        int32_t** centers_out, int32_t** contexts_out) try {
+  if (window <= 0 || n < 0) return -1;
+  uint64_t x = seed + 0x9E3779B97F4A7C15ULL;
+  auto next_u64 = [&x]() {
+    x += 0x9E3779B97F4A7C15ULL;
+    uint64_t z = x;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  };
+  auto next_unit = [&next_u64]() {
+    return double(next_u64() >> 11) * (1.0 / 9007199254740992.0);
+  };
+
+  // subsample survivors, assign per-center shrink b
+  std::vector<int32_t> kept;
+  std::vector<int32_t> kseq;
+  std::vector<int32_t> b;
+  kept.reserve(size_t(n));
+  kseq.reserve(size_t(n));
+  b.reserve(size_t(n));
+  for (int64_t i = 0; i < n; ++i) {
+    if (keep_prob == nullptr || next_unit() < double(keep_prob[i])) {
+      kept.push_back(flat[i]);
+      kseq.push_back(seq_id[i]);
+      b.push_back(1 + int32_t(next_u64() % uint64_t(window)));
+    }
+  }
+  std::vector<int32_t> cen;
+  std::vector<int32_t> ctx;
+  const int64_t m = int64_t(kept.size());
+  for (int64_t i = 0; i < m; ++i) {
+    for (int32_t d = 1; d <= window; ++d) {
+      int64_t j = i + d;
+      if (j >= m || kseq[size_t(j)] != kseq[size_t(i)]) break;
+      if (d <= b[size_t(i)]) {  // (center=i, context=j)
+        cen.push_back(kept[size_t(i)]);
+        ctx.push_back(kept[size_t(j)]);
+      }
+      if (d <= b[size_t(j)]) {  // mirror
+        cen.push_back(kept[size_t(j)]);
+        ctx.push_back(kept[size_t(i)]);
+      }
+    }
+  }
+  const int64_t total = int64_t(cen.size());
+  // Fisher-Yates over both arrays with one permutation
+  for (int64_t i = total - 1; i > 0; --i) {
+    int64_t j = int64_t(next_u64() % uint64_t(i + 1));
+    std::swap(cen[size_t(i)], cen[size_t(j)]);
+    std::swap(ctx[size_t(i)], ctx[size_t(j)]);
+  }
+  int32_t* c_out = (int32_t*)std::malloc(size_t(total) * sizeof(int32_t));
+  int32_t* x_out = (int32_t*)std::malloc(size_t(total) * sizeof(int32_t));
+  if ((total > 0 && (!c_out || !x_out))) {
+    std::free(c_out);
+    std::free(x_out);
+    return -1;
+  }
+  if (total > 0) {
+    std::memcpy(c_out, cen.data(), size_t(total) * sizeof(int32_t));
+    std::memcpy(x_out, ctx.data(), size_t(total) * sizeof(int32_t));
+  }
+  *centers_out = c_out;
+  *contexts_out = x_out;
+  return total;
+} catch (const std::exception&) {
+  // bad_alloc etc. must not unwind across the C ABI; callers fall back
+  // to the numpy miner on -1.
+  return -1;
+}
+
+int32_t dl4j_native_abi_version() { return 2; }
 
 }  // extern "C"
