@@ -225,3 +225,16 @@ class MultiDataSet:
             f"MultiDataSet(features={[f.shape for f in self.features]}, "
             f"labels={[y.shape for y in self.labels]})"
         )
+
+
+def to_multi_data_set(ds: "DataSet") -> "MultiDataSet":
+    """DataSet -> single-input/single-output MultiDataSet (reference
+    ComputationGraphUtil.toMultiDataSet / spark DataSetToMultiDataSetFn)."""
+    return MultiDataSet(
+        features=[ds.features],
+        labels=[ds.labels] if ds.labels is not None else [],
+        features_masks=(
+            [ds.features_mask] if ds.features_mask is not None else None),
+        labels_masks=(
+            [ds.labels_mask] if ds.labels_mask is not None else None),
+    )

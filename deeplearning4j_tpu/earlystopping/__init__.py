@@ -11,7 +11,9 @@ from deeplearning4j_tpu.earlystopping.config import (
     EarlyStoppingResult,
 )
 from deeplearning4j_tpu.earlystopping.trainer import (
+    EarlyStoppingGraphTrainer,
     EarlyStoppingTrainer,
+    IEarlyStoppingTrainer,
     ParallelEarlyStoppingTrainer,
 )
 from deeplearning4j_tpu.earlystopping.listener import (

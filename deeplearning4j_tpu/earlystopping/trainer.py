@@ -172,3 +172,11 @@ class ParallelEarlyStoppingTrainer(EarlyStoppingTrainer):
         if not self._has_fit:
             return float(self.net.score_value)
         return self._last_fit_score
+
+
+# Reference-name aliases: the Java API needs a separate graph trainer
+# (EarlyStoppingGraphTrainer.java) and trainer interface
+# (IEarlyStoppingTrainer.java) only because of typing; here one trainer
+# serves both model kinds.
+IEarlyStoppingTrainer = EarlyStoppingTrainer
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

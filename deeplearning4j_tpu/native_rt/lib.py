@@ -1,9 +1,11 @@
 """ctypes bindings for libdl4j_native.so with numpy fallbacks.
 
-Loading: first try the prebuilt .so next to native/dl4j_native.cpp; if
-missing and a toolchain exists, build it once with make (a few hundred
-ms); else run on the numpy fallbacks. No pip/pybind11 involved (neither
-is available in the image) — plain C ABI via ctypes.
+Loading: when the C++ source and a toolchain exist, ``make`` runs under a
+file lock on every first load (a no-op when the .so is newer than the
+source; a rebuild when a prebuilt .so predates new ABI entry points),
+then the .so is dlopened; without source/toolchain any existing .so is
+used as-is, else the numpy fallbacks run. No pip/pybind11 involved
+(neither is available in the image) — plain C ABI via ctypes.
 """
 
 from __future__ import annotations
@@ -95,13 +97,24 @@ class NativeLib:
     def _try_load() -> Optional[ctypes.CDLL]:
         src = os.path.join(_NATIVE_DIR, "dl4j_native.cpp")
         if os.path.exists(src):
-            # Always invoke make: it is a no-op when the .so is newer
-            # than the source, and rebuilds a STALE prebuilt .so so new
-            # ABI entry points (e.g. dl4j_mine_pairs) actually load.
+            # Invoke make on first load: a no-op when the .so is newer
+            # than the source, a rebuild when a stale prebuilt .so lacks
+            # new ABI entry points (e.g. dl4j_mine_pairs). The build runs
+            # under an exclusive file lock so concurrent worker processes
+            # never dlopen a half-written .so or interleave compiles.
             try:
-                subprocess.run(["make", "-C", _NATIVE_DIR],
-                               check=True, capture_output=True, timeout=120)
-            except (OSError, subprocess.SubprocessError):
+                import fcntl
+
+                lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+                with open(lock_path, "w") as lock_f:
+                    fcntl.flock(lock_f, fcntl.LOCK_EX)
+                    try:
+                        subprocess.run(
+                            ["make", "-C", _NATIVE_DIR],
+                            check=True, capture_output=True, timeout=120)
+                    finally:
+                        fcntl.flock(lock_f, fcntl.LOCK_UN)
+            except (OSError, subprocess.SubprocessError, ImportError):
                 pass  # fall through to whatever .so already exists
         if not os.path.exists(_SO_PATH):
             return None

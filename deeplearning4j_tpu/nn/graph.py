@@ -244,31 +244,91 @@ class ComputationGraph:
         return reg
 
     # ------------------------------------------------------------------
+    def _step_body(self, params, state, upd_state, iteration, rng, inputs,
+                   labels, masks, label_masks):
+        (score, new_state), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True
+        )(params, state, rng, inputs, labels, masks, label_masks)
+        new_params = {}
+        new_upd = {}
+        for name, v in self._layer_vertices.items():
+            c = v.conf
+            g = normalize_gradients(
+                c.resolved("gradient_normalization"),
+                grads[name],
+                float(c.resolved("gradient_normalization_threshold")),
+            )
+            updates, new_upd[name] = self._updaters[name].update(
+                g, upd_state[name], resolve_lr(c, iteration), iteration
+            )
+            new_params[name] = jax.tree.map(
+                lambda p, u: p - u, params[name], updates
+            )
+        return new_params, new_state, new_upd, score
+
     @functools.cached_property
     def _train_step(self):
-        def step(params, state, upd_state, iteration, rng, inputs, labels,
-                 masks, label_masks):
-            (score, new_state), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True
-            )(params, state, rng, inputs, labels, masks, label_masks)
-            new_params = {}
-            new_upd = {}
-            for name, v in self._layer_vertices.items():
-                c = v.conf
-                g = normalize_gradients(
-                    c.resolved("gradient_normalization"),
-                    grads[name],
-                    float(c.resolved("gradient_normalization_threshold")),
-                )
-                updates, new_upd[name] = self._updaters[name].update(
-                    g, upd_state[name], resolve_lr(c, iteration), iteration
-                )
-                new_params[name] = jax.tree.map(
-                    lambda p, u: p - u, params[name], updates
-                )
-            return new_params, new_state, new_upd, score
+        return jax.jit(self._step_body, donate_argnums=(0, 1, 2))
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+    @functools.cached_property
+    def _train_steps_scan(self):
+        """K graph train steps fused into one lax.scan computation (the
+        ComputationGraph counterpart of MultiLayerNetwork.fit_scan)."""
+
+        def steps(params, state, upd_state, iteration, rng, inputs_k,
+                  labels_k):
+            def body(carry, inp):
+                p, s, u, it, key = carry
+                key, sub = jax.random.split(key)
+                xs, ys = inp
+                p, s, u, score = self._step_body(
+                    p, s, u, it, sub, xs, ys, None, None)
+                return (p, s, u, it + 1, key), score
+
+            (p, s, u, it, _), scores = jax.lax.scan(
+                body, (params, state, upd_state, iteration, rng),
+                (inputs_k, labels_k))
+            return p, s, u, scores
+
+        return jax.jit(steps, donate_argnums=(0, 1, 2))
+
+    def fit_scan(self, inputs_stacked, labels_stacked):
+        """Run K fused steps over pre-stacked batches. ``inputs_stacked``:
+        dict input-name -> [K, B, ...] (or a single array for
+        single-input graphs); ``labels_stacked``: list of [K, B, ...]
+        per output (or a single array). Unmasked plain-SGD fast path;
+        returns the K per-step scores lazily (device array)."""
+        self.init()
+        if not isinstance(inputs_stacked, dict):
+            inputs_stacked = {
+                self.conf.network_inputs[0]: inputs_stacked}
+        if not isinstance(labels_stacked, (list, tuple)):
+            labels_stacked = [labels_stacked]
+        if set(inputs_stacked) != set(self.conf.network_inputs):
+            raise ValueError(
+                f"fit_scan got inputs {sorted(inputs_stacked)} but graph "
+                f"has inputs {sorted(self.conf.network_inputs)}")
+        if len(labels_stacked) != len(self.conf.network_outputs):
+            raise ValueError(
+                f"fit_scan got {len(labels_stacked)} label arrays but "
+                f"graph has {len(self.conf.network_outputs)} outputs")
+        inputs_k = {k: jnp.asarray(v, self._dtype)
+                    for k, v in inputs_stacked.items()}
+        labels_k = [jnp.asarray(y, self._dtype) for y in labels_stacked]
+        self._key, sub = jax.random.split(self._key)
+        start = self.iteration
+        self.params, self.state, self.updater_state, scores = (
+            self._train_steps_scan(
+                self.params, self.state, self.updater_state,
+                self.iteration, sub, inputs_k, labels_k))
+        k = int(next(iter(inputs_k.values())).shape[0])
+        self.iteration += k
+        self.score_value = scores[-1]
+        for listener in self.listeners:
+            n = max(1, listener.invoked_every)
+            if self.iteration // n > start // n:
+                listener.iteration_done(self, self.iteration)
+        return scores
 
     @functools.cached_property
     def _output_fn(self):

@@ -94,3 +94,19 @@ class LambdaIterationListener(IterationListener):
 
     def iteration_done(self, model, iteration: int) -> None:
         self._fn(model, iteration)
+
+
+class BestScoreIterationListener(IterationListener):
+    """Track the best (lowest) score seen (reference Spark
+    BestScoreAccumulator / BestScoreIterationListener roles)."""
+
+    def __init__(self, frequency: int = 1):
+        self.invoked_every = max(1, frequency)
+        self.best_score = float("inf")
+        self.best_iteration = -1
+
+    def iteration_done(self, model, iteration: int) -> None:
+        score = float(model.score_value)
+        if score < self.best_score:
+            self.best_score = score
+            self.best_iteration = iteration

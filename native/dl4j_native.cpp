@@ -193,16 +193,21 @@ int32_t dl4j_one_hot(const uint8_t* labels, int64_t n, int32_t num_classes,
 // Fisher-Yates permutation of [0, n) with SplitMix64 — deterministic
 // per seed (the shuffling batcher the reference gets from DataSet
 // .shuffle / SamplingDataSetIterator).
+// splitmix64 step — the one PRNG shared by shuffle_indices (whose Python
+// fallback matches it bit-for-bit) and mine_pairs.
+static inline uint64_t dl4j_splitmix_next(uint64_t* x) {
+  *x += 0x9E3779B97F4A7C15ULL;
+  uint64_t z = *x;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
 void dl4j_shuffle_indices(int64_t n, uint64_t seed, int64_t* out) {
   for (int64_t i = 0; i < n; ++i) out[i] = i;
   uint64_t x = seed + 0x9E3779B97F4A7C15ULL;
   for (int64_t i = n - 1; i > 0; --i) {
-    // splitmix64 step
-    x += 0x9E3779B97F4A7C15ULL;
-    uint64_t z = x;
-    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
-    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
-    z = z ^ (z >> 31);
+    uint64_t z = dl4j_splitmix_next(&x);
     int64_t j = int64_t(z % uint64_t(i + 1));
     int64_t t = out[i];
     out[i] = out[j];
@@ -291,13 +296,7 @@ int64_t dl4j_mine_pairs(const int32_t* flat, const int32_t* seq_id,
                         int32_t** centers_out, int32_t** contexts_out) try {
   if (window <= 0 || n < 0) return -1;
   uint64_t x = seed + 0x9E3779B97F4A7C15ULL;
-  auto next_u64 = [&x]() {
-    x += 0x9E3779B97F4A7C15ULL;
-    uint64_t z = x;
-    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
-    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
-    return z ^ (z >> 31);
-  };
+  auto next_u64 = [&x]() { return dl4j_splitmix_next(&x); };
   auto next_unit = [&next_u64]() {
     return double(next_u64() >> 11) * (1.0 / 9007199254740992.0);
   };

@@ -112,3 +112,63 @@ class TestIteratorFactories:
         conf = {DataSetIteratorFactory.KEY: "builtins.dict"}
         with pytest.raises(TypeError):
             DataSetIteratorFactory.from_conf(conf)
+
+
+class TestGraphFitScan:
+    def test_graph_scanned_steps(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.optimize.listeners import (
+            BestScoreIterationListener,
+        )
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(6).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("h", L.DenseLayer(n_in=8, n_out=16,
+                                         activation="tanh"), "in")
+            .add_layer("out", L.OutputLayer(
+                n_in=16, n_out=3, activation="softmax",
+                loss_function=LossFunction.MCXENT), "h")
+            .set_outputs("out")
+            .build()
+        )
+        graph = ComputationGraph(conf).init()
+        best = BestScoreIterationListener()
+        graph.listeners = [best]
+        feats, labels, x, cls = _stacked(k=4, batch=32)
+        first = None
+        for _ in range(30):
+            scores = graph.fit_scan(feats, labels)
+            if first is None:
+                first = float(np.asarray(scores[0]))
+        arr = np.asarray(scores)
+        assert arr.shape == (4,)
+        assert graph.iteration == 120
+        assert arr[-1] < first  # loss went down across the run
+        pred = np.asarray(graph.output(x)[0]).argmax(1)
+        assert (pred == cls).mean() > 0.8
+        assert np.isfinite(best.best_score)
+        assert best.best_iteration > 0
+
+    def test_rejects_wrong_label_count(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("o1", L.OutputLayer(
+                n_in=8, n_out=2, activation="softmax",
+                loss_function=LossFunction.MCXENT), "in")
+            .add_layer("o2", L.OutputLayer(
+                n_in=8, n_out=2, activation="softmax",
+                loss_function=LossFunction.MCXENT), "in")
+            .set_outputs("o1", "o2")
+            .build()
+        )
+        graph = ComputationGraph(conf).init()
+        feats = np.zeros((2, 4, 8), np.float32)
+        one_label = np.zeros((2, 4, 2), np.float32)
+        with pytest.raises(ValueError, match="label arrays"):
+            graph.fit_scan(feats, one_label)
