@@ -34,8 +34,10 @@ class MultiHeadSelfAttention(BaseRecurrentLayer):
     n_heads: int = 4
     causal: bool = True
     ring_axis: Optional[str] = None  # sequence-parallel mesh axis
-    # pallas flash-attention fast path: True/False force, None = auto
-    # (TPU backend, no mask, T multiple of 128 and >= 256)
+    # pallas flash-attention path: True forces it (TPU, no mask, T
+    # multiple of 128 and >= 256), False forces dense, None = auto —
+    # engages only at T >= 4096 where flash is speed-neutral and the
+    # O(T²) dense score materialization starts to matter
     use_flash: Optional[bool] = None
 
 
@@ -113,7 +115,15 @@ def _should_use_flash(use_flash, q, mask) -> bool:
             "use_flash=True requires the TPU backend, no mask, a "
             "sequence length >= 256 divisible by 128, and head dim "
             "<= 128 or divisible by 128")
-    return kernel_ok if use_flash is None else bool(use_flash)
+    if use_flash is None:
+        # Auto mode: flash is the LONG-context enabler — it removes the
+        # O(T²) score materialization that stops dense attention at
+        # ~16k+ tokens — but measured on-chip it only reaches speed
+        # parity around T=4096 and is much slower below (XLA's fused
+        # dense path wins at short T). Auto-enable where it's at least
+        # neutral on speed and strictly better on memory.
+        return kernel_ok and t >= 4096
+    return bool(use_flash)
 
 
 def _flash_attention(q, k, v, causal):
