@@ -1,0 +1,35 @@
+"""Long-context causal transformer: remat + (auto) flash attention.
+
+Run: python examples/long_context_transformer.py
+On TPU, T >= 4096 engages the pallas flash-attention kernel; remat trades
+recompute for activation memory so depth x T stays within HBM.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def main():
+    net = MultiLayerNetwork(transformer_lm(
+        n_in=64, width=256, n_layers=4, n_heads=8, n_classes=64,
+        remat=True)).init()
+    B, T = 2, 4096
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, 64, T)).astype(np.float32)
+    y = np.zeros((B, 64, T), np.float32)
+    y[np.arange(B)[:, None], rng.integers(0, 64, (B, T)),
+      np.arange(T)[None, :]] = 1.0
+    for step in range(5):
+        net.fit(x, y)
+        print(f"step {step}: loss {float(net.score_value):.4f}")
+
+
+if __name__ == "__main__":
+    main()

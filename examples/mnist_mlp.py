@@ -1,0 +1,52 @@
+"""Train the BASELINE row-1 MLP on MNIST and evaluate.
+
+Run: python examples/mnist_mlp.py
+(The MNIST loader falls back to a deterministic synthetic set offline.)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+
+def main():
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(123)
+        .learning_rate(0.1)
+        .updater(Updater.NESTEROVS).momentum(0.9)
+        .compute_dtype("bfloat16")  # MXU mixed precision, f32 master params
+        .list()
+        .layer(0, L.DenseLayer(n_in=784, n_out=500, activation="relu"))
+        .layer(1, L.OutputLayer(n_in=500, n_out=10, activation="softmax",
+                                loss_function=LossFunction.MCXENT))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(ScoreIterationListener(50))
+
+    train = MnistDataSetIterator(128, train=True, num_examples=8192)
+    test = MnistDataSetIterator(256, train=False, num_examples=2048)
+
+    for epoch in range(3):
+        train.reset()
+        net.fit(train)
+        print(f"epoch {epoch}: score {float(net.score_value):.4f}")
+
+    evaluation: Evaluation = net.evaluate(test)
+    print(evaluation.stats())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Word2Vec skip-gram on a text file (or a built-in toy corpus).
+
+Run: python examples/word2vec_similarity.py [corpus.txt]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import random
+
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator,
+    LineSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+# two topic clusters; deterministic sampling keeps the demo reproducible
+_rng = random.Random(7)
+_ROYAL = ["king", "queen", "crown", "castle", "rules", "throne"]
+_PETS = ["dog", "cat", "barks", "sleeps", "yard", "bone"]
+TOY = [
+    " ".join(_rng.choice(pool) for _ in range(6))
+    for pool in (_rng.choice([_ROYAL, _PETS]) for _ in range(600))
+]
+
+
+def main():
+    if len(sys.argv) > 1:
+        sentences = LineSentenceIterator(sys.argv[1])
+    else:
+        sentences = CollectionSentenceIterator(TOY)
+    vec = (
+        Word2Vec.Builder()
+        .iterate(sentences)
+        .tokenizer_factory(DefaultTokenizerFactory())
+        .layer_size(32)
+        .window_size(4)
+        .min_word_frequency(2)
+        .sampling(0.0)
+        .epochs(4)
+        .seed(42)
+        .build()
+    )
+    vec.fit()
+    for a, b in [("king", "queen"), ("king", "dog")]:
+        print(f"similarity({a}, {b}) = {vec.similarity(a, b):.3f}")
+    print("nearest(king):", vec.words_nearest("king", 5))
+
+
+if __name__ == "__main__":
+    main()
