@@ -149,3 +149,79 @@ class TestTorchParity:
         t = F.max_pool2d(torch.relu(F.conv2d(
             t, torch.from_numpy(w2), torch.from_numpy(b2))), 2, 2)
         np.testing.assert_allclose(ours, t.numpy(), rtol=1e-4, atol=1e-4)
+
+
+class TestLstmGoldenNumerics:
+    """GravesLSTM scan vs an independent numpy loop implementing the
+    documented peephole formulation (reference LSTMHelpers.java:147-189:
+    i/f gates peek at c_prev, o peeks at the NEW cell state)."""
+
+    def test_scan_matches_numpy_loop(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        n_in, n_out, t, b = 4, 6, 7, 3
+        rng = np.random.default_rng(1)
+        W = rng.normal(size=(n_in, 4 * n_out)).astype(np.float32) * 0.3
+        RW = rng.normal(size=(n_out, 4 * n_out + 3)).astype(
+            np.float32) * 0.3
+        bias = rng.normal(size=(4 * n_out,)).astype(np.float32) * 0.1
+        x = rng.normal(size=(b, n_in, t)).astype(np.float32)
+
+        conf = (NeuralNetConfiguration.Builder().seed(0).list()
+                .layer(0, L.GravesLSTM(n_in=n_in, n_out=n_out,
+                                       activation="tanh"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.params["0"] = {"W": W, "RW": RW, "b": bias}
+        ours = np.asarray(net.output(x))  # [B, n_out, T]
+
+        def sigmoid(z):
+            return 1.0 / (1.0 + np.exp(-z))
+
+        rw_g, peep = RW[:, :4 * n_out], RW[:, 4 * n_out:]
+        h = np.zeros((b, n_out), np.float64)
+        c = np.zeros((b, n_out), np.float64)
+        outs = []
+        for step in range(t):
+            xt = x[:, :, step].astype(np.float64)
+            z = xt @ W + h @ rw_g + bias
+            zi, zf, zo, zg = (z[:, :n_out], z[:, n_out:2 * n_out],
+                              z[:, 2 * n_out:3 * n_out], z[:, 3 * n_out:])
+            i = sigmoid(zi + c * peep[:, 0])
+            f = sigmoid(zf + c * peep[:, 1])
+            g = np.tanh(zg)
+            c = f * c + i * g
+            o = sigmoid(zo + c * peep[:, 2])
+            h = o * np.tanh(c)
+            outs.append(h)
+        theirs = np.stack(outs, axis=-1)  # [B, n_out, T]
+        np.testing.assert_allclose(ours, theirs, rtol=2e-5, atol=2e-5)
+
+    def test_masked_steps_freeze_state(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        rng = np.random.default_rng(2)
+        conf = (NeuralNetConfiguration.Builder().seed(0).list()
+                .layer(0, L.GravesLSTM(n_in=3, n_out=5, activation="tanh"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(2, 3, 6)).astype(np.float32)
+        # mask out the last 2 steps of example 0
+        fm = np.ones((2, 6), np.float32)
+        fm[0, 4:] = 0.0
+        x[0, :, 4:] = 99.0  # garbage in the masked steps
+        out = np.asarray(net._forward_fn(
+            net.params, net.state, np.asarray(x), None, False,
+            np.asarray(fm))[0])
+        # frozen state: masked-step LSTM outputs repeat the last visible
+        # step's hidden state instead of consuming the garbage input
+        np.testing.assert_allclose(out[0, :, 4], out[0, :, 3],
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(out[0, :, 5], out[0, :, 3],
+                                   rtol=1e-6, atol=1e-6)
+        # the unmasked example is unaffected and its steps keep evolving
+        assert not np.allclose(out[1, :, 4], out[1, :, 3])
