@@ -57,7 +57,8 @@ class TestOptimizersConvergence:
     def test_solver_dispatches_on_conf_algo(self):
         for algo in (OptimizationAlgorithm.CONJUGATE_GRADIENT,
                      OptimizationAlgorithm.LBFGS,
-                     OptimizationAlgorithm.LINE_GRADIENT_DESCENT):
+                     OptimizationAlgorithm.LINE_GRADIENT_DESCENT,
+                     OptimizationAlgorithm.HESSIAN_FREE):
             net, ds = _problem()
             net.conf.confs[0].optimization_algo = algo
             before = net.score(ds)
@@ -74,7 +75,9 @@ class TestOptimizersConvergence:
         net_s, _ = _problem(seed=3)
         for _ in range(5):
             net_s.fit(ds)
-        sgd_after = float(net_s.score_value)
+        # evaluate the FINAL params (score_value is the pre-update loss
+        # of the last step, which would make this 5-vs-4)
+        sgd_after = net_s.score(ds)
         assert lbfgs_after <= sgd_after * 1.05
 
 
