@@ -229,7 +229,7 @@ class MultiLayerNetwork:
     # The jitted train step (whole §3.1 stack as one XLA computation)
     # ------------------------------------------------------------------
     def _step_body(self, params, state, upd_state, iteration, rng, features,
-                   labels, feature_mask, label_mask):
+                   labels, feature_mask, label_mask, grad_scale=1.0):
         (score, new_state), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True
         )(params, state, rng, features, labels, feature_mask, label_mask)
@@ -242,6 +242,13 @@ class MultiLayerNetwork:
                 grads[si],
                 float(c.resolved("gradient_normalization_threshold")),
             )
+            # grad_scale=1.0 normally; dp-size under ACCUM_GRADIENT-
+            # without-divide (reference DIVIDE_ACCUM_GRADIENT=false: sum
+            # of per-worker gradients = mean times worker count). Applied
+            # AFTER normalization — the reference normalizes each
+            # worker's gradient before accumulating, so the sum of n
+            # normalized gradients is n times the normalized gradient.
+            g = jax.tree.map(lambda a: a * grad_scale, g)
             lr = resolve_lr(c, iteration)
             updates, new_upd[si] = upd.update(
                 g, upd_state[si], lr, iteration
@@ -262,13 +269,14 @@ class MultiLayerNetwork:
         dispatch-latency killer for small models: per-step launches over
         PCIe/tunnel otherwise dominate sub-millisecond step times."""
 
-        def steps(params, state, upd_state, iteration, rng, feats, labels):
+        def steps(params, state, upd_state, iteration, rng, feats, labels,
+                  grad_scale=1.0):
             def body(carry, inp):
                 p, s, u, it, key = carry
                 key, sub = jax.random.split(key)
                 f, y = inp
                 p, s, u, score = self._step_body(
-                    p, s, u, it, sub, f, y, None, None)
+                    p, s, u, it, sub, f, y, None, None, grad_scale)
                 return (p, s, u, it + 1, key), score
 
             (p, s, u, it, _), scores = jax.lax.scan(
@@ -278,7 +286,8 @@ class MultiLayerNetwork:
 
         return jax.jit(steps, donate_argnums=(0, 1, 2))
 
-    def fit_scan(self, features_stacked, labels_stacked):
+    def fit_scan(self, features_stacked, labels_stacked,
+                 grad_scale: float = 1.0):
         """Run one scanned pass over pre-stacked batches
         ([K, B, ...], [K, B, n_out]); returns the K per-step scores as a
         device array (convert with np.asarray to force a sync — kept lazy
@@ -301,7 +310,7 @@ class MultiLayerNetwork:
         self.params, self.state, self.updater_state, scores = (
             self._train_steps_scan(
                 self.params, self.state, self.updater_state,
-                self.iteration, sub, feats, labels))
+                self.iteration, sub, feats, labels, grad_scale))
         self.iteration += int(feats.shape[0])
         self.score_value = scores[-1]  # lazy device scalar, like _fit_batch
         for listener in self.listeners:

@@ -80,6 +80,8 @@ class ParallelTrainer:
         tp_axis: Optional[str] = None,
         average_each_iteration: bool = True,
         local_steps: int = 1,
+        accumulate_gradients: bool = False,
+        divide_gradient: bool = True,
     ):
         net.init()
         self.net = net
@@ -88,6 +90,17 @@ class ParallelTrainer:
         self.tp_axis = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
         self.average_each_iteration = average_each_iteration
         self.local_steps = max(1, local_steps)
+        # Reference engine flags org.deeplearning4j.spark.iteration.
+        # {accumgrad,dividegrad} (SparkDl4jMultiLayer.java:80-81): with
+        # accumulate_gradients the applied update is the per-worker
+        # gradient SUM (divide_gradient=False) or mean (=True; identical
+        # to the sharded-batch gradient this trainer already computes).
+        self.accumulate_gradients = accumulate_gradients
+        self.divide_gradient = divide_gradient
+        if accumulate_gradients and not average_each_iteration:
+            raise ValueError(
+                "accumulate_gradients applies to the per-step synchronous "
+                "mode; K-local-steps mode averages parameters instead")
         if not average_each_iteration and net.state:
             raise ValueError(
                 "K-local-steps-then-average mode does not support layers "
@@ -134,6 +147,12 @@ class ParallelTrainer:
             NamedSharding(self.mesh, P(self.dp_axis)),
         )
 
+    def _grad_scale(self) -> float:
+        """dp-size under ACCUM_GRADIENT-without-divide, else 1."""
+        if self.accumulate_gradients and not self.divide_gradient:
+            return float(self.mesh.shape[self.dp_axis])
+        return 1.0
+
     def _shard_stacked(self, arr):
         """[K, B, ...] pre-stacked batches: shard B over dp, K stays on
         every device (it is the scan axis)."""
@@ -158,7 +177,8 @@ class ParallelTrainer:
         # listener cadence apply identically here.
         return self.net.fit_scan(
             self._shard_stacked(features_stacked),
-            self._shard_stacked(labels_stacked))
+            self._shard_stacked(labels_stacked),
+            grad_scale=self._grad_scale())
 
     # ------------------------------------------------------------------
     def fit(self, data, labels=None) -> float:
@@ -188,7 +208,7 @@ class ParallelTrainer:
         net._key, sub = jax.random.split(net._key)
         net.params, net.state, net.updater_state, score = net._train_step(
             net.params, net.state, net.updater_state,
-            net.iteration, sub, feats, labels, fm, lm,
+            net.iteration, sub, feats, labels, fm, lm, self._grad_scale(),
         )
         net.score_value = score
         net.iteration += 1

@@ -172,3 +172,42 @@ class TestGraphFitScan:
         one_label = np.zeros((2, 4, 2), np.float32)
         with pytest.raises(ValueError, match="label arrays"):
             graph.fit_scan(feats, one_label)
+
+
+class TestAccumulateGradients:
+    def _data(self):
+        rng = np.random.default_rng(2)
+        cls = rng.integers(0, 3, 64)
+        x = rng.normal(loc=cls[:, None], size=(64, 8)).astype(np.float32)
+        return DataSet(x, np.eye(3, dtype=np.float32)[cls])
+
+    def test_accum_with_divide_equals_sync_mean(self):
+        mesh = make_mesh(MeshSpec({"dp": len(jax.devices())}))
+        ds = self._data()
+        t1 = ParallelTrainer(_net(), mesh=mesh)
+        t2 = ParallelTrainer(_net(), mesh=mesh,
+                             accumulate_gradients=True,
+                             divide_gradient=True)
+        t1.fit(ds)
+        t2.fit(ds)
+        np.testing.assert_allclose(
+            np.asarray(t1.net.params_flat()),
+            np.asarray(t2.net.params_flat()), rtol=1e-6)
+
+    def test_accum_without_divide_takes_bigger_steps(self):
+        mesh = make_mesh(MeshSpec({"dp": len(jax.devices())}))
+        n = mesh.shape["dp"]
+        if n == 1:
+            pytest.skip("needs >1 device to distinguish sum from mean")
+        ds = self._data()
+        mean_t = ParallelTrainer(_net(), mesh=mesh)
+        sum_t = ParallelTrainer(_net(), mesh=mesh,
+                                accumulate_gradients=True,
+                                divide_gradient=False)
+        p0 = np.asarray(mean_t.net.params_flat()).copy()
+        mean_t.fit(ds)
+        sum_t.fit(ds)
+        d_mean = np.asarray(mean_t.net.params_flat()) - p0
+        d_sum = np.asarray(sum_t.net.params_flat()) - p0
+        # summed gradients move n times as far on the first (SGD) step
+        np.testing.assert_allclose(d_sum, n * d_mean, rtol=1e-4, atol=1e-6)
