@@ -245,7 +245,7 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
     def _step_body(self, params, state, upd_state, iteration, rng, inputs,
-                   labels, masks, label_masks):
+                   labels, masks, label_masks, grad_scale=1.0):
         (score, new_state), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True
         )(params, state, rng, inputs, labels, masks, label_masks)
@@ -258,6 +258,8 @@ class ComputationGraph:
                 grads[name],
                 float(c.resolved("gradient_normalization_threshold")),
             )
+            # see MultiLayerNetwork._step_body: ACCUM-without-divide scale
+            g = jax.tree.map(lambda a: a * grad_scale, g)
             updates, new_upd[name] = self._updaters[name].update(
                 g, upd_state[name], resolve_lr(c, iteration), iteration
             )
@@ -276,13 +278,13 @@ class ComputationGraph:
         ComputationGraph counterpart of MultiLayerNetwork.fit_scan)."""
 
         def steps(params, state, upd_state, iteration, rng, inputs_k,
-                  labels_k):
+                  labels_k, grad_scale=1.0):
             def body(carry, inp):
                 p, s, u, it, key = carry
                 key, sub = jax.random.split(key)
                 xs, ys = inp
                 p, s, u, score = self._step_body(
-                    p, s, u, it, sub, xs, ys, None, None)
+                    p, s, u, it, sub, xs, ys, None, None, grad_scale)
                 return (p, s, u, it + 1, key), score
 
             (p, s, u, it, _), scores = jax.lax.scan(
@@ -292,7 +294,8 @@ class ComputationGraph:
 
         return jax.jit(steps, donate_argnums=(0, 1, 2))
 
-    def fit_scan(self, inputs_stacked, labels_stacked):
+    def fit_scan(self, inputs_stacked, labels_stacked,
+                 grad_scale: float = 1.0):
         """Run K fused steps over pre-stacked batches. ``inputs_stacked``:
         dict input-name -> [K, B, ...] (or a single array for
         single-input graphs); ``labels_stacked``: list of [K, B, ...]
@@ -320,7 +323,7 @@ class ComputationGraph:
         self.params, self.state, self.updater_state, scores = (
             self._train_steps_scan(
                 self.params, self.state, self.updater_state,
-                self.iteration, sub, inputs_k, labels_k))
+                self.iteration, sub, inputs_k, labels_k, grad_scale))
         k = int(next(iter(inputs_k.values())).shape[0])
         self.iteration += k
         self.score_value = scores[-1]
@@ -506,6 +509,21 @@ class ComputationGraph:
 
     def num_params(self) -> int:
         return int(self.params_flat().shape[0])
+
+    def clone(self) -> "ComputationGraph":
+        """Deep-copy (buffers AND conf: the train step donates
+        params/state, so aliased references would be deleted by the
+        donor's next step; conf isolation matches
+        MultiLayerNetwork.clone). Skips init() — its random params would
+        be immediately overwritten."""
+        copy = functools.partial(jax.tree.map, jnp.copy)
+        net = ComputationGraph(self.conf.clone())
+        net.params = copy(self.params)
+        net.updater_state = copy(self.updater_state)
+        net.state = copy(self.state)
+        net.iteration = self.iteration
+        net._initialized = True
+        return net
 
     def save(self, path: str) -> None:
         """One-zip checkpoint (util/model_serializer format)."""

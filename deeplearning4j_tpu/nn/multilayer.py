@@ -245,9 +245,13 @@ class MultiLayerNetwork:
             # grad_scale=1.0 normally; dp-size under ACCUM_GRADIENT-
             # without-divide (reference DIVIDE_ACCUM_GRADIENT=false: sum
             # of per-worker gradients = mean times worker count). Applied
-            # AFTER normalization — the reference normalizes each
-            # worker's gradient before accumulating, so the sum of n
-            # normalized gradients is n times the normalized gradient.
+            # AFTER normalization. NOTE: this computes n*normalize(mean),
+            # which matches the reference's sum-of-per-worker-normalized
+            # gradients exactly for plain SGD and whenever normalization
+            # is inactive or uniform across workers; with per-worker
+            # clipping that differs between shards the reference's sum
+            # can diverge from this global form (a documented deviation —
+            # the global batch here is ONE gradient, not N).
             g = jax.tree.map(lambda a: a * grad_scale, g)
             lr = resolve_lr(c, iteration)
             updates, new_upd[si] = upd.update(
@@ -624,9 +628,15 @@ class MultiLayerNetwork:
         return net
 
     def clone(self) -> "MultiLayerNetwork":
-        net = MultiLayerNetwork(self.conf.clone()).init()
-        net.params = jax.tree.map(lambda x: x, self.params)
-        net.updater_state = jax.tree.map(lambda x: x, self.updater_state)
-        net.state = jax.tree.map(lambda x: x, self.state)
+        # Deep-copy the buffers: the train step DONATES params/state, so
+        # aliased references in a clone would be deleted by the donor's
+        # next step ("Array has been deleted"). Skip init() — its random
+        # params would be immediately overwritten.
+        copy = functools.partial(jax.tree.map, jnp.copy)
+        net = MultiLayerNetwork(self.conf.clone())
+        net.params = copy(self.params)
+        net.updater_state = copy(self.updater_state)
+        net.state = copy(self.state)
         net.iteration = self.iteration
+        net._initialized = True
         return net

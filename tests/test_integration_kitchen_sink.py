@@ -1,0 +1,154 @@
+"""Cross-subsystem integration: one workflow touching conf serde,
+training, listeners, UI storage, checkpointing, early stopping, eval,
+and model reload — the glue the reference exercises across its
+module-level test suites (SURVEY.md §4 network-integration pattern)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.checkpoint.manager import CheckpointManager
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    LoggingEarlyStoppingListener,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.listeners import (
+    BestScoreIterationListener,
+    CollectScoresIterationListener,
+)
+from deeplearning4j_tpu.ui.storage import HistoryStorage
+
+
+def _data(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    cls = rng.integers(0, 3, n)
+    x = rng.normal(loc=cls[:, None] * 1.5, size=(n, 6)).astype(np.float32)
+    return x, np.eye(3, dtype=np.float32)[cls], cls
+
+
+def test_full_workflow(tmp_path):
+    # 1. conf built, shipped as JSON (the cluster wire format), rebuilt
+    conf_json = (
+        NeuralNetConfiguration.Builder().seed(11).learning_rate(0.1)
+        .updater(Updater.NESTEROVS).momentum(0.9)
+        .list()
+        .layer(0, L.DenseLayer(n_in=6, n_out=24, activation="relu"))
+        .layer(1, L.OutputLayer(n_in=24, n_out=3, activation="softmax",
+                                loss_function=LossFunction.MCXENT))
+        .build().to_json()
+    )
+    net = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+    net.init()
+
+    # 2. listeners: score history + best tracking + UI history storage
+    scores = CollectScoresIterationListener(frequency=1)
+    best = BestScoreIterationListener()
+    net.set_listeners(scores, best)
+    history = HistoryStorage()
+
+    x, y, cls = _data()
+    train = ListDataSetIterator(
+        [DataSet(x[i:i + 50], y[i:i + 50]) for i in range(0, 200, 50)])
+    val = ListDataSetIterator([DataSet(x[200:], y[200:])])
+
+    # 3. early stopping around the training loop, checkpointing each epoch
+    ckpt = CheckpointManager(str(tmp_path / "ckpts"), keep_last_n=2)
+    cfg = (
+        EarlyStoppingConfiguration.Builder()
+        .model_saver(InMemoryModelSaver())
+        .score_calculator(DataSetLossCalculator(val))
+        .epoch_termination_conditions(
+            ScoreImprovementEpochTerminationCondition(3))
+        .build()
+    )
+    listener = LoggingEarlyStoppingListener()
+
+    class CheckpointingTrainer(EarlyStoppingTrainer):
+        def _fit_batch(self, ds):
+            super()._fit_batch(ds)
+            history.put("score", self.net.iteration,
+                        float(self.net.score_value))
+
+    trainer = CheckpointingTrainer(cfg, net, train, listener=listener)
+    result = trainer.fit()
+    ckpt.save(net.iteration, net)
+    ckpt.wait_until_finished()
+
+    assert result.best_model is not None
+    assert result.best_model_score < 1.0
+    assert len(scores.scores) > 0
+    assert np.isfinite(best.best_score)
+    assert len(history.get("score")) > 0
+    assert len(listener.epochs) >= 3
+
+    # 4. evaluation on the best model
+    evaluation: Evaluation = result.best_model.evaluate(
+        ListDataSetIterator([DataSet(x[200:], y[200:])]))
+    assert evaluation.accuracy() > 0.85
+    assert "Accuracy" in evaluation.stats()
+
+    # 5. save/reload round trip keeps predictions identical
+    model_path = str(tmp_path / "model.zip")
+    result.best_model.save(model_path)
+    reloaded = MultiLayerNetwork.load(model_path)
+    np.testing.assert_allclose(
+        np.asarray(result.best_model.output(x[200:])),
+        np.asarray(reloaded.output(x[200:])), rtol=1e-6)
+
+    # 6. checkpoint restore resumes at the saved iteration
+    restored_net, meta = ckpt.restore()
+    assert restored_net.iteration == net.iteration
+    np.testing.assert_allclose(np.asarray(restored_net.params_flat()),
+                               np.asarray(net.params_flat()), rtol=1e-6)
+
+
+def test_clone_survives_donated_steps():
+    """Regression: clone() must deep-copy buffers — the jitted train step
+    donates params, which deletes aliased arrays in a shallow clone."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    x, y, cls = _data(60)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(2).learning_rate(0.1)
+        .list()
+        .layer(0, L.DenseLayer(n_in=6, n_out=8, activation="tanh"))
+        .layer(1, L.OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                loss_function=LossFunction.MCXENT))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y)
+    snap = net.clone()
+    before = np.asarray(snap.params_flat()).copy()
+    for _ in range(3):
+        net.fit(x, y)  # donates and deletes the live net's old buffers
+    np.testing.assert_allclose(np.asarray(snap.params_flat()), before)
+    assert snap.output(x).shape == (60, 3)
+
+    gconf = (
+        NeuralNetConfiguration.Builder().seed(2).learning_rate(0.1)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("out", L.OutputLayer(
+            n_in=6, n_out=3, activation="softmax",
+            loss_function=LossFunction.MCXENT), "in")
+        .set_outputs("out")
+        .build()
+    )
+    graph = ComputationGraph(gconf).init()
+    graph.fit(x, y)
+    gsnap = graph.clone()
+    gbefore = np.asarray(gsnap.params_flat()).copy()
+    for _ in range(3):
+        graph.fit(x, y)
+    np.testing.assert_allclose(np.asarray(gsnap.params_flat()), gbefore)
