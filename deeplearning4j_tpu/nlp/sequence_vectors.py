@@ -50,7 +50,7 @@ class SequenceVectors:
         negative: int = 0,
         use_hierarchic_softmax: bool = True,
         min_word_frequency: int = 5,
-        subsampling: float = 1e-3,
+        subsampling: float = 0.0,  # reference default: disabled (SequenceVectors.java:206)
         epochs: int = 1,
         batch_size: int = 4096,
         seed: int = 12345,

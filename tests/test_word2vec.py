@@ -102,6 +102,7 @@ class TestWord2Vec:
             .window_size(3)
             .min_word_frequency(5)
             .learning_rate(0.05)
+            .sampling(1e-3)  # subsample the shared filler words
             .epochs(8)
             .seed(7)
             .use_hierarchic_softmax(mode == "hs")
