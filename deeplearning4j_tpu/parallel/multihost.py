@@ -1,0 +1,134 @@
+"""Multi-host bootstrap: jax.distributed + the HTTP control plane.
+
+TPU-native replacement for the reference's cluster runtimes' process
+bootstrap (reference DeepLearning4jDistributed.java:66 setup — ActorSystem
++ ZooKeeper registration + Hazelcast membership; SURVEY.md §5.8): the
+data plane is `jax.distributed` (one process per host, gang-scheduled,
+XLA collectives over ICI within a slice and DCN across), and the control
+plane (config registry, membership, heartbeats, elastic
+checkpoint-restart) is the `scaleout.coordinator` HTTP service the akka
+stack maps to.
+
+On Cloud TPU pods `jax.distributed.initialize()` autodetects everything;
+elsewhere pass coordinator_address/num_processes/process_id explicitly.
+Single-process callers get a no-op — the same code runs 1-host and
+N-host.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join the jax.distributed gang. Idempotent; returns process_id.
+
+    No-ops (returning 0) when nothing indicates a multi-process run:
+    no arguments, no JAX_COORDINATOR_ADDRESS, and no TPU pod metadata.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_index()
+    explicit = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    # A pod is MULTIPLE worker hosts; single-host runtimes (and the test
+    # harness, which sets TPU_WORKER_HOSTNAMES=localhost) stay no-op.
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    on_pod = (len([h for h in hostnames.split(",") if h.strip()]) > 1
+              or bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")))
+    if not explicit and not on_pod:
+        return 0  # single process
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        # already initialized by the caller (the pattern the JAX docs
+        # recommend on pods) — treat as ours and carry on
+        pass
+    _initialized = True
+    log.info("jax.distributed up: process %d/%d, %d local / %d global devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+    return jax.process_index()
+
+
+def host_local_to_global(arr, mesh, pspec):
+    """Assemble a global array from each host's local shard (the
+    multi-host feed path: every host loads only its slice of the batch).
+    Single-process: a plain device_put with the requested sharding."""
+    from jax.sharding import NamedSharding
+
+    if jax.process_count() == 1:
+        return jax.device_put(arr, NamedSharding(mesh, pspec))
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(
+        arr, mesh, pspec)
+
+
+def global_to_host_local(arr, mesh, pspec):
+    """Inverse of host_local_to_global (gather my host's shard)."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.global_array_to_host_local_array(
+        arr, mesh, pspec)
+
+
+def sync_hosts(name: str = "barrier") -> None:
+    """Cross-host barrier (reference: the BSP round fences its workers)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+class MultiHostContext:
+    """Ties the gang to the control plane: jax.distributed for the data
+    plane, CoordinatorClient registration + heartbeats for membership and
+    elastic checkpoint-restart (SURVEY.md §5.3: gang-scheduled TPU maps
+    worker elasticity onto restart-from-checkpoint)."""
+
+    def __init__(self, coordinator_url: Optional[str] = None,
+                 heartbeat_interval: float = 1.0):
+        self.process_id = initialize_multihost()
+        self.num_processes = jax.process_count()
+        self._hb = None
+        if coordinator_url:
+            from deeplearning4j_tpu.scaleout.coordinator import (
+                CoordinatorClient,
+                HeartbeatThread,
+            )
+
+            self.worker_id = f"host-{self.process_id}"
+            self._hb = HeartbeatThread(
+                CoordinatorClient(coordinator_url), self.worker_id,
+                interval=heartbeat_interval)
+
+    def is_chief(self) -> bool:
+        return self.process_id == 0
+
+    def close(self) -> None:
+        """Stop heartbeating and deregister — a clean exit must not be
+        mistaken for a crash and trigger elastic restart."""
+        if self._hb is not None:
+            self._hb.stop(deregister=True)
+            self._hb = None

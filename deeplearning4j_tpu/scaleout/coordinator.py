@@ -371,3 +371,38 @@ class CoordinatorClient(StateTracker):
                 return True
             time.sleep(poll)
         return False
+
+
+class HeartbeatThread:
+    """Daemon heartbeat against a CoordinatorClient, with registration
+    and best-effort deregistration. Shared by host-level members
+    (parallel.multihost) — in-process workers (runner._Worker) keep
+    their own loops because heartbeating is entangled with their
+    stop/fault-injection flags."""
+
+    def __init__(self, client: "CoordinatorClient", worker_id: str,
+                 interval: float = 1.0):
+        self.client = client
+        self.worker_id = worker_id
+        self.interval = interval
+        self._stop = threading.Event()
+        self.client.add_worker(worker_id)
+
+        def beat():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.client.heartbeat(self.worker_id)
+                except OSError:  # control-plane outage is non-fatal
+                    pass
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        if deregister:
+            try:
+                self.client.remove_worker(self.worker_id)
+            except OSError:
+                pass  # clean exit is best-effort; eviction will catch it

@@ -211,3 +211,52 @@ class TestAccumulateGradients:
         d_sum = np.asarray(sum_t.net.params_flat()) - p0
         # summed gradients move n times as far on the first (SGD) step
         np.testing.assert_allclose(d_sum, n * d_mean, rtol=1e-4, atol=1e-6)
+
+
+class TestMultiHost:
+    def test_single_process_noop_and_helpers(self):
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel.multihost import (
+            global_to_host_local,
+            host_local_to_global,
+            initialize_multihost,
+            sync_hosts,
+        )
+
+        assert initialize_multihost() == 0  # no pod env: no-op
+        sync_hosts()  # no-op barrier
+        mesh = make_mesh(MeshSpec({"dp": len(jax.devices())}))
+        x = np.arange(len(jax.devices()) * 4, dtype=np.float32).reshape(
+            len(jax.devices()), 4)
+        g = host_local_to_global(x, mesh, P("dp"))
+        assert g.shape == x.shape
+        back = global_to_host_local(g, mesh, P("dp"))
+        np.testing.assert_allclose(np.asarray(back), x)
+
+    def test_context_with_control_plane(self):
+        from deeplearning4j_tpu.parallel.multihost import MultiHostContext
+        from deeplearning4j_tpu.scaleout.coordinator import (
+            CoordinatorServer,
+        )
+
+        server = CoordinatorServer()
+        server.start()
+        try:
+            ctx = MultiHostContext(
+                coordinator_url=server.address, heartbeat_interval=0.05)
+            assert ctx.is_chief()
+            assert ctx.num_processes == 1
+            import time
+
+            with server.state.lock:
+                t0 = server.state.workers["host-0"]
+            time.sleep(0.2)  # a few heartbeats
+            with server.state.lock:
+                assert server.state.workers["host-0"] > t0  # beat advanced
+            ctx.close()
+            time.sleep(0.05)
+            with server.state.lock:
+                assert "host-0" not in server.state.workers  # deregistered
+        finally:
+            server.stop()
