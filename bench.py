@@ -20,7 +20,7 @@ import numpy as np
 REFERENCE_CPU_EXAMPLES_PER_SEC = 3000.0  # estimated; none published
 BATCH = 2048
 SCAN_STEPS = 64   # steps fused into one XLA computation via lax.scan
-TIMED_CALLS = 40  # timed scan invocations (= 2560 optimizer steps)
+TIMED_CALLS = 80  # timed scan invocations (= 5120 optimizer steps)
 
 
 def main() -> None:
