@@ -188,6 +188,8 @@ def dbn(
     sizes: Sequence[int] = (784, 500, 250, 10),
     lr: float = 0.05,
     seed: int = 12345,
+    updater: Updater = Updater.SGD,
+    momentum: float = 0.9,
 ):
     """BASELINE.json configs[3]: DBN — stacked RBMs + softmax output,
     pretrain+finetune (reference MultiLayerNetwork.pretrain :150)."""
@@ -195,7 +197,8 @@ def dbn(
         NeuralNetConfiguration.Builder()
         .seed(seed)
         .learning_rate(lr)
-        .updater(Updater.SGD)
+        .updater(updater)
+        .momentum(momentum)
         .activation("sigmoid")
         .list()
     )
