@@ -192,7 +192,9 @@ def dbn(
     momentum: float = 0.9,
 ):
     """BASELINE.json configs[3]: DBN — stacked RBMs + softmax output,
-    pretrain+finetune (reference MultiLayerNetwork.pretrain :150)."""
+    pretrain+finetune (reference MultiLayerNetwork.pretrain :150).
+    ``momentum`` only takes effect with ``updater=Updater.NESTEROVS``
+    (plain SGD, the reference-faithful default, ignores it)."""
     b = (
         NeuralNetConfiguration.Builder()
         .seed(seed)

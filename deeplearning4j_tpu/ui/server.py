@@ -32,23 +32,103 @@ from deeplearning4j_tpu.util.httpjson import HttpService, JsonHandler
 
 _DASHBOARD = """<!doctype html>
 <html><head><title>deeplearning4j_tpu</title>
-<style>body{font-family:monospace;margin:2em}pre{background:#f4f4f4;
-padding:1em}</style></head>
+<style>
+body{font-family:monospace;margin:2em;background:#fafafa}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;
+      padding:12px;margin:10px 0}
+canvas{display:block}
+h1{font-size:18px} h2{font-size:13px;margin:0 0 6px 0;color:#333}
+pre{background:#f4f4f4;padding:8px;max-height:120px;overflow:auto}
+</style></head>
 <body><h1>deeplearning4j_tpu training dashboard</h1>
-<div id="keys"></div><pre id="latest"></pre>
+<div id="charts"></div>
 <script>
+// Per-series renderers: numeric payloads -> line chart; histogram
+// payloads ({bins:[...], counts:[...]} or {name:[...counts]}) -> bars;
+// anything else -> latest-value text (the reference's
+// histogram/score/activations views, vanilla canvas instead of
+// Dropwizard+JS assets).
+const cards = {};  // key -> element (keys may contain arbitrary text)
+function card(key){
+  let el = cards[key];
+  if (!el){
+    el = document.createElement('div'); el.className='card';
+    const h2 = document.createElement('h2');
+    h2.textContent = key;  // textContent: never inject keys as HTML
+    const cv = document.createElement('canvas');
+    cv.width = 640; cv.height = 160;
+    const pre = document.createElement('pre');
+    pre.style.display = 'none';
+    el.append(h2, cv, pre);
+    document.getElementById('charts').appendChild(el);
+    cards[key] = el;
+  }
+  return el;
+}
+function line(ctx, pts, W, H){
+  const xs = pts.map(p=>p[0]), ys = pts.map(p=>Number(p[1]));
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  const y0 = Math.min(...ys), y1 = Math.max(...ys);
+  const sx = i => 40 + (W-50) * (x1>x0 ? (i-x0)/(x1-x0) : 0.5);
+  const sy = v => H-18 - (H-30) * (y1>y0 ? (v-y0)/(y1-y0) : 0.5);
+  ctx.strokeStyle='#888'; ctx.strokeRect(40, 12, W-50, H-30);
+  ctx.fillStyle='#333'; ctx.font='10px monospace';
+  ctx.fillText(y1.toPrecision(4), 2, 18);
+  ctx.fillText(y0.toPrecision(4), 2, H-18);
+  ctx.fillText('iter '+x0, 40, H-4); ctx.fillText(''+x1, W-60, H-4);
+  ctx.strokeStyle='#0a62c9'; ctx.beginPath();
+  pts.forEach((p,i)=>{const X=sx(p[0]),Y=sy(Number(p[1]));
+                      i?ctx.lineTo(X,Y):ctx.moveTo(X,Y);});
+  ctx.stroke();
+}
+function bars(ctx, counts, W, H){
+  const m = Math.max(...counts, 1);
+  const bw = (W-50)/counts.length;
+  ctx.fillStyle='#0a62c9';
+  counts.forEach((c,i)=>{
+    const h = (H-30)*c/m;
+    ctx.fillRect(40+i*bw, H-18-h, Math.max(1,bw-1), h);
+  });
+  ctx.strokeStyle='#888'; ctx.strokeRect(40, 12, W-50, H-30);
+}
+function render(key, pts){
+  const el = card(key);
+  const cv = el.querySelector('canvas'), pre = el.querySelector('pre');
+  const showChart = on => {
+    cv.style.display = on ? 'block' : 'none';
+    pre.style.display = on ? 'none' : 'block';
+  };
+  const ctx = cv.getContext('2d');
+  ctx.clearRect(0,0,cv.width,cv.height);
+  const last = pts[pts.length-1];
+  const numeric = pts.every(p=>typeof p[1] === 'number');
+  if (numeric){ showChart(true); line(ctx, pts, cv.width, cv.height);
+                return; }
+  const v = last[1];
+  let counts = null;
+  if (v && Array.isArray(v.counts)) counts = v.counts;
+  else if (v && typeof v === 'object'){
+    const first = Object.values(v)[0];
+    if (Array.isArray(first) && first.every(n=>typeof n==='number'))
+      counts = first;
+  }
+  if (counts){ showChart(true); bars(ctx, counts, cv.width, cv.height);
+               return; }
+  showChart(false);
+  pre.textContent = '@'+last[0]+': '+JSON.stringify(v).slice(0,800);
+}
+const history = {};  // key -> accumulated points (incremental polling)
+async function poll(k){
+  const have = history[k] || [];
+  const since = have.length ? have[have.length-1][0] : -1;
+  const s = await (await fetch('/series?key='+encodeURIComponent(k)+
+                               '&since='+since)).json();
+  history[k] = have.concat(s.points);
+  if (history[k].length) render(k, history[k]);
+}
 async function tick(){
   const ks = await (await fetch('/keys')).json();
-  document.getElementById('keys').textContent =
-      'series: ' + ks.keys.join(', ');
-  let out = '';
-  for (const k of ks.keys){
-    const s = await (await fetch('/series?key='+encodeURIComponent(k))).json();
-    const last = s.points[s.points.length-1];
-    if (last) out += k + ' @' + last[0] + ': ' +
-        JSON.stringify(last[1]).slice(0,200) + '\\n';
-  }
-  document.getElementById('latest').textContent = out;
+  await Promise.all(ks.keys.map(poll));
 }
 setInterval(tick, 2000); tick();
 </script></body></html>"""
