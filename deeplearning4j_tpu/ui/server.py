@@ -66,9 +66,13 @@ function card(key){
   return el;
 }
 function line(ctx, pts, W, H){
-  const xs = pts.map(p=>p[0]), ys = pts.map(p=>Number(p[1]));
-  const x0 = Math.min(...xs), x1 = Math.max(...xs);
-  const y0 = Math.min(...ys), y1 = Math.max(...ys);
+  // loop, not Math.min(...spread): spread throws on very long series
+  let x0=Infinity, x1=-Infinity, y0=Infinity, y1=-Infinity;
+  for (const p of pts){
+    const x = p[0], y = Number(p[1]);
+    if (x < x0) x0 = x; if (x > x1) x1 = x;
+    if (y < y0) y0 = y; if (y > y1) y1 = y;
+  }
   const sx = i => 40 + (W-50) * (x1>x0 ? (i-x0)/(x1-x0) : 0.5);
   const sy = v => H-18 - (H-30) * (y1>y0 ? (v-y0)/(y1-y0) : 0.5);
   ctx.strokeStyle='#888'; ctx.strokeRect(40, 12, W-50, H-30);
@@ -82,7 +86,8 @@ function line(ctx, pts, W, H){
   ctx.stroke();
 }
 function bars(ctx, counts, W, H){
-  const m = Math.max(...counts, 1);
+  let m = 1;
+  for (const c of counts) if (c > m) m = c;
   const bw = (W-50)/counts.length;
   ctx.fillStyle='#0a62c9';
   counts.forEach((c,i)=>{
@@ -117,20 +122,36 @@ function render(key, pts){
   showChart(false);
   pre.textContent = '@'+last[0]+': '+JSON.stringify(v).slice(0,800);
 }
-const history = {};  // key -> accumulated points (incremental polling)
-async function poll(k){
-  const have = history[k] || [];
-  const since = have.length ? have[have.length-1][0] : -1;
-  const s = await (await fetch('/series?key='+encodeURIComponent(k)+
-                               '&since='+since)).json();
-  history[k] = have.concat(s.points);
-  if (history[k].length) render(k, history[k]);
+const history = {};   // key -> accumulated points
+const fetched = {};   // key -> server-side append count already pulled
+const KEEP = 5000;    // client-side retention bound
+async function poll(k, serverCount){
+  try {
+    const have = fetched[k] || 0;
+    if (serverCount < have){          // server restarted/reset: refetch
+      history[k] = []; fetched[k] = 0;
+    } else if (serverCount === have){ // nothing new: skip the request
+      return;
+    }
+    const s = await (await fetch('/series?key='+encodeURIComponent(k)+
+                                 '&offset='+(fetched[k]||0))).json();
+    fetched[k] = serverCount;
+    let pts = (history[k]||[]).concat(s.points);
+    if (pts.length > KEEP) pts = pts.slice(-KEEP);
+    history[k] = pts;
+    if (pts.length) render(k, pts);
+  } catch (e) { /* per-key failure must not break other charts */ }
 }
 async function tick(){
   const ks = await (await fetch('/keys')).json();
-  await Promise.all(ks.keys.map(poll));
+  await Promise.all(ks.keys.map(k => poll(k, ks.counts[k]||0)));
 }
-setInterval(tick, 2000); tick();
+// chained loop (not setInterval): no overlapping ticks on slow servers
+async function loop(){
+  try { await tick(); } catch (e) {}
+  setTimeout(loop, 2000);
+}
+loop();
 </script></body></html>"""
 
 
@@ -144,11 +165,16 @@ class _Handler(JsonHandler):
         if parsed.path == "/":
             self.send_bytes(_DASHBOARD.encode(), "text/html")
         elif parsed.path == "/keys":
-            self.send_json({"keys": self.storage.keys()})
+            self.send_json({"keys": self.storage.keys(),
+                            "counts": self.storage.counts()})
         elif parsed.path == "/series":
             key = qs.get("key", [""])[0]
-            since = int(qs.get("since", ["-1"])[0])
-            self.send_json({"points": self.storage.get(key, since)})
+            if "offset" in qs:
+                self.send_json({"points": self.storage.get_from(
+                    key, int(qs["offset"][0]))})
+            else:
+                since = int(qs.get("since", ["-1"])[0])
+                self.send_json({"points": self.storage.get(key, since)})
         elif parsed.path == "/nearest":
             word = qs.get("word", [""])[0]
             k = int(qs.get("k", ["5"])[0])

@@ -29,12 +29,14 @@ class HistoryStorage:
     def __init__(self, max_points: int = 10_000):
         self._lock = threading.RLock()
         self._series: Dict[str, List[tuple]] = defaultdict(list)
+        self._appended: Dict[str, int] = defaultdict(int)  # incl. trimmed
         self.max_points = max_points
 
     def put(self, key: str, iteration: int, payload: Any) -> None:
         with self._lock:
             series = self._series[key]
             series.append((int(iteration), payload))
+            self._appended[key] += 1
             if len(series) > self.max_points:
                 del series[: len(series) - self.max_points]
 
@@ -42,6 +44,21 @@ class HistoryStorage:
         with self._lock:
             return [(i, p) for i, p in self._series.get(key, [])
                     if i > since]
+
+    def get_from(self, key: str, offset: int = 0) -> List[tuple]:
+        """Points appended at global position >= offset — count-based
+        incremental polling that stays correct across iteration resets
+        and duplicate iteration numbers (offsets account for trimming)."""
+        with self._lock:
+            series = self._series.get(key, [])
+            dropped = self._appended.get(key, 0) - len(series)
+            return list(series[max(0, offset - dropped):])
+
+    def counts(self) -> Dict[str, int]:
+        """Total points appended per key (monotone unless the storage is
+        replaced — clients reset on decrease)."""
+        with self._lock:
+            return dict(self._appended)
 
     def latest(self, key: str) -> Optional[tuple]:
         with self._lock:

@@ -1,0 +1,90 @@
+"""Time-series shape utilities.
+
+TPU-native equivalent of reference util/TimeSeriesUtils.java (3d<->2d
+reshapes used around masked RNN losses) plus the variable-length padding
+the reference handles via per-batch masks (TestVariableLengthTS pattern):
+padding to a static max length + mask is THE jit-friendly form — dynamic
+lengths would retrigger XLA compilation per shape.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def reshape_3d_to_2d(x: np.ndarray) -> np.ndarray:
+    """[N, C, T] activations -> [N*T, C] rows (reference
+    TimeSeriesUtils.reshape3dTo2d: time-distributed loss form)."""
+    x = np.asarray(x)
+    if x.ndim != 3:
+        raise ValueError(f"expected [N, C, T], got shape {x.shape}")
+    n, c, t = x.shape
+    return np.transpose(x, (0, 2, 1)).reshape(n * t, c)
+
+
+def reshape_2d_to_3d(x: np.ndarray, batch: int) -> np.ndarray:
+    """[N*T, C] rows -> [N, C, T] (reference reshape2dTo3d)."""
+    x = np.asarray(x)
+    if x.ndim != 2 or x.shape[0] % batch:
+        raise ValueError(
+            f"rows {x.shape} not divisible into batch {batch}")
+    t = x.shape[0] // batch
+    return np.transpose(x.reshape(batch, t, x.shape[1]), (0, 2, 1))
+
+
+def reshape_mask_to_vector(mask: np.ndarray) -> np.ndarray:
+    """[N, T] time mask -> [N*T] row mask, aligned with
+    reshape_3d_to_2d's row order (reference
+    reshapeTimeSeriesMaskToVector)."""
+    mask = np.asarray(mask)
+    if mask.ndim != 2:
+        raise ValueError(f"expected [N, T], got {mask.shape}")
+    return mask.reshape(-1)
+
+
+def reshape_vector_to_mask(vec: np.ndarray, batch: int) -> np.ndarray:
+    """[N*T] -> [N, T] (reference reshapeVectorToTimeSeriesMask)."""
+    vec = np.asarray(vec)
+    if vec.ndim != 1 or vec.shape[0] % batch:
+        raise ValueError(f"vector {vec.shape} not divisible by {batch}")
+    return vec.reshape(batch, -1)
+
+
+def moving_average(values, n: int) -> np.ndarray:
+    """Simple trailing moving average of a 1-D series (reference
+    TimeSeriesUtils.movingAverage): output[i] = mean(values[i-n+1..i]),
+    defined from index n-1 on (length len(values)-n+1)."""
+    v = np.asarray(values, np.float64)
+    if n < 1 or n > len(v):
+        raise ValueError(f"window {n} invalid for length {len(v)}")
+    c = np.cumsum(np.concatenate([[0.0], v]))
+    return (c[n:] - c[:-n]) / n
+
+
+def pad_sequences(
+    sequences: Sequence[np.ndarray],
+    max_length: int = 0,
+    pad_value: float = 0.0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Stack variable-length [C, T_i] sequences into a static
+    ([N, C, T_max], [N, T_max] mask) pair — the jit-stable encoding of
+    variable lengths (masks flow through fit/eval per SURVEY §5.7; the
+    reference builds these masks by hand in TestVariableLengthTS)."""
+    seqs: List[np.ndarray] = [np.asarray(s) for s in sequences]
+    if not seqs:
+        raise ValueError("no sequences")
+    if any(s.ndim != 2 for s in seqs):
+        raise ValueError("each sequence must be [C, T_i]")
+    c = seqs[0].shape[0]
+    if any(s.shape[0] != c for s in seqs):
+        raise ValueError("inconsistent channel counts")
+    t_max = max_length or max(s.shape[1] for s in seqs)
+    out = np.full((len(seqs), c, t_max), pad_value, seqs[0].dtype)
+    mask = np.zeros((len(seqs), t_max), np.float32)
+    for i, s in enumerate(seqs):
+        t = min(s.shape[1], t_max)
+        out[i, :, :t] = s[:, :t]
+        mask[i, :t] = 1.0
+    return out, mask

@@ -123,3 +123,41 @@ class TestUiServer:
         with urllib.request.urlopen(self.server.address + "/") as resp:
             html = resp.read().decode()
         assert "dashboard" in html
+
+
+class TestIncrementalPolling:
+    def test_offset_and_counts(self):
+        from deeplearning4j_tpu.ui.storage import HistoryStorage
+
+        st = HistoryStorage(max_points=5)
+        for i in range(8):
+            st.put("score", i, float(i))
+        # 3 oldest trimmed; global offsets still line up
+        assert st.counts()["score"] == 8
+        assert [i for i, _ in st.get_from("score", 0)] == [3, 4, 5, 6, 7]
+        assert [i for i, _ in st.get_from("score", 6)] == [6, 7]
+        assert st.get_from("score", 8) == []
+        # duplicate iteration numbers are preserved (count-based, not
+        # iteration-based)
+        st.put("score", 7, 99.0)
+        assert [p for _, p in st.get_from("score", 8)] == [99.0]
+
+    def test_server_endpoints(self):
+        import json
+        import urllib.request
+
+        from deeplearning4j_tpu.ui.server import UiServer
+
+        server = UiServer()
+        server.start()
+        try:
+            for i in range(4):
+                server.storage.put("s", i, float(i))
+            ks = json.loads(urllib.request.urlopen(
+                server.address + "/keys").read())
+            assert ks["counts"]["s"] == 4
+            got = json.loads(urllib.request.urlopen(
+                server.address + "/series?key=s&offset=2").read())
+            assert [i for i, _ in got["points"]] == [2, 3]
+        finally:
+            server.stop()

@@ -158,3 +158,61 @@ class TestDiskBasedQueue:
         assert os.path.isdir(d)
         q.close()
         assert not os.path.isdir(d)
+
+
+class TestTimeSeriesUtils:
+    def test_3d_2d_round_trip(self):
+        from deeplearning4j_tpu.util.time_series import (
+            reshape_2d_to_3d,
+            reshape_3d_to_2d,
+        )
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 3, 5)).astype(np.float32)
+        flat = reshape_3d_to_2d(x)
+        assert flat.shape == (20, 3)
+        np.testing.assert_array_equal(reshape_2d_to_3d(flat, 4), x)
+        # row order matches time-major within each example
+        np.testing.assert_array_equal(flat[0], x[0, :, 0])
+        np.testing.assert_array_equal(flat[1], x[0, :, 1])
+
+    def test_mask_round_trip(self):
+        from deeplearning4j_tpu.util.time_series import (
+            reshape_mask_to_vector,
+            reshape_vector_to_mask,
+        )
+
+        m = np.array([[1, 1, 0], [1, 0, 0]], np.float32)
+        v = reshape_mask_to_vector(m)
+        assert v.shape == (6,)
+        np.testing.assert_array_equal(reshape_vector_to_mask(v, 2), m)
+
+    def test_moving_average(self):
+        from deeplearning4j_tpu.util.time_series import moving_average
+
+        got = moving_average([1, 2, 3, 4, 5], 3)
+        np.testing.assert_allclose(got, [2.0, 3.0, 4.0])
+
+    def test_pad_sequences_and_masked_rnn(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.util.time_series import pad_sequences
+
+        rng = np.random.default_rng(1)
+        seqs = [rng.normal(size=(3, t)).astype(np.float32)
+                for t in (4, 6, 2)]
+        x, mask = pad_sequences(seqs)
+        assert x.shape == (3, 3, 6) and mask.shape == (3, 6)
+        np.testing.assert_array_equal(mask.sum(axis=1), [4, 6, 2])
+        np.testing.assert_array_equal(x[2, :, 2:], 0.0)
+
+        # feeds straight into a masked recurrent forward
+        conf = (NeuralNetConfiguration.Builder().seed(0).list()
+                .layer(0, L.GravesLSTM(n_in=3, n_out=4,
+                                       activation="tanh"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = net._forward_fn(net.params, net.state, np.asarray(x), None,
+                              False, np.asarray(mask))[0]
+        assert np.asarray(out).shape == (3, 4, 6)
