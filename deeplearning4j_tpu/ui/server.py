@@ -133,9 +133,13 @@ async function poll(k, serverCount){
     } else if (serverCount === have){ // nothing new: skip the request
       return;
     }
+    const off = fetched[k]||0;
     const s = await (await fetch('/series?key='+encodeURIComponent(k)+
-                                 '&offset='+(fetched[k]||0))).json();
-    fetched[k] = serverCount;
+                                 '&offset='+off)).json();
+    // count what we actually received, not the /keys snapshot: points
+    // appended between /keys and /series would otherwise be re-fetched
+    // and duplicated next tick
+    fetched[k] = off + s.points.length;
     let pts = (history[k]||[]).concat(s.points);
     if (pts.length > KEEP) pts = pts.slice(-KEEP);
     history[k] = pts;
