@@ -139,6 +139,43 @@ def serialize_for_pjrt(fn, example_arg) -> Tuple[bytes, bytes]:
     return exported.mlir_module_serialized, copts
 
 
+def export_network_for_native(net, example_input) -> Tuple[bytes, bytes]:
+    """Serialize a trained MultiLayerNetwork/ComputationGraph forward
+    pass (params baked in as constants) to the (VHLO, CompileOptions)
+    pair — deploy-time serving through the C++ client with no Python or
+    jax process on the box."""
+    import jax
+    import jax.numpy as jnp
+
+    params = jax.tree.map(jnp.asarray, net.params)
+    state = jax.tree.map(jnp.asarray, net.state) if net.state else {}
+    is_graph = hasattr(net.conf, "network_inputs")
+    if is_graph and (len(net.conf.network_inputs) != 1
+                     or len(net.conf.network_outputs) != 1):
+        raise ValueError(
+            "export_network_for_native serves single-input/single-output "
+            f"models; graph has {len(net.conf.network_inputs)} inputs / "
+            f"{len(net.conf.network_outputs)} outputs")
+
+    def forward(x):
+        if is_graph:
+            acts, _ = net._forward_fn(
+                params, state, {net.conf.network_inputs[0]: x}, None,
+                False)
+            out = acts[net.conf.network_outputs[0]]
+        else:
+            out = net._forward_fn(params, state, x, None, False)[0]
+        # the C ABI moves f32 bytes; a compute_dtype="bfloat16" net would
+        # otherwise export a bf16 result the client misreads
+        return out.astype(jnp.float32)
+
+    # Serve at full precision: the TPU's default bf16 matmul passes are
+    # a training trade-off; exported inference should match the trained
+    # model's f32 outputs.
+    with jax.default_matmul_precision("highest"):
+        return serialize_for_pjrt(forward, jnp.asarray(example_input))
+
+
 def harness_tpu_options() -> Optional[str]:
     """Option spec for the tunnel TPU plugin in this harness (None when
     the env markers are absent — e.g. on a machine with local chips the
@@ -147,6 +184,11 @@ def harness_tpu_options() -> Optional[str]:
 
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return None
+    # Derivations the harness sitecustomize performs at interpreter
+    # start; re-derive here so plugin loading also works in `python -S`
+    # processes (where no sitecustomize ran).
+    os.environ.setdefault("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
+    os.environ.setdefault("AXON_LOOPBACK_RELAY", "1")
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     return (f"i:remote_compile=1;i:local_only=0;i:priority=0;"
             f"s:topology={gen}:1x1x1;i:n_slices=1;"

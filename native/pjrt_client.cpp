@@ -340,10 +340,36 @@ int64_t dl4j_pjrt_run_f32(void* handle, const char* code,
   PJRT_Buffer* out_buf = out_dev0[0];
 
   // -- device -> host ------------------------------------------------
+  // Request a dense ROW-MAJOR host layout explicitly: with
+  // host_layout=nullptr the copy uses the device buffer's layout, and
+  // TPU buffers are frequently column-major/tiled — the bytes would
+  // arrive permuted.
+  PJRT_Buffer_Dimensions_Args bd;
+  std::memset(&bd, 0, sizeof(bd));
+  bd.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+  bd.buffer = out_buf;
+  if (take_error(api, api->PJRT_Buffer_Dimensions(&bd), err, errn)) {
+    destroy_buf(out_buf);
+    destroy_exe();
+    return -1;
+  }
+  std::vector<int64_t> minor_to_major(bd.num_dims);
+  for (size_t i = 0; i < bd.num_dims; ++i) {
+    minor_to_major[i] = int64_t(bd.num_dims - 1 - i);
+  }
+  PJRT_Buffer_MemoryLayout row_major;
+  std::memset(&row_major, 0, sizeof(row_major));
+  row_major.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+  row_major.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
+  row_major.tiled.struct_size = PJRT_Buffer_MemoryLayout_Tiled_STRUCT_SIZE;
+  row_major.tiled.minor_to_major = minor_to_major.data();
+  row_major.tiled.minor_to_major_size = minor_to_major.size();
+
   PJRT_Buffer_ToHostBuffer_Args th;
   std::memset(&th, 0, sizeof(th));
   th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
   th.src = out_buf;
+  th.host_layout = &row_major;
   th.dst = nullptr;  // query size
   if (take_error(api, api->PJRT_Buffer_ToHostBuffer(&th), err, errn)) {
     destroy_buf(out_buf);
