@@ -116,13 +116,15 @@ def mnist_dataset(
     binarize: bool = False,
     as_image: bool = False,
     seed: Optional[int] = None,
+    normalize: bool = True,
 ) -> DataSet:
     from deeplearning4j_tpu.native_rt import one_hot, u8_to_f32
 
     imgs, labels = load_mnist(train, num_examples)
-    x = u8_to_f32(imgs)
+    x = u8_to_f32(imgs, scale=(1.0 / 255.0) if normalize else 1.0)
     if binarize:
-        x = (x > 0.5).astype(np.float32)
+        # threshold at half intensity in whichever scale is active
+        x = (x > (0.5 if normalize else 127.5)).astype(np.float32)
     if as_image:
         x = x.reshape(-1, 1, 28, 28)  # [N, C, H, W]
     else:
@@ -146,9 +148,20 @@ class MnistDataSetIterator(BaseDataSetIterator):
         shuffle: bool = False,
         seed: int = 123,
         as_image: bool = False,
+        normalize: bool = True,
     ):
         ds = mnist_dataset(
             train, num_examples, binarize, as_image,
-            seed if shuffle else None,
+            seed if shuffle else None, normalize=normalize,
         )
         super().__init__(batch_size, ds)
+
+
+class RawMnistDataSetIterator(MnistDataSetIterator):
+    """Raw 0-255 pixel values, no normalization (reference
+    datasets/iterator/impl/RawMnistDataSetIterator.java)."""
+
+    def __init__(self, batch_size: int,
+                 num_examples: Optional[int] = None, train: bool = True):
+        super().__init__(batch_size, num_examples, train=train,
+                         normalize=False)

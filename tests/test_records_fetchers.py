@@ -272,3 +272,17 @@ class TestFetchers:
         ev = net.evaluate(CifarDataSetIterator(64, num_examples=256,
                                                train=False, flatten=True))
         assert ev.accuracy() > 0.5  # well above 10% chance
+
+
+def test_raw_mnist_iterator_unnormalized():
+    from deeplearning4j_tpu.datasets.mnist import (
+        MnistDataSetIterator,
+        RawMnistDataSetIterator,
+    )
+
+    raw = RawMnistDataSetIterator(16, num_examples=32).next()
+    assert raw.features.max() > 1.5  # 0-255 pixel values
+    norm = MnistDataSetIterator(16, num_examples=32).next()
+    assert norm.features.max() <= 1.0
+    np.testing.assert_allclose(raw.features / 255.0, norm.features,
+                               rtol=1e-6)
