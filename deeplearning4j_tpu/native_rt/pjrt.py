@@ -159,9 +159,9 @@ def export_network_for_native(net, example_input) -> Tuple[bytes, bytes]:
 
     def forward(x):
         if is_graph:
-            acts, _ = net._forward_fn(
+            acts = net._forward_fn(
                 params, state, {net.conf.network_inputs[0]: x}, None,
-                False)
+                False)[0]
             out = acts[net.conf.network_outputs[0]]
         else:
             out = net._forward_fn(params, state, x, None, False)[0]

@@ -29,7 +29,13 @@ class LabelsSource:
 
     def next_label(self) -> str:
         if self._fixed:
-            label = self._labels[self._counter % len(self._labels)]
+            if self._counter >= len(self._labels):
+                raise IndexError(
+                    "LabelsSource exhausted: %d fixed labels but document "
+                    "#%d requested one — the corpus has more documents "
+                    "than labels (the reference errors here too)"
+                    % (len(self._labels), self._counter))
+            label = self._labels[self._counter]
         else:
             label = self.template % self._counter
             self._labels.append(label)

@@ -21,6 +21,10 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.enums import (
+    BackpropType,
+    OptimizationAlgorithm,
+)
 from deeplearning4j_tpu.nn.conf.graph_conf import (
     ComputationGraphConfiguration,
     DuplicateToTimeSeriesVertex,
@@ -70,6 +74,7 @@ class ComputationGraph:
         self.iteration = 0
         self.score_value = float("nan")
         self.listeners: List = []
+        self._rnn_state: Dict[str, Any] = {}
         self._layer_vertices = {
             name: v
             for name, v in conf.vertices.items()
@@ -121,8 +126,14 @@ class ComputationGraph:
         rng,
         train: bool,
         masks: Optional[Dict[str, Array]] = None,
+        rnn_state: Optional[Dict[str, Any]] = None,
+        stop_at: Optional[str] = None,
     ):
-        """Topological-order forward. Returns (activation dict, new_state)."""
+        """Topological-order forward. Returns
+        (activation dict, new_state, new_rnn_state) — ``rnn_state`` is the
+        per-vertex recurrent carry (reference ComputationGraph
+        rnnActivateUsingStoredState :1233: stored state fed back in for
+        streaming inference and truncated-BPTT window chaining)."""
         if self._compute_dtype is not None:
             # Mixed precision: bf16 compute, f32 master params (same
             # scheme as MultiLayerNetwork._forward_fn)
@@ -132,6 +143,7 @@ class ComputationGraph:
             inputs = {k: cast(v) for k, v in inputs.items()}
         acts: Dict[str, Array] = dict(inputs)
         new_state = dict(state) if state else {}
+        new_rnn: Dict[str, Any] = {}
         # Masks propagate along edges: a vertex inherits its first input's
         # time mask, so stacked recurrent layers stay masked (parity with
         # MultiLayerNetwork, which hands feature_mask to every recurrent
@@ -164,6 +176,8 @@ class ComputationGraph:
                     )
                 impl = self._impls[name]
                 layer_state = new_state.get(name)
+                if layer_state is None and rnn_state:
+                    layer_state = rnn_state.get(name)
                 is_recurrent = isinstance(
                     vertex.conf.layer, L.RECURRENT_LAYER_TYPES
                 )
@@ -177,14 +191,19 @@ class ComputationGraph:
                     rng=layer_keys.get(name) if train else None,
                     mask=mask,
                 )
-                if st is not None and name in new_state:
+                if st is not None:
                     if self._compute_dtype is not None:
                         # carried state stays at master dtype so repeated
                         # steps see stable input dtypes (no recompiles)
                         st = jax.tree_util.tree_map(
                             functools.partial(_cast_floating,
                                               dtype=self._dtype), st)
-                    new_state[name] = st
+                    if name in new_state:
+                        new_state[name] = st
+                    else:
+                        # recurrent carry (h, c): returned separately so
+                        # rnn_time_step/tBPTT can chain it across calls
+                        new_rnn[name] = st
                 acts[name] = out
             elif isinstance(vertex, MergeVertex):
                 acts[name] = jnp.concatenate(xs, axis=1)
@@ -206,11 +225,16 @@ class ComputationGraph:
                 )
             else:
                 raise ValueError(f"Unknown vertex type {type(vertex).__name__}")
-        return acts, new_state
+            if name == stop_at:
+                # partial forward (pretraining): downstream vertices are
+                # never consumed, so don't trace them at all
+                break
+        return acts, new_state, new_rnn
 
-    def _loss_fn(self, params, state, rng, inputs, labels, masks, label_masks):
-        acts, new_state = self._forward_fn(
-            params, state, inputs, rng, True, masks
+    def _loss_fn(self, params, state, rng, inputs, labels, masks, label_masks,
+                 rnn_state=None):
+        acts, new_state, new_rnn = self._forward_fn(
+            params, state, inputs, rng, True, masks, rnn_state
         )
         score = 0.0
         for out_name, y in zip(self.conf.network_outputs, labels):
@@ -222,7 +246,7 @@ class ComputationGraph:
                 out = _cast_floating(out, dtype=self._dtype)  # loss in f32
             score = score + impl.loss(v.conf, out, y, lm)
         score = score + self._reg_score(params)
-        return score, new_state
+        return score, (new_state, new_rnn)
 
     def _reg_score(self, params):
         reg = 0.0
@@ -244,11 +268,10 @@ class ComputationGraph:
         return reg
 
     # ------------------------------------------------------------------
-    def _step_body(self, params, state, upd_state, iteration, rng, inputs,
-                   labels, masks, label_masks, grad_scale=1.0):
-        (score, new_state), grads = jax.value_and_grad(
-            self._loss_fn, has_aux=True
-        )(params, state, rng, inputs, labels, masks, label_masks)
+    def _apply_updates(self, params, upd_state, grads, iteration,
+                       grad_scale=1.0):
+        """Per-vertex normalize → scale → updater → subtract (shared by
+        the standard and tBPTT steps)."""
         new_params = {}
         new_upd = {}
         for name, v in self._layer_vertices.items():
@@ -258,7 +281,7 @@ class ComputationGraph:
                 grads[name],
                 float(c.resolved("gradient_normalization_threshold")),
             )
-            # see MultiLayerNetwork._step_body: ACCUM-without-divide scale
+            # see MultiLayerNetwork._apply_updates: ACCUM-without-divide
             g = jax.tree.map(lambda a: a * grad_scale, g)
             updates, new_upd[name] = self._updaters[name].update(
                 g, upd_state[name], resolve_lr(c, iteration), iteration
@@ -266,6 +289,15 @@ class ComputationGraph:
             new_params[name] = jax.tree.map(
                 lambda p, u: p - u, params[name], updates
             )
+        return new_params, new_upd
+
+    def _step_body(self, params, state, upd_state, iteration, rng, inputs,
+                   labels, masks, label_masks, grad_scale=1.0):
+        (score, (new_state, _)), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True
+        )(params, state, rng, inputs, labels, masks, label_masks)
+        new_params, new_upd = self._apply_updates(
+            params, upd_state, grads, iteration, grad_scale)
         return new_params, new_state, new_upd, score
 
     @functools.cached_property
@@ -301,6 +333,16 @@ class ComputationGraph:
         single-input graphs); ``labels_stacked``: list of [K, B, ...]
         per output (or a single array). Unmasked plain-SGD fast path;
         returns the K per-step scores lazily (device array)."""
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            raise ValueError(
+                "fit_scan is the full-BPTT SGD fast path; truncated-BPTT "
+                "graphs must train via fit()")
+        for name, v in self._layer_vertices.items():
+            algo = v.conf.optimization_algo
+            if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+                raise ValueError(
+                    f"fit_scan only supports SGD, but vertex {name!r} is "
+                    f"configured with {algo}; use fit()")
         self.init()
         if not isinstance(inputs_stacked, dict):
             inputs_stacked = {
@@ -336,7 +378,7 @@ class ComputationGraph:
     @functools.cached_property
     def _output_fn(self):
         def out(params, state, inputs):
-            acts, _ = self._forward_fn(params, state, inputs, None, False)
+            acts, _, _ = self._forward_fn(params, state, inputs, None, False)
             return [acts[name] for name in self.conf.network_outputs]
 
         return jax.jit(out)
@@ -421,14 +463,28 @@ class ComputationGraph:
         if labels is not None:
             data = DataSet(data, labels)
         if isinstance(data, DataSetIterator):
+            if self.conf.pretrain:
+                self.pretrain(data)
+                data.reset()
+            if not self.conf.backprop:
+                return
             for ds in data:
                 self._fit_one(ds)
         else:
             self._fit_one(data)
 
     def _fit_one(self, data) -> None:
-        inputs, labels, masks, lmasks = self._coerce_multi(data)
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            self._fit_tbptt(data)
+            return
         first_conf = next(iter(self._layer_vertices.values())).conf
+        if (first_conf.optimization_algo
+                != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
+            from deeplearning4j_tpu.optimize.solver import Solver
+
+            Solver(self).optimize(data)
+            return
+        inputs, labels, masks, lmasks = self._coerce_multi(data)
         n_iter = max(1, first_conf.num_iterations)
         for _ in range(n_iter):
             self._key, sub = jax.random.split(self._key)
@@ -447,6 +503,160 @@ class ComputationGraph:
                 listener.iteration_done(self, self.iteration)
 
     # ------------------------------------------------------------------
+    # Truncated BPTT (reference ComputationGraph.doTruncatedBPTT :1349):
+    # chop the time axis into fwd-length windows, carry per-vertex
+    # recurrent state (stop-gradient) across windows. Non-temporal (2-D)
+    # inputs are fed whole into every window, as the reference does.
+    # ------------------------------------------------------------------
+    def _fit_tbptt(self, data) -> None:
+        inputs, labels, masks, lmasks = self._coerce_multi(data)
+        length = self.conf.tbptt_fwd_length
+        temporal = [v.shape[2] for v in list(inputs.values()) + labels
+                    if v.ndim == 3]
+        if not temporal:
+            raise ValueError(
+                "truncated BPTT requires at least one [B, C, T] input or "
+                "label")
+        t_total = max(temporal)
+        rnn_state: Dict[str, Any] = {}
+        for start in range(0, t_total, length):
+            end = min(start + length, t_total)
+            iw = {k: (v[:, :, start:end] if v.ndim == 3 else v)
+                  for k, v in inputs.items()}
+            lw = [y[:, :, start:end] if y.ndim == 3 else y for y in labels]
+            mw = (None if masks is None
+                  else {k: m[:, start:end] for k, m in masks.items()})
+            lmw = (None if lmasks is None
+                   else {k: m[:, start:end] for k, m in lmasks.items()})
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.state, self.updater_state, rnn_state,
+             score) = self._tbptt_step(
+                self.params, self.state, self.updater_state,
+                self.iteration, sub, iw, lw, mw, lmw, rnn_state)
+            self.score_value = score
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+
+    @functools.cached_property
+    def _tbptt_step(self):
+        def step(params, state, upd_state, iteration, rng, inputs, labels,
+                 masks, lmasks, rnn_state):
+            (score, (new_state, new_rnn)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(params, state, rng, inputs, labels, masks, lmasks, rnn_state)
+            new_params, new_upd = self._apply_updates(
+                params, upd_state, grads, iteration)
+            new_rnn = jax.lax.stop_gradient(new_rnn)
+            return new_params, new_state, new_upd, new_rnn, score
+
+        return jax.jit(step)
+
+    # ------------------------------------------------------------------
+    # RNN streaming inference (reference ComputationGraph.rnnTimeStep
+    # :1196): stateful step-by-step forward carrying hidden state between
+    # calls; 2-D inputs are treated as one time step and the output is
+    # squeezed back to 2-D, matching the reference's shape contract.
+    # ------------------------------------------------------------------
+    def rnn_time_step(self, *features) -> List[Array]:
+        self.init()
+        # Direct consumers of each network input: a 2-D input consumed by
+        # recurrent layers is ONE time step (expand to [B, C, 1], as the
+        # reference's BaseRecurrentLayer.rnnTimeStep does internally); a
+        # 2-D input consumed by non-recurrent vertices (Dense,
+        # DuplicateToTimeSeries) is static and keeps its rank.
+        consumers: Dict[str, List[str]] = {}
+        for vname, in_names in self.conf.vertex_inputs.items():
+            for inp in in_names:
+                consumers.setdefault(inp, []).append(vname)
+        inputs = {}
+        ranks = []
+        for n, f in zip(self.conf.network_inputs, features):
+            x = jnp.asarray(f, self._dtype)
+            ranks.append(x.ndim)
+            if x.ndim == 2:
+                cons = consumers.get(n, [])
+                rec = [c for c in cons
+                       if isinstance(self.conf.vertices[c], LayerVertex)
+                       and isinstance(self.conf.vertices[c].conf.layer,
+                                      L.RECURRENT_LAYER_TYPES)]
+                if rec and len(rec) == len(cons):
+                    x = x[:, :, None]
+                elif rec:
+                    raise ValueError(
+                        f"Input {n!r} feeds both recurrent ({rec}) and "
+                        f"non-recurrent vertices; pass it as 3-D "
+                        f"[B, C, 1] to disambiguate one-time-step intent")
+            inputs[n] = x
+        # squeeze outputs back to 2-D only when ALL inputs were 2-D
+        # (mixed-rank calls keep the full time axis — a 3-D input's
+        # T-step output must not be truncated to step 0)
+        squeeze = bool(ranks) and all(r == 2 for r in ranks)
+        acts, _, new_rnn = self._forward_fn(
+            self.params, self.state, inputs, None, False,
+            rnn_state=self._rnn_state or None,
+        )
+        self._rnn_state = new_rnn
+        outs = [acts[name] for name in self.conf.network_outputs]
+        if squeeze:
+            outs = [o[:, :, 0] if o.ndim == 3 else o for o in outs]
+        return outs
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_state = {}
+
+    # ------------------------------------------------------------------
+    # Greedy layer-wise pretraining (reference ComputationGraph.pretrain
+    # :341-427): for each pretrainable layer vertex in topological order,
+    # feed each batch forward (inference mode) to the vertex's input,
+    # then run that vertex's unsupervised update (RBM CD-k / AE).
+    # ------------------------------------------------------------------
+    def pretrain(self, data_iter) -> None:
+        self.init()
+        from deeplearning4j_tpu.optimize.pretrainer import pretrain_graph
+
+        pretrain_graph(self, data_iter)
+
+    def _pretrain_input(self, name: str, ds) -> Array:
+        """Activations feeding vertex ``name`` (inference mode), with the
+        vertex's own preprocessor applied — the graph analog of
+        MultiLayerNetwork's activationFromPrevLayer. The partial forward
+        stops at the feeding vertex (downstream vertices are not traced)
+        and is jitted, cached per feeding vertex."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if isinstance(ds, DataSet) and ds.labels is None:
+            # feature-only data — the normal input to unsupervised
+            # pretraining; _coerce_multi would choke on labels=None
+            inputs = {self.conf.network_inputs[0]: jnp.asarray(
+                ds.features, self._dtype)}
+            masks = (None if ds.features_mask is None else {
+                self.conf.network_inputs[0]: jnp.asarray(ds.features_mask)})
+        else:
+            inputs, _, masks, _ = self._coerce_multi(ds)
+        vertex = self.conf.vertices[name]
+        in_name = self.conf.vertex_inputs[name][0]
+        if in_name in inputs:
+            x = inputs[in_name]
+        else:
+            cache = getattr(self, "_pretrain_fwd_cache", None)
+            if cache is None:
+                cache = self._pretrain_fwd_cache = {}
+            fn = cache.get(in_name)
+            if fn is None:
+                def fwd(params, state, inputs, masks, _n=in_name):
+                    acts, _, _ = self._forward_fn(
+                        params, state, inputs, None, False, masks,
+                        stop_at=_n)
+                    return acts[_n]
+
+                fn = cache[in_name] = jax.jit(fwd)
+            x = fn(self.params, self.state, inputs, masks)
+        if vertex.preprocessor is not None:
+            x = vertex.preprocessor.pre_process(x)
+        return x
+
+    # ------------------------------------------------------------------
     def output(self, *features) -> List[Array]:
         self.init()
         inputs = {
@@ -461,7 +671,8 @@ class ComputationGraph:
             n: jnp.asarray(f, self._dtype)
             for n, f in zip(self.conf.network_inputs, features)
         }
-        acts, _ = self._forward_fn(self.params, self.state, inputs, None, False)
+        acts, _, _ = self._forward_fn(
+            self.params, self.state, inputs, None, False)
         return acts
 
     def score(self, data=None) -> float:

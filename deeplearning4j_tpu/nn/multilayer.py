@@ -228,11 +228,10 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # The jitted train step (whole §3.1 stack as one XLA computation)
     # ------------------------------------------------------------------
-    def _step_body(self, params, state, upd_state, iteration, rng, features,
-                   labels, feature_mask, label_mask, grad_scale=1.0):
-        (score, new_state), grads = jax.value_and_grad(
-            self._loss_fn, has_aux=True
-        )(params, state, rng, features, labels, feature_mask, label_mask)
+    def _apply_updates(self, params, upd_state, grads, iteration,
+                       grad_scale=1.0):
+        """Per-layer normalize → scale → updater → subtract (shared by
+        the standard and tBPTT steps)."""
         new_params = {}
         new_upd = {}
         for i, (c, upd) in enumerate(zip(self.conf.confs, self._updaters)):
@@ -260,6 +259,15 @@ class MultiLayerNetwork:
             new_params[si] = jax.tree.map(
                 lambda p, u: p - u, params[si], updates
             )
+        return new_params, new_upd
+
+    def _step_body(self, params, state, upd_state, iteration, rng, features,
+                   labels, feature_mask, label_mask, grad_scale=1.0):
+        (score, new_state), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True
+        )(params, state, rng, features, labels, feature_mask, label_mask)
+        new_params, new_upd = self._apply_updates(
+            params, upd_state, grads, iteration, grad_scale)
         return new_params, new_state, new_upd, score
 
     @functools.cached_property
@@ -419,12 +427,13 @@ class MultiLayerNetwork:
             self._key, sub = jax.random.split(self._key)
             (
                 self.params,
+                self.state,
                 self.updater_state,
                 rnn_state,
                 score,
             ) = self._tbptt_step(
-                self.params, self.updater_state, self.iteration, sub,
-                fw, lw, fmw, lmw, rnn_state,
+                self.params, self.state, self.updater_state,
+                self.iteration, sub, fw, lw, fmw, lmw, rnn_state,
             )
             self.score_value = score
             self.iteration += 1
@@ -433,38 +442,26 @@ class MultiLayerNetwork:
 
     @functools.cached_property
     def _tbptt_step(self):
-        def loss(params, rng, f, y, fm, lm, rnn_state):
-            out, _, new_rnn = self._forward_fn(
-                params, self.state, f, rng, True, fm, rnn_state=rnn_state
+        def loss(params, state, rng, f, y, fm, lm, rnn_state):
+            out, new_state, new_rnn = self._forward_fn(
+                params, state, f, rng, True, fm, rnn_state=rnn_state
             )
             if self._compute_dtype is not None:
                 out = _cast_floating(out, dtype=self._dtype)  # loss in f32
             impl = self._impls[-1]
             score = impl.loss(self.conf.confs[-1], out, y, lm)
             score = score + self._reg_score(params)
-            return score, new_rnn
+            return score, (new_state, new_rnn)
 
-        def step(params, upd_state, iteration, rng, f, y, fm, lm, rnn_state):
-            (score, new_rnn), grads = jax.value_and_grad(loss, has_aux=True)(
-                params, rng, f, y, fm, lm, rnn_state
-            )
-            new_params = {}
-            new_upd = {}
-            for i, (c, upd) in enumerate(zip(self.conf.confs, self._updaters)):
-                si = str(i)
-                g = normalize_gradients(
-                    c.resolved("gradient_normalization"),
-                    grads[si],
-                    float(c.resolved("gradient_normalization_threshold")),
-                )
-                updates, new_upd[si] = upd.update(
-                    g, upd_state[si], resolve_lr(c, iteration), iteration
-                )
-                new_params[si] = jax.tree.map(
-                    lambda p, u: p - u, params[si], updates
-                )
+        def step(params, state, upd_state, iteration, rng, f, y, fm, lm,
+                 rnn_state):
+            (score, (new_state, new_rnn)), grads = jax.value_and_grad(
+                loss, has_aux=True
+            )(params, state, rng, f, y, fm, lm, rnn_state)
+            new_params, new_upd = self._apply_updates(
+                params, upd_state, grads, iteration)
             new_rnn = jax.lax.stop_gradient(new_rnn)
-            return new_params, new_upd, new_rnn, score
+            return new_params, new_state, new_upd, new_rnn, score
 
         return jax.jit(step)
 

@@ -1,10 +1,12 @@
 """Greedy layer-wise unsupervised pretraining.
 
 Mirror of reference MultiLayerNetwork.pretrain(DataSetIterator) :150-226
-(§3.3 call stack): for each pretrainable layer, feed data forward through
-the already-trained stack, then run that layer's unsupervised update
-(RBM CD-k / denoising-AE gradient) for conf.numIterations iterations per
-batch. Each layer's update is one jitted computation.
+(§3.3 call stack) and ComputationGraph.pretrain :341-427: for each
+pretrainable unit (layer index / layer vertex), feed data forward through
+the already-trained stack to that unit's input, then run the unit's
+unsupervised update (RBM CD-k / denoising-AE gradient) for
+conf.numIterations iterations per batch. Each unit's update is one jitted
+computation, shared between the MLN and graph paths.
 """
 
 from __future__ import annotations
@@ -19,43 +21,75 @@ from deeplearning4j_tpu.nn.updater.updaters import resolve_lr
 
 
 def pretrain_network(net, data_iter) -> None:
-    # jitted steps are cached on the network so repeated pretrain() calls
-    # reuse the compiled executable instead of retracing. The cache key
-    # includes the conf's serialized form, so editing hyperparameters
-    # (k, corruption_level, ...) between calls correctly retraces.
+    """Greedy pretrain of a MultiLayerNetwork's RBM/AE layers."""
+    for i, (conf, impl) in enumerate(zip(net.conf.confs, net._impls)):
+        if not isinstance(conf.layer, PRETRAIN_LAYER_TYPES):
+            continue
+
+        def get_input(ds, _i=i):
+            x = jnp.asarray(ds.features, net._dtype)
+            return _activate_to(net, _i, x)
+
+        _pretrain_unit(net, str(i), conf, impl, net._updaters[i],
+                       get_input, data_iter)
+
+
+def pretrain_graph(net, data_iter) -> None:
+    """Greedy pretrain of a ComputationGraph's pretrainable layer
+    vertices, in topological order (reference ComputationGraph.pretrain
+    :341-427)."""
+    from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
+
+    for name in net.order:
+        vertex = net.conf.vertices[name]
+        if not (isinstance(vertex, LayerVertex)
+                and isinstance(vertex.conf.layer, PRETRAIN_LAYER_TYPES)):
+            continue
+
+        def get_input(ds, _n=name):
+            return net._pretrain_input(_n, ds)
+
+        _pretrain_unit(net, name, vertex.conf, net._impls[name],
+                       net._updaters[name], get_input, data_iter)
+
+
+def _pretrain_unit(net, key_name, conf, impl, upd, get_input,
+                   data_iter) -> None:
+    """Pretrain one unit whose params live at net.params[key_name].
+
+    Jitted steps are cached on the network so repeated pretrain() calls
+    reuse the compiled executable instead of retracing. The cache key
+    includes the conf's serialized form, so editing hyperparameters
+    (k, corruption_level, ...) between calls correctly retraces.
+    """
     from deeplearning4j_tpu.nn.conf.serde import to_json as _conf_json
 
     cache = getattr(net, "_pretrain_step_cache", None)
     if cache is None:
         cache = net._pretrain_step_cache = {}
-    for i, (conf, impl) in enumerate(zip(net.conf.confs, net._impls)):
-        if not isinstance(conf.layer, PRETRAIN_LAYER_TYPES):
-            continue
-        key = (i, _conf_json(conf, indent=None))
-        step = cache.get(key)
-        if step is None:
-            step = cache[key] = _make_pretrain_step(net, i, conf, impl)
-        data_iter.reset()
-        n_iter = max(1, conf.num_iterations)
-        for ds in data_iter:
-            x = jnp.asarray(ds.features, net._dtype)
-            x_in = _activate_to(net, i, x)
-            for _ in range(n_iter):
-                net._key, sub = jax.random.split(net._key)
-                si = str(i)
-                # lr resolved host-side per call so conf edits between
-                # pretrain() passes take effect despite the cached jit.
-                lr = resolve_lr(conf, net.iteration)
-                (
-                    net.params[si],
-                    net.updater_state[si],
-                    score,
-                ) = step(net.params[si], net.updater_state[si],
-                         net.iteration, lr, sub, x_in)
-                net.score_value = score
-                net.iteration += 1
-                for listener in net.listeners:
-                    listener.iteration_done(net, net.iteration)
+    key = (key_name, _conf_json(conf, indent=None))
+    step = cache.get(key)
+    if step is None:
+        step = cache[key] = _make_pretrain_step(conf, impl, upd)
+    data_iter.reset()
+    n_iter = max(1, conf.num_iterations)
+    for ds in data_iter:
+        x_in = get_input(ds)
+        for _ in range(n_iter):
+            net._key, sub = jax.random.split(net._key)
+            # lr resolved host-side per call so conf edits between
+            # pretrain() passes take effect despite the cached jit.
+            lr = resolve_lr(conf, net.iteration)
+            (
+                net.params[key_name],
+                net.updater_state[key_name],
+                score,
+            ) = step(net.params[key_name], net.updater_state[key_name],
+                     net.iteration, lr, sub, x_in)
+            net.score_value = score
+            net.iteration += 1
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration)
 
 
 def _activate_to(net, layer_idx: int, x):
@@ -72,9 +106,7 @@ def _activate_to(net, layer_idx: int, x):
     return pp.pre_process(out) if pp is not None else out
 
 
-def _make_pretrain_step(net, i: int, conf, impl):
-    upd = net._updaters[i]
-
+def _make_pretrain_step(conf, impl, upd):
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(layer_params, upd_state, iteration, lr, rng, x):
         score, grads = impl.pretrain_value_and_grad(conf, layer_params, x, rng)

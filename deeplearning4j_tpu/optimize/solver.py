@@ -25,6 +25,15 @@ from deeplearning4j_tpu.optimize.terminations import DEFAULT_CONDITIONS
 Array = jax.Array
 
 
+def _first_conf(net):
+    """The conf holding the solver knobs: confs[0] for MultiLayerNetwork;
+    any layer-vertex conf for ComputationGraph (the knobs are global)."""
+    confs = getattr(net.conf, "confs", None)
+    if confs:
+        return confs[0]
+    return next(iter(net._layer_vertices.values())).conf
+
+
 def backtrack_line_search(
     f: Callable[[Array], float],
     x: Array,
@@ -62,26 +71,38 @@ class FlatProblem:
 
         net.init()
         self._net = net
-        self._feats = jnp.asarray(ds.features, net._dtype)
-        self._labels = jnp.asarray(ds.labels, net._dtype)
+        if hasattr(net, "_coerce_multi"):
+            # ComputationGraph: inputs is a {name: array} pytree and
+            # labels a per-output list — both jit-able arguments, and
+            # graph._loss_fn has the same arity as the MLN one.
+            (self._feats, self._labels, self._masks,
+             self._lmasks) = net._coerce_multi(ds)
+        else:
+            self._feats = jnp.asarray(ds.features, net._dtype)
+            self._labels = jnp.asarray(ds.labels, net._dtype)
+            self._masks = (None if ds.features_mask is None
+                           else jnp.asarray(ds.features_mask))
+            self._lmasks = (None if ds.labels_mask is None
+                            else jnp.asarray(ds.labels_mask))
         x0, unravel = ravel_pytree(net.params)
         self.x0 = x0
         self._unravel = unravel
 
         if not hasattr(net, "_flat_loss_cache"):
-            def loss_flat(flat, state, feats, labels):
+            def loss_flat(flat, state, feats, labels, masks, lmasks):
                 params = unravel(flat)
                 score, _ = net._loss_fn(
-                    params, state, None, feats, labels, None, None
+                    params, state, None, feats, labels, masks, lmasks
                 )
                 return score
 
-            def hvp(flat, v, state, feats, labels):
+            def hvp(flat, v, state, feats, labels, masks, lmasks):
                 # Hessian-vector product by forward-over-reverse autodiff
                 # — the jax-native form of the reference's R-op
                 # (MultiLayerNetwork.computeDeltasR :728 used by
                 # StochasticHessianFree.java)
-                g = lambda f: jax.grad(loss_flat)(f, state, feats, labels)
+                g = lambda f: jax.grad(loss_flat)(
+                    f, state, feats, labels, masks, lmasks)
                 return jax.jvp(g, (flat,), (v,))[1]
 
             net._flat_loss_cache = (
@@ -92,14 +113,16 @@ class FlatProblem:
         self._vag, self._val, self._hvp = net._flat_loss_cache
 
     def value_and_grad(self, flat):
-        return self._vag(flat, self._net.state, self._feats, self._labels)
+        return self._vag(flat, self._net.state, self._feats, self._labels,
+                         self._masks, self._lmasks)
 
     def value(self, flat):
-        return self._val(flat, self._net.state, self._feats, self._labels)
+        return self._val(flat, self._net.state, self._feats, self._labels,
+                         self._masks, self._lmasks)
 
     def hessian_vector_product(self, flat, v):
         return self._hvp(flat, v, self._net.state, self._feats,
-                         self._labels)
+                         self._labels, self._masks, self._lmasks)
 
     def write_back(self, flat: Array) -> None:
         self._net.params = self._unravel(flat)
@@ -115,7 +138,7 @@ class BaseOptimizer:
         from deeplearning4j_tpu.optimize import stepfunctions
 
         self.net = net
-        conf = net.conf.confs[0]
+        conf = _first_conf(net)
         self.max_iterations = max_iterations or conf.num_iterations
         self.max_ls_iterations = conf.max_num_line_search_iterations
         self.terminations = list(terminations)
@@ -322,9 +345,10 @@ class Solver:
         self.net = net
 
     def optimize(self, ds) -> float:
-        algo = self.net.conf.confs[0].optimization_algo
+        algo = _first_conf(self.net).optimization_algo
         if algo == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
-            self.net._fit_batch(ds)
+            fit = getattr(self.net, "_fit_batch", None) or self.net._fit_one
+            fit(ds)
             return float(self.net.score_value)
         try:
             cls = _OPTIMIZERS[algo]

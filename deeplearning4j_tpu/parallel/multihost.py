@@ -57,10 +57,18 @@ def initialize_multihost(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError:
-        # already initialized by the caller (the pattern the JAX docs
-        # recommend on pods) — treat as ours and carry on
-        pass
+    except RuntimeError as e:
+        # Only "already initialized by the caller" is benign (the pattern
+        # the JAX docs recommend on pods); current JAX phrases it
+        # "distributed.initialize should only be called once.", older
+        # builds "already initialized". Any other bootstrap failure —
+        # bad coordinator address, barrier timeout — must propagate:
+        # swallowing it would silently degrade a pod run into N
+        # independent single-process runs that all believe they are chief.
+        msg = str(e).lower()
+        if ("only be called once" not in msg
+                and "already initialized" not in msg):
+            raise
     _initialized = True
     log.info("jax.distributed up: process %d/%d, %d local / %d global devices",
              jax.process_index(), jax.process_count(),

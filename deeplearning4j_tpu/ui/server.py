@@ -136,10 +136,12 @@ async function poll(k, serverCount){
     const off = fetched[k]||0;
     const s = await (await fetch('/series?key='+encodeURIComponent(k)+
                                  '&offset='+off)).json();
-    // count what we actually received, not the /keys snapshot: points
-    // appended between /keys and /series would otherwise be re-fetched
-    // and duplicated next tick
-    fetched[k] = off + s.points.length;
+    // count from the server-reported start, not our requested offset:
+    // a server that trimmed past our offset returns start > off, and
+    // assuming points began at off would re-fetch and duplicate the
+    // retained series next tick
+    fetched[k] = (typeof s.start === 'number' ? s.start : off) +
+                 s.points.length;
     let pts = (history[k]||[]).concat(s.points);
     if (pts.length > KEEP) pts = pts.slice(-KEEP);
     history[k] = pts;
@@ -174,8 +176,9 @@ class _Handler(JsonHandler):
         elif parsed.path == "/series":
             key = qs.get("key", [""])[0]
             if "offset" in qs:
-                self.send_json({"points": self.storage.get_from(
-                    key, int(qs["offset"][0]))})
+                start, points = self.storage.get_window(
+                    key, int(qs["offset"][0]))
+                self.send_json({"points": points, "start": start})
             else:
                 since = int(qs.get("since", ["-1"])[0])
                 self.send_json({"points": self.storage.get(key, since)})

@@ -49,10 +49,18 @@ class HistoryStorage:
         """Points appended at global position >= offset — count-based
         incremental polling that stays correct across iteration resets
         and duplicate iteration numbers (offsets account for trimming)."""
+        return self.get_window(key, offset)[1]
+
+    def get_window(self, key: str, offset: int = 0):
+        """(start, points) where ``start`` is the actual global append
+        position of points[0]. When the requested offset has been trimmed
+        away, start > offset is returned so clients can resynchronise
+        their counters instead of double-counting the retained series."""
         with self._lock:
             series = self._series.get(key, [])
             dropped = self._appended.get(key, 0) - len(series)
-            return list(series[max(0, offset - dropped):])
+            local = max(0, offset - dropped)
+            return dropped + local, list(series[local:])
 
     def counts(self) -> Dict[str, int]:
         """Total points appended per key (monotone unless the storage is

@@ -232,3 +232,31 @@ class TestGraphGradients:
                 denom = abs(analytic[i]) + abs(num)
                 if denom > 1e-8:
                     assert abs(analytic[i] - num) / denom < 1e-3
+
+
+class TestGraphFitScanGuards:
+    """fit_scan is the plain-SGD full-BPTT fast path; mis-configured
+    graphs must raise instead of silently training wrong (ADVICE r1)."""
+
+    def test_rejects_tbptt(self):
+        from deeplearning4j_tpu.nn.conf.enums import BackpropType
+
+        conf = _simple_graph_conf()
+        conf.backprop_type = BackpropType.TRUNCATED_BPTT
+        graph = ComputationGraph(conf)
+        x = np.zeros((2, 4, 4), np.float32)
+        y = np.zeros((2, 4, 3), np.float32)
+        with pytest.raises(ValueError, match="truncated-BPTT"):
+            graph.fit_scan(x, y)
+
+    def test_rejects_non_sgd(self):
+        from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
+        conf = _simple_graph_conf()
+        for v in conf.vertices.values():
+            v.conf.optimization_algo = OptimizationAlgorithm.LBFGS
+        graph = ComputationGraph(conf)
+        x = np.zeros((2, 4, 4), np.float32)
+        y = np.zeros((2, 4, 3), np.float32)
+        with pytest.raises(ValueError, match="only supports SGD"):
+            graph.fit_scan(x, y)

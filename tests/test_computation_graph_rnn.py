@@ -1,0 +1,447 @@
+"""ComputationGraph recurrent-training parity tests.
+
+Pattern from reference nn/graph/ComputationGraphTestRNN.java (SURVEY.md
+§4): rnnTimeStep streaming equals the full forward pass, truncated BPTT
+windows the time axis and carries state, and graph pretraining trains
+unsupervised vertices. Plus the non-SGD Solver routing the reference
+reaches through Solver.java from ComputationGraph.fit.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.enums import BackpropType, OptimizationAlgorithm
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    DuplicateToTimeSeriesVertex,
+    MergeVertex,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+RNG = np.random.default_rng(7)
+
+
+def _rnn_graph_conf(tbptt=False, window=5):
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(42)
+        .learning_rate(0.05)
+        .activation("tanh")
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("lstm", L.GravesLSTM(n_in=3, n_out=4), "in")
+        .add_layer(
+            "out",
+            L.RnnOutputLayer(
+                n_in=4, n_out=2, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+            "lstm",
+        )
+        .set_outputs("out")
+    )
+    if tbptt:
+        b = (b.backprop_type(BackpropType.TRUNCATED_BPTT)
+             .t_bptt_forward_length(window)
+             .t_bptt_backward_length(window))
+    return b.build()
+
+
+def _seq_data(n=4, t=20):
+    x = RNG.normal(size=(n, 3, t)).astype(np.float32)
+    y = np.zeros((n, 2, t), np.float32)
+    y[np.arange(n)[:, None], RNG.integers(0, 2, (n, t)), np.arange(t)[None, :]] = 1.0
+    return x, y
+
+
+class TestGraphStreaming:
+    def test_rnn_time_step_matches_full_forward(self):
+        graph = ComputationGraph(_rnn_graph_conf()).init()
+        x, _ = _seq_data(n=2, t=5)
+        full = np.asarray(graph.output(x)[0])
+        graph.rnn_clear_previous_state()
+        step_outs = []
+        for t in range(5):
+            out = graph.rnn_time_step(x[:, :, t])[0]
+            step_outs.append(np.asarray(out))
+        stepped = np.stack(step_outs, axis=2)
+        np.testing.assert_allclose(full, stepped, atol=1e-5)
+
+    def test_three_d_chunks_match_full_forward(self):
+        """Streaming in uneven 3-D chunks (reference
+        testRnnTimeStepMultipleCalls pattern)."""
+        graph = ComputationGraph(_rnn_graph_conf()).init()
+        x, _ = _seq_data(n=2, t=9)
+        full = np.asarray(graph.output(x)[0])
+        graph.rnn_clear_previous_state()
+        chunks = [x[:, :, 0:4], x[:, :, 4:7], x[:, :, 7:9]]
+        got = np.concatenate(
+            [np.asarray(graph.rnn_time_step(c)[0]) for c in chunks], axis=2)
+        np.testing.assert_allclose(full, got, atol=1e-5)
+
+    def test_clear_state_resets(self):
+        graph = ComputationGraph(_rnn_graph_conf()).init()
+        x = RNG.normal(size=(1, 3)).astype(np.float32)
+        a = np.asarray(graph.rnn_time_step(x)[0])
+        b = np.asarray(graph.rnn_time_step(x)[0])
+        assert not np.allclose(a, b)  # state carried across calls
+        graph.rnn_clear_previous_state()
+        c = np.asarray(graph.rnn_time_step(x)[0])
+        np.testing.assert_allclose(a, c, atol=1e-6)
+
+
+class TestGraphTBPTT:
+    def test_tbptt_trains_and_windows(self):
+        graph = ComputationGraph(_rnn_graph_conf(tbptt=True, window=5))
+        x, y = _seq_data(n=4, t=20)
+        graph.fit(DataSet(x, y))
+        # 20 timesteps / window 5 = 4 optimizer iterations.
+        assert graph.iteration == 4
+        assert np.isfinite(float(graph.score_value))
+
+    def test_tbptt_state_carry_differs_from_independent_windows(self):
+        """Window k>0 must see the carried LSTM state, not a zero state:
+        compare against training each window as an independent sequence."""
+        x, y = _seq_data(n=4, t=10)
+        carried = ComputationGraph(_rnn_graph_conf(tbptt=True, window=5))
+        carried.fit(DataSet(x, y))
+        independent = ComputationGraph(_rnn_graph_conf())
+        for s in (0, 5):
+            independent.fit(DataSet(x[:, :, s:s + 5], y[:, :, s:s + 5]))
+        p1 = np.asarray(carried.params_flat())
+        p2 = np.asarray(independent.params_flat())
+        assert not np.allclose(p1, p2)
+
+    def test_tbptt_with_mask_and_static_input(self):
+        """Multi-input graph: one temporal input, one static (2-D) input
+        fed whole into every window; feature masks sliced per window."""
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.05)
+            .activation("tanh")
+            .graph_builder()
+            .add_inputs("seq", "static")
+            .add_layer("lstm", L.GravesLSTM(n_in=3, n_out=4), "seq")
+            .add_vertex(
+                "static_t",
+                DuplicateToTimeSeriesVertex(reference_input="seq"),
+                "static",
+            )
+            .add_layer(
+                "out",
+                L.RnnOutputLayer(
+                    n_in=6, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+                "merge",
+            )
+            .add_vertex("merge", MergeVertex(), "lstm", "static_t")
+            .set_outputs("out")
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .t_bptt_forward_length(4)
+            .t_bptt_backward_length(4)
+            .build()
+        )
+        graph = ComputationGraph(conf)
+        x, y = _seq_data(n=3, t=8)
+        static = RNG.normal(size=(3, 2)).astype(np.float32)
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+        mds = MultiDataSet([x, static], [y])
+        graph.fit(mds)
+        assert graph.iteration == 2  # 8 / 4 windows
+        assert np.isfinite(float(graph.score_value))
+
+
+class TestGraphPretrain:
+    def test_pretrain_trains_ae_vertex(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer(
+                "ae",
+                L.AutoEncoder(n_in=6, n_out=4, corruption_level=0.3),
+                "in",
+            )
+            .add_layer(
+                "out",
+                L.OutputLayer(
+                    n_in=4, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+                "ae",
+            )
+            .set_outputs("out")
+            .pretrain(True)
+            .build()
+        )
+        graph = ComputationGraph(conf).init()
+        x = RNG.normal(size=(16, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, 16)]
+        it = ListDataSetIterator([DataSet(x, y)], batch_size=16)
+        before = np.asarray(graph.params["ae"]["W"]).copy()
+        out_before = np.asarray(graph.params["out"]["W"]).copy()
+        graph.pretrain(it)
+        after = np.asarray(graph.params["ae"]["W"])
+        out_after = np.asarray(graph.params["out"]["W"])
+        assert not np.allclose(before, after)  # AE vertex pretrained
+        np.testing.assert_allclose(out_before, out_after)  # output untouched
+        assert np.isfinite(float(graph.score_value))
+
+    def test_fit_iterator_runs_pretrain_then_backprop(self):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("ae", L.AutoEncoder(n_in=6, n_out=4), "in")
+            .add_layer(
+                "out",
+                L.OutputLayer(
+                    n_in=4, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+                "ae",
+            )
+            .set_outputs("out")
+            .pretrain(True)
+            .build()
+        )
+        graph = ComputationGraph(conf).init()
+        x = RNG.normal(size=(16, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, 16)]
+        it = ListDataSetIterator([DataSet(x, y)], batch_size=16)
+        out_before = np.asarray(graph.params["out"]["W"]).copy()
+        graph.fit(it)
+        # backprop phase after pretrain must train the output layer too
+        assert not np.allclose(out_before, np.asarray(graph.params["out"]["W"]))
+
+
+class TestGraphSolver:
+    @pytest.mark.parametrize(
+        "algo",
+        [OptimizationAlgorithm.LBFGS,
+         OptimizationAlgorithm.CONJUGATE_GRADIENT],
+        ids=["lbfgs", "cg"],
+    )
+    def test_non_sgd_fit_reduces_score(self, algo):
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.1)
+            .optimization_algo(algo)
+            .iterations(10)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", L.DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+            .add_layer(
+                "out",
+                L.OutputLayer(
+                    n_in=8, n_out=3, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+                "d",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        graph = ComputationGraph(conf).init()
+        from deeplearning4j_tpu.datasets.iris import iris_dataset
+
+        ds = iris_dataset()
+        ds.normalize_zero_mean_unit_variance()
+        s0 = graph.score(ds)
+        graph.fit(ds)
+        assert graph.score(ds) < s0
+        assert graph.iteration > 0
+
+
+class TestTbpttStatefulVertices:
+    def test_mln_tbptt_updates_batchnorm_state(self):
+        """Stateful layers (BN running mean/var) must update during tBPTT
+        (reference updates stateful layers in doTruncatedBPTT too)."""
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            FeedForwardToRnnPreProcessor,
+            RnnToFeedForwardPreProcessor,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.05)
+            .activation("tanh")
+            .list()
+            .layer(0, L.GravesLSTM(n_in=3, n_out=4))
+            .layer(1, L.BatchNormalization(n_in=4, n_out=4))
+            .layer(
+                2,
+                L.RnnOutputLayer(
+                    n_in=4, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+            )
+            .input_pre_processor(1, RnnToFeedForwardPreProcessor())
+            .input_pre_processor(2, FeedForwardToRnnPreProcessor())
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .t_bptt_forward_length(5)
+            .t_bptt_backward_length(5)
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        m0 = np.asarray(net.state["1"]["mean"]).copy()
+        x, y = _seq_data(n=4, t=10)
+        net.fit(DataSet(x, y))
+        m1 = np.asarray(net.state["1"]["mean"])
+        assert not np.allclose(m0, m1), "BN running mean never updated"
+
+    def test_graph_tbptt_updates_batchnorm_state(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import LastTimeStepVertex
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.05)
+            .activation("tanh")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", L.GravesLSTM(n_in=3, n_out=4), "in")
+            .add_vertex("last", LastTimeStepVertex(mask_input="in"), "lstm")
+            .add_layer("bn", L.BatchNormalization(n_in=4, n_out=4), "last")
+            .add_layer(
+                "out",
+                L.OutputLayer(
+                    n_in=4, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+                "bn",
+            )
+            .set_outputs("out")
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .t_bptt_forward_length(5)
+            .t_bptt_backward_length(5)
+            .build()
+        )
+        graph = ComputationGraph(conf).init()
+        m0 = np.asarray(graph.state["bn"]["mean"]).copy()
+        x, _ = _seq_data(n=4, t=10)
+        y2 = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, 4)]
+        graph.fit(DataSet(x, y2))
+        m1 = np.asarray(graph.state["bn"]["mean"])
+        assert not np.allclose(m0, m1), "BN running mean never updated"
+
+
+class TestSolverMasks:
+    def test_lbfgs_respects_masks(self):
+        """Masked (padded) timesteps must not influence non-SGD training:
+        perturbing features at masked positions must leave the LBFGS
+        trajectory unchanged."""
+        def make():
+            return ComputationGraph(
+                NeuralNetConfiguration.Builder()
+                .seed(42)
+                .learning_rate(0.1)
+                .optimization_algo(OptimizationAlgorithm.LBFGS)
+                .iterations(3)
+                .activation("tanh")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", L.GravesLSTM(n_in=3, n_out=4), "in")
+                .add_layer(
+                    "out",
+                    L.RnnOutputLayer(
+                        n_in=4, n_out=2, activation="softmax",
+                        loss_function=LossFunction.MCXENT,
+                    ),
+                    "lstm",
+                )
+                .set_outputs("out")
+                .build()
+            )
+
+        x, y = _seq_data(n=4, t=6)
+        fm = np.ones((4, 6), np.float32)
+        fm[:, 4:] = 0.0  # last two steps padded
+        g1 = make()
+        g1.fit(DataSet(x, y, fm, fm.copy()))
+        noisy = x + 100.0 * (1.0 - fm[:, None, :])
+        g2 = make()
+        g2.fit(DataSet(noisy, y, fm, fm.copy()))
+        np.testing.assert_allclose(
+            np.asarray(g1.params_flat()), np.asarray(g2.params_flat()),
+            rtol=1e-5, atol=1e-6)
+
+
+class TestMixedRankStreaming:
+    def test_mixed_rank_inputs_keep_time_axis(self):
+        """2-D + 3-D inputs in one rnn_time_step call: the 3-D output
+        must keep its full time axis (reference squeezes only when ALL
+        inputs are 2-D)."""
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .activation("tanh")
+            .graph_builder()
+            .add_inputs("seq", "static")
+            .add_layer("lstm", L.GravesLSTM(n_in=3, n_out=4), "seq")
+            .add_vertex(
+                "static_t",
+                DuplicateToTimeSeriesVertex(reference_input="seq"),
+                "static",
+            )
+            .add_vertex("merge", MergeVertex(), "lstm", "static_t")
+            .add_layer(
+                "out",
+                L.RnnOutputLayer(
+                    n_in=6, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+                "merge",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        graph = ComputationGraph(conf).init()
+        seq = RNG.normal(size=(2, 3, 5)).astype(np.float32)
+        static = RNG.normal(size=(2, 2)).astype(np.float32)
+        out = graph.rnn_time_step(seq, static)[0]
+        assert out.shape == (2, 2, 5)  # full time axis preserved
+
+
+class TestGraphPretrainUnlabeled:
+    def test_pretrain_accepts_feature_only_datasets(self):
+        """Unsupervised pretraining takes unlabeled data (labels=None),
+        like MultiLayerNetwork.pretrain."""
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("ae", L.AutoEncoder(n_in=6, n_out=4), "in")
+            .add_layer(
+                "out",
+                L.OutputLayer(
+                    n_in=4, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT,
+                ),
+                "ae",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        graph = ComputationGraph(conf).init()
+        x = RNG.normal(size=(16, 6)).astype(np.float32)
+        it = ListDataSetIterator([DataSet(x, None)], batch_size=16)
+        w0 = np.asarray(graph.params["ae"]["W"]).copy()
+        graph.pretrain(it)
+        assert not np.allclose(w0, np.asarray(graph.params["ae"]["W"]))

@@ -82,7 +82,13 @@ class TestLabelAwareIterators:
 
     def test_labels_source_fixed(self):
         src = LabelsSource(labels=["X", "Y"])
-        assert [src.next_label() for _ in range(4)] == ["X", "Y", "X", "Y"]
+        assert [src.next_label() for _ in range(2)] == ["X", "Y"]
+        # More documents than fixed labels is an error (the reference
+        # errors too) — silently wrapping would mislabel documents.
+        with pytest.raises(IndexError):
+            src.next_label()
+        src.reset()
+        assert src.next_label() == "X"
 
 
 class TestSentenceIteratorCombinators:

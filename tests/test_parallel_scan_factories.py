@@ -260,3 +260,38 @@ class TestMultiHost:
                 assert "host-0" not in server.state.workers  # deregistered
         finally:
             server.stop()
+
+    def test_bootstrap_failure_propagates(self, monkeypatch):
+        """A genuine jax.distributed failure (bad coordinator, timeout)
+        must raise, not silently degrade into N single-process runs that
+        all think they are chief."""
+        import deeplearning4j_tpu.parallel.multihost as mh
+
+        monkeypatch.setattr(mh, "_initialized", False)
+
+        def boom(**kw):
+            raise RuntimeError("barrier timed out connecting to coordinator")
+
+        monkeypatch.setattr(mh.jax.distributed, "initialize", boom)
+        with pytest.raises(RuntimeError, match="barrier timed out"):
+            mh.initialize_multihost(coordinator_address="10.0.0.1:1234",
+                                    num_processes=2, process_id=0)
+        assert mh._initialized is False
+
+    def test_bootstrap_already_initialized_is_benign(self, monkeypatch):
+        import deeplearning4j_tpu.parallel.multihost as mh
+
+        monkeypatch.setattr(mh, "_initialized", False)
+
+        def already(**kw):
+            # the message current JAX actually raises on double-init
+            # (jax/_src/distributed.py)
+            raise RuntimeError(
+                "distributed.initialize should only be called once.")
+
+        monkeypatch.setattr(mh.jax.distributed, "initialize", already)
+        assert mh.initialize_multihost(
+            coordinator_address="10.0.0.1:1234",
+            num_processes=1, process_id=0) == 0
+        assert mh._initialized is True
+        monkeypatch.setattr(mh, "_initialized", False)

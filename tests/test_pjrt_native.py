@@ -172,3 +172,29 @@ def test_trained_network_served_natively(tmp_path):
     assert r2.returncode == 0, (r2.stdout.decode()[-300:],
                                 r2.stderr.decode()[-1500:])
     assert b"NATIVE_SERVING_OK" in r2.stdout
+
+
+def test_export_computation_graph_serializes():
+    """Regression: graph branch of export_network_for_native must track
+    ComputationGraph._forward_fn's 3-tuple return (serialize-only — no
+    native client needed)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.native_rt.pjrt import export_network_for_native
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    conf = (NeuralNetConfiguration.Builder().seed(5).learning_rate(0.1)
+            .graph_builder().add_inputs("in")
+            .add_layer("d", L.DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                       "in")
+            .add_layer("out", L.OutputLayer(
+                n_in=8, n_out=3, activation="softmax",
+                loss_function=LossFunction.MCXENT), "d")
+            .set_outputs("out").build())
+    graph = ComputationGraph(conf).init()
+    code, copts = export_network_for_native(
+        graph, np.zeros((2, 4), np.float32))
+    assert len(code) > 0 and len(copts) > 0
