@@ -1,8 +1,17 @@
-"""Benchmark driver: prints ONE JSON line for the round harness.
+"""Benchmark driver: prints one JSON line per BASELINE config; the final
+line is the headline row the round harness parses.
 
-Config: BASELINE.json configs[0] — MLP 784-500-10 on MNIST, the reference's
-MultiLayerNetwork.fit hot loop (reference nn/multilayer/
-MultiLayerNetwork.java:1130). Metric: training examples/sec/chip.
+Configs (BASELINE.json):
+- configs[1] — LeNet-5 on MNIST, the reference's im2col+GEMM conv path
+  (reference nn/layers/convolution/ConvolutionLayer.java:135) as MXU
+  convolutions.
+- configs[0] — MLP 784-500-10 on MNIST, the reference's
+  MultiLayerNetwork.fit hot loop (reference nn/multilayer/
+  MultiLayerNetwork.java:1130). This is the headline (printed last).
+
+Metric: training examples/sec/chip, plus an analytic MFU estimate
+(model FLOPs / v5e peak bf16 ~197 TFLOP/s) so the harness tracks
+efficiency, not just throughput.
 
 ``vs_baseline`` compares against an ESTIMATED reference figure: the
 reference publishes no numbers (BASELINE.md), so we use 3000 examples/sec
@@ -18,12 +27,38 @@ import time
 import numpy as np
 
 REFERENCE_CPU_EXAMPLES_PER_SEC = 3000.0  # estimated; none published
-BATCH = 2048
-SCAN_STEPS = 64   # steps fused into one XLA computation via lax.scan
-TIMED_CALLS = 80  # timed scan invocations (= 5120 optimizer steps)
+# A CPU conv net is far slower than the MLP: LeNet is ~5.8x the
+# FLOPs/example and im2col+GEMM on 2015 nd4j-native has no MXU to
+# amortize it, so use a proportionally scaled stand-in.
+REFERENCE_CPU_LENET_EXAMPLES_PER_SEC = 500.0  # estimated; none published
+V5E_PEAK_BF16_FLOPS = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
+
+# Train-step FLOPs/example ~= 3x forward (fwd + bwd-activations +
+# bwd-weights), matmul/conv MACs only.
+MLP_FLOPS_PER_EXAMPLE = 3 * 2 * (784 * 500 + 500 * 10)
+LENET_FLOPS_PER_EXAMPLE = 3 * 2 * (
+    20 * 5 * 5 * 1 * 24 * 24      # conv1: 1->20ch, 24x24 out
+    + 50 * 5 * 5 * 20 * 8 * 8     # conv2: 20->50ch, 8x8 out
+    + 800 * 500                   # dense
+    + 500 * 10                    # output
+)
 
 
-def main() -> None:
+def _run(net, feats, labels, timed_calls, scan_steps, batch):
+    # Warm up + compile; the value fetch (not just block_until_ready) is
+    # the reliable sync point across PJRT transports.
+    float(np.asarray(net.fit_scan(feats, labels)[-1]))
+
+    t0 = time.perf_counter()
+    for _ in range(timed_calls):
+        scores = net.fit_scan(feats, labels)
+    final = float(np.asarray(scores[-1]))  # force completion of the chain
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final)
+    return timed_calls * scan_steps * batch / dt
+
+
+def bench_mlp():
     import jax
 
     from deeplearning4j_tpu.datasets.mnist import mnist_dataset
@@ -31,6 +66,8 @@ def main() -> None:
     from deeplearning4j_tpu.nn.conf import layers as L
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.ops.losses import LossFunction
+
+    batch, scan_steps, timed_calls = 2048, 64, 80
 
     conf = (
         NeuralNetConfiguration.Builder()
@@ -55,43 +92,67 @@ def main() -> None:
     )
     net = MultiLayerNetwork(conf).init()
 
-    ds = mnist_dataset(train=True, num_examples=BATCH * 8)
-    batches = ds.batch_by(BATCH)
+    ds = mnist_dataset(train=True, num_examples=batch * 8)
+    batches = ds.batch_by(batch)
 
-    # SCAN_STEPS batches pre-stacked on device: the whole optimizer loop
+    # scan_steps batches pre-stacked on device: the whole optimizer loop
     # over them is ONE lax.scan computation — a single host dispatch per
     # 64 steps, so the measurement reflects chip throughput rather than
     # dispatch latency over the host link.
-    reps = (SCAN_STEPS + len(batches) - 1) // len(batches)
+    reps = (scan_steps + len(batches) - 1) // len(batches)
     feats = jax.device_put(
-        np.stack([b.features for b in batches] * reps)[:SCAN_STEPS])
+        np.stack([b.features for b in batches] * reps)[:scan_steps])
     labels = jax.device_put(
-        np.stack([b.labels for b in batches] * reps)[:SCAN_STEPS])
+        np.stack([b.labels for b in batches] * reps)[:scan_steps])
 
-    # Warm up + compile; the value fetch (not just block_until_ready) is
-    # the reliable sync point across PJRT transports.
-    float(np.asarray(net.fit_scan(feats, labels)[-1]))
+    ex_s = _run(net, feats, labels, timed_calls, scan_steps, batch)
+    return {
+        "metric": "mnist_mlp_784_500_10_train_throughput",
+        "value": round(ex_s, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(ex_s / REFERENCE_CPU_EXAMPLES_PER_SEC, 2),
+        "mfu": round(ex_s * MLP_FLOPS_PER_EXAMPLE / V5E_PEAK_BF16_FLOPS, 4),
+    }
 
-    t0 = time.perf_counter()
-    for _ in range(TIMED_CALLS):
-        scores = net.fit_scan(feats, labels)
-    final = float(np.asarray(scores[-1]))  # force completion of the chain
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final)
 
-    examples_per_sec = TIMED_CALLS * SCAN_STEPS * BATCH / dt
-    print(
-        json.dumps(
-            {
-                "metric": "mnist_mlp_784_500_10_train_throughput",
-                "value": round(examples_per_sec, 1),
-                "unit": "examples/sec/chip",
-                "vs_baseline": round(
-                    examples_per_sec / REFERENCE_CPU_EXAMPLES_PER_SEC, 2
-                ),
-            }
-        )
-    )
+def bench_lenet():
+    import jax
+
+    from deeplearning4j_tpu.datasets.mnist import mnist_dataset
+    from deeplearning4j_tpu.models.zoo import lenet5
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch, scan_steps, timed_calls = 2048, 64, 20
+
+    conf = lenet5()
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+    net = MultiLayerNetwork(conf).init()
+
+    ds = mnist_dataset(train=True, num_examples=batch * 8)
+    batches = ds.batch_by(batch)
+    reps = (scan_steps + len(batches) - 1) // len(batches)
+    feats = np.stack(
+        [b.features for b in batches] * reps)[:scan_steps]
+    feats = jax.device_put(feats.reshape(scan_steps, batch, 1, 28, 28))
+    labels = jax.device_put(
+        np.stack([b.labels for b in batches] * reps)[:scan_steps])
+
+    ex_s = _run(net, feats, labels, timed_calls, scan_steps, batch)
+    return {
+        "metric": "mnist_lenet5_train_throughput",
+        "value": round(ex_s, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(
+            ex_s / REFERENCE_CPU_LENET_EXAMPLES_PER_SEC, 2),
+        "mfu": round(
+            ex_s * LENET_FLOPS_PER_EXAMPLE / V5E_PEAK_BF16_FLOPS, 4),
+    }
+
+
+def main() -> None:
+    print(json.dumps(bench_lenet()))
+    print(json.dumps(bench_mlp()))  # headline: last line is parsed
 
 
 if __name__ == "__main__":
