@@ -184,6 +184,75 @@ def transformer_lm(
     return b.remat(remat).build()
 
 
+def moe_transformer_lm(
+    n_in: int = 64,
+    width: int = 128,
+    n_blocks: int = 2,
+    n_heads: int = 4,
+    n_classes: int = 64,
+    n_experts: int = 8,
+    n_hidden: int = 0,
+    capacity_factor: float = 1.25,
+    top_k: int = 1,
+    lr: float = 1e-3,
+    seed: int = 12345,
+    ring_axis=None,
+    ep_axis=None,
+    remat: bool = False,
+):
+    """Mixture-of-experts transformer: each block is causal multi-head
+    self-attention followed by a residual capacity-routed MoE FFN
+    (nn/layers/moe.py). ``ep_axis`` shards experts over that mesh axis
+    with explicit all-to-all dispatch (parallel/expert_parallel.py);
+    ``ring_axis`` adds ring-attention sequence parallelism — the two
+    compose for the dryrun's ep mesh."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        MultiHeadSelfAttention,
+    )
+    from deeplearning4j_tpu.nn.layers.moe import MoeDense
+
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(Updater.ADAM)
+        .activation("identity")
+        .weight_init(WeightInit.XAVIER)
+        .list()
+    )
+    li = 0
+    for blk in range(n_blocks):
+        b.layer(
+            li,
+            MultiHeadSelfAttention(
+                n_in=n_in if blk == 0 else width,
+                n_out=width,
+                n_heads=n_heads,
+                causal=True,
+                ring_axis=ring_axis,
+            ),
+        )
+        li += 1
+        b.layer(
+            li,
+            MoeDense(
+                n_in=width, n_out=width,
+                n_experts=n_experts, n_hidden=n_hidden,
+                capacity_factor=capacity_factor, top_k=top_k,
+                ep_axis=ep_axis,
+            ),
+        )
+        li += 1
+    b.layer(
+        li,
+        L.RnnOutputLayer(
+            n_in=width, n_out=n_classes, activation="softmax",
+            loss_function=LossFunction.MCXENT,
+        ),
+    )
+    return b.remat(remat).build()
+
+
 def dbn(
     sizes: Sequence[int] = (784, 500, 250, 10),
     lr: float = 0.05,

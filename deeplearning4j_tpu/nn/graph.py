@@ -246,7 +246,19 @@ class ComputationGraph:
                 out = _cast_floating(out, dtype=self._dtype)  # loss in f32
             score = score + impl.loss(v.conf, out, y, lm)
         score = score + self._reg_score(params)
+        score = score + self._aux_score(new_state)
         return score, (new_state, new_rnn)
+
+    def _aux_score(self, new_state):
+        """Auxiliary training losses vertices emit through the state
+        channel (MoeDense load-balancing loss), gate-weighted per conf."""
+        aux = 0.0
+        for name, v in self._layer_vertices.items():
+            w = getattr(v.conf.layer, "aux_weight", None)
+            st = new_state.get(name) if new_state else None
+            if w and st and "aux_loss" in st:
+                aux = aux + w * st["aux_loss"]
+        return aux
 
     def _reg_score(self, params):
         reg = 0.0

@@ -21,6 +21,7 @@ from deeplearning4j_tpu.nn.layers import (
     convolution,
     dense,
     embedding,
+    moe,
     normalization,
     pretrain,
     recurrent,
@@ -43,6 +44,7 @@ _IMPLS = {
     L.AutoEncoder: pretrain.AutoEncoderImpl,
     L.RecursiveAutoEncoder: pretrain.AutoEncoderImpl,
     attention.MultiHeadSelfAttention: attention.AttentionImpl,
+    moe.MoeDense: moe.MoeDenseImpl,
 }
 
 

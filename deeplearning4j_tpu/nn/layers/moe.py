@@ -1,0 +1,100 @@
+"""Mixture-of-experts dense layer (conf bean + impl).
+
+NEW capability relative to the reference (SURVEY.md §2.7 expert-
+parallelism mandate): a capacity-factored top-k MoE FFN block that slots
+into a MultiLayerNetwork/ComputationGraph stack next to attention layers
+(models/zoo.py ``moe_transformer_lm``). Dispatch math lives in
+parallel/expert_parallel.py; this layer adapts it to the framework's
+layer contract:
+
+- accepts [N, C] feed-forward or [N, C, T] recurrent activations
+  (tokens = N·T);
+- the load-balancing auxiliary loss is returned through the layer-state
+  channel (``{"aux_loss": ...}``) and added to the training score by
+  MultiLayerNetwork._loss_fn weighted by ``aux_weight`` — the same
+  functional-state route BatchNormalization uses for running stats;
+- ``ep_axis`` names a mesh axis for explicit all-to-all expert
+  parallelism when the surrounding train step runs under shard_map
+  (same convention as MultiHeadSelfAttention.ring_axis), with
+  ``W_up/W_down`` holding the local expert slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import FeedForwardLayer
+from deeplearning4j_tpu.nn.conf.serde import register_bean
+from deeplearning4j_tpu.nn.layers.base import LayerImplBase
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.parallel.expert_parallel import moe_apply
+
+
+@register_bean("MoeDense")
+@dataclasses.dataclass
+class MoeDense(FeedForwardLayer):
+    """Conf bean: n_in must equal n_out (the block is residual-shaped:
+    route -> expert FFN (n_in -> n_hidden -> n_out) -> combine [+ x])."""
+
+    n_experts: int = 8
+    n_hidden: int = 0           # 0 => 4 * n_in
+    capacity_factor: float = 1.25
+    top_k: int = 1
+    aux_weight: float = 0.01    # weight of the load-balancing loss
+    residual: bool = True
+    ep_axis: Optional[str] = None  # expert-parallel mesh axis
+
+
+class MoeDenseImpl(LayerImplBase):
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        lc = conf.layer
+        if lc.n_out and lc.n_out != lc.n_in:
+            raise ValueError(
+                f"MoeDense needs n_in == n_out, got {lc.n_in}/{lc.n_out}")
+        d, e = lc.n_in, lc.n_experts
+        h = lc.n_hidden or 4 * d
+        kr, ku, kd = jax.random.split(key, 3)
+        scheme = conf.resolved("weight_init")
+        dist = conf.resolved("dist")
+        return {
+            "router": init_weights(kr, (d, e), scheme, dist, dtype),
+            "W_up": init_weights(ku, (e, d, h), scheme, dist, dtype),
+            "W_down": init_weights(kd, (e, h, d), scheme, dist, dtype),
+        }
+
+    @classmethod
+    def init_state(cls, conf, dtype=jnp.float32):
+        # Registers the layer in the state pytree so _forward_fn threads
+        # the per-batch aux loss out to _loss_fn.
+        return {"aux_loss": jnp.zeros((), dtype)}
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None,
+              mask=None):
+        lc = conf.layer
+        x = cls.maybe_dropout(conf, x, train, rng)
+        recurrent = x.ndim == 3  # [N, C, T]
+        if recurrent:
+            n, c, t = x.shape
+            tokens = jnp.transpose(x, (0, 2, 1)).reshape(n * t, c)
+        else:
+            tokens = x
+        y, aux = moe_apply(
+            params, tokens,
+            capacity_factor=lc.capacity_factor,
+            top_k=lc.top_k,
+            ep_axis=lc.ep_axis,
+        )
+        if lc.residual:
+            y = y + tokens
+        y = cls.activation_of(conf)(y)
+        if recurrent:
+            y = jnp.transpose(y.reshape(n, t, c), (0, 2, 1))
+            if mask is not None:
+                y = y * mask[:, None, :]
+        return y, {"aux_loss": aux}

@@ -205,6 +205,7 @@ class MultiLayerNetwork:
             out = _cast_floating(out, dtype=self._dtype)  # loss in f32
         score = impl.loss(out_conf, out, labels, label_mask)
         score = score + self._reg_score(params)
+        score = score + self._aux_score(new_state)
         return score, new_state
 
     def _reg_score(self, params):
@@ -224,6 +225,17 @@ class MultiLayerNetwork:
                 if l2:
                     reg = reg + 0.5 * l2 * jnp.sum(p * p)
         return reg
+
+    def _aux_score(self, new_state):
+        """Auxiliary training losses layers emit through the state
+        channel (MoeDense load-balancing loss), gate-weighted per conf."""
+        aux = 0.0
+        for i, c in enumerate(self.conf.confs):
+            w = getattr(c.layer, "aux_weight", None)
+            st = new_state.get(str(i)) if new_state else None
+            if w and st and "aux_loss" in st:
+                aux = aux + w * st["aux_loss"]
+        return aux
 
     # ------------------------------------------------------------------
     # The jitted train step (whole §3.1 stack as one XLA computation)
@@ -451,6 +463,7 @@ class MultiLayerNetwork:
             impl = self._impls[-1]
             score = impl.loss(self.conf.confs[-1], out, y, lm)
             score = score + self._reg_score(params)
+            score = score + self._aux_score(new_state)
             return score, (new_state, new_rnn)
 
         def step(params, state, upd_state, iteration, rng, f, y, fm, lm,

@@ -10,12 +10,24 @@ collective permutes, giving the symmetric backward pipeline.
 
 Expressed entirely as shard_map + fori_loop: per-device FLOPs drop to 1/S
 of the model, bubble fraction = (S-1)/(M+S-1), exactly the GPipe schedule.
+
+Two levels:
+- ``pipeline_apply`` / ``make_pipelined_mlp``: the raw schedule on a
+  homogeneous hand-built stage function.
+- ``PipelineTrainer``: full integration with conf-built
+  MultiLayerNetworks — heterogeneous layer widths (stage-boundary
+  activations are flattened and padded to a common hop-buffer width),
+  per-layer preprocessors, the configured loss on the last stage,
+  microbatch gradient accumulation (GPipe sync semantics: grads sum over
+  microbatches before one updater step), and the network's own updaters
+  — so a PP-trained net follows the single-device trajectory exactly.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable
+import math
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +35,17 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 Array = jax.Array
+
+
+def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """GPipe idle fraction: (S-1)/(M+S-1) — each device computes M of
+    the M+S-1 schedule ticks."""
+    return (n_stages - 1) / (n_microbatches + n_stages - 1)
+
+
+def schedule_ticks(n_stages: int, n_microbatches: int) -> int:
+    """Total pipeline ticks for M microbatches through S stages."""
+    return n_microbatches + n_stages - 1
 
 
 def pipeline_apply(
@@ -122,3 +145,289 @@ def make_pipelined_mlp(
         out_specs=P(),
         check_vma=False,
     )
+
+
+def partition_stages(net, n_stages: int) -> List[Tuple[int, int]]:
+    """Contiguous layer ranges, greedily balanced by parameter count
+    (heterogeneous widths welcome). Requires n_layers >= n_stages."""
+    n_layers = net.n_layers
+    if n_layers < n_stages:
+        raise ValueError(
+            f"{n_layers} layers cannot fill {n_stages} pipeline stages")
+    counts = []
+    for i in range(n_layers):
+        leaves = jax.tree.leaves(net.params[str(i)])
+        counts.append(max(1, sum(int(math.prod(p.shape)) for p in leaves)))
+    target = sum(counts) / n_stages
+    ranges: List[Tuple[int, int]] = []
+    start, acc = 0, 0.0
+    for i, c in enumerate(counts):
+        acc += c
+        layers_left = n_layers - (i + 1)
+        stages_left = n_stages - len(ranges) - 1
+        if stages_left == 0:
+            continue
+        if acc >= target or layers_left == stages_left:
+            ranges.append((start, i + 1))
+            start, acc = i + 1, 0.0
+    ranges.append((start, n_layers))
+    return ranges
+
+
+class PipelineTrainer:
+    """GPipe-train a conf-built MultiLayerNetwork over the mesh's ``pp``
+    axis.
+
+    The network's layers are partitioned into S = mesh.shape[pp] contiguous
+    stages (``stage_ranges`` or parameter-count balanced). Each optimizer
+    step runs the microbatched pipeline forward, computes the configured
+    loss on the last stage, accumulates gradients across all M microbatches
+    (summed by AD through the schedule loop — GPipe's synchronous
+    semantics), all-reduces the per-stage partial grads over ``pp``, and
+    applies the network's own updaters — so the parameter trajectory
+    matches single-device ``net.fit`` on the same batches to numerical
+    tolerance (asserted in tests/test_pipeline_expert.py).
+
+    Stage-boundary activations are flattened and right-padded to the
+    widest boundary so the ``lax.ppermute`` hop buffer is homogeneous;
+    each stage unpads/reshapes on ingest. Params are replicated across
+    the mesh (in_spec P()); compute is partitioned — device s only
+    executes its stage's branch of the ``lax.switch``, giving per-device
+    FLOPs ~1/S and the (S-1)/(M+S-1) bubble of the schedule.
+
+    Aux-emitting layers (MoeDense) are supported: per-stage weighted aux
+    losses are accumulated over the valid microbatch window and psum-ed
+    into the training loss (the aux statistic is computed per microbatch,
+    so MoE trajectories match single-device in expectation rather than
+    bit-for-bit).
+
+    Limitations (documented, enforced): plain-SGD-family training only
+    (no tBPTT, no second-order solvers), no running-state layers
+    (BatchNormalization statistics are per-microbatch quantities), no
+    feature/label masks.
+    """
+
+    def __init__(
+        self,
+        net,
+        mesh: Mesh,
+        pp_axis: str = "pp",
+        n_microbatches: int = 4,
+        stage_ranges: Optional[Sequence[Tuple[int, int]]] = None,
+    ):
+        from deeplearning4j_tpu.nn.conf.enums import (
+            BackpropType,
+            OptimizationAlgorithm,
+        )
+
+        net.init()
+        for si, st in (net.state or {}).items():
+            # Aux-only state (MoeDense load-balance loss) is step-local
+            # and threaded into the pipeline loss below; true running
+            # statistics are microbatch-local quantities we can't carry.
+            if not (isinstance(st, dict) and set(st) <= {"aux_loss"}):
+                lname = type(net.conf.confs[int(si)].layer).__name__
+                raise ValueError(
+                    "PipelineTrainer does not support layers with "
+                    f"running state (layer {si}: {lname})")
+        if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            raise ValueError("PipelineTrainer does not support tBPTT")
+        algo = net.conf.confs[0].optimization_algo
+        if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            raise ValueError(
+                "PipelineTrainer requires STOCHASTIC_GRADIENT_DESCENT "
+                f"(got {algo})")
+        self.net = net
+        self.mesh = mesh
+        self.pp_axis = pp_axis
+        self.n_stages = int(mesh.shape[pp_axis])
+        self.n_microbatches = int(n_microbatches)
+        self.stage_ranges = list(
+            stage_ranges if stage_ranges is not None
+            else partition_stages(net, self.n_stages))
+        if len(self.stage_ranges) != self.n_stages:
+            raise ValueError(
+                f"{len(self.stage_ranges)} stage ranges for "
+                f"{self.n_stages} pipeline devices")
+        flat = [i for s, e in self.stage_ranges for i in range(s, e)]
+        if flat != list(range(net.n_layers)):
+            raise ValueError(
+                f"stage ranges {self.stage_ranges} must cover layers "
+                f"0..{net.n_layers - 1} contiguously")
+        self._step_cache = {}
+
+    # -- stage math ----------------------------------------------------
+    def _apply_stage(self, s: int, params, x, rngs, train=True):
+        """Apply layers [start, end) of stage s (with preprocessors).
+        Returns (activations, weighted aux-loss sum of the stage)."""
+        net = self.net
+        start, end = self.stage_ranges[s]
+        aux = jnp.zeros((), net._dtype)
+        for i in range(start, end):
+            c = net.conf.confs[i]
+            pp = net.conf.preprocessor_for(i)
+            if pp is not None:
+                x = pp.pre_process(x, rngs[i] if train else None)
+            x, st = net._impls[i].apply(
+                c, params[str(i)], x,
+                state=None, train=train, rng=rngs[i], mask=None,
+            )
+            w = getattr(c.layer, "aux_weight", None)
+            if w and isinstance(st, dict) and "aux_loss" in st:
+                aux = aux + w * st["aux_loss"].astype(net._dtype)
+        return x, aux
+
+    def _boundary_shapes(self, feats_mb_shape):
+        """Activation shape entering each stage (index 0 = input)."""
+        net = self.net
+        shapes = [feats_mb_shape]
+        x = jax.ShapeDtypeStruct(feats_mb_shape, net._dtype)
+        rngs = [None] * net.n_layers
+        for s in range(self.n_stages):
+            x = jax.eval_shape(
+                lambda xx, _s=s: self._apply_stage(
+                    _s, net.params, xx, rngs, train=False)[0], x)
+            shapes.append(x.shape)
+        return shapes
+
+    # -- the jitted step ----------------------------------------------
+    def _build_step(self, feats_shape, labels_shape):
+        net = self.net
+        S, M = self.n_stages, self.n_microbatches
+        axis = self.pp_axis
+        B = feats_shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mb = B // M
+        feats_mb_shape = (mb,) + tuple(feats_shape[1:])
+        shapes = self._boundary_shapes(feats_mb_shape)
+        widths = [int(math.prod(sh[1:])) for sh in shapes]
+        K = max(widths[1:])  # hop-buffer width (boundaries + final out)
+        out_conf = net.conf.confs[-1]
+        out_impl = net._impls[-1]
+        cd = net._compute_dtype
+
+        def branch(s):
+            in_shape = shapes[s]
+
+            def run(params, x_feed, buf, y_mb, rngs):
+                if s == 0:
+                    xin = x_feed
+                else:
+                    w = widths[s]
+                    xin = buf[:, :w].reshape(in_shape)
+                y, aux = self._apply_stage(s, params, xin, rngs)
+                if s == S - 1:
+                    yl = y
+                    if cd is not None:
+                        yl = yl.astype(net._dtype)
+                    loss = out_impl.loss(out_conf, yl, y_mb, None)
+                else:
+                    loss = jnp.zeros((), net._dtype)
+                yf = y.reshape(mb, -1)
+                yf = jnp.pad(yf, ((0, 0), (0, K - yf.shape[1])))
+                return yf, loss, aux
+
+            return run
+
+        branches = [branch(s) for s in range(S)]
+
+        def local_loss(params, feats, labels, rng):
+            idx = lax.axis_index(axis)
+            if cd is not None:
+                from deeplearning4j_tpu.nn.multilayer import _cast_floating
+                params = jax.tree.map(
+                    functools.partial(_cast_floating, dtype=cd), params)
+                feats = feats.astype(cd)
+            x_mbs = feats.reshape((M, mb) + feats.shape[1:])
+            y_mbs = labels.reshape((M, mb) + labels.shape[1:])
+            hop_dtype = cd if cd is not None else net._dtype
+            buf0 = jnp.zeros((mb, K), hop_dtype)
+            loss0 = jnp.zeros((), net._dtype)
+
+            def tick(t, carry):
+                buf, loss_acc, aux_acc = carry
+                # Stage idx processes microbatch t - idx at tick t; fold
+                # the microbatch index into the rng so each microbatch
+                # draws distinct dropout masks.
+                mb_idx = jnp.clip(t - idx, 0, M - 1)
+                rngs = list(jax.random.split(
+                    jax.random.fold_in(rng, mb_idx), net.n_layers))
+                feed = x_mbs[jnp.minimum(t, M - 1)]
+                out_t = jnp.maximum(t - (S - 1), 0)
+                y_mb = y_mbs[out_t]
+                yf, loss, aux = lax.switch(
+                    idx, branches, params, feed, buf, y_mb, rngs)
+                write = (idx == S - 1) & (t - (S - 1) >= 0)
+                loss_acc = loss_acc + jnp.where(write, loss, 0.0)
+                # Stage idx holds a REAL microbatch only for ticks in
+                # [idx, idx + M); warmup/drain garbage must not leak
+                # into the aux loss.
+                valid = (t >= idx) & (t < idx + M)
+                aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+                perm = [(i, (i + 1) % S) for i in range(S)]
+                buf = lax.ppermute(yf, axis, perm)
+                return buf, loss_acc, aux_acc
+
+            _, loss_sum, aux_sum = lax.fori_loop(
+                0, M + S - 1, tick, (buf0, loss0, loss0))
+            # Only the last stage accumulated the loss; aux accumulated
+            # per stage. Microbatch losses are per-mb means -> batch mean
+            # = mean of the M microbatch means (equal sizes). NB the MoE
+            # aux loss is computed per microbatch here vs per batch
+            # single-device: a nonlinear statistic, so trajectories with
+            # MoE layers match in expectation, not bit-for-bit.
+            # psum(aux_sum) = sum over stages of their layers' aux over M
+            # microbatches = sum over mb of the net's total aux.
+            return (lax.psum(loss_sum, axis)
+                    + lax.psum(aux_sum, axis)) / M
+
+        pipe_loss = shard_map(
+            local_loss,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+
+        def step(params, upd_state, iteration, rng, feats, labels):
+            def loss_fn(p):
+                return pipe_loss(p, feats, labels, rng) + net._reg_score(p)
+
+            score, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_upd = net._apply_updates(
+                params, upd_state, grads, iteration)
+            return new_params, new_upd, score
+
+        return jax.jit(step)
+
+    # -- public API ----------------------------------------------------
+    def fit(self, data, labels=None) -> float:
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        net = self.net
+        if labels is not None:
+            data = DataSet(data, labels)
+        batches = [data] if isinstance(data, DataSet) else data
+        score = float("nan")
+        for ds in batches:
+            if ds.features_mask is not None or ds.labels_mask is not None:
+                raise ValueError(
+                    "PipelineTrainer does not support masked datasets")
+            feats = jnp.asarray(ds.features, net._dtype)
+            labs = jnp.asarray(ds.labels, net._dtype)
+            key = (feats.shape, labs.shape)
+            if key not in self._step_cache:
+                self._step_cache[key] = self._build_step(
+                    feats.shape, labs.shape)
+            net._key, sub = jax.random.split(net._key)
+            net.params, net.updater_state, s = self._step_cache[key](
+                net.params, net.updater_state, net.iteration, sub,
+                feats, labs,
+            )
+            net.score_value = s
+            net.iteration += 1
+            score = float(s)
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration)
+        return score

@@ -6,8 +6,12 @@ import numpy as np
 
 from deeplearning4j_tpu.parallel.expert_parallel import (
     ep_param_shardings,
+    expert_capacity,
     init_moe_params,
+    make_ep_moe,
     moe_apply,
+    moe_apply_dense,
+    route_top_k,
 )
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 from deeplearning4j_tpu.parallel.pipeline_parallel import make_pipelined_mlp
@@ -102,3 +106,345 @@ class TestExpertParallel:
         assert y.shape == (256, 8)
         # Aux loss near 1.0 indicates roughly uniform routing at init.
         assert 0.5 < float(aux) < 4.0
+
+
+class TestCapacityRouting:
+    """Capacity-factored dispatch (the real EP: FLOPs independent of E)."""
+
+    def _setup(self, B=64, E=4, D=8, H=16, seed=0):
+        params = init_moe_params(
+            jax.random.key(seed), n_experts=E, d_in=D, d_hidden=H
+        )
+        x = jnp.asarray(
+            np.random.default_rng(seed).normal(size=(B, D)), jnp.float32
+        )
+        return params, x
+
+    def test_capacity_matches_dense_when_undropped(self):
+        """With capacity_factor = E no token can be dropped, so capacity
+        dispatch must reproduce the dense one-hot reference exactly."""
+        params, x = self._setup()
+        y_cap, aux_cap = moe_apply(params, x, capacity_factor=4.0)
+        y_dense, aux_dense = moe_apply_dense(params, x)
+        np.testing.assert_allclose(
+            np.asarray(y_cap), np.asarray(y_dense), atol=1e-5
+        )
+        np.testing.assert_allclose(float(aux_cap), float(aux_dense),
+                                   atol=1e-5)
+
+    def test_over_capacity_tokens_dropped(self):
+        """All tokens routed to one expert + capacity 1 => exactly one
+        token is served; dropped tokens combine to zero."""
+        params, x = self._setup(B=8, E=2)
+        # Rig the router so every token picks expert 0.
+        params["router"] = jnp.zeros_like(params["router"]).at[:, 0].set(0.0)
+        params["router"] = params["router"].at[0, 0].set(100.0)
+        x = jnp.abs(x).at[:, 0].set(1.0)  # positive first feature
+        dispatch, combine, aux = route_top_k(
+            x.astype(jnp.float32) @ params["router"], capacity=1
+        )
+        assert float(jnp.sum(dispatch)) == 1.0  # one slot filled
+        y, _ = moe_apply(params, x, capacity_factor=1.0 / 8)
+        served = np.asarray(jnp.any(jnp.abs(y) > 0, axis=-1))
+        assert served.sum() == 1 and served[0]
+
+    def test_flops_independent_of_expert_count(self):
+        """Compiled FLOPs of the capacity path stay ~flat as E doubles
+        (the dense path scales ×E) — the defining EP property."""
+
+        def flops(fn, *args):
+            c = jax.jit(fn).lower(*args).compile()
+            (analysis,) = [c.cost_analysis()] if isinstance(
+                c.cost_analysis(), dict) else [c.cost_analysis()[0]]
+            return analysis["flops"]
+
+        dense_f, cap_f = [], []
+        for E in (4, 8, 16):
+            params, x = self._setup(B=128, E=E, D=32, H=64)
+            cap_f.append(flops(
+                lambda p, xx: moe_apply(p, xx, capacity_factor=1.0)[0],
+                params, x))
+            dense_f.append(flops(
+                lambda p, xx: moe_apply_dense(p, xx)[0], params, x))
+        assert dense_f[-1] > 3.0 * dense_f[0]  # dense: ~x4 from E=4->16
+        assert cap_f[-1] < 1.5 * cap_f[0]      # capacity: ~flat
+
+    def test_top2_gates_renormalized(self):
+        """Top-2: output = renormalized-gate-weighted sum of the two
+        chosen experts' FFNs (checked against a direct computation)."""
+        params, x = self._setup(B=16, E=4)
+        y, _ = moe_apply(params, x, capacity_factor=4.0, top_k=2)
+
+        probs = jax.nn.softmax(x @ params["router"], axis=-1)
+        top2 = jnp.argsort(probs, axis=-1)[:, -2:][:, ::-1]
+        expect = []
+        for b in range(x.shape[0]):
+            acc = 0.0
+            denom = float(probs[b, top2[b, 0]] + probs[b, top2[b, 1]])
+            for j in range(2):
+                e = int(top2[b, j])
+                h = jax.nn.relu(x[b] @ params["W_up"][e])
+                acc = acc + float(probs[b, e]) / denom * (
+                    h @ params["W_down"][e])
+            expect.append(acc)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(jnp.stack(expect)), atol=1e-4
+        )
+
+    def test_expert_capacity_bounds(self):
+        assert expert_capacity(64, 4, 1.0) == 16
+        assert expert_capacity(64, 4, 1.25) == 20
+        assert expert_capacity(4, 8, 1.0) == 1   # floor at 1
+        assert expert_capacity(8, 2, 99.0) == 8  # cap at n_tokens
+
+
+class TestAllToAllExpertParallel:
+    """Explicit shard_map EP: two lax.all_to_all exchanges over ``ep``."""
+
+    def test_matches_single_device_moe(self):
+        mesh = make_mesh(MeshSpec({"ep": 4}))
+        E, D, H, B = 8, 8, 16, 32
+        params = init_moe_params(
+            jax.random.key(0), n_experts=E, d_in=D, d_hidden=H
+        )
+        x = jnp.asarray(
+            np.random.default_rng(1).normal(size=(B, D)), jnp.float32
+        )
+        fn = make_ep_moe(mesh, "ep", capacity_factor=float(E))
+        params_ep = jax.device_put(params, ep_param_shardings(mesh, "ep"))
+        x_ep = jax.device_put(x, NamedSharding(mesh, P("ep", None)))
+        y_ep, aux_ep = jax.jit(fn)(params_ep, x_ep)
+        # Undropped capacity => exact agreement with the global capacity
+        # path (and hence with the dense reference, by the parity test).
+        y_ref, _ = moe_apply(params, x, capacity_factor=float(E))
+        np.testing.assert_allclose(
+            np.asarray(y_ep), np.asarray(y_ref), atol=1e-5
+        )
+
+    def test_dp_ep_mesh_training_step(self):
+        mesh = make_mesh(MeshSpec({"dp": 2, "ep": 4}))
+        E, D, H, B = 4, 8, 16, 32
+        params = jax.device_put(
+            init_moe_params(jax.random.key(0), n_experts=E, d_in=D,
+                            d_hidden=H),
+            ep_param_shardings(mesh, "ep"),
+        )
+        fn = make_ep_moe(mesh, "ep", token_axes=("dp", "ep"),
+                         capacity_factor=2.0)
+        rng = np.random.default_rng(5)
+        x = jax.device_put(
+            jnp.asarray(rng.normal(size=(B, D)), jnp.float32),
+            NamedSharding(mesh, P(("dp", "ep"), None)),
+        )
+        y_target = jnp.asarray(rng.normal(size=(B, D)), jnp.float32)
+
+        @jax.jit
+        def step(params, x, y):
+            def loss(p):
+                out, aux = fn(p, x)
+                return jnp.mean((out - y) ** 2) + 0.01 * aux
+
+            l, g = jax.value_and_grad(loss)(params)
+            return jax.tree.map(lambda p, gg: p - 0.1 * gg, params, g), l
+
+        l0 = None
+        for _ in range(20):
+            params, l = step(params, x, y_target)
+            if l0 is None:
+                l0 = float(l)
+        assert float(l) < l0, (l0, float(l))
+
+
+class TestMoeLayer:
+    """MoeDense conf layer inside a MultiLayerNetwork (models/zoo.py
+    moe_transformer_lm)."""
+
+    def _seq_data(self, n=8, c=16, t=12, k=8, seed=1):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, c, t)).astype(np.float32)
+        y = np.zeros((n, k, t), np.float32)
+        idx = rng.integers(0, k, (n, t))
+        for i in range(n):
+            y[i, idx[i], np.arange(t)] = 1.0
+        return DataSet(x, y)
+
+    def test_moe_transformer_trains(self):
+        from deeplearning4j_tpu.models.zoo import moe_transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = moe_transformer_lm(
+            n_in=16, width=16, n_blocks=1, n_heads=2, n_classes=8,
+            n_experts=4, n_hidden=32, lr=1e-2,
+        )
+        net = MultiLayerNetwork(conf).init()
+        ds = self._seq_data()
+        scores = []
+        for _ in range(15):
+            net.fit(ds)
+            scores.append(float(net.score_value))
+        assert scores[-1] < scores[0], scores
+
+    def test_aux_loss_reaches_score(self):
+        """The training score must include aux_weight * load-balance loss
+        (plumbed through the layer-state channel)."""
+        from deeplearning4j_tpu.models.zoo import moe_transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        def build(aux_w):
+            conf = moe_transformer_lm(
+                n_in=16, width=16, n_blocks=1, n_heads=2, n_classes=8,
+                n_experts=4, n_hidden=32,
+            )
+            for c in conf.confs:
+                if hasattr(c.layer, "aux_weight"):
+                    c.layer.aux_weight = aux_w
+            return MultiLayerNetwork(conf).init()
+
+        ds = self._seq_data()
+        net0, net_big = build(0.0), build(10.0)
+        net0.fit(ds)
+        net_big.fit(ds)
+        s0, s_big = float(net0.score_value), float(net_big.score_value)
+        # aux ~ 1 at uniform routing, so the weighted gap must show up.
+        assert s_big > s0 + 1.0, (s0, s_big)
+
+    def test_moe_bean_json_roundtrip(self):
+        from deeplearning4j_tpu.models.zoo import moe_transformer_lm
+        from deeplearning4j_tpu.nn.conf.multi_layer import (
+            MultiLayerConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers.moe import MoeDense
+
+        conf = moe_transformer_lm(n_in=8, width=8, n_blocks=1, n_heads=2,
+                                  n_classes=4, n_experts=4, top_k=2)
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        moes = [c.layer for c in back.confs if isinstance(c.layer, MoeDense)]
+        assert len(moes) == 1
+        assert moes[0].n_experts == 4 and moes[0].top_k == 2
+
+
+class TestPipelineTrainer:
+    """Conf-built MultiLayerNetwork through the GPipe schedule."""
+
+    def _mnist_like(self, n=32, seed=0):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, 784)).astype(np.float32)
+        y = np.zeros((n, 10), np.float32)
+        y[np.arange(n), rng.integers(0, 10, n)] = 1.0
+        return DataSet(x, y)
+
+    def test_matches_single_device_trajectory(self):
+        """PP-trained MNIST MLP must track single-device net.fit on the
+        same batches: same seed, same updaters, tolerance-level equality
+        (VERDICT round-1 acceptance criterion)."""
+        from deeplearning4j_tpu.models.zoo import mlp
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        sizes = (784, 256, 128, 64, 10)  # heterogeneous widths, 4 layers
+        net_pp = MultiLayerNetwork(mlp(sizes, lr=0.05)).init()
+        net_sd = MultiLayerNetwork(mlp(sizes, lr=0.05)).init()
+        mesh = make_mesh(MeshSpec({"pp": 4}))
+        trainer = PipelineTrainer(net_pp, mesh, n_microbatches=4)
+
+        for step in range(5):
+            ds = self._mnist_like(seed=step)
+            s_pp = trainer.fit(ds)
+            net_sd.fit(ds)
+            assert abs(s_pp - float(net_sd.score_value)) < 1e-4, step
+        for k in net_sd.params:
+            for name in net_sd.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(net_pp.params[k][name]),
+                    np.asarray(net_sd.params[k][name]),
+                    rtol=1e-4, atol=1e-5,
+                )
+
+    def test_bubble_fraction_of_schedule(self):
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            bubble_fraction,
+            schedule_ticks,
+        )
+
+        S, M = 4, 4
+        ticks = schedule_ticks(S, M)
+        assert ticks == M + S - 1 == 7
+        # Each device computes M useful ticks of the M+S-1 total.
+        assert bubble_fraction(S, M) == (ticks - M) / ticks == 3 / 7
+        # More microbatches shrink the bubble (GPipe's lever).
+        assert bubble_fraction(S, 16) < bubble_fraction(S, 4)
+
+    def test_partition_balances_param_counts(self):
+        from deeplearning4j_tpu.models.zoo import mlp
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            partition_stages,
+        )
+
+        net = MultiLayerNetwork(mlp((784, 256, 128, 64, 10))).init()
+        ranges = partition_stages(net, 2)
+        assert len(ranges) == 2
+        assert ranges[0][0] == 0 and ranges[-1][1] == net.n_layers
+        # Layer 0 holds ~75% of params: it must sit alone in stage 0.
+        assert ranges[0] == (0, 1)
+
+    def test_rejects_stateful_and_masked(self):
+        import pytest
+
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.ops.losses import LossFunction
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .list()
+            .layer(0, L.DenseLayer(n_in=8, n_out=8, activation="relu"))
+            .layer(1, L.BatchNormalization(n_in=8, n_out=8))
+            .layer(2, L.OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                    loss_function=LossFunction.MCXENT))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        with pytest.raises(ValueError, match="running state"):
+            PipelineTrainer(net, mesh)
+
+    def test_moe_network_through_pipeline(self):
+        """MoeDense (aux-only state) composes with PipelineTrainer: the
+        aux loss reaches the pipelined score and training descends."""
+        from deeplearning4j_tpu.models.zoo import moe_transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        conf = moe_transformer_lm(
+            n_in=12, width=12, n_blocks=1, n_heads=2, n_classes=6,
+            n_experts=2, n_hidden=16, lr=1e-2,
+        )
+        net = MultiLayerNetwork(conf).init()
+        mesh = make_mesh(MeshSpec({"pp": 3}))  # attn | moe | rnn-out
+        trainer = PipelineTrainer(
+            net, mesh, n_microbatches=2,
+            stage_ranges=[(0, 1), (1, 2), (2, 3)],
+        )
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(8, 12, 5)).astype(np.float32)
+        y = np.zeros((8, 6, 5), np.float32)
+        idx = rng.integers(0, 6, (8, 5))
+        for i in range(8):
+            y[i, idx[i], np.arange(5)] = 1.0
+        ds = DataSet(x, y)
+        scores = [trainer.fit(ds) for _ in range(10)]
+        assert scores[-1] < scores[0], scores
