@@ -4,7 +4,10 @@ Mirror of reference nlp models/glove/{Glove.java:31, AbstractCoOccurrences,
 GloveWeightLookupTable}. The reference counts co-occurrences with an actor
 pipeline spilling to binary files and trains with per-element AdaGrad
 (Hogwild); here counting is a host-side dict pass (1/distance weighting,
-symmetric window) and training is a jitted batched AdaGrad scatter update.
+symmetric window) for in-RAM corpora, or the disk-spill counter
+(nlp/cooccurrence.py DiskBackedCoOccurrences, the AbstractCoOccurrences
+bounded-memory design) when ``max_pairs_in_memory`` is set; training is
+a jitted batched AdaGrad scatter update either way.
 """
 
 from __future__ import annotations
@@ -160,7 +163,35 @@ class Glove(SequenceVectors):
         self.syn0 = self.w + self.wt
         return float(loss)
 
-    def fit(self, sequences_factory) -> None:
+    def train_cooccurrence_batches(self, batches, learning_rate=None) -> float:
+        """One pass over an iterable of (rows, cols, xij) batches at a
+        fixed lr — the disk-streaming counterpart of
+        ``train_cooccurrences``, which shuffles each batch before its
+        scatter steps; peak memory is one batch + the tables. (The
+        reference streams its merged spill file sequentially too —
+        AbstractCoOccurrences.java:135.)"""
+        if not hasattr(self, "w"):
+            raise ValueError("init_tables() (or fit) must run first")
+        loss = 0.0
+        for rows, cols, xij in batches:
+            loss = self.train_cooccurrences(rows, cols, xij, learning_rate)
+        self.syn0 = self.w + self.wt
+        return loss
+
+    def fit(
+        self,
+        sequences_factory,
+        max_pairs_in_memory: int | None = None,
+        spill_dir: str | None = None,
+    ) -> None:
+        """``max_pairs_in_memory`` bounds counting memory: co-occurrence
+        counts spill to sorted disk shards past that many distinct pairs
+        and training streams the k-way merge per epoch (reference
+        AbstractCoOccurrences maxMemory knob)."""
+        from deeplearning4j_tpu.nlp.cooccurrence import (
+            DiskBackedCoOccurrences,
+        )
+
         seqs = (
             sequences_factory()
             if callable(sequences_factory)
@@ -170,6 +201,20 @@ class Glove(SequenceVectors):
         if self.vocab is None:
             self.vocab = build_vocab(seqs, self.min_word_frequency)
         self.init_tables()
-        rows, cols, xij = self._count_cooccurrences(seqs)
-        for _ in range(self.epochs):
-            self.losses.append(self.train_cooccurrences(rows, cols, xij))
+        if max_pairs_in_memory is None:
+            rows, cols, xij = self._count_cooccurrences(seqs)
+            for _ in range(self.epochs):
+                self.losses.append(
+                    self.train_cooccurrences(rows, cols, xij))
+            return
+        counter = DiskBackedCoOccurrences(
+            self.vocab, window=self.window, symmetric=self.symmetric,
+            max_pairs_in_memory=max_pairs_in_memory, spill_dir=spill_dir,
+        )
+        try:
+            counter.count_sequences(seqs)
+            for _ in range(self.epochs):
+                self.losses.append(self.train_cooccurrence_batches(
+                    counter.iter_batches(self.batch_size)))
+        finally:
+            counter.cleanup()

@@ -175,8 +175,16 @@ class GRU(BaseRecurrentLayer):
 @register_bean("ImageLSTM")
 @dataclasses.dataclass
 class ImageLSTM(BaseRecurrentLayer):
-    """Kept for subtype-registry parity (reference nn/conf/layers/
-    ImageLSTM.java); runtime implementation maps to GravesLSTM semantics."""
+    """Karpathy-style image-captioning LSTM (reference nn/conf/layers/
+    ImageLSTM.java + nn/layers/recurrent/ImageLSTM.java): time step 0 is
+    the image embedding, the remaining steps are word embeddings; the
+    decoder head drops the image step. ``n_hidden`` is the LSTM cell
+    width — the reference hard-codes 8 with a TODO to make it an
+    attribute (ImageLSTMParamInitializer.java:52); here it is one.
+    ``n_in`` is the embedding width, ``n_out`` the decoder (vocabulary)
+    width."""
+
+    n_hidden: int = 8
 
 
 @register_bean("EmbeddingLayer")

@@ -36,13 +36,13 @@ _IMPLS = {
     L.LocalResponseNormalization: normalization.LRNImpl,
     L.BatchNormalization: normalization.BatchNormImpl,
     L.GravesLSTM: recurrent.LSTMImpl,
-    L.ImageLSTM: recurrent.LSTMImpl,
+    L.ImageLSTM: recurrent.ImageLSTMImpl,
     L.GravesBidirectionalLSTM: recurrent.BiLSTMImpl,
     L.GRU: recurrent.GRUImpl,
     L.RnnOutputLayer: recurrent.RnnOutputImpl,
     L.RBM: pretrain.RBMImpl,
     L.AutoEncoder: pretrain.AutoEncoderImpl,
-    L.RecursiveAutoEncoder: pretrain.AutoEncoderImpl,
+    L.RecursiveAutoEncoder: pretrain.RecursiveAutoEncoderImpl,
     attention.MultiHeadSelfAttention: attention.AttentionImpl,
     moe.MoeDense: moe.MoeDenseImpl,
 }

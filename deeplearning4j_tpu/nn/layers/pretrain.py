@@ -184,3 +184,80 @@ class AutoEncoderImpl(LayerImplBase):
         return jax.value_and_grad(
             lambda p: cls.pretrain_loss(conf, p, x, rng)
         )(params)
+
+
+class RecursiveAutoEncoderImpl(LayerImplBase):
+    """Recursive autoencoder — backprop through structure (reference
+    nn/layers/feedforward/autoencoder/recursive/RecursiveAutoEncoder.java
+    + RecursiveParamInitializer.java: UNTIED encoder W [nIn, nOut] /
+    decoder U [nOut, nIn], hidden bias b, visible bias vb).
+
+    The reference's computeGradientAndScore (:102-160) greedily folds the
+    input rows: starting from the base pair [x0; x1], each step prepends
+    the next row to the running stack, encodes/decodes every row, and
+    adds 0.5 * mean((z - stack)^2) to the score (:155). Because encode/
+    decode act row-wise, row j's reconstruction error err_j appears in
+    every step whose stack contains it — steps have sizes m = 2..R, and
+    the step of size m contributes (1/m) * sum_{j<m} err_j. The score is
+    therefore computed here in closed form as sum_j w_j * err_j with
+    tail-harmonic weights w_j = sum_{m=max(j+1,2)}^{R} 1/m — one encoder
+    and one decoder matmul over all rows instead of the reference's
+    O(R^2) recomputation loop (the TPU-native restructuring).
+
+    Gradients are the exact autodiff of this score; the reference's
+    hand-written accumulation (:126-151) is explicitly marked "TODO
+    review code below to confirm computation" (:100) and mixes up its
+    own operand shapes, so the score — not that loop — is the parity
+    contract.
+    """
+
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        lc = conf.layer
+        kw, ku = jax.random.split(key)
+        scheme = conf.resolved("weight_init")
+        dist = conf.resolved("dist")
+        w = init_weights(kw, (lc.n_in, lc.n_out), scheme, dist, dtype)
+        u = init_weights(ku, (lc.n_out, lc.n_in), scheme, dist, dtype)
+        b = jnp.full((lc.n_out,), conf.resolved("bias_init"), dtype)
+        vb = jnp.full((lc.n_in,), lc.visible_bias_init, dtype)
+        return {"W": w, "U": u, "b": b, "vb": vb}
+
+    @classmethod
+    def encode(cls, conf, params, x):
+        return cls.activation_of(conf)(x @ params["W"] + params["b"])
+
+    @classmethod
+    def decode(cls, conf, params, y):
+        return cls.activation_of(conf)(y @ params["U"] + params["vb"])
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None,
+              mask=None):
+        # Reference activate(input) :81-84 — forward through a stack is
+        # the encoding.
+        x = cls.maybe_dropout(conf, x, train, rng)
+        return cls.encode(conf, params, x), state
+
+    @classmethod
+    def pretrain_loss(cls, conf, params, x, rng):
+        rows = x.shape[0]
+        if rows < 2:
+            raise ValueError(
+                "RecursiveAutoEncoder needs >= 2 rows to fold")
+        z = cls.decode(conf, params, cls.encode(conf, params, x))
+        err = 0.5 * jnp.mean((z - x) ** 2, axis=-1)  # [R] per-row
+        # w_j = sum_{m=max(j+1,2)}^{R} 1/m  (tail harmonic numbers)
+        m = jnp.arange(rows + 1, dtype=x.dtype)
+        inv = jnp.where(m >= 2, 1.0 / jnp.maximum(m, 1), 0.0)
+        # tail[k] = sum_{m=k}^{R} 1/m for k in 0..R
+        tail = jnp.cumsum(inv[::-1])[::-1]
+        lo = jnp.maximum(jnp.arange(rows) + 1, 2)
+        weights = tail[lo]
+        return jnp.sum(weights * err)
+
+    @classmethod
+    def pretrain_value_and_grad(cls, conf, params, x, rng):
+        return jax.value_and_grad(
+            lambda p: cls.pretrain_loss(conf, p, x, rng)
+        )(params)

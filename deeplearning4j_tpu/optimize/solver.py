@@ -44,17 +44,90 @@ def backtrack_line_search(
     initial_step: float = 1.0,
     c1: float = 1e-4,
     rho: float = 0.5,
+    minimize: bool = True,
 ) -> Tuple[float, float]:
-    """Armijo backtracking (reference BackTrackLineSearch.java).
-    Returns (step, f(x + step*direction))."""
-    slope = float(jnp.vdot(grad, direction))
-    step = initial_step
+    """Armijo/Wolfe backtracking (reference BackTrackLineSearch.java).
+    Returns (step, f(x + step*direction)).
+
+    ``minimize=False`` is the reference's sufficient-INCREASE branch
+    (BackTrackLineSearch.java:257-263) for score-ascent objectives — the
+    reference selects it by step-function type (:163,
+    minObjectiveFunction = stepFunction instanceof Negative*); here the
+    caller states the objective sense directly because this solver's
+    directions are always descent-oriented for minimize=True, and the
+    maximize formulas (:304-330) are the minimize ones applied to -f,
+    which is how they are evaluated here. Also mirrored: quadratic-then-
+    cubic interpolation backtracking (:278-303, Numerical Recipes
+    lnsrch) with the lambda in [0.1, 0.5]·lambda_prev clamp, best-step
+    tracking for the max-iterations exit (:239-245), and scaling back
+    non-finite jumps (:266-273). ``rho`` remains the fallback shrink
+    when interpolation degenerates.
+    """
+    sign = 1.0 if minimize else -1.0
+
+    def phi(s: float) -> float:
+        return sign * float(f(x + s * direction))
+
+    slope = sign * float(jnp.vdot(grad, direction))
+    phi0 = sign * float(fx)
+    step = float(initial_step)
+    step_prev = phi_prev = None
+    best_step, best_phi = 0.0, phi0
     for _ in range(max_iterations):
-        fnew = float(f(x + step * direction))
-        if fnew <= fx + c1 * step * slope:
-            return step, fnew
-        step *= rho
-    return step, float(f(x + step * direction))
+        phin = phi(step)
+        if not np.isfinite(phin):
+            # Jumped into unstable territory: scale back hard (:266-273)
+            # and restart the interpolation history.
+            step_prev = phi_prev = None
+            step *= 0.2
+            continue
+        if phin < best_phi:
+            best_step, best_phi = step, phin
+        if phin <= phi0 + c1 * step * slope:  # sufficient decrease of phi
+            return step, sign * phin
+        # Interpolation backtrack: quadratic on the first shrink, cubic
+        # through the last two points after.
+        if step_prev is None:
+            # First shrink: quadratic model. Clamped like the cubic
+            # branch — after a non-finite restart ``step`` may be < 1
+            # and the unclamped formula could jump back toward the
+            # divergent region.
+            denom = 2.0 * (phin - phi0 - slope)
+            tmp = -slope / denom if denom != 0.0 else rho * step
+            tmp = min(tmp, 0.5 * step)
+        else:
+            rhs1 = phin - phi0 - step * slope
+            rhs2 = phi_prev - phi0 - step_prev * slope
+            a = (rhs1 / step**2 - rhs2 / step_prev**2) / (step - step_prev)
+            b = (-step_prev * rhs1 / step**2
+                 + step * rhs2 / step_prev**2) / (step - step_prev)
+            if a == 0.0:
+                tmp = -slope / (2.0 * b) if b != 0.0 else rho * step
+            else:
+                disc = b * b - 3.0 * a * slope
+                if disc < 0.0:
+                    tmp = 0.5 * step
+                elif b <= 0.0:
+                    tmp = (-b + np.sqrt(disc)) / (3.0 * a)
+                else:
+                    tmp = -slope / (b + np.sqrt(disc))
+            tmp = min(tmp, 0.5 * step)  # lambda <= 0.5 lambda_1
+        step_prev, phi_prev = step, phin
+        if not np.isfinite(tmp):
+            tmp = rho * step
+        step = max(tmp, 0.1 * step)     # lambda >= 0.1 lambda_1
+    if best_step > 0.0:
+        # Max iterations: the best step observed (reference bestStepSize
+        # exit, :239-245).
+        return best_step, sign * best_phi
+    # Nothing improved: deliberate deviation from the reference's 0.0
+    # (keep params) — a zero step makes EpsTermination read the stalled
+    # score as converged on the spot, whereas taking the smallest probed
+    # step perturbs the iterate enough for CG/LBFGS to rebuild a descent
+    # direction and keep optimizing (observed on the convergence tests).
+    if step_prev is not None:
+        return step_prev, sign * phi_prev
+    return 0.0, fx
 
 
 class FlatProblem:
@@ -215,6 +288,12 @@ class ConjugateGradient(BaseOptimizer):
                 )
             )
             d = -grad + beta * self._prev_dir
+            if float(jnp.vdot(grad, d)) >= 0.0:
+                # Non-descent direction: restart with steepest descent —
+                # the reference reaches the same state through its
+                # zero-step path (gamma = max(0, 0) -> -g next round,
+                # ConjugateGradient.java:69-72).
+                d = -grad
         self._prev_grad = grad
         self._prev_dir = d
         return d

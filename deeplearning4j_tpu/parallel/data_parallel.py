@@ -142,6 +142,17 @@ class ParallelTrainer:
     def _shard_batch(self, arr):
         if arr is None:
             return None
+        if jax.process_count() > 1:
+            # Multi-host: the caller passes its HOST-LOCAL slice of the
+            # global batch (each host loads only its shard); assemble
+            # the global array from the per-host pieces.
+            from deeplearning4j_tpu.parallel.multihost import (
+                host_local_to_global,
+            )
+
+            return host_local_to_global(
+                np.asarray(arr, self.net._dtype), self.mesh,
+                P(self.dp_axis))
         return jax.device_put(
             jnp.asarray(arr, self.net._dtype),
             NamedSharding(self.mesh, P(self.dp_axis)),

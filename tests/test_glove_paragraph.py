@@ -125,3 +125,62 @@ class TestNlpRegressions:
         v = pv.infer_vector("sun day light")
         assert v.shape == (8,)
         assert np.isfinite(v).all()
+
+
+class TestDiskSpillCoOccurrences:
+    """Bounded-memory counting (reference AbstractCoOccurrences spill
+    design): tiny in-memory caps force multiple disk shards, and the
+    merged stream must reproduce the in-memory counts and vectors."""
+
+    def test_merged_counts_equal_in_memory(self, tmp_path):
+        from deeplearning4j_tpu.nlp.cooccurrence import (
+            DiskBackedCoOccurrences,
+        )
+        from deeplearning4j_tpu.nlp.vocab import build_vocab
+
+        corpus = [s.split() for s in _topic_corpus()]
+        vocab = build_vocab(corpus, 5)
+        glove = Glove(window=4, min_word_frequency=5)
+        glove.vocab = vocab
+        rows, cols, xij = glove._count_cooccurrences(corpus)
+        in_mem = {(int(r), int(c)): float(x)
+                  for r, c, x in zip(rows, cols, xij)}
+
+        counter = DiskBackedCoOccurrences(
+            vocab, window=4, max_pairs_in_memory=16,
+            spill_dir=str(tmp_path),
+        )
+        counter.count_sequences(corpus)
+        assert counter.n_shards() > 2  # the cap actually forced spills
+        spilled = {}
+        for r, c, x in counter.iter_batches(batch_size=100):
+            assert len(r) <= 100
+            for rr, cc, xx in zip(r, c, x):
+                key = (int(rr), int(cc))
+                assert key not in spilled  # merge summed duplicates
+                spilled[key] = float(xx)
+        assert spilled.keys() == in_mem.keys()
+        for k, val in in_mem.items():
+            np.testing.assert_allclose(spilled[k], val, rtol=1e-5)
+
+    def test_spill_training_matches_in_memory_vectors(self, tmp_path):
+        corpus = [s.split() for s in _topic_corpus()]
+
+        def make():
+            return Glove(
+                layer_size=8, window=4, min_word_frequency=5,
+                epochs=5, learning_rate=0.05, x_max=10.0, seed=1,
+            )
+
+        ref = make()
+        ref.fit(corpus)
+        spill = make()
+        # Cap of 16 distinct pairs: counting never holds the full map.
+        spill.fit(corpus, max_pairs_in_memory=16,
+                  spill_dir=str(tmp_path))
+        # One batch per epoch (batch 65536 >> pairs): the scatter update
+        # aggregates the whole batch, so pair order is immaterial and
+        # the trajectories must agree to float tolerance.
+        np.testing.assert_allclose(
+            np.asarray(ref.syn0), np.asarray(spill.syn0), atol=1e-4
+        )

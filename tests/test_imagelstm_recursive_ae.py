@@ -1,0 +1,248 @@
+"""Faithful ImageLSTM + RecursiveAutoEncoder implementations.
+
+Parity contracts: reference nn/layers/recurrent/ImageLSTM.java
+activate() :176-251 (Karpathy captioning LSTM; forward math re-derived
+below as a numpy loop) and nn/layers/feedforward/autoencoder/recursive/
+RecursiveAutoEncoder.java computeGradientAndScore() :102-160 (greedy
+row-folding reconstruction score; re-derived as the literal loop).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers import get_impl
+from deeplearning4j_tpu.nn.layers.pretrain import RecursiveAutoEncoderImpl
+from deeplearning4j_tpu.nn.layers.recurrent import ImageLSTMImpl
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+RNG = np.random.default_rng(31)
+
+
+def _imagelstm_conf(n_in=5, n_hidden=6, n_out=7, activation="tanh"):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(3)
+        .activation(activation)
+        .list()
+        .layer(0, L.ImageLSTM(n_in=n_in, n_out=n_out, n_hidden=n_hidden))
+        .layer(1, L.RnnOutputLayer(n_in=n_out, n_out=n_out,
+                                   activation="softmax",
+                                   loss_function=LossFunction.MCXENT))
+        .build()
+    )
+    return conf.confs[0]
+
+
+def _reference_imagelstm(rw, w, b, x_tc, use_tanh=True):
+    """Literal numpy port of ImageLSTM.activate() :194-248 for ONE
+    sequence: x_tc [T, C]; returns [T-1, n_out]."""
+    t_len = x_tc.shape[0]
+    h = w.shape[0]
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h_prev = np.zeros(h)
+    c_prev = np.zeros(h)
+    houts = []
+    for t in range(t_len):
+        h_in = np.concatenate([[1.0], x_tc[t], h_prev])
+        z = h_in @ rw
+        i, f, o = sig(z[:h]), sig(z[h:2 * h]), sig(z[2 * h:3 * h])
+        g = np.tanh(z[3 * h:])
+        c = i * g + (f * c_prev if t > 0 else 0.0)  # no forget at t=0
+        hout = o * (np.tanh(c) if use_tanh else c)
+        houts.append(hout)
+        h_prev, c_prev = hout, c
+    hs = np.stack(houts)
+    return hs[1:] @ w + b  # decoder drops the image step
+
+
+class TestImageLSTM:
+    def test_registry_maps_to_dedicated_impl(self):
+        assert get_impl(L.ImageLSTM()) is ImageLSTMImpl
+
+    def test_forward_matches_reference_loop(self):
+        conf = _imagelstm_conf()
+        params = ImageLSTMImpl.init(jax.random.key(0), conf)
+        n, t = 3, 4
+        x = RNG.normal(size=(n, 5, t)).astype(np.float32)
+        out, _ = ImageLSTMImpl.apply(conf, params, jnp.asarray(x))
+        assert out.shape == (n, 7, t - 1)
+        rw = np.asarray(params["RW"])
+        w = np.asarray(params["W"])
+        b = np.asarray(params["b"])
+        for bidx in range(n):
+            expect = _reference_imagelstm(rw, w, b, x[bidx].T)
+            np.testing.assert_allclose(
+                np.asarray(out[bidx]).T, expect, atol=1e-5)
+
+    def test_identity_activation_skips_cell_tanh(self):
+        """Reference :234-237: non-tanh activation -> h = o * c."""
+        conf = _imagelstm_conf(activation="identity")
+        params = ImageLSTMImpl.init(jax.random.key(1), conf)
+        x = RNG.normal(size=(2, 5, 3)).astype(np.float32)
+        out, _ = ImageLSTMImpl.apply(conf, params, jnp.asarray(x))
+        for bidx in range(2):
+            expect = _reference_imagelstm(
+                np.asarray(params["RW"]), np.asarray(params["W"]),
+                np.asarray(params["b"]), x[bidx].T, use_tanh=False)
+            np.testing.assert_allclose(
+                np.asarray(out[bidx]).T, expect, atol=1e-5)
+
+    def test_streaming_state_matches_full_forward(self):
+        """Feeding [image] then words one step at a time with carried
+        state reproduces the full-sequence decode."""
+        conf = _imagelstm_conf()
+        params = ImageLSTMImpl.init(jax.random.key(2), conf)
+        n, t = 2, 5
+        x = RNG.normal(size=(n, 5, t)).astype(np.float32)
+        full, _ = ImageLSTMImpl.apply(conf, params, jnp.asarray(x))
+
+        out0, state = ImageLSTMImpl.apply(
+            conf, params, jnp.asarray(x[:, :, :1]))
+        assert out0.shape == (n, 7, 0)  # image step decodes nothing
+        streamed = []
+        for step in range(1, t):
+            o, state = ImageLSTMImpl.apply(
+                conf, params, jnp.asarray(x[:, :, step:step + 1]),
+                state=state)
+            streamed.append(np.asarray(o)[:, :, 0])
+        np.testing.assert_allclose(
+            np.stack(streamed, axis=2), np.asarray(full), atol=1e-5)
+
+    def test_gradient_flows(self):
+        conf = _imagelstm_conf()
+        params = ImageLSTMImpl.init(jax.random.key(3), conf)
+        x = jnp.asarray(RNG.normal(size=(2, 5, 4)).astype(np.float32))
+
+        def loss(p):
+            out, _ = ImageLSTMImpl.apply(conf, p, x)
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(params)
+        # Finite-difference check on one RW entry.
+        eps = 1e-3
+        p_plus = dict(params)
+        p_plus["RW"] = params["RW"].at[2, 3].add(eps)
+        p_minus = dict(params)
+        p_minus["RW"] = params["RW"].at[2, 3].add(-eps)
+        fd = (loss(p_plus) - loss(p_minus)) / (2 * eps)
+        np.testing.assert_allclose(
+            float(g["RW"][2, 3]), float(fd), rtol=2e-2)
+
+    def test_rejects_masks(self):
+        conf = _imagelstm_conf()
+        params = ImageLSTMImpl.init(jax.random.key(4), conf)
+        x = jnp.zeros((2, 5, 3))
+        with pytest.raises(ValueError, match="mask"):
+            ImageLSTMImpl.apply(conf, params, x, mask=jnp.ones((2, 3)))
+
+
+def _rae_conf(n_in=6, n_out=4):
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(5)
+        .activation("sigmoid")
+        .list()
+        .layer(0, L.RecursiveAutoEncoder(n_in=n_in, n_out=n_out))
+        .layer(1, L.OutputLayer(n_in=n_out, n_out=2, activation="softmax"))
+        .build()
+    )
+    return conf.confs[0]
+
+
+def _reference_rae_score(params, x):
+    """Literal numpy port of computeGradientAndScore's score
+    accumulation (:113-156): greedy row folding, 0.5*mean sq per step."""
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    w, u = np.asarray(params["W"]), np.asarray(params["U"])
+    b, vb = np.asarray(params["b"]), np.asarray(params["vb"])
+    curr = None
+    score = 0.0
+    i = 0
+    while i < x.shape[0]:
+        combined = (
+            np.concatenate([x[i:i + 1], x[i + 1:i + 2]], axis=0)
+            if curr is None
+            else np.concatenate([x[i:i + 1], curr], axis=0)
+        )
+        if i == 0:
+            i += 1
+        curr = combined
+        y = sig(combined @ w + b)
+        z = sig(y @ u + vb)
+        score += 0.5 * np.mean((z - combined) ** 2)
+        i += 1
+    return score
+
+
+class TestRecursiveAutoEncoder:
+    def test_registry_maps_to_dedicated_impl(self):
+        assert get_impl(L.RecursiveAutoEncoder()) is RecursiveAutoEncoderImpl
+
+    def test_untied_decoder_params(self):
+        conf = _rae_conf()
+        params = RecursiveAutoEncoderImpl.init(jax.random.key(0), conf)
+        assert params["W"].shape == (6, 4)
+        assert params["U"].shape == (4, 6)  # untied, not W.T
+        assert params["b"].shape == (4,) and params["vb"].shape == (6,)
+
+    def test_score_matches_reference_folding_loop(self):
+        """Closed-form tail-harmonic score == the literal reference
+        loop, for several row counts."""
+        conf = _rae_conf()
+        params = RecursiveAutoEncoderImpl.init(jax.random.key(1), conf)
+        for rows in (2, 3, 5, 8):
+            x = RNG.normal(size=(rows, 6)).astype(np.float32)
+            ours = float(RecursiveAutoEncoderImpl.pretrain_loss(
+                conf, params, jnp.asarray(x), None))
+            ref = _reference_rae_score(params, x)
+            np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_pretrain_descends(self):
+        conf = _rae_conf()
+        params = RecursiveAutoEncoderImpl.init(jax.random.key(2), conf)
+        x = jnp.asarray(RNG.normal(size=(8, 6)).astype(np.float32))
+        score0 = None
+        for _ in range(50):
+            s, g = RecursiveAutoEncoderImpl.pretrain_value_and_grad(
+                conf, params, x, None)
+            if score0 is None:
+                score0 = float(s)
+            params = jax.tree.map(lambda p, gg: p - 0.5 * gg, params, g)
+        assert float(s) < score0
+
+    def test_network_greedy_pretrain(self):
+        """RecursiveAutoEncoder works as a pretrain layer in a
+        MultiLayerNetwork (reference layerwise pretrain path)."""
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(7)
+            .learning_rate(0.1)
+            .activation("sigmoid")
+            .list()
+            .pretrain(True)
+            .layer(0, L.RecursiveAutoEncoder(n_in=6, n_out=4))
+            .layer(1, L.OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                    loss_function=LossFunction.MCXENT))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.normal(size=(16, 6)).astype(np.float32)
+        y = np.zeros((16, 2), np.float32)
+        y[np.arange(16), RNG.integers(0, 2, 16)] = 1.0
+        it = ListDataSetIterator([DataSet(x, y)])
+        w_before = np.asarray(net.params["0"]["W"]).copy()
+        net.pretrain(it)
+        assert not np.allclose(w_before, np.asarray(net.params["0"]["W"]))

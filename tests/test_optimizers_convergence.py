@@ -3,6 +3,7 @@ TestOptimizers.java: every OptimizationAlgorithm must drive the loss down
 on a small real problem; BackTrackLineSearchTest: the line search must
 return a step that does not increase the loss)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -107,3 +108,49 @@ class TestBackTrackLineSearch:
         step, fnew = backtrack_line_search(f, x0, f(x0), g, -g, 20)
         assert fnew < f(x0)
         assert step < 1.0
+
+
+class TestLineSearchBranches:
+    """Wolfe branches of backtrack_line_search (reference
+    BackTrackLineSearch.java:239-273)."""
+
+    def test_sufficient_increase_for_ascent(self):
+        from deeplearning4j_tpu.optimize.solver import backtrack_line_search
+
+        # Maximize f(x) = -(x-3)^2 from x=0; ascent direction = +grad.
+        f = lambda x: float(-(x - 3.0) ** 2)
+        x = jnp.asarray(0.0)
+        grad = jnp.asarray(6.0)  # df/dx at 0
+        step, fnew = backtrack_line_search(
+            f, x, f(x), grad, grad, minimize=False, initial_step=0.5)
+        assert step > 0 and fnew > f(x)
+
+    def test_nonfinite_jump_scaled_back(self):
+        from deeplearning4j_tpu.optimize.solver import backtrack_line_search
+
+        # Blows up for |x| > 2, quadratic inside.
+        def f(x):
+            v = float(x)
+            return float("inf") if abs(v) > 2 else v ** 2
+
+        x = jnp.asarray(1.0)
+        grad = jnp.asarray(2.0)
+        step, fnew = backtrack_line_search(
+            f, x, f(x), grad, -grad, initial_step=8.0, max_iterations=8)
+        assert np.isfinite(fnew) and fnew < f(x)
+
+    def test_best_step_on_exhaustion(self):
+        from deeplearning4j_tpu.optimize.solver import backtrack_line_search
+
+        # Armijo with c1=1 on f(x)=x^2 from x=1 along -grad: condition
+        # f(1-2s) <= 1 - 4s is unsatisfiable for s in (0,1], so the
+        # search must exhaust and return the best step it saw (the
+        # reference's bestStepSize exit, BackTrackLineSearch.java:239).
+        f = lambda x: float(x) ** 2
+        x = jnp.asarray(1.0)
+        grad = jnp.asarray(2.0)
+        step, fnew = backtrack_line_search(
+            f, x, f(x), grad, -grad, c1=1.0, max_iterations=4)
+        assert 0 < step <= 1 and fnew < f(x)
+        # The returned value is f at the returned step.
+        np.testing.assert_allclose(fnew, f(1.0 - 2.0 * step), rtol=1e-6)
