@@ -163,18 +163,37 @@ class Glove(SequenceVectors):
         self.syn0 = self.w + self.wt
         return float(loss)
 
-    def train_cooccurrence_batches(self, batches, learning_rate=None) -> float:
+    def train_cooccurrence_batches(self, batches, learning_rate=None,
+                                   shuffle_window: int = 8) -> float:
         """One pass over an iterable of (rows, cols, xij) batches at a
         fixed lr — the disk-streaming counterpart of
-        ``train_cooccurrences``, which shuffles each batch before its
-        scatter steps; peak memory is one batch + the tables. (The
-        reference streams its merged spill file sequentially too —
-        AbstractCoOccurrences.java:135.)"""
+        ``train_cooccurrences``. The merged spill stream arrives in
+        sorted key order, so ``shuffle_window`` consecutive batches are
+        buffered and shuffled TOGETHER (train_cooccurrences permutes the
+        concatenation) before their scatter steps — bounded-memory SGD
+        mixing, vs the in-memory path's full-pair-set permutation (a
+        global shuffle would need O(pairs) memory, the thing this path
+        exists to avoid). Peak memory: shuffle_window batches + tables."""
         if not hasattr(self, "w"):
             raise ValueError("init_tables() (or fit) must run first")
         loss = 0.0
-        for rows, cols, xij in batches:
+        window: list = []
+
+        def flush():
+            nonlocal loss
+            if not window:
+                return
+            rows = np.concatenate([b[0] for b in window])
+            cols = np.concatenate([b[1] for b in window])
+            xij = np.concatenate([b[2] for b in window])
             loss = self.train_cooccurrences(rows, cols, xij, learning_rate)
+            window.clear()
+
+        for batch in batches:
+            window.append(batch)
+            if len(window) >= shuffle_window:
+                flush()
+        flush()
         self.syn0 = self.w + self.wt
         return loss
 
@@ -213,6 +232,8 @@ class Glove(SequenceVectors):
         )
         try:
             counter.count_sequences(seqs)
+            if counter.n_shards() == 0:
+                raise ValueError("Empty co-occurrence matrix")
             for _ in range(self.epochs):
                 self.losses.append(self.train_cooccurrence_batches(
                     counter.iter_batches(self.batch_size)))

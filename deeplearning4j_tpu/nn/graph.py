@@ -604,15 +604,25 @@ class ComputationGraph:
         # (mixed-rank calls keep the full time axis — a 3-D input's
         # T-step output must not be truncated to step 0)
         squeeze = bool(ranks) and all(r == 2 for r in ranks)
-        acts, _, new_rnn = self._forward_fn(
-            self.params, self.state, inputs, None, False,
-            rnn_state=self._rnn_state or None,
-        )
+        acts, _, new_rnn = self._rnn_step_jit(
+            self.params, self.state, inputs, self._rnn_state)
         self._rnn_state = new_rnn
         outs = [acts[name] for name in self.conf.network_outputs]
         if squeeze:
             outs = [o[:, :, 0] if o.ndim == 3 else o for o in outs]
         return outs
+
+    @functools.cached_property
+    def _rnn_step_jit(self):
+        # One jitted computation per streaming step instead of one host
+        # dispatch per XLA op (mirrors MultiLayerNetwork._rnn_step_jit).
+        def f(params, state, inputs, rnn_state):
+            return self._forward_fn(
+                params, state, inputs, None, False,
+                rnn_state=rnn_state or None,
+            )
+
+        return jax.jit(f)
 
     def rnn_clear_previous_state(self) -> None:
         self._rnn_state = {}

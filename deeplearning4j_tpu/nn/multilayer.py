@@ -551,6 +551,20 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # RNN streaming + state (reference rnnTimeStep, stateMap)
     # ------------------------------------------------------------------
+    @functools.cached_property
+    def _rnn_step_jit(self):
+        # One jitted computation per streaming step instead of one host
+        # dispatch per XLA op (the serving loop's hot path); retraces
+        # only when the rnn-state pytree structure flips from empty
+        # (first call) to populated.
+        def f(params, state, x, rnn_state):
+            return self._forward_fn(
+                params, state, x, None, False,
+                rnn_state=rnn_state or None,
+            )
+
+        return jax.jit(f)
+
     def rnn_time_step(self, x) -> Array:
         """Stateful single/multi-step inference carrying hidden state
         between calls (reference rnnTimeStep)."""
@@ -558,10 +572,8 @@ class MultiLayerNetwork:
         x = jnp.asarray(x, self._dtype)
         if x.ndim == 2:
             x = x[:, :, None]
-        out, _, new_rnn = self._forward_fn(
-            self.params, self.state, x, None, False,
-            rnn_state=self._rnn_state or None,
-        )
+        out, _, new_rnn = self._rnn_step_jit(
+            self.params, self.state, x, self._rnn_state)
         self._rnn_state = new_rnn
         return out
 

@@ -45,6 +45,7 @@ def backtrack_line_search(
     c1: float = 1e-4,
     rho: float = 0.5,
     minimize: bool = True,
+    move=None,
 ) -> Tuple[float, float]:
     """Armijo/Wolfe backtracking (reference BackTrackLineSearch.java).
     Returns (step, f(x + step*direction)).
@@ -62,13 +63,24 @@ def backtrack_line_search(
     tracking for the max-iterations exit (:239-245), and scaling back
     non-finite jumps (:266-273). ``rho`` remains the fallback shrink
     when interpolation degenerates.
+
+    ``move(x, direction, step)`` evaluates candidates with the SAME step
+    function the optimizer will apply afterward (the reference's
+    lineMaximizer runs the configured stepFunction on each probe), so
+    the returned score describes the point actually stepped to — for
+    the Negative* step functions the probes go along -direction and the
+    caller passes minimize=False.
     """
+    if move is None:
+        move = lambda xx, d, s: xx + s * d  # noqa: E731
     sign = 1.0 if minimize else -1.0
 
     def phi(s: float) -> float:
-        return sign * float(f(x + s * direction))
+        return sign * float(f(move(x, direction, s)))
 
-    slope = sign * float(jnp.vdot(grad, direction))
+    # Effective probe direction (linear step functions): slope of phi.
+    delta = move(x, direction, 1.0) - x
+    slope = sign * float(jnp.vdot(grad, delta))
     phi0 = sign * float(fx)
     step = float(initial_step)
     step_prev = phi_prev = None
@@ -236,9 +248,23 @@ class BaseOptimizer:
             score, grad = problem.value_and_grad(x)
             score = float(score)
             direction = self.direction(x, grad, it)
+            # Probe with the configured step function; Negative* step
+            # functions walk -direction, so with this solver's descent
+            # directions they ASCEND — select the sufficient-increase
+            # branch for them (the reference's minObjectiveFunction =
+            # stepFunction instanceof Negative* rule, translated to
+            # this port's descent-direction convention).
+            from deeplearning4j_tpu.optimize import stepfunctions as SF
+
+            negative = isinstance(
+                self.step_function,
+                (SF.NegativeDefaultStepFunction,
+                 SF.NegativeGradientStepFunction))
             step, new_score = backtrack_line_search(
                 problem.value, x, score, grad, direction,
                 self.max_ls_iterations,
+                minimize=not negative,
+                move=self.step_function.step,
             )
             x = self.step_function.step(x, direction, step)
             self._ls_scores = (score, new_score)  # for adaptive hooks

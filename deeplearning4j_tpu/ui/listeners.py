@@ -72,3 +72,39 @@ class ActivationIterationListener(IterationListener):
         self.sink.put(
             "activations", iteration,
             [float(np.mean(np.abs(np.asarray(a)))) for a in acts])
+
+
+class ActivationImageListener(IterationListener):
+    """Convolutional activation maps + filter kernels rendered as image
+    grids (reference deeplearning4j-ui activation render path): for each
+    4-D layer activation on the probe batch, ship the first example's
+    channel maps; for each 4-D weight, ship the per-output-filter
+    kernels."""
+
+    def __init__(self, sink: Any, probe_features, frequency: int = 1,
+                 max_images: int = 16):
+        from deeplearning4j_tpu.ui.render import (
+            filter_grid_payload,
+            image_grid_payload,
+        )
+
+        self.sink = sink
+        self.probe = np.asarray(probe_features)
+        self.invoked_every = frequency
+        self.max_images = max_images
+        self._act_grid = image_grid_payload
+        self._filter_grid = filter_grid_payload
+
+    def iteration_done(self, model, iteration: int) -> None:
+        acts = model.feed_forward(self.probe, train=False)  # input first
+        for i, a in enumerate(acts):
+            a = np.asarray(a)
+            if a.ndim == 4:
+                name = "input" if i == 0 else f"layer{i - 1}"
+                self.sink.put(f"activation_images/{name}", iteration,
+                              self._act_grid(a, self.max_images))
+        for key, p in model.param_table().items():
+            p = np.asarray(p)
+            if p.ndim == 4:
+                self.sink.put(f"filters/{key}", iteration,
+                              self._filter_grid(p, self.max_images))

@@ -96,6 +96,73 @@ function bars(ctx, counts, W, H){
   });
   ctx.strokeStyle='#888'; ctx.strokeRect(40, 12, W-50, H-30);
 }
+function imageGrid(ctx, v, W, H){
+  // per-channel activation maps / per-filter kernels as a grey grid
+  // (the reference's convolutional activation/filter render view)
+  const n = v.images.length;
+  const cols = Math.ceil(Math.sqrt(n)), rows = Math.ceil(n/cols);
+  const cell = Math.max(8, Math.min(Math.floor((W-20)/cols),
+                                    Math.floor((H-20)/rows)));
+  ctx.imageSmoothingEnabled = false;
+  v.images.forEach((img, i) => {
+    const oc = document.createElement('canvas');
+    oc.width = v.w; oc.height = v.h;
+    const id = oc.getContext('2d').createImageData(v.w, v.h);
+    img.forEach((px, j) => {
+      id.data[4*j] = px; id.data[4*j+1] = px; id.data[4*j+2] = px;
+      id.data[4*j+3] = 255;
+    });
+    oc.getContext('2d').putImageData(id, 0, 0);
+    ctx.drawImage(oc, 10+(i%cols)*cell, 10+Math.floor(i/cols)*cell,
+                  cell-2, cell-2);
+  });
+}
+function scatter(ctx, v, W, H){
+  // 2-D embedding scatter (the reference's t-SNE render view)
+  let x0=Infinity,x1=-Infinity,y0=Infinity,y1=-Infinity;
+  for (const p of v.points){
+    if (p[0]<x0)x0=p[0]; if (p[0]>x1)x1=p[0];
+    if (p[1]<y0)y0=p[1]; if (p[1]>y1)y1=p[1];
+  }
+  const sx = x => 12 + (W-24)*(x1>x0 ? (x-x0)/(x1-x0) : 0.5);
+  const sy = y => H-12 - (H-24)*(y1>y0 ? (y-y0)/(y1-y0) : 0.5);
+  ctx.strokeStyle='#888'; ctx.strokeRect(8, 8, W-16, H-16);
+  ctx.fillStyle='#0a62c9'; ctx.font='9px monospace';
+  v.points.forEach((p, i) => {
+    const X = sx(p[0]), Y = sy(p[1]);
+    ctx.beginPath(); ctx.arc(X, Y, 2, 0, 6.3); ctx.fill();
+    if (v.labels && v.points.length <= 200){
+      ctx.fillStyle='#555'; ctx.fillText(v.labels[i], X+3, Y-2);
+      ctx.fillStyle='#0a62c9';
+    }
+  });
+}
+function flow(ctx, v, W, H){
+  // network structure boxes + connections (the reference's
+  // FlowIterationListener interactive flow view)
+  const L = v.layers, n = L.length;
+  const bw = Math.min(110, Math.floor((W-30)/n)-8), bh = 52;
+  const y = Math.floor(H/2) - bh/2;
+  ctx.font='9px monospace';
+  L.forEach((l, i) => {
+    const x = 15 + i*(bw+8);
+    ctx.fillStyle='#eaf2fc'; ctx.fillRect(x, y, bw, bh);
+    ctx.strokeStyle='#0a62c9'; ctx.strokeRect(x, y, bw, bh);
+    ctx.fillStyle='#222';
+    ctx.fillText(String(l.type).slice(0, 14), x+3, y+12);
+    ctx.fillText((l.n_in==null?'?':l.n_in)+' -> '+
+                 (l.n_out==null?'?':l.n_out), x+3, y+26);
+    if (l.activation) ctx.fillText(String(l.activation), x+3, y+40);
+    if (i){
+      ctx.strokeStyle='#888'; ctx.beginPath();
+      ctx.moveTo(x-8, y+bh/2); ctx.lineTo(x, y+bh/2); ctx.stroke();
+      ctx.beginPath(); ctx.moveTo(x-4, y+bh/2-3); ctx.lineTo(x, y+bh/2);
+      ctx.lineTo(x-4, y+bh/2+3); ctx.stroke();
+    }
+  });
+  ctx.fillStyle='#555';
+  ctx.fillText('params: '+v.num_params, 15, y+bh+14);
+}
 function render(key, pts){
   const el = card(key);
   const cv = el.querySelector('canvas'), pre = el.querySelector('pre');
@@ -103,13 +170,27 @@ function render(key, pts){
     cv.style.display = on ? 'block' : 'none';
     pre.style.display = on ? 'none' : 'block';
   };
+  const setH = h => { if (cv.height !== h) cv.height = h; };
   const ctx = cv.getContext('2d');
-  ctx.clearRect(0,0,cv.width,cv.height);
   const last = pts[pts.length-1];
   const numeric = pts.every(p=>typeof p[1] === 'number');
+  const v = last[1];
+  if (numeric){ setH(160); }
+  else if (v && v.type === 'image_grid'){ setH(280); }
+  else if (v && v.type === 'scatter'){ setH(280); }
+  ctx.clearRect(0,0,cv.width,cv.height);
   if (numeric){ showChart(true); line(ctx, pts, cv.width, cv.height);
                 return; }
-  const v = last[1];
+  if (v && v.type === 'image_grid'){
+    showChart(true); imageGrid(ctx, v, cv.width, cv.height); return;
+  }
+  if (v && v.type === 'scatter'){
+    showChart(true); scatter(ctx, v, cv.width, cv.height); return;
+  }
+  if (v && Array.isArray(v.layers)){
+    setH(120); ctx.clearRect(0,0,cv.width,cv.height);
+    showChart(true); flow(ctx, v, cv.width, cv.height); return;
+  }
   let counts = null;
   if (v && Array.isArray(v.counts)) counts = v.counts;
   else if (v && typeof v === 'object'){
@@ -117,7 +198,8 @@ function render(key, pts){
     if (Array.isArray(first) && first.every(n=>typeof n==='number'))
       counts = first;
   }
-  if (counts){ showChart(true); bars(ctx, counts, cv.width, cv.height);
+  if (counts){ setH(160); ctx.clearRect(0,0,cv.width,cv.height);
+               showChart(true); bars(ctx, counts, cv.width, cv.height);
                return; }
   showChart(false);
   pre.textContent = '@'+last[0]+': '+JSON.stringify(v).slice(0,800);

@@ -154,3 +154,15 @@ class TestLineSearchBranches:
         assert 0 < step <= 1 and fnew < f(x)
         # The returned value is f at the returned step.
         np.testing.assert_allclose(fnew, f(1.0 - 2.0 * step), rtol=1e-6)
+
+    def test_negative_step_function_score_matches_stepped_point(self):
+        """With a Negative* step function the line search must probe the
+        same points the step function later moves to (x - s*d): the
+        reported score equals the loss at the actually-stepped params."""
+        from deeplearning4j_tpu.optimize.solver import LineGradientDescent
+
+        net, ds = _problem()
+        opt = LineGradientDescent(
+            net, max_iterations=1, step_function="negative_default")
+        after = opt.optimize(ds)
+        assert after == pytest.approx(net.score(ds), rel=1e-4)
