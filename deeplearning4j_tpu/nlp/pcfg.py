@@ -1,0 +1,170 @@
+"""Trainable PCFG constituency parser (CKY decode).
+
+The reference's parse-tree pipeline runs a trained constituency parser
+behind UIMA (reference text/corpora + TreeParser / TreeVectorizer,
+models/rntn consuming its trees); round 1 stood that in with the
+deterministic chunker in nlp/tree_parser.py. This module supplies the
+trainable statistical counterpart: a PCFG induced from example
+``ParseTree``s (rules counted off collapsed-unary, binarized trees —
+CNF via the same transformers the RNTN pipeline uses) and decoded with
+CKY over log probabilities. Out-of-vocabulary words back off to a
+uniform preterminal distribution; sentences with no full-span parse
+fall back to the chunker so downstream consumers (TreeVectorizer →
+RNTN) always receive a tree.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.nlp.tree_parser import (
+    BinarizeTreeTransformer,
+    CollapseUnaries,
+    ParseTree,
+    TreeParser,
+)
+
+
+class PcfgParser:
+    def __init__(self, fallback: Optional[TreeParser] = None):
+        self.fallback = fallback or TreeParser()
+        self._fitted = False
+
+    # -- grammar induction --------------------------------------------
+    def fit(self, trees: Iterable[ParseTree]) -> "PcfgParser":
+        binarize = BinarizeTreeTransformer()
+        collapse = CollapseUnaries()
+        binary: Dict[str, Counter] = defaultdict(Counter)  # A -> (B, C)
+        lexicon: Dict[str, Counter] = defaultdict(Counter)  # T -> word
+        roots: Counter = Counter()
+        n_trees = 0
+        for tree in trees:
+            t = binarize.transform(collapse.transform(tree))
+            roots[t.label] += 1
+            n_trees += 1
+            self._count(t, binary, lexicon)
+        if not n_trees:
+            raise ValueError("no training trees")
+
+        # Freeze plain dicts first: defaultdict lookups below would
+        # otherwise insert empty entries (every binary nonterminal would
+        # leak into the preterminal set and every preterminal into the
+        # binary table).
+        binary = dict(binary)
+        lexicon = dict(lexicon)
+        empty: Counter = Counter()
+        self._preterminals: List[str] = sorted(lexicon)
+        self._log_binary: Dict[Tuple[str, str], List[Tuple[str, float]]]
+        self._log_binary = defaultdict(list)
+        for a, rhs in binary.items():
+            total = sum(rhs.values()) + sum(lexicon.get(a, empty).values())
+            for (b, c), n in rhs.items():
+                self._log_binary[(b, c)].append((a, math.log(n / total)))
+        self._log_lex: Dict[str, List[Tuple[str, float]]] = defaultdict(list)
+        for t, words in lexicon.items():
+            total = sum(words.values()) + sum(binary.get(t, empty).values())
+            for w, n in words.items():
+                self._log_lex[w].append((t, math.log(n / total)))
+        total_roots = sum(roots.values())
+        self._log_root = {a: math.log(n / total_roots)
+                          for a, n in roots.items()}
+        self._fitted = True
+        return self
+
+    def _count(self, node: ParseTree, binary, lexicon) -> None:
+        if node.is_leaf():
+            return
+        kids = node.children
+        if len(kids) == 1 and kids[0].is_leaf():
+            lexicon[node.label][kids[0].word.lower()] += 1
+            return
+        if len(kids) == 2:
+            binary[node.label][(kids[0].label, kids[1].label)] += 1
+        elif len(kids) == 1:
+            # residual unary over a non-leaf: treat as X -> (Y, Y) is
+            # wrong; instead skip through (collapse should have removed
+            # these, but be robust)
+            self._count(kids[0], binary, lexicon)
+            return
+        for k in kids:
+            self._count(k, binary, lexicon)
+
+    # -- CKY decode ----------------------------------------------------
+    def parse_tokens(self, tokens: Sequence[str]) -> Optional[ParseTree]:
+        """Best full-span tree for the token list, or None if the
+        grammar cannot cover it."""
+        if not self._fitted:
+            raise ValueError("fit() must run first")
+        n = len(tokens)
+        if n == 0:
+            return None
+        # chart[(i, j)]: label -> (logp, back) where back is either
+        # ("lex", word) or (k, left_label, right_label)
+        chart: List[Dict[str, Tuple[float, tuple]]] = [
+            {} for _ in range(n * (n + 1))]
+
+        def cell(i, j):
+            return chart[i * (n + 1) + j]
+
+        oov_logp = math.log(1.0 / max(1, len(self._preterminals)))
+        for i, w in enumerate(tokens):
+            entries = self._log_lex.get(w.lower())
+            c = cell(i, i + 1)
+            if entries:
+                for t, lp in entries:
+                    if lp > c.get(t, (-math.inf,))[0]:
+                        c[t] = (lp, ("lex", w))
+            else:
+                for t in self._preterminals:
+                    c[t] = (oov_logp, ("lex", w))
+        for span in range(2, n + 1):
+            for i in range(0, n - span + 1):
+                j = i + span
+                c = cell(i, j)
+                for k in range(i + 1, j):
+                    left, right = cell(i, k), cell(k, j)
+                    if not left or not right:
+                        continue
+                    for bl, (lpb, _) in left.items():
+                        for cl, (lpc, _) in right.items():
+                            for a, lpr in self._log_binary.get(
+                                    (bl, cl), ()):
+                                score = lpr + lpb + lpc
+                                if score > c.get(a, (-math.inf,))[0]:
+                                    c[a] = (score, (k, bl, cl))
+        top = cell(0, n)
+        best, best_score = None, -math.inf
+        for a, (lp, _) in top.items():
+            if a not in self._log_root:
+                continue  # only labels observed as tree roots qualify
+            score = lp + self._log_root[a]
+            if score > best_score:
+                best, best_score = a, score
+        if best is None:
+            return None
+        return self._build(0, n, best, cell)
+
+    def _build(self, i, j, label, cell) -> ParseTree:
+        _, back = cell(i, j)[label]
+        if back[0] == "lex":
+            return ParseTree(
+                label=label,
+                children=[ParseTree(label=label, word=back[1])])
+        k, bl, cl = back
+        return ParseTree(label=label, children=[
+            self._build(i, k, bl, cell),
+            self._build(k, j, cl, cell),
+        ])
+
+    # -- TreeParser-compatible surface --------------------------------
+    def parse(self, sentence: str) -> ParseTree:
+        tokens = [t for t in sentence.split() if t]
+        tree = self.parse_tokens(tokens) if self._fitted else None
+        if tree is None:
+            return self.fallback.parse(sentence)
+        return tree
+
+    def get_trees(self, text: str) -> List[ParseTree]:
+        return [self.parse(s) for s in text.split(".") if s.strip()]

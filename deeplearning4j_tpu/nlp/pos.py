@@ -1,0 +1,139 @@
+"""Trainable HMM part-of-speech tagger.
+
+The reference tags tokens through trained UIMA/ClearTK annotators behind
+``PosUimaTokenizer`` (reference text/tokenization/tokenizer/
+PosUimaTokenizer.java) — a statistical model shipped as a binary. Round
+1 stood that in with the closed-lexicon ``RuleBasedPosTagger``
+(nlp/tokenization.py); this module supplies the trainable statistical
+counterpart: a supervised bigram HMM (add-k smoothed transition and
+emission counts) decoded with the framework's Viterbi
+(util/viterbi.py — the reference carries the same algorithm in
+util/Viterbi.java). Unknown words back off to orthographic-class
+emissions (suffix/capitalization/digit shape) estimated from rare
+training words, the classic HMM-tagger unknown-word model.
+
+Interface-compatible with RuleBasedPosTagger (``tag(token)``), plus the
+context-aware ``tag_sequence(tokens)`` that single-token rules cannot
+express.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.util.viterbi import viterbi_decode
+
+
+def _shape_class(word: str) -> str:
+    w = word.lower()
+    feats = [w[-3:] if len(w) >= 3 else w]
+    if word[:1].isupper():
+        feats.append("CAP")
+    if any(ch.isdigit() for ch in word):
+        feats.append("DIG")
+    return "|".join(feats)
+
+
+class HmmPosTagger:
+    """Supervised bigram HMM: fit on tagged sentences, Viterbi decode."""
+
+    def __init__(self, smoothing: float = 0.1, rare_threshold: int = 1):
+        self.smoothing = smoothing
+        self.rare_threshold = rare_threshold
+        self._fitted = False
+
+    def fit(
+        self, tagged_sentences: Iterable[Sequence[Tuple[str, str]]]
+    ) -> "HmmPosTagger":
+        trans: Dict[str, Counter] = defaultdict(Counter)
+        emit: Dict[str, Counter] = defaultdict(Counter)
+        init: Counter = Counter()
+        word_counts: Counter = Counter()
+        sentences = [list(s) for s in tagged_sentences if s]
+        if not sentences:
+            raise ValueError("no tagged sentences")
+        for sent in sentences:
+            for w, _ in sent:
+                word_counts[w.lower()] += 1
+        shape_emit: Dict[str, Counter] = defaultdict(Counter)
+        for sent in sentences:
+            prev = None
+            for w, t in sent:
+                lw = w.lower()
+                emit[t][lw] += 1
+                if word_counts[lw] <= self.rare_threshold:
+                    shape_emit[t][_shape_class(w)] += 1
+                if prev is None:
+                    init[t] += 1
+                else:
+                    trans[prev][t] += 1
+                prev = t
+
+        self.tags: List[str] = sorted(emit)
+        tag_idx = {t: i for i, t in enumerate(self.tags)}
+        S = len(self.tags)
+        k = self.smoothing
+        self._log_init = np.full(S, -math.inf)
+        total_init = sum(init.values())
+        for t, c in init.items():
+            self._log_init[tag_idx[t]] = math.log(c / total_init)
+        self._log_init = np.maximum(self._log_init, math.log(k / (S * 10)))
+        self._log_trans = np.zeros((S, S))
+        for i, t in enumerate(self.tags):
+            row = trans[t]
+            total = sum(row.values()) + k * S
+            for j, t2 in enumerate(self.tags):
+                self._log_trans[i, j] = math.log(
+                    (row.get(t2, 0) + k) / total)
+        # word -> per-tag log emission (smoothed within each tag)
+        self._vocab = set(word_counts)
+        self._log_emit_word: Dict[str, np.ndarray] = {}
+        tag_totals = {t: sum(emit[t].values()) for t in self.tags}
+        for w in self._vocab:
+            col = np.empty(S)
+            for i, t in enumerate(self.tags):
+                col[i] = math.log(
+                    (emit[t].get(w, 0) + k)
+                    / (tag_totals[t] + k * max(1, len(self._vocab))))
+            self._log_emit_word[w] = col
+        # orthographic-class backoff for OOV words
+        self._log_emit_shape: Dict[str, np.ndarray] = {}
+        shapes = {s for c in shape_emit.values() for s in c}
+        for s in shapes:
+            col = np.empty(S)
+            for i, t in enumerate(self.tags):
+                col[i] = math.log(
+                    (shape_emit[t].get(s, 0) + k)
+                    / (sum(shape_emit[t].values()) + k * max(1, len(shapes))))
+            self._log_emit_shape[s] = col
+        self._log_emit_unk = np.full(S, math.log(1.0 / S))
+        self._fitted = True
+        return self
+
+    # -- decoding ------------------------------------------------------
+    def _emission(self, word: str) -> np.ndarray:
+        lw = word.lower()
+        if lw in self._log_emit_word:
+            return self._log_emit_word[lw]
+        col = self._log_emit_shape.get(_shape_class(word))
+        return col if col is not None else self._log_emit_unk
+
+    def tag_sequence(self, tokens: Sequence[str]) -> List[str]:
+        if not self._fitted:
+            raise ValueError("fit() must run first")
+        if not tokens:
+            return []
+        log_emit = np.stack([self._emission(w) for w in tokens])
+        _, path = viterbi_decode(self._log_init, self._log_trans, log_emit)
+        return [self.tags[i] for i in path]
+
+    def tag(self, token: str) -> str:
+        """Single-token compatibility with RuleBasedPosTagger (no
+        context: the HMM reduces to argmax init+emission)."""
+        if not token:
+            return "NONE"
+        return self.tag_sequence([token])[0]

@@ -87,7 +87,9 @@ class RuleBasedPosTagger:
     """Tiny deterministic POS tagger (closed-class lexicon + suffix
     rules). Stands in for the reference's UIMA/ClearTK tagger behind
     PosUimaTokenizer (text/tokenization/tokenizer/PosUimaTokenizer.java);
-    intentionally coarse — callers only branch on the tag class."""
+    intentionally coarse — callers only branch on the tag class. For a
+    TRAINABLE statistical tagger with the same ``tag`` interface plus
+    contextual ``tag_sequence``, use nlp/pos.py HmmPosTagger."""
 
     _DETERMINERS = {"the", "a", "an", "this", "that", "these", "those"}
     _PRONOUNS = {"i", "you", "he", "she", "it", "we", "they", "me",

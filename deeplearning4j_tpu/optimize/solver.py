@@ -248,22 +248,33 @@ class BaseOptimizer:
             score, grad = problem.value_and_grad(x)
             score = float(score)
             direction = self.direction(x, grad, it)
-            # Probe with the configured step function; Negative* step
-            # functions walk -direction, so with this solver's descent
-            # directions they ASCEND — select the sufficient-increase
-            # branch for them (the reference's minObjectiveFunction =
-            # stepFunction instanceof Negative* rule, translated to
-            # this port's descent-direction convention).
+            # Probe with the configured step function so the reported
+            # score always describes the point actually stepped to. The
+            # reference's Negative* step functions SUBTRACT a
+            # gradient-oriented direction to minimize
+            # (minObjectiveFunction = instanceof Negative*,
+            # BackTrackLineSearch.java:163); this port's solvers emit
+            # descent-oriented directions, so a configured Negative*
+            # step function gets the direction negated back to gradient
+            # orientation — every reference step-function config
+            # minimizes here exactly as it does there.
             from deeplearning4j_tpu.optimize import stepfunctions as SF
 
-            negative = isinstance(
-                self.step_function,
-                (SF.NegativeDefaultStepFunction,
-                 SF.NegativeGradientStepFunction))
+            if isinstance(self.step_function,
+                          (SF.NegativeDefaultStepFunction,
+                           SF.NegativeGradientStepFunction)):
+                direction = -direction
+            # Constant step functions (x +/- direction, step ignored):
+            # phi(s) is flat in s, so probing more than once re-runs the
+            # identical loss evaluation.
+            ls_iters = self.max_ls_iterations
+            if isinstance(self.step_function,
+                          (SF.GradientStepFunction,
+                           SF.NegativeGradientStepFunction)):
+                ls_iters = 1
             step, new_score = backtrack_line_search(
                 problem.value, x, score, grad, direction,
-                self.max_ls_iterations,
-                minimize=not negative,
+                ls_iters,
                 move=self.step_function.step,
             )
             x = self.step_function.step(x, direction, step)

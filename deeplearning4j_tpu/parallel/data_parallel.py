@@ -87,7 +87,17 @@ class ParallelTrainer:
         self.net = net
         self.mesh = mesh
         self.dp_axis = dp_axis
+        # ComputationGraph duck type: multi-input coercion + dict params
+        self.is_graph = hasattr(net, "_coerce_multi")
         self.tp_axis = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
+        if self.is_graph and self.tp_axis:
+            raise ValueError(
+                "tensor parallelism (tp_axis) supports MultiLayerNetwork "
+                "only; ComputationGraph trains dp-sharded")
+        if self.is_graph and not average_each_iteration:
+            raise ValueError(
+                "K-local-steps-then-average supports MultiLayerNetwork "
+                "only; ComputationGraph trains per-step synchronous")
         self.average_each_iteration = average_each_iteration
         self.local_steps = max(1, local_steps)
         # Reference engine flags org.deeplearning4j.spark.iteration.
@@ -176,9 +186,9 @@ class ParallelTrainer:
         """K fused global steps: ``lax.scan`` over pre-stacked sharded
         batches ([K, B, ...] with B split over the dp axis) — one host
         dispatch per K synchronous all-reduced steps. The pod-scale
-        composition of MultiLayerNetwork.fit_scan: XLA inserts the
-        gradient all-reduce inside the scan body, so the ICI collective
-        pipelines with compute across all K steps."""
+        composition of MultiLayerNetwork/ComputationGraph.fit_scan: XLA
+        inserts the gradient all-reduce inside the scan body, so the ICI
+        collective pipelines with compute across all K steps."""
         if not self.average_each_iteration:
             raise ValueError(
                 "fit_scan is the per-step-synchronous path; "
@@ -186,19 +196,27 @@ class ParallelTrainer:
         # Shard then delegate: jnp.asarray inside net.fit_scan preserves
         # the placement, and the net-level guards (tBPTT, non-SGD) and
         # listener cadence apply identically here.
+        if self.is_graph:
+            # dict of [K, B, ...] inputs / list of [K, B, ...] labels
+            features_stacked = jax.tree.map(
+                self._shard_stacked, features_stacked)
+            labels_stacked = jax.tree.map(
+                self._shard_stacked, labels_stacked)
+        else:
+            features_stacked = self._shard_stacked(features_stacked)
+            labels_stacked = self._shard_stacked(labels_stacked)
         return self.net.fit_scan(
-            self._shard_stacked(features_stacked),
-            self._shard_stacked(labels_stacked),
+            features_stacked, labels_stacked,
             grad_scale=self._grad_scale())
 
     # ------------------------------------------------------------------
     def fit(self, data, labels=None) -> float:
         """One (or more) global synchronous steps on the given batch."""
-        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
         if labels is not None:
             data = DataSet(data, labels)
-        if isinstance(data, DataSet):
+        if isinstance(data, (DataSet, MultiDataSet)):
             batches = [data]
         else:
             batches = data  # iterator
@@ -212,14 +230,24 @@ class ParallelTrainer:
 
     def _fit_sync(self, ds) -> float:
         net = self.net
-        feats = self._shard_batch(ds.features)
-        labels = self._shard_batch(ds.labels)
-        fm = self._shard_batch(ds.features_mask)
-        lm = self._shard_batch(ds.labels_mask)
+        if self.is_graph:
+            # Multi-input/multi-output batch: shard every feature/label/
+            # mask leaf over dp (graph _train_step has the same arity as
+            # the MLN one, with pytree-valued inputs/labels).
+            inputs, labels, fm, lm = net._coerce_multi(ds)
+            inputs = jax.tree.map(self._shard_batch, inputs)
+            labels = jax.tree.map(self._shard_batch, labels)
+            fm = None if fm is None else jax.tree.map(self._shard_batch, fm)
+            lm = None if lm is None else jax.tree.map(self._shard_batch, lm)
+        else:
+            inputs = self._shard_batch(ds.features)
+            labels = self._shard_batch(ds.labels)
+            fm = self._shard_batch(ds.features_mask)
+            lm = self._shard_batch(ds.labels_mask)
         net._key, sub = jax.random.split(net._key)
         net.params, net.state, net.updater_state, score = net._train_step(
             net.params, net.state, net.updater_state,
-            net.iteration, sub, feats, labels, fm, lm, self._grad_scale(),
+            net.iteration, sub, inputs, labels, fm, lm, self._grad_scale(),
         )
         net.score_value = score
         net.iteration += 1

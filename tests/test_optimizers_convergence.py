@@ -157,8 +157,8 @@ class TestLineSearchBranches:
 
     def test_negative_step_function_score_matches_stepped_point(self):
         """With a Negative* step function the line search must probe the
-        same points the step function later moves to (x - s*d): the
-        reported score equals the loss at the actually-stepped params."""
+        same points the step function later moves to: the reported score
+        equals the loss at the actually-stepped params."""
         from deeplearning4j_tpu.optimize.solver import LineGradientDescent
 
         net, ds = _problem()
@@ -166,3 +166,16 @@ class TestLineSearchBranches:
             net, max_iterations=1, step_function="negative_default")
         after = opt.optimize(ds)
         assert after == pytest.approx(net.score(ds), rel=1e-4)
+
+    def test_negative_default_still_minimizes(self):
+        """negative_default is the reference's STANDARD minimize config
+        (it subtracts a gradient-oriented direction); a user migrating a
+        reference config must see the loss descend, not ascend."""
+        from deeplearning4j_tpu.optimize.solver import LineGradientDescent
+
+        net, ds = _problem()
+        before = net.score(ds)
+        after = LineGradientDescent(
+            net, max_iterations=10,
+            step_function="negative_default").optimize(ds)
+        assert after < before * 0.8, (before, after)

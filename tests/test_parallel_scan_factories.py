@@ -295,3 +295,99 @@ class TestMultiHost:
             num_processes=1, process_id=0) == 0
         assert mh._initialized is True
         monkeypatch.setattr(mh, "_initialized", False)
+
+
+class TestGraphParallelTrainer:
+    """ParallelTrainer over a ComputationGraph: dp-sharded synchronous
+    steps must match single-device graph training exactly."""
+
+    def _graph_conf(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        return (
+            NeuralNetConfiguration.Builder()
+            .seed(42)
+            .learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", L.DenseLayer(n_in=4, n_out=6,
+                                          activation="relu"), "a")
+            .add_layer("db", L.DenseLayer(n_in=3, n_out=6,
+                                          activation="relu"), "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer(
+                "out",
+                L.OutputLayer(n_in=12, n_out=3, activation="softmax",
+                              loss_function=LossFunction.MCXENT),
+                "m",
+            )
+            .set_outputs("out")
+            .build()
+        )
+
+    def test_multi_input_graph_matches_single_device(self):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        rng = np.random.default_rng(0)
+        xa = rng.normal(size=(16, 4)).astype(np.float32)
+        xb = rng.normal(size=(16, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        mds = MultiDataSet([xa, xb], [y])
+
+        g_ref = ComputationGraph(self._graph_conf()).init()
+        g_dp = ComputationGraph(self._graph_conf()).init()
+        mesh = make_mesh(MeshSpec({"dp": 4}))
+        trainer = ParallelTrainer(g_dp, mesh)
+        for _ in range(4):
+            g_ref.fit(mds)
+            trainer.fit(mds)
+        np.testing.assert_allclose(
+            float(g_dp.score_value), float(g_ref.score_value), rtol=1e-5)
+        for name in g_ref.params:
+            for k in g_ref.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(g_dp.params[name][k]),
+                    np.asarray(g_ref.params[name][k]),
+                    rtol=1e-4, atol=1e-6,
+                )
+
+    def test_graph_fit_scan_sharded(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        rng = np.random.default_rng(1)
+        K, B = 6, 16
+        xa = rng.normal(size=(K, B, 4)).astype(np.float32)
+        xb = rng.normal(size=(K, B, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (K, B))]
+
+        g_dp = ComputationGraph(self._graph_conf()).init()
+        mesh = make_mesh(MeshSpec({"dp": 4}))
+        trainer = ParallelTrainer(g_dp, mesh)
+        scores = trainer.fit_scan({"a": xa, "b": xb}, [y])
+        s = np.asarray(scores)
+        assert s.shape == (K,) and np.all(np.isfinite(s))
+        assert s[-1] < s[0]
+
+    def test_graph_rejects_tp_and_local_steps(self):
+        import pytest
+
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec({"dp": 2, "tp": 2}))
+        g = ComputationGraph(self._graph_conf())
+        with pytest.raises(ValueError, match="tensor parallelism"):
+            ParallelTrainer(g, mesh, tp_axis="tp")
+        g2 = ComputationGraph(self._graph_conf())
+        mesh2 = make_mesh(MeshSpec({"dp": 4}))
+        with pytest.raises(ValueError, match="K-local-steps"):
+            ParallelTrainer(g2, mesh2, average_each_iteration=False)

@@ -1,0 +1,136 @@
+"""Trainable statistical NLP: HMM PoS tagger + PCFG CKY parser.
+
+These replace the round-1 rule-based stand-ins for the reference's
+trained UIMA annotators (PosUimaTokenizer / TreeParser pipeline).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.pcfg import PcfgParser
+from deeplearning4j_tpu.nlp.pos import HmmPosTagger
+from deeplearning4j_tpu.nlp.tree_parser import (
+    ParseTree,
+    TreeParser,
+    TreeVectorizer,
+)
+
+
+def _tagged_corpus():
+    # "flies" is NN after a determiner, VB after a noun — only a
+    # contextual model can split these.
+    return [
+        [("the", "DT"), ("flies", "NN"), ("buzz", "VB")],
+        [("a", "DT"), ("flies", "NN"), ("land", "VB")],
+        [("the", "DT"), ("bird", "NN"), ("flies", "VB")],
+        [("a", "DT"), ("plane", "NN"), ("flies", "VB")],
+        [("the", "DT"), ("dog", "NN"), ("barked", "VB")],
+        [("a", "DT"), ("cat", "NN"), ("jumped", "VB")],
+        [("the", "DT"), ("dog", "NN"), ("walked", "VB")],
+    ] * 3
+
+
+class TestHmmPosTagger:
+    def test_context_disambiguates_same_word(self):
+        tagger = HmmPosTagger().fit(_tagged_corpus())
+        tags1 = tagger.tag_sequence(["the", "flies", "buzz"])
+        tags2 = tagger.tag_sequence(["the", "bird", "flies"])
+        assert tags1 == ["DT", "NN", "VB"]
+        assert tags2 == ["DT", "NN", "VB"]
+        # same surface form, different position, different tag
+        assert tags1[1] == "NN" and tags2[2] == "VB"
+
+    def test_oov_suffix_backoff(self):
+        tagger = HmmPosTagger().fit(_tagged_corpus())
+        # unseen -ed verb after a noun: the shape class learned from
+        # rare words ("barked"/"jumped"/"walked") plus NN->VB
+        # transitions must carry it
+        tags = tagger.tag_sequence(["the", "dog", "hopped"])
+        assert tags == ["DT", "NN", "VB"]
+
+    def test_single_token_interface_compat(self):
+        tagger = HmmPosTagger().fit(_tagged_corpus())
+        assert tagger.tag("the") == "DT"
+        assert tagger.tag("") == "NONE"
+
+    def test_tree_parser_accepts_hmm_tagger(self):
+        tagger = HmmPosTagger().fit(_tagged_corpus())
+        tree = TreeParser(tagger=tagger).parse("the dog barked")
+        assert tree.label == "S"
+        assert tree.yield_words() == ["the", "dog", "barked"]
+
+    def test_unfitted_raises(self):
+        with pytest.raises(ValueError):
+            HmmPosTagger().tag_sequence(["x"])
+
+
+def _toy_trees():
+    def pre(t, w):
+        return ParseTree(label=t, children=[ParseTree(label=t, word=w)])
+
+    def np_(*kids):
+        return ParseTree(label="NP", children=list(kids))
+
+    def vp(*kids):
+        return ParseTree(label="VP", children=list(kids))
+
+    def s(*kids):
+        return ParseTree(label="S", children=list(kids))
+
+    trees = []
+    for det, noun, verb, obj in [
+        ("the", "dog", "sees", "cat"),
+        ("a", "cat", "sees", "dog"),
+        ("the", "cat", "likes", "bird"),
+        ("a", "bird", "likes", "dog"),
+    ]:
+        trees.append(
+            s(np_(pre("DT", det), pre("NN", noun)),
+              vp(pre("VB", verb), np_(pre("DT", "the"), pre("NN", obj))))
+        )
+    return trees
+
+
+class TestPcfgParser:
+    def test_parses_novel_combination_with_learned_bracketing(self):
+        parser = PcfgParser().fit(_toy_trees())
+        tree = parser.parse("a dog likes the bird")
+        assert tree.yield_words() == ["a", "dog", "likes", "the", "bird"]
+        assert tree.label == "S"
+        # learned S -> NP VP bracketing: first constituent spans 2 words
+        assert tree.children[0].yield_words() == ["a", "dog"]
+        labels = {tree.children[0].label, tree.children[1].label}
+        assert "NP" in labels
+
+    def test_oov_word_still_parses(self):
+        parser = PcfgParser().fit(_toy_trees())
+        tree = parser.parse("the wug sees the dog")
+        assert tree.yield_words() == ["the", "wug", "sees", "the", "dog"]
+
+    def test_fallback_on_uncoverable_sentence(self):
+        parser = PcfgParser().fit(_toy_trees())
+        # 1 token: grammar has no full parse (needs NP VP); chunker
+        # fallback must still produce a tree
+        tree = parser.parse("dog")
+        assert tree.yield_words() == ["dog"]
+
+    def test_feeds_tree_vectorizer(self):
+        parser = PcfgParser().fit(_toy_trees())
+        tv = TreeVectorizer(parser=parser)
+        rntn_trees = tv.get_trees_with_labels("the dog sees the cat")
+        assert len(rntn_trees) == 1
+
+    def test_unfitted_raises(self):
+        with pytest.raises(ValueError):
+            PcfgParser().parse_tokens(["x"])
+
+    def test_preterminals_exclude_phrase_labels(self):
+        """Phrase nonterminals (S/NP/VP) must never seed lexical cells:
+        two OOV tokens have no NP VP cover, so parse_tokens returns None
+        and parse() falls back to the chunker — not a malformed tree
+        with phrase labels directly dominating words."""
+        parser = PcfgParser().fit(_toy_trees())
+        assert set(parser._preterminals) == {"DT", "NN", "VB"}
+        assert parser.parse_tokens(["zzz", "qqq"]) is None
+        tree = parser.parse("zzz qqq")  # chunker fallback
+        assert tree.yield_words() == ["zzz", "qqq"]
