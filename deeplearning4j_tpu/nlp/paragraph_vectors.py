@@ -79,8 +79,13 @@ class ParagraphVectors(SequenceVectors):
 
     # Override pair mining: every (word, label) pair of each doc.
     def _mine_pairs(self, sequences, rng):
+        # Mixed code lengths per batch -> always the full padded
+        # Huffman-path slice (the code-length class split in
+        # SequenceVectors._pad_and_batch is a skip-gram mining concern).
+        lmax = self._code_lmax if self.use_hs else 0
         centers: List[int] = []
         contexts: List[int] = []
+        emitted = 0
         for lbl, toks in sequences:
             li = self.vocab.index_of(lbl)
             if li < 0:
@@ -92,12 +97,17 @@ class ParagraphVectors(SequenceVectors):
                     yield (
                         np.asarray(centers, np.int32),
                         np.asarray(contexts, np.int32),
+                        lmax,
+                        emitted,
                     )
+                    emitted += len(centers)
                     centers, contexts = [], []
         if centers:
             yield (
                 np.asarray(centers, np.int32),
                 np.asarray(contexts, np.int32),
+                lmax,
+                emitted,
             )
 
     # ------------------------------------------------------------------

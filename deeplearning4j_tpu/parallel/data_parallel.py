@@ -63,6 +63,34 @@ def tp_param_specs(net, mesh_axis: str = "tp"):
     return specs
 
 
+def ep_param_specs(net, mesh_axis: str = "ep",
+                   base: Optional[dict] = None):
+    """Overlay expert sharding onto a param-spec pytree: MoeDense
+    expert tensors carry their leading expert axis on ``mesh_axis``;
+    under pjit XLA turns the capacity-dispatch einsums into the expert
+    all-to-all (GSPMD counterpart of the explicit
+    parallel/expert_parallel.make_ep_moe schedule)."""
+    from deeplearning4j_tpu.nn.layers.moe import MoeDense
+
+    n_ep = None
+    specs = dict(base) if base else {}
+    for i, c in enumerate(net.conf.confs):
+        lc = c.layer
+        layer_specs = dict(specs.get(str(i), {}))
+        if isinstance(lc, MoeDense):
+            layer_specs["W_up"] = P(mesh_axis, None, None)
+            layer_specs["W_down"] = P(mesh_axis, None, None)
+            n_ep = lc.n_experts
+        for name in net.params[str(i)]:
+            layer_specs.setdefault(name, P())
+        specs[str(i)] = layer_specs
+    if n_ep is None:
+        raise ValueError(
+            "ep_axis was configured but the network has no MoeDense "
+            "layers to shard")
+    return specs
+
+
 class ParallelTrainer:
     """Synchronous SPMD trainer wrapping a MultiLayerNetwork.
 
@@ -78,6 +106,7 @@ class ParallelTrainer:
         mesh: Mesh,
         dp_axis: str = "dp",
         tp_axis: Optional[str] = None,
+        ep_axis: Optional[str] = None,
         average_each_iteration: bool = True,
         local_steps: int = 1,
         accumulate_gradients: bool = False,
@@ -90,10 +119,26 @@ class ParallelTrainer:
         # ComputationGraph duck type: multi-input coercion + dict params
         self.is_graph = hasattr(net, "_coerce_multi")
         self.tp_axis = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
-        if self.is_graph and self.tp_axis:
+        self.ep_axis = ep_axis if (ep_axis and ep_axis in mesh.axis_names) else None
+        if self.is_graph and (self.tp_axis or self.ep_axis):
             raise ValueError(
-                "tensor parallelism (tp_axis) supports MultiLayerNetwork "
-                "only; ComputationGraph trains dp-sharded")
+                "tensor/expert parallelism (tp_axis/ep_axis) supports "
+                "MultiLayerNetwork only; ComputationGraph trains "
+                "dp-sharded")
+        if self.ep_axis:
+            from deeplearning4j_tpu.nn.layers.moe import MoeDense
+
+            for c in net.conf.confs:
+                if (isinstance(c.layer, MoeDense)
+                        and c.layer.n_experts % mesh.shape[ep_axis]):
+                    raise ValueError(
+                        f"n_experts {c.layer.n_experts} not divisible "
+                        f"by mesh ep={mesh.shape[ep_axis]}")
+                if isinstance(c.layer, MoeDense) and c.layer.ep_axis:
+                    raise ValueError(
+                        "MoeDense.ep_axis (explicit shard_map all-to-all)"
+                        " and ParallelTrainer ep_axis (GSPMD sharding) "
+                        "are alternative dispatch paths; configure one")
         if self.is_graph and not average_each_iteration:
             raise ValueError(
                 "K-local-steps-then-average supports MultiLayerNetwork "
@@ -111,6 +156,10 @@ class ParallelTrainer:
             raise ValueError(
                 "accumulate_gradients applies to the per-step synchronous "
                 "mode; K-local-steps mode averages parameters instead")
+        if self.ep_axis and not average_each_iteration:
+            raise ValueError(
+                "expert-sharded params require the per-step synchronous "
+                "mode (K-local-steps shard_maps with replicated params)")
         if not average_each_iteration and net.state:
             raise ValueError(
                 "K-local-steps-then-average mode does not support layers "
@@ -128,6 +177,8 @@ class ParallelTrainer:
                 lambda _: P(), self.net.params,
                 is_leaf=lambda x: isinstance(x, jax.Array),
             )
+        if self.ep_axis:
+            specs = ep_param_specs(self.net, self.ep_axis, base=specs)
         return jax.tree.map(
             lambda s: NamedSharding(self.mesh, s),
             specs,

@@ -385,7 +385,7 @@ class TestGraphParallelTrainer:
 
         mesh = make_mesh(MeshSpec({"dp": 2, "tp": 2}))
         g = ComputationGraph(self._graph_conf())
-        with pytest.raises(ValueError, match="tensor parallelism"):
+        with pytest.raises(ValueError, match="tensor/expert parallelism"):
             ParallelTrainer(g, mesh, tp_axis="tp")
         g2 = ComputationGraph(self._graph_conf())
         mesh2 = make_mesh(MeshSpec({"dp": 4}))

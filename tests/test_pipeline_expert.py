@@ -448,3 +448,90 @@ class TestPipelineTrainer:
         ds = DataSet(x, y)
         scores = [trainer.fit(ds) for _ in range(10)]
         assert scores[-1] < scores[0], scores
+
+
+class TestConfLevelExpertParallel:
+    """ParallelTrainer ep_axis: MoeDense expert tensors sharded over the
+    mesh ep axis, GSPMD inserting the expert collectives."""
+
+    def _net(self):
+        from deeplearning4j_tpu.models.zoo import moe_transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = moe_transformer_lm(
+            n_in=8, width=8, n_blocks=1, n_heads=2, n_classes=4,
+            n_experts=4, n_hidden=16, lr=1e-2,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self, n=8, c=8, t=6, k=4, seed=1):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, c, t)).astype(np.float32)
+        y = np.zeros((n, k, t), np.float32)
+        idx = rng.integers(0, k, (n, t))
+        for i in range(n):
+            y[i, idx[i], np.arange(t)] = 1.0
+        return DataSet(x, y)
+
+    def test_expert_params_sharded_and_trajectory_matches(self):
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+
+        ds = self._data()
+        mesh = make_mesh(MeshSpec({"dp": 2, "ep": 4}))
+        net_ep = self._net()
+        trainer = ParallelTrainer(net_ep, mesh, ep_axis="ep")
+        # the MoE layer's expert tensors actually carry the ep axis
+        moe_key = next(
+            k for k in net_ep.params
+            if "W_up" in net_ep.params[k])
+        spec = net_ep.params[moe_key]["W_up"].sharding.spec
+        assert spec[0] == "ep", spec
+
+        net_ref = self._net()
+        ref_trainer = ParallelTrainer(
+            net_ref, make_mesh(MeshSpec({"dp": 2})))
+        for _ in range(4):
+            s_ep = trainer.fit(ds)
+            s_ref = ref_trainer.fit(ds)
+            np.testing.assert_allclose(s_ep, s_ref, rtol=1e-4)
+        for k in net_ref.params:
+            for name in net_ref.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(net_ep.params[k][name]),
+                    np.asarray(net_ref.params[k][name]),
+                    rtol=1e-4, atol=1e-5,
+                )
+
+    def test_rejects_indivisible_and_double_configured(self):
+        import pytest
+
+        from deeplearning4j_tpu.models.zoo import moe_transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+
+        mesh = make_mesh(MeshSpec({"dp": 2, "ep": 4}))
+        conf = moe_transformer_lm(n_in=8, width=8, n_blocks=1, n_heads=2,
+                                  n_classes=4, n_experts=3, n_hidden=16)
+        with pytest.raises(ValueError, match="divisible"):
+            ParallelTrainer(MultiLayerNetwork(conf).init(), mesh,
+                            ep_axis="ep")
+        conf2 = moe_transformer_lm(n_in=8, width=8, n_blocks=1, n_heads=2,
+                                   n_classes=4, n_experts=4, n_hidden=16,
+                                   ep_axis="ep")
+        with pytest.raises(ValueError, match="alternative dispatch"):
+            ParallelTrainer(MultiLayerNetwork(conf2).init(), mesh,
+                            ep_axis="ep")
+
+    def test_ep_without_moe_layers_raises(self):
+        import pytest
+
+        from deeplearning4j_tpu.models.zoo import mlp
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+
+        mesh = make_mesh(MeshSpec({"dp": 2, "ep": 4}))
+        net = MultiLayerNetwork(mlp((8, 6, 2))).init()
+        with pytest.raises(ValueError, match="no MoeDense"):
+            ParallelTrainer(net, mesh, ep_axis="ep")
