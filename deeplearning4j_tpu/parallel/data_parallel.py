@@ -188,12 +188,24 @@ class ParallelTrainer:
     def _place_params(self) -> None:
         shardings = self._param_sharding()
         self.net.params = jax.device_put(self.net.params, shardings)
-        # Updater state mirrors param shapes; give it the same placement.
-        ushard = jax.tree.map(
-            lambda _: NamedSharding(self.mesh, P()),
-            self.net.updater_state,
-            is_leaf=lambda x: isinstance(x, jax.Array),
-        )
+        # Updater state: each moment subtree (Adam m/v, Nesterovs v, …)
+        # mirrors the layer's param pytree, so it takes the SAME
+        # shardings — replicating Adam moments of ep/tp-sharded params
+        # would hold the full unsharded tensors on every device and
+        # reshard against sharded gradients each step.
+        repl = NamedSharding(self.mesh, P())
+        ushard = {}
+        for si, moments in self.net.updater_state.items():
+            layer = {}
+            for mk, sub in (moments or {}).items():
+                try:
+                    layer[mk] = jax.tree.map(lambda s, _: s,
+                                             shardings[si], sub)
+                except ValueError:  # structure doesn't mirror params
+                    layer[mk] = jax.tree.map(
+                        lambda _: repl, sub,
+                        is_leaf=lambda x: isinstance(x, jax.Array))
+            ushard[si] = layer
         self.net.updater_state = jax.device_put(self.net.updater_state, ushard)
         if self.net.state:
             self.net.state = jax.device_put(

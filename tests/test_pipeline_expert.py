@@ -488,6 +488,10 @@ class TestConfLevelExpertParallel:
             if "W_up" in net_ep.params[k])
         spec = net_ep.params[moe_key]["W_up"].sharding.spec
         assert spec[0] == "ep", spec
+        # Adam moments of expert-sharded params carry the SAME sharding
+        # (replicated moments would hold full tensors on every device).
+        mspec = net_ep.updater_state[moe_key]["m"]["W_up"].sharding.spec
+        assert mspec[0] == "ep", mspec
 
         net_ref = self._net()
         ref_trainer = ParallelTrainer(
