@@ -105,6 +105,40 @@ def lenet5(
     )
 
 
+def image_captioner(
+    embed_dim: int = 32,
+    n_hidden: int = 32,
+    vocab: int = 64,
+    lr: float = 1e-2,
+    seed: int = 12345,
+):
+    """Karpathy-style captioning stack on the dedicated ImageLSTM
+    (reference nn/layers/recurrent/ImageLSTM.java semantics — see
+    nn/layers/recurrent.ImageLSTMImpl): input [N, embed_dim, 1+T] whose
+    step 0 is the image embedding and steps 1.. are word embeddings; the
+    ImageLSTM decodes the word steps to vocab logits [N, vocab, T],
+    which the RnnOutputLayer turns into per-step softmax + MCXENT
+    against next-word labels [N, vocab, T]."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(Updater.ADAM)
+        .weight_init(WeightInit.XAVIER)
+        .list()
+        .layer(0, L.ImageLSTM(n_in=embed_dim, n_out=vocab,
+                              n_hidden=n_hidden, activation="tanh"))
+        .layer(
+            1,
+            L.RnnOutputLayer(
+                n_in=vocab, n_out=vocab, activation="softmax",
+                loss_function=LossFunction.MCXENT,
+            ),
+        )
+        .build()
+    )
+
+
 def lstm_classifier(
     n_in: int,
     n_hidden: int,

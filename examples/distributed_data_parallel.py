@@ -1,6 +1,7 @@
 """Data-parallel training over a device mesh with fused multi-step scans.
 
-Run on N chips (or simulate): XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+Simulates an 8-device CPU mesh by default; DL4J_EXAMPLES_PLATFORM=native
+keeps whatever platform JAX selected (real chips):
     python examples/distributed_data_parallel.py
 """
 
@@ -9,9 +10,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 import jax
+
+if os.environ.get("DL4J_EXAMPLES_PLATFORM", "cpu") == "cpu":
+    # --xla_force_host_platform_device_count only multiplies CPU
+    # devices; force the CPU backend so the simulated mesh exists even
+    # where an accelerator plugin is registered.
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
 
 from deeplearning4j_tpu.models.zoo import mlp
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork

@@ -1,15 +1,25 @@
 """Mixture-of-experts transformer trained expert-parallel + a GPipe
 pipeline run of a conf-built MLP — the round-2 parallelism surface.
 
-Run on N devices (or simulate):
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-      python examples/moe_expert_parallel.py
+Simulates an 8-device mesh on CPU by default (the same code runs
+unchanged on real chips: DL4J_EXAMPLES_PLATFORM=native keeps whatever
+platform JAX selected):
+  python examples/moe_expert_parallel.py
 """
 
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+if os.environ.get("DL4J_EXAMPLES_PLATFORM", "cpu") == "cpu":
+    # --xla_force_host_platform_device_count only multiplies CPU
+    # devices; force the CPU backend so the simulated mesh exists even
+    # where an accelerator plugin is registered.
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 

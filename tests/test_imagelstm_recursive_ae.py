@@ -246,3 +246,44 @@ class TestRecursiveAutoEncoder:
         w_before = np.asarray(net.params["0"]["W"]).copy()
         net.pretrain(it)
         assert not np.allclose(w_before, np.asarray(net.params["0"]["W"]))
+
+
+class TestImageCaptionerZoo:
+    """End-to-end captioning on the dedicated ImageLSTM (zoo entry):
+    the image embedding at step 0 must steer the caption tokens."""
+
+    def test_learns_image_conditioned_captions(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import image_captioner
+
+        embed, vocab, t_words = 8, 6, 4
+        rng = np.random.default_rng(0)
+        # two "images", each with a fixed caption token sequence
+        img = rng.normal(size=(2, embed)).astype(np.float32) * 2.0
+        captions = np.array([[1, 2, 3, 4], [4, 3, 2, 1]])
+        word_embed = rng.normal(size=(vocab, embed)).astype(np.float32)
+
+        def seq_for(i):
+            # [embed, 1+T]: image step then teacher-forced word steps
+            words = word_embed[captions[i, :-1]]
+            start = np.zeros((1, embed), np.float32)  # BOS embedding
+            steps = np.concatenate([img[i:i + 1], start, words], axis=0)
+            return steps.T  # [C, 1+T]
+
+        x = np.stack([seq_for(i) for i in range(2)])
+        y = np.zeros((2, vocab, t_words), np.float32)
+        for i in range(2):
+            y[i, captions[i], np.arange(t_words)] = 1.0
+
+        net = MultiLayerNetwork(image_captioner(
+            embed_dim=embed, n_hidden=16, vocab=vocab, lr=5e-2)).init()
+        ds = DataSet(x, y)
+        scores = []
+        for _ in range(60):
+            net.fit(ds)
+            scores.append(float(net.score_value))
+        assert scores[-1] < scores[0] * 0.5, (scores[0], scores[-1])
+        # the two images must yield their own caption sequences
+        out = np.asarray(net.output(x))  # [2, vocab, T]
+        pred = out.argmax(axis=1)
+        np.testing.assert_array_equal(pred, captions)

@@ -539,3 +539,54 @@ class TestConfLevelExpertParallel:
         net = MultiLayerNetwork(mlp((8, 6, 2))).init()
         with pytest.raises(ValueError, match="no MoeDense"):
             ParallelTrainer(net, mesh, ep_axis="ep")
+
+
+class TestMoeInComputationGraph:
+    """MoeDense as a graph vertex: aux loss reaches the graph score via
+    ComputationGraph._aux_score (the graph-side state channel)."""
+
+    def _graph(self, aux_w):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers.moe import MoeDense
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(9)
+            .learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("moe", MoeDense(n_in=8, n_out=8, n_experts=2,
+                                       n_hidden=16, aux_weight=aux_w),
+                       "in")
+            .add_layer(
+                "out",
+                L.OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function=LossFunction.MCXENT),
+                "moe",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        return ComputationGraph(conf).init()
+
+    def test_trains_and_aux_reaches_graph_score(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        ds = DataSet(x, y)
+
+        g0, g_big = self._graph(0.0), self._graph(10.0)
+        g0.fit(ds)
+        g_big.fit(ds)
+        assert float(g_big.score_value) > float(g0.score_value) + 1.0
+
+        scores = []
+        for _ in range(15):
+            g0.fit(ds)
+            scores.append(float(g0.score_value))
+        assert scores[-1] < scores[0]
