@@ -310,14 +310,38 @@ class MultiLayerNetwork:
 
         return jax.jit(steps, donate_argnums=(0, 1, 2))
 
+    @functools.cached_property
+    def _train_steps_scan_masked(self):
+        """Masked variant of _train_steps_scan: the per-batch feature and
+        label masks ride the scan as extra xs, so masked time-series
+        training gets the same one-dispatch-per-K-batches fast path."""
+
+        def steps(params, state, upd_state, iteration, rng, feats, labels,
+                  fms, lms, grad_scale=1.0):
+            def body(carry, inp):
+                p, s, u, it, key = carry
+                key, sub = jax.random.split(key)
+                f, y, fm, lm = inp
+                p, s, u, score = self._step_body(
+                    p, s, u, it, sub, f, y, fm, lm, grad_scale)
+                return (p, s, u, it + 1, key), score
+
+            (p, s, u, it, _), scores = jax.lax.scan(
+                body, (params, state, upd_state, iteration, rng),
+                (feats, labels, fms, lms))
+            return p, s, u, scores
+
+        return jax.jit(steps, donate_argnums=(0, 1, 2))
+
     def fit_scan(self, features_stacked, labels_stacked,
+                 features_mask_stacked=None, labels_mask_stacked=None,
                  grad_scale: float = 1.0):
         """Run one scanned pass over pre-stacked batches
-        ([K, B, ...], [K, B, n_out]); returns the K per-step scores as a
-        device array (convert with np.asarray to force a sync — kept lazy
-        here so chained calls pipeline without a host round-trip each).
-        Unmasked plain-SGD fast path — use fit() when masks, tBPTT, or a
-        second-order solver are configured."""
+        ([K, B, ...], [K, B, n_out], optional masks [K, B, T]); returns
+        the K per-step scores as a device array (convert with np.asarray
+        to force a sync — kept lazy here so chained calls pipeline
+        without a host round-trip each). Plain-SGD fast path — use fit()
+        when tBPTT or a second-order solver is configured."""
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
             raise ValueError(
                 "fit_scan is the full-BPTT SGD fast path; truncated-BPTT "
@@ -331,10 +355,25 @@ class MultiLayerNetwork:
         labels = jnp.asarray(labels_stacked, self._dtype)
         self._key, sub = jax.random.split(self._key)
         start = self.iteration
-        self.params, self.state, self.updater_state, scores = (
-            self._train_steps_scan(
-                self.params, self.state, self.updater_state,
-                self.iteration, sub, feats, labels, grad_scale))
+        if features_mask_stacked is not None or labels_mask_stacked is not None:
+            # Synthesize the missing mask as all-ones so one masked
+            # kernel covers every presence combination.
+            fms = (jnp.asarray(features_mask_stacked)
+                   if features_mask_stacked is not None
+                   else jnp.ones(feats.shape[:2] + (feats.shape[-1],),
+                                 self._dtype))
+            lms = (jnp.asarray(labels_mask_stacked)
+                   if labels_mask_stacked is not None
+                   else jnp.ones(labels.shape[:2] + (labels.shape[-1],),
+                                 self._dtype))
+            step_fn = self._train_steps_scan_masked
+            extra = (fms, lms)
+        else:
+            step_fn = self._train_steps_scan
+            extra = ()
+        self.params, self.state, self.updater_state, scores = step_fn(
+            self.params, self.state, self.updater_state,
+            self.iteration, sub, feats, labels, *extra, grad_scale)
         self.iteration += int(feats.shape[0])
         self.score_value = scores[-1]  # lazy device scalar, like _fit_batch
         for listener in self.listeners:

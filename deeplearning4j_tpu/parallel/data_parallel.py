@@ -245,13 +245,16 @@ class ParallelTrainer:
             NamedSharding(self.mesh, P(None, self.dp_axis)),
         )
 
-    def fit_scan(self, features_stacked, labels_stacked):
+    def fit_scan(self, features_stacked, labels_stacked,
+                 features_mask_stacked=None, labels_mask_stacked=None):
         """K fused global steps: ``lax.scan`` over pre-stacked sharded
         batches ([K, B, ...] with B split over the dp axis) — one host
         dispatch per K synchronous all-reduced steps. The pod-scale
         composition of MultiLayerNetwork/ComputationGraph.fit_scan: XLA
         inserts the gradient all-reduce inside the scan body, so the ICI
-        collective pipelines with compute across all K steps."""
+        collective pipelines with compute across all K steps. Masked
+        time-series batches ([K, B, T] masks) ride the same fused path
+        (MultiLayerNetwork only)."""
         if not self.average_each_iteration:
             raise ValueError(
                 "fit_scan is the per-step-synchronous path; "
@@ -260,16 +263,28 @@ class ParallelTrainer:
         # the placement, and the net-level guards (tBPTT, non-SGD) and
         # listener cadence apply identically here.
         if self.is_graph:
+            if (features_mask_stacked is not None
+                    or labels_mask_stacked is not None):
+                raise ValueError(
+                    "masked fit_scan supports MultiLayerNetwork only; "
+                    "masked graphs train via fit()")
             # dict of [K, B, ...] inputs / list of [K, B, ...] labels
             features_stacked = jax.tree.map(
                 self._shard_stacked, features_stacked)
             labels_stacked = jax.tree.map(
                 self._shard_stacked, labels_stacked)
-        else:
-            features_stacked = self._shard_stacked(features_stacked)
-            labels_stacked = self._shard_stacked(labels_stacked)
+            return self.net.fit_scan(
+                features_stacked, labels_stacked,
+                grad_scale=self._grad_scale())
+        features_stacked = self._shard_stacked(features_stacked)
+        labels_stacked = self._shard_stacked(labels_stacked)
+        fms = (None if features_mask_stacked is None
+               else self._shard_stacked(features_mask_stacked))
+        lms = (None if labels_mask_stacked is None
+               else self._shard_stacked(labels_mask_stacked))
         return self.net.fit_scan(
             features_stacked, labels_stacked,
+            features_mask_stacked=fms, labels_mask_stacked=lms,
             grad_scale=self._grad_scale())
 
     # ------------------------------------------------------------------

@@ -391,3 +391,32 @@ class TestGraphParallelTrainer:
         mesh2 = make_mesh(MeshSpec({"dp": 4}))
         with pytest.raises(ValueError, match="K-local-steps"):
             ParallelTrainer(g2, mesh2, average_each_iteration=False)
+
+
+class TestMaskedParallelFitScan:
+    def test_masked_batches_over_dp_mesh(self):
+        from deeplearning4j_tpu.models.zoo import lstm_classifier
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        rng = np.random.default_rng(0)
+        k, b, t = 3, 8, 6
+        feats = rng.normal(size=(k, b, 5, t)).astype(np.float32)
+        labels = np.zeros((k, b, 3, t), np.float32)
+        idx = rng.integers(0, 3, (k, b, t))
+        for i in range(k):
+            for j in range(b):
+                labels[i, j, idx[i, j], np.arange(t)] = 1.0
+        lens = rng.integers(2, t + 1, (k, b))
+        fm = (np.arange(t)[None, None, :] < lens[:, :, None]).astype(
+            np.float32)
+
+        net = MultiLayerNetwork(lstm_classifier(
+            n_in=5, n_hidden=8, n_classes=3, lr=0.05))
+        trainer = ParallelTrainer(net, make_mesh(MeshSpec({"dp": 4})))
+        scores = trainer.fit_scan(feats, labels,
+                                  features_mask_stacked=fm,
+                                  labels_mask_stacked=fm)
+        s = np.asarray(scores)
+        assert s.shape == (k,) and np.all(np.isfinite(s))
