@@ -338,13 +338,44 @@ class ComputationGraph:
 
         return jax.jit(steps, donate_argnums=(0, 1, 2))
 
+    @functools.cached_property
+    def _train_steps_scan_masked(self):
+        """Masked variant of _train_steps_scan: mask dicts ride the scan
+        as extra xs (a dict pytree scans leaf-wise; an absent mask is an
+        EMPTY dict, which contributes no scan leaves and which the loss
+        path already treats like None), so masked graphs keep the fused
+        fast path — one compiled kernel per mask-dict structure, keyed
+        by jit itself."""
+
+        def steps(params, state, upd_state, iteration, rng, inputs_k,
+                  labels_k, masks_k, lmasks_k, grad_scale=1.0):
+            def body(carry, inp):
+                p, s, u, it, k = carry
+                k, sub = jax.random.split(k)
+                xs, ys, m, lm = inp
+                p, s, u, score = self._step_body(
+                    p, s, u, it, sub, xs, ys, m, lm, grad_scale)
+                return (p, s, u, it + 1, k), score
+
+            (p, s, u, it, _), scores = jax.lax.scan(
+                body, (params, state, upd_state, iteration, rng),
+                (inputs_k, labels_k, masks_k, lmasks_k))
+            return p, s, u, scores
+
+        return jax.jit(steps, donate_argnums=(0, 1, 2))
+
     def fit_scan(self, inputs_stacked, labels_stacked,
+                 masks_stacked=None, label_masks_stacked=None,
                  grad_scale: float = 1.0):
         """Run K fused steps over pre-stacked batches. ``inputs_stacked``:
         dict input-name -> [K, B, ...] (or a single array for
         single-input graphs); ``labels_stacked``: list of [K, B, ...]
-        per output (or a single array). Unmasked plain-SGD fast path;
-        returns the K per-step scores lazily (device array)."""
+        per output (or a single array). Optional masks:
+        ``masks_stacked`` dict input-name -> [K, B, T] (or a single
+        array for single-input graphs), ``label_masks_stacked`` dict
+        output-name -> [K, B, T] — they ride the scan as extra xs, so
+        masked time-series graphs get the same fused fast path.
+        Plain-SGD; returns the K per-step scores lazily (device array)."""
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
             raise ValueError(
                 "fit_scan is the full-BPTT SGD fast path; truncated-BPTT "
@@ -372,12 +403,44 @@ class ComputationGraph:
         inputs_k = {k: jnp.asarray(v, self._dtype)
                     for k, v in inputs_stacked.items()}
         labels_k = [jnp.asarray(y, self._dtype) for y in labels_stacked]
+        if masks_stacked is not None and not isinstance(masks_stacked, dict):
+            masks_stacked = {self.conf.network_inputs[0]: masks_stacked}
+        if (label_masks_stacked is not None
+                and not isinstance(label_masks_stacked, dict)):
+            label_masks_stacked = {
+                self.conf.network_outputs[0]: label_masks_stacked}
+        # Mask keys are looked up with .get() downstream, so a mistyped
+        # name would silently train unmasked — validate here.
+        if masks_stacked is not None:
+            bad = set(masks_stacked) - set(self.conf.network_inputs)
+            if bad:
+                raise ValueError(
+                    f"masks_stacked has keys {sorted(bad)} that are not "
+                    f"network inputs {sorted(self.conf.network_inputs)}")
+        if label_masks_stacked is not None:
+            bad = set(label_masks_stacked) - set(self.conf.network_outputs)
+            if bad:
+                raise ValueError(
+                    f"label_masks_stacked has keys {sorted(bad)} that "
+                    f"are not network outputs "
+                    f"{sorted(self.conf.network_outputs)}")
+        masks_k = {k: jnp.asarray(v)
+                   for k, v in (masks_stacked or {}).items()}
+        lmasks_k = {k: jnp.asarray(v)
+                    for k, v in (label_masks_stacked or {}).items()}
         self._key, sub = jax.random.split(self._key)
         start = self.iteration
-        self.params, self.state, self.updater_state, scores = (
-            self._train_steps_scan(
-                self.params, self.state, self.updater_state,
-                self.iteration, sub, inputs_k, labels_k, grad_scale))
+        if masks_k or lmasks_k:
+            self.params, self.state, self.updater_state, scores = (
+                self._train_steps_scan_masked(
+                    self.params, self.state, self.updater_state,
+                    self.iteration, sub, inputs_k, labels_k,
+                    masks_k, lmasks_k, grad_scale))
+        else:
+            self.params, self.state, self.updater_state, scores = (
+                self._train_steps_scan(
+                    self.params, self.state, self.updater_state,
+                    self.iteration, sub, inputs_k, labels_k, grad_scale))
         k = int(next(iter(inputs_k.values())).shape[0])
         self.iteration += k
         self.score_value = scores[-1]

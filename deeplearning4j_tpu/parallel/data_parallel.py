@@ -253,8 +253,9 @@ class ParallelTrainer:
         composition of MultiLayerNetwork/ComputationGraph.fit_scan: XLA
         inserts the gradient all-reduce inside the scan body, so the ICI
         collective pipelines with compute across all K steps. Masked
-        time-series batches ([K, B, T] masks) ride the same fused path
-        (MultiLayerNetwork only)."""
+        time-series batches ride the same fused path: [K, B, T] arrays
+        for MultiLayerNetwork, per-input/per-output dicts for
+        ComputationGraph."""
         if not self.average_each_iteration:
             raise ValueError(
                 "fit_scan is the per-step-synchronous path; "
@@ -263,18 +264,21 @@ class ParallelTrainer:
         # the placement, and the net-level guards (tBPTT, non-SGD) and
         # listener cadence apply identically here.
         if self.is_graph:
-            if (features_mask_stacked is not None
-                    or labels_mask_stacked is not None):
-                raise ValueError(
-                    "masked fit_scan supports MultiLayerNetwork only; "
-                    "masked graphs train via fit()")
-            # dict of [K, B, ...] inputs / list of [K, B, ...] labels
+            # dict of [K, B, ...] inputs / list of [K, B, ...] labels /
+            # dict [K, B, T] masks — all dp-sharded leaf-wise
             features_stacked = jax.tree.map(
                 self._shard_stacked, features_stacked)
             labels_stacked = jax.tree.map(
                 self._shard_stacked, labels_stacked)
+            fms = (None if features_mask_stacked is None
+                   else jax.tree.map(self._shard_stacked,
+                                     features_mask_stacked))
+            lms = (None if labels_mask_stacked is None
+                   else jax.tree.map(self._shard_stacked,
+                                     labels_mask_stacked))
             return self.net.fit_scan(
                 features_stacked, labels_stacked,
+                masks_stacked=fms, label_masks_stacked=lms,
                 grad_scale=self._grad_scale())
         features_stacked = self._shard_stacked(features_stacked)
         labels_stacked = self._shard_stacked(labels_stacked)
