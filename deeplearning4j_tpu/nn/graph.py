@@ -319,43 +319,22 @@ class ComputationGraph:
     @functools.cached_property
     def _train_steps_scan(self):
         """K graph train steps fused into one lax.scan computation (the
-        ComputationGraph counterpart of MultiLayerNetwork.fit_scan)."""
-
-        def steps(params, state, upd_state, iteration, rng, inputs_k,
-                  labels_k, grad_scale=1.0):
-            def body(carry, inp):
-                p, s, u, it, key = carry
-                key, sub = jax.random.split(key)
-                xs, ys = inp
-                p, s, u, score = self._step_body(
-                    p, s, u, it, sub, xs, ys, None, None, grad_scale)
-                return (p, s, u, it + 1, key), score
-
-            (p, s, u, it, _), scores = jax.lax.scan(
-                body, (params, state, upd_state, iteration, rng),
-                (inputs_k, labels_k))
-            return p, s, u, scores
-
-        return jax.jit(steps, donate_argnums=(0, 1, 2))
-
-    @functools.cached_property
-    def _train_steps_scan_masked(self):
-        """Masked variant of _train_steps_scan: mask dicts ride the scan
-        as extra xs (a dict pytree scans leaf-wise; an absent mask is an
-        EMPTY dict, which contributes no scan leaves and which the loss
-        path already treats like None), so masked graphs keep the fused
-        fast path — one compiled kernel per mask-dict structure, keyed
-        by jit itself."""
+        ComputationGraph counterpart of MultiLayerNetwork.fit_scan).
+        Mask dicts ride the scan as extra xs (a dict pytree scans
+        leaf-wise): an absent mask is an EMPTY dict, which contributes
+        no scan leaves and which the loss path already treats like None
+        — one compiled kernel per mask-dict structure, keyed by jit
+        itself."""
 
         def steps(params, state, upd_state, iteration, rng, inputs_k,
                   labels_k, masks_k, lmasks_k, grad_scale=1.0):
             def body(carry, inp):
-                p, s, u, it, k = carry
-                k, sub = jax.random.split(k)
+                p, s, u, it, key = carry
+                key, sub = jax.random.split(key)
                 xs, ys, m, lm = inp
                 p, s, u, score = self._step_body(
                     p, s, u, it, sub, xs, ys, m, lm, grad_scale)
-                return (p, s, u, it + 1, k), score
+                return (p, s, u, it + 1, key), score
 
             (p, s, u, it, _), scores = jax.lax.scan(
                 body, (params, state, upd_state, iteration, rng),
@@ -430,17 +409,11 @@ class ComputationGraph:
                     for k, v in (label_masks_stacked or {}).items()}
         self._key, sub = jax.random.split(self._key)
         start = self.iteration
-        if masks_k or lmasks_k:
-            self.params, self.state, self.updater_state, scores = (
-                self._train_steps_scan_masked(
-                    self.params, self.state, self.updater_state,
-                    self.iteration, sub, inputs_k, labels_k,
-                    masks_k, lmasks_k, grad_scale))
-        else:
-            self.params, self.state, self.updater_state, scores = (
-                self._train_steps_scan(
-                    self.params, self.state, self.updater_state,
-                    self.iteration, sub, inputs_k, labels_k, grad_scale))
+        self.params, self.state, self.updater_state, scores = (
+            self._train_steps_scan(
+                self.params, self.state, self.updater_state,
+                self.iteration, sub, inputs_k, labels_k,
+                masks_k, lmasks_k, grad_scale))
         k = int(next(iter(inputs_k.values())).shape[0])
         self.iteration += k
         self.score_value = scores[-1]
