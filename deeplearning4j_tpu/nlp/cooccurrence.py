@@ -52,6 +52,7 @@ class DiskBackedCoOccurrences:
         self.max_pairs = int(max_pairs_in_memory)
         self._own_dir = spill_dir is None
         self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="dl4j_cooc_")
+        os.makedirs(self.spill_dir, exist_ok=True)
         self._counts: Dict[int, float] = {}  # key = row * V + col
         self._shards = []
         self._n_spills = 0

@@ -134,8 +134,9 @@ class Glove(SequenceVectors):
     def train_cooccurrences(self, rows, cols, xij,
                             learning_rate=None) -> float:
         """One shuffled pass over the given co-occurrence triples at a
-        fixed lr; returns the last batch loss — the incremental
-        granularity the distributed GlovePerformer dispatches at
+        fixed lr; returns the pair-weighted mean batch loss over the
+        pass — the incremental granularity the distributed
+        GlovePerformer dispatches at
         (reference scaleout/perform/models/glove/GlovePerformer.java)."""
         if not hasattr(self, "w"):
             raise ValueError("init_tables() (or fit) must run first")
@@ -149,7 +150,10 @@ class Glove(SequenceVectors):
         if not hasattr(self, "_glove_rng"):
             self._glove_rng = np.random.default_rng(self.seed)
         order = self._glove_rng.permutation(len(rows))
-        loss = float("nan")
+        # Device-scalar accumulation: one host sync per PASS, not per
+        # batch (a per-batch float() would serialize dispatch on the
+        # TPU tunnel, where transfers block behind queued compute).
+        loss_sum = jnp.zeros((), jnp.float32)
         for start in range(0, len(rows), self.batch_size):
             sel = order[start : start + self.batch_size]
             (self.w, self.wt, self.b, self.bt, self.gw, self.gwt,
@@ -159,9 +163,10 @@ class Glove(SequenceVectors):
                 jnp.asarray(rows[sel]), jnp.asarray(cols[sel]),
                 jnp.asarray(xij[sel]), lr,
             )
+            loss_sum = loss_sum + loss * len(sel)
         # Final embedding = w + wt (standard GloVe practice).
         self.syn0 = self.w + self.wt
-        return float(loss)
+        return float(loss_sum) / len(rows)
 
     def train_cooccurrence_batches(self, batches, learning_rate=None,
                                    shuffle_window: int = 8) -> float:
@@ -176,17 +181,24 @@ class Glove(SequenceVectors):
         exists to avoid). Peak memory: shuffle_window batches + tables."""
         if not hasattr(self, "w"):
             raise ValueError("init_tables() (or fit) must run first")
-        loss = 0.0
+        # Pair-count-weighted mean across flushes so the returned epoch
+        # loss is comparable to the in-memory path's full-pass loss (a
+        # bare last-flush loss would reflect only the final window).
+        loss_weighted_sum = 0.0
+        total_pairs = 0
         window: list = []
 
         def flush():
-            nonlocal loss
+            nonlocal loss_weighted_sum, total_pairs
             if not window:
                 return
             rows = np.concatenate([b[0] for b in window])
             cols = np.concatenate([b[1] for b in window])
             xij = np.concatenate([b[2] for b in window])
-            loss = self.train_cooccurrences(rows, cols, xij, learning_rate)
+            flush_loss = self.train_cooccurrences(
+                rows, cols, xij, learning_rate)
+            loss_weighted_sum += flush_loss * len(rows)
+            total_pairs += len(rows)
             window.clear()
 
         for batch in batches:
@@ -195,7 +207,7 @@ class Glove(SequenceVectors):
                 flush()
         flush()
         self.syn0 = self.w + self.wt
-        return loss
+        return loss_weighted_sum / total_pairs if total_pairs else 0.0
 
     def fit(
         self,

@@ -123,7 +123,15 @@ class NeuralNetConfiguration:
             if name in fields:
 
                 def setter(value):
-                    setattr(self._conf, name, value)
+                    # Accept the enum member or its name/value as a
+                    # string ("LBFGS", "lbfgs") — the tolerance the
+                    # reference gets from Jackson enum deserialization.
+                    from deeplearning4j_tpu.nn.conf.serde import (
+                        coerce_enum_value,
+                    )
+
+                    setattr(self._conf, name, coerce_enum_value(
+                        NeuralNetConfiguration, name, value))
                     return self
 
                 return setter

@@ -87,33 +87,52 @@ def from_jsonable(data: Any) -> Any:
 _HINTS_CACHE: dict[type, dict] = {}
 
 
-def _coerce_enums(obj):
-    """Coerce string field values back into Enum members where the dataclass
-    declared an Enum type (JSON carries only the value)."""
+def enum_field_type(cls: type, field_name: str):
+    """The Enum type a dataclass field is declared with (unwrapping
+    Optional/union hints), or None."""
     import typing
+    import types as _types
 
-    cls = type(obj)
     hints = _HINTS_CACHE.get(cls)
     if hints is None:
         hints = typing.get_type_hints(cls)
         _HINTS_CACHE[cls] = hints
+    t = hints.get(field_name)
+    if typing.get_origin(t) in (typing.Union, _types.UnionType):
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        enum_args = [
+            a for a in args if isinstance(a, type) and issubclass(a, enum.Enum)
+        ]
+        t = enum_args[0] if enum_args else None
+    if isinstance(t, type) and issubclass(t, enum.Enum):
+        return t
+    return None
+
+
+def coerce_enum_value(cls: type, field_name: str, value):
+    """Coerce a string into the field's Enum member, accepting either
+    the member NAME ("LBFGS") or its wire value ("lbfgs") — shared by
+    JSON deserialization and the fluent Builder setters."""
+    t = enum_field_type(cls, field_name)
+    if t is not None and isinstance(value, str) and not isinstance(value, t):
+        try:
+            return t[value.upper()]
+        except KeyError:
+            return t(value)
+    return value
+
+
+def _coerce_enums(obj):
+    """Coerce string field values back into Enum members where the dataclass
+    declared an Enum type (JSON carries only the value)."""
+    cls = type(obj)
     for f in dataclasses.fields(obj):
         v = getattr(obj, f.name)
         if not isinstance(v, str):
             continue
-        t = hints.get(f.name)
-        if t is None:
-            continue
-        import types as _types
-
-        if typing.get_origin(t) in (typing.Union, _types.UnionType):
-            args = [a for a in typing.get_args(t) if a is not type(None)]
-            enum_args = [
-                a for a in args if isinstance(a, type) and issubclass(a, enum.Enum)
-            ]
-            t = enum_args[0] if enum_args else None
-        if isinstance(t, type) and issubclass(t, enum.Enum):
-            object.__setattr__(obj, f.name, t(v))
+        coerced = coerce_enum_value(cls, f.name, v)
+        if coerced is not v:
+            object.__setattr__(obj, f.name, coerced)
     return obj
 
 

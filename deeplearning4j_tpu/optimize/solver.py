@@ -100,12 +100,13 @@ def backtrack_line_search(
         # Interpolation backtrack: quadratic on the first shrink, cubic
         # through the last two points after.
         if step_prev is None:
-            # First shrink: quadratic model. Clamped like the cubic
-            # branch — after a non-finite restart ``step`` may be < 1
-            # and the unclamped formula could jump back toward the
-            # divergent region.
-            denom = 2.0 * (phin - phi0 - slope)
-            tmp = -slope / denom if denom != 0.0 else rho * step
+            # First shrink: step-scaled quadratic model through phi(0),
+            # phi'(0), phi(step) — exact for any step, not just step==1
+            # (matters after a non-finite 0.2x restart). Clamped like
+            # the cubic branch as a safety bound.
+            denom = 2.0 * (phin - phi0 - slope * step)
+            tmp = (-slope * step * step / denom
+                   if denom != 0.0 else rho * step)
             tmp = min(tmp, 0.5 * step)
         else:
             rhs1 = phin - phi0 - step * slope
