@@ -71,6 +71,52 @@ def _resolve_compute_dtype(master_dtype, compute_dtype_name):
 _REGULARIZED_KEYS = ("W", "RW", "W_bwd", "RW_bwd")
 
 
+def layer_reg_score(c, layer_params):
+    """l1/l2 penalty of ONE layer's params — shared by the full-model
+    ``_reg_score`` and PipelineTrainer's per-stage reg branches (a fix
+    here must apply to both, or PP trajectories drift)."""
+    if not c.use_regularization:
+        return 0.0
+    l1 = float(c.resolved("l1") or 0.0)
+    l2 = float(c.resolved("l2") or 0.0)
+    if l1 == 0.0 and l2 == 0.0:
+        return 0.0
+    reg = 0.0
+    for name, p in layer_params.items():
+        if name not in _REGULARIZED_KEYS:
+            continue
+        if l1:
+            reg = reg + l1 * jnp.sum(jnp.abs(p))
+        if l2:
+            reg = reg + 0.5 * l2 * jnp.sum(p * p)
+    return reg
+
+
+def layer_update(c, updater, grads, upd_state, iteration, grad_scale=1.0):
+    """normalize -> scale -> updater rule for ONE layer; returns
+    (updates, new_state) and the caller applies ``params -= updates``.
+    Shared by ``_apply_updates`` and PipelineTrainer's per-stage update
+    branches.
+
+    grad_scale=1.0 normally; dp-size under ACCUM_GRADIENT-
+    without-divide (reference DIVIDE_ACCUM_GRADIENT=false: sum of
+    per-worker gradients = mean times worker count). Applied AFTER
+    normalization. NOTE: this computes n*normalize(mean), which matches
+    the reference's sum-of-per-worker-normalized gradients exactly for
+    plain SGD and whenever normalization is inactive or uniform across
+    workers; with per-worker clipping that differs between shards the
+    reference's sum can diverge from this global form (a documented
+    deviation — the global batch here is ONE gradient, not N)."""
+    g = normalize_gradients(
+        c.resolved("gradient_normalization"),
+        grads,
+        float(c.resolved("gradient_normalization_threshold")),
+    )
+    g = jax.tree.map(lambda a: a * grad_scale, g)
+    lr = resolve_lr(c, iteration)
+    return updater.update(g, upd_state, lr, iteration)
+
+
 class MultiLayerNetwork:
     """Sequential network over layer conf beans.
 
@@ -90,6 +136,10 @@ class MultiLayerNetwork:
         self._updaters = [make_layer_updater(c) for c in conf.confs]
         self._rnn_state: Dict[str, Any] = {}
         self._initialized = False
+        # Bumped by in-place param mutation APIs (set_param) so caches
+        # that mirror params (e.g. PipelineTrainer's stage-sharded
+        # buffers) can detect staleness without deep comparison.
+        self.params_version = 0
         self._dtype = _dtype_of(conf.dtype)
         self._compute_dtype = _resolve_compute_dtype(
             self._dtype, conf.compute_dtype)
@@ -211,19 +261,7 @@ class MultiLayerNetwork:
     def _reg_score(self, params):
         reg = 0.0
         for i, c in enumerate(self.conf.confs):
-            if not c.use_regularization:
-                continue
-            l1 = float(c.resolved("l1") or 0.0)
-            l2 = float(c.resolved("l2") or 0.0)
-            if l1 == 0.0 and l2 == 0.0:
-                continue
-            for name, p in params[str(i)].items():
-                if name not in _REGULARIZED_KEYS:
-                    continue
-                if l1:
-                    reg = reg + l1 * jnp.sum(jnp.abs(p))
-                if l2:
-                    reg = reg + 0.5 * l2 * jnp.sum(p * p)
+            reg = reg + layer_reg_score(c, params[str(i)])
         return reg
 
     def _aux_score(self, new_state):
@@ -248,26 +286,8 @@ class MultiLayerNetwork:
         new_upd = {}
         for i, (c, upd) in enumerate(zip(self.conf.confs, self._updaters)):
             si = str(i)
-            g = normalize_gradients(
-                c.resolved("gradient_normalization"),
-                grads[si],
-                float(c.resolved("gradient_normalization_threshold")),
-            )
-            # grad_scale=1.0 normally; dp-size under ACCUM_GRADIENT-
-            # without-divide (reference DIVIDE_ACCUM_GRADIENT=false: sum
-            # of per-worker gradients = mean times worker count). Applied
-            # AFTER normalization. NOTE: this computes n*normalize(mean),
-            # which matches the reference's sum-of-per-worker-normalized
-            # gradients exactly for plain SGD and whenever normalization
-            # is inactive or uniform across workers; with per-worker
-            # clipping that differs between shards the reference's sum
-            # can diverge from this global form (a documented deviation —
-            # the global batch here is ONE gradient, not N).
-            g = jax.tree.map(lambda a: a * grad_scale, g)
-            lr = resolve_lr(c, iteration)
-            updates, new_upd[si] = upd.update(
-                g, upd_state[si], lr, iteration
-            )
+            updates, new_upd[si] = layer_update(
+                c, upd, grads[si], upd_state[si], iteration, grad_scale)
             new_params[si] = jax.tree.map(
                 lambda p, u: p - u, params[si], updates
             )
@@ -629,6 +649,7 @@ class MultiLayerNetwork:
     def set_params_flat(self, flat) -> None:
         _, unravel = ravel_pytree(self.params)
         self.params = unravel(jnp.asarray(flat))
+        self.params_version += 1
 
     def num_params(self) -> int:
         return int(self.params_flat().shape[0])
@@ -644,6 +665,7 @@ class MultiLayerNetwork:
     def set_param(self, key: str, value) -> None:
         idx, name = key.split("_", 1)
         self.params[idx][name] = jnp.asarray(value, self._dtype)
+        self.params_version += 1
 
     # ------------------------------------------------------------------
     # Evaluation + listeners

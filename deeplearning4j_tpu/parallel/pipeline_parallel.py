@@ -25,16 +25,88 @@ Two levels:
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax, shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Array = jax.Array
+
+
+class _StagePacker:
+    """Flatten one pytree per stage into rows of a single [S, K] buffer.
+
+    The buffer is the unit of stage sharding: laid out with
+    ``P(pp_axis)`` each device holds exactly its own stage's row
+    (1/S of the total, plus padding to the widest stage), and the
+    per-stage structure is recovered inside ``lax.switch`` branches
+    with static per-stage offsets/treedefs.
+    """
+
+    def __init__(self, subtrees):
+        self.specs = []
+        total = 0
+        for tree_ in subtrees:
+            leaves, treedef = jax.tree.flatten(tree_)
+            shapes = [tuple(l.shape) for l in leaves]
+            sizes = [int(math.prod(sh)) for sh in shapes]
+            n = int(sum(sizes))
+            self.specs.append((treedef, shapes, sizes, n))
+            total += n
+        self.total = total
+        self.width = max([sp[3] for sp in self.specs] + [1])
+
+    def pack(self, subtrees, dtype) -> np.ndarray:
+        """Host-side pack: numpy rows (the full buffer never lands on a
+        single device — device_put with a P(pp) sharding moves each row
+        straight to its stage's devices)."""
+        rows = []
+        for (treedef, shapes, sizes, n), tree_ in zip(self.specs, subtrees):
+            leaves = jax.tree.leaves(tree_)
+            row = np.zeros((self.width,), dtype)
+            off = 0
+            for leaf, sz in zip(leaves, sizes):
+                row[off:off + sz] = np.ravel(np.asarray(leaf))
+                off += sz
+            rows.append(row)
+        return np.stack(rows)
+
+    def unpack_row(self, s: int, vec):
+        """Rebuild stage ``s``'s pytree from its (traced) row vector."""
+        treedef, shapes, sizes, _ = self.specs[s]
+        leaves = []
+        off = 0
+        for sh, sz in zip(shapes, sizes):
+            leaves.append(vec[off:off + sz].reshape(sh))
+            off += sz
+        return jax.tree.unflatten(treedef, leaves)
+
+    def pack_row(self, s: int, tree_, dtype):
+        """Traced repack of one stage's pytree into a padded row."""
+        _, _, _, n = self.specs[s]
+        leaves = jax.tree.leaves(tree_)
+        if not leaves:
+            return jnp.zeros((self.width,), dtype)
+        vec = jnp.concatenate([jnp.ravel(l).astype(dtype) for l in leaves])
+        return jnp.pad(vec, (0, self.width - n))
+
+    def unpack_to_host(self, buf) -> list:
+        """Gather the [S, K] buffer to host and rebuild every stage's
+        pytree (numpy leaves) — the end-of-fit sync back to the net."""
+        mat = np.asarray(jax.device_get(buf))
+        out = []
+        for s, (treedef, shapes, sizes, _) in enumerate(self.specs):
+            leaves = []
+            off = 0
+            for sh, sz in zip(shapes, sizes):
+                leaves.append(mat[s, off:off + sz].reshape(sh))
+                off += sz
+            out.append(jax.tree.unflatten(treedef, leaves))
+        return out
 
 
 def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
@@ -190,10 +262,25 @@ class PipelineTrainer:
 
     Stage-boundary activations are flattened and right-padded to the
     widest boundary so the ``lax.ppermute`` hop buffer is homogeneous;
-    each stage unpads/reshapes on ingest. Params are replicated across
-    the mesh (in_spec P()); compute is partitioned — device s only
-    executes its stage's branch of the ``lax.switch``, giving per-device
-    FLOPs ~1/S and the (S-1)/(M+S-1) bubble of the schedule.
+    each stage unpads/reshapes on ingest.
+
+    **Stage-sharded state (memory 1/S per device).** Parameters and
+    updater state live packed as ``[S, K]`` buffers laid out with
+    ``P(pp)`` — each device stores ONLY its own stage's row (1/S of the
+    model + padding to the widest stage), the defining property of
+    pipeline parallelism. Gradients are taken INSIDE the shard_map
+    w.r.t. the local row (the transpose of the activation ``ppermute``
+    carries cross-stage sensitivities), and the per-stage slice of the
+    network's updaters runs on-device via ``lax.switch`` — no full
+    gradient, parameter, or updater buffer ever materializes on any
+    device. ``per_device_state_bytes()`` exposes the accounting.
+
+    **dp x pp composition.** If the mesh also carries a data axis
+    (``dp_axis``, autodetected as "dp"), the batch is sharded over it
+    and per-stage gradients are ``lax.pmean``-ed across replicas before
+    the update — data parallelism composed with pipeline stages on ONE
+    mesh, matching the single-device trajectory on the concatenated
+    batch.
 
     Aux-emitting layers (MoeDense) are supported: per-stage weighted aux
     losses are accumulated over the valid microbatch window and psum-ed
@@ -214,6 +301,7 @@ class PipelineTrainer:
         pp_axis: str = "pp",
         n_microbatches: int = 4,
         stage_ranges: Optional[Sequence[Tuple[int, int]]] = None,
+        dp_axis: Optional[str] = None,
     ):
         from deeplearning4j_tpu.nn.conf.enums import (
             BackpropType,
@@ -254,7 +342,77 @@ class PipelineTrainer:
             raise ValueError(
                 f"stage ranges {self.stage_ranges} must cover layers "
                 f"0..{net.n_layers - 1} contiguously")
+        if dp_axis is None and "dp" in mesh.axis_names:
+            dp_axis = "dp"
+        if dp_axis is not None and dp_axis not in mesh.axis_names:
+            raise ValueError(f"dp axis {dp_axis!r} not in mesh "
+                             f"{mesh.axis_names}")
+        self.dp_axis = dp_axis
+        self.n_replicas = int(mesh.shape[dp_axis]) if dp_axis else 1
         self._step_cache = {}
+        # Stage-sharded packed training state ([S, K] P(pp) buffers).
+        self._theta = None
+        self._ustate = None
+        self._synced_params = None
+        self._p_pack = _StagePacker(
+            [self._stage_subtree(net.params, s)
+             for s in range(self.n_stages)])
+        self._u_pack = _StagePacker(
+            [self._stage_subtree(net.updater_state, s)
+             for s in range(self.n_stages)])
+
+    def _stage_subtree(self, tree_, s: int):
+        start, end = self.stage_ranges[s]
+        return {str(i): tree_[str(i)] for i in range(start, end)}
+
+    # -- packed-state lifecycle ---------------------------------------
+    def _ensure_packed(self):
+        """Pack net.params/updater_state into the stage-sharded buffers
+        (host rows -> device_put lands each row only on its stage's
+        devices). Re-packs if the net's param dict was swapped out."""
+        net = self.net
+        token = (id(net.params), getattr(net, "params_version", 0))
+        if self._theta is not None and self._synced_params == token:
+            return
+        sh = NamedSharding(self.mesh, P(self.pp_axis))
+        theta_host = self._p_pack.pack(
+            [self._stage_subtree(net.params, s)
+             for s in range(self.n_stages)], np.dtype(net._dtype))
+        u_host = self._u_pack.pack(
+            [self._stage_subtree(net.updater_state, s)
+             for s in range(self.n_stages)], np.dtype(net._dtype))
+        self._theta = jax.device_put(theta_host, sh)
+        self._ustate = jax.device_put(u_host, sh)
+        self._synced_params = token
+
+    def _sync_to_net(self):
+        """Gather packed state back into net.params / net.updater_state
+        as HOST numpy leaves (a device re-upload here would materialize
+        the full model on the default device and defeat the 1/S memory
+        property; jit transfers leaves on their next use)."""
+        net = self.net
+        for sub in self._p_pack.unpack_to_host(self._theta):
+            net.params.update(sub)
+        for sub in self._u_pack.unpack_to_host(self._ustate):
+            net.updater_state.update(sub)
+        self._synced_params = (
+            id(net.params), getattr(net, "params_version", 0))
+
+    def per_device_state_bytes(self) -> dict:
+        """{device: bytes of params+updater state resident} — the 1/S
+        memory accounting (each device holds only its stage's row)."""
+        self._ensure_packed()
+        acc: dict = {}
+        for buf in (self._theta, self._ustate):
+            for shard in buf.addressable_shards:
+                d = shard.device
+                acc[d] = acc.get(d, 0) + shard.data.nbytes
+        return acc
+
+    def total_state_bytes(self) -> int:
+        """Unpadded params+updater-state bytes of the whole model."""
+        item = np.dtype(self.net._dtype).itemsize
+        return (self._p_pack.total + self._u_pack.total) * item
 
     # -- stage math ----------------------------------------------------
     def _apply_stage(self, s: int, params, x, rngs, train=True):
@@ -292,13 +450,23 @@ class PipelineTrainer:
 
     # -- the jitted step ----------------------------------------------
     def _build_step(self, feats_shape, labels_shape):
+        from deeplearning4j_tpu.nn.multilayer import (
+            layer_reg_score,
+            layer_update,
+        )
+
         net = self.net
         S, M = self.n_stages, self.n_microbatches
         axis = self.pp_axis
+        dp = self.dp_axis
+        R = self.n_replicas
+        p_pack, u_pack = self._p_pack, self._u_pack
         B = feats_shape[0]
-        if B % M:
-            raise ValueError(f"batch {B} not divisible by {M} microbatches")
-        mb = B // M
+        if B % (R * M):
+            raise ValueError(
+                f"batch {B} not divisible by {R} replicas x {M} "
+                f"microbatches")
+        mb = B // (R * M)  # per-replica microbatch
         feats_mb_shape = (mb,) + tuple(feats_shape[1:])
         shapes = self._boundary_shapes(feats_mb_shape)
         widths = [int(math.prod(sh[1:])) for sh in shapes]
@@ -310,7 +478,8 @@ class PipelineTrainer:
         def branch(s):
             in_shape = shapes[s]
 
-            def run(params, x_feed, buf, y_mb, rngs):
+            def run(theta_vec, x_feed, buf, y_mb, rngs):
+                params = p_pack.unpack_row(s, theta_vec)
                 if s == 0:
                     xin = x_feed
                 else:
@@ -332,74 +501,125 @@ class PipelineTrainer:
 
         branches = [branch(s) for s in range(S)]
 
-        def local_loss(params, feats, labels, rng):
+        def reg_branch(s):
+            start, end = self.stage_ranges[s]
+
+            def run(theta_vec):
+                params = p_pack.unpack_row(s, theta_vec)
+                reg = jnp.zeros((), net._dtype)
+                for i in range(start, end):
+                    reg = reg + layer_reg_score(
+                        net.conf.confs[i], params[str(i)])
+                return reg
+
+            return run
+
+        reg_branches = [reg_branch(s) for s in range(S)]
+
+        def upd_branch(s):
+            start, end = self.stage_ranges[s]
+
+            def run(theta_vec, grad_vec, u_vec, iteration):
+                params = p_pack.unpack_row(s, theta_vec)
+                grads = p_pack.unpack_row(s, grad_vec)
+                upd = u_pack.unpack_row(s, u_vec)
+                new_p, new_u = {}, {}
+                for i in range(start, end):
+                    si = str(i)
+                    updates, new_u[si] = layer_update(
+                        net.conf.confs[i], net._updaters[i],
+                        grads[si], upd[si], iteration)
+                    new_p[si] = jax.tree.map(
+                        lambda p, u: p - u, params[si], updates)
+                return (p_pack.pack_row(s, new_p, net._dtype),
+                        u_pack.pack_row(s, new_u, net._dtype))
+
+            return run
+
+        upd_branches = [upd_branch(s) for s in range(S)]
+
+        def local_step(theta, ustate, iteration, rng, feats, labels):
+            # theta [1, Kp]: this device's stage row. feats/labels: this
+            # replica's batch shard (full batch when no dp axis).
             idx = lax.axis_index(axis)
-            if cd is not None:
-                from deeplearning4j_tpu.nn.multilayer import _cast_floating
-                params = jax.tree.map(
-                    functools.partial(_cast_floating, dtype=cd), params)
-                feats = feats.astype(cd)
-            x_mbs = feats.reshape((M, mb) + feats.shape[1:])
-            y_mbs = labels.reshape((M, mb) + labels.shape[1:])
-            hop_dtype = cd if cd is not None else net._dtype
-            buf0 = jnp.zeros((mb, K), hop_dtype)
-            loss0 = jnp.zeros((), net._dtype)
+            if dp is not None:
+                # Decorrelate dropout across replicas.
+                rng = jax.random.fold_in(rng, lax.axis_index(dp))
 
-            def tick(t, carry):
-                buf, loss_acc, aux_acc = carry
-                # Stage idx processes microbatch t - idx at tick t; fold
-                # the microbatch index into the rng so each microbatch
-                # draws distinct dropout masks.
-                mb_idx = jnp.clip(t - idx, 0, M - 1)
-                rngs = list(jax.random.split(
-                    jax.random.fold_in(rng, mb_idx), net.n_layers))
-                feed = x_mbs[jnp.minimum(t, M - 1)]
-                out_t = jnp.maximum(t - (S - 1), 0)
-                y_mb = y_mbs[out_t]
-                yf, loss, aux = lax.switch(
-                    idx, branches, params, feed, buf, y_mb, rngs)
-                write = (idx == S - 1) & (t - (S - 1) >= 0)
-                loss_acc = loss_acc + jnp.where(write, loss, 0.0)
-                # Stage idx holds a REAL microbatch only for ticks in
-                # [idx, idx + M); warmup/drain garbage must not leak
-                # into the aux loss.
-                valid = (t >= idx) & (t < idx + M)
-                aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
-                perm = [(i, (i + 1) % S) for i in range(S)]
-                buf = lax.ppermute(yf, axis, perm)
-                return buf, loss_acc, aux_acc
+            def loss_fn(theta_row):
+                tv = theta_row.astype(cd) if cd is not None else theta_row
+                f = feats.astype(cd) if cd is not None else feats
+                x_mbs = f.reshape((M, mb) + f.shape[1:])
+                y_mbs = labels.reshape((M, mb) + labels.shape[1:])
+                hop_dtype = cd if cd is not None else net._dtype
+                buf0 = jnp.zeros((mb, K), hop_dtype)
+                loss0 = jnp.zeros((), net._dtype)
 
-            _, loss_sum, aux_sum = lax.fori_loop(
-                0, M + S - 1, tick, (buf0, loss0, loss0))
-            # Only the last stage accumulated the loss; aux accumulated
-            # per stage. Microbatch losses are per-mb means -> batch mean
-            # = mean of the M microbatch means (equal sizes). NB the MoE
-            # aux loss is computed per microbatch here vs per batch
-            # single-device: a nonlinear statistic, so trajectories with
-            # MoE layers match in expectation, not bit-for-bit.
-            # psum(aux_sum) = sum over stages of their layers' aux over M
-            # microbatches = sum over mb of the net's total aux.
-            return (lax.psum(loss_sum, axis)
-                    + lax.psum(aux_sum, axis)) / M
+                def tick(t, carry):
+                    buf, loss_acc, aux_acc = carry
+                    # Stage idx processes microbatch t - idx at tick t;
+                    # fold the microbatch index into the rng so each
+                    # microbatch draws distinct dropout masks.
+                    mb_idx = jnp.clip(t - idx, 0, M - 1)
+                    rngs = list(jax.random.split(
+                        jax.random.fold_in(rng, mb_idx), net.n_layers))
+                    feed = x_mbs[jnp.minimum(t, M - 1)]
+                    out_t = jnp.maximum(t - (S - 1), 0)
+                    y_mb = y_mbs[out_t]
+                    yf, loss, aux = lax.switch(
+                        idx, branches, tv, feed, buf, y_mb, rngs)
+                    write = (idx == S - 1) & (t - (S - 1) >= 0)
+                    loss_acc = loss_acc + jnp.where(write, loss, 0.0)
+                    # Stage idx holds a REAL microbatch only for ticks
+                    # in [idx, idx + M); warmup/drain garbage must not
+                    # leak into the aux loss.
+                    valid = (t >= idx) & (t < idx + M)
+                    aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+                    perm = [(i, (i + 1) % S) for i in range(S)]
+                    buf = lax.ppermute(yf, axis, perm)
+                    return buf, loss_acc, aux_acc
 
-        pipe_loss = shard_map(
-            local_loss,
+                _, loss_sum, aux_sum = lax.fori_loop(
+                    0, M + S - 1, tick, (buf0, loss0, loss0))
+                # LOCAL (unreduced) stage contribution: data loss lives
+                # on the last stage, aux/reg on each stage. The global
+                # score = psum of these, but the psum must happen OUTSIDE
+                # the differentiated function: under shard_map the
+                # transpose of psum is psum, so differentiating a
+                # reduced scalar (whose cotangent is 1 on EVERY device)
+                # would scale all gradients by S. Differentiating the
+                # local sum is exact — cross-stage sensitivities ride the
+                # ppermute transpose. Microbatch losses are per-mb means
+                # -> batch mean = mean of the M microbatch means (equal
+                # sizes). NB the MoE aux loss is computed per microbatch
+                # here vs per batch single-device: a nonlinear
+                # statistic, so trajectories with MoE layers match in
+                # expectation, not bit-for-bit.
+                reg = lax.switch(idx, reg_branches, theta_row)
+                return (loss_sum + aux_sum) / M + reg
+
+            score_local, grad = jax.value_and_grad(loss_fn)(theta[0])
+            # Reported score: sum of stage contributions over the ring.
+            score = lax.psum(score_local, axis)
+            if dp is not None:
+                # Average per-stage gradients across data replicas: the
+                # mean over the global batch (equal shard sizes).
+                grad = lax.pmean(grad, dp)
+                score = lax.pmean(score, dp)
+            new_t, new_u = lax.switch(
+                idx, upd_branches, theta[0], grad, ustate[0], iteration)
+            return new_t[None], new_u[None], score
+
+        batch_spec = P(dp) if dp is not None else P()
+        step = shard_map(
+            local_step,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), P()),
-            out_specs=P(),
+            in_specs=(P(self.pp_axis), P(self.pp_axis), P(), P(),
+                      batch_spec, batch_spec),
+            out_specs=(P(self.pp_axis), P(self.pp_axis), P()),
             check_vma=False,
         )
-
-        def step(params, upd_state, iteration, rng, feats, labels):
-            def loss_fn(p):
-                return pipe_loss(p, feats, labels, rng) + net._reg_score(p)
-
-            score, grads = jax.value_and_grad(loss_fn)(params)
-            new_params, new_upd = net._apply_updates(
-                params, upd_state, grads, iteration)
-            return new_params, new_upd, score
-
-        return jax.jit(step)
+        return jax.jit(step, donate_argnums=(0, 1))
 
     # -- public API ----------------------------------------------------
     def fit(self, data, labels=None) -> float:
@@ -410,24 +630,38 @@ class PipelineTrainer:
             data = DataSet(data, labels)
         batches = [data] if isinstance(data, DataSet) else data
         score = float("nan")
+        self._ensure_packed()
+        bspec = (NamedSharding(self.mesh, P(self.dp_axis))
+                 if self.dp_axis is not None
+                 else NamedSharding(self.mesh, P()))
         for ds in batches:
             if ds.features_mask is not None or ds.labels_mask is not None:
                 raise ValueError(
                     "PipelineTrainer does not support masked datasets")
-            feats = jnp.asarray(ds.features, net._dtype)
-            labs = jnp.asarray(ds.labels, net._dtype)
+            feats = jax.device_put(
+                jnp.asarray(ds.features, net._dtype), bspec)
+            labs = jax.device_put(
+                jnp.asarray(ds.labels, net._dtype), bspec)
             key = (feats.shape, labs.shape)
             if key not in self._step_cache:
                 self._step_cache[key] = self._build_step(
                     feats.shape, labs.shape)
             net._key, sub = jax.random.split(net._key)
-            net.params, net.updater_state, s = self._step_cache[key](
-                net.params, net.updater_state, net.iteration, sub,
+            self._theta, self._ustate, s = self._step_cache[key](
+                self._theta, self._ustate, net.iteration, sub,
                 feats, labs,
             )
             net.score_value = s
             net.iteration += 1
             score = float(s)
+            if net.listeners:
+                # Listeners may inspect/checkpoint net.params: sync the
+                # packed state back before each callback (listener-free
+                # training pays one gather per fit() call instead).
+                self._sync_to_net()
             for listener in net.listeners:
                 listener.iteration_done(net, net.iteration)
+        # One host gather per fit() CALL (not per step): keep
+        # net.params/updater_state the canonical user-visible copy.
+        self._sync_to_net()
         return score

@@ -590,3 +590,162 @@ class TestMoeInComputationGraph:
             g0.fit(ds)
             scores.append(float(g0.score_value))
         assert scores[-1] < scores[0]
+
+
+class TestStageShardedPipeline:
+    """The defining property of PP: per-device parameter + updater
+    memory ~ 1/S of the model (VERDICT round-2 item 1), and dp x pp
+    composition on one mesh (item 2)."""
+
+    def _balanced_net(self, lr=0.05):
+        from deeplearning4j_tpu.models.zoo import mlp
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        # Near-equal layer widths -> near-equal stage rows, so the
+        # padded-row accounting is tight.
+        return MultiLayerNetwork(mlp((128, 128, 128, 128, 10), lr=lr)).init()
+
+    def _batch(self, n=32, n_in=128, n_out=10, seed=0):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, n_in)).astype(np.float32)
+        y = np.zeros((n, n_out), np.float32)
+        y[np.arange(n), rng.integers(0, n_out, n)] = 1.0
+        return DataSet(x, y)
+
+    def test_per_device_state_is_one_stage_not_the_model(self):
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        net = self._balanced_net()
+        mesh = make_mesh(MeshSpec({"pp": 4}))
+        trainer = PipelineTrainer(net, mesh, n_microbatches=4)
+        trainer.fit(self._batch())  # packed state live after training
+        per_dev = trainer.per_device_state_bytes()
+        total = trainer.total_state_bytes()
+        assert len(per_dev) == 4
+        # Replicated storage (the round-2 design) would put >= `total`
+        # on EVERY device; stage sharding stores one padded stage row.
+        worst = max(per_dev.values())
+        assert worst < total / 2, (worst, total)
+        # Padded-row accounting is exact: row width x itemsize per buffer.
+        item = np.dtype(np.float32).itemsize
+        expect = (trainer._p_pack.width + trainer._u_pack.width) * item
+        assert worst == expect
+        # And the stage rows jointly cover the model (no truncation).
+        assert trainer._p_pack.total * item <= total
+
+    def test_model_larger_than_single_device_budget(self):
+        """A model whose params + updater state exceed a (simulated)
+        per-device budget still trains under PP because each device
+        only stores its stage."""
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        net = self._balanced_net()
+        mesh = make_mesh(MeshSpec({"pp": 4}))
+        trainer = PipelineTrainer(net, mesh, n_microbatches=4)
+        s0 = trainer.fit(self._batch(seed=1))
+        total = trainer.total_state_bytes()
+        budget = total // 2  # model does NOT fit one device
+        assert total > budget
+        assert max(trainer.per_device_state_bytes().values()) < budget
+        s1 = trainer.fit(self._batch(seed=2))
+        assert np.isfinite(s0) and np.isfinite(s1)
+
+    def test_dp_pp_matches_single_device_trajectory(self):
+        """dp x pp on ONE mesh: data-sharded batches through pipelined
+        stages track single-device fit on the concatenated batch."""
+        from deeplearning4j_tpu.models.zoo import mlp
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        sizes = (784, 256, 128, 64, 10)
+        net_pp = MultiLayerNetwork(mlp(sizes, lr=0.05)).init()
+        net_sd = MultiLayerNetwork(mlp(sizes, lr=0.05)).init()
+        mesh = make_mesh(MeshSpec({"dp": 2, "pp": 4}))
+        trainer = PipelineTrainer(net_pp, mesh, n_microbatches=2)
+        assert trainer.dp_axis == "dp" and trainer.n_replicas == 2
+
+        for step in range(4):
+            ds = self._batch(n=32, n_in=784, seed=step)
+            s_pp = trainer.fit(ds)
+            net_sd.fit(ds)
+            assert abs(s_pp - float(net_sd.score_value)) < 1e-4, step
+        for k in net_sd.params:
+            for name in net_sd.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(net_pp.params[k][name]),
+                    np.asarray(net_sd.params[k][name]),
+                    rtol=1e-4, atol=1e-5,
+                )
+
+    def test_updater_state_follows_stages(self):
+        """Adam moment buffers live stage-sharded and the trajectory
+        still matches single-device (updater math runs per stage)."""
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.conf.enums import Updater
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.ops.losses import LossFunction
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        def build():
+            return (
+                NeuralNetConfiguration.Builder()
+                .seed(5).learning_rate(0.01).updater(Updater.ADAM)
+                .list()
+                .layer(0, L.DenseLayer(n_in=32, n_out=24,
+                                       activation="relu"))
+                .layer(1, L.DenseLayer(n_in=24, n_out=16,
+                                       activation="relu"))
+                .layer(2, L.OutputLayer(
+                    n_in=16, n_out=4, activation="softmax",
+                    loss_function=LossFunction.MCXENT))
+                .build()
+            )
+
+        net_pp = MultiLayerNetwork(build()).init()
+        net_sd = MultiLayerNetwork(build()).init()
+        mesh = make_mesh(MeshSpec({"pp": 3}))
+        trainer = PipelineTrainer(
+            net_pp, mesh, n_microbatches=2,
+            stage_ranges=[(0, 1), (1, 2), (2, 3)])
+        for step in range(3):
+            ds = self._batch(n=16, n_in=32, n_out=4, seed=step)
+            trainer.fit(ds)
+            net_sd.fit(ds)
+        for k in net_sd.params:
+            for name in net_sd.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(net_pp.params[k][name]),
+                    np.asarray(net_sd.params[k][name]),
+                    rtol=1e-4, atol=1e-5,
+                )
+        # Adam m/v for layer 1 live only on stage 1's device.
+        upd = np.asarray(jax.device_get(trainer._ustate))
+        assert upd.shape[0] == 3
+
+    def test_set_param_between_fits_is_respected(self):
+        """In-place net.set_param between fit() calls must invalidate
+        the packed stage buffers (params_version token), not train on
+        from stale weights."""
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        net = self._balanced_net(lr=0.0)  # lr=0: fit must be identity
+        mesh = make_mesh(MeshSpec({"pp": 4}))
+        trainer = PipelineTrainer(net, mesh, n_microbatches=4)
+        trainer.fit(self._batch(seed=0))  # packs buffers
+        net.set_param("0_W", np.zeros_like(np.asarray(net.params["0"]["W"])))
+        trainer.fit(self._batch(seed=1))
+        assert np.all(np.asarray(net.params["0"]["W"]) == 0.0), \
+            "stale packed params overwrote set_param"
