@@ -33,19 +33,33 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from deeplearning4j_tpu.nn.conf import layers as L
-from deeplearning4j_tpu.nn.updater.updaters import (
-    normalize_gradients,
-    resolve_lr,
-)
+
+
+def _layer_items(net):
+    """Uniform (param_key, layer_bean) iteration for MultiLayerNetwork
+    (keys "0".."N-1" over conf.confs) and ComputationGraph (keys =
+    layer-vertex names)."""
+    if hasattr(net, "_layer_vertices"):
+        for name in sorted(net._layer_vertices):
+            yield name, net._layer_vertices[name].conf.layer
+    else:
+        for i, c in enumerate(net.conf.confs):
+            yield str(i), c.layer
 
 
 def tp_param_specs(net, mesh_axis: str = "tp"):
     """PartitionSpec pytree for a network's params: Megatron column/row
-    alternation for stacked Dense layers; replicate everything else."""
+    alternation for stacked Dense layers; replicate everything else.
+    MultiLayerNetwork only — the column/row alternation is defined by
+    the sequential layer chain, which an arbitrary graph DAG lacks."""
+    if hasattr(net, "_layer_vertices"):
+        raise ValueError(
+            "tp_param_specs requires a MultiLayerNetwork: Megatron "
+            "column/row alternation follows the sequential layer chain; "
+            "for ComputationGraphs shard expert (ep) or data (dp) axes")
     specs = {}
     col = True
-    for i, c in enumerate(net.conf.confs):
-        lc = c.layer
+    for key, lc in _layer_items(net):
         layer_specs = {}
         if isinstance(lc, (L.DenseLayer,)) and not isinstance(
             lc, L.OutputLayer
@@ -57,9 +71,9 @@ def tp_param_specs(net, mesh_axis: str = "tp"):
                 layer_specs["W"] = P(mesh_axis, None)
                 layer_specs["b"] = P()
             col = not col
-        for name in net.params[str(i)]:
+        for name in net.params[key]:
             layer_specs.setdefault(name, P())
-        specs[str(i)] = layer_specs
+        specs[key] = layer_specs
     return specs
 
 
@@ -69,21 +83,21 @@ def ep_param_specs(net, mesh_axis: str = "ep",
     expert tensors carry their leading expert axis on ``mesh_axis``;
     under pjit XLA turns the capacity-dispatch einsums into the expert
     all-to-all (GSPMD counterpart of the explicit
-    parallel/expert_parallel.make_ep_moe schedule)."""
+    parallel/expert_parallel.make_ep_moe schedule). Works for both
+    MultiLayerNetwork layers and ComputationGraph MoE layer vertices."""
     from deeplearning4j_tpu.nn.layers.moe import MoeDense
 
     n_ep = None
     specs = dict(base) if base else {}
-    for i, c in enumerate(net.conf.confs):
-        lc = c.layer
-        layer_specs = dict(specs.get(str(i), {}))
+    for key, lc in _layer_items(net):
+        layer_specs = dict(specs.get(key, {}))
         if isinstance(lc, MoeDense):
             layer_specs["W_up"] = P(mesh_axis, None, None)
             layer_specs["W_down"] = P(mesh_axis, None, None)
             n_ep = lc.n_experts
-        for name in net.params[str(i)]:
+        for name in net.params[key]:
             layer_specs.setdefault(name, P())
-        specs[str(i)] = layer_specs
+        specs[key] = layer_specs
     if n_ep is None:
         raise ValueError(
             "ep_axis was configured but the network has no MoeDense "
@@ -120,29 +134,26 @@ class ParallelTrainer:
         self.is_graph = hasattr(net, "_coerce_multi")
         self.tp_axis = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
         self.ep_axis = ep_axis if (ep_axis and ep_axis in mesh.axis_names) else None
-        if self.is_graph and (self.tp_axis or self.ep_axis):
+        if self.is_graph and self.tp_axis:
             raise ValueError(
-                "tensor/expert parallelism (tp_axis/ep_axis) supports "
-                "MultiLayerNetwork only; ComputationGraph trains "
-                "dp-sharded")
+                "tensor parallelism (tp_axis) supports MultiLayerNetwork "
+                "only: the Megatron column/row alternation follows the "
+                "sequential layer chain; ComputationGraphs compose dp "
+                "and ep axes")
         if self.ep_axis:
             from deeplearning4j_tpu.nn.layers.moe import MoeDense
 
-            for c in net.conf.confs:
-                if (isinstance(c.layer, MoeDense)
-                        and c.layer.n_experts % mesh.shape[ep_axis]):
+            for _, lc in _layer_items(net):
+                if (isinstance(lc, MoeDense)
+                        and lc.n_experts % mesh.shape[ep_axis]):
                     raise ValueError(
-                        f"n_experts {c.layer.n_experts} not divisible "
+                        f"n_experts {lc.n_experts} not divisible "
                         f"by mesh ep={mesh.shape[ep_axis]}")
-                if isinstance(c.layer, MoeDense) and c.layer.ep_axis:
+                if isinstance(lc, MoeDense) and lc.ep_axis:
                     raise ValueError(
                         "MoeDense.ep_axis (explicit shard_map all-to-all)"
                         " and ParallelTrainer ep_axis (GSPMD sharding) "
                         "are alternative dispatch paths; configure one")
-        if self.is_graph and not average_each_iteration:
-            raise ValueError(
-                "K-local-steps-then-average supports MultiLayerNetwork "
-                "only; ComputationGraph trains per-step synchronous")
         self.average_each_iteration = average_each_iteration
         self.local_steps = max(1, local_steps)
         # Reference engine flags org.deeplearning4j.spark.iteration.
@@ -340,13 +351,23 @@ class ParallelTrainer:
     # ------------------------------------------------------------------
     def _fit_local_then_average(self, ds) -> float:
         """K local steps per dp shard, then pmean of params+updater state
-        (reference average-at-end semantics)."""
+        (reference average-at-end semantics). Works for MultiLayerNetwork
+        and ComputationGraph (pytree-valued inputs/labels)."""
         net = self.net
         step = self._local_steps_fn
-        feats = self._shard_batch(ds.features)
-        labels = self._shard_batch(ds.labels)
-        fm = self._shard_batch(ds.features_mask)
-        lm = self._shard_batch(ds.labels_mask)
+        if self.is_graph:
+            inputs, labs, fmt, lmt = net._coerce_multi(ds)
+            feats = jax.tree.map(self._shard_batch, inputs)
+            labels = jax.tree.map(self._shard_batch, labs)
+            fm = None if fmt is None else jax.tree.map(
+                self._shard_batch, fmt)
+            lm = None if lmt is None else jax.tree.map(
+                self._shard_batch, lmt)
+        else:
+            feats = self._shard_batch(ds.features)
+            labels = self._shard_batch(ds.labels)
+            fm = self._shard_batch(ds.features_mask)
+            lm = self._shard_batch(ds.labels_mask)
         net._key, sub = jax.random.split(net._key)
         net.params, net.updater_state, score = step(
             net.params, net.updater_state, jnp.asarray(net.iteration),
@@ -364,6 +385,20 @@ class ParallelTrainer:
         dp = self.dp_axis
         K = self.local_steps
 
+        from deeplearning4j_tpu.nn.multilayer import layer_update
+
+        if self.is_graph:
+            items = [
+                (name, net._layer_vertices[name].conf, net._updaters[name])
+                for name in sorted(net._layer_vertices)
+            ]
+        else:
+            items = [
+                (str(i), c, upd)
+                for i, (c, upd) in enumerate(
+                    zip(net.conf.confs, net._updaters))
+            ]
+
         def local_steps(params, upd_state, iteration, rng, feats, labels,
                         fm, lm):
             def one_step(carry, k):
@@ -374,21 +409,11 @@ class ParallelTrainer:
                   fm, lm)
                 new_params = {}
                 new_upd = {}
-                for i, (c, upd) in enumerate(
-                    zip(net.conf.confs, net._updaters)
-                ):
-                    si = str(i)
-                    g = normalize_gradients(
-                        c.resolved("gradient_normalization"),
-                        grads[si],
-                        float(c.resolved("gradient_normalization_threshold")),
-                    )
-                    updates, new_upd[si] = upd.update(
-                        g, upd_state[si], resolve_lr(c, iteration + k),
-                        iteration + k,
-                    )
-                    new_params[si] = jax.tree.map(
-                        lambda p, u: p - u, params[si], updates
+                for key, c, upd in items:
+                    updates, new_upd[key] = layer_update(
+                        c, upd, grads[key], upd_state[key], iteration + k)
+                    new_params[key] = jax.tree.map(
+                        lambda p, u: p - u, params[key], updates
                     )
                 return (new_params, new_upd), score
 

@@ -376,21 +376,25 @@ class TestGraphParallelTrainer:
         assert s.shape == (K,) and np.all(np.isfinite(s))
         assert s[-1] < s[0]
 
-    def test_graph_rejects_tp_and_local_steps(self):
+    def test_graph_rejects_tp_but_supports_local_steps(self):
         import pytest
 
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
         from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 
+        # tp needs the sequential Megatron alternation — still MLN-only.
         mesh = make_mesh(MeshSpec({"dp": 2, "tp": 2}))
         g = ComputationGraph(self._graph_conf())
-        with pytest.raises(ValueError, match="tensor/expert parallelism"):
+        with pytest.raises(ValueError, match="sequential layer chain"):
             ParallelTrainer(g, mesh, tp_axis="tp")
+        # K-local-steps-then-average works for graphs now (round-2
+        # VERDICT item 2); trajectory parity is asserted in
+        # test_pipeline_expert.py::TestGraphLocalSteps.
         g2 = ComputationGraph(self._graph_conf())
         mesh2 = make_mesh(MeshSpec({"dp": 4}))
-        with pytest.raises(ValueError, match="K-local-steps"):
-            ParallelTrainer(g2, mesh2, average_each_iteration=False)
+        ParallelTrainer(g2, mesh2, average_each_iteration=False,
+                        local_steps=2)
 
 
 class TestMaskedParallelFitScan:

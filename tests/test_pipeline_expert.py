@@ -749,3 +749,140 @@ class TestStageShardedPipeline:
         trainer.fit(self._batch(seed=1))
         assert np.all(np.asarray(net.params["0"]["W"]) == 0.0), \
             "stale packed params overwrote set_param"
+
+
+class TestGraphExpertParallel:
+    """ParallelTrainer ep_axis over a ComputationGraph MoE layer vertex
+    (round-2 VERDICT item 2: the graph restriction at
+    data_parallel.py:123-126 is lifted) — mirrors
+    TestConfLevelExpertParallel for the graph API."""
+
+    def _graph(self, n_experts=4):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers.moe import MoeDense
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(9)
+            .learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("moe", MoeDense(n_in=8, n_out=8,
+                                       n_experts=n_experts,
+                                       n_hidden=16, aux_weight=0.01),
+                       "in")
+            .add_layer(
+                "out",
+                L.OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function=LossFunction.MCXENT),
+                "moe",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        return ComputationGraph(conf).init()
+
+    def _data(self, n=16, seed=2):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+        return DataSet(x, y)
+
+    def test_graph_moe_vertex_expert_sharded_and_matches_dp(self):
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+
+        ds = self._data()
+        mesh = make_mesh(MeshSpec({"dp": 2, "ep": 4}))
+        g_ep = self._graph()
+        trainer = ParallelTrainer(g_ep, mesh, ep_axis="ep")
+        # Expert tensors of the VERTEX actually carry the ep axis.
+        spec = g_ep.params["moe"]["W_up"].sharding.spec
+        assert spec[0] == "ep", spec
+
+        g_ref = self._graph()
+        ref = ParallelTrainer(g_ref, make_mesh(MeshSpec({"dp": 2})))
+        for _ in range(4):
+            s_ep = trainer.fit(ds)
+            s_ref = ref.fit(ds)
+            np.testing.assert_allclose(s_ep, s_ref, rtol=1e-4)
+        for k in g_ref.params:
+            for name in g_ref.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(g_ep.params[k][name]),
+                    np.asarray(g_ref.params[k][name]),
+                    rtol=1e-4, atol=1e-5,
+                )
+
+    def test_graph_tp_still_rejected_with_reason(self):
+        import pytest
+
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+
+        mesh = make_mesh(MeshSpec({"dp": 2, "tp": 4}))
+        with pytest.raises(ValueError, match="sequential layer chain"):
+            ParallelTrainer(self._graph(), mesh, tp_axis="tp")
+
+
+class TestGraphLocalSteps:
+    """K-local-steps-then-average for ComputationGraphs (round-2
+    VERDICT item 2: the restriction at data_parallel.py:142 is
+    lifted): a linear graph must follow the SAME trajectory as the
+    equivalent MultiLayerNetwork under the identical mode."""
+
+    def test_graph_local_steps_matches_mln(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import mlp
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.ops.losses import LossFunction
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+
+        from deeplearning4j_tpu.nn.conf.enums import Updater
+
+        net = MultiLayerNetwork(
+            mlp((12, 8, 4), lr=0.05, updater=Updater.SGD)).init()
+        gconf = (
+            NeuralNetConfiguration.Builder()
+            .seed(1).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", L.DenseLayer(n_in=12, n_out=8,
+                                             activation="relu"), "in")
+            .add_layer("out", L.OutputLayer(
+                n_in=8, n_out=4, activation="softmax",
+                loss_function=LossFunction.MCXENT), "dense")
+            .set_outputs("out")
+            .build()
+        )
+        g = ComputationGraph(gconf).init()
+        # Identical starting weights (key layouts differ across APIs).
+        g.params["dense"] = jax.tree.map(jnp.asarray, net.params["0"])
+        g.params["out"] = jax.tree.map(jnp.asarray, net.params["1"])
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 12)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+        ds = DataSet(x, y)
+        mesh = make_mesh(MeshSpec({"dp": 2}))
+        t_mln = ParallelTrainer(net, mesh, average_each_iteration=False,
+                                local_steps=3)
+        t_g = ParallelTrainer(g, mesh, average_each_iteration=False,
+                              local_steps=3)
+        for _ in range(3):
+            s_m = t_mln.fit(ds)
+            s_g = t_g.fit(ds)
+            np.testing.assert_allclose(s_g, s_m, rtol=1e-5)
+        for mk, gk in (("0", "dense"), ("1", "out")):
+            for name in net.params[mk]:
+                np.testing.assert_allclose(
+                    np.asarray(g.params[gk][name]),
+                    np.asarray(net.params[mk][name]),
+                    rtol=1e-5, atol=1e-6,
+                )
