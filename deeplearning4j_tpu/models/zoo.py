@@ -105,6 +105,54 @@ def lenet5(
     )
 
 
+def wide_cnn(
+    height: int = 32,
+    width: int = 32,
+    channels: int = 3,
+    n_classes: int = 10,
+    lr: float = 0.05,
+    seed: int = 12345,
+):
+    """CIFAR-scale modern-width CNN (64/128-channel 3x3 convs): the
+    conv-MFU control experiment — same conv machinery as lenet5 but
+    with contraction sizes the 128x128 MXU can fill, demonstrating the
+    framework's conv ceiling when the ARCHITECTURE permits
+    (BENCHMARKS.md conv-MFU section)."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(Updater.NESTEROVS)
+        .momentum(0.9)
+        .weight_init(WeightInit.XAVIER)
+        .list()
+        .layer(0, L.ConvolutionLayer(
+            n_out=64, kernel_size=(3, 3), stride=(1, 1),
+            padding=(1, 1), activation="relu"))
+        .layer(1, L.ConvolutionLayer(
+            n_out=64, kernel_size=(3, 3), stride=(1, 1),
+            padding=(1, 1), activation="relu"))
+        .layer(2, L.SubsamplingLayer(
+            pooling_type=L.PoolingType.MAX,
+            kernel_size=(2, 2), stride=(2, 2)))
+        .layer(3, L.ConvolutionLayer(
+            n_out=128, kernel_size=(3, 3), stride=(1, 1),
+            padding=(1, 1), activation="relu"))
+        .layer(4, L.ConvolutionLayer(
+            n_out=128, kernel_size=(3, 3), stride=(1, 1),
+            padding=(1, 1), activation="relu"))
+        .layer(5, L.SubsamplingLayer(
+            pooling_type=L.PoolingType.MAX,
+            kernel_size=(2, 2), stride=(2, 2)))
+        .layer(6, L.DenseLayer(n_out=256, activation="relu"))
+        .layer(7, L.OutputLayer(
+            n_out=n_classes, activation="softmax",
+            loss_function=LossFunction.MCXENT))
+        .set_input_type(InputType.convolutional(height, width, channels))
+        .build()
+    )
+
+
 def image_captioner(
     embed_dim: int = 32,
     n_hidden: int = 32,

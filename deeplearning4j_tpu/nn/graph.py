@@ -134,12 +134,22 @@ class ComputationGraph:
         per-vertex recurrent carry (reference ComputationGraph
         rnnActivateUsingStoredState :1233: stored state fed back in for
         streaming inference and truncated-BPTT window chaining)."""
+        # Output-layer vertices run at the master dtype (same rationale
+        # as MultiLayerNetwork._forward_fn: a bf16 softmax quantizes
+        # probabilities coarsely enough to stall training).
+        out_f32_vertices = (
+            set(self.conf.network_outputs)
+            if self._compute_dtype is not None else set())
         if self._compute_dtype is not None:
             # Mixed precision: bf16 compute, f32 master params (same
             # scheme as MultiLayerNetwork._forward_fn)
             cast = functools.partial(
                 _cast_floating, dtype=self._compute_dtype)
-            params = jax.tree_util.tree_map(cast, params)
+            params = {
+                k: (sub if k in out_f32_vertices
+                    else jax.tree_util.tree_map(cast, sub))
+                for k, sub in params.items()
+            }
             inputs = {k: cast(v) for k, v in inputs.items()}
         acts: Dict[str, Array] = dict(inputs)
         new_state = dict(state) if state else {}
@@ -182,6 +192,8 @@ class ComputationGraph:
                     vertex.conf.layer, L.RECURRENT_LAYER_TYPES
                 )
                 mask = in_mask if is_recurrent else None
+                if name in out_f32_vertices:
+                    x = _cast_floating(x, self._dtype)
                 out, st = impl.apply(
                     vertex.conf,
                     params[name],

@@ -415,9 +415,13 @@ class PipelineTrainer:
         return (self._p_pack.total + self._u_pack.total) * item
 
     # -- stage math ----------------------------------------------------
-    def _apply_stage(self, s: int, params, x, rngs, train=True):
+    def _apply_stage(self, s: int, params, x, rngs, train=True,
+                     master_from=None):
         """Apply layers [start, end) of stage s (with preprocessors).
-        Returns (activations, weighted aux-loss sum of the stage)."""
+        Returns (activations, weighted aux-loss sum of the stage).
+        ``master_from``: layer index from which activations are cast
+        back to the master dtype (the f32 output-layer rule of
+        MultiLayerNetwork._forward_fn under mixed precision)."""
         net = self.net
         start, end = self.stage_ranges[s]
         aux = jnp.zeros((), net._dtype)
@@ -426,6 +430,12 @@ class PipelineTrainer:
             pp = net.conf.preprocessor_for(i)
             if pp is not None:
                 x = pp.pre_process(x, rngs[i] if train else None)
+            if master_from is not None and i == master_from:
+                # AFTER the preprocessor — matching the cast point in
+                # MultiLayerNetwork._forward_fn so mixed-precision
+                # trajectories agree with single-device fit.
+                from deeplearning4j_tpu.nn.multilayer import _cast_floating
+                x = _cast_floating(x, net._dtype)
             x, st = net._impls[i].apply(
                 c, params[str(i)], x,
                 state=None, train=train, rng=rngs[i], mask=None,
@@ -475,17 +485,37 @@ class PipelineTrainer:
         out_impl = net._impls[-1]
         cd = net._compute_dtype
 
+        from deeplearning4j_tpu.nn.conf import layers as _L
+
+        # Mixed precision: the output layer runs at the master dtype
+        # (see MultiLayerNetwork._forward_fn — a bf16 softmax stalls
+        # training at a calibration plateau).
+        out_f32 = (cd is not None
+                   and isinstance(net.conf.confs[-1].layer,
+                                  _L.BaseOutputLayer))
+        last_layer = net.n_layers - 1
+        last_si = str(last_layer)
+
         def branch(s):
             in_shape = shapes[s]
 
-            def run(theta_vec, x_feed, buf, y_mb, rngs):
-                params = p_pack.unpack_row(s, theta_vec)
+            def run(theta_cd, theta_master, x_feed, buf, y_mb, rngs):
+                params = p_pack.unpack_row(s, theta_cd)
+                if out_f32 and s == S - 1:
+                    # The output layer's params come from the f32 row
+                    # (the casted copy of that slice is dead code XLA
+                    # drops).
+                    params[last_si] = p_pack.unpack_row(
+                        s, theta_master)[last_si]
                 if s == 0:
                     xin = x_feed
                 else:
                     w = widths[s]
                     xin = buf[:, :w].reshape(in_shape)
-                y, aux = self._apply_stage(s, params, xin, rngs)
+                y, aux = self._apply_stage(
+                    s, params, xin, rngs,
+                    master_from=(last_layer
+                                 if out_f32 and s == S - 1 else None))
                 if s == S - 1:
                     yl = y
                     if cd is not None:
@@ -494,6 +524,8 @@ class PipelineTrainer:
                 else:
                     loss = jnp.zeros((), net._dtype)
                 yf = y.reshape(mb, -1)
+                if cd is not None:
+                    yf = yf.astype(cd)  # homogeneous hop-buffer dtype
                 yf = jnp.pad(yf, ((0, 0), (0, K - yf.shape[1])))
                 return yf, loss, aux
 
@@ -567,7 +599,8 @@ class PipelineTrainer:
                     out_t = jnp.maximum(t - (S - 1), 0)
                     y_mb = y_mbs[out_t]
                     yf, loss, aux = lax.switch(
-                        idx, branches, tv, feed, buf, y_mb, rngs)
+                        idx, branches, tv, theta_row, feed, buf, y_mb,
+                        rngs)
                     write = (idx == S - 1) & (t - (S - 1) >= 0)
                     loss_acc = loss_acc + jnp.where(write, loss, 0.0)
                     # Stage idx holds a REAL microbatch only for ticks

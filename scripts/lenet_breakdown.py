@@ -1,0 +1,186 @@
+"""Per-op LeNet-5 train-step breakdown on the real TPU chip.
+
+Attributes the LeNet step time (BENCH `mnist_lenet5_train_throughput`,
+~13-14% MFU) to its constituent blocks, substantiating BENCHMARKS.md's
+"the 1998 architecture, not the conv machinery" claim next to the
+wide_cnn control row (~47% MFU on the same machinery).
+
+Method: ablation over conf-built subnets timed on the IDENTICAL
+fit_scan path bench.py uses (K fused steps per dispatch, value-fetch
+sync, bf16 compute + f32 head). Subtracting a minimal head-only net's
+time isolates each block, so scan plumbing/updater/dispatch overheads
+cancel instead of being mis-attributed (a naive per-op microbench pays
+a fixed ~1.5 ms/step serialization cost on this transport and sums to
+3x the real step). Run:
+
+    python scripts/lenet_breakdown.py [--batch 2048] [--k 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def _build(layers, input_type, lr=0.002):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    b = (NeuralNetConfiguration.Builder()
+         .seed(12345).learning_rate(lr)
+         .updater(Updater.NESTEROVS).momentum(0.9)
+         .list())
+    for i, layer in enumerate(layers):
+        b.layer(i, layer)
+    conf = b.set_input_type(input_type).build()
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+    return MultiLayerNetwork(conf).init()
+
+
+def _time_net(net, feats, labels, k, reps=3, calls=20):
+    """ms/step over `calls` BACK-TO-BACK fit_scan dispatches with one
+    value-fetch sync at the end (bench.py's estimator): a per-call sync
+    pays the tunnel's fixed ~70 ms dispatch+fetch latency and would
+    swamp sub-ms steps."""
+
+    def run():
+        for _ in range(calls):
+            out = net.fit_scan(feats, labels)[-1]
+        return out
+
+    float(np.asarray(run()))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run()
+        float(np.asarray(out))  # tunnel-reliable sync
+        best = min(best, time.perf_counter() - t0)
+    return best / (k * calls) * 1e3  # ms/step
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--k", type=int, default=64)
+    args = ap.parse_args()
+    B, K = args.batch, args.k
+
+    import jax
+
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    rng = np.random.default_rng(0)
+
+    def data(shape, n_out=10):
+        feats = jax.device_put(
+            rng.normal(size=(K, B) + shape).astype(np.float32))
+        labels = jax.device_put(np.eye(n_out, dtype=np.float32)[
+            rng.integers(0, n_out, (K, B))])
+        return feats, labels
+
+    def out_layer(n_out=10):
+        return L.OutputLayer(n_out=n_out, activation="softmax",
+                             loss_function=LossFunction.MCXENT)
+
+    results = {}
+
+    # head-only baseline: flatten 784 -> out (scan plumbing + updater +
+    # softmax head; every ablation net pays this too)
+    net = _build([out_layer()], InputType.convolutional(28, 28, 1))
+    f, lab = data((1, 28, 28))
+    results["head784"] = _time_net(net, f, lab, K)
+
+    # + conv1 block (conv1 + pool1)
+    net = _build([
+        L.ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                           activation="identity"),
+        L.SubsamplingLayer(pooling_type=L.PoolingType.MAX,
+                           kernel_size=(2, 2), stride=(2, 2)),
+        out_layer(),
+    ], InputType.convolutional(28, 28, 1))
+    results["conv1_block"] = _time_net(net, f, lab, K)
+
+    # conv1 alone (no pool) to split conv from pool
+    net = _build([
+        L.ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                           activation="identity"),
+        out_layer(),
+    ], InputType.convolutional(28, 28, 1))
+    results["conv1_nopool"] = _time_net(net, f, lab, K)
+
+    # conv2 block on its natural input [20,12,12]
+    net = _build([
+        L.ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                           activation="identity"),
+        L.SubsamplingLayer(pooling_type=L.PoolingType.MAX,
+                           kernel_size=(2, 2), stride=(2, 2)),
+        out_layer(),
+    ], InputType.convolutional(12, 12, 20))
+    f2, lab2 = data((20, 12, 12))
+    results["conv2_block"] = _time_net(net, f2, lab2, K)
+
+    # head-only at the conv2 input shape (its own flatten cost)
+    net = _build([out_layer()], InputType.convolutional(12, 12, 20))
+    results["head2880"] = _time_net(net, f2, lab2, K)
+
+    # dense tail 800 -> 500 -> 10
+    net = _build([
+        L.DenseLayer(n_out=500, activation="relu"),
+        out_layer(),
+    ], InputType.feed_forward(800))
+    f3, lab3 = data((800,))
+    results["dense_tail"] = _time_net(net, f3, lab3, K)
+
+    net = _build([out_layer()], InputType.feed_forward(800))
+    results["head800"] = _time_net(net, f3, lab3, K)
+
+    # the real thing
+    from deeplearning4j_tpu.datasets.mnist import mnist_dataset
+    from deeplearning4j_tpu.models.zoo import lenet5
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = lenet5(lr=0.002)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+    net = MultiLayerNetwork(conf).init()
+    ds = mnist_dataset(train=True, num_examples=B * 8)
+    batches = ds.batch_by(B)
+    reps = (K + len(batches) - 1) // len(batches)
+    feats = np.stack([b.features for b in batches] * reps)[:K]
+    feats = jax.device_put(feats.reshape(K, B, 1, 28, 28))
+    labels = jax.device_put(
+        np.stack([b.labels for b in batches] * reps)[:K])
+    full = _time_net(net, feats, labels, K)
+
+    conv1 = results["conv1_nopool"] - results["head784"]
+    pool1 = results["conv1_block"] - results["conv1_nopool"]
+    conv2_blk = results["conv2_block"] - results["head2880"]
+    dense = results["dense_tail"] - results["head800"]
+    head = results["head784"]
+    attributed = conv1 + pool1 + conv2_blk + dense + head
+
+    print(f"\nLeNet-5 ablation breakdown  batch={B}  K={K} "
+          f"(fit_scan path, ms/step, best of 3)")
+    print(f"{'component':<36}{'ms/step':>9}{'% of full':>11}")
+    for name, ms in [
+        ("conv1 1->20 5x5 (fwd+bwd)", conv1),
+        ("pool1 2x2 (fwd+bwd)", pool1),
+        ("conv2 block 20->50 +pool (fwd+bwd)", conv2_blk),
+        ("dense 800->500 (fwd+bwd)", dense),
+        ("head: flatten+out+loss+updater+scan", head),
+        ("sum of attributed", attributed),
+        ("full LeNet step", full),
+        ("residual (interactions)", full - attributed),
+    ]:
+        print(f"{name:<36}{ms:>9.4f}{ms / full * 100:>10.1f}%")
+    print("\nraw ablation nets (ms/step):",
+          {k: round(v, 4) for k, v in results.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -222,3 +222,47 @@ class TestFitScan:
         assert np.isfinite(float(net.score_value))
         assert np.isfinite(np.asarray(scores)).all()
         assert net.iteration == 10
+
+
+class TestF32OutputHead:
+    """Under mixed precision the OUTPUT layer runs at the master dtype:
+    a bf16 softmax quantizes probabilities coarsely enough to stall
+    training at a calibration plateau (measured on LeNet/MNIST —
+    BENCHMARKS.md mixed-precision note)."""
+
+    def test_mln_output_layer_runs_f32(self):
+        import jax.numpy as jnp
+
+        net = MultiLayerNetwork(_conf("bfloat16")).init()
+        x, _ = _data()
+        acts, _, _ = net._forward_fn(
+            net.params, {}, jnp.asarray(x), None, False, None,
+            collect=True)
+        assert acts[0].dtype == jnp.bfloat16   # body: compute dtype
+        assert acts[-1].dtype == jnp.float32   # head: master dtype
+
+    def test_graph_output_vertex_runs_f32(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+            .compute_dtype("bfloat16")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("h", L.DenseLayer(n_in=8, n_out=16,
+                                         activation="relu"), "in")
+            .add_layer("out", L.OutputLayer(
+                n_in=16, n_out=3, activation="softmax",
+                loss_function="mcxent"), "h")
+            .set_outputs("out")
+            .build()
+        )
+        g = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = {"in": jnp.asarray(
+            rng.normal(size=(8, 8)).astype(np.float32))}
+        acts, _, _ = g._forward_fn(g.params, {}, x, None, False, None)
+        assert acts["h"].dtype == jnp.bfloat16
+        assert acts["out"].dtype == jnp.float32
