@@ -288,10 +288,15 @@ class PipelineTrainer:
     so MoE trajectories match single-device in expectation rather than
     bit-for-bit).
 
+    Running-state layers (BatchNormalization) train under GHOST-BATCH-
+    NORM semantics: normalization uses each microbatch's own statistics
+    and the running averages update once per microbatch (M updates per
+    step where single-device fit makes one; under dp the replicas'
+    statistics are pmean-averaged). State rows are stage-sharded like
+    params.
+
     Limitations (documented, enforced): plain-SGD-family training only
-    (no tBPTT, no second-order solvers), no running-state layers
-    (BatchNormalization statistics are per-microbatch quantities), no
-    feature/label masks.
+    (no tBPTT, no second-order solvers), no feature/label masks.
     """
 
     def __init__(
@@ -309,15 +314,17 @@ class PipelineTrainer:
         )
 
         net.init()
-        for si, st in (net.state or {}).items():
-            # Aux-only state (MoeDense load-balance loss) is step-local
-            # and threaded into the pipeline loss below; true running
-            # statistics are microbatch-local quantities we can't carry.
-            if not (isinstance(st, dict) and set(st) <= {"aux_loss"}):
-                lname = type(net.conf.confs[int(si)].layer).__name__
-                raise ValueError(
-                    "PipelineTrainer does not support layers with "
-                    f"running state (layer {si}: {lname})")
+        # Aux-only state (MoeDense load-balance loss) is step-local and
+        # threaded into the pipeline loss; RUNNING state (BatchNorm
+        # mean/var) is stage-sharded like params and updated once per
+        # VALID microbatch tick — ghost-batch-norm semantics: each
+        # microbatch contributes its own statistics, so running averages
+        # see M updates per step where single-device fit sees one
+        # (documented deviation; normalization itself uses the current
+        # microbatch's batch stats either way).
+        self._stateful = sorted(
+            si for si, st in (net.state or {}).items()
+            if not (isinstance(st, dict) and set(st) <= {"aux_loss"}))
         if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
             raise ValueError("PipelineTrainer does not support tBPTT")
         algo = net.conf.confs[0].optimization_algo
@@ -353,6 +360,7 @@ class PipelineTrainer:
         # Stage-sharded packed training state ([S, K] P(pp) buffers).
         self._theta = None
         self._ustate = None
+        self._sstate = None
         self._synced_params = None
         self._p_pack = _StagePacker(
             [self._stage_subtree(net.params, s)
@@ -360,10 +368,19 @@ class PipelineTrainer:
         self._u_pack = _StagePacker(
             [self._stage_subtree(net.updater_state, s)
              for s in range(self.n_stages)])
+        self._s_pack = _StagePacker(
+            [self._stage_state_subtree(s) for s in range(self.n_stages)])
 
     def _stage_subtree(self, tree_, s: int):
         start, end = self.stage_ranges[s]
         return {str(i): tree_[str(i)] for i in range(start, end)}
+
+    def _stage_state_subtree(self, s: int):
+        """Running-state (non-aux) subtree of stage s, from net.state."""
+        start, end = self.stage_ranges[s]
+        return {si: self.net.state[si]
+                for si in (str(i) for i in range(start, end))
+                if si in self._stateful}
 
     # -- packed-state lifecycle ---------------------------------------
     def _ensure_packed(self):
@@ -381,8 +398,12 @@ class PipelineTrainer:
         u_host = self._u_pack.pack(
             [self._stage_subtree(net.updater_state, s)
              for s in range(self.n_stages)], np.dtype(net._dtype))
+        s_host = self._s_pack.pack(
+            [self._stage_state_subtree(s) for s in range(self.n_stages)],
+            np.dtype(net._dtype))
         self._theta = jax.device_put(theta_host, sh)
         self._ustate = jax.device_put(u_host, sh)
+        self._sstate = jax.device_put(s_host, sh)
         self._synced_params = token
 
     def _sync_to_net(self):
@@ -395,6 +416,8 @@ class PipelineTrainer:
             net.params.update(sub)
         for sub in self._u_pack.unpack_to_host(self._ustate):
             net.updater_state.update(sub)
+        for sub in self._s_pack.unpack_to_host(self._sstate):
+            net.state.update(sub)
         self._synced_params = (
             id(net.params), getattr(net, "params_version", 0))
 
@@ -403,7 +426,7 @@ class PipelineTrainer:
         memory accounting (each device holds only its stage's row)."""
         self._ensure_packed()
         acc: dict = {}
-        for buf in (self._theta, self._ustate):
+        for buf in (self._theta, self._ustate, self._sstate):
             for shard in buf.addressable_shards:
                 d = shard.device
                 acc[d] = acc.get(d, 0) + shard.data.nbytes
@@ -416,16 +439,23 @@ class PipelineTrainer:
 
     # -- stage math ----------------------------------------------------
     def _apply_stage(self, s: int, params, x, rngs, train=True,
-                     master_from=None):
+                     master_from=None, state=None):
         """Apply layers [start, end) of stage s (with preprocessors).
-        Returns (activations, weighted aux-loss sum of the stage).
+        Returns (activations, weighted aux-loss sum of the stage, new
+        running state of the stage's stateful layers).
         ``master_from``: layer index from which activations are cast
         back to the master dtype (the f32 output-layer rule of
-        MultiLayerNetwork._forward_fn under mixed precision)."""
+        MultiLayerNetwork._forward_fn under mixed precision).
+        ``state``: {si: running-state} for this stage's stateful layers
+        (BatchNorm mean/var)."""
+        from deeplearning4j_tpu.nn.multilayer import _cast_floating
+
         net = self.net
         start, end = self.stage_ranges[s]
         aux = jnp.zeros((), net._dtype)
+        new_state = {}
         for i in range(start, end):
+            si = str(i)
             c = net.conf.confs[i]
             pp = net.conf.preprocessor_for(i)
             if pp is not None:
@@ -434,16 +464,21 @@ class PipelineTrainer:
                 # AFTER the preprocessor — matching the cast point in
                 # MultiLayerNetwork._forward_fn so mixed-precision
                 # trajectories agree with single-device fit.
-                from deeplearning4j_tpu.nn.multilayer import _cast_floating
                 x = _cast_floating(x, net._dtype)
             x, st = net._impls[i].apply(
-                c, params[str(i)], x,
-                state=None, train=train, rng=rngs[i], mask=None,
+                c, params[si], x,
+                state=(state or {}).get(si), train=train, rng=rngs[i],
+                mask=None,
             )
             w = getattr(c.layer, "aux_weight", None)
             if w and isinstance(st, dict) and "aux_loss" in st:
                 aux = aux + w * st["aux_loss"].astype(net._dtype)
-        return x, aux
+            elif st is not None and si in self._stateful:
+                # running statistics stay at the master dtype (same rule
+                # as _forward_fn's carried-state cast)
+                new_state[si] = jax.tree.map(
+                    lambda a: _cast_floating(a, net._dtype), st)
+        return x, aux, new_state
 
     def _boundary_shapes(self, feats_mb_shape):
         """Activation shape entering each stage (index 0 = input)."""
@@ -454,7 +489,8 @@ class PipelineTrainer:
         for s in range(self.n_stages):
             x = jax.eval_shape(
                 lambda xx, _s=s: self._apply_stage(
-                    _s, net.params, xx, rngs, train=False)[0], x)
+                    _s, net.params, xx, rngs, train=False,
+                    state=self._stage_state_subtree(_s))[0], x)
             shapes.append(x.shape)
         return shapes
 
@@ -496,10 +532,13 @@ class PipelineTrainer:
         last_layer = net.n_layers - 1
         last_si = str(last_layer)
 
+        s_pack = self._s_pack
+
         def branch(s):
             in_shape = shapes[s]
 
-            def run(theta_cd, theta_master, x_feed, buf, y_mb, rngs):
+            def run(theta_cd, theta_master, state_vec, x_feed, buf,
+                    y_mb, rngs):
                 params = p_pack.unpack_row(s, theta_cd)
                 if out_f32 and s == S - 1:
                     # The output layer's params come from the f32 row
@@ -512,10 +551,11 @@ class PipelineTrainer:
                 else:
                     w = widths[s]
                     xin = buf[:, :w].reshape(in_shape)
-                y, aux = self._apply_stage(
+                y, aux, new_st = self._apply_stage(
                     s, params, xin, rngs,
                     master_from=(last_layer
-                                 if out_f32 and s == S - 1 else None))
+                                 if out_f32 and s == S - 1 else None),
+                    state=s_pack.unpack_row(s, state_vec))
                 if s == S - 1:
                     yl = y
                     if cd is not None:
@@ -527,7 +567,13 @@ class PipelineTrainer:
                 if cd is not None:
                     yf = yf.astype(cd)  # homogeneous hop-buffer dtype
                 yf = jnp.pad(yf, ((0, 0), (0, K - yf.shape[1])))
-                return yf, loss, aux
+                # Running statistics carry no gradient (has_aux
+                # semantics of the single-device step); keep the stage's
+                # old row where it has no stateful layers.
+                st_row = (lax.stop_gradient(
+                    s_pack.pack_row(s, new_st, net._dtype))
+                    if new_st else state_vec)
+                return yf, loss, aux, st_row
 
             return run
 
@@ -570,7 +616,8 @@ class PipelineTrainer:
 
         upd_branches = [upd_branch(s) for s in range(S)]
 
-        def local_step(theta, ustate, iteration, rng, feats, labels):
+        def local_step(theta, ustate, sstate, iteration, rng, feats,
+                       labels):
             # theta [1, Kp]: this device's stage row. feats/labels: this
             # replica's batch shard (full batch when no dp axis).
             idx = lax.axis_index(axis)
@@ -588,7 +635,7 @@ class PipelineTrainer:
                 loss0 = jnp.zeros((), net._dtype)
 
                 def tick(t, carry):
-                    buf, loss_acc, aux_acc = carry
+                    buf, loss_acc, aux_acc, st_vec = carry
                     # Stage idx processes microbatch t - idx at tick t;
                     # fold the microbatch index into the rng so each
                     # microbatch draws distinct dropout masks.
@@ -598,22 +645,24 @@ class PipelineTrainer:
                     feed = x_mbs[jnp.minimum(t, M - 1)]
                     out_t = jnp.maximum(t - (S - 1), 0)
                     y_mb = y_mbs[out_t]
-                    yf, loss, aux = lax.switch(
-                        idx, branches, tv, theta_row, feed, buf, y_mb,
-                        rngs)
+                    yf, loss, aux, st_new = lax.switch(
+                        idx, branches, tv, theta_row, st_vec, feed, buf,
+                        y_mb, rngs)
                     write = (idx == S - 1) & (t - (S - 1) >= 0)
                     loss_acc = loss_acc + jnp.where(write, loss, 0.0)
                     # Stage idx holds a REAL microbatch only for ticks
                     # in [idx, idx + M); warmup/drain garbage must not
-                    # leak into the aux loss.
+                    # leak into the aux loss or the running statistics
+                    # (ghost-BN: one state update per VALID microbatch).
                     valid = (t >= idx) & (t < idx + M)
                     aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+                    st_vec = jnp.where(valid, st_new, st_vec)
                     perm = [(i, (i + 1) % S) for i in range(S)]
                     buf = lax.ppermute(yf, axis, perm)
-                    return buf, loss_acc, aux_acc
+                    return buf, loss_acc, aux_acc, st_vec
 
-                _, loss_sum, aux_sum = lax.fori_loop(
-                    0, M + S - 1, tick, (buf0, loss0, loss0))
+                _, loss_sum, aux_sum, st_final = lax.fori_loop(
+                    0, M + S - 1, tick, (buf0, loss0, loss0, sstate[0]))
                 # LOCAL (unreduced) stage contribution: data loss lives
                 # on the last stage, aux/reg on each stage. The global
                 # score = psum of these, but the psum must happen OUTSIDE
@@ -629,30 +678,35 @@ class PipelineTrainer:
                 # statistic, so trajectories with MoE layers match in
                 # expectation, not bit-for-bit.
                 reg = lax.switch(idx, reg_branches, theta_row)
-                return (loss_sum + aux_sum) / M + reg
+                return (loss_sum + aux_sum) / M + reg, st_final
 
-            score_local, grad = jax.value_and_grad(loss_fn)(theta[0])
+            (score_local, st_final), grad = jax.value_and_grad(
+                loss_fn, has_aux=True)(theta[0])
             # Reported score: sum of stage contributions over the ring.
             score = lax.psum(score_local, axis)
             if dp is not None:
                 # Average per-stage gradients across data replicas: the
-                # mean over the global batch (equal shard sizes).
+                # mean over the global batch (equal shard sizes); ghost-
+                # BN running statistics average across replicas too (the
+                # per-replica microbatch stats are equal-sized samples).
                 grad = lax.pmean(grad, dp)
                 score = lax.pmean(score, dp)
+                st_final = lax.pmean(st_final, dp)
             new_t, new_u = lax.switch(
                 idx, upd_branches, theta[0], grad, ustate[0], iteration)
-            return new_t[None], new_u[None], score
+            return new_t[None], new_u[None], st_final[None], score
 
         batch_spec = P(dp) if dp is not None else P()
         step = shard_map(
             local_step,
             mesh=self.mesh,
-            in_specs=(P(self.pp_axis), P(self.pp_axis), P(), P(),
-                      batch_spec, batch_spec),
-            out_specs=(P(self.pp_axis), P(self.pp_axis), P()),
+            in_specs=(P(self.pp_axis), P(self.pp_axis), P(self.pp_axis),
+                      P(), P(), batch_spec, batch_spec),
+            out_specs=(P(self.pp_axis), P(self.pp_axis), P(self.pp_axis),
+                       P()),
             check_vma=False,
         )
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     # -- public API ----------------------------------------------------
     def fit(self, data, labels=None) -> float:
@@ -680,10 +734,11 @@ class PipelineTrainer:
                 self._step_cache[key] = self._build_step(
                     feats.shape, labs.shape)
             net._key, sub = jax.random.split(net._key)
-            self._theta, self._ustate, s = self._step_cache[key](
-                self._theta, self._ustate, net.iteration, sub,
-                feats, labs,
-            )
+            self._theta, self._ustate, self._sstate, s = \
+                self._step_cache[key](
+                    self._theta, self._ustate, self._sstate,
+                    net.iteration, sub, feats, labs,
+                )
             net.score_value = s
             net.iteration += 1
             score = float(s)
