@@ -80,10 +80,13 @@ def route_top_k(
     ``dispatch`` is a {0,1} token→slot assignment (each token occupies at
     most k slots, each expert at most C tokens, first-come in batch
     order); ``combine`` is dispatch weighted by the (optionally
-    renormalized) router gate. Routing runs in f32 — cumsum-based slot
-    positions are exact integers that bf16 cannot represent past 256.
+    renormalized) router gate. Routing runs at AT LEAST f32 — cumsum-
+    based slot positions are exact integers that bf16 cannot represent
+    past 256 — and follows the input up to f64 (gradient checks run the
+    whole net in double precision; a hard f32 cast here would inject
+    rounding noise larger than the centered difference).
     """
-    f32 = jnp.float32
+    f32 = jnp.promote_types(logits.dtype, jnp.float32)
     probs = jax.nn.softmax(logits.astype(f32), axis=-1)  # [B, E]
     B, E = probs.shape
     remaining = probs
@@ -149,7 +152,8 @@ def moe_apply(
     B = x.shape[0]
     E = params["router"].shape[1]
     capacity = expert_capacity(B, E, capacity_factor, top_k)
-    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    rdt = jnp.promote_types(x.dtype, jnp.float32)
+    logits = x.astype(rdt) @ params["router"].astype(rdt)
     dispatch, combine, aux = route_top_k(logits, capacity, top_k)
     xe = jnp.einsum("bec,bd->ecd", dispatch.astype(x.dtype), x)
     if ep_axis is not None:
