@@ -76,6 +76,20 @@ class NativeLib:
             self.has_mine_pairs = True
         except AttributeError:  # older prebuilt .so
             self.has_mine_pairs = False
+        try:  # ABI v3+: vocab hash + whitespace tokenizer
+            lib.dl4j_vocab_new.restype = ctypes.c_void_p
+            lib.dl4j_vocab_new.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int32]
+            lib.dl4j_vocab_free.argtypes = [ctypes.c_void_p]
+            lib.dl4j_tokenize.restype = ctypes.c_int64
+            lib.dl4j_tokenize.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int32))]
+            self.has_tokenize = True
+        except AttributeError:  # older prebuilt .so
+            self.has_tokenize = False
 
     @classmethod
     def load(cls) -> Optional["NativeLib"]:
@@ -294,6 +308,61 @@ def mine_pairs(flat: np.ndarray, seq_id: np.ndarray, window: int,
     nl.lib.dl4j_free(cen)
     nl.lib.dl4j_free(ctx)
     return centers, contexts
+
+
+class NativeVocab:
+    """C++ word->index hash for dl4j_tokenize; frees itself on gc.
+    Returns None from ``create`` when the native library (or the ABI v3
+    tokenizer) is unavailable."""
+
+    def __init__(self, nl: "NativeLib", handle: int):
+        self._nl = nl
+        self._handle = handle
+
+    @classmethod
+    def create(cls, words: List[str],
+               indices: np.ndarray) -> Optional["NativeVocab"]:
+        nl = NativeLib.load()
+        if nl is None or not getattr(nl, "has_tokenize", False):
+            return None
+        enc = [w.encode("utf-8") for w in words]
+        buf = b"".join(enc)
+        offsets = np.zeros(len(enc) + 1, np.int64)
+        np.cumsum([len(e) for e in enc], out=offsets[1:])
+        idx = np.ascontiguousarray(indices, np.int32)
+        handle = nl.lib.dl4j_vocab_new(
+            buf, offsets.ctypes.data_as(ctypes.c_void_p),
+            idx.ctypes.data_as(ctypes.c_void_p), len(enc))
+        if not handle:
+            return None
+        return cls(nl, handle)
+
+    def tokenize(self, text: bytes
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Newline-separated sequences of whitespace-separated tokens ->
+        (vocab ids, sequence ids); out-of-vocab tokens are skipped."""
+        ids = ctypes.POINTER(ctypes.c_int32)()
+        sid = ctypes.POINTER(ctypes.c_int32)()
+        n = self._nl.lib.dl4j_tokenize(
+            self._handle, text, len(text),
+            ctypes.byref(ids), ctypes.byref(sid))
+        if n < 0:
+            return None
+        if n == 0:
+            self._nl.lib.dl4j_free(ids)
+            self._nl.lib.dl4j_free(sid)
+            return np.empty(0, np.int32), np.empty(0, np.int32)
+        out = (np.ctypeslib.as_array(ids, (n,)).copy(),
+               np.ctypeslib.as_array(sid, (n,)).copy())
+        self._nl.lib.dl4j_free(ids)
+        self._nl.lib.dl4j_free(sid)
+        return out
+
+    def __del__(self):
+        try:
+            self._nl.lib.dl4j_vocab_free(self._handle)
+        except Exception:
+            pass
 
 
 def shuffle_indices(n: int, seed: int) -> np.ndarray:

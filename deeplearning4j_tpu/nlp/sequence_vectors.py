@@ -71,14 +71,20 @@ class SequenceVectors:
         self.syn0: Optional[Array] = None  # [V, D] word vectors
         self.syn1: Optional[Array] = None  # [V, D] HS inner-node weights
         self.syn1neg: Optional[Array] = None  # [V, D] NS context weights
+        self._native_vocab = None  # C++ tokenizer hash (lazy, ABI v3)
+        self._native_vocab_tried = False
 
     # ------------------------------------------------------------------
     # Vocab + weights
     # ------------------------------------------------------------------
     def build_vocab_from(self, sequences: Iterable[Sequence[str]]) -> None:
-        self.vocab = build_vocab(sequences, self.min_word_frequency)
+        self.vocab = build_vocab(
+            (s.split() if isinstance(s, str) else s for s in sequences),
+            self.min_word_frequency)
         if self.use_hs:
             assign_huffman_codes(self.vocab)
+        self._native_vocab = None  # rebuilt lazily for the new vocab
+        self._native_vocab_tried = False
         self._reset_weights()
 
     def _reset_weights(self) -> None:
@@ -121,8 +127,66 @@ class SequenceVectors:
         keep = (np.sqrt(f / self.subsampling) + 1) * self.subsampling / f
         return np.minimum(1.0, keep)
 
+    def _tokenize_corpus(self, sequences: Iterable[Sequence[str]]):
+        """Corpus -> (flat vocab-index array, sequence-id array).
+
+        Fast path: the C++ vocab-hash tokenizer (ABI v3,
+        native/dl4j_native.cpp dl4j_tokenize) — the corpus is joined
+        into one newline-separated buffer with C-speed str.join and
+        scanned natively, removing the per-token Python dict lookup
+        that dominated round-2 host time (~0.55 s/1M words). Sequences
+        may be token lists (tokens must be whitespace-free — true of
+        any tokenizer output; the native and fallback paths otherwise
+        disagree on how to split them) OR raw whitespace-separated
+        strings (the reference's SentenceIterator contract; interior
+        newlines are treated as plain spaces, matching str.split)."""
+        from deeplearning4j_tpu.native_rt.lib import NativeVocab
+
+        if self._native_vocab is None and self._native_vocab_tried is False:
+            self._native_vocab_tried = True
+            words = self.vocab.vocab_words()
+            self._native_vocab = NativeVocab.create(
+                [w.word for w in words],
+                np.asarray([w.index for w in words], np.int32))
+        if self._native_vocab is not None:
+            # Materialize one-shot iterators first: the join consumes
+            # them, and a native failure must still be able to fall
+            # back (list of refs — cheap).
+            if not isinstance(sequences, (list, tuple)):
+                sequences = list(sequences)
+            text = "\n".join(
+                s.replace("\n", " ") if isinstance(s, str)
+                else " ".join(s)
+                for s in sequences)
+            out = self._native_vocab.tokenize(text.encode("utf-8"))
+            if out is not None:
+                return out
+        word_to_idx = {
+            w.word: w.index for w in self.vocab.vocab_words()
+        }
+        flat_parts: List[np.ndarray] = []
+        seq_parts: List[np.ndarray] = []
+        for sid, tokens in enumerate(sequences):
+            if isinstance(tokens, str):
+                tokens = tokens.split()
+            idxs = [word_to_idx[t] for t in tokens if t in word_to_idx]
+            if idxs:
+                arr = np.asarray(idxs, np.int32)
+                flat_parts.append(arr)
+                seq_parts.append(np.full(len(arr), sid, np.int32))
+        if not flat_parts:
+            return np.empty(0, np.int32), np.empty(0, np.int32)
+        return np.concatenate(flat_parts), np.concatenate(seq_parts)
+
     def _mine_pairs(
         self, sequences: Iterable[Sequence[str]], rng: np.random.Generator
+    ):
+        flat, seq_id = self._tokenize_corpus(sequences)
+        yield from self._mine_pairs_from_ids(flat, seq_id, rng)
+
+    def _mine_pairs_from_ids(
+        self, flat: np.ndarray, seq_id: np.ndarray,
+        rng: np.random.Generator,
     ):
         """Yield (center_idx, context_idx) int32 arrays in batches, applying
         frequent-word subsampling and the word2vec per-center random window
@@ -130,22 +194,9 @@ class SequenceVectors:
         array with sequence ids, and every window offset is one numpy
         slice-compare — no per-token Python loop (this mining is the
         words/sec hot path feeding the jitted update)."""
-        keep_prob = self._keep_probs()
-        word_to_idx = {
-            w.word: w.index for w in self.vocab.vocab_words()
-        }
-        flat_parts: List[np.ndarray] = []
-        seq_parts: List[np.ndarray] = []
-        for sid, tokens in enumerate(sequences):
-            idxs = [word_to_idx[t] for t in tokens if t in word_to_idx]
-            if idxs:
-                arr = np.asarray(idxs, np.int32)
-                flat_parts.append(arr)
-                seq_parts.append(np.full(len(arr), sid, np.int32))
-        if not flat_parts:
+        if len(flat) == 0:
             return
-        flat = np.concatenate(flat_parts)
-        seq_id = np.concatenate(seq_parts)
+        keep_prob = self._keep_probs()
         # Native C++ fast path: subsample + window walk + shuffle in one
         # call (native/dl4j_native.cpp dl4j_mine_pairs); numpy below is
         # the portable fallback with identical semantics.
@@ -400,15 +451,35 @@ class SequenceVectors:
             ).astype(np.float32)
 
         key_box = [key]
+        # Fast path: tokenize ONCE and reuse the id-corpus across
+        # epochs — the ids (8 B/token) are far smaller than the token
+        # strings, and epochs differ only in subsampling/window draws,
+        # which happen in the miner. Only taken when BOTH hold:
+        # - the corpus is a materialized iterable (a CALLABLE factory
+        #   may stream fresh/augmented sequences per epoch — the
+        #   documented contract — so it is re-invoked and re-tokenized
+        #   each epoch), and
+        # - _mine_pairs is not overridden (ParagraphVectors mines
+        #   label-word pairs from the sequences themselves and must see
+        #   them, not the id arrays).
+        plain_miner = type(self)._mine_pairs is SequenceVectors._mine_pairs
+        id_corpus = None
         for epoch in range(self.epochs):
-            seqs = (
-                sequences_factory()
-                if callable(sequences_factory)
-                else sequences_factory
-            )
+            if id_corpus is not None:
+                batches = self._mine_pairs_from_ids(*id_corpus, rng)
+            else:
+                seqs = (
+                    sequences_factory()
+                    if callable(sequences_factory)
+                    else sequences_factory
+                )
+                if plain_miner and not callable(sequences_factory):
+                    id_corpus = self._tokenize_corpus(seqs)
+                    batches = self._mine_pairs_from_ids(*id_corpus, rng)
+                else:
+                    batches = self._mine_pairs(seqs, rng)
             pairs_done = self._dispatch_chunks(
-                self._mine_pairs(seqs, rng), annealed_lrs, key_box,
-                pairs_done)
+                batches, annealed_lrs, key_box, pairs_done)
         self._pairs_trained = pairs_done
 
     # batches per device dispatch (see _hs_step docstring)

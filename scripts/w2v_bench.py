@@ -1,0 +1,78 @@
+"""Word2Vec end-to-end words/sec on the real TPU chip: HS and NS rows.
+
+Protocol identical to the round-2 BENCHMARKS.md measurement (zipf 1M
+words, vocab 10k, d=128, window 5, single chip, warm) so rounds stay
+comparable; adds the negative-sampling row the VERDICT flagged as
+unmeasured, and a host-tokenization timing isolating the native
+dl4j_tokenize gain. Run: python scripts/w2v_bench.py [--words 1000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def make_corpus(n_words: int, vocab: int = 10_000, sent_len: int = 20,
+                seed: int = 7):
+    rng = np.random.default_rng(seed)
+    # zipf over a 10k vocab, tokens as strings "w<i>"
+    ranks = np.arange(1, vocab + 1)
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    ids = rng.choice(vocab, size=n_words, p=probs)
+    words = np.array([f"w{i}" for i in range(vocab)])
+    toks = words[ids]
+    return [
+        " ".join(toks[i:i + sent_len])
+        for i in range(0, n_words, sent_len)
+    ]
+
+
+def run(mode: str, corpus, n_words: int) -> dict:
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    kw = dict(layer_size=128, window=5, min_word_frequency=1,
+              batch_size=8192, seed=3)
+    if mode == "hs":
+        w2v = Word2Vec(use_hierarchic_softmax=True, negative=0, **kw)
+    else:
+        w2v = Word2Vec(use_hierarchic_softmax=False, negative=5, **kw)
+    w2v.build_vocab_from(corpus)
+
+    # tokenization-only timing (the round-2 host bottleneck)
+    t0 = time.perf_counter()
+    flat, _ = w2v._tokenize_corpus(corpus)
+    tok_s = time.perf_counter() - t0
+
+    # warm compile on a small slice
+    w2v.fit(corpus[:200])
+    w2v._reset_weights()
+
+    t0 = time.perf_counter()
+    w2v.fit(corpus)
+    dt = time.perf_counter() - t0
+    return {
+        "mode": mode,
+        "words_per_sec": round(n_words / dt, 1),
+        "fit_seconds": round(dt, 3),
+        "tokenize_seconds": round(tok_s, 3),
+        "tokens_kept": int(len(flat)),
+        "pairs_trained": int(w2v._pairs_trained),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--words", type=int, default=1_000_000)
+    ap.add_argument("--trials", type=int, default=3)
+    args = ap.parse_args()
+    corpus = make_corpus(args.words)
+    for mode in ("hs", "ns"):
+        for t in range(args.trials):
+            print(mode, t, run(mode, corpus, args.words))
+
+
+if __name__ == "__main__":
+    main()

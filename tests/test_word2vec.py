@@ -204,3 +204,46 @@ class TestVectorizers:
         ir = v.vocab.index_of("rare1")
         # Per-occurrence weight of the ubiquitous term is lower.
         assert x[0, ic] / 2.0 < x[0, ir]
+
+
+class TestNativeTokenizer:
+    """C++ dl4j_tokenize fast path (ABI v3) must agree with the Python
+    fallback — including raw-string sentences and interior newlines."""
+
+    def _w2v(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        corpus = [["alpha", "beta", "gamma"], ["beta", "delta"],
+                  ["alpha", "alpha", "delta", "gamma"]]
+        w = Word2Vec(layer_size=8, window=2, min_word_frequency=1,
+                     seed=1)
+        w.build_vocab_from(corpus)
+        return w
+
+    def test_native_matches_fallback(self):
+        import numpy as np
+
+        w = self._w2v()
+        seqs = [["alpha", "beta", "unknowntok", "gamma"],
+                "beta delta  alpha",        # raw string, double space
+                "alpha\nbeta",              # interior newline == space
+                ["delta"]]
+        native = w._tokenize_corpus(list(seqs))
+        # Force the Python fallback.
+        w._native_vocab, w._native_vocab_tried = None, True
+        fallback = w._tokenize_corpus(list(seqs))
+        if native is None:
+            return  # no native lib in this environment
+        np.testing.assert_array_equal(native[0], fallback[0])
+        # seq ids must group identically (values may differ by offset)
+        _, n_inv = np.unique(native[1], return_inverse=True)
+        _, f_inv = np.unique(fallback[1], return_inverse=True)
+        np.testing.assert_array_equal(n_inv, f_inv)
+
+    def test_generator_corpus_survives_native_failure_path(self):
+        """One-shot iterators are materialized before the join, so the
+        fallback never sees a drained generator."""
+        w = self._w2v()
+        flat, sid = w._tokenize_corpus(
+            s for s in [["alpha", "beta"], ["gamma"]])
+        assert len(flat) == 3
