@@ -362,6 +362,7 @@ class PipelineTrainer:
         self._ustate = None
         self._sstate = None
         self._synced_params = None
+        self._gather_fn = None
         self._p_pack = _StagePacker(
             [self._stage_subtree(net.params, s)
              for s in range(self.n_stages)])
@@ -406,17 +407,38 @@ class PipelineTrainer:
         self._sstate = jax.device_put(s_host, sh)
         self._synced_params = token
 
+    def _gatherable(self, buf):
+        """Multi-host: a [S, K] P(pp) buffer has non-addressable shards
+        when the pp axis spans processes; reshard to replicated first
+        (one cross-host all-gather) so device_get works everywhere.
+
+        NOTE: the gather transiently materializes that one buffer
+        replicated on-device before the host copy — an explicit
+        full-model materialization is what a sync IS; buffers are
+        gathered one at a time, so the transient peak is one buffer,
+        not all three. The jitted identity is cached on self (jit
+        caches by function object)."""
+        if jax.process_count() > 1:
+            if self._gather_fn is None:
+                self._gather_fn = jax.jit(
+                    lambda a: a,
+                    out_shardings=NamedSharding(self.mesh, P()))
+            return self._gather_fn(buf)
+        return buf
+
     def _sync_to_net(self):
         """Gather packed state back into net.params / net.updater_state
         as HOST numpy leaves (a device re-upload here would materialize
         the full model on the default device and defeat the 1/S memory
         property; jit transfers leaves on their next use)."""
         net = self.net
-        for sub in self._p_pack.unpack_to_host(self._theta):
+        for sub in self._p_pack.unpack_to_host(self._gatherable(self._theta)):
             net.params.update(sub)
-        for sub in self._u_pack.unpack_to_host(self._ustate):
+        for sub in self._u_pack.unpack_to_host(
+                self._gatherable(self._ustate)):
             net.updater_state.update(sub)
-        for sub in self._s_pack.unpack_to_host(self._sstate):
+        for sub in self._s_pack.unpack_to_host(
+                self._gatherable(self._sstate)):
             net.state.update(sub)
         self._synced_params = (
             id(net.params), getattr(net, "params_version", 0))
@@ -742,10 +764,15 @@ class PipelineTrainer:
             net.score_value = s
             net.iteration += 1
             score = float(s)
-            if net.listeners:
+            if net.listeners and jax.process_count() == 1:
                 # Listeners may inspect/checkpoint net.params: sync the
                 # packed state back before each callback (listener-free
                 # training pays one gather per fit() call instead).
+                # Multi-process runs sync once at end-of-fit only: the
+                # sync is a cross-host collective, and a host-local
+                # `net.listeners` condition would deadlock the gang
+                # whenever listeners are attached asymmetrically (e.g.
+                # a chief-only checkpoint listener).
                 self._sync_to_net()
             for listener in net.listeners:
                 listener.iteration_done(net, net.iteration)
