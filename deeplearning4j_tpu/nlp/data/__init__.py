@@ -1,0 +1,109 @@
+"""Bundled training fixtures for the out-of-the-box NLP models.
+
+The reference's PoS tagging and tree parsing work with zero setup
+because UIMA/ClearTK ship trained models as dependency artifacts
+(reference text/tokenization/tokenizer/PosUimaTokenizer.java:35-50,
+text/corpora/treeparser/TreeParser.java); the analogue here is a small
+bundled tagged corpus + treebank that ``HmmPosTagger.pretrained()`` /
+``PcfgParser.pretrained()`` train from on first use (milliseconds, then
+cached for the process).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load_tagged_corpus() -> List[List[Tuple[str, str]]]:
+    """Bundled word/TAG corpus -> [[(word, tag), ...], ...]."""
+    out = []
+    with open(os.path.join(_DIR, "pos_en_fixture.txt")) as f:
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            sent = []
+            for t in toks:
+                word, _, tag = t.rpartition("/")
+                sent.append((word, tag))
+            out.append(sent)
+    return out
+
+
+def parse_bracketed(s: str):
+    """One Penn-style bracketed tree string -> ParseTree. Raises
+    ValueError (with the offending text) on truncated or malformed
+    input instead of an uninformative IndexError from deep inside the
+    scan."""
+    from deeplearning4j_tpu.nlp.tree_parser import ParseTree
+
+    pos = 0
+
+    def fail(msg):
+        raise ValueError(
+            f"malformed bracketed tree at char {pos}: {msg} "
+            f"in {s[:80]!r}")
+
+    def scan_atom():
+        nonlocal pos
+        end = pos
+        while end < len(s) and s[end] not in " ()":
+            end += 1
+        if end == pos:
+            fail("expected a label/word")
+        atom = s[pos:end]
+        pos = end
+        return atom
+
+    def parse_node():
+        nonlocal pos
+        if pos >= len(s) or s[pos] != "(":
+            fail("expected '('")
+        pos += 1
+        label = scan_atom()
+        children = []
+        word = None
+        while True:
+            while pos < len(s) and s[pos] == " ":
+                pos += 1
+            if pos >= len(s):
+                fail(f"unclosed '({label}'")
+            if s[pos] == ")":
+                pos += 1
+                break
+            if s[pos] == "(":
+                children.append(parse_node())
+            else:
+                word = scan_atom()
+        if word is not None and children:
+            fail(f"node ({label} ...) mixes children and a word")
+        if word is not None:
+            # Codebase pre-terminal convention (tree_parser.ParseTree):
+            # "(DT the)" is a DT node wrapping a leaf that carries the
+            # word — is_pre_terminal() relies on that shape.
+            return ParseTree(label=label,
+                             children=[ParseTree(label=label, word=word)])
+        return ParseTree(label=label, children=children)
+
+    while pos < len(s) and s[pos] == " ":
+        pos += 1
+    return parse_node()
+
+
+def load_treebank():
+    """Bundled bracketed treebank -> [ParseTree, ...]."""
+    trees = []
+    with open(os.path.join(_DIR, "trees_en_fixture.txt")) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                trees.append(parse_bracketed(line))
+            except ValueError as e:
+                raise ValueError(
+                    f"trees_en_fixture.txt line {lineno}: {e}") from None
+    return trees

@@ -28,9 +28,25 @@ from deeplearning4j_tpu.nlp.tree_parser import (
 
 
 class PcfgParser:
+    _pretrained_singleton = None
+
     def __init__(self, fallback: Optional[TreeParser] = None):
         self.fallback = fallback or TreeParser()
         self._fitted = False
+
+    @classmethod
+    def pretrained(cls) -> "PcfgParser":
+        """Out-of-the-box parser induced from the bundled treebank
+        (deeplearning4j_tpu/nlp/data) — the analogue of the reference's
+        shipped ClearTK/OpenNLP parsing models
+        (text/corpora/treeparser/TreeParser.java), which make parsing
+        work with zero user setup. Induces in milliseconds on first
+        call, then cached for the process."""
+        if cls._pretrained_singleton is None:
+            from deeplearning4j_tpu.nlp.data import load_treebank
+
+            cls._pretrained_singleton = cls().fit(load_treebank())
+        return cls._pretrained_singleton
 
     # -- grammar induction --------------------------------------------
     def fit(self, trees: Iterable[ParseTree]) -> "PcfgParser":

@@ -41,10 +41,25 @@ def _shape_class(word: str) -> str:
 class HmmPosTagger:
     """Supervised bigram HMM: fit on tagged sentences, Viterbi decode."""
 
+    _pretrained_singleton = None
+
     def __init__(self, smoothing: float = 0.1, rare_threshold: int = 1):
         self.smoothing = smoothing
         self.rare_threshold = rare_threshold
         self._fitted = False
+
+    @classmethod
+    def pretrained(cls) -> "HmmPosTagger":
+        """Out-of-the-box tagger trained from the bundled corpus
+        (deeplearning4j_tpu/nlp/data) — the analogue of the reference's
+        shipped UIMA PoS models (PosUimaTokenizer.java:35-50), which
+        make tagging work with zero user setup. Trains in milliseconds
+        on first call, then cached for the process."""
+        if cls._pretrained_singleton is None:
+            from deeplearning4j_tpu.nlp.data import load_tagged_corpus
+
+            cls._pretrained_singleton = cls().fit(load_tagged_corpus())
+        return cls._pretrained_singleton
 
     def fit(
         self, tagged_sentences: Iterable[Sequence[Tuple[str, str]]]

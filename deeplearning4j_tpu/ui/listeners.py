@@ -45,12 +45,26 @@ class FlowIterationListener(IterationListener):
         layers = []
         for i, conf in enumerate(model.conf.confs):
             bean = conf.layer
+            si = str(i)
+            shapes = {
+                name: list(np.asarray(p).shape)
+                for name, p in model.params.get(si, {}).items()
+            }
+            n_par = int(sum(int(np.prod(s)) for s in shapes.values()))
+            pp = model.conf.preprocessor_for(i)
             layers.append({
                 "index": i,
                 "type": type(bean).__name__,
                 "n_in": getattr(bean, "n_in", None),
                 "n_out": getattr(bean, "n_out", None),
                 "activation": getattr(bean, "activation", None),
+                # per-layer detail for the flow view's hover/click panel
+                # (reference FlowIterationListener's per-layer ModelInfo,
+                # FlowIterationListener.java:120-200)
+                "n_params": n_par,
+                "param_shapes": shapes,
+                "preprocessor": type(pp).__name__ if pp else None,
+                "updater": str(conf.resolved("updater") or ""),
             })
         n_params = int(sum(np.asarray(p).size
                            for p in model.param_table().values()))

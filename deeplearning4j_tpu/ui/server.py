@@ -31,7 +31,7 @@ from deeplearning4j_tpu.ui.storage import HistoryStorage
 from deeplearning4j_tpu.util.httpjson import HttpService, JsonHandler
 
 _DASHBOARD = """<!doctype html>
-<html><head><title>deeplearning4j_tpu</title>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu</title>
 <style>
 body{font-family:monospace;margin:2em;background:#fafafa}
 .card{background:#fff;border:1px solid #ddd;border-radius:6px;
@@ -137,17 +137,24 @@ function scatter(ctx, v, W, H){
     }
   });
 }
-function flow(ctx, v, W, H){
-  // network structure boxes + connections (the reference's
-  // FlowIterationListener interactive flow view)
+function flow(ctx, v, W, H, cv){
+  // network structure boxes + connections; hover highlights a layer
+  // and click pins its detail panel (the reference's interactive
+  // FlowIterationListener view with per-layer ModelInfo)
   const L = v.layers, n = L.length;
   const bw = Math.min(110, Math.floor((W-30)/n)-8), bh = 52;
   const y = Math.floor(H/2) - bh/2;
   ctx.font='9px monospace';
+  const boxes = [];
+  const hov = cv._flowHover, pin = cv._flowPin;
   L.forEach((l, i) => {
     const x = 15 + i*(bw+8);
-    ctx.fillStyle='#eaf2fc'; ctx.fillRect(x, y, bw, bh);
-    ctx.strokeStyle='#0a62c9'; ctx.strokeRect(x, y, bw, bh);
+    boxes.push({x:x, y:y, w:bw, h:bh, layer:l});
+    const hot = (i === hov) || (i === pin);
+    ctx.fillStyle = hot ? '#cfe3fa' : '#eaf2fc';
+    ctx.fillRect(x, y, bw, bh);
+    ctx.strokeStyle='#0a62c9'; ctx.lineWidth = hot ? 2 : 1;
+    ctx.strokeRect(x, y, bw, bh); ctx.lineWidth = 1;
     ctx.fillStyle='#222';
     ctx.fillText(String(l.type).slice(0, 14), x+3, y+12);
     ctx.fillText((l.n_in==null?'?':l.n_in)+' -> '+
@@ -161,7 +168,94 @@ function flow(ctx, v, W, H){
     }
   });
   ctx.fillStyle='#555';
-  ctx.fillText('params: '+v.num_params, 15, y+bh+14);
+  ctx.fillText('params: '+v.num_params+
+               '   (hover a layer; click to pin)', 15, y+bh+14);
+  cv._flowBoxes = boxes;
+  cv._flowLast = v;
+  const detail = () => {
+    const idx = (cv._flowPin != null) ? cv._flowPin : cv._flowHover;
+    const pre = cv.parentElement.querySelector('pre');
+    if (idx == null || !cv._flowBoxes[idx]){
+      pre.style.display='none'; return;
+    }
+    const l = cv._flowBoxes[idx].layer;
+    pre.style.display='block';
+    pre.textContent =
+      'layer '+l.index+': '+l.type+'\\n'+
+      'in/out: '+l.n_in+' -> '+l.n_out+
+      (l.activation ? '   activation: '+l.activation : '')+'\\n'+
+      'params: '+(l.n_params==null?'?':l.n_params)+
+      '   shapes: '+JSON.stringify(l.param_shapes||{})+'\\n'+
+      (l.preprocessor ? 'preprocessor: '+l.preprocessor+'\\n' : '')+
+      (l.updater ? 'updater: '+l.updater : '');
+  };
+  detail();  // keep a pinned/hovered panel alive across poll redraws
+  if (!cv._flowWired){
+    cv._flowWired = true;
+    const hit = ev => {
+      const r = cv.getBoundingClientRect();
+      const mx = ev.clientX - r.left, my = ev.clientY - r.top;
+      const bs = cv._flowBoxes || [];
+      for (let i = 0; i < bs.length; i++){
+        const b = bs[i];
+        if (mx>=b.x && mx<=b.x+b.w && my>=b.y && my<=b.y+b.h) return i;
+      }
+      return null;
+    };
+    const redraw = () => {
+      ctx.clearRect(0, 0, cv.width, cv.height);
+      flow(ctx, cv._flowLast, cv.width, cv.height, cv);
+    };
+    cv.addEventListener('mousemove', ev => {
+      const i = hit(ev);
+      if (i !== cv._flowHover){ cv._flowHover = i; redraw(); }
+    });
+    cv.addEventListener('click', ev => {
+      const i = hit(ev);
+      cv._flowPin = (cv._flowPin === i) ? null : i;
+      redraw();
+    });
+    cv.addEventListener('mouseleave', () => {
+      if (cv._flowHover != null){ cv._flowHover = null; redraw(); }
+    });
+  }
+}
+function wireScrub(el, cv, pts, draw){
+  // iteration scrubber for per-iteration payload drops (the reference
+  // t-SNE tab re-renders each drop; dragging replays the history,
+  // releasing at the right edge returns to live)
+  cv._scrubPts = pts;
+  let s = el.querySelector('input[type=range]');
+  if (!s){
+    s = document.createElement('input');
+    s.type = 'range'; s.min = 0; s.style.width = '620px';
+    el.appendChild(s);
+    const lab = document.createElement('span');
+    lab.style.cssText = 'font-size:10px;color:#555;margin-left:6px';
+    el.appendChild(lab);
+    cv._scrubLab = lab;
+    s.addEventListener('input', () => {
+      const P = cv._scrubPts;
+      const i = Number(s.value);
+      // Pin the ITERATION, not the index: the KEEP trim shifts indices
+      // as new points arrive, which would silently advance a "frozen"
+      // view at live rate.
+      cv._scrubIter = (i >= P.length - 1) ? null : P[i][0];
+      draw();
+    });
+  }
+  const atLive = cv._scrubIter == null;
+  s.max = Math.max(0, pts.length - 1);
+  let shown = pts.length - 1;
+  if (!atLive){
+    shown = 0;
+    for (let i = pts.length - 1; i >= 0; i--)
+      if (pts[i][0] <= cv._scrubIter){ shown = i; break; }
+  }
+  s.value = shown;
+  cv._scrubLab.textContent = 'iter '+pts[shown][0]+
+    (atLive ? ' (live)' : ' (scrubbed — drag right for live)');
+  return shown;
 }
 function render(key, pts){
   const el = card(key);
@@ -185,11 +279,25 @@ function render(key, pts){
     showChart(true); imageGrid(ctx, v, cv.width, cv.height); return;
   }
   if (v && v.type === 'scatter'){
-    showChart(true); scatter(ctx, v, cv.width, cv.height); return;
+    showChart(true);
+    const draw = () => {
+      const P = cv._scrubPts;
+      let i = P.length - 1;
+      if (cv._scrubIter != null){
+        i = 0;
+        for (let j = P.length - 1; j >= 0; j--)
+          if (P[j][0] <= cv._scrubIter){ i = j; break; }
+      }
+      ctx.clearRect(0, 0, cv.width, cv.height);
+      scatter(ctx, P[i][1], cv.width, cv.height);
+    };
+    wireScrub(el, cv, pts, draw);
+    draw();
+    return;
   }
   if (v && Array.isArray(v.layers)){
     setH(120); ctx.clearRect(0,0,cv.width,cv.height);
-    showChart(true); flow(ctx, v, cv.width, cv.height); return;
+    showChart(true); flow(ctx, v, cv.width, cv.height, cv); return;
   }
   let counts = null;
   if (v && Array.isArray(v.counts)) counts = v.counts;

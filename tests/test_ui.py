@@ -276,3 +276,42 @@ class TestRenderPayloads:
                        "function flow", "image_grid", "v.layers",
                        "putImageData"):
             assert needle in html, needle
+
+
+class TestDashboardInteractivity:
+    """The dashboard's interactive pieces (flow hover/click detail,
+    t-SNE iteration scrubber) — structural checks; no JS engine ships
+    in this image, so balance and presence are the testable surface."""
+
+    def test_dashboard_script_balanced_and_interactive(self):
+        from deeplearning4j_tpu.ui.server import _DASHBOARD
+
+        for piece in ("wireScrub", "_flowPin", "_flowHover",
+                      "addEventListener('mousemove'",
+                      "addEventListener('click'",
+                      "input[type=range]"):
+            assert piece in _DASHBOARD, piece
+        script = _DASHBOARD.split("<script>")[1].split("</script>")[0]
+        for op, cl in (("{", "}"), ("(", ")"), ("[", "]")):
+            assert script.count(op) == script.count(cl), (op, cl)
+
+    def test_flow_payload_carries_per_layer_detail(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.models.zoo import mlp
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.ui.listeners import FlowIterationListener
+
+        class Sink:
+            def put(self, key, it, payload):
+                self.payload = payload
+
+        sink = Sink()
+        net = MultiLayerNetwork(mlp((20, 16, 4))).init()
+        FlowIterationListener(sink).iteration_done(net, 0)
+        layers = sink.payload["layers"]
+        assert layers[0]["n_params"] == 20 * 16 + 16
+        assert layers[0]["param_shapes"]["W"] == [20, 16]
+        assert layers[0]["updater"]
+        total = sum(l["n_params"] for l in layers)
+        assert total == sink.payload["num_params"]
