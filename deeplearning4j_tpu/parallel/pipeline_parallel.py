@@ -295,8 +295,15 @@ class PipelineTrainer:
     statistics are pmean-averaged). State rows are stage-sharded like
     params.
 
+    Masked time-series batches are supported: each microbatch's
+    feature mask feeds its recurrent layers and its label mask the
+    output loss; per-microbatch masked means are re-weighted by their
+    unmasked counts so the step loss equals the GLOBAL masked mean —
+    exact single-device parity even when masks spread unevenly across
+    microbatches.
+
     Limitations (documented, enforced): plain-SGD-family training only
-    (no tBPTT, no second-order solvers), no feature/label masks.
+    (no tBPTT, no second-order solvers).
     """
 
     def __init__(
@@ -461,7 +468,7 @@ class PipelineTrainer:
 
     # -- stage math ----------------------------------------------------
     def _apply_stage(self, s: int, params, x, rngs, train=True,
-                     master_from=None, state=None):
+                     master_from=None, state=None, feature_mask=None):
         """Apply layers [start, end) of stage s (with preprocessors).
         Returns (activations, weighted aux-loss sum of the stage, new
         running state of the stage's stateful layers).
@@ -469,7 +476,10 @@ class PipelineTrainer:
         back to the master dtype (the f32 output-layer rule of
         MultiLayerNetwork._forward_fn under mixed precision).
         ``state``: {si: running-state} for this stage's stateful layers
-        (BatchNorm mean/var)."""
+        (BatchNorm mean/var).
+        ``feature_mask``: this microbatch's [mb, T] time mask — handed
+        to recurrent layers only (the _forward_fn rule)."""
+        from deeplearning4j_tpu.nn.conf import layers as _L
         from deeplearning4j_tpu.nn.multilayer import _cast_floating
 
         net = self.net
@@ -487,10 +497,11 @@ class PipelineTrainer:
                 # MultiLayerNetwork._forward_fn so mixed-precision
                 # trajectories agree with single-device fit.
                 x = _cast_floating(x, net._dtype)
+            is_rec = isinstance(c.layer, _L.RECURRENT_LAYER_TYPES)
             x, st = net._impls[i].apply(
                 c, params[si], x,
                 state=(state or {}).get(si), train=train, rng=rngs[i],
-                mask=None,
+                mask=feature_mask if is_rec else None,
             )
             w = getattr(c.layer, "aux_weight", None)
             if w and isinstance(st, dict) and "aux_loss" in st:
@@ -559,8 +570,8 @@ class PipelineTrainer:
         def branch(s):
             in_shape = shapes[s]
 
-            def run(theta_cd, theta_master, state_vec, x_feed, buf,
-                    y_mb, rngs):
+            def run(theta_cd, theta_master, state_vec, x_feed, fm_mb,
+                    buf, y_mb, lm_mb, rngs):
                 params = p_pack.unpack_row(s, theta_cd)
                 if out_f32 and s == S - 1:
                     # The output layer's params come from the f32 row
@@ -577,12 +588,13 @@ class PipelineTrainer:
                     s, params, xin, rngs,
                     master_from=(last_layer
                                  if out_f32 and s == S - 1 else None),
-                    state=s_pack.unpack_row(s, state_vec))
+                    state=s_pack.unpack_row(s, state_vec),
+                    feature_mask=fm_mb)
                 if s == S - 1:
                     yl = y
                     if cd is not None:
                         yl = yl.astype(net._dtype)
-                    loss = out_impl.loss(out_conf, yl, y_mb, None)
+                    loss = out_impl.loss(out_conf, yl, y_mb, lm_mb)
                 else:
                     loss = jnp.zeros((), net._dtype)
                 yf = y.reshape(mb, -1)
@@ -639,7 +651,7 @@ class PipelineTrainer:
         upd_branches = [upd_branch(s) for s in range(S)]
 
         def local_step(theta, ustate, sstate, iteration, rng, feats,
-                       labels):
+                       labels, fm, lm):
             # theta [1, Kp]: this device's stage row. feats/labels: this
             # replica's batch shard (full batch when no dp axis).
             idx = lax.axis_index(axis)
@@ -652,26 +664,44 @@ class PipelineTrainer:
                 f = feats.astype(cd) if cd is not None else feats
                 x_mbs = f.reshape((M, mb) + f.shape[1:])
                 y_mbs = labels.reshape((M, mb) + labels.shape[1:])
+                fm_mbs = (None if fm is None
+                          else fm.reshape((M, mb) + fm.shape[1:]))
+                lm_mbs = (None if lm is None
+                          else lm.reshape((M, mb) + lm.shape[1:]))
                 hop_dtype = cd if cd is not None else net._dtype
                 buf0 = jnp.zeros((mb, K), hop_dtype)
                 loss0 = jnp.zeros((), net._dtype)
 
                 def tick(t, carry):
-                    buf, loss_acc, aux_acc, st_vec = carry
+                    buf, loss_acc, w_acc, aux_acc, st_vec = carry
                     # Stage idx processes microbatch t - idx at tick t;
                     # fold the microbatch index into the rng so each
                     # microbatch draws distinct dropout masks.
                     mb_idx = jnp.clip(t - idx, 0, M - 1)
                     rngs = list(jax.random.split(
                         jax.random.fold_in(rng, mb_idx), net.n_layers))
-                    feed = x_mbs[jnp.minimum(t, M - 1)]
+                    feed_t = jnp.minimum(t, M - 1)
+                    feed = x_mbs[feed_t]
+                    fm_mb = None if fm_mbs is None else fm_mbs[mb_idx]
                     out_t = jnp.maximum(t - (S - 1), 0)
                     y_mb = y_mbs[out_t]
+                    lm_mb = None if lm_mbs is None else lm_mbs[out_t]
                     yf, loss, aux, st_new = lax.switch(
-                        idx, branches, tv, theta_row, st_vec, feed, buf,
-                        y_mb, rngs)
+                        idx, branches, tv, theta_row, st_vec, feed,
+                        fm_mb, buf, y_mb, lm_mb, rngs)
                     write = (idx == S - 1) & (t - (S - 1) >= 0)
-                    loss_acc = loss_acc + jnp.where(write, loss, 0.0)
+                    # Masked losses are per-microbatch masked MEANS
+                    # (ops/losses._reduce: sum(l*m)/max(sum(m),1));
+                    # multiplying by max(w,1) inverts that clamped
+                    # denominator EXACTLY (incl. fractional masks with
+                    # w<1), so loss_acc accumulates raw masked SUMS and
+                    # the final quotient by the raw weight total is the
+                    # global masked mean (unmasked: weight 1 -> /M).
+                    w_mb = (jnp.asarray(1.0, net._dtype) if lm_mbs is None
+                            else jnp.sum(lm_mb).astype(net._dtype))
+                    loss_acc = loss_acc + jnp.where(
+                        write, loss * jnp.maximum(w_mb, 1.0), 0.0)
+                    w_acc = w_acc + jnp.where(write, w_mb, 0.0)
                     # Stage idx holds a REAL microbatch only for ticks
                     # in [idx, idx + M); warmup/drain garbage must not
                     # leak into the aux loss or the running statistics
@@ -681,10 +711,11 @@ class PipelineTrainer:
                     st_vec = jnp.where(valid, st_new, st_vec)
                     perm = [(i, (i + 1) % S) for i in range(S)]
                     buf = lax.ppermute(yf, axis, perm)
-                    return buf, loss_acc, aux_acc, st_vec
+                    return buf, loss_acc, w_acc, aux_acc, st_vec
 
-                _, loss_sum, aux_sum, st_final = lax.fori_loop(
-                    0, M + S - 1, tick, (buf0, loss0, loss0, sstate[0]))
+                _, loss_sum, w_sum, aux_sum, st_final = lax.fori_loop(
+                    0, M + S - 1, tick,
+                    (buf0, loss0, loss0, loss0, sstate[0]))
                 # LOCAL (unreduced) stage contribution: data loss lives
                 # on the last stage, aux/reg on each stage. The global
                 # score = psum of these, but the psum must happen OUTSIDE
@@ -700,19 +731,31 @@ class PipelineTrainer:
                 # statistic, so trajectories with MoE layers match in
                 # expectation, not bit-for-bit.
                 reg = lax.switch(idx, reg_branches, theta_row)
-                return (loss_sum + aux_sum) / M + reg, st_final
+                # GLOBAL weight total across data replicas: without it,
+                # dp x pp would average per-replica masked MEANS, which
+                # differs from the global masked mean when masks spread
+                # unevenly across shards. w is theta-independent (mask
+                # sums only), so this psum has no gradient path and the
+                # psum-transpose subtlety above does not apply; each
+                # replica's term then composes by SUM over dp (psum'd
+                # outside), with aux/reg divided by R to keep their
+                # replica-mean/once-only semantics.
+                w_g = lax.psum(w_sum, dp) if dp is not None else w_sum
+                data = loss_sum / jnp.maximum(w_g, 1.0)
+                return (data + aux_sum / (M * R) + reg / R,
+                        st_final)
 
             (score_local, st_final), grad = jax.value_and_grad(
                 loss_fn, has_aux=True)(theta[0])
             # Reported score: sum of stage contributions over the ring.
             score = lax.psum(score_local, axis)
             if dp is not None:
-                # Average per-stage gradients across data replicas: the
-                # mean over the global batch (equal shard sizes); ghost-
-                # BN running statistics average across replicas too (the
+                # SUM the per-replica terms (the global quotient already
+                # carries the cross-replica weight total); ghost-BN
+                # running statistics average across replicas (the
                 # per-replica microbatch stats are equal-sized samples).
-                grad = lax.pmean(grad, dp)
-                score = lax.pmean(score, dp)
+                grad = lax.psum(grad, dp)
+                score = lax.psum(score, dp)
                 st_final = lax.pmean(st_final, dp)
             new_t, new_u = lax.switch(
                 idx, upd_branches, theta[0], grad, ustate[0], iteration)
@@ -723,7 +766,8 @@ class PipelineTrainer:
             local_step,
             mesh=self.mesh,
             in_specs=(P(self.pp_axis), P(self.pp_axis), P(self.pp_axis),
-                      P(), P(), batch_spec, batch_spec),
+                      P(), P(), batch_spec, batch_spec, batch_spec,
+                      batch_spec),
             out_specs=(P(self.pp_axis), P(self.pp_axis), P(self.pp_axis),
                        P()),
             check_vma=False,
@@ -744,14 +788,17 @@ class PipelineTrainer:
                  if self.dp_axis is not None
                  else NamedSharding(self.mesh, P()))
         for ds in batches:
-            if ds.features_mask is not None or ds.labels_mask is not None:
-                raise ValueError(
-                    "PipelineTrainer does not support masked datasets")
             feats = jax.device_put(
                 jnp.asarray(ds.features, net._dtype), bspec)
             labs = jax.device_put(
                 jnp.asarray(ds.labels, net._dtype), bspec)
-            key = (feats.shape, labs.shape)
+            fm = (None if ds.features_mask is None else jax.device_put(
+                jnp.asarray(ds.features_mask, net._dtype), bspec))
+            lm = (None if ds.labels_mask is None else jax.device_put(
+                jnp.asarray(ds.labels_mask, net._dtype), bspec))
+            key = (feats.shape, labs.shape,
+                   None if fm is None else fm.shape,
+                   None if lm is None else lm.shape)
             if key not in self._step_cache:
                 self._step_cache[key] = self._build_step(
                     feats.shape, labs.shape)
@@ -759,7 +806,7 @@ class PipelineTrainer:
             self._theta, self._ustate, self._sstate, s = \
                 self._step_cache[key](
                     self._theta, self._ustate, self._sstate,
-                    net.iteration, sub, feats, labs,
+                    net.iteration, sub, feats, labs, fm, lm,
                 )
             net.score_value = s
             net.iteration += 1

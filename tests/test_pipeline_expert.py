@@ -905,3 +905,93 @@ class TestGraphLocalSteps:
                     np.asarray(net.params[mk][name]),
                     rtol=1e-5, atol=1e-6,
                 )
+
+    def test_masked_sequences_match_single_device(self):
+        """Masked time-series under PP (the last broad exclusion):
+        per-microbatch masked means re-weighted by unmasked counts ==
+        the global masked mean, so the trajectory matches single-device
+        masked fit exactly even with uneven masks per microbatch."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import lstm_classifier
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        def build():
+            return MultiLayerNetwork(
+                lstm_classifier(n_in=6, n_hidden=8, n_classes=3,
+                                lr=0.05)).init()
+
+        net_pp, net_sd = build(), build()
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        trainer = PipelineTrainer(net_pp, mesh, n_microbatches=2,
+                                  stage_ranges=[(0, 1), (1, 2)])
+        rng = np.random.default_rng(1)
+        b, t = 8, 5
+        x = rng.normal(size=(b, 6, t)).astype(np.float32)
+        y = np.zeros((b, 3, t), np.float32)
+        idx = rng.integers(0, 3, (b, t))
+        for i in range(b):
+            y[i, idx[i], np.arange(t)] = 1.0
+        # Uneven masks: first half long sequences, second half short —
+        # the microbatch split sees different unmasked counts.
+        fm = np.ones((b, t), np.float32)
+        fm[b // 2:, 3:] = 0.0
+        ds = DataSet(x, y, features_mask=fm, labels_mask=fm.copy())
+        for step in range(4):
+            s_pp = trainer.fit(ds)
+            net_sd.fit(ds)
+            assert abs(s_pp - float(net_sd.score_value)) < 1e-4, step
+        for k in net_sd.params:
+            for name in net_sd.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(net_pp.params[k][name]),
+                    np.asarray(net_sd.params[k][name]),
+                    rtol=1e-4, atol=1e-5,
+                )
+
+    def test_masked_sequences_dp_pp_global_masked_mean(self):
+        """dp x pp with masks spread UNEVENLY across the dp shards: the
+        weight total is psum'd across replicas, so the step still
+        computes the GLOBAL masked mean (a per-replica-mean average
+        would diverge here)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import lstm_classifier
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        def build():
+            return MultiLayerNetwork(
+                lstm_classifier(n_in=6, n_hidden=8, n_classes=3,
+                                lr=0.05)).init()
+
+        net_pp, net_sd = build(), build()
+        mesh = make_mesh(MeshSpec({"dp": 2, "pp": 2}))
+        trainer = PipelineTrainer(net_pp, mesh, n_microbatches=2,
+                                  stage_ranges=[(0, 1), (1, 2)])
+        rng = np.random.default_rng(2)
+        b, t = 8, 6
+        x = rng.normal(size=(b, 6, t)).astype(np.float32)
+        y = np.zeros((b, 3, t), np.float32)
+        idx = rng.integers(0, 3, (b, t))
+        for i in range(b):
+            y[i, idx[i], np.arange(t)] = 1.0
+        # Replica 0's shard (rows 0..3) nearly unmasked, replica 1's
+        # (rows 4..7) mostly masked — the distinguishing case.
+        fm = np.ones((b, t), np.float32)
+        fm[b // 2:, 1:] = 0.0
+        ds = DataSet(x, y, features_mask=fm, labels_mask=fm.copy())
+        for step in range(4):
+            s_pp = trainer.fit(ds)
+            net_sd.fit(ds)
+            assert abs(s_pp - float(net_sd.score_value)) < 1e-4, step
+        for k in net_sd.params:
+            for name in net_sd.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(net_pp.params[k][name]),
+                    np.asarray(net_sd.params[k][name]),
+                    rtol=1e-4, atol=1e-5,
+                )
