@@ -77,6 +77,51 @@ def tp_param_specs(net, mesh_axis: str = "tp"):
     return specs
 
 
+def fsdp_param_specs(net, mesh, mesh_axis: str = "fsdp",
+                     base: Optional[dict] = None):
+    """Overlay ZeRO-3/FSDP sharding onto a param-spec pytree: every
+    parameter leaf's LARGEST divisible dimension is sharded over
+    ``mesh_axis``, so per-device persistent parameter + updater-state
+    memory drops to ~1/F of the model. Under jit, XLA all-gathers each
+    tensor at its use site and reduce-scatters its gradient — the
+    ZeRO-3 schedule derived by GSPMD instead of hand-written bucketing
+    (the TPU-native analogue of torch FSDP / DeepSpeed ZeRO stage 3).
+    Leaves already carrying a spec in ``base`` (tp/ep shardings) are
+    left alone; leaves with no dimension divisible by F stay
+    replicated. Works for MultiLayerNetwork and ComputationGraph."""
+    F = int(mesh.shape[mesh_axis])
+    specs = dict(base) if base else {}
+    for key, _ in _layer_items(net):
+        layer_specs = dict(specs.get(key, {}))
+        for name, p in net.params[key].items():
+            existing = layer_specs.get(name)
+            if existing is not None and any(existing):
+                continue  # tp/ep laid this tensor out already
+            shape = np.shape(p)
+            best = None
+            for d in sorted(range(len(shape)), key=lambda d: -shape[d]):
+                if shape[d] % F == 0 and shape[d] >= F:
+                    best = d
+                    break
+            if best is None:
+                layer_specs[name] = P()
+            else:
+                spec = [None] * len(shape)
+                spec[best] = mesh_axis
+                layer_specs[name] = P(*spec)
+        specs[key] = layer_specs
+    if not any(
+        mesh_axis in tuple(sp)
+        for layer in specs.values() for sp in layer.values()
+    ):
+        raise ValueError(
+            f"fsdp_axis={mesh_axis!r} (size {F}) shards NOTHING: no "
+            "parameter dimension is divisible by it — training would "
+            "run fully replicated while promising 1/F memory. Pick a "
+            "divisor of the layer widths or drop the axis.")
+    return specs
+
+
 def ep_param_specs(net, mesh_axis: str = "ep",
                    base: Optional[dict] = None):
     """Overlay expert sharding onto a param-spec pytree: MoeDense
@@ -121,6 +166,7 @@ class ParallelTrainer:
         dp_axis: str = "dp",
         tp_axis: Optional[str] = None,
         ep_axis: Optional[str] = None,
+        fsdp_axis: Optional[str] = None,
         average_each_iteration: bool = True,
         local_steps: int = 1,
         accumulate_gradients: bool = False,
@@ -134,6 +180,16 @@ class ParallelTrainer:
         self.is_graph = hasattr(net, "_coerce_multi")
         self.tp_axis = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
         self.ep_axis = ep_axis if (ep_axis and ep_axis in mesh.axis_names) else None
+        self.fsdp_axis = (fsdp_axis
+                          if (fsdp_axis and fsdp_axis in mesh.axis_names)
+                          else None)
+        # The fsdp axis IS a data axis (as in torch FSDP / ZeRO-3): the
+        # batch shards over dp x fsdp jointly, so all D*F devices do
+        # data-parallel work while parameters live sharded over fsdp.
+        self._batch_axes = (
+            (dp_axis, self.fsdp_axis)
+            if self.fsdp_axis and self.fsdp_axis != dp_axis
+            else (dp_axis,))
         if self.is_graph and self.tp_axis:
             raise ValueError(
                 "tensor parallelism (tp_axis) supports MultiLayerNetwork "
@@ -167,10 +223,11 @@ class ParallelTrainer:
             raise ValueError(
                 "accumulate_gradients applies to the per-step synchronous "
                 "mode; K-local-steps mode averages parameters instead")
-        if self.ep_axis and not average_each_iteration:
+        if (self.ep_axis or self.fsdp_axis) and not average_each_iteration:
             raise ValueError(
-                "expert-sharded params require the per-step synchronous "
-                "mode (K-local-steps shard_maps with replicated params)")
+                "expert-/fsdp-sharded params require the per-step "
+                "synchronous mode (K-local-steps shard_maps with "
+                "replicated params)")
         if not average_each_iteration and net.state:
             raise ValueError(
                 "K-local-steps-then-average mode does not support layers "
@@ -190,6 +247,9 @@ class ParallelTrainer:
             )
         if self.ep_axis:
             specs = ep_param_specs(self.net, self.ep_axis, base=specs)
+        if self.fsdp_axis:
+            specs = fsdp_param_specs(self.net, self.mesh, self.fsdp_axis,
+                                     base=specs)
         return jax.tree.map(
             lambda s: NamedSharding(self.mesh, s),
             specs,
@@ -236,16 +296,20 @@ class ParallelTrainer:
 
             return host_local_to_global(
                 np.asarray(arr, self.net._dtype), self.mesh,
-                P(self.dp_axis))
+                P(self._batch_axes))
         return jax.device_put(
             jnp.asarray(arr, self.net._dtype),
-            NamedSharding(self.mesh, P(self.dp_axis)),
+            NamedSharding(self.mesh, P(self._batch_axes)),
         )
 
     def _grad_scale(self) -> float:
-        """dp-size under ACCUM_GRADIENT-without-divide, else 1."""
+        """data-worker count under ACCUM_GRADIENT-without-divide (the
+        fsdp axis counts: it carries batch shards too), else 1."""
         if self.accumulate_gradients and not self.divide_gradient:
-            return float(self.mesh.shape[self.dp_axis])
+            n = 1.0
+            for ax in self._batch_axes:
+                n *= float(self.mesh.shape[ax])
+            return n
         return 1.0
 
     def _shard_stacked(self, arr):
@@ -253,7 +317,7 @@ class ParallelTrainer:
         every device (it is the scan axis)."""
         return jax.device_put(
             jnp.asarray(arr, self.net._dtype),
-            NamedSharding(self.mesh, P(None, self.dp_axis)),
+            NamedSharding(self.mesh, P(None, self._batch_axes)),
         )
 
     def fit_scan(self, features_stacked, labels_stacked,
