@@ -90,6 +90,12 @@ class SequenceVectors:
     def _reset_weights(self) -> None:
         v = self.vocab.num_words()
         d = self.layer_size
+        # Drop compiled-step caches: their closures captured the OLD
+        # vocab's Huffman tables / unigram logits, and a re-built vocab
+        # would otherwise train against stale (wrong-vocab) indices.
+        self.__dict__.pop("_hs_step_cache", None)
+        self.__dict__.pop("_ns_step", None)
+        self.__dict__.pop("_ns_inner", None)
         key = jax.random.key(self.seed)
         # syn0 ~ U(-0.5, 0.5)/D (reference InMemoryLookupTable.resetWeights)
         self.syn0 = (
@@ -312,7 +318,9 @@ class SequenceVectors:
         if l_max not in cache:
             inner = self._hs_inner(l_max)
 
-            @jax.jit
+            # donate: the embedding tables are dead after each dispatch;
+            # without donation every chunk copies [V, D] x2 out.
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
             def steps(syn0, syn1, centers, contexts, lrs):
                 def body(carry, inp):
                     s0, s1 = carry
@@ -367,7 +375,7 @@ class SequenceVectors:
         """Scanned multi-batch negative-sampling update (see _hs_step)."""
         inner = self._ns_inner
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def steps(syn0, syn1neg, centers, contexts, lrs, rng):
             def body(carry, inp):
                 s0, s1, key = carry
@@ -512,6 +520,26 @@ class SequenceVectors:
         # every device input — indices AND learning rates — uploads in
         # the idle window and the compute phase dispatches back-to-back
         # with no host->device copy in between to drain the pipeline.
+        pass_base = pairs_done
+        # The scan dispatches DONATE the embedding tables; an exception
+        # mid-dispatch (tunnel error, Ctrl-C) would otherwise leave
+        # self.syn0/... bound to deleted buffers. Snapshot to host once
+        # per pass (~15 MB, device idle here) and restore on failure so
+        # the model stays readable at its pass-entry state.
+        backup = (np.asarray(self.syn0), np.asarray(self.syn1),
+                  np.asarray(self.syn1neg))
+        try:
+            return self._dispatch_chunks_inner(
+                batches, lr_fn, key_box, pairs_done)
+        except BaseException:
+            self.syn0 = jnp.asarray(backup[0])
+            self.syn1 = jnp.asarray(backup[1])
+            self.syn1neg = jnp.asarray(backup[2])
+            raise
+
+    def _dispatch_chunks_inner(self, batches, lr_fn, key_box,
+                               pairs_done=0) -> int:
+        CHUNK = self._DISPATCH_CHUNK
         pass_base = pairs_done
 
         def stage(group, lmax):

@@ -247,3 +247,45 @@ class TestNativeTokenizer:
         flat, sid = w._tokenize_corpus(
             s for s in [["alpha", "beta"], ["gamma"]])
         assert len(flat) == 3
+
+
+class TestTrainingStateLifecycle:
+    """Donated-dispatch and cache-lifetime guarantees."""
+
+    def test_vocab_rebuild_resets_compiled_step_caches(self):
+        """A second build_vocab_from must not train against the old
+        vocab's Huffman tables captured in compiled-step closures."""
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        corp_a = [["a", "b", "c", "d"]] * 50
+        corp_b = [["x", "y", "z", "w", "v", "u"]] * 50
+        w = Word2Vec(layer_size=8, window=2, min_word_frequency=1,
+                     seed=1)
+        w.fit(corp_a)
+        w.build_vocab_from(corp_b)
+        assert "_hs_step_cache" not in w.__dict__
+        w.fit(corp_b)
+        assert w.get_word_vector("x") is not None
+
+    def test_model_readable_after_mid_pass_failure(self):
+        """The scan dispatches donate the embedding tables; a failure
+        mid-pass must restore the pass-entry state instead of leaving
+        deleted buffers bound."""
+        import jax
+
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        corp = [["a", "b", "c", "d"]] * 50
+        w = Word2Vec(layer_size=8, window=2, min_word_frequency=1,
+                     seed=1)
+        w.build_vocab_from(corp)
+        before = np.asarray(w.syn0).copy()
+
+        def bad_lr(offsets):
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            w._dispatch_chunks(
+                w._mine_pairs(corp, np.random.default_rng(0)),
+                bad_lr, [jax.random.key(0)])
+        np.testing.assert_allclose(np.asarray(w.syn0), before)
