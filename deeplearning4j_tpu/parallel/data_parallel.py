@@ -19,6 +19,15 @@ Also provides K-local-steps-then-average (the reference's
 :275-295) via ``shard_map``: each dp group runs K independent steps on its
 local shard, then params and updater state are ``pmean``-ed — byte-for-byte
 the Spark semantics, compiled.
+
+Sequence parallelism (``sp_axis``; SURVEY.md §5.7 mandate) shards the TIME
+axis of [N, C, T] batches: the whole train step runs inside ``shard_map``
+with replicated params, attention layers (ring_axis=sp_axis) execute the
+ring-attention schedule over ICI, and the loss/gradient are reconstructed
+as exact global (masked) means via count-weighted psums — so a conf-built
+transformer trains on sequences P× longer than one device's activation
+memory allows, with single-device trajectory parity. Composes with dp
+(batch axis shards over dp, time over sp, gradients psum over both).
 """
 
 from __future__ import annotations
@@ -29,8 +38,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from deeplearning4j_tpu.nn.conf import layers as L
 
@@ -167,6 +176,7 @@ class ParallelTrainer:
         tp_axis: Optional[str] = None,
         ep_axis: Optional[str] = None,
         fsdp_axis: Optional[str] = None,
+        sp_axis: Optional[str] = None,
         average_each_iteration: bool = True,
         local_steps: int = 1,
         accumulate_gradients: bool = False,
@@ -183,6 +193,16 @@ class ParallelTrainer:
         self.fsdp_axis = (fsdp_axis
                           if (fsdp_axis and fsdp_axis in mesh.axis_names)
                           else None)
+        self.sp_axis = (sp_axis
+                        if (sp_axis and sp_axis in mesh.axis_names)
+                        else None)
+        if self.sp_axis:
+            self._validate_sp(net)
+            self._sp_axes = tuple(
+                a for a in
+                ((dp_axis if dp_axis in mesh.axis_names else None),
+                 self.sp_axis)
+                if a)
         # The fsdp axis IS a data axis (as in torch FSDP / ZeRO-3): the
         # batch shards over dp x fsdp jointly, so all D*F devices do
         # data-parallel work while parameters live sharded over fsdp.
@@ -228,6 +248,17 @@ class ParallelTrainer:
                 "expert-/fsdp-sharded params require the per-step "
                 "synchronous mode (K-local-steps shard_maps with "
                 "replicated params)")
+        if self.sp_axis and not average_each_iteration:
+            raise ValueError(
+                "sequence parallelism (sp_axis) is a per-step "
+                "synchronous mode: the ring exchanges K/V blocks inside "
+                "every step, so K-independent-local-steps semantics do "
+                "not apply")
+        if self.sp_axis and accumulate_gradients:
+            raise ValueError(
+                "accumulate_gradients (per-worker gradient SUM) is a dp "
+                "engine flag; the sp step applies the exact global mean "
+                "gradient")
         if not average_each_iteration and net.state:
             raise ValueError(
                 "K-local-steps-then-average mode does not support layers "
@@ -284,23 +315,7 @@ class ParallelTrainer:
             )
 
     def _shard_batch(self, arr):
-        if arr is None:
-            return None
-        if jax.process_count() > 1:
-            # Multi-host: the caller passes its HOST-LOCAL slice of the
-            # global batch (each host loads only its shard); assemble
-            # the global array from the per-host pieces.
-            from deeplearning4j_tpu.parallel.multihost import (
-                host_local_to_global,
-            )
-
-            return host_local_to_global(
-                np.asarray(arr, self.net._dtype), self.mesh,
-                P(self._batch_axes))
-        return jax.device_put(
-            jnp.asarray(arr, self.net._dtype),
-            NamedSharding(self.mesh, P(self._batch_axes)),
-        )
+        return self._put_spec(arr, P(self._batch_axes))
 
     def _grad_scale(self) -> float:
         """data-worker count under ACCUM_GRADIENT-without-divide (the
@@ -335,6 +350,10 @@ class ParallelTrainer:
             raise ValueError(
                 "fit_scan is the per-step-synchronous path; "
                 "K-local-steps mode already fuses via local_steps")
+        if self.sp_axis:
+            return self._fit_scan_sp(
+                features_stacked, labels_stacked,
+                features_mask_stacked, labels_mask_stacked)
         # Shard then delegate: jnp.asarray inside net.fit_scan preserves
         # the placement, and the net-level guards (tBPTT, non-SGD) and
         # listener cadence apply identically here.
@@ -387,6 +406,8 @@ class ParallelTrainer:
 
     def _fit_sync(self, ds) -> float:
         net = self.net
+        if self.sp_axis:
+            return self._fit_sp(ds)
         if self.is_graph:
             # Multi-input/multi-output batch: shard every feature/label/
             # mask leaf over dp (graph _train_step has the same arity as
@@ -508,3 +529,264 @@ class ParallelTrainer:
             check_vma=False,
         )
         return jax.jit(fn)
+
+    # ------------------------------------------------------------------
+    # Sequence parallelism (sp_axis): conf-level ring attention
+    # ------------------------------------------------------------------
+    def _validate_sp(self, net) -> None:
+        """sp_axis shards the TIME axis of [N, C, T] batches over the
+        mesh, so every layer must be time-shardable: attention cores run
+        the ring-attention schedule (parallel/sequence_parallel.py —
+        K/V blocks rotate over ICI via ppermute), per-timestep layers
+        (RnnOutputLayer) run on their local shard unchanged. Sequential
+        recurrences (LSTM/GRU) and cross-time preprocessors cannot."""
+        from deeplearning4j_tpu.nn.conf.enums import BackpropType
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadSelfAttention,
+        )
+        from deeplearning4j_tpu.nn.layers.moe import MoeDense
+
+        from deeplearning4j_tpu.nn.conf.enums import (
+            OptimizationAlgorithm,
+        )
+
+        if self.is_graph:
+            raise ValueError(
+                "sp_axis supports MultiLayerNetwork only (the time-axis "
+                "shard contract is defined on the sequential layer "
+                "chain)")
+        if self.tp_axis or self.ep_axis or self.fsdp_axis:
+            raise ValueError(
+                "sp_axis runs the step inside shard_map with replicated "
+                "params; it composes with dp but not with tp/ep/fsdp "
+                "param sharding")
+        algo = net.conf.confs[0].optimization_algo
+        if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            raise ValueError(
+                f"sp_axis is a plain-SGD-family path (got {algo}); "
+                "second-order solvers need unsharded line searches")
+        if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            raise ValueError(
+                "sp_axis replaces tBPTT as the long-sequence device "
+                "(SURVEY.md §5.7): full-BPTT with the time axis sharded")
+        for i, c in enumerate(net.conf.confs):
+            lc = c.layer
+            if net.conf.preprocessor_for(i) is not None:
+                raise ValueError(
+                    f"layer {i}: input preprocessors reshape across the "
+                    "sharded time axis and are not supported under "
+                    "sp_axis")
+            if isinstance(lc, MultiHeadSelfAttention):
+                if lc.ring_axis != self.sp_axis:
+                    raise ValueError(
+                        f"layer {i}: MultiHeadSelfAttention.ring_axis="
+                        f"{lc.ring_axis!r} must equal sp_axis="
+                        f"{self.sp_axis!r} so the attention core runs "
+                        "the ring schedule over the mesh's sp devices")
+            elif isinstance(lc, (L.RnnOutputLayer, MoeDense)):
+                # Per-timestep/per-token layers shard trivially. NOTE:
+                # MoeDense capacity routing becomes per-time-shard
+                # (each device routes its local tokens against its own
+                # capacity) — ghost-routing semantics, the documented
+                # deviation, analogous to ghost batch norm under pp.
+                pass
+            else:
+                raise ValueError(
+                    f"layer {i} ({type(lc).__name__}) is not "
+                    "time-shardable: sp_axis supports "
+                    "MultiHeadSelfAttention (ring_axis=sp_axis), "
+                    "MoeDense, and RnnOutputLayer")
+        stateful = [
+            si for si, st in (net.state or {}).items()
+            if not (isinstance(st, dict) and set(st) <= {"aux_loss"})
+        ]
+        if stateful:
+            raise ValueError(
+                f"layers {stateful} carry running state; sp_axis "
+                "supports stateless / aux-only-state layers")
+        if not hasattr(net._impls[-1], "loss"):
+            raise ValueError(
+                "last layer must be an output layer to compute a score "
+                f"(got {type(net.conf.confs[-1].layer).__name__})")
+
+    def _sp_body_core(self, params, state, upd_state, iteration, rng,
+                      f, y, fm, lm):
+        """One synchronous global step on local [N?, C, T_local] shards,
+        inside shard_map over (dp?, sp). Exact single-device semantics:
+        the data term is the GLOBAL (masked) mean — local masked sums
+        and mask counts are psum'd so the step loss and gradient match
+        an unsharded step even when masks spread unevenly across time
+        shards (the pipeline trainer's masked-mean contract)."""
+        from deeplearning4j_tpu.nn.multilayer import _cast_floating
+
+        net = self.net
+        axes = self._sp_axes
+        ndev = 1
+        for a in axes:
+            ndev *= int(self.mesh.shape[a])
+        # Decorrelate per-device dropout draws; parity with the
+        # unsharded net holds for dropout-free confs (tests'
+        # configuration) — a sharded dropout mask cannot reproduce the
+        # single-device draw pattern under any keying.
+        didx = lax.axis_index(self.sp_axis)
+        if len(axes) == 2:
+            didx = (lax.axis_index(axes[0]) * lax.axis_size(axes[1])
+                    + didx)
+        rng = jax.random.fold_in(rng, didx)
+
+        def loss_fn(p):
+            out, new_state, _ = net._forward_fn(
+                p, state, f, rng, True, fm)
+            if net._compute_dtype is not None:
+                out = _cast_floating(out, net._dtype)
+            data = net._impls[-1].loss(net.conf.confs[-1], out, y, lm)
+            rows = out.shape[0] * (out.shape[2] if out.ndim == 3 else 1)
+            if lm is None:
+                count = jnp.asarray(float(rows), data.dtype)
+            else:
+                count = jnp.sum(lm.astype(data.dtype))
+            # data is the LOCAL masked mean = local_sum / max(count, 1);
+            # recover the sum exactly (count 0 => data 0) and re-weight
+            # by the global count.
+            local_sum = data * jnp.maximum(count, 1.0)
+            total = jnp.maximum(lax.psum(count, axes), 1.0)
+            local = local_sum / total
+            # reg is computed identically on every device and aux is a
+            # per-shard estimate: divide by the device count so the
+            # psum of per-device losses (and of their gradients) yields
+            # reg once and the device-mean aux.
+            local = local + (net._reg_score(p)
+                             + net._aux_score(new_state)) / ndev
+            return local, new_state
+
+        (loss_local, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = jax.tree.map(lambda g: lax.psum(g, axes), grads)
+        score = lax.psum(loss_local, axes)
+        new_params, new_upd = net._apply_updates(
+            params, upd_state, grads, iteration)
+        new_state = jax.tree.map(
+            lambda s: lax.pmean(s, axes), new_state)
+        return new_params, new_state, new_upd, score
+
+    def _sp_specs(self):
+        dp = self._sp_axes[0] if len(self._sp_axes) == 2 else None
+        sp = self.sp_axis
+        net = self.net
+        is_arr = lambda x: isinstance(x, jax.Array)  # noqa: E731
+        pspec = jax.tree.map(lambda _: P(), net.params, is_leaf=is_arr)
+        sspec = jax.tree.map(lambda _: P(), net.state, is_leaf=is_arr)
+        uspec = jax.tree.map(
+            lambda _: P(), net.updater_state, is_leaf=is_arr)
+        return pspec, sspec, uspec, P(dp, None, sp), P(dp, sp)
+
+    @functools.cached_property
+    def _sp_step_fn(self):
+        pspec, sspec, uspec, xspec, mspec = self._sp_specs()
+        fn = shard_map(
+            self._sp_body_core,
+            mesh=self.mesh,
+            in_specs=(pspec, sspec, uspec, P(), P(),
+                      xspec, xspec, mspec, mspec),
+            out_specs=(pspec, sspec, uspec, P()),
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def _sp_scan_fn(self):
+        """K fused sp steps: lax.scan over [K, ...] stacked batches
+        INSIDE the shard_map, so the per-step psums and ring ppermutes
+        pipeline across all K steps in one dispatch."""
+        pspec, sspec, uspec, xspec, mspec = self._sp_specs()
+        kx = P(*((None,) + tuple(xspec)))
+        km = P(*((None,) + tuple(mspec)))
+
+        def steps(params, state, upd_state, iteration, rng,
+                  fs, ys, fms, lms):
+            def body(carry, inp):
+                p, s, u, it = carry
+                f, y, fm, lm, k = (
+                    inp.get("f"), inp.get("y"), inp.get("fm"),
+                    inp.get("lm"), inp["k"])
+                p, s, u, score = self._sp_body_core(
+                    p, s, u, it, jax.random.fold_in(rng, k), f, y, fm, lm)
+                return (p, s, u, it + 1), score
+
+            xs = {"f": fs, "y": ys, "k": jnp.arange(fs.shape[0])}
+            if fms is not None:
+                xs["fm"] = fms
+            if lms is not None:
+                xs["lm"] = lms
+            (params, state, upd_state, _), scores = jax.lax.scan(
+                body, (params, state, upd_state, iteration), xs)
+            return params, state, upd_state, scores
+
+        fn = shard_map(
+            steps,
+            mesh=self.mesh,
+            in_specs=(pspec, sspec, uspec, P(), P(), kx, kx, km, km),
+            out_specs=(pspec, sspec, uspec, P()),
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=(0, 1, 2))
+
+    def _put_spec(self, arr, spec):
+        """Place a host batch on the mesh under ``spec``. Multi-host:
+        the caller passes its HOST-LOCAL slice of the global batch (each
+        host loads only its shard); assemble the global array from the
+        per-host pieces."""
+        if arr is None:
+            return None
+        if jax.process_count() > 1:
+            from deeplearning4j_tpu.parallel.multihost import (
+                host_local_to_global,
+            )
+
+            return host_local_to_global(
+                np.asarray(arr, self.net._dtype), self.mesh, spec)
+        return jax.device_put(
+            jnp.asarray(arr, self.net._dtype),
+            NamedSharding(self.mesh, spec))
+
+    def _fit_sp(self, ds) -> float:
+        net = self.net
+        _, _, _, xspec, mspec = self._sp_specs()
+        feats = self._put_spec(ds.features, xspec)
+        labels = self._put_spec(ds.labels, xspec)
+        fm = self._put_spec(ds.features_mask, mspec)
+        lm = self._put_spec(ds.labels_mask, mspec)
+        net._key, sub = jax.random.split(net._key)
+        net.params, net.state, net.updater_state, score = self._sp_step_fn(
+            net.params, net.state, net.updater_state,
+            jnp.asarray(net.iteration), sub, feats, labels, fm, lm)
+        net.score_value = score
+        net.iteration += 1
+        for listener in net.listeners:
+            listener.iteration_done(net, net.iteration)
+        return float(score)
+
+    def _fit_scan_sp(self, fs, ys, fms=None, lms=None):
+        net = self.net
+        _, _, _, xspec, mspec = self._sp_specs()
+        kx = P(*((None,) + tuple(xspec)))
+        km = P(*((None,) + tuple(mspec)))
+        fs = self._put_spec(fs, kx)
+        ys = self._put_spec(ys, kx)
+        fms = self._put_spec(fms, km)
+        lms = self._put_spec(lms, km)
+        net._key, sub = jax.random.split(net._key)
+        start = net.iteration
+        net.params, net.state, net.updater_state, scores = (
+            self._sp_scan_fn(
+                net.params, net.state, net.updater_state,
+                jnp.asarray(net.iteration), sub, fs, ys, fms, lms))
+        net.iteration += int(fs.shape[0])
+        net.score_value = scores[-1]
+        for listener in net.listeners:
+            # same crossing cadence as net.fit_scan: fire once per call
+            # iff the K-step window crossed a multiple of invoked_every
+            n = max(1, listener.invoked_every)
+            if net.iteration // n > start // n:
+                listener.iteration_done(net, net.iteration)
+        return scores
