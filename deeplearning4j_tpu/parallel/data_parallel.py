@@ -58,9 +58,17 @@ def _layer_items(net):
 
 def tp_param_specs(net, mesh_axis: str = "tp"):
     """PartitionSpec pytree for a network's params: Megatron column/row
-    alternation for stacked Dense layers; replicate everything else.
-    MultiLayerNetwork only — the column/row alternation is defined by
-    the sequential layer chain, which an arbitrary graph DAG lacks."""
+    alternation for stacked Dense layers; attention layers shard over
+    HEADS (Wq/Wk/Wv column-parallel so each device owns n_heads/T whole
+    heads, Wo row-parallel so XLA inserts one all-reduce after the
+    output projection — the Megatron self-attention block); replicate
+    everything else. MultiLayerNetwork only — the column/row
+    alternation is defined by the sequential layer chain, which an
+    arbitrary graph DAG lacks."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        MultiHeadSelfAttention,
+    )
+
     if hasattr(net, "_layer_vertices"):
         raise ValueError(
             "tp_param_specs requires a MultiLayerNetwork: Megatron "
@@ -70,7 +78,16 @@ def tp_param_specs(net, mesh_axis: str = "tp"):
     col = True
     for key, lc in _layer_items(net):
         layer_specs = {}
-        if isinstance(lc, (L.DenseLayer,)) and not isinstance(
+        if isinstance(lc, MultiHeadSelfAttention):
+            # Head sharding propagates through the [N,T,D]->[N,H,T,dh]
+            # reshape only when the tp size divides the head count
+            # (GSPMD splits D into whole heads).
+            layer_specs["Wq"] = P(None, mesh_axis)
+            layer_specs["Wk"] = P(None, mesh_axis)
+            layer_specs["Wv"] = P(None, mesh_axis)
+            layer_specs["Wo"] = P(mesh_axis, None)
+            layer_specs["b"] = P()
+        elif isinstance(lc, (L.DenseLayer,)) and not isinstance(
             lc, L.OutputLayer
         ):
             if col:
@@ -216,6 +233,24 @@ class ParallelTrainer:
                 "only: the Megatron column/row alternation follows the "
                 "sequential layer chain; ComputationGraphs compose dp "
                 "and ep axes")
+        if self.tp_axis:
+            from deeplearning4j_tpu.nn.layers.attention import (
+                MultiHeadSelfAttention,
+            )
+
+            T = int(mesh.shape[self.tp_axis])
+            for _, lc in _layer_items(net):
+                if isinstance(lc, MultiHeadSelfAttention):
+                    if lc.n_heads % T:
+                        raise ValueError(
+                            f"n_heads {lc.n_heads} not divisible by mesh "
+                            f"tp={T}: head sharding needs whole heads "
+                            "per device")
+                    if lc.ring_axis:
+                        raise ValueError(
+                            "ring attention (ring_axis/sp) and head-"
+                            "sharded tp are alternative attention "
+                            "layouts; configure one")
         if self.ep_axis:
             from deeplearning4j_tpu.nn.layers.moe import MoeDense
 
@@ -540,16 +575,20 @@ class ParallelTrainer:
         K/V blocks rotate over ICI via ppermute), per-timestep layers
         (RnnOutputLayer) run on their local shard unchanged. Sequential
         recurrences (LSTM/GRU) and cross-time preprocessors cannot."""
-        from deeplearning4j_tpu.nn.conf.enums import BackpropType
+        from deeplearning4j_tpu.nn.conf.enums import (
+            BackpropType,
+            OptimizationAlgorithm,
+        )
         from deeplearning4j_tpu.nn.layers.attention import (
             MultiHeadSelfAttention,
         )
         from deeplearning4j_tpu.nn.layers.moe import MoeDense
 
-        from deeplearning4j_tpu.nn.conf.enums import (
-            OptimizationAlgorithm,
-        )
-
+        if self.sp_axis == self.dp_axis:
+            raise ValueError(
+                f"sp_axis {self.sp_axis!r} must name a mesh axis "
+                "distinct from dp_axis: the batch axis shards over dp "
+                "and the time axis over sp")
         if self.is_graph:
             raise ValueError(
                 "sp_axis supports MultiLayerNetwork only (the time-axis "

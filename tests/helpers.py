@@ -1,0 +1,16 @@
+"""Shared test fixtures/builders (imported as plain modules: the repo
+root is on sys.path via conftest)."""
+
+import numpy as np
+
+
+def lm_batch(rng, n, c, t, k, dtype=np.float32):
+    """Random [N, C, T] features + scatter one-hot [N, K, T] labels —
+    the language-model batch shape shared by the sequence-parallel,
+    tensor-parallel, and pipeline transformer parity tests."""
+    x = rng.normal(size=(n, c, t)).astype(dtype)
+    ids = rng.integers(0, k, size=(n, t))
+    y = np.zeros((n, k, t), dtype)
+    for i in range(n):
+        y[i, ids[i], np.arange(t)] = 1.0
+    return x, y

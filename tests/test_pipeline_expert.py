@@ -1129,3 +1129,41 @@ class TestFsdpAxis:
         mesh = make_mesh(MeshSpec({"dp": 2, "fsdp": 4}))
         with pytest.raises(ValueError, match="shards NOTHING"):
             ParallelTrainer(net, mesh, fsdp_axis="fsdp")
+
+
+class TestTransformerPipeline:
+    def test_transformer_dp_pp_matches_single_device(self):
+        """The attention flagship pipelines: stages of causal attention
+        layers stream microbatches over dp x pp with single-device
+        trajectory parity (attention stages were previously untested
+        under the pipeline schedule)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        def mk():
+            return MultiLayerNetwork(transformer_lm(
+                n_in=8, width=16, n_layers=3, n_heads=2, n_classes=8,
+                lr=1e-2, seed=3)).init()
+
+        ref, net = mk(), mk()
+        mesh = make_mesh(MeshSpec({"dp": 2, "pp": 4}))
+        trainer = PipelineTrainer(net, mesh, n_microbatches=2)
+        from tests.helpers import lm_batch
+
+        x, y = lm_batch(np.random.default_rng(0), n=8, c=8, t=12, k=8)
+        for _ in range(3):
+            ref.fit(DataSet(x, y))
+            s = trainer.fit(DataSet(x, y))
+        np.testing.assert_allclose(s, float(ref.score_value), rtol=1e-5)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(net.params[si][name]), np.asarray(p),
+                    atol=3e-4,
+                    err_msg=f"param {si}/{name} diverged under dp x pp",
+                )

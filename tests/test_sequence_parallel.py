@@ -139,12 +139,9 @@ class TestRingAttentionMask:
 
 
 def _lm_batch(rng, n, c, t, k):
-    """Random [N, C, T] features + one-hot [N, K, T] labels."""
-    x = rng.normal(size=(n, c, t)).astype(np.float32)
-    ids = rng.integers(0, k, size=(n, t))
-    y = np.zeros((n, k, t), np.float32)
-    for i in range(n):
-        y[i, ids[i], np.arange(t)] = 1.0
+    from tests.helpers import lm_batch
+
+    x, y = lm_batch(rng, n, c, t, k)
     return jnp.asarray(x), jnp.asarray(y)
 
 
@@ -345,3 +342,13 @@ class TestConfLevelSequenceParallel:
         with pytest.raises(ValueError, match="output layer"):
             ParallelTrainer(MultiLayerNetwork(headless), mesh,
                             sp_axis="sp")
+
+    def test_sp_rejects_dp_collision(self):
+        from deeplearning4j_tpu.parallel.data_parallel import (
+            ParallelTrainer,
+        )
+
+        mesh = make_mesh(MeshSpec({"dp": 2, "sp": 4}))
+        with pytest.raises(ValueError, match="distinct from dp_axis"):
+            ParallelTrainer(_transformer(ring_axis="dp"), mesh,
+                            sp_axis="dp")
