@@ -590,3 +590,34 @@ class TestAttentionTensorParallel:
             ring_axis="tp"))
         with pytest.raises(ValueError, match="alternative attention"):
             ParallelTrainer(ringy, mesh, tp_axis="tp")
+
+    def test_dp_tp_fsdp_three_axis_composition(self):
+        """dp x tp x fsdp on one mesh: attention heads shard over tp,
+        fsdp overlays ZeRO-3 sharding on the leaves tp left replicated
+        (biases, output W), the batch shards over dp x fsdp — exact
+        single-device trajectory."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.parallel.data_parallel import (
+            ParallelTrainer,
+        )
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        x, y = self._batch()
+        ref = self._net()
+        net3 = self._net()
+        mesh = make_mesh(MeshSpec({"dp": 2, "tp": 2, "fsdp": 2}))
+        trainer = ParallelTrainer(
+            net3, mesh, tp_axis="tp", fsdp_axis="fsdp")
+        assert "tp" in tuple(net3.params["0"]["Wq"].sharding.spec)
+        assert "fsdp" in tuple(net3.params["2"]["W"].sharding.spec)
+        for _ in range(3):
+            ref.fit(DataSet(x, y))
+            s3 = trainer.fit(DataSet(x, y))
+        np.testing.assert_allclose(s3, float(ref.score_value), rtol=2e-4)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(net3.params[si][name]), np.asarray(p),
+                    atol=3e-4,
+                    err_msg=f"param {si}/{name} diverged under 3-axis",
+                )
