@@ -39,6 +39,12 @@ class MultiHeadSelfAttention(BaseRecurrentLayer):
     # engages only at T >= 4096 where flash is speed-neutral and the
     # O(T²) dense score materialization starts to matter
     use_flash: Optional[bool] = None
+    # KV-cache length for rnn_time_step streaming (reference
+    # rnnTimeStep contract, BaseRecurrentLayer stateMap): a FIXED-size
+    # right-aligned sliding cache so the decode step compiles once
+    # (static shapes — no per-step recompilation as context grows);
+    # tokens older than this many steps fall out of the window
+    stream_max_t: int = 512
 
 
 class AttentionImpl(LayerImplBase):
@@ -79,18 +85,38 @@ class AttentionImpl(LayerImplBase):
         k = split_heads(params["Wk"])
         v = split_heads(params["Wv"])
 
-        if lc.ring_axis:
-            from deeplearning4j_tpu.parallel.sequence_parallel import (
-                ring_attention,
-            )
-
-            o = ring_attention(
-                q, k, v, lc.ring_axis, causal=lc.causal, key_mask=mask
-            )
-        elif _should_use_flash(lc.use_flash, q, mask):
-            o = _flash_attention(q, k, v, lc.causal)
+        if state is not None:
+            # Streaming continuation (rnn_time_step): attend over the
+            # carried KV cache + this chunk — the attention analogue of
+            # the LSTM carried (h, c) (reference BaseRecurrentLayer
+            # stateMap). Always causal (the future is unwritten when
+            # decoding); masks don't apply (reference streams unmasked).
+            o, state = cls._stream_attend(lc, q, k, v, state)
         else:
-            o = _dense_attention(q, k, v, lc.causal, mask)
+            if lc.ring_axis:
+                from deeplearning4j_tpu.parallel.sequence_parallel import (
+                    ring_attention,
+                )
+
+                o = ring_attention(
+                    q, k, v, lc.ring_axis, causal=lc.causal, key_mask=mask
+                )
+            elif _should_use_flash(lc.use_flash, q, mask):
+                o = _flash_attention(q, k, v, lc.causal)
+            else:
+                o = _dense_attention(q, k, v, lc.causal, mask)
+            if not train and not lc.ring_axis:
+                # Prefill: expose the (right-aligned, fixed-size) KV
+                # cache so a later rnn_time_step call continues this
+                # context. Under output()/evaluate the returned rnn
+                # state is discarded, so XLA dead-code-eliminates the
+                # cache build; training (train=True) never creates it —
+                # tBPTT windows stay independent, as without a cache.
+                # (Built for non-causal layers too so that a SECOND
+                # streaming call reaches _stream_attend's explicit
+                # cannot-stream error instead of silently attending
+                # chunk-locally.)
+                state = cls._prefill_cache(lc, k, v)
 
         o = jnp.transpose(o, (0, 2, 1, 3)).reshape(
             o.shape[0], o.shape[2], d
@@ -101,6 +127,57 @@ class AttentionImpl(LayerImplBase):
         if mask is not None:
             out = out * mask[:, None, :]
         return out, state
+
+    # -- rnn_time_step streaming (fixed-size sliding KV cache) ---------
+    @classmethod
+    def _prefill_cache(cls, lc, k, v):
+        """Right-align the last ``stream_max_t`` K/V positions into the
+        fixed-size cache (zeros pad the left when underfilled)."""
+        tm = lc.stream_max_t
+        n, h, t, dh = k.shape
+        zk = jnp.zeros((n, h, tm, dh), k.dtype)
+        return {
+            "k": jnp.concatenate([zk, k], axis=2)[:, :, -tm:, :],
+            "v": jnp.concatenate([zk, v], axis=2)[:, :, -tm:, :],
+            "filled": jnp.asarray(min(t, tm), jnp.int32),
+        }
+
+    @classmethod
+    def _stream_attend(cls, lc, q, k, v, cache):
+        """Dense attention of the current chunk's queries over
+        cache + chunk. The cache stays ``stream_max_t`` long (static
+        shapes — one compiled decode step regardless of how much
+        context has streamed); the oldest tokens slide out when the
+        window is exceeded."""
+        tm = lc.stream_max_t
+        t = q.shape[2]
+        if not lc.causal:
+            raise ValueError(
+                "non-causal (bidirectional) attention cannot stream: "
+                "rnn_time_step continuation would need future tokens; "
+                "use causal=True or run output() on full sequences")
+        if t > tm:
+            raise ValueError(
+                f"rnn_time_step continuation chunk of {t} steps exceeds "
+                f"stream_max_t={tm}: its oldest keys would slide out "
+                "before later queries attend them — raise stream_max_t "
+                "or stream smaller chunks")
+        ck = jnp.concatenate([cache["k"], k], axis=2)[:, :, -tm:, :]
+        cv = jnp.concatenate([cache["v"], v], axis=2)[:, :, -tm:, :]
+        filled = jnp.minimum(cache["filled"] + t, tm)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) / jnp.sqrt(
+            jnp.asarray(q.shape[-1], q.dtype)
+        )
+        cpos = jnp.arange(tm)
+        valid = cpos >= (tm - filled)               # [Tm]
+        qpos = tm - t + jnp.arange(t)               # queries sit at the
+        causal_ok = cpos[None, :] <= qpos[:, None]  # cache tail [t, Tm]
+        ok = causal_ok & valid[None, :]
+        neg = jnp.asarray(-1e30, q.dtype)
+        scores = jnp.where(ok[None, None], scores, neg)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w, cv)
+        return o, {"k": ck, "v": cv, "filled": filled}
 
 
 def _should_use_flash(use_flash, q, mask) -> bool:

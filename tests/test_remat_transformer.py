@@ -2,6 +2,7 @@
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.models.zoo import mlp, transformer_lm
@@ -85,3 +86,94 @@ def test_transformer_lm_remat_trains():
     for _ in range(5):
         net.fit(ds)
     assert np.isfinite(net.score_value)
+
+
+class TestAttentionStreaming:
+    """rnn_time_step on attention layers: the fixed-size KV cache makes
+    chunked streaming reproduce the full-sequence causal forward — the
+    attention analogue of the reference's rnnTimeStep-vs-output parity
+    contract for LSTMs (ComputationGraphTestRNN pattern)."""
+
+    def _net(self, stream_max_t=64):
+        conf = transformer_lm(n_in=8, width=16, n_layers=2, n_heads=2,
+                              n_classes=8, seed=9)
+        for c in conf.confs:
+            if hasattr(c.layer, "stream_max_t"):
+                c.layer.stream_max_t = stream_max_t
+        return MultiLayerNetwork(conf).init()
+
+    def test_chunked_streaming_matches_full_forward(self):
+        net = self._net()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(3, 8, 12)).astype(np.float32)
+        full = np.asarray(net.output(x))
+        stream = self._net()
+        outs = []
+        for lo, hi in [(0, 5), (5, 6), (6, 12)]:  # uneven chunks
+            outs.append(np.asarray(stream.rnn_time_step(x[:, :, lo:hi])))
+        np.testing.assert_allclose(
+            np.concatenate(outs, axis=2), full, atol=1e-5)
+
+    def test_single_step_decode_loop(self):
+        """One token at a time — the autoregressive decode hot path —
+        matches the full forward position by position."""
+        net = self._net()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 8, 9)).astype(np.float32)
+        full = np.asarray(net.output(x))
+        stream = self._net()
+        for t in range(9):
+            step = np.asarray(stream.rnn_time_step(x[:, :, t]))
+            np.testing.assert_allclose(
+                step[:, :, 0], full[:, :, t], atol=1e-5,
+                err_msg=f"decode step {t} diverged")
+
+    def test_clear_state_restarts_context(self):
+        net = self._net()
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 8, 4)).astype(np.float32)
+        a = np.asarray(net.rnn_time_step(x))
+        net.rnn_clear_previous_state()
+        b = np.asarray(net.rnn_time_step(x))
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_sliding_window_cap(self):
+        """Context beyond stream_max_t slides out: outputs equal a
+        windowed-attention forward where each query sees only the last
+        stream_max_t keys."""
+        tm = 6
+        net = self._net(stream_max_t=tm)
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(2, 8, 10)).astype(np.float32)
+        outs = []
+        stream = self._net(stream_max_t=tm)
+        for t in range(10):
+            outs.append(np.asarray(stream.rnn_time_step(x[:, :, t])))
+        got = np.concatenate(outs, axis=2)
+        assert np.isfinite(got).all()
+        # early positions (within the window of every later layer) still
+        # match the full forward; the tail has slid out of the window
+        full = np.asarray(net.output(x))
+        np.testing.assert_allclose(
+            got[:, :, :tm // 2], full[:, :, :tm // 2], atol=1e-5)
+
+    def test_oversized_continuation_chunk_raises(self):
+        net = self._net(stream_max_t=4)
+        rng = np.random.default_rng(4)
+        net.rnn_time_step(rng.normal(size=(2, 8, 2)).astype(np.float32))
+        with pytest.raises(ValueError, match="stream_max_t"):
+            net.rnn_time_step(
+                rng.normal(size=(2, 8, 6)).astype(np.float32))
+
+    def test_non_causal_streaming_raises(self):
+        conf = transformer_lm(n_in=8, width=16, n_layers=1, n_heads=2,
+                              n_classes=8, seed=9)
+        for c in conf.confs:
+            if hasattr(c.layer, "causal"):
+                c.layer.causal = False
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(2, 8, 3)).astype(np.float32)
+        net.rnn_time_step(x)  # first chunk: self-contained, fine
+        with pytest.raises(ValueError, match="cannot stream"):
+            net.rnn_time_step(x)
