@@ -159,25 +159,33 @@ class AttentionImpl(LayerImplBase):
         if t > tm:
             raise ValueError(
                 f"rnn_time_step continuation chunk of {t} steps exceeds "
-                f"stream_max_t={tm}: its oldest keys would slide out "
-                "before later queries attend them — raise stream_max_t "
-                "or stream smaller chunks")
-        ck = jnp.concatenate([cache["k"], k], axis=2)[:, :, -tm:, :]
-        cv = jnp.concatenate([cache["v"], v], axis=2)[:, :, -tm:, :]
-        filled = jnp.minimum(cache["filled"] + t, tm)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) / jnp.sqrt(
+                f"stream_max_t={tm}: raise stream_max_t or stream "
+                "smaller chunks")
+        # Attend over the FULL [cache | chunk] extension (length tm+t)
+        # and slice only the returned cache: slicing BEFORE attending
+        # would drop cached keys still inside the sliding window of the
+        # chunk's EARLY queries (chunked streaming would diverge from
+        # one-token-at-a-time streaming once the window saturates).
+        ek = jnp.concatenate([cache["k"], k], axis=2)   # [N,H,tm+t,dh]
+        ev = jnp.concatenate([cache["v"], v], axis=2)
+        prev = cache["filled"]
+        filled = jnp.minimum(prev + t, tm)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ek) / jnp.sqrt(
             jnp.asarray(q.shape[-1], q.dtype)
         )
-        cpos = jnp.arange(tm)
-        valid = cpos >= (tm - filled)               # [Tm]
-        qpos = tm - t + jnp.arange(t)               # queries sit at the
-        causal_ok = cpos[None, :] <= qpos[:, None]  # cache tail [t, Tm]
-        ok = causal_ok & valid[None, :]
+        j = jnp.arange(tm + t)                    # extension positions
+        i = jnp.arange(t)                         # query i at ext tm+i
+        ok = (
+            (j[None, :] <= tm + i[:, None])       # causal
+            & (j[None, :] >= i[:, None] + 1)      # its last-tm window
+            & (j[None, :] >= tm - prev)           # cache zeros invalid
+        )
         neg = jnp.asarray(-1e30, q.dtype)
         scores = jnp.where(ok[None, None], scores, neg)
         w = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", w, cv)
-        return o, {"k": ck, "v": cv, "filled": filled}
+        o = jnp.einsum("bhqk,bhkd->bhqd", w, ev)
+        return o, {"k": ek[:, :, -tm:, :], "v": ev[:, :, -tm:, :],
+                   "filled": filled}
 
 
 def _should_use_flash(use_flash, q, mask) -> bool:

@@ -445,3 +445,38 @@ class TestGraphPretrainUnlabeled:
         w0 = np.asarray(graph.params["ae"]["W"]).copy()
         graph.pretrain(it)
         assert not np.allclose(w0, np.asarray(graph.params["ae"]["W"]))
+
+
+class TestGraphAttentionStreaming:
+    def test_attention_vertex_streams_with_kv_cache(self):
+        """ComputationGraph rnn_time_step through an attention vertex:
+        the vertex's carried KV cache makes chunked streaming match the
+        full causal forward (same contract the LSTM vertices satisfy)."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadSelfAttention,
+        )
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(4).learning_rate(0.01)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("attn", MultiHeadSelfAttention(
+                n_in=6, n_out=8, n_heads=2, causal=True), "in")
+            .add_layer("out", L.RnnOutputLayer(
+                n_in=8, n_out=5, activation="softmax",
+                loss_function=LossFunction.MCXENT), "attn")
+            .set_outputs("out")
+            .build()
+        )
+        graph = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 6, 10)).astype(np.float32)
+        full = np.asarray(graph.output(x)[0])
+        graph.rnn_clear_previous_state()
+        outs = []
+        for lo, hi in [(0, 4), (4, 5), (5, 10)]:
+            outs.append(np.asarray(
+                graph.rnn_time_step(x[:, :, lo:hi])[0]))
+        np.testing.assert_allclose(
+            np.concatenate(outs, axis=2), full, atol=1e-5)

@@ -177,3 +177,52 @@ class TestAttentionStreaming:
         net.rnn_time_step(x)  # first chunk: self-contained, fine
         with pytest.raises(ValueError, match="cannot stream"):
             net.rnn_time_step(x)
+
+    def test_chunked_equals_single_step_past_window_saturation(self):
+        """Once total context exceeds stream_max_t, chunked streaming
+        must still equal one-token-at-a-time streaming: early queries
+        of a chunk attend cached keys that a premature cache slice
+        would have dropped."""
+        tm = 8
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(2, 8, 16)).astype(np.float32)
+        one = self._net(stream_max_t=tm)
+        a = np.concatenate(
+            [np.asarray(one.rnn_time_step(x[:, :, t]))
+             for t in range(16)], axis=2)
+        chunked = self._net(stream_max_t=tm)
+        b = np.concatenate(
+            [np.asarray(chunked.rnn_time_step(x[:, :, lo:lo + 8]))
+             for lo in (0, 8)], axis=2)
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_ring_axis_streaming_raises_clearly(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadSelfAttention,
+        )
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        conf = transformer_lm(n_in=8, width=16, n_layers=1, n_heads=2,
+                              n_classes=8, ring_axis="sp")
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="ring_axis"):
+            net.rnn_time_step(np.zeros((1, 8, 2), np.float32))
+
+        gconf = (
+            NeuralNetConfiguration.Builder().seed(1).learning_rate(0.01)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("attn", MultiHeadSelfAttention(
+                n_in=8, n_out=8, n_heads=2, ring_axis="sp"), "in")
+            .add_layer("out", L.RnnOutputLayer(
+                n_in=8, n_out=4, activation="softmax",
+                loss_function=LossFunction.MCXENT), "attn")
+            .set_outputs("out")
+            .build()
+        )
+        graph = ComputationGraph(gconf).init()
+        with pytest.raises(ValueError, match="ring_axis"):
+            graph.rnn_time_step(np.zeros((1, 8, 2), np.float32))
