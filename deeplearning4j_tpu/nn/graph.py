@@ -620,14 +620,13 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def rnn_time_step(self, *features) -> List[Array]:
         self.init()
-        for name, lv in self._layer_vertices.items():
-            if getattr(lv.conf.layer, "ring_axis", None):
-                raise ValueError(
-                    f"rnn_time_step streams on a single device; layer "
-                    f"vertex {name!r} is configured with ring_axis="
-                    f"{lv.conf.layer.ring_axis!r} (sequence "
-                    "parallelism) — rebuild the conf with "
-                    "ring_axis=None for serving")
+        from deeplearning4j_tpu.nn.layers.attention import (
+            guard_streamable,
+        )
+
+        guard_streamable(
+            (name, lv.conf.layer)
+            for name, lv in self._layer_vertices.items())
         # Direct consumers of each network input: a 2-D input consumed by
         # recurrent layers is ONE time step (expand to [B, C, 1], as the
         # reference's BaseRecurrentLayer.rnnTimeStep does internally); a

@@ -36,8 +36,8 @@ class MultiHeadSelfAttention(BaseRecurrentLayer):
     ring_axis: Optional[str] = None  # sequence-parallel mesh axis
     # pallas flash-attention path: True forces it (TPU, no mask, T
     # multiple of 128 and >= 256), False forces dense, None = auto —
-    # engages only at T >= 4096 where flash is speed-neutral and the
-    # O(T²) dense score materialization starts to matter
+    # engages at T >= 2048 where the tuned kernel clearly beats dense
+    # and the O(T²) dense score materialization starts to matter
     use_flash: Optional[bool] = None
     # KV-cache length for rnn_time_step streaming (reference
     # rnnTimeStep contract, BaseRecurrentLayer stateMap): a FIXED-size
@@ -188,6 +188,21 @@ class AttentionImpl(LayerImplBase):
                    "filled": filled}
 
 
+def guard_streamable(named_layer_beans) -> None:
+    """Raise if any layer bean carries ring_axis: rnn_time_step streams
+    on a single device, and sequence-parallel attention cannot (shared
+    by MultiLayerNetwork.rnn_time_step and
+    ComputationGraph.rnn_time_step)."""
+    for name, lc in named_layer_beans:
+        if getattr(lc, "ring_axis", None):
+            raise ValueError(
+                f"rnn_time_step streams on a single device; layer "
+                f"{name} is configured with ring_axis="
+                f"{lc.ring_axis!r} (sequence parallelism) and cannot "
+                "stream — rebuild the conf with ring_axis=None for "
+                "serving")
+
+
 def _should_use_flash(use_flash, q, mask) -> bool:
     if use_flash is False:
         return False
@@ -203,24 +218,48 @@ def _should_use_flash(use_flash, q, mask) -> bool:
     if use_flash is None:
         # Auto mode: flash is the LONG-context enabler — it removes the
         # O(T²) score materialization that stops dense attention at
-        # ~16k+ tokens — but measured on-chip it only reaches speed
-        # parity around T=4096 and is much slower below (XLA's fused
-        # dense path wins at short T). Auto-enable where it's at least
-        # neutral on speed and strictly better on memory.
-        return kernel_ok and t >= 4096
+        # ~16k+ tokens. With the tuned 1024-element block sizes (the
+        # kernel defaults were pathological — see _flash_attention) it
+        # reaches speed parity by T~512-1024 and wins ~2x at T=4096;
+        # keep a conservative 2048 threshold where the win is clear
+        # beyond dispatch noise and the memory savings start to matter.
+        # The t % 512 == 0 condition guarantees a healthy block size:
+        # a T like 2176 (=128*17) would degrade the kernel to
+        # 128-blocks — the pathological regime — where dense is faster.
+        return kernel_ok and t >= 2048 and t % 512 == 0
     return bool(use_flash)
 
 
 def _flash_attention(q, k, v, causal):
     """Pallas TPU flash-attention kernel: O(T) memory instead of the
     dense O(T²) score matrix (pallas_guide.md; long-context fast path —
-    SURVEY.md §5.7)."""
+    SURVEY.md §5.7).
+
+    Block sizes are pinned to the largest of (1024, 512, 256, 128)
+    dividing T: the kernel's defaults measured PATHOLOGICAL at long
+    context on v5e — T=16384 forward 584 ms default vs 47 ms at
+    1024-blocks (12x), fwd+bwd 177 ms vs 48 ms (3.7x); 2048-blocks
+    fails to compile (VMEM). Auto mode only engages where T yields
+    >= 512 blocks; a forced use_flash=True accepts whatever divisor T
+    offers. Measured in BENCHMARKS.md (long-context section)."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
         flash_attention,
     )
 
+    t = q.shape[2]
+    # largest block <= 1024 that divides T (T % 128 == 0 guaranteed by
+    # _should_use_flash, so 128 always divides)
+    n = next(b for b in (1024, 512, 256, 128) if t % b == 0)
+    bs = BlockSizes(
+        block_q=n, block_k_major=n, block_k=n, block_b=1,
+        block_q_major_dkv=n, block_k_major_dkv=n,
+        block_k_dkv=n, block_q_dkv=n,
+        block_k_major_dq=n, block_k_dq=n, block_q_dq=n,
+    )
     return flash_attention(
-        q, k, v, causal=causal, sm_scale=q.shape[-1] ** -0.5)
+        q, k, v, causal=causal, sm_scale=q.shape[-1] ** -0.5,
+        block_sizes=bs)
 
 
 def _dense_attention(q, k, v, causal, mask):

@@ -645,14 +645,12 @@ class MultiLayerNetwork:
         """Stateful single/multi-step inference carrying hidden state
         between calls (reference rnnTimeStep)."""
         self.init()
-        for c in self.conf.confs:
-            if getattr(c.layer, "ring_axis", None):
-                raise ValueError(
-                    "rnn_time_step streams on a single device; attention "
-                    f"layers configured with ring_axis="
-                    f"{c.layer.ring_axis!r} (sequence parallelism) "
-                    "cannot stream — rebuild the conf with "
-                    "ring_axis=None for serving")
+        from deeplearning4j_tpu.nn.layers.attention import (
+            guard_streamable,
+        )
+
+        guard_streamable(
+            (str(i), c.layer) for i, c in enumerate(self.conf.confs))
         x = jnp.asarray(x, self._dtype)
         if x.ndim == 2:
             x = x[:, :, None]

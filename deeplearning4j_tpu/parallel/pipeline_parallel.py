@@ -304,6 +304,17 @@ class PipelineTrainer:
 
     Limitations (documented, enforced): plain-SGD-family training only
     (no tBPTT, no second-order solvers).
+
+    **Why pp composes with dp but not tp/fsdp.** The 1/S memory
+    property comes from packing each stage's pytree into one row of a
+    [S, K] buffer laid out P(pp) — a single flattened vector per
+    device, unpacked with static offsets inside ``lax.switch``. Tensor
+    or fsdp sharding needs per-TENSOR layouts, which a flattened padded
+    row cannot express; sharding the row itself would force an
+    all-gather before every unpack (fsdp-esque memory, none of tp's
+    compute split). Models needing tp x pp should use the GSPMD
+    ParallelTrainer axes (tp/fsdp compose there, including head-sharded
+    attention) — pp's niche is the 1/S-memory schedule for deep stacks.
     """
 
     def __init__(
