@@ -36,8 +36,8 @@ class MultiHeadSelfAttention(BaseRecurrentLayer):
     ring_axis: Optional[str] = None  # sequence-parallel mesh axis
     # pallas flash-attention path: True forces it (TPU, no mask, T
     # multiple of 128 and >= 256), False forces dense, None = auto —
-    # engages at T >= 2048 where the tuned kernel clearly beats dense
-    # and the O(T²) dense score materialization starts to matter
+    # engages at T >= 2048 when T % 512 == 0 (healthy kernel blocks),
+    # and at T >= 8192 unconditionally (dense OOMs long before 32k)
     use_flash: Optional[bool] = None
     # KV-cache length for rnn_time_step streaming (reference
     # rnnTimeStep contract, BaseRecurrentLayer stateMap): a FIXED-size
@@ -226,7 +226,10 @@ def _should_use_flash(use_flash, q, mask) -> bool:
         # The t % 512 == 0 condition guarantees a healthy block size:
         # a T like 2176 (=128*17) would degrade the kernel to
         # 128-blocks — the pathological regime — where dense is faster.
-        return kernel_ok and t >= 2048 and t % 512 == 0
+        # Above 8192 that tradeoff inverts: even degraded-block flash
+        # beats dense's O(T²) score materialization (4.3 GB at 8k,
+        # OOM by 32k), so memory safety overrides block health there.
+        return kernel_ok and t >= 2048 and (t % 512 == 0 or t >= 8192)
     return bool(use_flash)
 
 
