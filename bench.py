@@ -257,13 +257,19 @@ def bench_wide_cnn():
 
 
 def transformer_flops_per_token(seq: int, n_in=64, width=256,
-                                n_layers=4, n_classes=64) -> int:
+                                n_layers=4, n_classes=64,
+                                causal_flash=False) -> int:
     """Analytic train FLOPs/token for zoo.transformer_lm: per layer,
-    qkv projections + output projection + causal attention (the dense
-    kernel computes full TxT scores, ~2*T*d executed MACs per token).
-    T is a bench-tuning knob, so the attention term derives from it."""
-    layer0 = 3 * n_in * width + width * width + 2 * seq * width
-    layer = 3 * width * width + width * width + 2 * seq * width
+    qkv projections + output projection + attention. The convention is
+    EXECUTED MACs: the dense kernel computes the full TxT scores and
+    masks (~2*T*d per token), so dense rows count the full term; the
+    causal pallas flash kernel skips future blocks and executes ~half,
+    so flash rows pass causal_flash=True — keeping mfu comparable as
+    hardware utilization across rows. T is a bench-tuning knob, so the
+    attention term derives from it."""
+    attn = (seq * width) if causal_flash else (2 * seq * width)
+    layer0 = 3 * n_in * width + width * width + attn
+    layer = 3 * width * width + width * width + attn
     return 3 * 2 * (layer0 + (n_layers - 1) * layer + width * n_classes)
 
 
@@ -349,7 +355,7 @@ def bench_transformer_long_context():
         "unit": "tokens/sec/chip",
         "vs_baseline": None,  # reference cannot run this config at all
         "mfu": round(
-            tok_s * transformer_flops_per_token(seq)
+            tok_s * transformer_flops_per_token(seq, causal_flash=True)
             / V5E_PEAK_BF16_FLOPS, 4),
     }
 

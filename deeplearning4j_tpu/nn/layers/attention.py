@@ -34,6 +34,11 @@ class MultiHeadSelfAttention(BaseRecurrentLayer):
     n_heads: int = 4
     causal: bool = True
     ring_axis: Optional[str] = None  # sequence-parallel mesh axis
+    # sub-chunk the visiting K/V block inside the ring (blockwise online
+    # softmax): bounds the per-device score buffer at
+    # [B, H, T_local, ring_block_size] instead of [.., T_local, T_local]
+    # — the memory lever for LONG local shards; None = whole block
+    ring_block_size: Optional[int] = None
     # pallas flash-attention path: True forces it (TPU, no mask, T
     # multiple of 128 and >= 256), False forces dense, None = auto —
     # engages at T >= 2048 when T % 512 == 0 (healthy kernel blocks),
@@ -99,7 +104,8 @@ class AttentionImpl(LayerImplBase):
                 )
 
                 o = ring_attention(
-                    q, k, v, lc.ring_axis, causal=lc.causal, key_mask=mask
+                    q, k, v, lc.ring_axis, causal=lc.causal,
+                    key_mask=mask, block_size=lc.ring_block_size,
                 )
             elif _should_use_flash(lc.use_flash, q, mask):
                 o = _flash_attention(q, k, v, lc.causal)
@@ -242,9 +248,11 @@ def _flash_attention(q, k, v, causal):
     dividing T: the kernel's defaults measured PATHOLOGICAL at long
     context on v5e — T=16384 forward 584 ms default vs 47 ms at
     1024-blocks (12x), fwd+bwd 177 ms vs 48 ms (3.7x); 2048-blocks
-    fails to compile (VMEM). Auto mode only engages where T yields
-    >= 512 blocks; a forced use_flash=True accepts whatever divisor T
-    offers. Measured in BENCHMARKS.md (long-context section)."""
+    fails to compile (VMEM). Auto mode engages only where T yields
+    >= 512 blocks BELOW 8192; at T >= 8192 it engages unconditionally
+    (degraded 128/256-blocks included — dense's O(T²) scores OOM there,
+    so a slow flash beats no flash). A forced use_flash=True accepts
+    whatever divisor T offers. Measured in BENCHMARKS.md."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes,
         flash_attention,

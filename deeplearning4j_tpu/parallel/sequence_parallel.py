@@ -68,6 +68,7 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     key_mask: Optional[Array] = None,
+    block_size: Optional[int] = None,
 ) -> Array:
     """Blockwise ring attention INSIDE shard_map.
 
@@ -79,10 +80,25 @@ def ring_attention(
     ``key_mask`` [B, T_local] (1 = valid) marks padded timesteps of the
     LOCAL key block; it rotates around the ring with its K/V block so
     padded keys are excluded from every device's softmax.
+
+    ``block_size``: sub-chunk the VISITING K/V block through the same
+    online softmax (the Liu et al. blockwise computation), bounding the
+    score buffer at [B, H, T_local, block_size] instead of
+    [B, H, T_local, T_local] — the memory lever that lets a device hold
+    a long T_local shard without materializing its full block-pair
+    score matrix. None = whole block at once (exact same math either
+    way; tests assert equality).
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, t, d = q.shape
+    bs = t if block_size is None else min(block_size, t)
+    if bs < 1:
+        raise ValueError(f"block_size must be positive, got {block_size}")
+    if t % bs:
+        raise ValueError(
+            f"block_size {bs} must divide the local shard length {t}")
+    n_sub = t // bs
 
     m0 = jnp.full((b, h, t), -jnp.inf, q.dtype)
     l0 = jnp.zeros((b, h, t), q.dtype)
@@ -99,18 +115,39 @@ def ring_attention(
         k_blk, v_blk, km_blk = kv
         # Which global block is visiting this device at this step?
         src_block = (idx + step) % n
-        k_pos = src_block * t + jnp.arange(t)
-        if causal:
-            mask = jnp.where(
-                q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf
-            ).astype(q.dtype)
+
+        def sub_body(s, mlo):
+            m, l, o = mlo
+            k_sub = lax.dynamic_slice_in_dim(k_blk, s * bs, bs, 2)
+            v_sub = lax.dynamic_slice_in_dim(v_blk, s * bs, bs, 2)
+            km_sub = lax.dynamic_slice_in_dim(km_blk, s * bs, bs, 1)
+            k_pos = src_block * t + s * bs + jnp.arange(bs)
+            if causal:
+                mask = jnp.where(
+                    q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf
+                ).astype(q.dtype)
+            else:
+                mask = jnp.zeros((t, bs), q.dtype)
+            # Padded keys of the visiting sub-block: -inf everywhere.
+            mask = mask[None, None] + jnp.where(
+                km_sub > 0, 0.0, -jnp.inf
+            ).astype(q.dtype)[:, None, None, :]
+            return _online_softmax_block(
+                q, k_sub, v_sub, m, l, o, mask)
+
+        if n_sub == 1:
+            m, l, o = sub_body(0, (m, l, o))
         else:
-            mask = jnp.zeros((t, t), q.dtype)
-        # Padded keys of the visiting block: -inf for every query.
-        mask = mask[None, None] + jnp.where(
-            km_blk > 0, 0.0, -jnp.inf
-        ).astype(q.dtype)[:, None, None, :]
-        m, l, o = _online_softmax_block(q, k_blk, v_blk, m, l, o, mask)
+            # Rematerialize each sub-block in the backward pass: without
+            # this, the scan-lowered loop SAVES every sub-block's
+            # [B, H, T_local, bs] probability matrix as a VJP residual,
+            # stacking right back to the full [T_local, T_local] the
+            # chunking exists to avoid. With remat, the backward
+            # recomputes each sub-block's scores from the (small) q/k/v
+            # slices — bounded memory in training too, at ~1 extra
+            # forward of compute (the flash-attention trade).
+            m, l, o = lax.fori_loop(
+                0, n_sub, jax.checkpoint(sub_body), (m, l, o))
         # Rotate K/V (+ their mask) to the next device (neighbor hop
         # over ICI).
         perm = [(i, (i - 1) % n) for i in range(n)]
@@ -128,7 +165,7 @@ def ring_attention(
 
 def make_ring_attention(
     mesh: Mesh, axis_name: str = "sp", causal: bool = True,
-    masked: bool = False,
+    masked: bool = False, block_size: Optional[int] = None,
 ):
     """shard_map-wrapped ring attention over global [B, H, T, D] arrays
     time-sharded on ``axis_name``. With ``masked=True`` the returned fn
@@ -136,12 +173,14 @@ def make_ring_attention(
     spec = P(None, None, axis_name, None)
     if masked:
         fn = lambda q, k, v, m: ring_attention(  # noqa: E731
-            q, k, v, axis_name, causal=causal, key_mask=m
+            q, k, v, axis_name, causal=causal, key_mask=m,
+            block_size=block_size,
         )
         in_specs = (spec, spec, spec, P(None, axis_name))
     else:
         fn = functools.partial(
-            ring_attention, axis_name=axis_name, causal=causal
+            ring_attention, axis_name=axis_name, causal=causal,
+            block_size=block_size,
         )
         in_specs = (spec, spec, spec)
     return shard_map(
