@@ -539,7 +539,7 @@ class PipelineTrainer:
         return shapes
 
     # -- the jitted step ----------------------------------------------
-    def _build_step(self, feats_shape, labels_shape):
+    def _build_step(self, feats_shape, labels_shape, scan_k=None):
         from deeplearning4j_tpu.nn.multilayer import (
             layer_reg_score,
             layer_update,
@@ -772,15 +772,44 @@ class PipelineTrainer:
                 idx, upd_branches, theta[0], grad, ustate[0], iteration)
             return new_t[None], new_u[None], st_final[None], score
 
-        batch_spec = P(dp) if dp is not None else P()
+        if scan_k is None:
+            fn = local_step
+            bspec = P(dp) if dp is not None else P()
+        else:
+            # K fused steps: lax.scan over [K, ...] stacked batches
+            # INSIDE the shard_map, so the whole K-step pipelined
+            # optimizer run is ONE dispatch (the fit_scan fusion the
+            # other trainers have — per-batch dispatch latency
+            # otherwise dominates small models on a tunnel transport).
+            def local_steps(theta, ustate, sstate, iteration, rng,
+                            fs, ys, fms, lms):
+                def body(carry, inp):
+                    th, us, ss, it = carry
+                    th, us, ss, score = local_step(
+                        th, us, ss, it,
+                        jax.random.fold_in(rng, inp["k"]),
+                        inp["f"], inp["y"], inp.get("fm"),
+                        inp.get("lm"))
+                    return (th, us, ss, it + 1), score
+
+                xs = {"f": fs, "y": ys, "k": jnp.arange(fs.shape[0])}
+                if fms is not None:
+                    xs["fm"] = fms
+                if lms is not None:
+                    xs["lm"] = lms
+                (theta, ustate, sstate, _), scores = jax.lax.scan(
+                    body, (theta, ustate, sstate, iteration), xs)
+                return theta, ustate, sstate, scores
+
+            fn = local_steps
+            bspec = P(None, dp) if dp is not None else P()
+
+        pp = P(self.pp_axis)
         step = shard_map(
-            local_step,
+            fn,
             mesh=self.mesh,
-            in_specs=(P(self.pp_axis), P(self.pp_axis), P(self.pp_axis),
-                      P(), P(), batch_spec, batch_spec, batch_spec,
-                      batch_spec),
-            out_specs=(P(self.pp_axis), P(self.pp_axis), P(self.pp_axis),
-                       P()),
+            in_specs=(pp, pp, pp, P(), P(), bspec, bspec, bspec, bspec),
+            out_specs=(pp, pp, pp, P()),
             check_vma=False,
         )
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -838,3 +867,46 @@ class PipelineTrainer:
         # net.params/updater_state the canonical user-visible copy.
         self._sync_to_net()
         return score
+
+    def fit_scan(self, features_stacked, labels_stacked,
+                 features_mask_stacked=None, labels_mask_stacked=None):
+        """K fused pipelined steps: one dispatch runs ``lax.scan`` over
+        [K, B, ...] pre-stacked batches, each scan iteration the full
+        microbatched GPipe schedule + updater — the fit_scan fusion the
+        other trainers have, on the stage-sharded pp (x dp) mesh.
+        Returns the K per-step scores."""
+        net = self.net
+        self._ensure_packed()
+        ksh = NamedSharding(
+            self.mesh,
+            P(None, self.dp_axis) if self.dp_axis is not None else P())
+        fs = jax.device_put(
+            jnp.asarray(features_stacked, net._dtype), ksh)
+        ys = jax.device_put(jnp.asarray(labels_stacked, net._dtype), ksh)
+        fms = (None if features_mask_stacked is None else jax.device_put(
+            jnp.asarray(features_mask_stacked, net._dtype), ksh))
+        lms = (None if labels_mask_stacked is None else jax.device_put(
+            jnp.asarray(labels_mask_stacked, net._dtype), ksh))
+        K = int(fs.shape[0])
+        key = ("scan", fs.shape, ys.shape,
+               None if fms is None else fms.shape,
+               None if lms is None else lms.shape)
+        if key not in self._step_cache:
+            self._step_cache[key] = self._build_step(
+                fs.shape[1:], ys.shape[1:], scan_k=K)
+        net._key, sub = jax.random.split(net._key)
+        start = net.iteration
+        self._theta, self._ustate, self._sstate, scores = \
+            self._step_cache[key](
+                self._theta, self._ustate, self._sstate,
+                net.iteration, sub, fs, ys, fms, lms,
+            )
+        net.iteration += K
+        net.score_value = scores[-1]
+        self._sync_to_net()
+        for listener in net.listeners:
+            # same crossing cadence as net.fit_scan
+            n = max(1, listener.invoked_every)
+            if net.iteration // n > start // n:
+                listener.iteration_done(net, net.iteration)
+        return scores

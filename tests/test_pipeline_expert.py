@@ -1167,3 +1167,90 @@ class TestTransformerPipeline:
                     atol=3e-4,
                     err_msg=f"param {si}/{name} diverged under dp x pp",
                 )
+
+
+class TestPipelineFitScan:
+    def test_pp_fit_scan_matches_sequential_fits(self):
+        """K fused pipelined steps == K sequential PipelineTrainer.fit
+        calls == K single-device fits, on a dp x pp mesh."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import mlp as zoo_mlp
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+
+        def mk():
+            return MultiLayerNetwork(
+                zoo_mlp((12, 10, 8, 6, 3), lr=0.05, seed=11)).init()
+
+        rng = np.random.default_rng(0)
+        K, B = 4, 8
+        cls = rng.integers(0, 3, K * B)
+        fs = rng.normal(loc=cls[:, None] * 0.5,
+                        size=(K * B, 12)).astype(np.float32)
+        ys = np.eye(3, dtype=np.float32)[cls]
+        fs = fs.reshape(K, B, 12)
+        ys = ys.reshape(K, B, 3)
+
+        mesh = make_mesh(MeshSpec({"dp": 2, "pp": 4}))
+        seq_net, scan_net, ref = mk(), mk(), mk()
+        seq_tr = PipelineTrainer(seq_net, mesh, n_microbatches=2)
+        scan_tr = PipelineTrainer(scan_net, mesh, n_microbatches=2)
+
+        seq_scores = [seq_tr.fit(DataSet(fs[i], ys[i]))
+                      for i in range(K)]
+        scores = np.asarray(scan_tr.fit_scan(fs, ys))
+        for i in range(K):
+            ref.fit(DataSet(fs[i], ys[i]))
+        assert scores.shape == (K,)
+        np.testing.assert_allclose(scores, seq_scores, rtol=1e-5)
+        np.testing.assert_allclose(
+            scores[-1], float(ref.score_value), rtol=1e-5)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(scan_net.params[si][name]),
+                    np.asarray(p), atol=1e-4,
+                    err_msg=f"param {si}/{name} diverged under pp scan")
+        assert scan_net.iteration == K
+
+    def test_pp_fit_scan_masked(self):
+        """Masked time-series batches ride the pp scan path with the
+        exact global masked mean."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+        from tests.helpers import lm_batch
+
+        def mk():
+            return MultiLayerNetwork(transformer_lm(
+                n_in=8, width=16, n_layers=3, n_heads=2, n_classes=8,
+                lr=1e-2, seed=5)).init()
+
+        rng = np.random.default_rng(1)
+        K = 3
+        fs, ys, lms = [], [], []
+        for _ in range(K):
+            x, y = lm_batch(rng, n=4, c=8, t=10, k=8)
+            m = np.ones((4, 10), np.float32)
+            m[0, 6:] = 0.0
+            m[2, 2:] = 0.0
+            fs.append(x); ys.append(y); lms.append(m)
+        fs, ys, lms = np.stack(fs), np.stack(ys), np.stack(lms)
+
+        mesh = make_mesh(MeshSpec({"pp": 4}))
+        ref, net = mk(), mk()
+        tr = PipelineTrainer(net, mesh, n_microbatches=2)
+        for i in range(K):
+            ref.fit(DataSet(fs[i], ys[i], features_mask=lms[i],
+                            labels_mask=lms[i]))
+        scores = tr.fit_scan(fs, ys, features_mask_stacked=lms,
+                             labels_mask_stacked=lms)
+        np.testing.assert_allclose(
+            float(scores[-1]), float(ref.score_value), rtol=1e-5)
