@@ -429,10 +429,9 @@ class ComputationGraph:
         k = int(next(iter(inputs_k.values())).shape[0])
         self.iteration += k
         self.score_value = scores[-1]
-        for listener in self.listeners:
-            n = max(1, listener.invoked_every)
-            if self.iteration // n > start // n:
-                listener.iteration_done(self, self.iteration)
+        from deeplearning4j_tpu.optimize.listeners import fire_crossed
+
+        fire_crossed(self.listeners, self, start, self.iteration)
         return scores
 
     @functools.cached_property

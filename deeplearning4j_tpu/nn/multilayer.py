@@ -413,12 +413,9 @@ class MultiLayerNetwork:
             self.iteration, sub, feats, labels, *extra, grad_scale)
         self.iteration += int(feats.shape[0])
         self.score_value = scores[-1]  # lazy device scalar, like _fit_batch
-        for listener in self.listeners:
-            n = max(1, listener.invoked_every)
-            # fire once per call iff the K-step window crossed a multiple
-            # of n (same cadence fit() would show, coalesced per call)
-            if self.iteration // n > start // n:
-                listener.iteration_done(self, self.iteration)
+        from deeplearning4j_tpu.optimize.listeners import fire_crossed
+
+        fire_crossed(self.listeners, self, start, self.iteration)
         return scores
 
     @functools.cached_property

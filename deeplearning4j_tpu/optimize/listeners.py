@@ -24,6 +24,17 @@ class IterationListener:
         raise NotImplementedError
 
 
+def fire_crossed(listeners, model, start: int, end: int) -> None:
+    """Fused K-step (fit_scan) listener cadence, shared by every scanned
+    trainer path: fire each listener once per call iff the [start, end]
+    iteration window crossed a multiple of its ``invoked_every`` — the
+    same cadence per-step fit() would show, coalesced per call."""
+    for listener in listeners:
+        n = max(1, listener.invoked_every)
+        if end // n > start // n:
+            listener.iteration_done(model, end)
+
+
 class ScoreIterationListener(IterationListener):
     """Log the score every N iterations (reference
     ScoreIterationListener.java:31)."""

@@ -822,10 +822,7 @@ class ParallelTrainer:
                 jnp.asarray(net.iteration), sub, fs, ys, fms, lms))
         net.iteration += int(fs.shape[0])
         net.score_value = scores[-1]
-        for listener in net.listeners:
-            # same crossing cadence as net.fit_scan: fire once per call
-            # iff the K-step window crossed a multiple of invoked_every
-            n = max(1, listener.invoked_every)
-            if net.iteration // n > start // n:
-                listener.iteration_done(net, net.iteration)
+        from deeplearning4j_tpu.optimize.listeners import fire_crossed
+
+        fire_crossed(net.listeners, net, start, net.iteration)
         return scores

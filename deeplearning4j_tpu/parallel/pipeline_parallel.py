@@ -539,7 +539,7 @@ class PipelineTrainer:
         return shapes
 
     # -- the jitted step ----------------------------------------------
-    def _build_step(self, feats_shape, labels_shape, scan_k=None):
+    def _build_step(self, feats_shape, labels_shape, scan=False):
         from deeplearning4j_tpu.nn.multilayer import (
             layer_reg_score,
             layer_update,
@@ -772,7 +772,7 @@ class PipelineTrainer:
                 idx, upd_branches, theta[0], grad, ustate[0], iteration)
             return new_t[None], new_u[None], st_final[None], score
 
-        if scan_k is None:
+        if not scan:
             fn = local_step
             bspec = P(dp) if dp is not None else P()
         else:
@@ -893,7 +893,7 @@ class PipelineTrainer:
                None if lms is None else lms.shape)
         if key not in self._step_cache:
             self._step_cache[key] = self._build_step(
-                fs.shape[1:], ys.shape[1:], scan_k=K)
+                fs.shape[1:], ys.shape[1:], scan=True)
         net._key, sub = jax.random.split(net._key)
         start = net.iteration
         self._theta, self._ustate, self._sstate, scores = \
@@ -904,9 +904,7 @@ class PipelineTrainer:
         net.iteration += K
         net.score_value = scores[-1]
         self._sync_to_net()
-        for listener in net.listeners:
-            # same crossing cadence as net.fit_scan
-            n = max(1, listener.invoked_every)
-            if net.iteration // n > start // n:
-                listener.iteration_done(net, net.iteration)
+        from deeplearning4j_tpu.optimize.listeners import fire_crossed
+
+        fire_crossed(net.listeners, net, start, net.iteration)
         return scores
