@@ -246,11 +246,16 @@ class ParallelTrainer:
                             f"n_heads {lc.n_heads} not divisible by mesh "
                             f"tp={T}: head sharding needs whole heads "
                             "per device")
-                    if lc.ring_axis:
+                    if lc.ring_axis and lc.ring_axis != self.sp_axis:
+                        # ring + tp COMPOSE when the ring runs over the
+                        # trainer's sp axis (2D attention parallelism:
+                        # time manual over sp, heads GSPMD-auto over
+                        # tp); a standalone ring_axis without sp_axis
+                        # has no mesh to ride.
                         raise ValueError(
-                            "ring attention (ring_axis/sp) and head-"
-                            "sharded tp are alternative attention "
-                            "layouts; configure one")
+                            "ring attention (ring_axis) composes with "
+                            "head-sharded tp only through "
+                            "ParallelTrainer(sp_axis=ring_axis)")
         if self.ep_axis:
             from deeplearning4j_tpu.nn.layers.moe import MoeDense
 
@@ -594,11 +599,11 @@ class ParallelTrainer:
                 "sp_axis supports MultiLayerNetwork only (the time-axis "
                 "shard contract is defined on the sequential layer "
                 "chain)")
-        if self.tp_axis or self.ep_axis or self.fsdp_axis:
+        if self.ep_axis or self.fsdp_axis:
             raise ValueError(
-                "sp_axis runs the step inside shard_map with replicated "
-                "params; it composes with dp but not with tp/ep/fsdp "
-                "param sharding")
+                "sp_axis composes with dp (manual batch/time axes) and "
+                "tp (params stay GSPMD-auto inside the partial-manual "
+                "shard_map), but not with ep/fsdp param sharding")
         algo = net.conf.confs[0].optimization_algo
         if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
             raise ValueError(
@@ -722,6 +727,10 @@ class ParallelTrainer:
     @functools.cached_property
     def _sp_step_fn(self):
         pspec, sspec, uspec, xspec, mspec = self._sp_specs()
+        # Manual only over (dp?, sp): any OTHER mesh axis (tp) stays
+        # GSPMD-auto inside the body, so head-sharded attention params
+        # keep their tp layout and XLA inserts the Megatron collectives
+        # around the ring — 2D/3D attention parallelism on one mesh.
         fn = shard_map(
             self._sp_body_core,
             mesh=self.mesh,
@@ -729,6 +738,7 @@ class ParallelTrainer:
                       xspec, xspec, mspec, mspec),
             out_specs=(pspec, sspec, uspec, P()),
             check_vma=False,
+            axis_names=frozenset(self._sp_axes),
         )
         return jax.jit(fn, donate_argnums=(0, 1, 2))
 
@@ -767,6 +777,7 @@ class ParallelTrainer:
             in_specs=(pspec, sspec, uspec, P(), P(), kx, kx, km, km),
             out_specs=(pspec, sspec, uspec, P()),
             check_vma=False,
+            axis_names=frozenset(self._sp_axes),
         )
         return jax.jit(fn, donate_argnums=(0, 1, 2))
 

@@ -588,7 +588,7 @@ class TestAttentionTensorParallel:
         ringy = MultiLayerNetwork(transformer_lm(
             n_in=8, width=16, n_layers=1, n_heads=8, n_classes=8,
             ring_axis="tp"))
-        with pytest.raises(ValueError, match="alternative attention"):
+        with pytest.raises(ValueError, match="sp_axis"):
             ParallelTrainer(ringy, mesh, tp_axis="tp")
 
     def test_dp_tp_fsdp_three_axis_composition(self):
