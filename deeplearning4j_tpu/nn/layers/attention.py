@@ -39,6 +39,11 @@ class MultiHeadSelfAttention(BaseRecurrentLayer):
     # [B, H, T_local, ring_block_size] instead of [.., T_local, T_local]
     # — the memory lever for LONG local shards; None = whole block
     ring_block_size: Optional[int] = None
+    # which SP schedule runs over ring_axis: "ring" (K/V ppermute hops,
+    # O(T_local) score memory) or "ulysses" (two all-to-alls swap
+    # heads<->time, full-T attention on H/P heads per device — fewer,
+    # larger collectives; needs n_heads % sp == 0)
+    sp_mode: str = "ring"
     # pallas flash-attention path: True forces it (TPU, no mask, T
     # multiple of 128 and >= 256), False forces dense, None = auto —
     # engages at T >= 2048 when T % 512 == 0 (healthy kernel blocks),
@@ -101,12 +106,30 @@ class AttentionImpl(LayerImplBase):
             if lc.ring_axis:
                 from deeplearning4j_tpu.parallel.sequence_parallel import (
                     ring_attention,
+                    ulysses_attention,
                 )
 
-                o = ring_attention(
-                    q, k, v, lc.ring_axis, causal=lc.causal,
-                    key_mask=mask, block_size=lc.ring_block_size,
-                )
+                if lc.sp_mode == "ulysses":
+                    if lc.ring_block_size:
+                        raise ValueError(
+                            "ring_block_size bounds the RING schedule's "
+                            "score memory; ulysses materializes the "
+                            "full [T, T] scores of its local heads — "
+                            "unset ring_block_size or use "
+                            "sp_mode='ring'")
+                    o = ulysses_attention(
+                        q, k, v, lc.ring_axis, causal=lc.causal,
+                        key_mask=mask,
+                    )
+                elif lc.sp_mode == "ring":
+                    o = ring_attention(
+                        q, k, v, lc.ring_axis, causal=lc.causal,
+                        key_mask=mask, block_size=lc.ring_block_size,
+                    )
+                else:
+                    raise ValueError(
+                        f"sp_mode {lc.sp_mode!r}: expected 'ring' or "
+                        "'ulysses'")
             elif _should_use_flash(lc.use_flash, q, mask):
                 o = _flash_attention(q, k, v, lc.causal)
             else:

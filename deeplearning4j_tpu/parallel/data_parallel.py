@@ -246,6 +246,13 @@ class ParallelTrainer:
                             f"n_heads {lc.n_heads} not divisible by mesh "
                             f"tp={T}: head sharding needs whole heads "
                             "per device")
+                    if (lc.ring_axis
+                            and getattr(lc, "sp_mode", "ring")
+                            == "ulysses"):
+                        raise ValueError(
+                            "ulysses sp_mode all-to-alls the HEAD axis "
+                            "over sp; it cannot compose with tp head "
+                            "sharding — use sp_mode='ring' with tp")
                     if lc.ring_axis and lc.ring_axis != self.sp_axis:
                         # ring + tp COMPOSE when the ring runs over the
                         # trainer's sp axis (2D attention parallelism:

@@ -163,6 +163,69 @@ def ring_attention(
     return o / jnp.maximum(l[..., None], 1e-20)
 
 
+def ulysses_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    key_mask: Optional[Array] = None,
+) -> Array:
+    """DeepSpeed-Ulysses-style all-to-all sequence parallelism INSIDE
+    shard_map — the OTHER standard SP scheme next to :func:`ring_attention`.
+
+    q/k/v: the LOCAL time shard [B, H, T_local, D]. Two ``all_to_all``
+    collectives swap the sharded axis: heads scatter over the ring while
+    the time axis gathers, so each device runs ordinary FULL-sequence
+    attention on H/P of the heads, then the output swaps back to
+    time-sharded. Communication is two all-to-alls of activations —
+    q/k/v stacked into ONE scatter collective plus one return swap
+    (vs P-1 K/V ppermute hops for the ring); the full [T, T] score
+    matrix of the local heads IS materialized, so Ulysses trades ring's
+    O(T_local) score memory for fewer, larger collectives — the right
+    choice when T fits on-device and the head count divides the ring.
+
+    ``key_mask`` [B, T_local]: all-gathered over the ring so padded
+    keys are excluded from the full-sequence softmax.
+    """
+    n = lax.axis_size(axis_name)
+    b, h, t, d = q.shape
+    if h % n:
+        raise ValueError(
+            f"ulysses needs n_heads ({h} local) divisible by the "
+            f"{axis_name} axis size {n}; use ring attention otherwise")
+
+    # ONE scatter collective for all three: [3, B, H, T_local, D] ->
+    # [3, B, H/P, T_global, D]
+    qkv = lax.all_to_all(
+        jnp.stack([q, k, v]), axis_name,
+        split_axis=2, concat_axis=3, tiled=True)
+    qg, kg, vg = qkv[0], qkv[1], qkv[2]
+    mask_full = (
+        None if key_mask is None
+        else lax.all_gather(
+            key_mask, axis_name, axis=1, tiled=True)  # [B, T_global]
+    )
+    tg = qg.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qg, kg) / jnp.sqrt(
+        jnp.asarray(d, qg.dtype))
+    neg = jnp.asarray(-jnp.inf, qg.dtype)
+    if causal:
+        cm = jnp.tril(jnp.ones((tg, tg), bool))
+        scores = jnp.where(cm[None, None], scores, neg)
+    if mask_full is not None:
+        scores = jnp.where(
+            mask_full[:, None, None, :] > 0, scores, neg)
+    w = jax.nn.softmax(scores, axis=-1)
+    # Guard fully-masked query rows (softmax of all -inf) against NaN.
+    if mask_full is not None:
+        w = jnp.where(jnp.isfinite(scores).any(-1, keepdims=True), w, 0.0)
+    og = jnp.einsum("bhqk,bhkd->bhqd", w, vg)
+    # [B, H/P, T_global, D] -> [B, H, T_local, D]
+    return lax.all_to_all(
+        og, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
 def make_ring_attention(
     mesh: Mesh, axis_name: str = "sp", causal: bool = True,
     masked: bool = False, block_size: Optional[int] = None,

@@ -498,3 +498,133 @@ class TestSpTpComposition:
         with pytest.raises(ValueError, match="sp_axis"):
             ParallelTrainer(
                 _transformer(ring_axis="ring"), mesh, tp_axis="tp")
+
+
+class TestUlyssesAttention:
+    """All-to-all (DeepSpeed-Ulysses) sequence parallelism: the other
+    standard SP schedule — heads scatter over the ring, time gathers,
+    full-sequence attention per device."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        from deeplearning4j_tpu.parallel.sequence_parallel import (
+            ulysses_attention,
+        )
+
+        mesh = make_mesh(MeshSpec({"sp": 4}))
+        rng = np.random.default_rng(11)
+        b, h, t, d = 2, 4, 32, 8
+        q = jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.float32)
+        spec = P(None, None, "sp", None)
+        uly = jax.jit(shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, "sp", causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False))
+        np.testing.assert_allclose(
+            np.asarray(uly(q, k, v)),
+            np.asarray(_dense_attention(q, k, v, causal)), atol=2e-5)
+
+    def test_masked_matches_dense(self):
+        from deeplearning4j_tpu.parallel.sequence_parallel import (
+            ulysses_attention,
+        )
+
+        mesh = make_mesh(MeshSpec({"sp": 4}))
+        rng = np.random.default_rng(12)
+        b, h, t, d = 2, 4, 32, 8
+        q = jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.float32)
+        mask = np.ones((b, t), np.float32)
+        mask[0, 20:] = 0.0
+        mask[1, 5:] = 0.0
+        mask = jnp.asarray(mask)
+        spec = P(None, None, "sp", None)
+        uly = jax.jit(shard_map(
+            lambda q, m: ulysses_attention(
+                q, q, q, "sp", causal=True, key_mask=m),
+            mesh=mesh, in_specs=(spec, P(None, "sp")), out_specs=spec,
+            check_vma=False))
+        out = np.asarray(uly(q, mask))
+        dscores = jnp.einsum("bhqd,bhkd->bhqk", q, q) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32))
+        dscores = jnp.where(
+            jnp.tril(jnp.ones((t, t), bool)), dscores, -jnp.inf)
+        dscores = jnp.where(mask[:, None, None, :] > 0, dscores, -jnp.inf)
+        w = jax.nn.softmax(dscores, axis=-1)
+        expected = np.asarray(jnp.einsum("bhqk,bhkd->bhqd", w, q))
+        valid_q = np.asarray(mask) > 0
+        sel = valid_q[:, None, :].repeat(h, 1)
+        np.testing.assert_allclose(out[sel], expected[sel], atol=2e-5)
+
+    def test_conf_level_ulysses_matches_single_device(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.parallel.data_parallel import (
+            ParallelTrainer,
+        )
+
+        rng = np.random.default_rng(13)
+        x, y = _lm_batch(rng, n=4, c=8, t=16, k=8)
+        ref = _transformer(ring_axis=None, seed=6)
+        net = _transformer(ring_axis="sp", seed=6)
+        for c in net.conf.confs:
+            if hasattr(c.layer, "sp_mode"):
+                c.layer.sp_mode = "ulysses"
+        mesh = make_mesh(MeshSpec({"dp": 4, "sp": 2}))
+        trainer = ParallelTrainer(net, mesh, sp_axis="sp")
+        for _ in range(3):
+            ref.fit(DataSet(x, y))
+            s = trainer.fit(DataSet(x, y))
+        np.testing.assert_allclose(s, float(ref.score_value), rtol=2e-4)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(net.params[si][name]), np.asarray(p),
+                    atol=2e-4,
+                    err_msg=f"param {si}/{name} diverged under ulysses",
+                )
+
+    def test_indivisible_heads_and_tp_compose_raise(self):
+        from deeplearning4j_tpu.parallel.data_parallel import (
+            ParallelTrainer,
+        )
+        from deeplearning4j_tpu.parallel.sequence_parallel import (
+            ulysses_attention,
+        )
+
+        mesh = make_mesh(MeshSpec({"sp": 4}))
+        q = jnp.zeros((1, 2, 16, 8), jnp.float32)  # 2 heads, sp=4
+        spec = P(None, None, "sp", None)
+        fn = shard_map(
+            lambda q: ulysses_attention(q, q, q, "sp"),
+            mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_vma=False)
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(fn)(q)
+
+        uly_net = _transformer(ring_axis="sp", seed=6)
+        for c in uly_net.conf.confs:
+            if hasattr(c.layer, "sp_mode"):
+                c.layer.sp_mode = "ulysses"
+        mesh3 = make_mesh(MeshSpec({"dp": 2, "sp": 2, "tp": 2}))
+        with pytest.raises(ValueError, match="cannot compose with tp"):
+            ParallelTrainer(uly_net, mesh3, sp_axis="sp", tp_axis="tp")
+
+    def test_ulysses_rejects_ring_block_size(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.parallel.data_parallel import (
+            ParallelTrainer,
+        )
+
+        net = _transformer(ring_axis="sp", seed=6)
+        for c in net.conf.confs:
+            if hasattr(c.layer, "sp_mode"):
+                c.layer.sp_mode = "ulysses"
+                c.layer.ring_block_size = 4
+        mesh = make_mesh(MeshSpec({"dp": 4, "sp": 2}))
+        trainer = ParallelTrainer(net, mesh, sp_axis="sp")
+        rng = np.random.default_rng(14)
+        x, y = _lm_batch(rng, n=4, c=8, t=16, k=8)
+        with pytest.raises(ValueError, match="ring_block_size"):
+            trainer.fit(DataSet(x, y))
