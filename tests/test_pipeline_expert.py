@@ -1254,3 +1254,59 @@ class TestPipelineFitScan:
                              labels_mask_stacked=lms)
         np.testing.assert_allclose(
             float(scores[-1]), float(ref.score_value), rtol=1e-5)
+
+
+class TestPipelineElasticResize:
+    def test_checkpoint_restore_across_stage_count_change(self):
+        """Elastic pp: train on 4 stages, checkpoint, restore into a
+        2-stage pipeline (half the devices died), continue training —
+        the packed stage-sharded state re-derives from the net's
+        canonical params, so resizing is restore-and-repack
+        (SURVEY §5.3: TPU elasticity = checkpoint-restart on a resized
+        mesh)."""
+        from deeplearning4j_tpu.checkpoint.manager import (
+            CheckpointManager,
+        )
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.zoo import mlp as zoo_mlp
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            PipelineTrainer,
+        )
+        import tempfile
+
+        rng = np.random.default_rng(0)
+        cls = rng.integers(0, 3, 32)
+        x = rng.normal(loc=cls[:, None] * 0.5,
+                       size=(32, 12)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[cls]
+        ds = DataSet(x, y)
+
+        net = MultiLayerNetwork(
+            zoo_mlp((12, 10, 8, 6, 3), lr=0.05, seed=2)).init()
+        big = PipelineTrainer(
+            net, make_mesh(MeshSpec({"pp": 4})), n_microbatches=2)
+        for _ in range(3):
+            s_before = big.fit(ds)
+
+        with tempfile.TemporaryDirectory() as d:
+            mgr = CheckpointManager(d, async_save=False)
+            mgr.save(3, net, score=s_before)
+            restored, _ = mgr.restore(3)
+
+        # single-device continuation is the trajectory oracle
+        oracle = restored.clone()
+        small = PipelineTrainer(
+            restored, make_mesh(MeshSpec({"pp": 2})), n_microbatches=4)
+        for _ in range(3):
+            s_small = small.fit(ds)
+            oracle.fit(ds)
+        np.testing.assert_allclose(
+            s_small, float(oracle.score_value), rtol=1e-5)
+        for si in oracle.params:
+            for name, p in oracle.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(restored.params[si][name]),
+                    np.asarray(p), atol=1e-4,
+                    err_msg=f"param {si}/{name} diverged after resize")
