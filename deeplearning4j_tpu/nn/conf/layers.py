@@ -147,7 +147,18 @@ class RnnOutputLayer(BaseOutputLayer):
 
 @dataclasses.dataclass
 class BaseRecurrentLayer(FeedForwardLayer):
-    """Reference nn/conf/layers/BaseRecurrentLayer.java."""
+    """Reference nn/conf/layers/BaseRecurrentLayer.java.
+
+    ``ring_axis``: when set and the layer runs inside a
+    sequence-parallel ``shard_map`` over that mesh axis
+    (``ParallelTrainer(sp_axis=...)``), the time dimension is sharded:
+    attention cores run the ring/Ulysses schedule and scan recurrences
+    (LSTM/GRU) run as a distributed ``sp_scan`` whose carry hops
+    device-to-device — exact full BPTT with O(T/P) activation memory
+    per device (the reference's only long-sequence device was
+    TRUNCATED BPTT; SURVEY.md §5.7)."""
+
+    ring_axis: "str | None" = None
 
 
 @register_bean("GravesLSTM")

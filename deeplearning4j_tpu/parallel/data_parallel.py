@@ -627,13 +627,17 @@ class ParallelTrainer:
                     f"layer {i}: input preprocessors reshape across the "
                     "sharded time axis and are not supported under "
                     "sp_axis")
-            if isinstance(lc, MultiHeadSelfAttention):
+            if isinstance(lc, (MultiHeadSelfAttention, L.GravesLSTM,
+                               L.GRU)):
+                # attention runs the ring/Ulysses schedule; LSTM/GRU
+                # recurrences run as distributed sp_scan (carry hops
+                # the ring) — exact full BPTT, O(T/P) memory/device
                 if lc.ring_axis != self.sp_axis:
                     raise ValueError(
-                        f"layer {i}: MultiHeadSelfAttention.ring_axis="
+                        f"layer {i}: {type(lc).__name__}.ring_axis="
                         f"{lc.ring_axis!r} must equal sp_axis="
-                        f"{self.sp_axis!r} so the attention core runs "
-                        "the ring schedule over the mesh's sp devices")
+                        f"{self.sp_axis!r} so the time axis runs "
+                        "the sp schedule over the mesh's sp devices")
             elif isinstance(lc, (L.RnnOutputLayer, MoeDense)):
                 # Per-timestep/per-token layers shard trivially. NOTE:
                 # MoeDense capacity routing becomes per-time-shard
@@ -645,8 +649,9 @@ class ParallelTrainer:
                 raise ValueError(
                     f"layer {i} ({type(lc).__name__}) is not "
                     "time-shardable: sp_axis supports "
-                    "MultiHeadSelfAttention (ring_axis=sp_axis), "
-                    "MoeDense, and RnnOutputLayer")
+                    "MultiHeadSelfAttention, GravesLSTM, and GRU "
+                    "(each with ring_axis=sp_axis), plus MoeDense and "
+                    "RnnOutputLayer")
         stateful = [
             si for si, st in (net.state or {}).items()
             if not (isinstance(st, dict) and set(st) <= {"aux_loss"})

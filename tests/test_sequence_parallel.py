@@ -628,3 +628,112 @@ class TestUlyssesAttention:
         x, y = _lm_batch(rng, n=4, c=8, t=16, k=8)
         with pytest.raises(ValueError, match="ring_block_size"):
             trainer.fit(DataSet(x, y))
+
+
+class TestRecurrentSequenceParallel:
+    """LSTM/GRU recurrences under conf-level sp: the time scan runs as a
+    distributed sp_scan (carry hops the ring) — exact full BPTT with
+    O(T/P) activation memory, where the reference's only long-sequence
+    device was TRUNCATED BPTT."""
+
+    def _rnn_net(self, kind, ring_axis=None, seed=4):
+        from deeplearning4j_tpu.nn.conf import (
+            NeuralNetConfiguration,
+            Updater,
+        )
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        lc = (L.GravesLSTM if kind == "lstm" else L.GRU)(
+            n_in=6, n_out=10, activation="tanh", ring_axis=ring_axis)
+        conf = (
+            NeuralNetConfiguration.Builder().seed(seed)
+            .learning_rate(0.05).updater(Updater.SGD)
+            .list()
+            .layer(0, lc)
+            .layer(1, L.RnnOutputLayer(
+                n_in=10, n_out=4, activation="softmax",
+                loss_function=LossFunction.MCXENT))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    @pytest.mark.parametrize("kind", ["lstm", "gru"])
+    def test_matches_single_device(self, kind):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.parallel.data_parallel import (
+            ParallelTrainer,
+        )
+
+        rng = np.random.default_rng(15)
+        x, y = _lm_batch(rng, n=4, c=6, t=16, k=4)
+        ref = self._rnn_net(kind)
+        net = self._rnn_net(kind, ring_axis="sp")
+        mesh = make_mesh(MeshSpec({"dp": 2, "sp": 4}))
+        trainer = ParallelTrainer(net, mesh, sp_axis="sp")
+        for _ in range(3):
+            ref.fit(DataSet(x, y))
+            s = trainer.fit(DataSet(x, y))
+        np.testing.assert_allclose(s, float(ref.score_value), rtol=2e-4)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(net.params[si][name]), np.asarray(p),
+                    atol=2e-4,
+                    err_msg=f"{kind} param {si}/{name} diverged",
+                )
+
+    def test_masked_lstm_matches_single_device(self):
+        """Masked variable-length sequences: mask chunks ride the sp
+        shards and the held-state semantics (h frozen through masked
+        steps) must survive the carry handoff."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.parallel.data_parallel import (
+            ParallelTrainer,
+        )
+
+        rng = np.random.default_rng(16)
+        x, y = _lm_batch(rng, n=4, c=6, t=16, k=4)
+        fm = np.ones((4, 16), np.float32)
+        fm[0, 9:] = 0.0   # ends mid-shard
+        fm[2, 3:] = 0.0   # ends in the first shard
+        lm = jnp.asarray(fm)
+        fm = jnp.asarray(fm)
+        ref = self._rnn_net("lstm")
+        net = self._rnn_net("lstm", ring_axis="sp")
+        mesh = make_mesh(MeshSpec({"sp": 4}))
+        trainer = ParallelTrainer(net, mesh, sp_axis="sp")
+        for _ in range(2):
+            ref.fit(DataSet(x, y, features_mask=fm, labels_mask=lm))
+            s = trainer.fit(
+                DataSet(x, y, features_mask=fm, labels_mask=lm))
+        np.testing.assert_allclose(s, float(ref.score_value), rtol=2e-4)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(net.params[si][name]), np.asarray(p),
+                    atol=2e-4, err_msg=f"param {si}/{name} diverged",
+                )
+
+    def test_bilstm_rejects_ring(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        conf = (
+            NeuralNetConfiguration.Builder().seed(1).learning_rate(0.05)
+            .list()
+            .layer(0, L.GravesBidirectionalLSTM(
+                n_in=6, n_out=10, activation="tanh", ring_axis="sp"))
+            .layer(1, L.RnnOutputLayer(
+                n_in=10, n_out=4, activation="softmax",
+                loss_function=LossFunction.MCXENT))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        x = np.zeros((2, 6, 8), np.float32)
+        y = np.zeros((2, 4, 8), np.float32)
+        with pytest.raises(ValueError, match="REVERSED"):
+            net.fit(x, y)
