@@ -146,10 +146,59 @@ def _cmd_train(args) -> int:
     batch = args.batch_size or len(feats)
     sets = [DataSet(feats[i:i + batch], labels[i:i + batch])
             for i in range(0, len(feats), batch)]
+    target = net
+    if args.mesh:
+        from deeplearning4j_tpu.parallel.data_parallel import (
+            ParallelTrainer,
+        )
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        try:
+            spec = {
+                k.strip(): int(v)
+                for k, v in (part.split("=")
+                             for part in args.mesh.split(","))
+            }
+        except ValueError:
+            raise SystemExit(
+                f"--mesh {args.mesh!r}: expected 'axis=N[,axis=N...]'")
+        if "dp" not in spec:
+            raise SystemExit(
+                "--mesh must include a dp axis (the batch shards over "
+                "it), e.g. 'dp=8' or 'dp=2,tp=4'")
+        # Batches shard over dp (x fsdp): drop ragged tails so every
+        # device gets an equal slice (standard data-parallel trimming).
+        div = spec["dp"] * spec.get("fsdp", 1)
+        trimmed = [ds for ds in (
+            DataSet(ds.features[:len(ds.features) // div * div],
+                    ds.labels[:len(ds.features) // div * div])
+            for ds in sets) if ds.features.shape[0] > 0]
+        dropped = (sum(s.features.shape[0] for s in sets)
+                   - sum(s.features.shape[0] for s in trimmed))
+        if not trimmed:
+            raise SystemExit(
+                f"--mesh {args.mesh!r}: every batch is smaller than the "
+                f"{div} data shards; raise --batch-size")
+        if dropped:
+            print(f"note: dropped {dropped} ragged-tail examples so "
+                  f"batches divide the {div} data shards")
+        sets = trimmed
+        target = ParallelTrainer(
+            net, make_mesh(MeshSpec(spec)),
+            tp_axis="tp" if "tp" in spec else None,
+            fsdp_axis="fsdp" if "fsdp" in spec else None,
+            ep_axis="ep" if "ep" in spec else None,
+            sp_axis="sp" if "sp" in spec else None,
+        )
     for _ in range(args.epochs):
-        net.fit(ListDataSetIterator(sets))
+        target.fit(ListDataSetIterator(sets))
     write_model(net, args.output)
-    score = net.score(DataSet(feats[:batch], labels[:batch]))
+    if target is not net:
+        # Mesh-trained nets (sp confs especially) score through the
+        # trainer's step — the last fit already computed it.
+        score = float(net.score_value)
+    else:
+        score = net.score(DataSet(feats[:batch], labels[:batch]))
     print(f"saved model to {args.output} (final score {score:.6f})")
     return 0
 
@@ -293,6 +342,12 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--epochs", type=int, default=1)
     t.add_argument("--batch-size", type=int, default=None)
     t.add_argument("--verbose", action="store_true")
+    t.add_argument(
+        "--mesh", default=None,
+        help="train over a device mesh, e.g. 'dp=8' or 'dp=2,tp=4': "
+             "axis sizes multiply to the device count; axes named "
+             "tp/fsdp/ep/sp engage the corresponding ParallelTrainer "
+             "sharding (dp shards the batch)")
     t.set_defaults(fn=_cmd_train)
 
     e = sub.add_parser("test", help="evaluate a saved model")

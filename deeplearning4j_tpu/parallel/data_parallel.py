@@ -583,10 +583,12 @@ class ParallelTrainer:
     def _validate_sp(self, net) -> None:
         """sp_axis shards the TIME axis of [N, C, T] batches over the
         mesh, so every layer must be time-shardable: attention cores run
-        the ring-attention schedule (parallel/sequence_parallel.py —
-        K/V blocks rotate over ICI via ppermute), per-timestep layers
-        (RnnOutputLayer) run on their local shard unchanged. Sequential
-        recurrences (LSTM/GRU) and cross-time preprocessors cannot."""
+        the ring/Ulysses schedule (parallel/sequence_parallel.py),
+        LSTM/GRU recurrences run as a distributed ``sp_scan`` (carry
+        hops the ring — exact full BPTT, O(T/P) memory/device), and
+        per-timestep layers (RnnOutputLayer, MoeDense) run on their
+        local shard unchanged. Bidirectional LSTM (reverse ring) and
+        cross-time preprocessors cannot."""
         from deeplearning4j_tpu.nn.conf.enums import (
             BackpropType,
             OptimizationAlgorithm,

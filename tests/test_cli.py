@@ -120,3 +120,44 @@ class TestEndToEnd:
         acc = float([ln for ln in stats.splitlines()
                      if "Accuracy" in ln][0].split()[-1])
         assert acc > 0.85
+
+
+class TestMeshTraining:
+    def test_train_with_mesh_flag(self, tmp_path, toy_csv, conf_json,
+                                  capsys):
+        """`dl4j train --mesh dp=8`: the CLI trains through
+        ParallelTrainer on a device mesh and the saved model evaluates
+        as well as the single-device run."""
+        model = str(tmp_path / "mesh_model.zip")
+        rc = main(["train", "--conf", conf_json, "--input", toy_csv,
+                   "--output", model, "--epochs", "30",
+                   "--batch-size", "40", "--mesh", "dp=8"])
+        assert rc == 0 and os.path.exists(model)
+        rc = main(["test", "--model", model, "--input", toy_csv])
+        assert rc == 0
+        stats = capsys.readouterr().out
+        acc = float([ln for ln in stats.splitlines()
+                     if "Accuracy" in ln][0].split()[-1])
+        assert acc > 0.8
+
+    def test_bad_mesh_flag_exits_clearly(self, tmp_path, toy_csv,
+                                         conf_json):
+        with pytest.raises(SystemExit, match="axis=N"):
+            main(["train", "--conf", conf_json, "--input", toy_csv,
+                  "--output", str(tmp_path / "m.zip"),
+                  "--mesh", "dp-8"])
+
+    def test_mesh_requires_dp_and_trims_ragged_tail(self, tmp_path,
+                                                    toy_csv, conf_json,
+                                                    capsys):
+        with pytest.raises(SystemExit, match="dp axis"):
+            main(["train", "--conf", conf_json, "--input", toy_csv,
+                  "--output", str(tmp_path / "m.zip"), "--mesh", "tp=8"])
+        # 120 rows, batch 50 -> sets of 50/50/20; dp=8 trims to 48/48/16
+        model = str(tmp_path / "trim_model.zip")
+        rc = main(["train", "--conf", conf_json, "--input", toy_csv,
+                   "--output", model, "--epochs", "5",
+                   "--batch-size", "50", "--mesh", "dp=8"])
+        assert rc == 0 and os.path.exists(model)
+        out = capsys.readouterr().out
+        assert "dropped 8 ragged-tail examples" in out
