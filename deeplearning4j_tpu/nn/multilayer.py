@@ -743,3 +743,24 @@ class MultiLayerNetwork:
         net.iteration = self.iteration
         net._initialized = True
         return net
+
+    def unsharded_clone(self) -> "MultiLayerNetwork":
+        """A clone with every bean's mesh-axis fields (``ring_axis``,
+        ``ep_axis``) cleared — the single-device serving/eval view of a
+        mesh-trained net. The ring/Ulysses and dense attention paths
+        (and sp_scan vs lax.scan recurrences, and all-to-all vs dense
+        MoE dispatch) are numerically equivalent (parity-tested), so
+        scores/outputs match the mesh-trained model; use this for score
+        calculators, evaluate(), or rnn_time_step, which run outside
+        the mesh.
+
+        Build it ONCE per serving/eval site and refresh weights per
+        evaluation (``serving.params = jax.tree.map(jnp.copy,
+        net.params)``; likewise ``state``) — a fresh clone per call
+        would re-jit the forward every time."""
+        net = self.clone()
+        for c in net.conf.confs:
+            for axis_field in ("ring_axis", "ep_axis"):
+                if getattr(c.layer, axis_field, None):
+                    setattr(c.layer, axis_field, None)
+        return net

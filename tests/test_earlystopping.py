@@ -113,3 +113,82 @@ class TestEarlyStopping:
         assert best is not None and latest is not None
         x = np.zeros((2, 4), np.float32)
         assert best.output(x).shape == (2, 3)
+
+
+class TestEarlyStoppingSequenceParallel:
+    def test_early_stopping_over_sp_trainer(self):
+        """ParallelEarlyStoppingTrainer drives an sp-sharded transformer:
+        training steps run on the mesh, validation scoring runs on the
+        net's unsharded_clone (ring and dense paths are numerically
+        equivalent)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterator import (
+            ListDataSetIterator,
+        )
+        from deeplearning4j_tpu.earlystopping.config import (
+            EarlyStoppingConfiguration,
+        )
+        from deeplearning4j_tpu.earlystopping.savers import (
+            InMemoryModelSaver,
+        )
+        from deeplearning4j_tpu.earlystopping.scorecalc import (
+            DataSetLossCalculator,
+        )
+        from deeplearning4j_tpu.earlystopping.terminations import (
+            MaxEpochsTerminationCondition,
+        )
+        from deeplearning4j_tpu.earlystopping.trainer import (
+            ParallelEarlyStoppingTrainer,
+        )
+        from deeplearning4j_tpu.models.zoo import transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.data_parallel import (
+            ParallelTrainer,
+        )
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+        from tests.helpers import lm_batch
+
+        rng = np.random.default_rng(0)
+        x, y = lm_batch(rng, n=4, c=8, t=16, k=8)
+        xv, yv = lm_batch(rng, n=4, c=8, t=16, k=8)
+        net = MultiLayerNetwork(transformer_lm(
+            n_in=8, width=16, n_layers=2, n_heads=2, n_classes=8,
+            lr=1e-2, ring_axis="sp")).init()
+        mesh = make_mesh(MeshSpec({"dp": 2, "sp": 4}))
+        trainer = ParallelTrainer(net, mesh, sp_axis="sp")
+
+        class UnshardedLossCalculator(DataSetLossCalculator):
+            # build the serving view once; refresh weights per eval so
+            # the dense forward jits exactly once across all epochs
+            _serving = None
+
+            def calculate_score(self, model):
+                import jax
+                import jax.numpy as jnp
+
+                if self._serving is None:
+                    self._serving = model.unsharded_clone()
+                else:
+                    self._serving.params = jax.tree.map(
+                        jnp.copy, model.params)
+                    self._serving.state = jax.tree.map(
+                        jnp.copy, model.state)
+                return super().calculate_score(self._serving)
+
+        conf = EarlyStoppingConfiguration(
+            model_saver=InMemoryModelSaver(),
+            score_calculator=UnshardedLossCalculator(
+                ListDataSetIterator([DataSet(xv, yv)])),
+            epoch_terminations=[MaxEpochsTerminationCondition(3)],
+        )
+        es = ParallelEarlyStoppingTrainer(
+            conf, trainer, ListDataSetIterator([DataSet(x, y)]))
+        result = es.fit()
+        assert result.total_epochs == 3
+        assert np.isfinite(result.best_model_score)
+        best = result.best_model
+        assert best is not None
+        # the saved best model evaluates WITHOUT the mesh
+        s = best.unsharded_clone().score(DataSet(xv, yv))
+        np.testing.assert_allclose(s, result.best_model_score,
+                                   rtol=1e-5)
