@@ -266,6 +266,62 @@ def transformer_lm(
     return b.remat(remat).build()
 
 
+def transformer_lm_flagship(
+    vocab: int = 64,
+    width: int = 1024,
+    n_layers: int = 8,
+    n_heads: int = 16,
+    lr: float = 3e-4,
+    warmup_steps: int = 100,
+    total_steps: int = 1000,
+    seed: int = 12345,
+    remat: bool = False,
+    ring_axis=None,
+):
+    """The convergence-grade flagship: pre-LN TransformerBlock stack
+    (attention + 4x FFN + residuals, nn/layers/attention.py) with Adam
+    and linear-warmup + cosine lr decay. Unlike the bare-attention
+    ``transformer_lm`` (which diverges at width >= 1024 under any flat
+    lr — BENCHMARKS.md flagship section), this configuration trains
+    stably at MXU-filling widths; bench.py gates it against the
+    analytic Markov entropy floor (datasets/markov.py) at >= 40% MFU.
+    """
+    from deeplearning4j_tpu.nn.layers.attention import TransformerBlock
+
+    b = (
+        NeuralNetConfiguration.Builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .lr_policy("warmup_cosine")
+        .lr_warmup_steps(warmup_steps)
+        .lr_total_steps(total_steps)
+        .updater(Updater.ADAM)
+        .activation("identity")
+        .weight_init(WeightInit.XAVIER)
+        .list()
+    )
+    for i in range(n_layers):
+        b.layer(
+            i,
+            TransformerBlock(
+                n_in=vocab if i == 0 else width,
+                n_out=width,
+                n_heads=n_heads,
+                causal=True,
+                ring_axis=ring_axis,
+            ),
+        )
+    b.layer(n_layers, L.LayerNormalization(n_in=width, n_out=width))
+    b.layer(
+        n_layers + 1,
+        L.RnnOutputLayer(
+            n_in=width, n_out=vocab, activation="softmax",
+            loss_function=LossFunction.MCXENT,
+        ),
+    )
+    return b.remat(remat).build()
+
+
 def moe_transformer_lm(
     n_in: int = 64,
     width: int = 128,

@@ -88,7 +88,7 @@ def setup_shapes(conf, input_type: InputType) -> None:
 
 
 def _boundary_preprocessor(cur: InputType, lc: L.Layer):
-    if isinstance(lc, L.BatchNormalization):
+    if isinstance(lc, (L.BatchNormalization, L.LayerNormalization)):
         return None  # shape-preserving in every representation
     wants_cnn = isinstance(lc, (L.ConvolutionLayer, L.SubsamplingLayer,
                                 L.LocalResponseNormalization))
@@ -179,7 +179,10 @@ def _fill_and_advance(lc: L.Layer, cur: InputType) -> InputType:
         )
     if isinstance(lc, L.LocalResponseNormalization):
         return cur
-    if isinstance(lc, L.BatchNormalization):
+    if isinstance(lc, (L.BatchNormalization, L.LayerNormalization)):
+        # Pure normalizers: representation-preserving (the input type
+        # passes through unchanged — no FF coercion of recurrent/CNN
+        # activations).
         if isinstance(cur, InputTypeConvolutional):
             if not lc.n_in:
                 lc.n_in = cur.channels

@@ -252,6 +252,22 @@ class LocalResponseNormalization(Layer):
     beta: float = 0.75
 
 
+@register_bean("LayerNormalization")
+@dataclasses.dataclass
+class LayerNormalization(FeedForwardLayer):
+    """Per-example LayerNorm over the channel axis (TPU-native addition
+    — the reference's only normalizations are batch-level
+    BatchNormalization.java and LRN; transformer stacks need the
+    batch-independent variant). Works on [N, C] and [N, C, T]
+    activations; ``n_in == n_out`` (a pure normalizer). The standard
+    final-norm for pre-LN transformer stacks: without it the residual
+    stream reaches the output head at depth-growing magnitude (measured:
+    width-1024 x 8 init loss 9.1 vs ln V = 4.16 — BENCHMARKS.md
+    flagship section)."""
+
+    eps: float = 1e-5
+
+
 @register_bean("BatchNormalization")
 @dataclasses.dataclass
 class BatchNormalization(FeedForwardLayer):

@@ -45,6 +45,18 @@ class NeuralNetConfiguration:
     bias_init: float = 0.0
     learning_rate: float = 1e-1
     learning_rate_schedule: Optional[Dict[int, float]] = None
+    # Smooth lr policy (TPU-native addition; the reference only has the
+    # piecewise ``learningRateAfter`` map above): "warmup_cosine" ramps
+    # linearly from 0 over ``lr_warmup_steps`` then follows a cosine to
+    # ``lr_min_fraction``*lr at ``lr_total_steps`` — the standard
+    # schedule for transformer convergence at width >= 1024, where a
+    # flat lr diverges (BENCHMARKS.md flagship section). Mutually
+    # exclusive with learning_rate_schedule. jit-safe: pure jnp ops on
+    # the iteration counter.
+    lr_policy: Optional[str] = None
+    lr_warmup_steps: int = 0
+    lr_total_steps: int = 0
+    lr_min_fraction: float = 0.1
     momentum: float = 0.5
     momentum_schedule: Optional[Dict[int, float]] = None
     l1: float = 0.0

@@ -34,6 +34,7 @@ _IMPLS = {
     L.ConvolutionLayer: convolution.ConvolutionImpl,
     L.SubsamplingLayer: convolution.SubsamplingImpl,
     L.LocalResponseNormalization: normalization.LRNImpl,
+    L.LayerNormalization: normalization.LayerNormImpl,
     L.BatchNormalization: normalization.BatchNormImpl,
     L.GravesLSTM: recurrent.LSTMImpl,
     L.ImageLSTM: recurrent.ImageLSTMImpl,
@@ -44,6 +45,7 @@ _IMPLS = {
     L.AutoEncoder: pretrain.AutoEncoderImpl,
     L.RecursiveAutoEncoder: pretrain.RecursiveAutoEncoderImpl,
     attention.MultiHeadSelfAttention: attention.AttentionImpl,
+    attention.TransformerBlock: attention.TransformerBlockImpl,
     moe.MoeDense: moe.MoeDenseImpl,
 }
 

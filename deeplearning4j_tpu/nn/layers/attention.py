@@ -94,58 +94,7 @@ class AttentionImpl(LayerImplBase):
         q = split_heads(params["Wq"])
         k = split_heads(params["Wk"])
         v = split_heads(params["Wv"])
-
-        if state is not None:
-            # Streaming continuation (rnn_time_step): attend over the
-            # carried KV cache + this chunk — the attention analogue of
-            # the LSTM carried (h, c) (reference BaseRecurrentLayer
-            # stateMap). Always causal (the future is unwritten when
-            # decoding); masks don't apply (reference streams unmasked).
-            o, state = cls._stream_attend(lc, q, k, v, state)
-        else:
-            if lc.ring_axis:
-                from deeplearning4j_tpu.parallel.sequence_parallel import (
-                    ring_attention,
-                    ulysses_attention,
-                )
-
-                if lc.sp_mode == "ulysses":
-                    if lc.ring_block_size:
-                        raise ValueError(
-                            "ring_block_size bounds the RING schedule's "
-                            "score memory; ulysses materializes the "
-                            "full [T, T] scores of its local heads — "
-                            "unset ring_block_size or use "
-                            "sp_mode='ring'")
-                    o = ulysses_attention(
-                        q, k, v, lc.ring_axis, causal=lc.causal,
-                        key_mask=mask,
-                    )
-                elif lc.sp_mode == "ring":
-                    o = ring_attention(
-                        q, k, v, lc.ring_axis, causal=lc.causal,
-                        key_mask=mask, block_size=lc.ring_block_size,
-                    )
-                else:
-                    raise ValueError(
-                        f"sp_mode {lc.sp_mode!r}: expected 'ring' or "
-                        "'ulysses'")
-            elif _should_use_flash(lc.use_flash, q, mask):
-                o = _flash_attention(q, k, v, lc.causal)
-            else:
-                o = _dense_attention(q, k, v, lc.causal, mask)
-            if not train and not lc.ring_axis:
-                # Prefill: expose the (right-aligned, fixed-size) KV
-                # cache so a later rnn_time_step call continues this
-                # context. Under output()/evaluate the returned rnn
-                # state is discarded, so XLA dead-code-eliminates the
-                # cache build; training (train=True) never creates it —
-                # tBPTT windows stay independent, as without a cache.
-                # (Built for non-causal layers too so that a SECOND
-                # streaming call reaches _stream_attend's explicit
-                # cannot-stream error instead of silently attending
-                # chunk-locally.)
-                state = cls._prefill_cache(lc, k, v)
+        o, state = cls._attend_core(lc, q, k, v, state, train, mask)
 
         o = jnp.transpose(o, (0, 2, 1, 3)).reshape(
             o.shape[0], o.shape[2], d
@@ -156,6 +105,66 @@ class AttentionImpl(LayerImplBase):
         if mask is not None:
             out = out * mask[:, None, :]
         return out, state
+
+    @classmethod
+    def _attend_core(cls, lc, q, k, v, state, train, mask):
+        """Attention-core dispatch on [N, H, T, dh] q/k/v, shared with
+        TransformerBlockImpl: streaming continuation, ring/Ulysses
+        sequence parallelism, pallas flash, or dense — plus the serving
+        KV-cache prefill."""
+        if state is not None:
+            # Streaming continuation (rnn_time_step): attend over the
+            # carried KV cache + this chunk — the attention analogue of
+            # the LSTM carried (h, c) (reference BaseRecurrentLayer
+            # stateMap). Always causal (the future is unwritten when
+            # decoding); masks don't apply (reference streams unmasked).
+            return cls._stream_attend(lc, q, k, v, state)
+        if lc.ring_axis:
+            from deeplearning4j_tpu.parallel.sequence_parallel import (
+                ring_attention,
+                ulysses_attention,
+            )
+
+            if lc.sp_mode == "ulysses":
+                if lc.ring_block_size:
+                    raise ValueError(
+                        "ring_block_size bounds the RING schedule's "
+                        "score memory; ulysses materializes the "
+                        "full [T, T] scores of its local heads — "
+                        "unset ring_block_size or use "
+                        "sp_mode='ring'")
+                o = ulysses_attention(
+                    q, k, v, lc.ring_axis, causal=lc.causal,
+                    key_mask=mask,
+                )
+            elif lc.sp_mode == "ring":
+                o = ring_attention(
+                    q, k, v, lc.ring_axis, causal=lc.causal,
+                    key_mask=mask, block_size=lc.ring_block_size,
+                )
+            else:
+                raise ValueError(
+                    f"sp_mode {lc.sp_mode!r}: expected 'ring' or "
+                    "'ulysses'")
+            return o, None
+        if _should_use_flash(lc.use_flash, q, mask):
+            o = _flash_attention(q, k, v, lc.causal)
+        else:
+            o = _dense_attention(q, k, v, lc.causal, mask)
+        new_state = None
+        if not train:
+            # Prefill: expose the (right-aligned, fixed-size) KV
+            # cache so a later rnn_time_step call continues this
+            # context. Under output()/evaluate the returned rnn
+            # state is discarded, so XLA dead-code-eliminates the
+            # cache build; training (train=True) never creates it —
+            # tBPTT windows stay independent, as without a cache.
+            # (Built for non-causal layers too so that a SECOND
+            # streaming call reaches _stream_attend's explicit
+            # cannot-stream error instead of silently attending
+            # chunk-locally.)
+            new_state = cls._prefill_cache(lc, k, v)
+        return o, new_state
 
     # -- rnn_time_step streaming (fixed-size sliding KV cache) ---------
     @classmethod
@@ -215,6 +224,124 @@ class AttentionImpl(LayerImplBase):
         o = jnp.einsum("bhqk,bhkd->bhqd", w, ev)
         return o, {"k": ek[:, :, -tm:, :], "v": ev[:, :, -tm:, :],
                    "filled": filled}
+
+
+@register_bean("TransformerBlock")
+@dataclasses.dataclass
+class TransformerBlock(BaseRecurrentLayer):
+    """Conf bean: a full pre-LN transformer block — LayerNorm →
+    multi-head self-attention → residual, then LayerNorm → FFN
+    (``ffn_mult``× inner width, gelu) → residual.
+
+    This is the convergence-grade building unit the bare
+    ``MultiHeadSelfAttention`` stack lacks: without the residual path
+    and pre-LN, width ≥ 1024 stacks diverge at any useful lr (measured,
+    BENCHMARKS.md flagship section), which is the standard
+    transformer-training result. NEW capability vs the 2015 reference
+    (predates attention; SURVEY.md §5.7 mandates first-class
+    long-context), layered on the framework's [N, C, T] recurrent
+    layout so it composes with RnnOutputLayer and the sp/pp/tp
+    parallel trainers.
+
+    When ``n_in != n_out`` the block first applies a learned input
+    projection (no residual across it — the standard embed step);
+    homogeneous interior blocks (n_in == n_out) are pure residual and
+    therefore stackable under the pipeline trainer's homogeneous-stage
+    mode."""
+
+    n_heads: int = 4
+    causal: bool = True
+    ffn_mult: int = 4
+    ffn_activation: str = "gelu"
+    ring_axis: Optional[str] = None
+    ring_block_size: Optional[int] = None
+    sp_mode: str = "ring"
+    use_flash: Optional[bool] = None
+    stream_max_t: int = 512
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    from deeplearning4j_tpu.nn.layers.normalization import layer_norm
+
+    return layer_norm(x, g, b, axis=-1, eps=eps)
+
+
+class TransformerBlockImpl(LayerImplBase):
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        lc = conf.layer
+        d_in, d = lc.n_in, lc.n_out
+        dff = lc.ffn_mult * d
+        kq, kk, kv, ko, k1, k2, ki = jax.random.split(key, 7)
+        scheme = conf.resolved("weight_init")
+        dist = conf.resolved("dist")
+        p = {
+            "ln1_g": jnp.ones((d,), dtype),
+            "ln1_b": jnp.zeros((d,), dtype),
+            "Wq": init_weights(kq, (d, d), scheme, dist, dtype),
+            "Wk": init_weights(kk, (d, d), scheme, dist, dtype),
+            "Wv": init_weights(kv, (d, d), scheme, dist, dtype),
+            "Wo": init_weights(ko, (d, d), scheme, dist, dtype),
+            "bo": jnp.zeros((d,), dtype),
+            "ln2_g": jnp.ones((d,), dtype),
+            "ln2_b": jnp.zeros((d,), dtype),
+            "W1": init_weights(k1, (d, dff), scheme, dist, dtype),
+            "b1": jnp.zeros((dff,), dtype),
+            "W2": init_weights(k2, (dff, d), scheme, dist, dtype),
+            "b2": jnp.zeros((d,), dtype),
+        }
+        if d_in != d:
+            p["Wi"] = init_weights(ki, (d_in, d), scheme, dist, dtype)
+        return p
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None,
+              mask=None):
+        from deeplearning4j_tpu.ops.activations import activation
+
+        lc = conf.layer
+        h, d = lc.n_heads, lc.n_out
+        if d % h:
+            raise ValueError(f"n_out {d} not divisible by n_heads {h}")
+        dh = d // h
+        x = cls.maybe_dropout(conf, x, train, rng)
+        xt = jnp.transpose(x, (0, 2, 1))  # [N, T, C]
+        if "Wi" in params:
+            xt = xt @ params["Wi"]
+
+        hn = _layer_norm(xt, params["ln1_g"], params["ln1_b"])
+
+        def split_heads(m):
+            y = hn @ m  # [N, T, D]
+            return jnp.transpose(
+                y.reshape(y.shape[0], y.shape[1], h, dh), (0, 2, 1, 3)
+            )  # [N, H, T, dh]
+
+        q = split_heads(params["Wq"])
+        k = split_heads(params["Wk"])
+        v = split_heads(params["Wv"])
+        o, state = AttentionImpl._attend_core(
+            lc, q, k, v, state, train, mask)
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(
+            o.shape[0], o.shape[2], d)  # [N, T, D]
+        xt = xt + (o @ params["Wo"] + params["bo"])
+
+        h2 = _layer_norm(xt, params["ln2_g"], params["ln2_b"])
+        ffn = activation(lc.ffn_activation)(
+            h2 @ params["W1"] + params["b1"])
+        xt = xt + (ffn @ params["W2"] + params["b2"])
+
+        out = jnp.transpose(xt, (0, 2, 1))  # [N, D, T]
+        if mask is not None:
+            out = out * mask[:, None, :]
+        return out, state
+
+
+# Beans carrying the shared attention-core options (n_heads, causal,
+# ring_axis/sp_mode, use_flash, stream_max_t). Parallel trainers
+# dispatch on this tuple, not the concrete classes, so both stay
+# covered by tp head-sharding, sp ring validation, etc.
+ATTENTION_BEANS = (MultiHeadSelfAttention, TransformerBlock)
 
 
 def guard_streamable(named_layer_beans) -> None:

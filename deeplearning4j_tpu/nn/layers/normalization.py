@@ -61,6 +61,41 @@ class BatchNormImpl(LayerImplBase):
         return out, new_state
 
 
+def layer_norm(x, g, b, axis: int = -1, eps: float = 1e-5):
+    """LayerNorm over ``axis``; moments at >= f32 so the bf16 compute
+    path keeps a stable normalizer (promote, don't hard-cast — the f64
+    gradient-check path must stay f64). Shared by LayerNormImpl (axis 1
+    on [N, C, T]) and TransformerBlockImpl (trailing axis on [N, T, C]).
+    """
+    ct = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(ct)
+    mu = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + jnp.asarray(eps, ct))
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    return (y * g.astype(ct).reshape(shape)
+            + b.astype(ct).reshape(shape)).astype(x.dtype)
+
+
+class LayerNormImpl(LayerImplBase):
+    """Per-example LayerNorm over the channel axis (conf bean
+    LayerNormalization); works on [N, C] and [N, C, T]."""
+
+    @classmethod
+    def init(cls, key, conf, dtype=jnp.float32) -> dict:
+        lc = conf.layer
+        n = lc.n_out or lc.n_in
+        return {"g": jnp.ones((n,), dtype), "b": jnp.zeros((n,), dtype)}
+
+    @classmethod
+    def apply(cls, conf, params, x, state=None, train=False, rng=None,
+              mask=None):
+        lc = conf.layer
+        y = layer_norm(x, params["g"], params["b"], axis=1, eps=lc.eps)
+        return y, None
+
+
 class LRNImpl(LayerImplBase):
     """Across-channel local response normalization (reference
     LocalResponseNormalization.java):

@@ -145,13 +145,33 @@ def make_layer_updater(conf) -> LayerUpdater:
 
 def resolve_lr(conf, iteration):
     """Learning rate with optional integer-keyed schedule (reference
-    ``learningRateAfter`` map semantics). jit-safe: the schedule dict is
-    static; the lookup compiles to nested selects."""
-    return _resolve_schedule(
-        float(conf.resolved("learning_rate")),
-        conf.learning_rate_schedule,
-        iteration,
-    )
+    ``learningRateAfter`` map semantics) or smooth lr_policy. jit-safe:
+    the schedule dict/policy constants are static; the lookup compiles
+    to selects / a closed-form cosine on the iteration counter."""
+    base = float(conf.resolved("learning_rate"))
+    policy = getattr(conf, "lr_policy", None)
+    if policy:
+        if conf.learning_rate_schedule:
+            raise ValueError(
+                "lr_policy and learning_rate_schedule are mutually "
+                "exclusive")
+        if policy != "warmup_cosine":
+            raise ValueError(
+                f"unknown lr_policy {policy!r} (known: 'warmup_cosine')")
+        warm = int(conf.lr_warmup_steps)
+        total = int(conf.lr_total_steps)
+        if total <= warm:
+            raise ValueError(
+                f"lr_policy='warmup_cosine' needs lr_total_steps "
+                f"({total}) > lr_warmup_steps ({warm}) — an unset "
+                "horizon would silently train at the min-fraction floor")
+        frac = float(conf.lr_min_fraction)
+        it = jnp.asarray(iteration, jnp.float32)
+        ramp = jnp.minimum(it / warm, 1.0) if warm > 0 else 1.0
+        prog = jnp.clip((it - warm) / (total - warm), 0.0, 1.0)
+        cos = frac + (1.0 - frac) * 0.5 * (1.0 + jnp.cos(jnp.pi * prog))
+        return base * ramp * cos
+    return _resolve_schedule(base, conf.learning_rate_schedule, iteration)
 
 
 def normalize_gradients(

@@ -44,6 +44,7 @@ ACTIVATIONS: dict[str, Callable[[Array], Array]] = {
     "tanh": jnp.tanh,
     "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
     "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
     "leakyrelu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
     "elu": jax.nn.elu,
     "softplus": jax.nn.softplus,

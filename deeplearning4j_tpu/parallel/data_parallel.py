@@ -67,6 +67,7 @@ def tp_param_specs(net, mesh_axis: str = "tp"):
     arbitrary graph DAG lacks."""
     from deeplearning4j_tpu.nn.layers.attention import (
         MultiHeadSelfAttention,
+        TransformerBlock,
     )
 
     if hasattr(net, "_layer_vertices"):
@@ -87,6 +88,20 @@ def tp_param_specs(net, mesh_axis: str = "tp"):
             layer_specs["Wv"] = P(None, mesh_axis)
             layer_specs["Wo"] = P(mesh_axis, None)
             layer_specs["b"] = P()
+        elif isinstance(lc, TransformerBlock):
+            # Megatron block sharding: attention heads column-sharded
+            # (as above), FFN W1 column / W2 row — the two all-reduces
+            # per block land after Wo and W2. LayerNorm params, biases,
+            # and the tiny input projection Wi stay replicated (LN
+            # normalizes the full channel axis; sharding it would cost
+            # a per-token collective for ~2*d floats of savings).
+            layer_specs["Wq"] = P(None, mesh_axis)
+            layer_specs["Wk"] = P(None, mesh_axis)
+            layer_specs["Wv"] = P(None, mesh_axis)
+            layer_specs["Wo"] = P(mesh_axis, None)
+            layer_specs["W1"] = P(None, mesh_axis)
+            layer_specs["b1"] = P(mesh_axis)
+            layer_specs["W2"] = P(mesh_axis, None)
         elif isinstance(lc, (L.DenseLayer,)) and not isinstance(
             lc, L.OutputLayer
         ):
@@ -235,12 +250,12 @@ class ParallelTrainer:
                 "and ep axes")
         if self.tp_axis:
             from deeplearning4j_tpu.nn.layers.attention import (
-                MultiHeadSelfAttention,
+                ATTENTION_BEANS,
             )
 
             T = int(mesh.shape[self.tp_axis])
             for _, lc in _layer_items(net):
-                if isinstance(lc, MultiHeadSelfAttention):
+                if isinstance(lc, ATTENTION_BEANS):
                     if lc.n_heads % T:
                         raise ValueError(
                             f"n_heads {lc.n_heads} not divisible by mesh "
@@ -594,7 +609,7 @@ class ParallelTrainer:
             OptimizationAlgorithm,
         )
         from deeplearning4j_tpu.nn.layers.attention import (
-            MultiHeadSelfAttention,
+            ATTENTION_BEANS,
         )
         from deeplearning4j_tpu.nn.layers.moe import MoeDense
 
@@ -629,8 +644,7 @@ class ParallelTrainer:
                     f"layer {i}: input preprocessors reshape across the "
                     "sharded time axis and are not supported under "
                     "sp_axis")
-            if isinstance(lc, (MultiHeadSelfAttention, L.GravesLSTM,
-                               L.GRU)):
+            if isinstance(lc, ATTENTION_BEANS + (L.GravesLSTM, L.GRU)):
                 # attention runs the ring/Ulysses schedule; LSTM/GRU
                 # recurrences run as distributed sp_scan (carry hops
                 # the ring) — exact full BPTT, O(T/P) memory/device
@@ -640,7 +654,8 @@ class ParallelTrainer:
                         f"{lc.ring_axis!r} must equal sp_axis="
                         f"{self.sp_axis!r} so the time axis runs "
                         "the sp schedule over the mesh's sp devices")
-            elif isinstance(lc, (L.RnnOutputLayer, MoeDense)):
+            elif isinstance(lc, (L.RnnOutputLayer, MoeDense,
+                                 L.LayerNormalization)):
                 # Per-timestep/per-token layers shard trivially. NOTE:
                 # MoeDense capacity routing becomes per-time-shard
                 # (each device routes its local tokens against its own
@@ -651,9 +666,10 @@ class ParallelTrainer:
                 raise ValueError(
                     f"layer {i} ({type(lc).__name__}) is not "
                     "time-shardable: sp_axis supports "
-                    "MultiHeadSelfAttention, GravesLSTM, and GRU "
-                    "(each with ring_axis=sp_axis), plus MoeDense and "
-                    "RnnOutputLayer")
+                    "MultiHeadSelfAttention, TransformerBlock, "
+                    "GravesLSTM, and GRU (each with "
+                    "ring_axis=sp_axis), plus MoeDense, "
+                    "LayerNormalization, and RnnOutputLayer")
         stateful = [
             si for si, st in (net.state or {}).items()
             if not (isinstance(st, dict) and set(st) <= {"aux_loss"})
