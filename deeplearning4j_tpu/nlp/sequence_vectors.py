@@ -352,8 +352,18 @@ class SequenceVectors:
             msk = cmask[centers]  # [B, L]
             w = syn1[pts]  # [B, L, D]
             dot = jnp.einsum("bld,bd->bl", w, h)
-            # p(code) via sigmoid; gradient of -log-likelihood:
+            # p(code) via sigmoid; gradient of -log-likelihood. The
+            # MAX_EXP=6 clamp mirrors the reference's exp-table range
+            # (InMemoryLookupTable.iterateSample skips HS updates whose
+            # logit falls outside the table): besides fidelity it is
+            # the stability brake for BATCHED scatter-adds — without
+            # it, hot Huffman roots accumulate thousands of same-sign
+            # stale-value updates per batch on real-text frequency
+            # distributions and the tables diverge to NaN (measured on
+            # the bundled raw_sentences corpus; zipf-synthetic runs
+            # were too short to develop it).
             g = (1.0 - cds - _sigmoid(dot)) * msk  # [B, L]
+            g = g * (jnp.abs(dot) < 6.0)
             dh = jnp.einsum("bl,bld->bd", g, w)  # accumulate into syn0
             dw = jnp.einsum("bl,bd->bld", g, h)  # into syn1 rows
             syn0 = syn0.at[contexts].add(lr * dh)
@@ -405,8 +415,20 @@ class SequenceVectors:
             wneg = syn1neg[negs]  # [B, K, D]
             dot_pos = jnp.sum(pos * h, axis=-1)  # [B]
             dot_neg = jnp.einsum("bkd,bd->bk", wneg, h)
-            g_pos = 1.0 - _sigmoid(dot_pos)  # label 1
-            g_neg = -_sigmoid(dot_neg)  # label 0
+            # The reference saturates NS gradients outside the
+            # exp-table range (iterateSample: g = (label-1)*alpha /
+            # (label-0)*alpha at |f| > MAX_EXP) rather than skipping.
+            # Under BATCHED scatter-adds saturation is not a brake —
+            # sustained +/-1 gradients on hot rows (high-frequency
+            # negatives) accumulate stale-value updates until the
+            # tables overflow (measured NaN on the bundled
+            # raw_sentences corpus). We therefore zero updates outside
+            # the table range for NS as well — a documented deviation
+            # with the same fixed-range rationale as the table itself.
+            in_rng_pos = jnp.abs(dot_pos) < 6.0
+            in_rng_neg = jnp.abs(dot_neg) < 6.0
+            g_pos = (1.0 - _sigmoid(dot_pos)) * in_rng_pos  # label 1
+            g_neg = -_sigmoid(dot_neg) * in_rng_neg  # label 0
             # Exclude accidental positives: the reference's iterateSample
             # skips sampled negatives equal to the target word.
             g_neg = g_neg * (negs != centers[:, None]).astype(g_neg.dtype)

@@ -41,6 +41,9 @@ def main():
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     V, T, B = args.vocab, args.seq, args.batch
+    if args.pool_seqs % B or args.epochs < 2:
+        raise SystemExit("--pool-seqs must be divisible by --batch and "
+                         "--epochs >= 2 (epoch 0 is the compile epoch)")
     K = args.pool_seqs // B
     steps_per_epoch = K
     total = args.epochs * steps_per_epoch
