@@ -1,68 +1,110 @@
-"""Benchmark driver: prints one JSON line per BASELINE config; the final
-line is the headline row the round harness parses.
+"""Benchmark driver: prints one JSON line per config; the final line is
+the headline row the round harness parses.
 
-Configs (BASELINE.json):
-- configs[1] — LeNet-5 on MNIST, the reference's im2col+GEMM conv path
-  (reference nn/layers/convolution/ConvolutionLayer.java:135) as MXU
-  convolutions.
-- configs[0] — MLP 784-500-10 on MNIST, the reference's
-  MultiLayerNetwork.fit hot loop (reference nn/multilayer/
-  MultiLayerNetwork.java:1130). This is the headline (printed last).
+Round-4 protocol (VERDICT items 1, 4, 5, 8):
 
-Metric: training examples/sec/chip, plus an analytic MFU estimate
-(model FLOPs / v5e peak bf16 ~197 TFLOP/s) so the harness tracks
-efficiency, not just throughput.
+- **Interleaved median-of-N trials.** Every throughput row runs N >= 3
+  timed trials; the fit_scan family is interleaved round-robin across
+  configs so shared-tunnel contention hits all configs alike instead of
+  whichever ran last. Rows emit ``{"value": median, "spread":
+  [min, max], "trials": N}`` — round-over-round deltas can finally be
+  told apart from transport noise.
+- **Converging flagship.** ``transformer_lm_flagship`` (width 1024 x 8
+  pre-LN blocks) trains on the Markov-chain task (datasets/markov.py)
+  whose optimal loss is the analytic conditional entropy; the row
+  carries BOTH mfu >= 0.40 and a held-out convergence gate — the same
+  run utilizes and converges (round-3 VERDICT's top ask).
+- **All five BASELINE configs.** MLP, LeNet (+wide-CNN control with a
+  real accuracy gate), Word2Vec words/sec with a semantic-quality gate
+  on the bundled REAL corpus, DBN pretrain+finetune, and the dp
+  allreduce step-time decomposition (subprocess on the 8-virtual-device
+  mesh — multi-chip hardware is not tunneled here).
+- **Real-data accuracy.** When the bundled fixtures exist (they ship
+  in-package), MLP accuracy is also measured on 200 REAL MNIST digits
+  and on sklearn's 1,797 real digit images; the synthetic-MNIST gate
+  remains for throughput-path parity with earlier rounds.
 
-``vs_baseline`` compares against an ESTIMATED reference figure: the
-reference publishes no numbers (BASELINE.md), so we use 3000 examples/sec
-as a generous stand-in for 2015-era nd4j-native CPU throughput on this
-config; the real floor will be measured when the harness provides one.
+``vs_baseline`` compares against ESTIMATED reference figures (the
+reference publishes no numbers — BASELINE.md): 3000 ex/s for the MLP,
+500 ex/s for conv nets, 2015-era nd4j-native CPU stand-ins.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 REFERENCE_CPU_EXAMPLES_PER_SEC = 3000.0  # estimated; none published
-# A CPU conv net is far slower than the MLP: LeNet is ~5.8x the
-# FLOPs/example and im2col+GEMM on 2015 nd4j-native has no MXU to
-# amortize it, so use a proportionally scaled stand-in.
 REFERENCE_CPU_LENET_EXAMPLES_PER_SEC = 500.0  # estimated; none published
+# Hogwild 2015 CPU Word2Vec: ~100k words/s on many cores (estimated).
+REFERENCE_CPU_W2V_WORDS_PER_SEC = 100_000.0
 V5E_PEAK_BF16_FLOPS = 197e12  # TPU v5e peak bf16 FLOP/s (public spec)
-# BASELINE.md parity gate (SURVEY §7 stage 5): rows with an accuracy
-# field must train to at least this held-out accuracy; a miss prints to
-# stderr and exits non-zero (stdout rows still emit for the driver).
 ACCURACY_GATE = 0.97
 _GATE_FAILED = False
+
+
+def _fail_gate(msg: str) -> None:
+    global _GATE_FAILED
+    print(f"GATE FAILED: {msg}", file=sys.stderr)
+    _GATE_FAILED = True
+
+
+def _sync(x) -> float:
+    # a value fetch (not just block_until_ready) is the only reliable
+    # sync point across PJRT transports (BENCHMARKS.md measurement notes)
+    return float(np.asarray(x))
+
 
 # Train-step FLOPs/example ~= 3x forward (fwd + bwd-activations +
 # bwd-weights), matmul/conv MACs only.
 MLP_FLOPS_PER_EXAMPLE = 3 * 2 * (784 * 500 + 500 * 10)
 LENET_FLOPS_PER_EXAMPLE = 3 * 2 * (
-    20 * 5 * 5 * 1 * 24 * 24      # conv1: 1->20ch, 24x24 out
-    + 50 * 5 * 5 * 20 * 8 * 8     # conv2: 20->50ch, 8x8 out
-    + 800 * 500                   # dense
-    + 500 * 10                    # output
+    20 * 5 * 5 * 1 * 24 * 24
+    + 50 * 5 * 5 * 20 * 8 * 8
+    + 800 * 500
+    + 500 * 10
 )
-# wide_cnn (models/zoo.py): CIFAR-scale 3x3 convs at 64/128 channels —
-# the conv-MFU control experiment (VERDICT r2 item 3): contractions
-# sized for the 128x128 MXU, same conv machinery as LeNet.
 WIDE_CNN_FLOPS_PER_EXAMPLE = 3 * 2 * (
-    9 * 3 * 64 * 32 * 32          # conv 3->64, 32x32 (same pad)
-    + 9 * 64 * 64 * 32 * 32       # conv 64->64
-    + 9 * 64 * 128 * 16 * 16      # conv 64->128 after pool
-    + 9 * 128 * 128 * 16 * 16     # conv 128->128
-    + 128 * 8 * 8 * 256           # dense
-    + 256 * 10                    # output
+    9 * 3 * 64 * 32 * 32
+    + 9 * 64 * 64 * 32 * 32
+    + 9 * 64 * 128 * 16 * 16
+    + 9 * 128 * 128 * 16 * 16
+    + 128 * 8 * 8 * 256
+    + 256 * 10
 )
+
+
+def transformer_flops_per_token(seq: int, n_in=64, width=256,
+                                n_layers=4, n_classes=64,
+                                causal_flash=False) -> int:
+    """Analytic train FLOPs/token for zoo.transformer_lm (bare-attention
+    stack). EXECUTED MACs: dense attention computes the full TxT scores
+    (~2*T*d per token); the causal pallas flash kernel skips future
+    blocks (~half) — causal_flash=True accounts for that, keeping mfu
+    comparable as hardware utilization across rows."""
+    attn = (seq * width) if causal_flash else (2 * seq * width)
+    layer0 = 3 * n_in * width + width * width + attn
+    layer = 3 * width * width + width * width + attn
+    return 3 * 2 * (layer0 + (n_layers - 1) * layer + width * n_classes)
+
+
+def flagship_flops_per_token(width, n_layers, seq, vocab,
+                             causal_flash=False) -> int:
+    """zoo.transformer_lm_flagship (pre-LN TransformerBlock): per layer
+    qkv 3w^2 + attn-proj w^2 + FFN 8w^2 = 12w^2 MACs/token + causal
+    attention (2*T*w dense; T*w when the flash kernel skips future
+    blocks); embed + head 2*V*w."""
+    attn = (seq * width) if causal_flash else (2 * seq * width)
+    per_layer = 12 * width * width + attn
+    return 3 * 2 * (n_layers * per_layer + 2 * vocab * width)
 
 
 def _mnist_accuracy(net, as_image=False, n=4096):
-    """Held-out accuracy after the timed training window (the
-    BASELINE.md parity gate; SURVEY §7 stage 5 target >= 0.97)."""
     from deeplearning4j_tpu.datasets.mnist import mnist_dataset
 
     test = mnist_dataset(train=False, num_examples=n, as_image=as_image)
@@ -70,266 +112,487 @@ def _mnist_accuracy(net, as_image=False, n=4096):
     return round(float(ev.accuracy()), 4)
 
 
-def _run(net, feats, labels, timed_calls, scan_steps, batch,
-         acc_fn=None, acc_calls=6):
-    # Warm up + compile; the value fetch (not just block_until_ready) is
-    # the reliable sync point across PJRT transports.
-    float(np.asarray(net.fit_scan(feats, labels)[-1]))
+# ----------------------------------------------------------------------
+# fit_scan family: setup() compiles + converges + gates; trial() is one
+# timed window. Trials interleave round-robin across all five configs.
+# ----------------------------------------------------------------------
+class ScanBench:
+    name = "?"
+    calls_per_trial = 4
+    rate_scale = 1.0  # tokens-per-example for sequence benches
 
-    # Accuracy gate at the CONVERGENCE point: a few more scan calls
-    # (hundreds of steps ~ tens of epochs on this set) reach the loss
-    # floor; the gate is evaluated here, BEFORE the long throughput
-    # window, because sustained over-training at full lr+momentum in
-    # bf16 eventually saturates the softmax (loss pins at the MCXENT
-    # clip floor ~16.4) — a measured property of the config documented
-    # in BENCHMARKS.md, not of the timed path.
-    acc = None
-    if acc_fn is not None:
-        for _ in range(acc_calls):
-            scores = net.fit_scan(feats, labels)
-        assert np.isfinite(float(np.asarray(scores[-1])))
-        acc = acc_fn(net)
-        if acc < ACCURACY_GATE:
-            # The row still prints (the driver parses stdout), but the
-            # gate failure is loud and the exit code non-zero.
-            import sys
+    def setup(self):
+        raise NotImplementedError
 
-            print(f"ACCURACY GATE FAILED: {acc} < {ACCURACY_GATE}",
-                  file=sys.stderr)
-            global _GATE_FAILED
-            _GATE_FAILED = True
+    def trial(self):
+        # The end-of-trial value fetch costs ~100 ms of tunnel latency;
+        # calls_per_trial is sized per config so the fetch stays a
+        # small fraction of the window (fit_scan calls chain lazily —
+        # the whole window is device-bound until the final sync).
+        t0 = time.perf_counter()
+        for _ in range(self.calls_per_trial):
+            scores = self.net.fit_scan(self.feats, self.labels)
+        final = _sync(scores[-1])
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final), f"{self.name}: non-finite loss"
+        self.rates.append(
+            self.calls_per_trial * self.scan_steps * self.batch
+            * self.rate_scale / dt)
 
-    # One full measurement window — the SAME estimator as BENCH_r01, so
-    # round-over-round numbers stay comparable. The tunnel is shared and
-    # identical code measures 2-5x apart under congestion; that spread
-    # is documented in BENCHMARKS.md rather than filtered here (a
-    # best-of-N estimator would inflate the official record).
-    t0 = time.perf_counter()
-    for _ in range(timed_calls):
-        scores = net.fit_scan(feats, labels)
-    final = float(np.asarray(scores[-1]))  # force completion of the chain
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final)
-    ex_s = timed_calls * scan_steps * batch / dt
-    return ex_s, acc
+    def finish(self, rates):
+        raise NotImplementedError
+
+    def _stack(self, feats_list, labels_list, scan_steps,
+               feats_shape=None):
+        """Stack + (optionally reshape) on HOST, then one device_put —
+        the upload is the expensive hop on this transport."""
+        import jax
+
+        reps = (scan_steps + len(feats_list) - 1) // len(feats_list)
+        f = np.stack(list(feats_list) * reps)[:scan_steps]
+        y = np.stack(list(labels_list) * reps)[:scan_steps]
+        if feats_shape is not None:
+            f = f.reshape(feats_shape)
+        return jax.device_put(f), jax.device_put(y)
 
 
-def bench_mlp():
-    import jax
+class MlpBench(ScanBench):
+    name = "mnist_mlp_784_500_10_train_throughput"
+    batch, scan_steps, calls_per_trial = 2048, 64, 96
 
-    from deeplearning4j_tpu.datasets.mnist import mnist_dataset
-    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
-    from deeplearning4j_tpu.nn.conf import layers as L
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-    from deeplearning4j_tpu.ops.losses import LossFunction
+    def setup(self):
+        from deeplearning4j_tpu.datasets.mnist import mnist_dataset
+        from deeplearning4j_tpu.models.zoo import mlp
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    batch, scan_steps, timed_calls = 2048, 64, 80
+        conf = mlp()
+        for c in conf.confs:
+            c.compute_dtype = "bfloat16"
+        self.net = MultiLayerNetwork(conf).init()
+        ds = mnist_dataset(train=True, num_examples=self.batch * 8)
+        bs = ds.batch_by(self.batch)
+        self.feats, self.labels = self._stack(
+            [b.features for b in bs], [b.labels for b in bs],
+            self.scan_steps)
+        self.rates = []
+        # compile + converge (a few hundred steps), gate BEFORE the
+        # timed window (sustained full-lr overtraining in bf16
+        # saturates the softmax eventually — BENCHMARKS.md)
+        _sync(self.net.fit_scan(self.feats, self.labels)[-1])
+        for _ in range(6):
+            scores = self.net.fit_scan(self.feats, self.labels)
+        assert np.isfinite(_sync(scores[-1]))
+        self.accuracy = _mnist_accuracy(self.net)
+        if self.accuracy < ACCURACY_GATE:
+            _fail_gate(f"mlp synthetic accuracy {self.accuracy}")
+        self.real = _real_data_accuracies()
 
-    conf = (
-        NeuralNetConfiguration.Builder()
-        .seed(12345)
-        .learning_rate(0.1)
-        .updater(Updater.NESTEROVS)
-        .momentum(0.9)
-        # TPU-idiomatic mixed precision: bf16 matmuls on the MXU, f32
-        # master params (verified >= 99% MNIST accuracy, ~1.4x step
-        # throughput vs f32 compute on this config)
-        .compute_dtype("bfloat16")
-        .list()
-        .layer(0, L.DenseLayer(n_in=784, n_out=500, activation="relu"))
-        .layer(
-            1,
-            L.OutputLayer(
-                n_in=500, n_out=10, activation="softmax",
-                loss_function=LossFunction.MCXENT,
-            ),
+    def finish(self, rates):
+        med = float(np.median(rates))
+        row = {
+            "metric": self.name,
+            "value": round(med, 1),
+            "unit": "examples/sec/chip",
+            "vs_baseline": round(med / REFERENCE_CPU_EXAMPLES_PER_SEC, 2),
+            "mfu": round(
+                med * MLP_FLOPS_PER_EXAMPLE / V5E_PEAK_BF16_FLOPS, 4),
+            "accuracy": self.accuracy,
+        }
+        row.update(self.real)
+        return row
+
+
+def _real_data_accuracies() -> dict:
+    """Accuracy on REAL data (round-4 VERDICT item 8): 200 bundled real
+    MNIST digits + sklearn's 1,797 real digit images. Trains small
+    dedicated nets (seconds); gates are sized to the train-set sizes
+    (160 real MNIST examples -> 0.75; 1,437 digits -> 0.93)."""
+    try:
+        from deeplearning4j_tpu.datasets.fixtures import (
+            digits_dataset,
+            mnist200_datasets,
         )
-        .build()
-    )
-    net = MultiLayerNetwork(conf).init()
-
-    ds = mnist_dataset(train=True, num_examples=batch * 8)
-    batches = ds.batch_by(batch)
-
-    # scan_steps batches pre-stacked on device: the whole optimizer loop
-    # over them is ONE lax.scan computation — a single host dispatch per
-    # 64 steps, so the measurement reflects chip throughput rather than
-    # dispatch latency over the host link.
-    reps = (scan_steps + len(batches) - 1) // len(batches)
-    feats = jax.device_put(
-        np.stack([b.features for b in batches] * reps)[:scan_steps])
-    labels = jax.device_put(
-        np.stack([b.labels for b in batches] * reps)[:scan_steps])
-
-    # Accuracy parity gate (BASELINE.md rows 1-2), evaluated at the
-    # convergence point on the held-out split. NOTE: zero-egress
-    # environment — when MNIST IDX files are absent this is the
-    # deterministic synthetic fallback (datasets/mnist.py), same split
-    # protocol.
-    ex_s, acc = _run(net, feats, labels, timed_calls, scan_steps, batch,
-                     acc_fn=_mnist_accuracy)
-    return {
-        "metric": "mnist_mlp_784_500_10_train_throughput",
-        "value": round(ex_s, 1),
-        "unit": "examples/sec/chip",
-        "vs_baseline": round(ex_s / REFERENCE_CPU_EXAMPLES_PER_SEC, 2),
-        "mfu": round(ex_s * MLP_FLOPS_PER_EXAMPLE / V5E_PEAK_BF16_FLOPS, 4),
-        "accuracy": acc,
-    }
-
-
-def bench_lenet():
-    import jax
-
-    from deeplearning4j_tpu.datasets.mnist import mnist_dataset
-    from deeplearning4j_tpu.models.zoo import lenet5
+    except Exception as e:  # fixtures absent: synthetic-only fallback
+        print(f"real-data fixtures unavailable ({e})", file=sys.stderr)
+        return {}
+    from deeplearning4j_tpu.models.zoo import mlp
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    batch, scan_steps, timed_calls = 2048, 64, 20
+    out = {}
+    tr, te = mnist200_datasets()
+    net = MultiLayerNetwork(mlp(sizes=(784, 128, 10), lr=0.3)).init()
+    for _ in range(80):
+        net.fit(tr)
+    out["accuracy_real_mnist200"] = round(
+        float(net.evaluate([te]).accuracy()), 4)
+    if out["accuracy_real_mnist200"] < 0.75:
+        _fail_gate(f"real mnist200 {out['accuracy_real_mnist200']}")
 
-    # lr: bf16 gradient noise on this conv stack needs ~2-5x smaller
-    # steps than f32 (measured: f32 converges at 0.01, bf16 plateaus at
-    # 0.905 there and converges at 0.002; both diverge at the old 0.05
-    # with batch 2048). Throughput is lr-independent; the accuracy gate
-    # requires a converging configuration.
-    conf = lenet5(lr=0.002)
-    for c in conf.confs:
-        c.compute_dtype = "bfloat16"
-    net = MultiLayerNetwork(conf).init()
-
-    ds = mnist_dataset(train=True, num_examples=batch * 8)
-    batches = ds.batch_by(batch)
-    reps = (scan_steps + len(batches) - 1) // len(batches)
-    feats = np.stack(
-        [b.features for b in batches] * reps)[:scan_steps]
-    feats = jax.device_put(feats.reshape(scan_steps, batch, 1, 28, 28))
-    labels = jax.device_put(
-        np.stack([b.labels for b in batches] * reps)[:scan_steps])
-
-    ex_s, acc = _run(net, feats, labels, timed_calls, scan_steps, batch,
-                     acc_fn=lambda n: _mnist_accuracy(n, as_image=True))
-    return {
-        "metric": "mnist_lenet5_train_throughput",
-        "value": round(ex_s, 1),
-        "unit": "examples/sec/chip",
-        "vs_baseline": round(
-            ex_s / REFERENCE_CPU_LENET_EXAMPLES_PER_SEC, 2),
-        "mfu": round(
-            ex_s * LENET_FLOPS_PER_EXAMPLE / V5E_PEAK_BF16_FLOPS, 4),
-        "accuracy": acc,
-    }
+    tr, te = digits_dataset()
+    net = MultiLayerNetwork(mlp(sizes=(64, 128, 10), lr=0.3)).init()
+    for _ in range(60):
+        net.fit(tr)
+    out["accuracy_real_digits"] = round(
+        float(net.evaluate([te]).accuracy()), 4)
+    if out["accuracy_real_digits"] < 0.93:
+        _fail_gate(f"real digits {out['accuracy_real_digits']}")
+    return out
 
 
-def bench_wide_cnn():
-    """Conv-MFU control experiment (VERDICT r2 item 3): a modern-width
-    conv net on the SAME conv machinery as LeNet. Synthetic CIFAR-shaped
-    data — this row measures the machinery's ceiling, not a dataset."""
-    import jax
+class LenetBench(ScanBench):
+    name = "mnist_lenet5_train_throughput"
+    batch, scan_steps, calls_per_trial = 2048, 64, 10
 
-    from deeplearning4j_tpu.models.zoo import wide_cnn
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    def setup(self):
+        import jax
 
-    batch, scan_steps, timed_calls = 1024, 16, 10
+        from deeplearning4j_tpu.datasets.mnist import mnist_dataset
+        from deeplearning4j_tpu.models.zoo import lenet5
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    conf = wide_cnn()
-    for c in conf.confs:
-        c.compute_dtype = "bfloat16"
-    net = MultiLayerNetwork(conf).init()
+        # bf16 conv stack converges at 0.002 (f32 at 0.01; both diverge
+        # at 0.05 with batch 2048 — BENCHMARKS.md)
+        conf = lenet5(lr=0.002)
+        for c in conf.confs:
+            c.compute_dtype = "bfloat16"
+        self.net = MultiLayerNetwork(conf).init()
+        ds = mnist_dataset(train=True, num_examples=self.batch * 8)
+        bs = ds.batch_by(self.batch)
+        self.feats, self.labels = self._stack(
+            [b.features for b in bs], [b.labels for b in bs],
+            self.scan_steps,
+            feats_shape=(self.scan_steps, self.batch, 1, 28, 28))
+        self.rates = []
+        _sync(self.net.fit_scan(self.feats, self.labels)[-1])
+        for _ in range(6):
+            scores = self.net.fit_scan(self.feats, self.labels)
+        assert np.isfinite(_sync(scores[-1]))
+        self.accuracy = _mnist_accuracy(self.net, as_image=True)
+        if self.accuracy < ACCURACY_GATE:
+            _fail_gate(f"lenet synthetic accuracy {self.accuracy}")
 
-    rng = np.random.default_rng(0)
-    feats = jax.device_put(
-        rng.normal(size=(scan_steps, batch, 3, 32, 32))
-        .astype(np.float32))
-    labels = jax.device_put(
-        np.eye(10, dtype=np.float32)[
-            rng.integers(0, 10, (scan_steps, batch))])
-
-    ex_s, _ = _run(net, feats, labels, timed_calls, scan_steps, batch)
-    return {
-        "metric": "wide_cnn_cifar_scale_train_throughput",
-        "value": round(ex_s, 1),
-        "unit": "examples/sec/chip",
-        "vs_baseline": round(
-            ex_s / REFERENCE_CPU_LENET_EXAMPLES_PER_SEC, 2),
-        "mfu": round(
-            ex_s * WIDE_CNN_FLOPS_PER_EXAMPLE / V5E_PEAK_BF16_FLOPS, 4),
-    }
-
-
-def transformer_flops_per_token(seq: int, n_in=64, width=256,
-                                n_layers=4, n_classes=64,
-                                causal_flash=False) -> int:
-    """Analytic train FLOPs/token for zoo.transformer_lm: per layer,
-    qkv projections + output projection + attention. The convention is
-    EXECUTED MACs: the dense kernel computes the full TxT scores and
-    masks (~2*T*d per token), so dense rows count the full term; the
-    causal pallas flash kernel skips future blocks and executes ~half,
-    so flash rows pass causal_flash=True — keeping mfu comparable as
-    hardware utilization across rows. T is a bench-tuning knob, so the
-    attention term derives from it."""
-    attn = (seq * width) if causal_flash else (2 * seq * width)
-    layer0 = 3 * n_in * width + width * width + attn
-    layer = 3 * width * width + width * width + attn
-    return 3 * 2 * (layer0 + (n_layers - 1) * layer + width * n_classes)
+    def finish(self, rates):
+        med = float(np.median(rates))
+        return {
+            "metric": self.name,
+            "value": round(med, 1),
+            "unit": "examples/sec/chip",
+            "vs_baseline": round(
+                med / REFERENCE_CPU_LENET_EXAMPLES_PER_SEC, 2),
+            "mfu": round(
+                med * LENET_FLOPS_PER_EXAMPLE / V5E_PEAK_BF16_FLOPS, 4),
+            "accuracy": self.accuracy,
+        }
 
 
-def bench_transformer():
-    """The long-context flagship (models/zoo.py transformer_lm):
-    training tokens/sec on synthetic sequences — NEW capability vs the
-    2015 reference, benched so the driver tracks it per round."""
-    import jax
+class WideCnnBench(ScanBench):
+    """Conv-MFU control at MXU-filling widths — now with a real
+    convergence gate: class = template + unit noise (a task with CNN
+    inductive bias; a linear-pixel teacher defeats pooled conv nets,
+    measured 15% — the template task reaches 1.00)."""
 
-    from deeplearning4j_tpu.models.zoo import transformer_lm
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    name = "wide_cnn_cifar_scale_train_throughput"
+    batch, scan_steps, calls_per_trial = 1024, 16, 6
 
-    # Batch 64: measured 2.1-2.2x the tokens/sec of batch 16 on this
-    # config (the B16 step underfills the MXU; B96 is flat vs B64), see
-    # BENCHMARKS.md transformer section.
-    batch, seq, scan_steps, timed_calls = 64, 512, 8, 20
+    def setup(self):
+        import jax
 
-    conf = transformer_lm(n_in=64, width=256, n_layers=4, n_heads=8,
-                          n_classes=64)
-    for c in conf.confs:
-        c.compute_dtype = "bfloat16"
-    net = MultiLayerNetwork(conf).init()
+        from deeplearning4j_tpu.models.zoo import wide_cnn
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    rng = np.random.default_rng(0)
-    feats = jax.device_put(
-        rng.normal(size=(scan_steps, batch, 64, seq))
-        .astype(np.float32))
-    idx = rng.integers(0, 64, (scan_steps, batch, seq))
-    labels = jax.device_put(
-        np.eye(64, dtype=np.float32)[idx].transpose(0, 1, 3, 2))
+        conf = wide_cnn(lr=0.005)
+        for c in conf.confs:
+            c.compute_dtype = "bfloat16"
+        self.net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        self.templates = rng.normal(size=(10, 3, 32, 32)).astype(
+            np.float32)
+        x, y, _ = self._make(self.scan_steps * self.batch, 1)
+        self.feats = jax.device_put(
+            x.reshape(self.scan_steps, self.batch, 3, 32, 32))
+        self.labels = jax.device_put(
+            y.reshape(self.scan_steps, self.batch, 10))
+        self.rates = []
+        _sync(self.net.fit_scan(self.feats, self.labels)[-1])
+        for _ in range(12):
+            scores = self.net.fit_scan(self.feats, self.labels)
+        assert np.isfinite(_sync(scores[-1]))
+        hx, _, hc = self._make(2048, 99)
+        out = np.asarray(self.net.output(hx))
+        self.accuracy = round(float((out.argmax(1) == hc).mean()), 4)
+        if self.accuracy < ACCURACY_GATE:
+            _fail_gate(f"wide_cnn accuracy {self.accuracy}")
 
-    ex_s, _ = _run(net, feats, labels, timed_calls, scan_steps, batch)
-    tok_s = ex_s * seq
-    return {
-        "metric": "transformer_lm_train_throughput",
-        "value": round(tok_s, 1),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": None,  # reference has no attention model
-        "mfu": round(
-            tok_s * transformer_flops_per_token(seq)
-            / V5E_PEAK_BF16_FLOPS, 4),
-    }
+    def _make(self, n, seed):
+        r = np.random.default_rng(seed)
+        cls = r.integers(0, 10, n)
+        x = (0.5 * self.templates[cls]
+             + r.normal(size=(n, 3, 32, 32))).astype(np.float32)
+        return x, np.eye(10, dtype=np.float32)[cls], cls
+
+    def finish(self, rates):
+        med = float(np.median(rates))
+        return {
+            "metric": self.name,
+            "value": round(med, 1),
+            "unit": "examples/sec/chip",
+            "vs_baseline": round(
+                med / REFERENCE_CPU_LENET_EXAMPLES_PER_SEC, 2),
+            "mfu": round(
+                med * WIDE_CNN_FLOPS_PER_EXAMPLE / V5E_PEAK_BF16_FLOPS,
+                4),
+            "accuracy": self.accuracy,
+        }
 
 
-def bench_transformer_long_context():
-    """Long-context training row: T=16384 with the tuned pallas flash
-    kernel + rematerialization — a sequence length dense attention
-    cannot train at all (the [T, T] scores alone would be 4.3 GB per
-    layer); the round-3 block-size tuning made this 2.9x faster
-    (BENCHMARKS.md long-context section)."""
+class TransformerBench(ScanBench):
+    name = "transformer_lm_train_throughput"
+    batch, seq, scan_steps, calls_per_trial = 64, 512, 8, 10
+    rate_scale = seq  # tokens per example
+
+    def setup(self):
+        import jax
+
+        from deeplearning4j_tpu.models.zoo import transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = transformer_lm(n_in=64, width=256, n_layers=4,
+                              n_heads=8, n_classes=64)
+        for c in conf.confs:
+            c.compute_dtype = "bfloat16"
+        self.net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        self.feats = jax.device_put(
+            rng.normal(size=(self.scan_steps, self.batch, 64, self.seq))
+            .astype(np.float32))
+        idx = rng.integers(0, 64, (self.scan_steps, self.batch, self.seq))
+        self.labels = jax.device_put(
+            np.eye(64, dtype=np.float32)[idx].transpose(0, 1, 3, 2))
+        self.rates = []
+        _sync(self.net.fit_scan(self.feats, self.labels)[-1])
+
+    def finish(self, rates):
+        med = float(np.median(rates))  # already tokens/s (rate_scale)
+        return {
+            "metric": self.name,
+            "value": round(med, 1),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": None,  # reference has no attention model
+            "mfu": round(
+                med * transformer_flops_per_token(self.seq)
+                / V5E_PEAK_BF16_FLOPS, 4),
+        }
+
+
+# ----------------------------------------------------------------------
+def run_interleaved(benches, n_trials=3):
+    for b in benches:
+        t0 = time.perf_counter()
+        b.setup()
+        print(f"setup {b.name}: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+    for _ in range(n_trials):
+        for b in benches:
+            b.trial()
+    rows = []
+    for b in benches:
+        row = b.finish(b.rates)
+        row["spread"] = [round(min(b.rates), 1), round(max(b.rates), 1)]
+        row["trials"] = len(b.rates)
+        rows.append(row)
+    return rows
+
+
+# ----------------------------------------------------------------------
+def bench_flagship():
+    """The converging high-MFU flagship (VERDICT r3 item 1): width-1024
+    x 8 TransformerBlock LM on the analytic Markov task. ONE run both
+    converges (held-out CE within 0.25 nats of the entropy floor) and
+    utilizes (mfu >= 0.40). Per-epoch wall times double as the trials."""
     import jax
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
-    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.datasets.markov import markov_lm_batches
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    batch, seq, timed_steps = 1, 16384, 8
+    V, T, B, pool, epochs = 64, 512, 16, 1024, 8
+    K = pool // B  # scan steps per epoch
+    width, n_layers = 1024, 8
 
-    conf = transformer_lm(n_in=64, width=256, n_layers=4, n_heads=8,
-                          n_classes=64, remat=True)
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=16,
+        lr=3e-4, warmup_steps=K, total_steps=epochs * K)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+    net = MultiLayerNetwork(conf).init()
+
+    feats, labels, floor = markov_lm_batches(
+        V, n_seq=pool, seq_len=T, seed=0, sample_seed=1)
+    hf, hl, _ = markov_lm_batches(
+        V, n_seq=128, seq_len=T, seed=0, sample_seed=777)
+    f = jax.device_put(feats.reshape(K, B, V, T).astype(np.uint8))
+    lab = jax.device_put(labels.reshape(K, B, V, T).astype(np.uint8))
+    held = DataSet(hf, hl)
+
+    start_loss = _sync(net.fit_scan(f, lab)[0])  # compile + epoch 0
+    rates = []
+    for _ in range(1, epochs):
+        t0 = time.perf_counter()
+        scores = net.fit_scan(f, lab)
+        assert np.isfinite(_sync(scores[-1]))
+        rates.append(K * B * T / (time.perf_counter() - t0))
+
+    held_loss = net.score(held)
+    fpt = flagship_flops_per_token(width, n_layers, T, V)
+    med = float(np.median(rates))
+    mfu = med * fpt / V5E_PEAK_BF16_FLOPS
+    converged = bool(held_loss - floor <= 0.25)
+    if not converged:
+        _fail_gate(
+            f"flagship held-out {held_loss:.4f} vs floor {floor:.4f}")
+    if mfu < 0.40:
+        _fail_gate(f"flagship mfu {mfu:.4f} < 0.40")
+    return {
+        "metric": "transformer_flagship_1024x8_train_throughput",
+        "value": round(med, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,  # no reference counterpart exists
+        "mfu": round(mfu, 4),
+        "spread": [round(min(rates), 1), round(max(rates), 1)],
+        "trials": len(rates),
+        "converged": converged,
+        "held_out_loss_nats": round(float(held_loss), 4),
+        "entropy_floor_nats": round(float(floor), 4),
+        "initial_loss_nats": round(float(start_loss), 4),
+    }
+
+
+def bench_w2v():
+    """BASELINE row 3: Word2Vec skip-gram words/sec with a semantic
+    quality gate on the bundled REAL corpus (the reference's
+    Word2VecTests corpus; SequenceVectors.java:100). NS mode — the
+    configuration that reproduces real semantics (BENCHMARKS.md)."""
+    from deeplearning4j_tpu.datasets.fixtures import raw_sentences
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    sents = raw_sentences()
+    n_words = sum(len(s.split()) for s in sents)
+    w2v = Word2Vec(layer_size=100, window=5, min_word_frequency=5,
+                   batch_size=2048, seed=3, subsampling=1e-3,
+                   use_hierarchic_softmax=False, negative=5)
+    w2v.build_vocab_from(sents)
+    w2v.fit(sents)  # warm: compiles every code-length class shape
+    w2v._reset_weights()
+    rates = []
+    for _ in range(3):  # 3 epochs = 3 trials; vectors keep training
+        t0 = time.perf_counter()
+        w2v.fit(sents)
+        _ = np.asarray(w2v.syn0)[0, 0]  # force device completion
+        rates.append(n_words / (time.perf_counter() - t0))
+    sim_close = float(w2v.similarity("day", "night"))
+    sim_far = float(w2v.similarity("day", "money"))
+    quality = bool(sim_close > 0.4 and sim_close - sim_far > 0.2)
+    if not quality:
+        _fail_gate(
+            f"w2v quality sim(day,night)={sim_close:.3f} "
+            f"sim(day,money)={sim_far:.3f}")
+    med = float(np.median(rates))
+    return {
+        "metric": "w2v_skipgram_ns_words_per_sec",
+        "value": round(med, 1),
+        "unit": "words/sec/chip (real corpus, negative=5)",
+        "vs_baseline": round(med / REFERENCE_CPU_W2V_WORDS_PER_SEC, 2),
+        "spread": [round(min(rates), 1), round(max(rates), 1)],
+        "trials": len(rates),
+        "quality_gate": quality,
+        "sim_day_night": round(sim_close, 3),
+        "sim_day_money": round(sim_far, 3),
+    }
+
+
+def bench_dbn():
+    """BASELINE row 4: DBN pretrain epochs/sec + finetune accuracy
+    (reference MultiLayerNetwork.pretrain :150 + RBM CD-k :110)."""
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.datasets.mnist import mnist_dataset
+    from deeplearning4j_tpu.models.zoo import dbn
+    from deeplearning4j_tpu.nn.conf.enums import Updater
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    n = 8192
+    ds = mnist_dataset(train=True, num_examples=n)
+    batches = ds.batch_by(1024)
+    net = MultiLayerNetwork(
+        dbn(lr=0.05, updater=Updater.NESTEROVS)).init()
+    for _ in range(2):  # compile + steady-state warm
+        net.pretrain(ListDataSetIterator(batches))
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        net.pretrain(ListDataSetIterator(batches))
+        rates.append(1.0 / (time.perf_counter() - t0))
+    for _ in range(40):  # finetune (reference finetune() :1140)
+        for b in batches:
+            net.fit(b)
+    acc = _mnist_accuracy(net, n=2048)
+    if acc < ACCURACY_GATE:
+        _fail_gate(f"dbn finetune accuracy {acc}")
+    med = float(np.median(rates))
+    return {
+        "metric": "dbn_pretrain_epochs_per_sec",
+        "value": round(med, 3),
+        "unit": "pretrain epochs/sec (8192 ex, 784-500-250-10 CD-1)",
+        "vs_baseline": None,  # reference publishes no DBN numbers
+        "spread": [round(min(rates), 3), round(max(rates), 3)],
+        "trials": len(rates),
+        "finetune_accuracy": acc,
+    }
+
+
+def bench_allreduce():
+    """BASELINE row 5: dp step-time decomposition on the 8-virtual-
+    device mesh, in a subprocess (the TPU process cannot re-init its
+    backend as CPU). scripts/allreduce_bench.py prints the row."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "scripts", "allreduce_bench.py")],
+        capture_output=True, text=True, timeout=600, env=env)
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    _fail_gate(f"allreduce bench produced no row: {proc.stderr[-400:]}")
+    return None
+
+
+def bench_transformer_long_context():
+    """Long-context row at 16k tokens, round-4 config (VERDICT item 2):
+    the WIDTH-1024 flagship block stack, B=2, flash attention, no
+    remat — 24.8% MFU where the round-3 width-256 toy ran at 2.9%.
+    The breakdown (scripts/longcontext_breakdown.py, BENCHMARKS.md)
+    showed the wall was model width, not the schedule: the flash
+    kernel's time is iteration-bound (~constant in head_dim at B=1),
+    so a dh=32 model can never fill the chip at 16k; dh=128 fills full
+    MXU tiles, and width-1024 matmuls dominate the step productively.
+    """
+    import jax
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch, seq, timed_steps = 2, 16384, 3
+    width, n_layers = 1024, 8
+
+    conf = transformer_lm_flagship(
+        vocab=64, width=width, n_layers=n_layers, n_heads=8,
+        lr=3e-4, warmup_steps=10, total_steps=1000, remat=False)
     for c in conf.confs:
         c.compute_dtype = "bfloat16"
     net = MultiLayerNetwork(conf).init()
@@ -341,31 +604,50 @@ def bench_transformer_long_context():
     ds = DataSet(jax.device_put(x), jax.device_put(y))
 
     net.fit(ds)  # compile + warm
-    float(np.asarray(net.score_value))
-    t0 = time.perf_counter()
-    for _ in range(timed_steps):
-        net.fit(ds)
-    final = float(np.asarray(net.score_value))
-    dt = time.perf_counter() - t0
+    _sync(net.score_value)
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            net.fit(ds)
+        final = _sync(net.score_value)
+        rates.append(timed_steps * batch * seq
+                     / (time.perf_counter() - t0))
     assert np.isfinite(final)
-    tok_s = timed_steps * batch * seq / dt
+    med = float(np.median(rates))
+    mfu = (med * flagship_flops_per_token(
+        width, n_layers, seq, 64, causal_flash=True)
+        / V5E_PEAK_BF16_FLOPS)
+    if mfu < 0.10:
+        _fail_gate(f"16k-context mfu {mfu:.4f} < 0.10")
     return {
         "metric": "transformer_lm_16k_context_train_throughput",
-        "value": round(tok_s, 1),
-        "unit": "tokens/sec/chip",
+        "value": round(med, 1),
+        "unit": "tokens/sec/chip (width-1024 flagship blocks, B=2)",
         "vs_baseline": None,  # reference cannot run this config at all
-        "mfu": round(
-            tok_s * transformer_flops_per_token(seq, causal_flash=True)
-            / V5E_PEAK_BF16_FLOPS, 4),
+        "mfu": round(mfu, 4),
+        "spread": [round(min(rates), 1), round(max(rates), 1)],
+        "trials": len(rates),
     }
 
 
 def main() -> None:
-    print(json.dumps(bench_lenet()))
-    print(json.dumps(bench_wide_cnn()))
-    print(json.dumps(bench_transformer()))
-    print(json.dumps(bench_transformer_long_context()))
-    print(json.dumps(bench_mlp()))  # headline: last line is parsed
+    benches = [LenetBench(), WideCnnBench(), TransformerBench(),
+               MlpBench()]
+    rows = run_interleaved(benches, n_trials=3)
+    mlp_row = rows.pop()  # headline printed LAST
+    for r in rows:
+        print(json.dumps(r))
+    for fn in (bench_transformer_long_context, bench_flagship,
+               bench_w2v, bench_dbn, bench_allreduce):
+        try:
+            row = fn()
+        except Exception as e:  # a broken row must not hide the rest
+            _fail_gate(f"{fn.__name__} raised: {e!r}")
+            row = None
+        if row:
+            print(json.dumps(row))
+    print(json.dumps(mlp_row))
     if _GATE_FAILED:
         raise SystemExit(1)
 

@@ -1,0 +1,636 @@
+"""dp x pp x tp pipeline parallelism for homogeneous-stage models.
+
+Round-4 VERDICT item 3. The packed-row ``PipelineTrainer``
+(pipeline_parallel.py) achieves 1/S stage memory for ARBITRARY
+heterogeneous stacks by flattening each stage into one row of a [S, K]
+buffer — a layout that cannot express per-TENSOR shardings, so pp could
+not compose with tp/fsdp there (documented at its "Why pp composes with
+dp but not tp" note). But the models that dominate TPU practice —
+transformer stacks of identical blocks — don't need the packed row at
+all: their stages are structurally identical, so stage parameters can
+be STACKED on a leading ``pp`` axis as ordinary pytrees
+(leaf [S, k, ...]) with per-tensor PartitionSpecs on the tensor dims.
+
+That unlocks the canonical large-model TPU topology on one mesh:
+
+- **pp** (manual): the GPipe microbatch schedule runs inside a
+  shard_map that is manual over ``pp`` only — activations hop
+  stage-to-stage via ``lax.ppermute``; each device's local stack slice
+  is its stage's k blocks (1/S of the stack).
+- **tp** (GSPMD-auto): block weights carry Megatron column/row specs on
+  their trailing dims (P("pp", None, None, "tp") etc. — per-tensor
+  layouts, exactly what the packed row could not express); XLA inserts
+  the two all-reduces per block inside each pipeline tick. Per-device
+  stack memory becomes ~1/(S*T) of the model.
+- **dp** (GSPMD-auto): the batch dim is sharded over ``dp``; gradient
+  all-reduces fall out of the global-batch mean.
+
+Layer grouping: the trainer finds the maximal contiguous run of
+structurally identical layers (same bean type, same leaf shapes, same
+resolved updater/regularization hyperparameters), requires its length
+to be divisible by S, and replicates everything before (``pre`` — e.g.
+the flagship's input-projection block) and after (``post`` — final
+LayerNorm + output head) on every device. pre/post are the cheap ends
+of an LM; the stack is where the memory and FLOPs live.
+
+Trajectory parity with single-device ``net.fit`` on the same batches is
+asserted in tests/test_homogeneous_pipeline.py, and the 1/(S*T) stage
+bytes in the same file — mirroring test_pipeline_expert.py:634's
+accounting for the packed trainer.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+# Megatron specs for a stacked TransformerBlock leaf ([S, k] + tensor
+# dims): qkv + FFN-in column-parallel, attn-out + FFN-out row-parallel.
+_BLOCK_TP_COL = {"Wq", "Wk", "Wv", "W1"}
+_BLOCK_TP_ROW = {"Wo", "W2"}
+_BLOCK_TP_VEC = {"b1"}  # [dff] vectors, sharded like the col outputs
+
+
+def _layer_signature(net, i: int):
+    """Structural identity key for stacking layer i with its peers."""
+    c = net.conf.confs[i]
+    leaves = jax.tree.flatten(net.params[str(i)])
+    shapes = tuple(
+        (tuple(l.shape), str(l.dtype)) for l in leaves[0])
+    upd = net._updaters[i]
+    return (
+        type(c.layer).__name__,
+        str(leaves[1]),
+        shapes,
+        upd.rule,
+        tuple(sorted((k, str(v)) for k, v in upd.hp.items())),
+        str(c.resolved("gradient_normalization")),
+        float(c.resolved("gradient_normalization_threshold")),
+        bool(c.use_regularization),
+        float(c.resolved("l1") or 0.0),
+        float(c.resolved("l2") or 0.0),
+        float(c.resolved("learning_rate")),
+    )
+
+
+def find_homogeneous_run(net):
+    """(start, end) of the longest contiguous run of structurally
+    identical layers (ties: the earliest)."""
+    n = net.n_layers
+    sigs = [_layer_signature(net, i) for i in range(n)]
+    best = (0, 1)
+    i = 0
+    while i < n:
+        j = i + 1
+        while j < n and sigs[j] == sigs[i]:
+            j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = j
+    return best
+
+
+class HomogeneousPipelineTrainer:
+    """GPipe over stage-STACKED homogeneous blocks, composing dp and tp
+    on the same mesh (see module docstring).
+
+    Limitations (enforced): plain-SGD-family full-BPTT training,
+    stateless layers (no BatchNorm running stats), no mask arrays, and
+    tp requires the stacked block to be a TransformerBlock (the
+    Megatron specs are defined for its parameter names).
+    """
+
+    def __init__(
+        self,
+        net,
+        mesh: Mesh,
+        pp_axis: str = "pp",
+        tp_axis: Optional[str] = None,
+        dp_axis: Optional[str] = None,
+        n_microbatches: int = 4,
+    ):
+        from deeplearning4j_tpu.nn.conf.enums import (
+            BackpropType,
+            OptimizationAlgorithm,
+        )
+        from deeplearning4j_tpu.nn.layers.attention import (
+            TransformerBlock,
+        )
+
+        net.init()
+        if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            raise ValueError(
+                "HomogeneousPipelineTrainer does not support tBPTT")
+        algo = net.conf.confs[0].optimization_algo
+        if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            raise ValueError(
+                "HomogeneousPipelineTrainer requires "
+                f"STOCHASTIC_GRADIENT_DESCENT (got {algo})")
+        stateful = [
+            si for si, st in (net.state or {}).items()
+            if not (isinstance(st, dict) and set(st) <= {"aux_loss"})]
+        if stateful:
+            raise ValueError(
+                f"layers {stateful} carry running state; use the "
+                "packed-row PipelineTrainer (ghost-batch-norm) instead")
+        self.net = net
+        self.mesh = mesh
+        self.pp_axis = pp_axis
+        self.S = int(mesh.shape[pp_axis])
+        self.M = int(n_microbatches)
+        if dp_axis is None and "dp" in mesh.axis_names:
+            dp_axis = "dp"
+        self.dp_axis = (dp_axis
+                        if dp_axis and dp_axis in mesh.axis_names
+                        else None)
+        self.tp_axis = (tp_axis
+                        if tp_axis and tp_axis in mesh.axis_names
+                        else None)
+        self.R = int(mesh.shape[self.dp_axis]) if self.dp_axis else 1
+
+        start, end = find_homogeneous_run(net)
+        run = end - start
+        if run < self.S or run % self.S:
+            raise ValueError(
+                f"homogeneous run of {run} identical layers (layers "
+                f"{start}..{end - 1}) is not divisible by the "
+                f"{self.S}-stage pp axis; add/remove blocks or use the "
+                "packed-row PipelineTrainer")
+        self.run = (start, end)
+        self.k = run // self.S  # blocks per stage
+        self.pre_idx = list(range(0, start))
+        self.post_idx = list(range(end, net.n_layers))
+        if not hasattr(net._impls[-1], "loss"):
+            raise ValueError("last layer must be an output layer")
+        block_bean = net.conf.confs[start].layer
+        self._block_is_tb = isinstance(block_bean, TransformerBlock)
+        if self.tp_axis:
+            if not self._block_is_tb:
+                raise ValueError(
+                    "tp_axis requires the stacked block to be a "
+                    f"TransformerBlock (got "
+                    f"{type(block_bean).__name__})")
+            T = int(mesh.shape[self.tp_axis])
+            if block_bean.n_heads % T:
+                raise ValueError(
+                    f"n_heads {block_bean.n_heads} not divisible by "
+                    f"mesh tp={T}")
+        self._stack_conf = net.conf.confs[start]
+        self._stack_updater = net._updaters[start]
+        self._step_cache = {}
+        self._state = None  # (pre, stack, post, pre_u, stack_u, post_u)
+        self._synced = None
+
+    # -- stacked-state lifecycle --------------------------------------
+    def _stack_leaf_spec(self, name: str) -> P:
+        """PartitionSpec for stacked leaf ``name`` ([S, k] + tensor
+        dims): pp on the stage axis, Megatron tp on the tensor dims."""
+        tp = self.tp_axis
+        if not tp or not self._block_is_tb:
+            return P(self.pp_axis)
+        if name in _BLOCK_TP_COL:
+            return P(self.pp_axis, None, None, tp)
+        if name in _BLOCK_TP_ROW:
+            return P(self.pp_axis, None, tp, None)
+        if name in _BLOCK_TP_VEC:
+            return P(self.pp_axis, None, tp)
+        return P(self.pp_axis)
+
+    def _stack_tree(self, tree):
+        """{name: leaf} per stacked layer -> {name: [S, k, ...]} as
+        HOST numpy (device_put with the P(pp, ...) sharding then lands
+        each stage row only on its stage's devices — the full stack
+        never materializes on one device)."""
+        start, end = self.run
+        names = list(tree[str(start)].keys())
+        out = {}
+        for name in names:
+            rows = [
+                np.stack([
+                    np.asarray(tree[str(start + s * self.k + j)][name])
+                    for j in range(self.k)])
+                for s in range(self.S)]
+            out[name] = np.stack(rows)
+        return out
+
+    def _unstack_into(self, tree, stacked):
+        start, _ = self.run
+        for name, leaf in stacked.items():
+            mat = np.asarray(jax.device_get(leaf))
+            for s in range(self.S):
+                for j in range(self.k):
+                    tree[str(start + s * self.k + j)][name] = (
+                        mat[s, j])
+
+    def _ensure_placed(self):
+        net = self.net
+        token = (id(net.params), getattr(net, "params_version", 0))
+        if self._state is not None and self._synced == token:
+            return
+        mesh = self.mesh
+        rep = NamedSharding(mesh, P())
+
+        def put_rep(tree):
+            return jax.device_put(
+                jax.tree.map(jnp.asarray, tree), rep)
+
+        pre_p = put_rep({str(i): net.params[str(i)]
+                         for i in self.pre_idx})
+        post_p = put_rep({str(i): net.params[str(i)]
+                          for i in self.post_idx})
+        pre_u = put_rep({str(i): net.updater_state[str(i)]
+                         for i in self.pre_idx})
+        post_u = put_rep({str(i): net.updater_state[str(i)]
+                          for i in self.post_idx})
+        stack_p = {
+            name: jax.device_put(
+                leaf, NamedSharding(mesh, self._stack_leaf_spec(name)))
+            for name, leaf in self._stack_tree(net.params).items()}
+        # updater-state leaves mirror the param leaves they track
+        # ({"m": {name: leaf}} for Adam) — shard them identically
+        stacked_u_raw = self._stack_updater_state()
+        stack_u = {
+            slot: {
+                name: jax.device_put(
+                    leaf,
+                    NamedSharding(mesh, self._stack_leaf_spec(name)))
+                for name, leaf in sub.items()}
+            for slot, sub in stacked_u_raw.items()}
+        self._state = (pre_p, stack_p, post_p, pre_u, stack_u, post_u)
+        self._synced = token
+
+    def _stack_updater_state(self):
+        """updater_state["i"] = {slot: {name: leaf}} -> {slot: {name:
+        [S, k, ...]}} (empty dict for SGD)."""
+        start, _ = self.run
+        ustate = self.net.updater_state
+        proto = ustate[str(start)]
+        return {
+            slot: {
+                name: np.stack([
+                    np.stack([
+                        np.asarray(ustate[
+                            str(start + s * self.k + j)][slot][name])
+                        for j in range(self.k)])
+                    for s in range(self.S)])
+                for name in proto[slot]}
+            for slot in proto}
+
+    def _sync_to_net(self):
+        net = self.net
+        pre_p, stack_p, post_p, pre_u, stack_u, post_u = self._state
+        for i in self.pre_idx + self.post_idx:
+            si = str(i)
+            src = pre_p if i in self.pre_idx else post_p
+            srcu = pre_u if i in self.pre_idx else post_u
+            net.params[si] = jax.tree.map(
+                lambda a: np.asarray(jax.device_get(a)), src[si])
+            net.updater_state[si] = jax.tree.map(
+                lambda a: np.asarray(jax.device_get(a)), srcu[si])
+        self._unstack_into(net.params, stack_p)
+        start, _ = self.run
+        for slot, sub in stack_u.items():
+            for name, leaf in sub.items():
+                mat = np.asarray(jax.device_get(leaf))
+                for s in range(self.S):
+                    for j in range(self.k):
+                        net.updater_state[
+                            str(start + s * self.k + j)][slot][name] = (
+                            mat[s, j])
+        self._synced = (id(net.params),
+                        getattr(net, "params_version", 0))
+
+    def per_device_state_bytes(self) -> dict:
+        """{device: stacked params+updater bytes resident} — the
+        1/(S*T) accounting (replicated pre/post excluded: they are the
+        deliberately-shared cheap ends)."""
+        self._ensure_placed()
+        _, stack_p, _, _, stack_u, _ = self._state
+        acc: dict = {}
+        leaves = list(stack_p.values()) + [
+            leaf for sub in stack_u.values() for leaf in sub.values()]
+        for buf in leaves:
+            for shard in buf.addressable_shards:
+                acc[shard.device] = (acc.get(shard.device, 0)
+                                     + shard.data.nbytes)
+        return acc
+
+    def total_stack_bytes(self) -> int:
+        self._ensure_placed()
+        _, stack_p, _, _, stack_u, _ = self._state
+        leaves = list(stack_p.values()) + [
+            leaf for sub in stack_u.values() for leaf in sub.values()]
+        return int(sum(l.size * l.dtype.itemsize for l in leaves))
+
+    # -- the step ------------------------------------------------------
+    def _apply_range(self, idxs, params, x, rngs, train):
+        """Apply replicated layers ``idxs`` (with preprocessors)."""
+        from deeplearning4j_tpu.nn.multilayer import _cast_floating
+
+        net = self.net
+        cd = net._compute_dtype
+        last = net.n_layers - 1
+        for i in idxs:
+            c = net.conf.confs[i]
+            pp = net.conf.preprocessor_for(i)
+            if pp is not None:
+                x = pp.pre_process(x, rngs[i] if train else None)
+            p = params[str(i)]
+            if cd is not None and i == last:
+                x = _cast_floating(x, net._dtype)  # f32 output head
+            elif cd is not None:
+                p = jax.tree.map(
+                    functools.partial(_cast_floating, dtype=cd), p)
+            x, _ = net._impls[i].apply(
+                c, p, x, state=None, train=train, rng=rngs[i],
+                mask=None)
+        return x
+
+    def _block_apply(self, stack_local, x, rng, train):
+        """This stage's k blocks, sequentially via lax.scan over the
+        block axis (stack_local leaves [k, ...])."""
+        from deeplearning4j_tpu.nn.multilayer import _cast_floating
+
+        net = self.net
+        conf = self._stack_conf
+        impl = net._impls[self.run[0]]
+        cd = net._compute_dtype
+
+        def one(x, inp):
+            p, key = inp
+            if cd is not None:
+                p = jax.tree.map(
+                    functools.partial(_cast_floating, dtype=cd), p)
+
+            def apply(pp_, xx):
+                y, _ = impl.apply(conf, pp_, xx, state=None,
+                                  train=train, rng=key, mask=None)
+                return y
+
+            if net.conf.remat:
+                apply = jax.checkpoint(apply)
+            return apply(p, x), None
+
+        keys = (jax.random.split(rng, self.k) if rng is not None
+                else jnp.zeros((self.k, 2), jnp.uint32))
+        # drop the local stage axis ([1, k, ...] -> [k, ...])
+        blocks = jax.tree.map(lambda l: l[0], stack_local)
+        x, _ = lax.scan(one, x, (blocks, keys))
+        return x
+
+    def _build_step(self, feats_shape, labels_shape, scan=False):
+        from deeplearning4j_tpu.nn.multilayer import (
+            layer_reg_score,
+            layer_update,
+        )
+
+        net = self.net
+        S, M, R = self.S, self.M, self.R
+        axis = self.pp_axis
+        cd = net._compute_dtype
+        B = feats_shape[0]
+        if B % M:
+            raise ValueError(
+                f"batch {B} not divisible by {M} microbatches")
+        mb = B // M
+        out_conf = net.conf.confs[-1]
+        out_impl = net._impls[-1]
+        start, _ = self.run
+
+        # Hop-buffer shape: the block interface [mb, width, T...] —
+        # probe via eval_shape of pre on one microbatch.
+        def probe(x):
+            rngs = [None] * net.n_layers
+            return self._apply_range(
+                self.pre_idx, net.params, x, rngs, False)
+
+        x_probe = jax.eval_shape(
+            probe,
+            jax.ShapeDtypeStruct((mb,) + tuple(feats_shape[1:]),
+                                 net._dtype))
+        hop_dtype = cd if cd is not None else net._dtype
+
+        def local_step(pre_p, stack_p, post_p, pre_u, stack_u, post_u,
+                       iteration, rng, feats, labels):
+            idx = lax.axis_index(axis)
+
+            def loss_fn(theta):
+                pre, stack_local, post = theta
+                f = feats.astype(cd) if cd is not None else feats
+                x_mbs = f.reshape((M, mb) + f.shape[1:])
+                y_mbs = labels.reshape((M, mb) + labels.shape[1:])
+                buf0 = jnp.zeros(x_probe.shape, hop_dtype)
+                z = jnp.zeros((), net._dtype)
+
+                def tick(t, carry):
+                    buf, loss_acc = carry
+                    mb_idx = jnp.clip(t - idx, 0, M - 1)
+                    rngs = list(jax.random.split(
+                        jax.random.fold_in(rng, mb_idx),
+                        net.n_layers))
+                    feed = x_mbs[jnp.minimum(t, M - 1)]
+                    h_pre = self._apply_range(
+                        self.pre_idx, pre, feed, rngs, True)
+                    xin = jnp.where(
+                        idx == 0, h_pre.astype(hop_dtype), buf)
+                    y = self._block_apply(
+                        stack_local, xin,
+                        jax.random.fold_in(rngs[start], idx), True)
+                    out = self._apply_range(
+                        self.post_idx, post, y, rngs, True)
+                    if cd is not None:
+                        out = out.astype(net._dtype)
+                    out_t = jnp.maximum(t - (S - 1), 0)
+                    loss_mb = out_impl.loss(
+                        out_conf, out, y_mbs[out_t], None)
+                    write = (idx == S - 1) & (t - (S - 1) >= 0)
+                    loss_acc = loss_acc + jnp.where(write, loss_mb, z)
+                    perm = [(i, (i + 1) % S) for i in range(S)]
+                    buf = lax.ppermute(
+                        y.astype(hop_dtype), axis, perm)
+                    return buf, loss_acc
+
+                _, loss_sum = lax.fori_loop(0, M + S - 1, tick,
+                                            (buf0, z))
+                # Local (unreduced) contribution — see
+                # pipeline_parallel.py on why the psum must stay
+                # OUTSIDE the differentiated function. Replicated
+                # pre/post reg divides by S so the pp-psum counts it
+                # once; stacked reg is per-stage-local already.
+                reg = jnp.zeros((), net._dtype)
+                for i in self.pre_idx + self.post_idx:
+                    reg = reg + layer_reg_score(
+                        net.conf.confs[i],
+                        (pre if i in self.pre_idx else post)[str(i)])
+                reg = reg / S
+                stack_reg = jax.vmap(lambda tree: layer_reg_score(
+                    self._stack_conf, tree))(
+                    jax.tree.map(lambda l: l[0], stack_local))
+                return loss_sum / M + reg + jnp.sum(stack_reg)
+
+            score_local, grads = jax.value_and_grad(loss_fn)(
+                (pre_p, stack_p, post_p))
+            g_pre, g_stack, g_post = grads
+            # pre/post gradients live on stage 0 / S-1 only; the ring
+            # sum recovers the full gradient (zeros elsewhere).
+            g_pre = lax.psum(g_pre, axis)
+            g_post = lax.psum(g_post, axis)
+            score = lax.psum(score_local, axis)
+
+            # -- updates (dp reduction falls out of the global-batch
+            # mean under GSPMD; no explicit dp collective needed) --
+            new_pre, new_pre_u = {}, {}
+            for i in self.pre_idx:
+                si = str(i)
+                upd, new_pre_u[si] = layer_update(
+                    net.conf.confs[i], net._updaters[i], g_pre[si],
+                    pre_u[si], iteration)
+                new_pre[si] = jax.tree.map(
+                    lambda p, u: p - u, pre_p[si], upd)
+            new_post, new_post_u = {}, {}
+            for i in self.post_idx:
+                si = str(i)
+                upd, new_post_u[si] = layer_update(
+                    net.conf.confs[i], net._updaters[i], g_post[si],
+                    post_u[si], iteration)
+                new_post[si] = jax.tree.map(
+                    lambda p, u: p - u, post_p[si], upd)
+
+            # stacked: per-(stage, block) layer_update, vmapped twice —
+            # identical math to the per-layer loop, batched.
+            def upd_block(g, u):
+                return layer_update(
+                    self._stack_conf, self._stack_updater, g, u,
+                    iteration)
+
+            upd_sb, new_stack_u = jax.vmap(jax.vmap(upd_block))(
+                g_stack, stack_u)
+            new_stack = jax.tree.map(
+                lambda p, u: p - u, stack_p, upd_sb)
+            return (new_pre, new_stack, new_post, new_pre_u,
+                    new_stack_u, new_post_u, score)
+
+        if not scan:
+            fn = local_step
+        else:
+            def fn(pre_p, stack_p, post_p, pre_u, stack_u, post_u,
+                   iteration, rng, fs, ys):
+                def body(carry, inp):
+                    a, b, c, d, e, f_, it = carry
+                    a, b, c, d, e, f_, score = local_step(
+                        a, b, c, d, e, f_, it,
+                        jax.random.fold_in(rng, inp["k"]),
+                        inp["f"], inp["y"])
+                    return (a, b, c, d, e, f_, it + 1), score
+
+                xs = {"f": fs, "y": ys, "k": jnp.arange(fs.shape[0])}
+                (pre_p, stack_p, post_p, pre_u, stack_u, post_u,
+                 _), scores = lax.scan(
+                    body,
+                    (pre_p, stack_p, post_p, pre_u, stack_u, post_u,
+                     iteration), xs)
+                return (pre_p, stack_p, post_p, pre_u, stack_u,
+                        post_u, scores)
+
+        rep = P()
+        pp_lead = P(self.pp_axis)
+        is_arr = lambda x: isinstance(  # noqa: E731
+            x, (jax.Array, np.ndarray))
+        pre_spec = jax.tree.map(
+            lambda _: rep, self._state[0], is_leaf=is_arr)
+        post_spec = jax.tree.map(
+            lambda _: rep, self._state[2], is_leaf=is_arr)
+        preu_spec = jax.tree.map(
+            lambda _: rep, self._state[3], is_leaf=is_arr)
+        postu_spec = jax.tree.map(
+            lambda _: rep, self._state[5], is_leaf=is_arr)
+        stack_spec = jax.tree.map(
+            lambda _: pp_lead, self._state[1], is_leaf=is_arr)
+        stacku_spec = jax.tree.map(
+            lambda _: pp_lead, self._state[4], is_leaf=is_arr)
+        # Batch specs are P() over the MANUAL pp axis; the dp sharding
+        # rides the input NamedSharding through the auto axes.
+        bspec = rep
+        step = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(pre_spec, stack_spec, post_spec, preu_spec,
+                      stacku_spec, postu_spec, rep, rep, bspec, bspec),
+            out_specs=(pre_spec, stack_spec, post_spec, preu_spec,
+                       stacku_spec, postu_spec, rep),
+            check_vma=False,
+            axis_names=frozenset({self.pp_axis}),
+        )
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
+
+    # -- public API ----------------------------------------------------
+    def _data_sharding(self, stacked=False):
+        # batch dim over dp (GSPMD-auto); replicated over pp/tp
+        if self.dp_axis is None:
+            return NamedSharding(self.mesh, P())
+        spec = (P(None, self.dp_axis) if stacked
+                else P(self.dp_axis))
+        return NamedSharding(self.mesh, spec)
+
+    def fit(self, data, labels=None) -> float:
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        net = self.net
+        if labels is not None:
+            data = DataSet(data, labels)
+        batches = [data] if isinstance(data, DataSet) else data
+        self._ensure_placed()
+        score = float("nan")
+        sh = self._data_sharding()
+        for ds in batches:
+            if ds.features_mask is not None or ds.labels_mask is not None:
+                raise ValueError(
+                    "HomogeneousPipelineTrainer does not support mask "
+                    "arrays; use the packed-row PipelineTrainer")
+            feats = jax.device_put(
+                jnp.asarray(ds.features, net._dtype), sh)
+            labs = jax.device_put(
+                jnp.asarray(ds.labels, net._dtype), sh)
+            key = (feats.shape, labs.shape)
+            if key not in self._step_cache:
+                self._step_cache[key] = self._build_step(
+                    feats.shape, labs.shape)
+            net._key, sub = jax.random.split(net._key)
+            (*state, s) = self._step_cache[key](
+                *self._state, net.iteration, sub, feats, labs)
+            self._state = tuple(state)
+            net.score_value = s
+            net.iteration += 1
+            score = float(s)
+        self._sync_to_net()
+        return score
+
+    def fit_scan(self, features_stacked, labels_stacked):
+        net = self.net
+        self._ensure_placed()
+        sh = self._data_sharding(stacked=True)
+        fs = jax.device_put(
+            jnp.asarray(features_stacked, net._dtype), sh)
+        ys = jax.device_put(
+            jnp.asarray(labels_stacked, net._dtype), sh)
+        key = ("scan", fs.shape, ys.shape)
+        if key not in self._step_cache:
+            self._step_cache[key] = self._build_step(
+                fs.shape[1:], ys.shape[1:], scan=True)
+        net._key, sub = jax.random.split(net._key)
+        (*state, scores) = self._step_cache[key](
+            *self._state, net.iteration, sub, fs, ys)
+        self._state = tuple(state)
+        net.iteration += int(fs.shape[0])
+        net.score_value = scores[-1]
+        self._sync_to_net()
+        return scores

@@ -1,0 +1,187 @@
+"""HomogeneousPipelineTrainer: dp x pp x tp on stage-stacked blocks
+(round-4 VERDICT item 3 — the packed-row trainer's documented tp wall,
+closed for homogeneous-stage models).
+
+Same verification pattern as tests/test_pipeline_expert.py for the
+packed trainer: single-device trajectory parity, per-device memory
+accounting (1/(S*T) here), and validation errors."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.homogeneous_pipeline import (
+    HomogeneousPipelineTrainer,
+    find_homogeneous_run,
+)
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+V, W, T = 8, 12, 12  # V != W so block 0 carries Wi (the pre group)
+
+
+def _net(n_layers=5, seed=11, width=W, heads=2):
+    # layer 0 projects V -> width (its Wi leaf breaks homogeneity), so
+    # the homogeneous run is blocks 1..n_layers-1 + pre/post replicated
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=heads,
+        lr=1e-2, warmup_steps=4, total_steps=400, seed=seed)
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(n=8, t=T, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, V, t)).astype(np.float32)
+    y = np.zeros((n, V, t), np.float32)
+    idx = rng.integers(0, V, (n, t))
+    for i in range(n):
+        y[i, idx[i], np.arange(t)] = 1.0
+    return x, y
+
+
+class TestRunDetection:
+    def test_finds_block_run(self):
+        net = _net(n_layers=5)
+        start, end = find_homogeneous_run(net)
+        # layer 0 (with Wi) excluded; LayerNorm + head excluded
+        assert (start, end) == (1, 5)
+
+    def test_indivisible_run_rejected(self):
+        net = _net(n_layers=4)  # run of 3 blocks, S=2
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        with pytest.raises(ValueError, match="not divisible"):
+            HomogeneousPipelineTrainer(net, mesh, n_microbatches=2)
+
+
+class TestTrajectoryParity:
+    def _parity(self, mesh_axes, tp_axis=None, steps=3):
+        x, y = _batch()
+        ref = _net()
+        pp_net = _net()
+        mesh = make_mesh(MeshSpec(mesh_axes))
+        trainer = HomogeneousPipelineTrainer(
+            pp_net, mesh, n_microbatches=4, tp_axis=tp_axis)
+        for _ in range(steps):
+            ref.fit(DataSet(x, y))
+            s_pp = trainer.fit(DataSet(x, y))
+        np.testing.assert_allclose(
+            s_pp, float(ref.score_value), rtol=2e-4)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(pp_net.params[si][name]),
+                    np.asarray(p), atol=3e-4,
+                    err_msg=f"param {si}/{name} diverged")
+
+    def test_pp_matches_single_device(self):
+        self._parity({"pp": 2})
+
+    def test_pp_tp_matches_single_device(self):
+        self._parity({"pp": 2, "tp": 2}, tp_axis="tp")
+
+    def test_dp_pp_tp_matches_single_device(self):
+        self._parity({"dp": 2, "pp": 2, "tp": 2}, tp_axis="tp")
+
+    def test_fit_scan_matches_fit(self):
+        x, y = _batch(n=8)
+        a = _net()
+        b = _net()
+        mesh = make_mesh(MeshSpec({"pp": 2, "tp": 2}))
+        ta = HomogeneousPipelineTrainer(
+            a, mesh, n_microbatches=2, tp_axis="tp")
+        tb = HomogeneousPipelineTrainer(
+            b, mesh, n_microbatches=2, tp_axis="tp")
+        K = 3
+        fs = np.stack([x] * K)
+        ys = np.stack([y] * K)
+        scores_scan = np.asarray(tb.fit_scan(fs, ys))
+        scores_fit = [ta.fit(DataSet(x, y)) for _ in range(K)]
+        np.testing.assert_allclose(
+            scores_scan, scores_fit, rtol=2e-4)
+        for si in a.params:
+            for name, p in a.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(b.params[si][name]), np.asarray(p),
+                    atol=3e-4, err_msg=f"{si}/{name}")
+
+
+class TestMemoryAccounting:
+    def test_per_device_stack_bytes_1_over_ST(self):
+        """Each device holds ~1/(S*T) of the stacked block params +
+        updater state — the dp x pp x tp memory claim, asserted the way
+        test_pipeline_expert.py:634 asserts the packed trainer's 1/S."""
+        net = _net(n_layers=5, width=16, heads=2)
+        mesh = make_mesh(MeshSpec({"pp": 2, "tp": 2}))
+        trainer = HomogeneousPipelineTrainer(
+            net, mesh, n_microbatches=2, tp_axis="tp")
+        per_dev = trainer.per_device_state_bytes()
+        total = trainer.total_stack_bytes()
+        S, Tp = 2, 2
+        assert len(per_dev) == S * Tp
+        for d, nbytes in per_dev.items():
+            # exact: every stacked leaf dim is divisible by its axis
+            frac = nbytes / total
+            assert abs(frac - 1 / (S * Tp)) < 0.02, (
+                f"{d}: {frac:.3f} of total, expected ~{1/(S*Tp):.3f}")
+
+    def test_tp_specs_applied(self):
+        net = _net(n_layers=5)
+        mesh = make_mesh(MeshSpec({"pp": 2, "tp": 2}))
+        trainer = HomogeneousPipelineTrainer(
+            net, mesh, n_microbatches=2, tp_axis="tp")
+        trainer._ensure_placed()
+        _, stack_p, _, _, stack_u, _ = trainer._state
+        assert tuple(stack_p["Wq"].sharding.spec) == (
+            "pp", None, None, "tp")
+        assert tuple(stack_p["W2"].sharding.spec) == (
+            "pp", None, "tp", None)
+        # Adam state mirrors the param layout
+        assert tuple(stack_u["m"]["Wq"].sharding.spec) == (
+            "pp", None, None, "tp")
+
+
+class TestValidation:
+    def test_rejects_tp_on_non_transformer_stack(self):
+        from deeplearning4j_tpu.models.zoo import mlp
+
+        net = MultiLayerNetwork(
+            mlp(sizes=(12, 8, 8, 8, 8, 8, 10))).init()
+        mesh = make_mesh(MeshSpec({"pp": 2, "tp": 2}))
+        with pytest.raises(ValueError, match="TransformerBlock"):
+            HomogeneousPipelineTrainer(
+                net, mesh, tp_axis="tp", n_microbatches=2)
+
+    def test_plain_pp_on_dense_stack_works(self):
+        """Without tp, any homogeneous run pipelines (Dense stacks)."""
+        from deeplearning4j_tpu.models.zoo import mlp
+
+        x = np.random.default_rng(0).normal(size=(8, 12)).astype(
+            np.float32)
+        y = np.eye(10, dtype=np.float32)[
+            np.random.default_rng(1).integers(0, 10, 8)]
+        sizes = (12, 8, 8, 8, 8, 8, 10)
+        ref = MultiLayerNetwork(mlp(sizes=sizes)).init()
+        net = MultiLayerNetwork(mlp(sizes=sizes)).init()
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        trainer = HomogeneousPipelineTrainer(
+            net, mesh, n_microbatches=2)
+        # run = the four interior 8->8 Dense layers; 12->8 & head repl.
+        assert trainer.run[1] - trainer.run[0] == 4
+        for _ in range(2):
+            ref.fit(DataSet(x, y))
+            s = trainer.fit(DataSet(x, y))
+        np.testing.assert_allclose(s, float(ref.score_value),
+                                   rtol=2e-4)
+
+    def test_rejects_masks(self):
+        net = _net()
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        trainer = HomogeneousPipelineTrainer(
+            net, mesh, n_microbatches=2)
+        x, y = _batch()
+        ds = DataSet(x, y)
+        ds.labels_mask = np.ones((8, T), np.float32)
+        with pytest.raises(ValueError, match="mask"):
+            trainer.fit(ds)
