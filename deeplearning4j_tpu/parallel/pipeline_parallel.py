@@ -302,8 +302,16 @@ class PipelineTrainer:
     exact single-device parity even when masks spread unevenly across
     microbatches.
 
+    **tBPTT** (round-4): TRUNCATED_BPTT configs train through the same
+    schedule, one window at a time — each time window runs the full
+    microbatched pipeline + one optimizer step, and per-(stage,
+    replica, microbatch) RNN carries cross windows stage-sharded under
+    stop-gradient (reference doTruncatedBPTT :1262 cadence; parity in
+    tests/test_pp_tbptt.py). Attention layers carry nothing across
+    windows (matching single-device training semantics).
+
     Limitations (documented, enforced): plain-SGD-family training only
-    (no tBPTT, no second-order solvers).
+    (no second-order solvers); tBPTT trains via fit(), not fit_scan.
 
     **Why pp composes with dp but not tp/fsdp.** The 1/S memory
     property comes from packing each stage's pytree into one row of a
@@ -343,8 +351,14 @@ class PipelineTrainer:
         self._stateful = sorted(
             si for si, st in (net.state or {}).items()
             if not (isinstance(st, dict) and set(st) <= {"aux_loss"}))
-        if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
-            raise ValueError("PipelineTrainer does not support tBPTT")
+        # tBPTT (round-4 VERDICT item 9): windows of the time axis run
+        # the full microbatched schedule each, with per-(stage,
+        # microbatch) RNN carries held stage-sharded between windows —
+        # deep LSTM stacks get the 1/S stage memory (reference
+        # doTruncatedBPTT MultiLayerNetwork.java:1262 semantics: one
+        # optimizer step per window, stop-gradient carries).
+        self.tbptt = (net.conf.backprop_type
+                      == BackpropType.TRUNCATED_BPTT)
         algo = net.conf.confs[0].optimization_algo
         if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
             raise ValueError(
@@ -375,6 +389,7 @@ class PipelineTrainer:
         self.dp_axis = dp_axis
         self.n_replicas = int(mesh.shape[dp_axis]) if dp_axis else 1
         self._step_cache = {}
+        self._rnn_dummy = None  # non-tBPTT steps carry a [.,.,1,1] stub
         # Stage-sharded packed training state ([S, K] P(pp) buffers).
         self._theta = None
         self._ustate = None
@@ -479,17 +494,21 @@ class PipelineTrainer:
 
     # -- stage math ----------------------------------------------------
     def _apply_stage(self, s: int, params, x, rngs, train=True,
-                     master_from=None, state=None, feature_mask=None):
+                     master_from=None, state=None, feature_mask=None,
+                     rnn_state=None):
         """Apply layers [start, end) of stage s (with preprocessors).
         Returns (activations, weighted aux-loss sum of the stage, new
-        running state of the stage's stateful layers).
+        running state of the stage's stateful layers, new RNN carries
+        of the stage's recurrent layers).
         ``master_from``: layer index from which activations are cast
         back to the master dtype (the f32 output-layer rule of
         MultiLayerNetwork._forward_fn under mixed precision).
         ``state``: {si: running-state} for this stage's stateful layers
         (BatchNorm mean/var).
         ``feature_mask``: this microbatch's [mb, T] time mask — handed
-        to recurrent layers only (the _forward_fn rule)."""
+        to recurrent layers only (the _forward_fn rule).
+        ``rnn_state``: {si: carry} for recurrent layers (tBPTT window
+        continuation; None carries = zero initial state)."""
         from deeplearning4j_tpu.nn.conf import layers as _L
         from deeplearning4j_tpu.nn.multilayer import _cast_floating
 
@@ -497,6 +516,7 @@ class PipelineTrainer:
         start, end = self.stage_ranges[s]
         aux = jnp.zeros((), net._dtype)
         new_state = {}
+        new_rnn = {}
         for i in range(start, end):
             si = str(i)
             c = net.conf.confs[i]
@@ -509,9 +529,12 @@ class PipelineTrainer:
                 # trajectories agree with single-device fit.
                 x = _cast_floating(x, net._dtype)
             is_rec = isinstance(c.layer, _L.RECURRENT_LAYER_TYPES)
+            layer_state = (state or {}).get(si)
+            if layer_state is None and rnn_state is not None:
+                layer_state = rnn_state.get(si)
             x, st = net._impls[i].apply(
                 c, params[si], x,
-                state=(state or {}).get(si), train=train, rng=rngs[i],
+                state=layer_state, train=train, rng=rngs[i],
                 mask=feature_mask if is_rec else None,
             )
             w = getattr(c.layer, "aux_weight", None)
@@ -522,7 +545,10 @@ class PipelineTrainer:
                 # as _forward_fn's carried-state cast)
                 new_state[si] = jax.tree.map(
                     lambda a: _cast_floating(a, net._dtype), st)
-        return x, aux, new_state
+            elif st is not None and rnn_state is not None and is_rec:
+                new_rnn[si] = jax.tree.map(
+                    lambda a: _cast_floating(a, net._dtype), st)
+        return x, aux, new_state, new_rnn
 
     def _boundary_shapes(self, feats_mb_shape):
         """Activation shape entering each stage (index 0 = input)."""
@@ -538,8 +564,36 @@ class PipelineTrainer:
             shapes.append(x.shape)
         return shapes
 
+    def _rnn_zero_trees(self, feats_mb_shape):
+        """Per-stage ZERO RNN-carry pytrees for one microbatch (probed
+        via eval_shape; recurrent impls treat a zero carry exactly as
+        the lazily-created initial carry).
+
+        Probed with ``train=True`` — the mode the schedule runs in.
+        This matters for attention layers (BaseRecurrentLayer
+        subclasses): their TRAINING apply carries no state (tBPTT
+        windows attend independently, same as single-device fit), while
+        inference builds a serving KV cache; a train=False probe would
+        collect that cache as a bogus window carry."""
+        net = self.net
+        rngs = [None] * net.n_layers
+        trees = []
+        x = jax.ShapeDtypeStruct(feats_mb_shape, net._dtype)
+        for s in range(self.n_stages):
+            out = jax.eval_shape(
+                lambda xx, _s=s: self._apply_stage(
+                    _s, net.params, xx, rngs, train=True,
+                    state=self._stage_state_subtree(_s),
+                    rnn_state={}), x)
+            x_struct, _, _, rnn_struct = out
+            trees.append(jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype), rnn_struct))
+            x = x_struct
+        return trees
+
     # -- the jitted step ----------------------------------------------
-    def _build_step(self, feats_shape, labels_shape, scan=False):
+    def _build_step(self, feats_shape, labels_shape, scan=False,
+                    tbptt=False):
         from deeplearning4j_tpu.nn.multilayer import (
             layer_reg_score,
             layer_update,
@@ -577,12 +631,16 @@ class PipelineTrainer:
         last_si = str(last_layer)
 
         s_pack = self._s_pack
+        # tBPTT: per-(stage, microbatch) RNN carries, packed like the
+        # other stage-sharded buffers (window continuation rows).
+        rnn_pack = (_StagePacker(self._rnn_zero_trees(feats_mb_shape))
+                    if tbptt else None)
 
         def branch(s):
             in_shape = shapes[s]
 
-            def run(theta_cd, theta_master, state_vec, x_feed, fm_mb,
-                    buf, y_mb, lm_mb, rngs):
+            def run(theta_cd, theta_master, state_vec, rnn_vec, x_feed,
+                    fm_mb, buf, y_mb, lm_mb, rngs):
                 params = p_pack.unpack_row(s, theta_cd)
                 if out_f32 and s == S - 1:
                     # The output layer's params come from the f32 row
@@ -595,12 +653,14 @@ class PipelineTrainer:
                 else:
                     w = widths[s]
                     xin = buf[:, :w].reshape(in_shape)
-                y, aux, new_st = self._apply_stage(
+                y, aux, new_st, new_rnn = self._apply_stage(
                     s, params, xin, rngs,
                     master_from=(last_layer
                                  if out_f32 and s == S - 1 else None),
                     state=s_pack.unpack_row(s, state_vec),
-                    feature_mask=fm_mb)
+                    feature_mask=fm_mb,
+                    rnn_state=(rnn_pack.unpack_row(s, rnn_vec)
+                               if rnn_pack else None))
                 if s == S - 1:
                     yl = y
                     if cd is not None:
@@ -618,7 +678,13 @@ class PipelineTrainer:
                 st_row = (lax.stop_gradient(
                     s_pack.pack_row(s, new_st, net._dtype))
                     if new_st else state_vec)
-                return yf, loss, aux, st_row
+                # The RNN carry crossing windows is a stop-gradient
+                # boundary (reference doTruncatedBPTT semantics; same
+                # as MultiLayerNetwork._tbptt_step's stop_gradient).
+                rnn_row = (lax.stop_gradient(
+                    rnn_pack.pack_row(s, new_rnn, net._dtype))
+                    if rnn_pack else rnn_vec)
+                return yf, loss, aux, st_row, rnn_row
 
             return run
 
@@ -661,10 +727,12 @@ class PipelineTrainer:
 
         upd_branches = [upd_branch(s) for s in range(S)]
 
-        def local_step(theta, ustate, sstate, iteration, rng, feats,
-                       labels, fm, lm):
+        def local_step(theta, ustate, sstate, rnn_in, iteration, rng,
+                       feats, labels, fm, lm):
             # theta [1, Kp]: this device's stage row. feats/labels: this
             # replica's batch shard (full batch when no dp axis).
+            # rnn_in [1, 1, M, Kr]: this (stage, replica)'s per-
+            # microbatch RNN carries (tBPTT only; [1] dummy otherwise).
             idx = lax.axis_index(axis)
             if dp is not None:
                 # Decorrelate dropout across replicas.
@@ -682,9 +750,12 @@ class PipelineTrainer:
                 hop_dtype = cd if cd is not None else net._dtype
                 buf0 = jnp.zeros((mb, K), hop_dtype)
                 loss0 = jnp.zeros((), net._dtype)
+                rnn0 = (rnn_in[0, 0] if tbptt
+                        else jnp.zeros((M, 1), net._dtype))
 
                 def tick(t, carry):
-                    buf, loss_acc, w_acc, aux_acc, st_vec = carry
+                    buf, loss_acc, w_acc, aux_acc, st_vec, rnn_mat = \
+                        carry
                     # Stage idx processes microbatch t - idx at tick t;
                     # fold the microbatch index into the rng so each
                     # microbatch draws distinct dropout masks.
@@ -697,9 +768,10 @@ class PipelineTrainer:
                     out_t = jnp.maximum(t - (S - 1), 0)
                     y_mb = y_mbs[out_t]
                     lm_mb = None if lm_mbs is None else lm_mbs[out_t]
-                    yf, loss, aux, st_new = lax.switch(
-                        idx, branches, tv, theta_row, st_vec, feed,
-                        fm_mb, buf, y_mb, lm_mb, rngs)
+                    rnn_vec = rnn_mat[mb_idx]
+                    yf, loss, aux, st_new, rnn_new = lax.switch(
+                        idx, branches, tv, theta_row, st_vec, rnn_vec,
+                        feed, fm_mb, buf, y_mb, lm_mb, rngs)
                     write = (idx == S - 1) & (t - (S - 1) >= 0)
                     # Masked losses are per-microbatch masked MEANS
                     # (ops/losses._reduce: sum(l*m)/max(sum(m),1));
@@ -715,18 +787,24 @@ class PipelineTrainer:
                     w_acc = w_acc + jnp.where(write, w_mb, 0.0)
                     # Stage idx holds a REAL microbatch only for ticks
                     # in [idx, idx + M); warmup/drain garbage must not
-                    # leak into the aux loss or the running statistics
-                    # (ghost-BN: one state update per VALID microbatch).
+                    # leak into the aux loss, the running statistics
+                    # (ghost-BN: one state update per VALID microbatch)
+                    # or the tBPTT window carries.
                     valid = (t >= idx) & (t < idx + M)
                     aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
                     st_vec = jnp.where(valid, st_new, st_vec)
+                    rnn_mat = lax.dynamic_update_index_in_dim(
+                        rnn_mat,
+                        jnp.where(valid, rnn_new, rnn_vec), mb_idx, 0)
                     perm = [(i, (i + 1) % S) for i in range(S)]
                     buf = lax.ppermute(yf, axis, perm)
-                    return buf, loss_acc, w_acc, aux_acc, st_vec
+                    return (buf, loss_acc, w_acc, aux_acc, st_vec,
+                            rnn_mat)
 
-                _, loss_sum, w_sum, aux_sum, st_final = lax.fori_loop(
+                (_, loss_sum, w_sum, aux_sum, st_final,
+                 rnn_final) = lax.fori_loop(
                     0, M + S - 1, tick,
-                    (buf0, loss0, loss0, loss0, sstate[0]))
+                    (buf0, loss0, loss0, loss0, sstate[0], rnn0))
                 # LOCAL (unreduced) stage contribution: data loss lives
                 # on the last stage, aux/reg on each stage. The global
                 # score = psum of these, but the psum must happen OUTSIDE
@@ -754,10 +832,10 @@ class PipelineTrainer:
                 w_g = lax.psum(w_sum, dp) if dp is not None else w_sum
                 data = loss_sum / jnp.maximum(w_g, 1.0)
                 return (data + aux_sum / (M * R) + reg / R,
-                        st_final)
+                        (st_final, rnn_final))
 
-            (score_local, st_final), grad = jax.value_and_grad(
-                loss_fn, has_aux=True)(theta[0])
+            (score_local, (st_final, rnn_final)), grad = \
+                jax.value_and_grad(loss_fn, has_aux=True)(theta[0])
             # Reported score: sum of stage contributions over the ring.
             score = lax.psum(score_local, axis)
             if dp is not None:
@@ -765,12 +843,16 @@ class PipelineTrainer:
                 # carries the cross-replica weight total); ghost-BN
                 # running statistics average across replicas (the
                 # per-replica microbatch stats are equal-sized samples).
+                # RNN window carries stay per-replica (each replica's
+                # batch shard continues its own sequences).
                 grad = lax.psum(grad, dp)
                 score = lax.psum(score, dp)
                 st_final = lax.pmean(st_final, dp)
             new_t, new_u = lax.switch(
                 idx, upd_branches, theta[0], grad, ustate[0], iteration)
-            return new_t[None], new_u[None], st_final[None], score
+            rnn_out = rnn_final[None, None] if tbptt else rnn_in
+            return (new_t[None], new_u[None], st_final[None], rnn_out,
+                    score)
 
         if not scan:
             fn = local_step
@@ -781,40 +863,112 @@ class PipelineTrainer:
             # optimizer run is ONE dispatch (the fit_scan fusion the
             # other trainers have — per-batch dispatch latency
             # otherwise dominates small models on a tunnel transport).
-            def local_steps(theta, ustate, sstate, iteration, rng,
+            def local_steps(theta, ustate, sstate, rnn, iteration, rng,
                             fs, ys, fms, lms):
                 def body(carry, inp):
-                    th, us, ss, it = carry
-                    th, us, ss, score = local_step(
-                        th, us, ss, it,
+                    th, us, ss, rn, it = carry
+                    th, us, ss, rn, score = local_step(
+                        th, us, ss, rn, it,
                         jax.random.fold_in(rng, inp["k"]),
                         inp["f"], inp["y"], inp.get("fm"),
                         inp.get("lm"))
-                    return (th, us, ss, it + 1), score
+                    return (th, us, ss, rn, it + 1), score
 
                 xs = {"f": fs, "y": ys, "k": jnp.arange(fs.shape[0])}
                 if fms is not None:
                     xs["fm"] = fms
                 if lms is not None:
                     xs["lm"] = lms
-                (theta, ustate, sstate, _), scores = jax.lax.scan(
-                    body, (theta, ustate, sstate, iteration), xs)
-                return theta, ustate, sstate, scores
+                (theta, ustate, sstate, rnn, _), scores = jax.lax.scan(
+                    body, (theta, ustate, sstate, rnn, iteration), xs)
+                return theta, ustate, sstate, rnn, scores
 
             fn = local_steps
             bspec = P(None, dp) if dp is not None else P()
 
         pp = P(self.pp_axis)
+        rnnspec = P(self.pp_axis, dp) if dp is not None else P(
+            self.pp_axis)
         step = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(pp, pp, pp, P(), P(), bspec, bspec, bspec, bspec),
-            out_specs=(pp, pp, pp, P()),
+            in_specs=(pp, pp, pp, rnnspec, P(), P(), bspec, bspec,
+                      bspec, bspec),
+            out_specs=(pp, pp, pp, rnnspec, P()),
             check_vma=False,
         )
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        jitted = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        # fit() needs the buffer's global shape to (zero-)init the
+        # window carries per batch ([1] dummy axes when not tBPTT).
+        rnn_shape = (S, R, M, rnn_pack.width) if tbptt else (S, R, 1, 1)
+        return jitted, rnn_shape
 
     # -- public API ----------------------------------------------------
+    def _rnn_sharding(self):
+        spec = (P(self.pp_axis, self.dp_axis)
+                if self.dp_axis is not None else P(self.pp_axis))
+        return NamedSharding(self.mesh, spec)
+
+    def _zero_rnn(self, rnn_shape):
+        return jax.device_put(
+            jnp.zeros(rnn_shape, self.net._dtype), self._rnn_sharding())
+
+    def _run_step(self, key, build_args, step_args, rnn):
+        """Build-or-fetch the step for ``key``, zero-init the RNN
+        buffer when absent, run one step. Returns (rnn', score)."""
+        net = self.net
+        if key not in self._step_cache:
+            self._step_cache[key] = self._build_step(*build_args)
+        step, rnn_shape = self._step_cache[key]
+        if rnn is None:
+            rnn = self._zero_rnn(rnn_shape)
+        net._key, sub = jax.random.split(net._key)
+        self._theta, self._ustate, self._sstate, rnn, s = step(
+            self._theta, self._ustate, self._sstate, rnn,
+            net.iteration, sub, *step_args)
+        net.score_value = s
+        net.iteration += 1
+        return rnn, s
+
+    def _fit_tbptt_batch(self, ds, bspec) -> float:
+        """Windowed tBPTT through the pipeline (reference
+        doTruncatedBPTT :1262-1320): each time window runs the FULL
+        microbatched GPipe schedule + one optimizer step; RNN carries
+        live stage-sharded per (stage, replica, microbatch) and cross
+        windows under stop-gradient."""
+        net = self.net
+        length = net.conf.tbptt_fwd_length
+        feats = jnp.asarray(ds.features, net._dtype)
+        labels = jnp.asarray(ds.labels, net._dtype)
+        fmask = (None if ds.features_mask is None
+                 else jnp.asarray(ds.features_mask, net._dtype))
+        lmask = (None if ds.labels_mask is None
+                 else jnp.asarray(ds.labels_mask, net._dtype))
+        t_total = feats.shape[2]
+        rnn = None  # fresh zero carries per batch (reference parity)
+        s = float("nan")
+        for start in range(0, t_total, length):
+            end = min(start + length, t_total)
+            fw = jax.device_put(feats[:, :, start:end], bspec)
+            lw = jax.device_put(labels[:, :, start:end], bspec)
+            fmw = (None if fmask is None else jax.device_put(
+                fmask[:, start:end], bspec))
+            lmw = (None if lmask is None else jax.device_put(
+                lmask[:, start:end], bspec))
+            key = ("tbptt", fw.shape, lw.shape,
+                   None if fmw is None else fmw.shape,
+                   None if lmw is None else lmw.shape)
+            rnn, s = self._run_step(
+                key, (fw.shape, lw.shape, False, True),
+                (fw, lw, fmw, lmw), rnn)
+            # Per-WINDOW listener cadence (single-device _fit_tbptt
+            # parity: iteration_done after every window).
+            if net.listeners and jax.process_count() == 1:
+                self._sync_to_net()
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration)
+        return float(s)
+
     def fit(self, data, labels=None) -> float:
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
@@ -828,6 +982,9 @@ class PipelineTrainer:
                  if self.dp_axis is not None
                  else NamedSharding(self.mesh, P()))
         for ds in batches:
+            if self.tbptt:
+                score = self._fit_tbptt_batch(ds, bspec)
+                continue
             feats = jax.device_put(
                 jnp.asarray(ds.features, net._dtype), bspec)
             labs = jax.device_put(
@@ -839,17 +996,9 @@ class PipelineTrainer:
             key = (feats.shape, labs.shape,
                    None if fm is None else fm.shape,
                    None if lm is None else lm.shape)
-            if key not in self._step_cache:
-                self._step_cache[key] = self._build_step(
-                    feats.shape, labs.shape)
-            net._key, sub = jax.random.split(net._key)
-            self._theta, self._ustate, self._sstate, s = \
-                self._step_cache[key](
-                    self._theta, self._ustate, self._sstate,
-                    net.iteration, sub, feats, labs, fm, lm,
-                )
-            net.score_value = s
-            net.iteration += 1
+            self._rnn_dummy, s = self._run_step(
+                key, (feats.shape, labs.shape),
+                (feats, labs, fm, lm), self._rnn_dummy)
             score = float(s)
             if net.listeners and jax.process_count() == 1:
                 # Listeners may inspect/checkpoint net.params: sync the
@@ -876,6 +1025,10 @@ class PipelineTrainer:
         other trainers have, on the stage-sharded pp (x dp) mesh.
         Returns the K per-step scores."""
         net = self.net
+        if self.tbptt:
+            raise ValueError(
+                "fit_scan is the full-BPTT fast path; truncated-BPTT "
+                "configs train via fit() (windowed schedule)")
         self._ensure_packed()
         ksh = NamedSharding(
             self.mesh,
@@ -894,13 +1047,16 @@ class PipelineTrainer:
         if key not in self._step_cache:
             self._step_cache[key] = self._build_step(
                 fs.shape[1:], ys.shape[1:], scan=True)
+        step, rnn_shape = self._step_cache[key]
+        if self._rnn_dummy is None:
+            self._rnn_dummy = self._zero_rnn(rnn_shape)
         net._key, sub = jax.random.split(net._key)
         start = net.iteration
-        self._theta, self._ustate, self._sstate, scores = \
-            self._step_cache[key](
-                self._theta, self._ustate, self._sstate,
-                net.iteration, sub, fs, ys, fms, lms,
-            )
+        (self._theta, self._ustate, self._sstate, self._rnn_dummy,
+         scores) = step(
+            self._theta, self._ustate, self._sstate, self._rnn_dummy,
+            net.iteration, sub, fs, ys, fms, lms,
+        )
         net.iteration += K
         net.score_value = scores[-1]
         self._sync_to_net()
