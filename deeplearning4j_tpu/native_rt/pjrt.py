@@ -80,6 +80,32 @@ class PjrtClient:
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int32, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
             ctypes.c_char_p, ctypes.c_int]
+        # serving API (compile-once, multi-arg execute, device buffers)
+        lib.dl4j_pjrt_compile.restype = ctypes.c_void_p
+        lib.dl4j_pjrt_compile.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_int]
+        lib.dl4j_pjrt_exe_destroy.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.dl4j_pjrt_buffer_from_host_f32.restype = ctypes.c_void_p
+        lib.dl4j_pjrt_buffer_from_host_f32.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.dl4j_pjrt_buffer_destroy.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.dl4j_pjrt_buffer_to_host_f32.restype = ctypes.c_int64
+        lib.dl4j_pjrt_buffer_to_host_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.dl4j_pjrt_execute.restype = ctypes.c_int64
+        lib.dl4j_pjrt_execute.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int]
         err = ctypes.create_string_buffer(4096)
         self._h = lib.dl4j_pjrt_open(
             plugin_path.encode(), options.encode(), err, len(err))
@@ -126,12 +152,13 @@ class PjrtClient:
         self.close()
 
 
-def serialize_for_pjrt(fn, example_arg) -> Tuple[bytes, bytes]:
+def serialize_for_pjrt(fn, *example_args) -> Tuple[bytes, bytes]:
     """(VHLO bytecode, serialized CompileOptionsProto) for a jittable
-    single-input function — the portable pair PjrtClient.run_f32 takes."""
+    function — the portable pair PjrtClient.run_f32 /
+    CompiledProgram consume."""
     import jax
 
-    exported = jax.export.export(jax.jit(fn))(example_arg)
+    exported = jax.export.export(jax.jit(fn))(*example_args)
     from jax._src import compiler
 
     copts = compiler.get_compile_options(
@@ -174,6 +201,148 @@ def export_network_for_native(net, example_input) -> Tuple[bytes, bytes]:
     # model's f32 outputs.
     with jax.default_matmul_precision("highest"):
         return serialize_for_pjrt(forward, jnp.asarray(example_input))
+
+
+class DeviceBuffer:
+    """A device-resident PJRT buffer owned by the native client (the
+    decode loop's cache tensors never round-trip to host)."""
+
+    def __init__(self, client: "PjrtClient", handle):
+        self._client = client
+        self._h = handle
+
+    def to_host(self, capacity: int = 1 << 20) -> np.ndarray:
+        lib, h = self._client._lib, self._client._h
+        # np.empty, not a ctypes array: ctypes zero-fills its buffer,
+        # which costs milliseconds at MB sizes — inside the per-token
+        # decode loop that allocator noise would pollute the latency
+        # this API exists to measure.
+        out = np.empty(capacity, np.float32)
+        err = ctypes.create_string_buffer(4096)
+        n = lib.dl4j_pjrt_buffer_to_host_f32(
+            h, self._h,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            capacity, err, len(err))
+        if n < 0:
+            raise RuntimeError(
+                f"buffer fetch failed: "
+                f"{err.value.decode(errors='replace')[:300]}")
+        return out[:n].copy()
+
+    def destroy(self) -> None:
+        if self._h:
+            self._client._lib.dl4j_pjrt_buffer_destroy(
+                self._client._h, self._h)
+            self._h = None
+
+
+class CompiledProgram:
+    """A compile-ONCE executable on the native client: ``execute``
+    takes/returns DeviceBuffers (N args, M outputs) — the serving-loop
+    shape (per-step recompilation or host round-trips of the KV cache
+    would dominate decode latency)."""
+
+    def __init__(self, client: "PjrtClient", code: bytes,
+                 compile_options: bytes = b""):
+        self._client = client
+        err = ctypes.create_string_buffer(4096)
+        lib = client._lib
+        self._h = lib.dl4j_pjrt_compile(
+            client._h, code, len(code), compile_options,
+            len(compile_options), err, len(err))
+        if not self._h:
+            raise RuntimeError(
+                f"PJRT compile failed: "
+                f"{err.value.decode(errors='replace')[:500]}")
+
+    def execute(self, inputs, max_outputs: int = 256):
+        """inputs: list of DeviceBuffer; returns list of DeviceBuffer."""
+        lib, h = self._client._lib, self._client._h
+        n_in = len(inputs)
+        in_arr = (ctypes.c_void_p * n_in)(
+            *[b._h for b in inputs])
+        out_arr = (ctypes.c_void_p * max_outputs)()
+        err = ctypes.create_string_buffer(4096)
+        n = lib.dl4j_pjrt_execute(
+            h, self._h, in_arr, n_in, out_arr, max_outputs, err,
+            len(err))
+        if n < 0:
+            raise RuntimeError(
+                f"PJRT execute failed: "
+                f"{err.value.decode(errors='replace')[:500]}")
+        return [DeviceBuffer(self._client, out_arr[i])
+                for i in range(n)]
+
+    def destroy(self) -> None:
+        if self._h:
+            self._client._lib.dl4j_pjrt_exe_destroy(
+                self._client._h, self._h)
+            self._h = None
+
+
+def buffer_from_host(client: "PjrtClient", x: np.ndarray) -> DeviceBuffer:
+    x = np.ascontiguousarray(x, np.float32)
+    dims = (ctypes.c_int64 * x.ndim)(*x.shape)
+    err = ctypes.create_string_buffer(4096)
+    h = client._lib.dl4j_pjrt_buffer_from_host_f32(
+        client._h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        dims, x.ndim, err, len(err))
+    if not h:
+        raise RuntimeError(
+            f"buffer upload failed: "
+            f"{err.value.decode(errors='replace')[:300]}")
+    return DeviceBuffer(client, h)
+
+
+def export_decode_step_for_native(net, n_batch: int = 1):
+    """Serialize ONE KV-cache decode step of a causal attention net
+    (params baked in) to the (VHLO, CompileOptions) pair plus the cache
+    template the caller zero-initializes.
+
+    The exported function is
+    ``(x_t [B, C, 1], *cache_leaves_f32) -> (logits [B, V, 1],
+    *new_cache_leaves_f32)`` with FIXED shapes (attention.py
+    stream_max_t sliding cache — one compiled step serves any context
+    length). int32 cache leaves (the 'filled' counters) ride as f32
+    through the C ABI and are cast back inside the program.
+
+    Returns (code, copts, cache_template, treedef) where
+    cache_template is a list of zero np.float32 arrays in flatten
+    order."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.layers.attention import guard_streamable
+
+    guard_streamable(
+        (str(i), c.layer) for i, c in enumerate(net.conf.confs))
+    params = jax.tree.map(jnp.asarray, net.params)
+    state = jax.tree.map(jnp.asarray, net.state) if net.state else {}
+    n_in = net.conf.confs[0].layer.n_in
+
+    # Probe the cache structure: one streaming step from empty state.
+    x_probe = jnp.zeros((n_batch, n_in, 1), jnp.float32)
+    _, _, rnn0 = jax.eval_shape(
+        lambda x: net._forward_fn(params, state, x, None, False,
+                                  rnn_state=None), x_probe)
+    leaves, treedef = jax.tree.flatten(rnn0)
+    dtypes = [l.dtype for l in leaves]
+    template = [np.zeros(l.shape, np.float32) for l in leaves]
+
+    def decode_step(x, *cache_f32):
+        cache = jax.tree.unflatten(
+            treedef,
+            [c.astype(d) for c, d in zip(cache_f32, dtypes)])
+        out, _, new_rnn = net._forward_fn(
+            params, state, x, None, False, rnn_state=cache)
+        new_flat = [l.astype(jnp.float32)
+                    for l in jax.tree.leaves(new_rnn)]
+        return (out.astype(jnp.float32), *new_flat)
+
+    with jax.default_matmul_precision("highest"):
+        code, copts = serialize_for_pjrt(
+            decode_step, x_probe, *[jnp.asarray(t) for t in template])
+    return (code, copts, template, treedef)
 
 
 def harness_tpu_options() -> Optional[str]:

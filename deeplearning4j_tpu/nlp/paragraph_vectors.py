@@ -124,7 +124,7 @@ class ParagraphVectors(SequenceVectors):
 
         @functools.partial(jax.jit, static_argnames=("steps",))
         def infer(vec, idxs, syn1, syn1neg, codes, points, cmask,
-                  neg_logits, key, lr0, steps):
+                  neg_table, key, lr0, steps):
             def body(s, carry):
                 vec, key = carry
                 lr = lr0 * (1.0 - s / steps)
@@ -137,9 +137,13 @@ class ParagraphVectors(SequenceVectors):
                 if negative > 0:
                     key, sub = jax.random.split(key)
                     pos = syn1neg[idxs]  # [T, D]
-                    negs = jax.random.categorical(
-                        sub, neg_logits, shape=(idxs.shape[0], negative)
-                    )
+                    # unigram-TABLE draws (sequence_vectors.py: the
+                    # categorical-over-[V] path materializes [T, K, V]
+                    # gumbel noise; the table is O(1) per draw)
+                    draws = jax.random.randint(
+                        sub, (idxs.shape[0], negative), 0,
+                        neg_table.shape[0])
+                    negs = neg_table[draws]
                     wneg = syn1neg[negs]  # [T, K, D]
                     g_pos = 1.0 - jax.nn.sigmoid(pos @ vec)  # [T]
                     g_neg = -jax.nn.sigmoid(
@@ -186,7 +190,7 @@ class ParagraphVectors(SequenceVectors):
             cmask = jnp.zeros((t, 1), jnp.float32)
         vec = self._infer_fn(
             vec, idxs, self.syn1, self.syn1neg, codes, points, cmask,
-            self._neg_logits, key, lr, steps,
+            self._neg_table, key, lr, steps,
         )
         return np.asarray(vec)
 

@@ -113,9 +113,23 @@ class SequenceVectors:
             # tunnel transport)
             self._code_len_np = mask.sum(axis=1)
             self._code_lmax = int(codes.shape[1])
-        self._neg_logits = jnp.log(
-            jnp.asarray(unigram_table_probs(self.vocab))
-        )
+        # Negative sampling draws from a PRECOMPUTED unigram table
+        # (reference InMemoryLookupTable's table, sized 1e8 there):
+        # table[uniform_int] is O(1) per draw, where categorical over
+        # [V] logits materializes (B, K, V) gumbel noise — 4e9 floats
+        # per batch at V=100k (measured ~130 ms/batch, the large-vocab
+        # NS wall; BENCHMARKS.md W2V section). Table quantization of
+        # p^0.75 matches the reference's sampling semantics exactly.
+        probs = np.asarray(unigram_table_probs(self.vocab), np.float64)
+        tsize = int(min(2 ** 24, max(2 ** 20, 16 * v)))
+        # Cumulative fill (reference table construction): slot i holds
+        # the word whose cumulative p^0.75 mass covers fraction i/tsize
+        # — every word gets >= 0 slots with NO truncation bias against
+        # the tail (a per-word min-1-then-truncate scheme would cut the
+        # rarest words' slots whenever rounding overshoots).
+        cum = np.cumsum(probs / probs.sum())
+        self._neg_table = jnp.asarray(np.searchsorted(
+            cum, (np.arange(tsize) + 0.5) / tsize).astype(np.int32))
 
     # ------------------------------------------------------------------
     # Pair mining (host side)
@@ -403,15 +417,15 @@ class SequenceVectors:
 
     @functools.cached_property
     def _ns_inner(self):
-        neg_logits = self._neg_logits
+        neg_table = self._neg_table
         k = self.negative
 
         def step(syn0, syn1neg, centers, contexts, lr, rng):
             h = syn0[contexts]  # [B, D]
             pos = syn1neg[centers]  # [B, D]
-            negs = jax.random.categorical(
-                rng, neg_logits, shape=(centers.shape[0], k)
-            )  # [B, K]
+            draws = jax.random.randint(
+                rng, (centers.shape[0], k), 0, neg_table.shape[0])
+            negs = neg_table[draws]  # [B, K]
             wneg = syn1neg[negs]  # [B, K, D]
             dot_pos = jnp.sum(pos * h, axis=-1)  # [B]
             dot_neg = jnp.einsum("bkd,bd->bk", wneg, h)

@@ -392,4 +392,207 @@ int64_t dl4j_pjrt_run_f32(void* handle, const char* code,
   return copy_failed ? -1 : n_floats;
 }
 
+// ---------------------------------------------------------------------
+// Serving API (round 4): compile ONCE, execute repeatedly with N args
+// and M outputs, buffers staying device-resident between steps — the
+// shape a KV-cache decode loop needs (per-step recompile or per-step
+// host round-trips of the cache would dominate decode latency).
+// ---------------------------------------------------------------------
+
+void* dl4j_pjrt_compile(void* handle, const char* code, int64_t code_size,
+                        const char* copts, int64_t copts_size, char* err,
+                        int errn) {
+  auto* h = static_cast<Handle*>(handle);
+  const PJRT_Api* api = h->api;
+  PJRT_Program prog;
+  std::memset(&prog, 0, sizeof(prog));
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.code = const_cast<char*>(code);
+  prog.code_size = size_t(code_size);
+  static const char kFormat[] = "mlir";
+  prog.format = kFormat;
+  prog.format_size = sizeof(kFormat) - 1;
+  PJRT_Client_Compile_Args comp;
+  std::memset(&comp, 0, sizeof(comp));
+  comp.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  comp.client = h->client;
+  comp.program = &prog;
+  comp.compile_options = copts ? copts : "";
+  comp.compile_options_size = size_t(copts_size);
+  if (take_error(api, api->PJRT_Client_Compile(&comp), err, errn)) {
+    return nullptr;
+  }
+  return comp.executable;
+}
+
+void dl4j_pjrt_exe_destroy(void* handle, void* exe) {
+  auto* h = static_cast<Handle*>(handle);
+  PJRT_LoadedExecutable_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+  d.executable = static_cast<PJRT_LoadedExecutable*>(exe);
+  h->api->PJRT_LoadedExecutable_Destroy(&d);
+}
+
+void* dl4j_pjrt_buffer_from_host_f32(void* handle, const float* in,
+                                     const int64_t* dims, int32_t nd,
+                                     char* err, int errn) {
+  auto* h = static_cast<Handle*>(handle);
+  const PJRT_Api* api = h->api;
+  PJRT_Client_AddressableDevices_Args devs;
+  std::memset(&devs, 0, sizeof(devs));
+  devs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  devs.client = h->client;
+  if (take_error(api, api->PJRT_Client_AddressableDevices(&devs), err,
+                 errn)) {
+    return nullptr;
+  }
+  if (devs.num_addressable_devices == 0) {
+    set_err(err, errn, "no addressable devices");
+    return nullptr;
+  }
+  PJRT_Client_BufferFromHostBuffer_Args hb;
+  std::memset(&hb, 0, sizeof(hb));
+  hb.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  hb.client = h->client;
+  hb.data = in;
+  hb.type = PJRT_Buffer_Type_F32;
+  hb.dims = dims;
+  hb.num_dims = size_t(nd);
+  hb.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  hb.device = devs.addressable_devices[0];
+  if (take_error(api, api->PJRT_Client_BufferFromHostBuffer(&hb), err,
+                 errn)) {
+    return nullptr;
+  }
+  if (!await_event(api, hb.done_with_host_buffer, err, errn)) {
+    PJRT_Buffer_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    d.buffer = hb.buffer;
+    api->PJRT_Buffer_Destroy(&d);
+    return nullptr;
+  }
+  return hb.buffer;
+}
+
+void dl4j_pjrt_buffer_destroy(void* handle, void* buf) {
+  auto* h = static_cast<Handle*>(handle);
+  PJRT_Buffer_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  d.buffer = static_cast<PJRT_Buffer*>(buf);
+  h->api->PJRT_Buffer_Destroy(&d);
+}
+
+int64_t dl4j_pjrt_buffer_to_host_f32(void* handle, void* buf, float* out,
+                                     int64_t out_capacity, char* err,
+                                     int errn) {
+  auto* h = static_cast<Handle*>(handle);
+  const PJRT_Api* api = h->api;
+  auto* b = static_cast<PJRT_Buffer*>(buf);
+  PJRT_Buffer_Dimensions_Args bd;
+  std::memset(&bd, 0, sizeof(bd));
+  bd.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+  bd.buffer = b;
+  if (take_error(api, api->PJRT_Buffer_Dimensions(&bd), err, errn)) {
+    return -1;
+  }
+  std::vector<int64_t> minor_to_major(bd.num_dims);
+  for (size_t i = 0; i < bd.num_dims; ++i) {
+    minor_to_major[i] = int64_t(bd.num_dims - 1 - i);
+  }
+  PJRT_Buffer_MemoryLayout row_major;
+  std::memset(&row_major, 0, sizeof(row_major));
+  row_major.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+  row_major.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
+  row_major.tiled.struct_size = PJRT_Buffer_MemoryLayout_Tiled_STRUCT_SIZE;
+  row_major.tiled.minor_to_major = minor_to_major.data();
+  row_major.tiled.minor_to_major_size = minor_to_major.size();
+  PJRT_Buffer_ToHostBuffer_Args th;
+  std::memset(&th, 0, sizeof(th));
+  th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  th.src = b;
+  th.host_layout = &row_major;
+  th.dst = nullptr;  // query size
+  if (take_error(api, api->PJRT_Buffer_ToHostBuffer(&th), err, errn)) {
+    return -1;
+  }
+  int64_t n_floats = int64_t(th.dst_size / sizeof(float));
+  if (n_floats > out_capacity) {
+    set_err(err, errn, "output larger than caller capacity");
+    return -1;
+  }
+  th.dst = out;
+  if (take_error(api, api->PJRT_Buffer_ToHostBuffer(&th), err, errn)) {
+    return -1;
+  }
+  if (!await_event(api, th.event, err, errn)) return -1;
+  return n_floats;
+}
+
+int64_t dl4j_pjrt_execute(void* handle, void* exe, void** in_bufs,
+                          int32_t n_in, void** out_bufs,
+                          int32_t out_capacity, char* err, int errn) {
+  auto* h = static_cast<Handle*>(handle);
+  const PJRT_Api* api = h->api;
+  auto* e = static_cast<PJRT_LoadedExecutable*>(exe);
+
+  // number of outputs from the wrapped executable
+  PJRT_LoadedExecutable_GetExecutable_Args ge;
+  std::memset(&ge, 0, sizeof(ge));
+  ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ge.loaded_executable = e;
+  if (take_error(api, api->PJRT_LoadedExecutable_GetExecutable(&ge), err,
+                 errn)) {
+    return -1;
+  }
+  PJRT_Executable_NumOutputs_Args no;
+  std::memset(&no, 0, sizeof(no));
+  no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  no.executable = ge.executable;
+  if (take_error(api, api->PJRT_Executable_NumOutputs(&no), err, errn)) {
+    return -1;
+  }
+  int64_t n_out = int64_t(no.num_outputs);
+  if (n_out > out_capacity) {
+    set_err(err, errn, "more outputs than caller capacity");
+    return -1;
+  }
+
+  PJRT_ExecuteOptions opts;
+  std::memset(&opts, 0, sizeof(opts));
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  std::vector<PJRT_Buffer*> args(static_cast<size_t>(n_in));
+  for (int32_t i = 0; i < n_in; ++i) {
+    args[size_t(i)] = static_cast<PJRT_Buffer*>(in_bufs[i]);
+  }
+  PJRT_Buffer* const* arg_lists[1] = {args.data()};
+  std::vector<PJRT_Buffer*> outs(size_t(n_out), nullptr);
+  PJRT_Buffer** out_lists[1] = {outs.data()};
+  PJRT_Event* done[1] = {nullptr};
+
+  PJRT_LoadedExecutable_Execute_Args ex;
+  std::memset(&ex, 0, sizeof(ex));
+  ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ex.executable = e;
+  ex.options = &opts;
+  ex.argument_lists = arg_lists;
+  ex.num_devices = 1;
+  ex.num_args = size_t(n_in);
+  ex.output_lists = out_lists;
+  ex.device_complete_events = done;
+  if (take_error(api, api->PJRT_LoadedExecutable_Execute(&ex), err,
+                 errn)) {
+    return -1;
+  }
+  if (!await_event(api, done[0], err, errn)) return -1;
+  for (int64_t i = 0; i < n_out; ++i) {
+    out_bufs[i] = outs[size_t(i)];
+  }
+  return n_out;
+}
+
 }  // extern "C"
