@@ -22,12 +22,13 @@ from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 V, W, T = 8, 12, 12  # V != W so block 0 carries Wi (the pre group)
 
 
-def _net(n_layers=5, seed=11, width=W, heads=2):
+def _net(n_layers=5, seed=11, width=W, heads=2, remat=False):
     # layer 0 projects V -> width (its Wi leaf breaks homogeneity), so
     # the homogeneous run is blocks 1..n_layers-1 + pre/post replicated
     conf = transformer_lm_flagship(
         vocab=V, width=width, n_layers=n_layers, n_heads=heads,
-        lr=1e-2, warmup_steps=4, total_steps=400, seed=seed)
+        lr=1e-2, warmup_steps=4, total_steps=400, seed=seed,
+        remat=remat)
     return MultiLayerNetwork(conf).init()
 
 
@@ -140,6 +141,50 @@ class TestMemoryAccounting:
         # Adam state mirrors the param layout
         assert tuple(stack_u["m"]["Wq"].sharding.spec) == (
             "pp", None, None, "tp")
+
+
+class TestMixedPrecisionAndRemat:
+    def test_bf16_pp_tp_matches_bf16_single_device(self):
+        """The homogeneous trainer's compute-dtype path (bf16 blocks,
+        f32 master params + output head) must track single-device
+        mixed-precision fit."""
+        x, y = _batch(t=8)
+
+        def build():
+            net = _net()
+            for c in net.conf.confs:
+                c.compute_dtype = "bfloat16"
+            return net
+
+        ref, pp_net = build(), build()
+        mesh = make_mesh(MeshSpec({"pp": 2, "tp": 2}))
+        trainer = HomogeneousPipelineTrainer(
+            pp_net, mesh, n_microbatches=2, tp_axis="tp")
+        for _ in range(2):
+            ref.fit(DataSet(x, y))
+            s_pp = trainer.fit(DataSet(x, y))
+        # bf16 hop buffers + bf16 compute: tolerances match the packed
+        # trainer's mixed-precision parity tests
+        np.testing.assert_allclose(
+            s_pp, float(ref.score_value), rtol=5e-3)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(pp_net.params[si][name]),
+                    np.asarray(p), atol=5e-3,
+                    err_msg=f"{si}/{name} diverged under bf16 pp x tp")
+
+    def test_remat_pp_matches_single_device(self):
+        x, y = _batch(t=8)
+        ref, pp_net = _net(remat=True), _net(remat=True)
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        trainer = HomogeneousPipelineTrainer(
+            pp_net, mesh, n_microbatches=2)
+        for _ in range(2):
+            ref.fit(DataSet(x, y))
+            s_pp = trainer.fit(DataSet(x, y))
+        np.testing.assert_allclose(
+            s_pp, float(ref.score_value), rtol=2e-4)
 
 
 class TestValidation:
