@@ -162,13 +162,25 @@ def _cmd_train(args) -> int:
         except ValueError:
             raise SystemExit(
                 f"--mesh {args.mesh!r}: expected 'axis=N[,axis=N...]'")
-        if "dp" not in spec:
+        if "dp" not in spec and "pp" not in spec:
             raise SystemExit(
                 "--mesh must include a dp axis (the batch shards over "
-                "it), e.g. 'dp=8' or 'dp=2,tp=4'")
-        # Batches shard over dp (x fsdp): drop ragged tails so every
-        # device gets an equal slice (standard data-parallel trimming).
-        div = spec["dp"] * spec.get("fsdp", 1)
+                "it), e.g. 'dp=8' or 'dp=2,tp=4' — or a pp axis for "
+                "pipeline stages ('pp=4', 'dp=2,pp=2,tp=2')")
+        pp_microbatches = 4
+        if "pp" in spec:
+            bad = sorted(set(spec) & {"fsdp", "ep", "sp"})
+            if bad:
+                raise SystemExit(
+                    f"--mesh axes {bad} do not compose with pp: the "
+                    "pipeline trainers support pp [+ dp] (packed-row) "
+                    "and dp x pp x tp (homogeneous stages)")
+        # Batches shard over dp (x fsdp) and split into pipeline
+        # microbatches under pp: drop ragged tails so every device
+        # gets an equal slice (standard data-parallel trimming).
+        div = spec.get("dp", 1) * spec.get("fsdp", 1)
+        if "pp" in spec:
+            div *= pp_microbatches
         trimmed = [ds for ds in (
             DataSet(ds.features[:len(ds.features) // div * div],
                     ds.labels[:len(ds.features) // div * div])
@@ -183,13 +195,32 @@ def _cmd_train(args) -> int:
             print(f"note: dropped {dropped} ragged-tail examples so "
                   f"batches divide the {div} data shards")
         sets = trimmed
-        target = ParallelTrainer(
-            net, make_mesh(MeshSpec(spec)),
-            tp_axis="tp" if "tp" in spec else None,
-            fsdp_axis="fsdp" if "fsdp" in spec else None,
-            ep_axis="ep" if "ep" in spec else None,
-            sp_axis="sp" if "sp" in spec else None,
-        )
+        if "pp" in spec and "tp" in spec:
+            # dp x pp x tp needs per-tensor layouts: the homogeneous
+            # stage-stacked trainer (parallel/homogeneous_pipeline.py)
+            from deeplearning4j_tpu.parallel.homogeneous_pipeline import (  # noqa: E501
+                HomogeneousPipelineTrainer,
+            )
+
+            target = HomogeneousPipelineTrainer(
+                net, make_mesh(MeshSpec(spec)), tp_axis="tp",
+                n_microbatches=pp_microbatches)
+        elif "pp" in spec:
+            from deeplearning4j_tpu.parallel.pipeline_parallel import (
+                PipelineTrainer,
+            )
+
+            target = PipelineTrainer(
+                net, make_mesh(MeshSpec(spec)),
+                n_microbatches=pp_microbatches)
+        else:
+            target = ParallelTrainer(
+                net, make_mesh(MeshSpec(spec)),
+                tp_axis="tp" if "tp" in spec else None,
+                fsdp_axis="fsdp" if "fsdp" in spec else None,
+                ep_axis="ep" if "ep" in spec else None,
+                sp_axis="sp" if "sp" in spec else None,
+            )
     for _ in range(args.epochs):
         target.fit(ListDataSetIterator(sets))
     write_model(net, args.output)
@@ -344,7 +375,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--verbose", action="store_true")
     t.add_argument(
         "--mesh", default=None,
-        help="train over a device mesh, e.g. 'dp=8' or 'dp=2,tp=4': "
+        help="train over a device mesh, e.g. 'dp=8', 'dp=2,tp=4', "
+             "'pp=4' (GPipe stages), or 'dp=2,pp=2,tp=2' "
+             "(homogeneous-stage pipeline): "
              "axis sizes multiply to the device count; axes named "
              "tp/fsdp/ep/sp engage the corresponding ParallelTrainer "
              "sharding (dp shards the batch)")

@@ -18,21 +18,39 @@ Run: python examples/flagship_transformer.py [--width 512] [--mesh]
 """
 
 import argparse
+import os
+import sys
 import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+if os.environ.get("DL4J_EXAMPLES_PLATFORM", "cpu") == "cpu":
+    # --xla_force_host_platform_device_count only multiplies CPU
+    # devices; force the CPU backend so the simulated mesh exists even
+    # where an accelerator plugin is registered.
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--width", type=int, default=512)
-    ap.add_argument("--layers", type=int, default=4)
+    # 5 layers: block 0 carries the vocab->width projection (its own
+    # pre group under --mesh), leaving 4 identical blocks — divisible
+    # by the pp=2 stage axis
+    ap.add_argument("--layers", type=int, default=5)
     ap.add_argument("--epochs", type=int, default=6)
     ap.add_argument("--mesh", action="store_true",
                     help="train dp x pp x tp on an 8-device mesh")
     args = ap.parse_args()
-
-    import jax
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.datasets.markov import markov_lm_batches

@@ -140,6 +140,32 @@ class TestMeshTraining:
                      if "Accuracy" in ln][0].split()[-1])
         assert acc > 0.8
 
+    def test_train_with_pp_mesh(self, tmp_path, toy_csv, conf_json,
+                                capsys):
+        """`dl4j train --mesh pp=2`: GPipe pipeline stages from the
+        CLI (round 4); the saved model evaluates like single-device."""
+        model = str(tmp_path / "pp_model.zip")
+        rc = main(["train", "--conf", conf_json, "--input", toy_csv,
+                   "--output", model, "--epochs", "30",
+                   "--batch-size", "40", "--mesh", "pp=2"])
+        assert rc == 0 and os.path.exists(model)
+        rc = main(["test", "--model", model, "--input", toy_csv])
+        assert rc == 0
+        stats = capsys.readouterr().out
+        acc = float([ln for ln in stats.splitlines()
+                     if "Accuracy" in ln][0].split()[-1])
+        assert acc > 0.8
+
+    def test_pp_tp_mesh_requires_homogeneous_stack(self, tmp_path,
+                                                   toy_csv, conf_json):
+        """dp x pp x tp routes to the homogeneous trainer, which
+        rejects a 2-layer heterogeneous MLP with a clear error."""
+        with pytest.raises(ValueError, match="not divisible|homogeneous"):
+            main(["train", "--conf", conf_json, "--input", toy_csv,
+                  "--output", str(tmp_path / "m.zip"),
+                  "--batch-size", "40", "--epochs", "1",
+                  "--mesh", "dp=2,pp=2,tp=2"])
+
     def test_bad_mesh_flag_exits_clearly(self, tmp_path, toy_csv,
                                          conf_json):
         with pytest.raises(SystemExit, match="axis=N"):

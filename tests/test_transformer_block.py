@@ -234,6 +234,39 @@ class TestParallelComposition:
         assert np.asarray(out).shape == (3, 6, 5)
 
 
+class TestComputationGraph:
+    def test_transformer_block_in_graph(self):
+        """The block works as a ComputationGraph vertex (shared
+        get_impl registry — reference ComputationGraph.java DAG)."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(4).learning_rate(1e-2).updater("adam")
+            .activation("identity")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("blk", TransformerBlock(
+                n_in=6, n_out=8, n_heads=2), "in")
+            .add_layer("norm", L.LayerNormalization(n_in=8, n_out=8),
+                       "blk")
+            .add_layer("out", L.RnnOutputLayer(
+                n_in=8, n_out=6, activation="softmax",
+                loss_function=LossFunction.MCXENT), "norm")
+            .set_outputs("out")
+            .build()
+        )
+        g = ComputationGraph(conf).init()
+        ds = _lm_ds()
+        s0 = None
+        for _ in range(15):
+            g.fit(ds)
+            if s0 is None:
+                s0 = float(g.score_value)
+        assert np.isfinite(float(g.score_value))
+        assert float(g.score_value) < s0  # learning, not just running
+
+
 class TestMarkovTask:
     def test_entropy_floor_below_uniform(self):
         _, pi, floor = make_chain(32, seed=0, concentration=1.5)
