@@ -220,7 +220,8 @@ class BaseOptimizer:
     termination."""
 
     def __init__(self, net, max_iterations: Optional[int] = None,
-                 terminations=DEFAULT_CONDITIONS, step_function=None):
+                 terminations=DEFAULT_CONDITIONS, step_function=None,
+                 problem_factory=None):
         from deeplearning4j_tpu.optimize import stepfunctions
 
         self.net = net
@@ -232,6 +233,12 @@ class BaseOptimizer:
             stepfunctions.from_name(step_function) if step_function
             else stepfunctions.DefaultStepFunction()
         )
+        # Alternate problem representation (same value/grad/write_back
+        # surface as FlatProblem): PipelineTrainer injects a stage-
+        # sharded [S, K] problem here so CG/LBFGS run with 1/S of the
+        # model per device — the solver math (vdot/axpy) is pure jnp,
+        # so it runs sharded under GSPMD without further changes.
+        self.problem_factory = problem_factory
 
     def direction(self, x, grad, it: int) -> Array:
         raise NotImplementedError
@@ -240,7 +247,9 @@ class BaseOptimizer:
         pass
 
     def optimize(self, ds) -> float:
-        problem = FlatProblem(self.net, ds)
+        problem = (self.problem_factory(self.net, ds)
+                   if self.problem_factory is not None
+                   else FlatProblem(self.net, ds))
         self._problem = problem  # direction() hooks may need hvp access
         x = problem.x0
         score = None
@@ -388,8 +397,9 @@ class StochasticHessianFree(BaseOptimizer):
 
     def __init__(self, net, max_iterations: Optional[int] = None,
                  terminations=DEFAULT_CONDITIONS, cg_iterations: int = 50,
-                 initial_lambda: float = 0.01):
-        super().__init__(net, max_iterations, terminations)
+                 initial_lambda: float = 0.01, problem_factory=None):
+        super().__init__(net, max_iterations, terminations,
+                         problem_factory=problem_factory)
         self.cg_iterations = cg_iterations
         self.lam = initial_lambda
         self._last_quad = 0.0

@@ -34,6 +34,8 @@ import numpy as np
 from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
 Array = jax.Array
 
 
@@ -310,8 +312,15 @@ class PipelineTrainer:
     tests/test_pp_tbptt.py). Attention layers carry nothing across
     windows (matching single-device training semantics).
 
-    Limitations (documented, enforced): plain-SGD-family training only
-    (no second-order solvers); tBPTT trains via fit(), not fit_scan.
+    **Full-batch solvers** (round-4): CONJUGATE_GRADIENT / LBFGS /
+    LINE_GRADIENT_DESCENT / HESSIAN_FREE configs run the reference's
+    BaseOptimizer loop against a stage-sharded ``PipelinedProblem``
+    (see that class) — the solver's flat vector is the [S, K] P(pp)
+    theta buffer itself, so solver memory keeps the 1/S property.
+
+    Limitations (documented, enforced): tBPTT trains via fit() (not
+    fit_scan) and composes with SGD only (solvers are full-batch,
+    matching reference Solver semantics).
 
     **Why pp composes with dp but not tp/fsdp.** The 1/S memory
     property comes from packing each stage's pytree into one row of a
@@ -334,10 +343,7 @@ class PipelineTrainer:
         stage_ranges: Optional[Sequence[Tuple[int, int]]] = None,
         dp_axis: Optional[str] = None,
     ):
-        from deeplearning4j_tpu.nn.conf.enums import (
-            BackpropType,
-            OptimizationAlgorithm,
-        )
+        from deeplearning4j_tpu.nn.conf.enums import BackpropType
 
         net.init()
         # Aux-only state (MoeDense load-balance loss) is step-local and
@@ -359,11 +365,20 @@ class PipelineTrainer:
         # optimizer step per window, stop-gradient carries).
         self.tbptt = (net.conf.backprop_type
                       == BackpropType.TRUNCATED_BPTT)
-        algo = net.conf.confs[0].optimization_algo
-        if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+        # Full-batch solvers (CG/LBFGS/LineGD/HF) ride the same GPipe
+        # schedule: fit() hands a stage-sharded PipelinedProblem to the
+        # BaseOptimizer loop instead of stepping updaters — the [S, K]
+        # P(pp) rows serve as the solver's flat vector, so directions,
+        # line-search probes, and L-BFGS history all stay 1/S-sharded
+        # (reference Solver.java:42 dispatch; its solvers are full-batch
+        # there too, ConjugateGradient.java / LBFGS.java).
+        self.algo = net.conf.confs[0].optimization_algo
+        if (self.algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
+                and self.tbptt):
             raise ValueError(
-                "PipelineTrainer requires STOCHASTIC_GRADIENT_DESCENT "
-                f"(got {algo})")
+                "pipelined solvers are full-batch (reference Solver "
+                "semantics); truncated-BPTT composes with SGD only "
+                f"(got {self.algo})")
         self.net = net
         self.mesh = mesh
         self.pp_axis = pp_axis
@@ -593,7 +608,7 @@ class PipelineTrainer:
 
     # -- the jitted step ----------------------------------------------
     def _build_step(self, feats_shape, labels_shape, scan=False,
-                    tbptt=False):
+                    tbptt=False, solver=False):
         from deeplearning4j_tpu.nn.multilayer import (
             layer_reg_score,
             layer_update,
@@ -727,16 +742,16 @@ class PipelineTrainer:
 
         upd_branches = [upd_branch(s) for s in range(S)]
 
-        def local_step(theta, ustate, sstate, rnn_in, iteration, rng,
-                       feats, labels, fm, lm):
-            # theta [1, Kp]: this device's stage row. feats/labels: this
-            # replica's batch shard (full batch when no dp axis).
-            # rnn_in [1, 1, M, Kr]: this (stage, replica)'s per-
-            # microbatch RNN carries (tBPTT only; [1] dummy otherwise).
+        def make_loss_fn(feats, labels, fm, lm, rng, rnn_in, sstate_row,
+                         use_rng=True):
+            """The pipelined loss as f(theta_row) — one closure serves
+            both the SGD step (value_and_grad -> updaters) and the
+            solver functions (value_and_grad / value-only probes), so
+            the schedule/masked-mean/aux semantics cannot drift between
+            the two paths. ``use_rng=False`` is the solver mode: layer
+            rngs are None (no dropout), matching the single-device
+            FlatProblem's ``_loss_fn(params, state, None, ...)``."""
             idx = lax.axis_index(axis)
-            if dp is not None:
-                # Decorrelate dropout across replicas.
-                rng = jax.random.fold_in(rng, lax.axis_index(dp))
 
             def loss_fn(theta_row):
                 tv = theta_row.astype(cd) if cd is not None else theta_row
@@ -760,8 +775,9 @@ class PipelineTrainer:
                     # fold the microbatch index into the rng so each
                     # microbatch draws distinct dropout masks.
                     mb_idx = jnp.clip(t - idx, 0, M - 1)
-                    rngs = list(jax.random.split(
+                    rngs = (list(jax.random.split(
                         jax.random.fold_in(rng, mb_idx), net.n_layers))
+                        if use_rng else [None] * net.n_layers)
                     feed_t = jnp.minimum(t, M - 1)
                     feed = x_mbs[feed_t]
                     fm_mb = None if fm_mbs is None else fm_mbs[mb_idx]
@@ -804,7 +820,7 @@ class PipelineTrainer:
                 (_, loss_sum, w_sum, aux_sum, st_final,
                  rnn_final) = lax.fori_loop(
                     0, M + S - 1, tick,
-                    (buf0, loss0, loss0, loss0, sstate[0], rnn0))
+                    (buf0, loss0, loss0, loss0, sstate_row, rnn0))
                 # LOCAL (unreduced) stage contribution: data loss lives
                 # on the last stage, aux/reg on each stage. The global
                 # score = psum of these, but the psum must happen OUTSIDE
@@ -834,6 +850,21 @@ class PipelineTrainer:
                 return (data + aux_sum / (M * R) + reg / R,
                         (st_final, rnn_final))
 
+            return loss_fn
+
+        def local_step(theta, ustate, sstate, rnn_in, iteration, rng,
+                       feats, labels, fm, lm):
+            # theta [1, Kp]: this device's stage row. feats/labels: this
+            # replica's batch shard (full batch when no dp axis).
+            # rnn_in [1, 1, M, Kr]: this (stage, replica)'s per-
+            # microbatch RNN carries (tBPTT only; [1] dummy otherwise).
+            idx = lax.axis_index(axis)
+            if dp is not None:
+                # Decorrelate dropout across replicas.
+                rng = jax.random.fold_in(rng, lax.axis_index(dp))
+            loss_fn = make_loss_fn(feats, labels, fm, lm, rng, rnn_in,
+                                   sstate[0])
+
             (score_local, (st_final, rnn_final)), grad = \
                 jax.value_and_grad(loss_fn, has_aux=True)(theta[0])
             # Reported score: sum of stage contributions over the ring.
@@ -853,6 +884,45 @@ class PipelineTrainer:
             rnn_out = rnn_final[None, None] if tbptt else rnn_in
             return (new_t[None], new_u[None], st_final[None], rnn_out,
                     score)
+
+        if solver:
+            # Solver mode: expose the pipelined loss as value_and_grad /
+            # value-only functions over the [S, Kp] theta buffer — no
+            # updater application, no state mutation (single-device
+            # FlatProblem parity: loss_flat discards new_state). The
+            # grad buffer comes back P(pp)-sharded like theta, so the
+            # BaseOptimizer's vector math runs 1/S-sharded under GSPMD.
+            def local_vag(theta, sstate, feats, labels, fm, lm):
+                loss_fn = make_loss_fn(feats, labels, fm, lm, None,
+                                       None, sstate[0], use_rng=False)
+                (score_local, _), grad = jax.value_and_grad(
+                    loss_fn, has_aux=True)(theta[0])
+                score = lax.psum(score_local, axis)
+                if dp is not None:
+                    grad = lax.psum(grad, dp)
+                    score = lax.psum(score, dp)
+                return grad[None], score
+
+            def local_val(theta, sstate, feats, labels, fm, lm):
+                loss_fn = make_loss_fn(feats, labels, fm, lm, None,
+                                       None, sstate[0], use_rng=False)
+                score_local, _ = loss_fn(theta[0])
+                score = lax.psum(score_local, axis)
+                if dp is not None:
+                    score = lax.psum(score, dp)
+                return score
+
+            bspec = P(dp) if dp is not None else P()
+            pp = P(self.pp_axis)
+            vag = shard_map(
+                local_vag, mesh=self.mesh,
+                in_specs=(pp, pp, bspec, bspec, bspec, bspec),
+                out_specs=(pp, P()), check_vma=False)
+            val = shard_map(
+                local_val, mesh=self.mesh,
+                in_specs=(pp, pp, bspec, bspec, bspec, bspec),
+                out_specs=P(), check_vma=False)
+            return jax.jit(vag), jax.jit(val)
 
         if not scan:
             fn = local_step
@@ -930,6 +1000,24 @@ class PipelineTrainer:
         net.iteration += 1
         return rnn, s
 
+    def _fit_solver_batch(self, ds) -> float:
+        """Run the conf's full-batch solver (CG/LBFGS/LineGD/HF) on one
+        batch with the pipelined loss: the BaseOptimizer loop drives a
+        ``PipelinedProblem`` whose x IS the stage-sharded theta buffer
+        (reference Solver.java:42 dispatch; BaseOptimizer.optimize
+        :163-226 loop semantics preserved — same iterations, listeners,
+        terminations as the single-device path)."""
+        from deeplearning4j_tpu.optimize.solver import _OPTIMIZERS
+
+        try:
+            cls = _OPTIMIZERS[self.algo]
+        except KeyError:
+            raise ValueError(
+                f"Unsupported optimization algorithm {self.algo}")
+        opt = cls(self.net,
+                  problem_factory=lambda net, d: PipelinedProblem(self, d))
+        return float(opt.optimize(ds))
+
     def _fit_tbptt_batch(self, ds, bspec) -> float:
         """Windowed tBPTT through the pipeline (reference
         doTruncatedBPTT :1262-1320): each time window runs the FULL
@@ -982,6 +1070,10 @@ class PipelineTrainer:
                  if self.dp_axis is not None
                  else NamedSharding(self.mesh, P()))
         for ds in batches:
+            if (self.algo
+                    != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
+                score = self._fit_solver_batch(ds)
+                continue
             if self.tbptt:
                 score = self._fit_tbptt_batch(ds, bspec)
                 continue
@@ -1029,6 +1121,11 @@ class PipelineTrainer:
             raise ValueError(
                 "fit_scan is the full-BPTT fast path; truncated-BPTT "
                 "configs train via fit() (windowed schedule)")
+        if (self.algo
+                != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
+            raise ValueError(
+                "fit_scan is the SGD fast path; full-batch solver "
+                f"configs ({self.algo}) train via fit()")
         self._ensure_packed()
         ksh = NamedSharding(
             self.mesh,
@@ -1064,3 +1161,75 @@ class PipelineTrainer:
 
         fire_crossed(net.listeners, net, start, net.iteration)
         return scores
+
+
+class PipelinedProblem:
+    """``FlatProblem`` counterpart on the stage-sharded [S, Kp] buffer.
+
+    The solver's x IS the trainer's packed theta ([S, Kp] laid out
+    ``P(pp)``): ``value_and_grad``/``value`` run the full microbatched
+    GPipe schedule (forward-only for line-search probes), and every
+    vector the BaseOptimizer materializes from x — directions, CG
+    conjugates, L-BFGS s/y history — inherits the sharding through
+    jnp arithmetic, so per-device solver memory stays at 1/S of the
+    model like the SGD path (the property asserted in
+    tests/test_pipeline_expert.py:634).
+
+    Mirrors optimize/solver.py FlatProblem's surface: ``x0``,
+    ``value_and_grad(x) -> (score, grad)``, ``value(x) -> score``,
+    ``hessian_vector_product`` (forward-over-reverse jvp through the
+    shard_map'd gradient — the pipelined form of the reference R-op,
+    MultiLayerNetwork.computeDeltasR :728), ``write_back``.
+    """
+
+    def __init__(self, trainer: "PipelineTrainer", ds):
+        import jax.numpy as jnp
+
+        net = trainer.net
+        trainer._ensure_packed()
+        self._trainer = trainer
+        bspec = (NamedSharding(trainer.mesh, P(trainer.dp_axis))
+                 if trainer.dp_axis is not None
+                 else NamedSharding(trainer.mesh, P()))
+        self._feats = jax.device_put(
+            jnp.asarray(ds.features, net._dtype), bspec)
+        self._labels = jax.device_put(
+            jnp.asarray(ds.labels, net._dtype), bspec)
+        self._fm = (None if ds.features_mask is None else jax.device_put(
+            jnp.asarray(ds.features_mask, net._dtype), bspec))
+        self._lm = (None if ds.labels_mask is None else jax.device_put(
+            jnp.asarray(ds.labels_mask, net._dtype), bspec))
+        key = ("solver", self._feats.shape, self._labels.shape,
+               None if self._fm is None else self._fm.shape,
+               None if self._lm is None else self._lm.shape)
+        if key not in trainer._step_cache:
+            trainer._step_cache[key] = trainer._build_step(
+                self._feats.shape, self._labels.shape, solver=True)
+        self._vag, self._val = trainer._step_cache[key]
+        self.x0 = trainer._theta
+
+    def value_and_grad(self, x):
+        grad, score = self._vag(x, self._trainer._sstate, self._feats,
+                                self._labels, self._fm, self._lm)
+        return score, grad
+
+    def value(self, x):
+        return self._val(x, self._trainer._sstate, self._feats,
+                         self._labels, self._fm, self._lm)
+
+    def hessian_vector_product(self, x, v):
+        def grad_of(t):
+            return self._vag(t, self._trainer._sstate, self._feats,
+                             self._labels, self._fm, self._lm)[0]
+
+        return jax.jvp(grad_of, (x,), (v,))[1]
+
+    def write_back(self, x) -> None:
+        # x replaces the packed buffer; net.params sync is lazy (end of
+        # PipelineTrainer.fit) unless listeners need to observe params
+        # after each solver iteration — single-process only, like the
+        # SGD path's listener sync (see fit()).
+        tr = self._trainer
+        tr._theta = x
+        if tr.net.listeners and jax.process_count() == 1:
+            tr._sync_to_net()
