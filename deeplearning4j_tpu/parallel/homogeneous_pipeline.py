@@ -37,6 +37,19 @@ Trajectory parity with single-device ``net.fit`` on the same batches is
 asserted in tests/test_homogeneous_pipeline.py, and the 1/(S*T) stage
 bytes in the same file — mirroring test_pipeline_expert.py:634's
 accounting for the packed trainer.
+
+**Interleaved virtual stages** (``interleave=V``): each device hosts V
+chunks of the stack round-robin (device d holds chunks {j*S + d}), so
+chunk c -> c+1 is always one +1 ring hop and the pipeline fill costs a
+chunk-time, not a stage-time — bubble (S-1)/(S*V + M - 1) at M = S
+(the general M <= S form is (V*(S-M) + M-1)/(S*V + M-1)), ~1/V of
+GPipe's at the same microbatch count (Megatron-LM interleaved schedule,
+arXiv:2104.04473 §2.2; here the backward schedule is the autodiff
+transpose of the same loop). The win matters because GPipe's
+alternative — raising M — multiplies live activation memory; V buys
+the same bubble at M = S. Enforced: M <= S when V > 1 (keeps the
+round-robin schedule collision-free: one chunk-application per device
+per tick).
 """
 
 from __future__ import annotations
@@ -82,6 +95,19 @@ def _layer_signature(net, i: int):
     )
 
 
+def interleaved_bubble_fraction(n_stages: int, n_microbatches: int,
+                                interleave: int = 1) -> float:
+    """Idle fraction of the (possibly interleaved) schedule, in
+    chunk-time units: each device computes M*V useful chunk ticks of
+    the S*V + M - 1 total. V=1 reduces to GPipe's (S-1)/(M+S-1); at
+    M = S, depth V cuts the bubble to (S-1)/(S*V + S - 1) — the
+    Megatron-LM interleaving win (arXiv:2104.04473 §2.2), bought with
+    V ring hops per microbatch instead of one."""
+    s, m, v = n_stages, n_microbatches, interleave
+    total = s * v + m - 1
+    return (total - m * v) / total
+
+
 def find_homogeneous_run(net):
     """(start, end) of the longest contiguous run of structurally
     identical layers (ties: the earliest)."""
@@ -117,6 +143,7 @@ class HomogeneousPipelineTrainer:
         tp_axis: Optional[str] = None,
         dp_axis: Optional[str] = None,
         n_microbatches: int = 4,
+        interleave: int = 1,
     ):
         from deeplearning4j_tpu.nn.conf.enums import (
             BackpropType,
@@ -147,6 +174,28 @@ class HomogeneousPipelineTrainer:
         self.pp_axis = pp_axis
         self.S = int(mesh.shape[pp_axis])
         self.M = int(n_microbatches)
+        # Interleaved (virtual-stage) schedule: each device hosts V
+        # chunks of the stack round-robin (device d holds chunks
+        # {j*S + d}), so the pipeline fill costs one CHUNK-time instead
+        # of one stage-time — bubble (S-1)/(S*V + M - 1) at M = S
+        # (general M <= S: (V*(S-M) + M-1)/(S*V + M-1)) vs GPipe's
+        # (S-1)/(M+S-1), i.e. ~V x smaller at M = S. The
+        # schedule stays collision-free (one chunk-application per
+        # device per tick) when M <= S, which is exactly the regime
+        # interleaving is FOR: GPipe needs M >> S for a small bubble
+        # (activation liveness grows with M); interleave V gets the
+        # same bubble at M = S with 1/V of that liveness
+        # (Megatron-LM interleaved schedule, arXiv:2104.04473 §2.2,
+        # recast for the autodiff-transposed backward).
+        self.V = int(interleave)
+        if self.V < 1:
+            raise ValueError(f"interleave must be >= 1 (got {self.V})")
+        if self.V > 1 and self.M > self.S:
+            raise ValueError(
+                f"interleave={self.V} requires n_microbatches <= pp "
+                f"({self.M} > {self.S}): the round-robin schedule is "
+                "collision-free only when a microbatch group fits the "
+                "ring; raise pp, lower M, or use interleave=1")
         if dp_axis is None and "dp" in mesh.axis_names:
             dp_axis = "dp"
         self.dp_axis = (dp_axis
@@ -159,14 +208,16 @@ class HomogeneousPipelineTrainer:
 
         start, end = find_homogeneous_run(net)
         run = end - start
-        if run < self.S or run % self.S:
+        chunks = self.S * self.V
+        if run < chunks or run % chunks:
             raise ValueError(
                 f"homogeneous run of {run} identical layers (layers "
-                f"{start}..{end - 1}) is not divisible by the "
-                f"{self.S}-stage pp axis; add/remove blocks or use the "
-                "packed-row PipelineTrainer")
+                f"{start}..{end - 1}) is not divisible by "
+                f"pp x interleave = {self.S} x {self.V}; add/remove "
+                "blocks, lower interleave, or use the packed-row "
+                "PipelineTrainer")
         self.run = (start, end)
-        self.k = run // self.S  # blocks per stage
+        self.k = run // chunks  # blocks per chunk (per stage when V=1)
         self.pre_idx = list(range(0, start))
         self.post_idx = list(range(end, net.n_layers))
         if not hasattr(net._impls[-1], "loss"):
@@ -193,43 +244,62 @@ class HomogeneousPipelineTrainer:
     # -- stacked-state lifecycle --------------------------------------
     def _stack_leaf_spec(self, name: str) -> P:
         """PartitionSpec for stacked leaf ``name`` ([S, k] + tensor
-        dims): pp on the stage axis, Megatron tp on the tensor dims."""
+        dims, or [V, S, k] + tensor dims when interleaved): pp on the
+        stage axis, Megatron tp on the tensor dims. Chunk j of device d
+        (= chunk index j*S + d in execution order) sits at [j, d] — a
+        P(None, pp) layout keeps the pp axis contiguous so each device
+        holds exactly its V round-robin chunks."""
         tp = self.tp_axis
         if not tp or not self._block_is_tb:
-            return P(self.pp_axis)
-        if name in _BLOCK_TP_COL:
-            return P(self.pp_axis, None, None, tp)
-        if name in _BLOCK_TP_ROW:
-            return P(self.pp_axis, None, tp, None)
-        if name in _BLOCK_TP_VEC:
-            return P(self.pp_axis, None, tp)
-        return P(self.pp_axis)
+            spec = P(self.pp_axis)
+        elif name in _BLOCK_TP_COL:
+            spec = P(self.pp_axis, None, None, tp)
+        elif name in _BLOCK_TP_ROW:
+            spec = P(self.pp_axis, None, tp, None)
+        elif name in _BLOCK_TP_VEC:
+            spec = P(self.pp_axis, None, tp)
+        else:
+            spec = P(self.pp_axis)
+        if self.V > 1:
+            spec = P(None, *spec)
+        return spec
+
+    def _layer_of(self, v: int, s: int, b: int) -> int:
+        """Conf index of block ``b`` of chunk [v, s] — chunk c = v*S+s
+        runs blocks [c*k, (c+1)*k) of the homogeneous run."""
+        return self.run[0] + (v * self.S + s) * self.k + b
 
     def _stack_tree(self, tree):
-        """{name: leaf} per stacked layer -> {name: [S, k, ...]} as
-        HOST numpy (device_put with the P(pp, ...) sharding then lands
-        each stage row only on its stage's devices — the full stack
-        never materializes on one device)."""
-        start, end = self.run
+        """{name: leaf} per stacked layer -> {name: [S, k, ...]} (or
+        [V, S, k, ...] interleaved) as HOST numpy (device_put with the
+        P(pp, ...) sharding then lands each stage row only on its
+        stage's devices — the full stack never materializes on one
+        device)."""
+        start, _ = self.run
         names = list(tree[str(start)].keys())
         out = {}
         for name in names:
-            rows = [
+            vs = [
                 np.stack([
-                    np.asarray(tree[str(start + s * self.k + j)][name])
-                    for j in range(self.k)])
-                for s in range(self.S)]
-            out[name] = np.stack(rows)
+                    np.stack([
+                        np.asarray(tree[str(self._layer_of(v, s, b))][
+                            name])
+                        for b in range(self.k)])
+                    for s in range(self.S)])
+                for v in range(self.V)]
+            out[name] = np.stack(vs) if self.V > 1 else vs[0]
         return out
 
     def _unstack_into(self, tree, stacked):
-        start, _ = self.run
         for name, leaf in stacked.items():
             mat = np.asarray(jax.device_get(leaf))
-            for s in range(self.S):
-                for j in range(self.k):
-                    tree[str(start + s * self.k + j)][name] = (
-                        mat[s, j])
+            if self.V == 1:
+                mat = mat[None]
+            for v in range(self.V):
+                for s in range(self.S):
+                    for b in range(self.k):
+                        tree[str(self._layer_of(v, s, b))][name] = (
+                            mat[v, s, b])
 
     def _ensure_placed(self):
         net = self.net
@@ -270,19 +340,23 @@ class HomogeneousPipelineTrainer:
 
     def _stack_updater_state(self):
         """updater_state["i"] = {slot: {name: leaf}} -> {slot: {name:
-        [S, k, ...]}} (empty dict for SGD)."""
-        start, _ = self.run
+        [S, k, ...]}} ([V, S, k, ...] interleaved; empty for SGD)."""
         ustate = self.net.updater_state
-        proto = ustate[str(start)]
-        return {
-            slot: {
-                name: np.stack([
+        proto = ustate[str(self.run[0])]
+
+        def stack_one(slot, name):
+            vs = [
+                np.stack([
                     np.stack([
-                        np.asarray(ustate[
-                            str(start + s * self.k + j)][slot][name])
-                        for j in range(self.k)])
+                        np.asarray(ustate[str(self._layer_of(
+                            v, s, b))][slot][name])
+                        for b in range(self.k)])
                     for s in range(self.S)])
-                for name in proto[slot]}
+                for v in range(self.V)]
+            return np.stack(vs) if self.V > 1 else vs[0]
+
+        return {
+            slot: {name: stack_one(slot, name) for name in proto[slot]}
             for slot in proto}
 
     def _sync_to_net(self):
@@ -297,15 +371,16 @@ class HomogeneousPipelineTrainer:
             net.updater_state[si] = jax.tree.map(
                 lambda a: np.asarray(jax.device_get(a)), srcu[si])
         self._unstack_into(net.params, stack_p)
-        start, _ = self.run
         for slot, sub in stack_u.items():
             for name, leaf in sub.items():
                 mat = np.asarray(jax.device_get(leaf))
-                for s in range(self.S):
-                    for j in range(self.k):
-                        net.updater_state[
-                            str(start + s * self.k + j)][slot][name] = (
-                            mat[s, j])
+                if self.V == 1:
+                    mat = mat[None]
+                for v in range(self.V):
+                    for s in range(self.S):
+                        for b in range(self.k):
+                            net.updater_state[str(self._layer_of(
+                                v, s, b))][slot][name] = mat[v, s, b]
         self._synced = (id(net.params),
                         getattr(net, "params_version", 0))
 
@@ -355,9 +430,11 @@ class HomogeneousPipelineTrainer:
                 mask=None)
         return x
 
-    def _block_apply(self, stack_local, x, rng, train):
-        """This stage's k blocks, sequentially via lax.scan over the
-        block axis (stack_local leaves [k, ...])."""
+    def _block_apply(self, stack_local, x, rng, train, chunk=None):
+        """One chunk's k blocks, sequentially via lax.scan over the
+        block axis. stack_local leaves are [1, k, ...] (V=1) or
+        [V, 1, k, ...] with ``chunk`` the (traced) local chunk index
+        to run this tick."""
         from deeplearning4j_tpu.nn.multilayer import _cast_floating
 
         net = self.net
@@ -382,8 +459,16 @@ class HomogeneousPipelineTrainer:
 
         keys = (jax.random.split(rng, self.k) if rng is not None
                 else jnp.zeros((self.k, 2), jnp.uint32))
-        # drop the local stage axis ([1, k, ...] -> [k, ...])
-        blocks = jax.tree.map(lambda l: l[0], stack_local)
+        if self.V == 1:
+            # drop the local stage axis ([1, k, ...] -> [k, ...])
+            blocks = jax.tree.map(lambda l: l[0], stack_local)
+        else:
+            # select this tick's chunk ([V, 1, k, ...] -> [k, ...]);
+            # a dynamic gather on the leading V axis — XLA keeps the
+            # non-selected chunks untouched on-device.
+            blocks = jax.tree.map(
+                lambda l: lax.dynamic_index_in_dim(
+                    l, chunk, 0, keepdims=False)[0], stack_local)
         x, _ = lax.scan(one, x, (blocks, keys))
         return x
 
@@ -394,7 +479,7 @@ class HomogeneousPipelineTrainer:
         )
 
         net = self.net
-        S, M, R = self.S, self.M, self.R
+        S, M, R, V = self.S, self.M, self.R, self.V
         axis = self.pp_axis
         cd = net._compute_dtype
         B = feats_shape[0]
@@ -433,33 +518,48 @@ class HomogeneousPipelineTrainer:
 
                 def tick(t, carry):
                     buf, loss_acc = carry
-                    mb_idx = jnp.clip(t - idx, 0, M - 1)
+                    # Device idx at tick t runs the unit (chunk c =
+                    # jc*S + idx, microbatch m = t - c): microbatch m
+                    # enters chunk c at tick c + m, and chunk c+1 is
+                    # always one ring hop away (device (c+1) % S), so
+                    # the +1 ppermute serves every interleave depth.
+                    # With M <= S (enforced for V > 1) at most one
+                    # (jc, m) is valid per device per tick; V == 1
+                    # reduces to the plain GPipe indexing.
+                    rel = t - idx
+                    jc = (jnp.clip(rel // S, 0, V - 1) if V > 1 else 0)
+                    m_raw = rel - jc * S
+                    mb_idx = jnp.clip(m_raw, 0, M - 1)
+                    valid = (m_raw >= 0) & (m_raw < M)
                     rngs = list(jax.random.split(
                         jax.random.fold_in(rng, mb_idx),
                         net.n_layers))
-                    feed = x_mbs[jnp.minimum(t, M - 1)]
+                    feed = x_mbs[mb_idx]
                     h_pre = self._apply_range(
                         self.pre_idx, pre, feed, rngs, True)
-                    xin = jnp.where(
-                        idx == 0, h_pre.astype(hop_dtype), buf)
+                    entry = ((idx == 0) & (jc == 0) if V > 1
+                             else idx == 0)
+                    xin = jnp.where(entry, h_pre.astype(hop_dtype),
+                                    buf)
                     y = self._block_apply(
                         stack_local, xin,
-                        jax.random.fold_in(rngs[start], idx), True)
+                        jax.random.fold_in(rngs[start], jc * S + idx),
+                        True, chunk=jc if V > 1 else None)
                     out = self._apply_range(
                         self.post_idx, post, y, rngs, True)
                     if cd is not None:
                         out = out.astype(net._dtype)
-                    out_t = jnp.maximum(t - (S - 1), 0)
                     loss_mb = out_impl.loss(
-                        out_conf, out, y_mbs[out_t], None)
-                    write = (idx == S - 1) & (t - (S - 1) >= 0)
+                        out_conf, out, y_mbs[mb_idx], None)
+                    write = ((idx == S - 1) & (jc == V - 1) & valid
+                             if V > 1 else (idx == S - 1) & valid)
                     loss_acc = loss_acc + jnp.where(write, loss_mb, z)
                     perm = [(i, (i + 1) % S) for i in range(S)]
                     buf = lax.ppermute(
                         y.astype(hop_dtype), axis, perm)
                     return buf, loss_acc
 
-                _, loss_sum = lax.fori_loop(0, M + S - 1, tick,
+                _, loss_sum = lax.fori_loop(0, S * V + M - 1, tick,
                                             (buf0, z))
                 # Local (unreduced) contribution — see
                 # pipeline_parallel.py on why the psum must stay
@@ -472,9 +572,14 @@ class HomogeneousPipelineTrainer:
                         net.conf.confs[i],
                         (pre if i in self.pre_idx else post)[str(i)])
                 reg = reg / S
-                stack_reg = jax.vmap(lambda tree: layer_reg_score(
-                    self._stack_conf, tree))(
-                    jax.tree.map(lambda l: l[0], stack_local))
+                reg_one = lambda tree: layer_reg_score(  # noqa: E731
+                    self._stack_conf, tree)
+                if V == 1:
+                    stack_reg = jax.vmap(reg_one)(
+                        jax.tree.map(lambda l: l[0], stack_local))
+                else:
+                    stack_reg = jax.vmap(jax.vmap(reg_one))(
+                        jax.tree.map(lambda l: l[:, 0], stack_local))
                 return loss_sum / M + reg + jnp.sum(stack_reg)
 
             score_local, grads = jax.value_and_grad(loss_fn)(
@@ -512,8 +617,10 @@ class HomogeneousPipelineTrainer:
                     self._stack_conf, self._stack_updater, g, u,
                     iteration)
 
-            upd_sb, new_stack_u = jax.vmap(jax.vmap(upd_block))(
-                g_stack, stack_u)
+            vm_upd = jax.vmap(jax.vmap(upd_block))
+            if V > 1:  # extra leading chunk axis [V, 1, k, ...]
+                vm_upd = jax.vmap(vm_upd)
+            upd_sb, new_stack_u = vm_upd(g_stack, stack_u)
             new_stack = jax.tree.map(
                 lambda p, u: p - u, stack_p, upd_sb)
             return (new_pre, new_stack, new_post, new_pre_u,
@@ -542,7 +649,8 @@ class HomogeneousPipelineTrainer:
                         post_u, scores)
 
         rep = P()
-        pp_lead = P(self.pp_axis)
+        pp_lead = (P(None, self.pp_axis) if self.V > 1
+                   else P(self.pp_axis))
         is_arr = lambda x: isinstance(  # noqa: E731
             x, (jax.Array, np.ndarray))
         pre_spec = jax.tree.map(

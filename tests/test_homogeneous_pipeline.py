@@ -230,3 +230,118 @@ class TestValidation:
         ds.labels_mask = np.ones((8, T), np.float32)
         with pytest.raises(ValueError, match="mask"):
             trainer.fit(ds)
+
+
+class TestInterleavedSchedule:
+    """interleave=V: each device hosts V round-robin chunks of the
+    stack, cutting the pipeline-fill bubble ~V x at the same
+    microbatch count (Megatron-LM interleaved schedule,
+    arXiv:2104.04473 §2.2) — the GPipe alternative of raising M pays
+    with M x activation liveness instead."""
+
+    def test_bubble_math(self):
+        from deeplearning4j_tpu.parallel.homogeneous_pipeline import (
+            interleaved_bubble_fraction,
+        )
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            bubble_fraction,
+        )
+
+        # V=1 reduces exactly to GPipe
+        assert interleaved_bubble_fraction(4, 8) == bubble_fraction(4, 8)
+        # at M=S=4: V=2 cuts 3/7 -> 3/11, V=4 -> 3/19
+        assert interleaved_bubble_fraction(4, 4, 1) == 3 / 7
+        assert interleaved_bubble_fraction(4, 4, 2) == 3 / 11
+        assert interleaved_bubble_fraction(4, 4, 4) == 3 / 19
+        # deeper interleave strictly shrinks the bubble
+        assert (interleaved_bubble_fraction(4, 4, 4)
+                < interleaved_bubble_fraction(4, 4, 2)
+                < interleaved_bubble_fraction(4, 4, 1))
+
+    def _parity(self, mesh_axes, interleave, tp_axis=None, steps=3,
+                n_layers=5):
+        x, y = _batch()
+        ref = _net(n_layers=n_layers)
+        pp_net = _net(n_layers=n_layers)
+        mesh = make_mesh(MeshSpec(mesh_axes))
+        trainer = HomogeneousPipelineTrainer(
+            pp_net, mesh, n_microbatches=2, tp_axis=tp_axis,
+            interleave=interleave)
+        for _ in range(steps):
+            ref.fit(DataSet(x, y))
+            s_pp = trainer.fit(DataSet(x, y))
+        np.testing.assert_allclose(
+            s_pp, float(ref.score_value), rtol=2e-4)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(pp_net.params[si][name]),
+                    np.asarray(p), atol=3e-4,
+                    err_msg=f"param {si}/{name} diverged (V>1)")
+
+    def test_interleave2_matches_single_device(self):
+        self._parity({"pp": 2}, interleave=2)
+
+    def test_interleave4_matches_single_device(self):
+        # run of 8 blocks over pp=2 x V=4 (one block per chunk)
+        self._parity({"pp": 2}, interleave=4, n_layers=9)
+
+    def test_interleave_dp_pp_tp_matches_single_device(self):
+        self._parity({"dp": 2, "pp": 2, "tp": 2}, interleave=2,
+                     tp_axis="tp")
+
+    def test_fit_scan_interleaved(self):
+        x, y = _batch(n=4)
+        a, b = _net(), _net()
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        ta = HomogeneousPipelineTrainer(
+            a, mesh, n_microbatches=2, interleave=2)
+        tb = HomogeneousPipelineTrainer(
+            b, mesh, n_microbatches=2, interleave=2)
+        K = 3
+        scores_scan = np.asarray(
+            tb.fit_scan(np.stack([x] * K), np.stack([y] * K)))
+        scores_fit = [ta.fit(DataSet(x, y)) for _ in range(K)]
+        np.testing.assert_allclose(scores_scan, scores_fit, rtol=2e-4)
+
+    def test_per_device_bytes_unchanged_by_interleave(self):
+        """V chunks per device hold the same total bytes as one stage
+        slice — interleaving reshuffles WHICH blocks a device owns,
+        not how many (still 1/(S*T) of the stack)."""
+        net = _net(n_layers=5, width=16, heads=2)
+        mesh = make_mesh(MeshSpec({"pp": 2, "tp": 2}))
+        trainer = HomogeneousPipelineTrainer(
+            net, mesh, n_microbatches=2, tp_axis="tp", interleave=2)
+        per_dev = trainer.per_device_state_bytes()
+        total = trainer.total_stack_bytes()
+        assert len(per_dev) == 4
+        for d, nbytes in per_dev.items():
+            assert abs(nbytes / total - 1 / 4) < 0.02, (d, nbytes)
+
+    def test_round_robin_chunk_assignment(self):
+        """Stacked leaf [V, S, k, ...]: device d's slice holds chunks
+        {j*S + d} — execution-order chunk c sits at [c // S, c % S]."""
+        net = _net(n_layers=9)  # run = blocks 1..8
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        trainer = HomogeneousPipelineTrainer(
+            net, mesh, n_microbatches=2, interleave=4)
+        stacked = trainer._stack_tree(net.params)["Wq"]
+        assert stacked.shape[:3] == (4, 2, 1)
+        for c in range(8):  # chunk c == block 1 + c (k == 1)
+            np.testing.assert_array_equal(
+                stacked[c // 2, c % 2, 0],
+                np.asarray(net.params[str(1 + c)]["Wq"]))
+
+    def test_rejects_m_greater_than_s(self):
+        net = _net()
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        with pytest.raises(ValueError, match="collision-free"):
+            HomogeneousPipelineTrainer(
+                net, mesh, n_microbatches=4, interleave=2)
+
+    def test_rejects_indivisible_interleave(self):
+        net = _net(n_layers=5)  # run of 4, pp=2 -> V=4 needs 8
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        with pytest.raises(ValueError, match="not divisible"):
+            HomogeneousPipelineTrainer(
+                net, mesh, n_microbatches=2, interleave=4)
