@@ -147,6 +147,10 @@ def _cmd_train(args) -> int:
     sets = [DataSet(feats[i:i + batch], labels[i:i + batch])
             for i in range(0, len(feats), batch)]
     target = net
+    if getattr(args, "pp_interleave", None) not in (None, 1) \
+            and not args.mesh:
+        raise SystemExit(
+            "--pp-interleave requires --mesh with a pp axis")
     if args.mesh:
         from deeplearning4j_tpu.parallel.data_parallel import (
             ParallelTrainer,
@@ -167,7 +171,17 @@ def _cmd_train(args) -> int:
                 "--mesh must include a dp axis (the batch shards over "
                 "it), e.g. 'dp=8' or 'dp=2,tp=4' — or a pp axis for "
                 "pipeline stages ('pp=4', 'dp=2,pp=2,tp=2')")
+        interleave = int(getattr(args, "pp_interleave", None) or 1)
+        if interleave < 1:
+            raise SystemExit(
+                f"--pp-interleave {interleave}: must be >= 1")
+        if interleave > 1 and "pp" not in spec:
+            raise SystemExit(
+                "--pp-interleave requires a pp axis in --mesh")
         pp_microbatches = 4
+        if interleave > 1:
+            # interleaved schedule is collision-free at M <= S
+            pp_microbatches = min(pp_microbatches, spec["pp"])
         if "pp" in spec:
             bad = sorted(set(spec) & {"fsdp", "ep", "sp"})
             if bad:
@@ -195,16 +209,19 @@ def _cmd_train(args) -> int:
             print(f"note: dropped {dropped} ragged-tail examples so "
                   f"batches divide the {div} data shards")
         sets = trimmed
-        if "pp" in spec and "tp" in spec:
-            # dp x pp x tp needs per-tensor layouts: the homogeneous
-            # stage-stacked trainer (parallel/homogeneous_pipeline.py)
+        if "pp" in spec and ("tp" in spec or interleave > 1):
+            # dp x pp x tp needs per-tensor layouts, and interleave
+            # needs stage-stacked chunks: the homogeneous trainer
+            # (parallel/homogeneous_pipeline.py)
             from deeplearning4j_tpu.parallel.homogeneous_pipeline import (  # noqa: E501
                 HomogeneousPipelineTrainer,
             )
 
             target = HomogeneousPipelineTrainer(
-                net, make_mesh(MeshSpec(spec)), tp_axis="tp",
-                n_microbatches=pp_microbatches)
+                net, make_mesh(MeshSpec(spec)),
+                tp_axis="tp" if "tp" in spec else None,
+                n_microbatches=pp_microbatches,
+                interleave=interleave)
         elif "pp" in spec:
             from deeplearning4j_tpu.parallel.pipeline_parallel import (
                 PipelineTrainer,
@@ -381,6 +398,11 @@ def build_parser() -> argparse.ArgumentParser:
              "axis sizes multiply to the device count; axes named "
              "tp/fsdp/ep/sp engage the corresponding ParallelTrainer "
              "sharding (dp shards the batch)")
+    t.add_argument(
+        "--pp-interleave", type=int, default=1,
+        help="virtual-stage interleave depth for pipeline meshes "
+             "(homogeneous-stage models only; ~V x smaller pipeline "
+             "bubble at the same microbatch count)")
     t.set_defaults(fn=_cmd_train)
 
     e = sub.add_parser("test", help="evaluate a saved model")

@@ -104,6 +104,11 @@ def interleaved_bubble_fraction(n_stages: int, n_microbatches: int,
     Megatron-LM interleaving win (arXiv:2104.04473 §2.2), bought with
     V ring hops per microbatch instead of one."""
     s, m, v = n_stages, n_microbatches, interleave
+    if v > 1 and m > s:
+        raise ValueError(
+            f"interleave={v} requires n_microbatches <= n_stages "
+            f"({m} > {s}) — the closed form (and the trainer's "
+            "schedule) is only defined for the collision-free regime")
     total = s * v + m - 1
     return (total - m * v) / total
 

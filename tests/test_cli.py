@@ -166,6 +166,36 @@ class TestMeshTraining:
                   "--batch-size", "40", "--epochs", "1",
                   "--mesh", "dp=2,pp=2,tp=2"])
 
+    def test_pp_interleave_from_cli(self, tmp_path, toy_csv, capsys):
+        """`dl4j train --mesh pp=2 --pp-interleave 2` routes to the
+        homogeneous trainer's interleaved schedule (a 4-deep identical
+        Dense stack splits into 4 chunks round-robin over 2 stages)."""
+        from deeplearning4j_tpu.models.zoo import mlp
+
+        conf = mlp(sizes=(4, 8, 8, 8, 8, 8, 2), lr=0.2)
+        cpath = tmp_path / "homog.json"
+        cpath.write_text(conf.to_json())
+        model = str(tmp_path / "ipp_model.zip")
+        rc = main(["train", "--conf", str(cpath), "--input", toy_csv,
+                   "--output", model, "--epochs", "30",
+                   "--batch-size", "40", "--mesh", "pp=2",
+                   "--pp-interleave", "2"])
+        assert rc == 0 and os.path.exists(model)
+        rc = main(["test", "--model", model, "--input", toy_csv])
+        assert rc == 0
+        stats = capsys.readouterr().out
+        acc = float([ln for ln in stats.splitlines()
+                     if "Accuracy" in ln][0].split()[-1])
+        assert acc > 0.8
+
+    def test_pp_interleave_requires_pp_axis(self, tmp_path, toy_csv,
+                                            conf_json):
+        with pytest.raises(SystemExit, match="pp axis"):
+            main(["train", "--conf", conf_json, "--input", toy_csv,
+                  "--output", str(tmp_path / "m.zip"),
+                  "--batch-size", "40", "--mesh", "dp=8",
+                  "--pp-interleave", "2"])
+
     def test_bad_mesh_flag_exits_clearly(self, tmp_path, toy_csv,
                                          conf_json):
         with pytest.raises(SystemExit, match="axis=N"):
