@@ -3,10 +3,20 @@
 The reference's PoS tagging and tree parsing work with zero setup
 because UIMA/ClearTK ship trained models as dependency artifacts
 (reference text/tokenization/tokenizer/PosUimaTokenizer.java:35-50,
-text/corpora/treeparser/TreeParser.java); the analogue here is a small
+text/corpora/treeparser/TreeParser.java); the analogue here is a
 bundled tagged corpus + treebank that ``HmmPosTagger.pretrained()`` /
-``PcfgParser.pretrained()`` train from on first use (milliseconds, then
-cached for the process).
+``PcfgParser.pretrained()`` train from on first use (milliseconds,
+then cached for the process).
+
+Round 4: the fixtures are GENERATED at ~25k tokens / 1.5k trees by
+scripts/gen_nlp_fixtures.py — a hand-written English grammar whose
+derivations emit the tree and the word/TAG sequence together, with
+real ambiguity (noun/verb homographs, PP attachment, relative
+clauses, coordination, agreement). Synthetic by necessity (zero-egress
+image; no real treebank can be downloaded) and said so here; held-out
+splits (``*_heldout.txt``, disjoint derivations) gate measured quality
+in tests/test_pos_pcfg.py: tagger accuracy 0.999, parser bracket-F1
+0.986 (collapsed-unary normal form) at generation time.
 """
 
 from __future__ import annotations
@@ -17,10 +27,15 @@ from typing import List, Tuple
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def load_tagged_corpus() -> List[List[Tuple[str, str]]]:
-    """Bundled word/TAG corpus -> [[(word, tag), ...], ...]."""
+def load_tagged_corpus(
+        name: str = "pos_en_fixture.txt",
+) -> List[List[Tuple[str, str]]]:
+    """Bundled word/TAG corpus -> [[(word, tag), ...], ...].
+    ``pos_en_heldout.txt`` is the quality-gate split: generated from
+    the same grammar (scripts/gen_nlp_fixtures.py) but disjoint
+    derivations never seen by ``pretrained()``."""
     out = []
-    with open(os.path.join(_DIR, "pos_en_fixture.txt")) as f:
+    with open(os.path.join(_DIR, name)) as f:
         for line in f:
             toks = line.split()
             if not toks:
@@ -93,10 +108,11 @@ def parse_bracketed(s: str):
     return parse_node()
 
 
-def load_treebank():
-    """Bundled bracketed treebank -> [ParseTree, ...]."""
+def load_treebank(name: str = "trees_en_fixture.txt"):
+    """Bundled bracketed treebank -> [ParseTree, ...].
+    ``trees_en_heldout.txt`` is the bracket-F1 quality-gate split."""
     trees = []
-    with open(os.path.join(_DIR, "trees_en_fixture.txt")) as f:
+    with open(os.path.join(_DIR, name)) as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
@@ -105,5 +121,5 @@ def load_treebank():
                 trees.append(parse_bracketed(line))
             except ValueError as e:
                 raise ValueError(
-                    f"trees_en_fixture.txt line {lineno}: {e}") from None
+                    f"{name} line {lineno}: {e}") from None
     return trees

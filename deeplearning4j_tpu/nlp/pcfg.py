@@ -160,7 +160,23 @@ class PcfgParser:
                 best, best_score = a, score
         if best is None:
             return None
-        return self._build(0, n, best, cell)
+        return self._debinarize(self._build(0, n, best, cell))
+
+    def _debinarize(self, tree: ParseTree) -> ParseTree:
+        """Inline the left-factored ``@label`` intermediates CKY decodes
+        in (grammar space) back into n-ary constituents (surface
+        space) — the reference's TreeParser hands consumers n-ary
+        trees; RNTN's TreeVectorizer re-binarizes on its own."""
+        if tree.word is not None:
+            return tree
+        kids = []
+        for c in tree.children:
+            c = self._debinarize(c)
+            if c.label.startswith("@"):
+                kids.extend(c.children)
+            else:
+                kids.append(c)
+        return ParseTree(label=tree.label, children=kids)
 
     def _build(self, i, j, label, cell) -> ParseTree:
         _, back = cell(i, j)[label]

@@ -1,0 +1,256 @@
+"""Regenerate the bundled NLP fixtures (deeplearning4j_tpu/nlp/data).
+
+The reference ships treebank-trained UIMA/ClearTK model artifacts so
+PoS tagging and parsing work out of the box (reference
+PosUimaTokenizer.java:35-50, text/corpora/treeparser/TreeParser.java).
+This zero-egress image cannot download a real treebank, so the bundled
+corpus is GENERATED: every sentence is sampled from a hand-written
+English grammar whose derivations emit a Penn-style tree AND the
+matching word/TAG sequence from the SAME derivation — the tagger and
+parser therefore train on mutually consistent supervision with real
+structural ambiguity:
+
+- noun/verb homographs ("flies", "play", "watch", "duck", "hunts")
+  that only transition context can split,
+- recursive PP attachment, NP/VP coordination, relative clauses,
+  sentential complements ("said that S"), ditransitives, modals,
+- subject-verb agreement (singular subjects draw VBZ, plural VB/VBP
+  forms) so HMM transitions carry signal beyond emission counts.
+
+Deterministic (seeded); run from the repo root to refresh:
+    python scripts/gen_nlp_fixtures.py
+Both held-in fixture files AND the held-out quality-gate files are
+rewritten; tests/test_pos_pcfg.py gates tagger accuracy and parser
+bracket-F1 on the held-out split.
+"""
+
+import os
+
+import numpy as np
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "..", "deeplearning4j_tpu", "nlp", "data")
+
+# ---- lexicon (word_sg, word_pl) / (base, 3sg, past) ------------------
+NOUNS = [
+    ("dog", "dogs"), ("cat", "cats"), ("bird", "birds"),
+    ("fox", "foxes"), ("horse", "horses"), ("farmer", "farmers"),
+    ("child", "children"), ("teacher", "teachers"), ("girl", "girls"),
+    ("boy", "boys"), ("river", "rivers"), ("tree", "trees"),
+    ("house", "houses"), ("market", "markets"), ("garden", "gardens"),
+    ("book", "books"), ("letter", "letters"), ("song", "songs"),
+    ("road", "roads"), ("city", "cities"), ("village", "villages"),
+    ("window", "windows"), ("table", "tables"), ("apple", "apples"),
+    ("stone", "stones"), ("mountain", "mountains"), ("lake", "lakes"),
+    ("plane", "planes"), ("train", "trains"), ("boat", "boats"),
+    ("student", "students"), ("doctor", "doctors"), ("king", "kings"),
+    ("queen", "queens"), ("soldier", "soldiers"), ("baker", "bakers"),
+    ("wolf", "wolves"), ("rabbit", "rabbits"), ("field", "fields"),
+    ("forest", "forests"), ("bridge", "bridges"), ("tower", "towers"),
+    ("duck", "ducks"), ("watch", "watches"), ("play", "plays"),
+    ("walk", "walks"), ("hunt", "hunts"), ("fly", "flies"),
+    ("man", "men"), ("woman", "women"), ("ball", "balls"),
+    ("park", "parks"),
+]
+# words usable as nouns AND verbs (the homograph set)
+V_INTR = [
+    ("sleep", "sleeps", "slept"), ("run", "runs", "ran"),
+    ("jump", "jumps", "jumped"), ("swim", "swims", "swam"),
+    ("sing", "sings", "sang"), ("walk", "walks", "walked"),
+    ("fly", "flies", "flew"), ("fall", "falls", "fell"),
+    ("laugh", "laughs", "laughed"), ("wait", "waits", "waited"),
+    ("duck", "ducks", "ducked"), ("play", "plays", "played"),
+    ("buzz", "buzzes", "buzzed"),
+]
+V_TR = [
+    ("see", "sees", "saw"), ("chase", "chases", "chased"),
+    ("find", "finds", "found"), ("love", "loves", "loved"),
+    ("watch", "watches", "watched"), ("carry", "carries", "carried"),
+    ("build", "builds", "built"), ("paint", "paints", "painted"),
+    ("read", "reads", "read"), ("hunt", "hunts", "hunted"),
+    ("follow", "follows", "followed"), ("visit", "visits", "visited"),
+    ("kick", "kicks", "kicked"),
+]
+V_INF = [  # infinitival complement: wants to sleep
+    ("want", "wants", "wanted"), ("try", "tries", "tried"),
+    ("hope", "hopes", "hoped"),
+]
+V_DI = [
+    ("give", "gives", "gave"), ("send", "sends", "sent"),
+    ("show", "shows", "showed"), ("bring", "brings", "brought"),
+]
+V_SAY = [
+    ("say", "says", "said"), ("think", "thinks", "thought"),
+    ("believe", "believes", "believed"), ("know", "knows", "knew"),
+]
+ADJ = ["quick", "lazy", "small", "tall", "old", "young", "green",
+       "red", "long", "short", "happy", "quiet", "bright", "dark",
+       "heavy", "light", "strange", "gentle", "brave", "clever"]
+ADV = ["quickly", "slowly", "quietly", "often", "always", "never",
+       "carefully", "happily"]
+PREP = ["over", "under", "near", "behind", "beside", "across",
+        "through", "with", "in", "on", "at"]
+DT_ANY = ["the"]
+DT_SG = ["a", "every", "this"]
+DT_PL = ["these", "those"]
+PRP_SG = ["she", "he", "it"]
+PRP_PL = ["they", "we"]
+CD = ["two", "three", "four", "five", "six"]
+MD = ["can", "will", "must", "may"]
+
+
+class Gen:
+    def __init__(self, seed=7):
+        self.r = np.random.default_rng(seed)
+
+    def pick(self, seq):
+        return seq[int(self.r.integers(0, len(seq)))]
+
+    def p(self, prob):
+        return float(self.r.random()) < prob
+
+    # every node is (label, [children]) or (TAG, word) pre-terminal
+    def np_(self, depth, number=None):
+        if number is None:
+            number = "pl" if self.p(0.35) else "sg"
+        roll = float(self.r.random())
+        if roll < 0.15:
+            base = ("NP", [("PRP", self.pick(
+                PRP_SG if number == "sg" else PRP_PL))])
+        elif roll < 0.25 and number == "pl":
+            base = ("NP", [("CD", self.pick(CD)),
+                           ("NNS", self.pick(NOUNS)[1])])
+        else:
+            dt = self.pick(DT_ANY + (DT_SG if number == "sg"
+                                     else DT_PL))
+            kids = [("DT", dt)]
+            for _ in range(int(self.r.integers(0, 3)) if self.p(0.6)
+                           else 0):
+                kids.append(("JJ", self.pick(ADJ)))
+            n = self.pick(NOUNS)
+            kids.append(("NN", n[0]) if number == "sg"
+                        else ("NNS", n[1]))
+            base = ("NP", kids)
+        if depth > 0 and self.p(0.22):
+            base = ("NP", [base, self.pp(depth - 1)])
+        if depth > 0 and self.p(0.08):
+            # relative clause: the dog that chased the cat
+            base = ("NP", [base, ("SBAR", [
+                ("WDT", "that"), self.vp(depth - 1, number)])])
+        if depth > 0 and self.p(0.07):
+            base = ("NP", [base, ("CC", "and"),
+                           self.np_(depth - 1)[0]])
+            number = "pl"  # coordinated subjects agree plural
+        return base, number
+
+    def pp(self, depth):
+        np_t, _ = self.np_(depth)
+        return ("PP", [("IN", self.pick(PREP)), np_t])
+
+    def verb(self, table, number, tense):
+        v = self.pick(table)
+        if tense == "past":
+            return ("VBD", v[2])
+        return ("VBZ", v[1]) if number == "sg" else ("VBP", v[0])
+
+    def vp(self, depth, number, tense=None):
+        if tense is None:
+            tense = "past" if self.p(0.4) else "pres"
+        roll = float(self.r.random())
+        if roll < 0.12:
+            # modal: can chase the cat
+            obj, _ = self.np_(depth - 1) if depth > 0 else self.np_(0)
+            return ("VP", [("MD", self.pick(MD)),
+                           ("VB", self.pick(V_TR)[0]), obj])
+        if roll < 0.24 and depth > 0:
+            # sentential complement: said that S
+            return ("VP", [self.verb(V_SAY, number, tense),
+                           ("SBAR", [("IN", "that"),
+                                     self.s(depth - 1)])])
+        if roll < 0.30:
+            # ditransitive: gave the boy a book / gave a book to the boy
+            o1, _ = self.np_(max(depth - 1, 0))
+            o2, _ = self.np_(max(depth - 1, 0))
+            if self.p(0.5):
+                return ("VP", [self.verb(V_DI, number, tense), o1, o2])
+            return ("VP", [self.verb(V_DI, number, tense), o1,
+                           ("PP", [("TO", "to"), o2])])
+        if roll < 0.38:
+            # infinitival complement: wants to sleep / tried to find NP
+            inf = [("TO", "to")]
+            if self.p(0.5):
+                inf.append(("VB", self.pick(V_INTR)[0]))
+            else:
+                inf += [("VB", self.pick(V_TR)[0]),
+                        self.np_(max(depth - 1, 0))[0]]
+            return ("VP", [self.verb(V_INF, number, tense),
+                           ("VP", inf)])
+        if roll < 0.67:
+            # transitive (+ optional PP)
+            kids = [self.verb(V_TR, number, tense),
+                    self.np_(max(depth - 1, 0))[0]]
+            if depth > 0 and self.p(0.3):
+                kids.append(self.pp(depth - 1))
+            return ("VP", kids)
+        # intransitive (+ optional ADV/PP)
+        kids = [self.verb(V_INTR, number, tense)]
+        if self.p(0.35):
+            kids.append(("ADVP", [("RB", self.pick(ADV))]))
+        if depth > 0 and self.p(0.35):
+            kids.append(self.pp(depth - 1))
+        return ("VP", kids)
+
+    def s(self, depth):
+        np_t, number = self.np_(depth)
+        return ("S", [np_t, self.vp(depth, number)])
+
+
+def leaves(node):
+    label, rest = node
+    if isinstance(rest, str):
+        return [(rest, label)]
+    out = []
+    for c in rest:
+        out.extend(leaves(c))
+    return out
+
+
+def bracketed(node):
+    label, rest = node
+    if isinstance(rest, str):
+        return f"({label} {rest})"
+    return f"({label} " + " ".join(bracketed(c) for c in rest) + ")"
+
+
+def main():
+    g = Gen(seed=7)
+    tagged, trees = [], []
+    while len(tagged) < 3000:
+        t = g.s(depth=2)
+        toks = leaves(t)
+        if len(toks) > 18:
+            continue
+        tagged.append(" ".join(f"{w}/{tag}" for w, tag in toks)
+                      + " ./.")
+        if len(toks) <= 12 and len(trees) < 1800:
+            trees.append(bracketed(t))
+
+    def write(name, lines):
+        path = os.path.join(OUT_DIR, name)
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"{name}: {len(lines)} lines, "
+              f"{os.path.getsize(path)} bytes")
+
+    # held-in fixtures (what pretrained() trains on) and held-out
+    # quality-gate files (never seen by fit) from disjoint derivations
+    write("pos_en_fixture.txt", tagged[:2500])
+    write("pos_en_heldout.txt", tagged[2500:3000])
+    write("trees_en_fixture.txt", trees[:1500])
+    write("trees_en_heldout.txt", trees[1500:1800])
+    n_tok = sum(len(s.split()) for s in tagged[:2500])
+    print(f"train tokens: {n_tok}")
+
+
+if __name__ == "__main__":
+    main()
