@@ -50,6 +50,16 @@ alternative — raising M — multiplies live activation memory; V buys
 the same bubble at M = S. Enforced: M <= S when V > 1 (keeps the
 round-robin schedule collision-free: one chunk-application per device
 per tick).
+
+**Sequence parallelism inside the ticks** (``sp_axis``): activations
+carry their time axis sharded over sp end-to-end — the blocks'
+attention runs the ring (or Ulysses) schedule over sp per tick
+(conf-level ``ring_axis``, as in ParallelTrainer's sp), the pp
+ppermute hops each (stage, time-shard) slice independently, and the
+loss/gradients reduce across time shards with the exact global-mean
+scaling. Composes with everything above: dp x pp x sp x tp on one
+mesh, plus interleave — trajectory parity asserted for each
+(tests/test_homogeneous_pipeline.py TestSequenceParallelComposition).
 """
 
 from __future__ import annotations
@@ -147,6 +157,7 @@ class HomogeneousPipelineTrainer:
         pp_axis: str = "pp",
         tp_axis: Optional[str] = None,
         dp_axis: Optional[str] = None,
+        sp_axis: Optional[str] = None,
         n_microbatches: int = 4,
         interleave: int = 1,
     ):
@@ -210,6 +221,18 @@ class HomogeneousPipelineTrainer:
                         if tp_axis and tp_axis in mesh.axis_names
                         else None)
         self.R = int(mesh.shape[self.dp_axis]) if self.dp_axis else 1
+        # Sequence parallelism INSIDE the pipeline ticks: the time axis
+        # of every activation is sharded over sp, the blocks' attention
+        # runs the ring/Ulysses schedule over it (conf-level ring_axis,
+        # same device as ParallelTrainer's sp), and the pp ppermute
+        # hops each (stage, time-shard)'s slice independently — the
+        # long-context + large-model topology dp x pp x sp (x tp) on
+        # ONE mesh.
+        self.sp_axis = (sp_axis
+                        if sp_axis and sp_axis in mesh.axis_names
+                        else None)
+        self.SPn = (int(mesh.shape[self.sp_axis])
+                    if self.sp_axis else 1)
 
         start, end = find_homogeneous_run(net)
         run = end - start
@@ -240,6 +263,22 @@ class HomogeneousPipelineTrainer:
                 raise ValueError(
                     f"n_heads {block_bean.n_heads} not divisible by "
                     f"mesh tp={T}")
+        if self.sp_axis:
+            # The time axis is SHARDED: every attention core must run a
+            # sequence-parallel schedule over this axis or it would
+            # silently attend only within its local shard (same check
+            # as ParallelTrainer's conf-level sp).
+            for i, c in enumerate(net.conf.confs):
+                lc = c.layer
+                if not isinstance(lc, TransformerBlock):
+                    continue
+                if getattr(lc, "ring_axis", None) != self.sp_axis:
+                    raise ValueError(
+                        f"layer {i}: sp_axis={self.sp_axis!r} requires "
+                        "every TransformerBlock bean to set ring_axis="
+                        f"{self.sp_axis!r} (got {lc.ring_axis!r}) — "
+                        "build the conf with ring_axis (e.g. "
+                        "transformer_lm_flagship(ring_axis=...))")
         self._stack_conf = net.conf.confs[start]
         self._stack_updater = net._updaters[start]
         self._step_cache = {}
@@ -485,6 +524,7 @@ class HomogeneousPipelineTrainer:
 
         net = self.net
         S, M, R, V = self.S, self.M, self.R, self.V
+        SP, SPn = self.sp_axis, self.SPn
         axis = self.pp_axis
         cd = net._compute_dtype
         B = feats_shape[0]
@@ -495,30 +535,33 @@ class HomogeneousPipelineTrainer:
         out_conf = net.conf.confs[-1]
         out_impl = net._impls[-1]
         start, _ = self.run
-
-        # Hop-buffer shape: the block interface [mb, width, T...] —
-        # probe via eval_shape of pre on one microbatch.
-        def probe(x):
-            rngs = [None] * net.n_layers
-            return self._apply_range(
-                self.pre_idx, net.params, x, rngs, False)
-
-        x_probe = jax.eval_shape(
-            probe,
-            jax.ShapeDtypeStruct((mb,) + tuple(feats_shape[1:]),
-                                 net._dtype))
         hop_dtype = cd if cd is not None else net._dtype
 
         def local_step(pre_p, stack_p, post_p, pre_u, stack_u, post_u,
                        iteration, rng, feats, labels):
             idx = lax.axis_index(axis)
+            if SP:
+                # Decorrelate dropout draws across time shards (parity
+                # with the unsharded net holds for dropout-free confs,
+                # as in ParallelTrainer._sp_body_core).
+                rng = jax.random.fold_in(rng, lax.axis_index(SP))
 
             def loss_fn(theta):
                 pre, stack_local, post = theta
                 f = feats.astype(cd) if cd is not None else feats
                 x_mbs = f.reshape((M, mb) + f.shape[1:])
                 y_mbs = labels.reshape((M, mb) + labels.shape[1:])
-                buf0 = jnp.zeros(x_probe.shape, hop_dtype)
+                # Hop-buffer shape: the block interface [mb, width,
+                # T...] probed abstractly on one LOCAL microbatch
+                # (under sp the pre group contains ring collectives,
+                # so the probe must run inside the manual context and
+                # its shapes carry T_local = T/SPn).
+                probe_local = jax.eval_shape(
+                    lambda xx: self._apply_range(
+                        self.pre_idx, pre, xx,
+                        [None] * net.n_layers, False),
+                    x_mbs[0])
+                buf0 = jnp.zeros(probe_local.shape, hop_dtype)
                 z = jnp.zeros((), net._dtype)
 
                 def tick(t, carry):
@@ -585,16 +628,25 @@ class HomogeneousPipelineTrainer:
                 else:
                     stack_reg = jax.vmap(jax.vmap(reg_one))(
                         jax.tree.map(lambda l: l[:, 0], stack_local))
-                return loss_sum / M + reg + jnp.sum(stack_reg)
+                # Under sp each device's loss_mb is the mean over ITS
+                # equal-size time shard: the global mean is the psum of
+                # local/SPn (reg replicated over sp divides the same
+                # way so the sp-psum counts it once).
+                return (loss_sum / M + reg + jnp.sum(stack_reg)) / SPn
 
             score_local, grads = jax.value_and_grad(loss_fn)(
                 (pre_p, stack_p, post_p))
             g_pre, g_stack, g_post = grads
             # pre/post gradients live on stage 0 / S-1 only; the ring
-            # sum recovers the full gradient (zeros elsewhere).
-            g_pre = lax.psum(g_pre, axis)
-            g_post = lax.psum(g_post, axis)
-            score = lax.psum(score_local, axis)
+            # sum recovers the full gradient (zeros elsewhere). Under
+            # sp every gradient also sums across time shards (params
+            # replicated over sp; each shard computed a partial term).
+            axes = (axis,) + ((SP,) if SP else ())
+            g_pre = lax.psum(g_pre, axes)
+            g_post = lax.psum(g_post, axes)
+            score = lax.psum(score_local, axes)
+            if SP:
+                g_stack = lax.psum(g_stack, SP)
 
             # -- updates (dp reduction falls out of the global-batch
             # mean under GSPMD; no explicit dp collective needed) --
@@ -670,9 +722,16 @@ class HomogeneousPipelineTrainer:
             lambda _: pp_lead, self._state[1], is_leaf=is_arr)
         stacku_spec = jax.tree.map(
             lambda _: pp_lead, self._state[4], is_leaf=is_arr)
-        # Batch specs are P() over the MANUAL pp axis; the dp sharding
-        # rides the input NamedSharding through the auto axes.
-        bspec = rep
+        # Batch specs are P() over the MANUAL axes except the time dim,
+        # which splits over sp when sequence parallelism is on; the dp
+        # sharding rides the input NamedSharding through the auto axes.
+        if self.sp_axis:
+            bspec = (P(None, None, None, self.sp_axis) if scan
+                     else P(None, None, self.sp_axis))
+        else:
+            bspec = rep
+        manual = {self.pp_axis} | (
+            {self.sp_axis} if self.sp_axis else set())
         step = shard_map(
             fn,
             mesh=self.mesh,
@@ -681,17 +740,40 @@ class HomogeneousPipelineTrainer:
             out_specs=(pre_spec, stack_spec, post_spec, preu_spec,
                        stacku_spec, postu_spec, rep),
             check_vma=False,
-            axis_names=frozenset({self.pp_axis}),
+            axis_names=frozenset(manual),
         )
         return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
 
     # -- public API ----------------------------------------------------
+    def _validate_sp_batch(self, feats_shape, labels_shape):
+        """Crafted diagnostics BEFORE device_put (whose PartitionSpec
+        rank/divisibility errors are opaque): sp shards the time axis
+        of [B, C, T] features AND labels. Shape-only — no host copy."""
+        if not self.sp_axis:
+            return
+        for what, shape in (("features", tuple(feats_shape)),
+                            ("labels", tuple(labels_shape))):
+            if len(shape) != 3:
+                raise ValueError(
+                    f"sp_axis shards the time axis of [B, C, T] "
+                    f"batches; got {what} of rank {len(shape)} "
+                    f"(shape {shape})")
+            if shape[2] % self.SPn:
+                raise ValueError(
+                    f"{what} time axis {shape[2]} not divisible "
+                    f"by sp={self.SPn}")
+
     def _data_sharding(self, stacked=False):
-        # batch dim over dp (GSPMD-auto); replicated over pp/tp
-        if self.dp_axis is None:
+        # batch dim over dp (GSPMD-auto), time dim over sp (manual);
+        # replicated over pp/tp
+        if self.sp_axis:
+            spec = (P(None, self.dp_axis, None, self.sp_axis) if stacked
+                    else P(self.dp_axis, None, self.sp_axis))
+        elif self.dp_axis is None:
             return NamedSharding(self.mesh, P())
-        spec = (P(None, self.dp_axis) if stacked
-                else P(self.dp_axis))
+        else:
+            spec = (P(None, self.dp_axis) if stacked
+                    else P(self.dp_axis))
         return NamedSharding(self.mesh, spec)
 
     def fit(self, data, labels=None) -> float:
@@ -709,6 +791,8 @@ class HomogeneousPipelineTrainer:
                 raise ValueError(
                     "HomogeneousPipelineTrainer does not support mask "
                     "arrays; use the packed-row PipelineTrainer")
+            self._validate_sp_batch(np.shape(ds.features),
+                                    np.shape(ds.labels))
             feats = jax.device_put(
                 jnp.asarray(ds.features, net._dtype), sh)
             labs = jax.device_put(
@@ -730,6 +814,8 @@ class HomogeneousPipelineTrainer:
     def fit_scan(self, features_stacked, labels_stacked):
         net = self.net
         self._ensure_placed()
+        self._validate_sp_batch(np.shape(features_stacked)[1:],
+                                np.shape(labels_stacked)[1:])
         sh = self._data_sharding(stacked=True)
         fs = jax.device_put(
             jnp.asarray(features_stacked, net._dtype), sh)

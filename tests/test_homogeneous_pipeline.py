@@ -345,3 +345,69 @@ class TestInterleavedSchedule:
         with pytest.raises(ValueError, match="not divisible"):
             HomogeneousPipelineTrainer(
                 net, mesh, n_microbatches=2, interleave=4)
+
+
+class TestSequenceParallelComposition:
+    """sp INSIDE the pipeline ticks: activations' time axis sharded
+    over sp, ring attention (conf-level ring_axis) runs per tick, the
+    pp ppermute hops each time-shard independently — dp x pp x sp (x
+    tp) on ONE mesh, the canonical long-context large-model layout."""
+
+    def _sp_net(self, ring_axis, n_layers=5):
+        from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+
+        conf = transformer_lm_flagship(
+            vocab=V, width=W, n_layers=n_layers, n_heads=2, lr=5e-3,
+            warmup_steps=4, total_steps=400, seed=11,
+            ring_axis=ring_axis)
+        return MultiLayerNetwork(conf).init()
+
+    def _parity(self, mesh_axes, steps=3, **kw):
+        x, y = _batch(t=16)
+        ref = self._sp_net(None)
+        sp_net = self._sp_net("sp")
+        mesh = make_mesh(MeshSpec(mesh_axes))
+        trainer = HomogeneousPipelineTrainer(
+            sp_net, mesh, sp_axis="sp", n_microbatches=2, **kw)
+        for _ in range(steps):
+            ref.fit(DataSet(x, y))
+            s_pp = trainer.fit(DataSet(x, y))
+        np.testing.assert_allclose(
+            s_pp, float(ref.score_value), rtol=2e-4)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(sp_net.params[si][name]),
+                    np.asarray(p), atol=3e-4,
+                    err_msg=f"param {si}/{name} diverged under pp x sp")
+
+    def test_pp_sp_matches_single_device(self):
+        self._parity({"pp": 2, "sp": 2})
+
+    def test_dp_pp_sp_matches_single_device(self):
+        self._parity({"dp": 2, "pp": 2, "sp": 2})
+
+    def test_pp_sp_tp_matches_single_device(self):
+        self._parity({"pp": 2, "sp": 2, "tp": 2}, tp_axis="tp")
+
+    def test_pp_sp_interleaved_matches_single_device(self):
+        self._parity({"pp": 2, "sp": 2}, interleave=2)
+
+    def test_requires_ring_axis_on_blocks(self):
+        net = self._sp_net(None)  # blocks without ring_axis
+        mesh = make_mesh(MeshSpec({"pp": 2, "sp": 2}))
+        with pytest.raises(ValueError, match="ring_axis"):
+            HomogeneousPipelineTrainer(
+                net, mesh, sp_axis="sp", n_microbatches=2)
+
+    def test_time_axis_must_divide_sp(self):
+        net = self._sp_net("sp")
+        mesh = make_mesh(MeshSpec({"pp": 2, "sp": 2}))
+        trainer = HomogeneousPipelineTrainer(
+            net, mesh, sp_axis="sp", n_microbatches=2)
+        x, y = _batch(t=9)  # 9 % 2 != 0
+        # jax's device_put rejects the placement before the trainer's
+        # own shape check can run — either way the error names the
+        # divisibility problem
+        with pytest.raises(ValueError, match="divisible"):
+            trainer.fit(DataSet(x, y))
