@@ -183,12 +183,12 @@ def _cmd_train(args) -> int:
             # interleaved schedule is collision-free at M <= S
             pp_microbatches = min(pp_microbatches, spec["pp"])
         if "pp" in spec:
-            bad = sorted(set(spec) & {"fsdp", "ep", "sp"})
+            bad = sorted(set(spec) & {"fsdp", "ep"})
             if bad:
                 raise SystemExit(
                     f"--mesh axes {bad} do not compose with pp: the "
                     "pipeline trainers support pp [+ dp] (packed-row) "
-                    "and dp x pp x tp (homogeneous stages)")
+                    "and dp x pp x sp x tp (homogeneous stages)")
         # Batches shard over dp (x fsdp) and split into pipeline
         # microbatches under pp: drop ragged tails so every device
         # gets an equal slice (standard data-parallel trimming).
@@ -209,10 +209,13 @@ def _cmd_train(args) -> int:
             print(f"note: dropped {dropped} ragged-tail examples so "
                   f"batches divide the {div} data shards")
         sets = trimmed
-        if "pp" in spec and ("tp" in spec or interleave > 1):
-            # dp x pp x tp needs per-tensor layouts, and interleave
-            # needs stage-stacked chunks: the homogeneous trainer
-            # (parallel/homogeneous_pipeline.py)
+        if "pp" in spec and ("tp" in spec or "sp" in spec
+                             or interleave > 1):
+            # dp x pp x sp x tp needs per-tensor layouts / sharded-time
+            # ticks / stage-stacked chunks: the homogeneous trainer
+            # (parallel/homogeneous_pipeline.py). sp additionally
+            # requires the conf's attention beans to carry
+            # ring_axis="sp" — the trainer checks and says so.
             from deeplearning4j_tpu.parallel.homogeneous_pipeline import (  # noqa: E501
                 HomogeneousPipelineTrainer,
             )
@@ -220,6 +223,7 @@ def _cmd_train(args) -> int:
             target = HomogeneousPipelineTrainer(
                 net, make_mesh(MeshSpec(spec)),
                 tp_axis="tp" if "tp" in spec else None,
+                sp_axis="sp" if "sp" in spec else None,
                 n_microbatches=pp_microbatches,
                 interleave=interleave)
         elif "pp" in spec:
@@ -393,8 +397,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument(
         "--mesh", default=None,
         help="train over a device mesh, e.g. 'dp=8', 'dp=2,tp=4', "
-             "'pp=4' (GPipe stages), or 'dp=2,pp=2,tp=2' "
-             "(homogeneous-stage pipeline): "
+             "'pp=4' (GPipe stages), or 'dp=2,pp=2,tp=2' / "
+             "'pp=2,sp=2,tp=2' (homogeneous-stage pipeline; sp needs "
+             "conf attention beans built with ring_axis='sp'): "
              "axis sizes multiply to the device count; axes named "
              "tp/fsdp/ep/sp engage the corresponding ParallelTrainer "
              "sharding (dp shards the batch)")

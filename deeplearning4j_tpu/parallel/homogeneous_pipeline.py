@@ -264,21 +264,51 @@ class HomogeneousPipelineTrainer:
                     f"n_heads {block_bean.n_heads} not divisible by "
                     f"mesh tp={T}")
         if self.sp_axis:
-            # The time axis is SHARDED: every attention core must run a
-            # sequence-parallel schedule over this axis or it would
-            # silently attend only within its local shard (same check
-            # as ParallelTrainer's conf-level sp).
+            # The time axis is SHARDED end-to-end: every layer must
+            # either run a sequence-parallel schedule over sp or be
+            # per-timestep, or it would silently compute within its
+            # local shard (mirrors ParallelTrainer's conf-level sp
+            # validation, data_parallel.py — minus GravesLSTM/GRU,
+            # whose sp_scan recurrence is not wired into the pipeline
+            # tick schedule).
+            from deeplearning4j_tpu.nn.conf import layers as L
+            from deeplearning4j_tpu.nn.layers.attention import (
+                ATTENTION_BEANS,
+            )
+            from deeplearning4j_tpu.nn.layers.moe import MoeDense
+
+            if self.sp_axis in (self.dp_axis, self.tp_axis, pp_axis):
+                raise ValueError(
+                    f"sp_axis {self.sp_axis!r} must name a mesh axis "
+                    "distinct from dp/pp/tp: the time axis shards over "
+                    "its own axis")
             for i, c in enumerate(net.conf.confs):
                 lc = c.layer
-                if not isinstance(lc, TransformerBlock):
-                    continue
-                if getattr(lc, "ring_axis", None) != self.sp_axis:
+                if net.conf.preprocessor_for(i) is not None:
                     raise ValueError(
-                        f"layer {i}: sp_axis={self.sp_axis!r} requires "
-                        "every TransformerBlock bean to set ring_axis="
-                        f"{self.sp_axis!r} (got {lc.ring_axis!r}) — "
-                        "build the conf with ring_axis (e.g. "
-                        "transformer_lm_flagship(ring_axis=...))")
+                        f"layer {i}: input preprocessors reshape "
+                        "across the sharded time axis and are not "
+                        "supported under sp_axis")
+                if isinstance(lc, ATTENTION_BEANS):
+                    if getattr(lc, "ring_axis", None) != self.sp_axis:
+                        raise ValueError(
+                            f"layer {i}: sp_axis={self.sp_axis!r} "
+                            f"requires {type(lc).__name__}.ring_axis="
+                            f"{self.sp_axis!r} (got {lc.ring_axis!r})"
+                            " — build the conf with ring_axis (e.g. "
+                            "transformer_lm_flagship(ring_axis=...))")
+                elif isinstance(lc, (L.RnnOutputLayer,
+                                     L.LayerNormalization, MoeDense)):
+                    pass  # per-timestep/per-token: shards trivially
+                else:
+                    raise ValueError(
+                        f"layer {i} ({type(lc).__name__}) is not "
+                        "time-shardable under the pipelined sp "
+                        "schedule: attention beans with "
+                        "ring_axis=sp_axis plus LayerNormalization/"
+                        "RnnOutputLayer/MoeDense are supported "
+                        "(GravesLSTM/GRU sequence parallelism is the "
+                        "ParallelTrainer(sp_axis=...) path)")
         self._stack_conf = net.conf.confs[start]
         self._stack_updater = net._updaters[start]
         self._step_cache = {}

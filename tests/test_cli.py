@@ -188,6 +188,21 @@ class TestMeshTraining:
                      if "Accuracy" in ln][0].split()[-1])
         assert acc > 0.8
 
+    def test_pp_sp_mesh_routes_to_homogeneous_trainer(self, tmp_path,
+                                                      toy_csv):
+        """--mesh pp=2,sp=2 reaches HomogeneousPipelineTrainer (no
+        blanket SystemExit): a Dense-stack conf is then rejected by the
+        trainer's own time-shardability validation, naming the fix."""
+        from deeplearning4j_tpu.models.zoo import mlp
+
+        conf = mlp(sizes=(4, 8, 8, 8, 8, 8, 2), lr=0.2)
+        cpath = tmp_path / "homog.json"
+        cpath.write_text(conf.to_json())
+        with pytest.raises(ValueError, match="time-shardable"):
+            main(["train", "--conf", str(cpath), "--input", toy_csv,
+                  "--output", str(tmp_path / "m.zip"),
+                  "--batch-size", "40", "--mesh", "pp=2,sp=2"])
+
     def test_pp_interleave_requires_pp_axis(self, tmp_path, toy_csv,
                                             conf_json):
         with pytest.raises(SystemExit, match="pp axis"):

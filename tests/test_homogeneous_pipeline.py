@@ -406,8 +406,8 @@ class TestSequenceParallelComposition:
         trainer = HomogeneousPipelineTrainer(
             net, mesh, sp_axis="sp", n_microbatches=2)
         x, y = _batch(t=9)  # 9 % 2 != 0
-        # jax's device_put rejects the placement before the trainer's
-        # own shape check can run — either way the error names the
-        # divisibility problem
-        with pytest.raises(ValueError, match="divisible"):
+        # _validate_sp_batch fires before device_put with the crafted
+        # message (the opaque PartitionSpec error never surfaces)
+        with pytest.raises(ValueError,
+                           match="time axis 9 not divisible"):
             trainer.fit(DataSet(x, y))
