@@ -314,6 +314,7 @@ class HomogeneousPipelineTrainer:
         self._step_cache = {}
         self._state = None  # (pre, stack, post, pre_u, stack_u, post_u)
         self._synced = None
+        self._gather_cache = {}  # multihost stacked-leaf gather (jit)
 
     # -- stacked-state lifecycle --------------------------------------
     def _stack_leaf_spec(self, name: str) -> P:
@@ -364,9 +365,18 @@ class HomogeneousPipelineTrainer:
             out[name] = np.stack(vs) if self.V > 1 else vs[0]
         return out
 
+    def _gatherable(self, leaf):
+        """Stacked leaves are P(pp, ...)-sharded: when the pp axis
+        spans processes their shards are non-addressable, and the
+        shared helper reshards to replicated first (no-op — and no
+        collective — when pp stays within this host)."""
+        from deeplearning4j_tpu.parallel.mesh import gather_for_host
+
+        return gather_for_host(self.mesh, leaf, self._gather_cache)
+
     def _unstack_into(self, tree, stacked):
         for name, leaf in stacked.items():
-            mat = np.asarray(jax.device_get(leaf))
+            mat = np.asarray(jax.device_get(self._gatherable(leaf)))
             if self.V == 1:
                 mat = mat[None]
             for v in range(self.V):
@@ -447,7 +457,7 @@ class HomogeneousPipelineTrainer:
         self._unstack_into(net.params, stack_p)
         for slot, sub in stack_u.items():
             for name, leaf in sub.items():
-                mat = np.asarray(jax.device_get(leaf))
+                mat = np.asarray(jax.device_get(self._gatherable(leaf)))
                 if self.V == 1:
                     mat = mat[None]
                 for v in range(self.V):

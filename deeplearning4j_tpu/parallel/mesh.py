@@ -62,3 +62,24 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
     """Shard the leading (example) axis over the data-parallel mesh axis."""
     return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def gather_for_host(mesh: Mesh, leaf, cache: dict):
+    """Make ``leaf`` device_get-able on every host.
+
+    Multihost meshes leave axis-sharded buffers with non-addressable
+    shards; resharding to replicated (one cross-host all-gather) fixes
+    that. Fully addressable leaves pass through untouched — no
+    collective when the sharded axis stays within this host. ALL
+    processes must call this in lockstep over the same leaves
+    (addressability is a property of the sharding, so the gate
+    branches identically everywhere). ``cache`` holds the jitted
+    identity between calls (jit re-specializes per shape/dtype)."""
+    if getattr(leaf, "is_fully_addressable", True):
+        return leaf
+    fn = cache.get("gather_fn")
+    if fn is None:
+        fn = cache["gather_fn"] = jax.jit(
+            lambda a: a,
+            out_shardings=NamedSharding(mesh, PartitionSpec()))
+    return fn(leaf)

@@ -410,7 +410,7 @@ class PipelineTrainer:
         self._ustate = None
         self._sstate = None
         self._synced_params = None
-        self._gather_fn = None
+        self._gather_cache = {}
         self._p_pack = _StagePacker(
             [self._stage_subtree(net.params, s)
              for s in range(self.n_stages)])
@@ -457,22 +457,19 @@ class PipelineTrainer:
 
     def _gatherable(self, buf):
         """Multi-host: a [S, K] P(pp) buffer has non-addressable shards
-        when the pp axis spans processes; reshard to replicated first
-        (one cross-host all-gather) so device_get works everywhere.
+        when the pp axis spans processes; the shared helper reshards to
+        replicated first (one cross-host all-gather) so device_get
+        works everywhere — and passes through with NO collective when
+        pp stays within this host.
 
         NOTE: the gather transiently materializes that one buffer
         replicated on-device before the host copy — an explicit
         full-model materialization is what a sync IS; buffers are
         gathered one at a time, so the transient peak is one buffer,
-        not all three. The jitted identity is cached on self (jit
-        caches by function object)."""
-        if jax.process_count() > 1:
-            if self._gather_fn is None:
-                self._gather_fn = jax.jit(
-                    lambda a: a,
-                    out_shardings=NamedSharding(self.mesh, P()))
-            return self._gather_fn(buf)
-        return buf
+        not all three."""
+        from deeplearning4j_tpu.parallel.mesh import gather_for_host
+
+        return gather_for_host(self.mesh, buf, self._gather_cache)
 
     def _sync_to_net(self):
         """Gather packed state back into net.params / net.updater_state

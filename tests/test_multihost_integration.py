@@ -258,10 +258,45 @@ sp_checksum = float(
     sum(float(np.abs(np.asarray(v)).sum())
         for k in snet.params for v in snet.params[k].values()))
 sync_hosts("sp-done")
+
+# ---- pp x sp on one mesh spanning the process boundary: pipeline
+# stages ppermute across hosts WHILE ring attention rotates K/V over
+# sp inside every tick (the homogeneous stage-stacked trainer); each
+# host stores half the block stack.
+from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+from deeplearning4j_tpu.parallel.homogeneous_pipeline import (
+    HomogeneousPipelineTrainer,
+)
+
+hmesh = Mesh(np.array(jax.devices()).reshape(2, 2), ("pp", "sp"))
+hnet = MultiLayerNetwork(transformer_lm_flagship(
+    vocab=6, width=8, n_layers=5, n_heads=2, lr=1e-2,
+    warmup_steps=2, total_steps=100, seed=3,
+    ring_axis="sp")).init()
+htrainer = HomogeneousPipelineTrainer(
+    hnet, hmesh, sp_axis="sp", n_microbatches=2)
+Th = 8
+hx = rng.normal(size=(4, 6, Th)).astype(np.float32)
+hids = rng.integers(0, 6, size=(4, Th))
+hy = np.zeros((4, 6, Th), np.float32)
+for i in range(4):
+    hy[i, hids[i], np.arange(Th)] = 1.0
+hsp_scores = [float(htrainer.fit(DataSet(hx, hy)))
+              for _ in range(3)]
+hsp_local_bytes = max(
+    htrainer.per_device_state_bytes().get(d, 0)
+    for d in jax.local_devices())
+hsp_total = htrainer.total_stack_bytes()
+hsp_checksum = float(
+    sum(float(np.abs(np.asarray(v)).sum())
+        for k in hnet.params for v in hnet.params[k].values()))
+sync_hosts("hsp-done")
 print(json.dumps({
     "pid": pid, "tp_scores": tp_scores, "tp_checksum": tp_checksum,
     "pp_scores": pp_scores, "pp_checksum": pp_checksum,
     "sp_scores": sp_scores, "sp_checksum": sp_checksum,
+    "hsp_scores": hsp_scores, "hsp_checksum": hsp_checksum,
+    "hsp_local_bytes": hsp_local_bytes, "hsp_total": hsp_total,
     "local_bytes": local_bytes, "total_bytes": total_bytes,
 }), flush=True)
 """
@@ -293,17 +328,22 @@ def test_two_process_tp_and_pp_mesh_spans_hosts(tmp_path):
         outs.append(json.loads(out.strip().splitlines()[-1]))
     by_pid = {o["pid"]: o for o in outs}
     assert set(by_pid) == {0, 1}
-    for key in ("tp_scores", "pp_scores", "sp_scores"):
+    for key in ("tp_scores", "pp_scores", "sp_scores", "hsp_scores"):
         np.testing.assert_allclose(
             by_pid[0][key], by_pid[1][key], rtol=1e-6)
         assert by_pid[0][key][-1] < by_pid[0][key][0]
-    for key in ("tp_checksum", "pp_checksum", "sp_checksum"):
+    for key in ("tp_checksum", "pp_checksum", "sp_checksum",
+                "hsp_checksum"):
         np.testing.assert_allclose(
             by_pid[0][key], by_pid[1][key], rtol=1e-6)
     # Stage sharding across hosts: each host stores HALF the packed
     # model (2 of 4 stage rows), not a replica.
     for o in outs:
         assert o["local_bytes"] * 2 == o["total_bytes"], o
+        # homogeneous pp x sp: this host's devices each hold half the
+        # stacked block params (pp=2 spans the process boundary; sp
+        # replicates the stack within a stage)
+        assert o["hsp_local_bytes"] * 2 == o["hsp_total"], o
 
 
 _ELASTIC_WORKER = """
