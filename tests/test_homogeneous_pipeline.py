@@ -347,6 +347,42 @@ class TestInterleavedSchedule:
                 net, mesh, n_microbatches=2, interleave=4)
 
 
+class TestElasticMeshResume:
+    def test_checkpoint_on_interleaved_pp2_resumes_on_pp4(self,
+                                                          tmp_path):
+        """The stacked state syncs back to net.params/updater_state at
+        end-of-fit, so a standard save/load moves training between
+        ARBITRARY mesh shapes: steps 0-1 on pp=2 x interleave=2, then
+        resume on pp=4 plain — the continued trajectory matches an
+        uninterrupted single-device run."""
+        x, y = _batch()
+        ref = _net(n_layers=9)
+        a = _net(n_layers=9)
+        mesh2 = make_mesh(MeshSpec({"pp": 2}))
+        tr_a = HomogeneousPipelineTrainer(
+            a, mesh2, n_microbatches=2, interleave=2)
+        for _ in range(2):
+            ref.fit(DataSet(x, y))
+            tr_a.fit(DataSet(x, y))
+        path = str(tmp_path / "mid.zip")
+        a.save(path)
+
+        b = MultiLayerNetwork.load(path)
+        mesh4 = make_mesh(MeshSpec({"pp": 4}))
+        tr_b = HomogeneousPipelineTrainer(b, mesh4, n_microbatches=4)
+        s = float("nan")
+        for _ in range(2):
+            ref.fit(DataSet(x, y))
+            s = tr_b.fit(DataSet(x, y))
+        np.testing.assert_allclose(s, float(ref.score_value),
+                                   rtol=2e-4)
+        for si in ref.params:
+            for name, p in ref.params[si].items():
+                np.testing.assert_allclose(
+                    np.asarray(b.params[si][name]), np.asarray(p),
+                    atol=3e-4, err_msg=f"{si}/{name}")
+
+
 class TestSequenceParallelComposition:
     """sp INSIDE the pipeline ticks: activations' time axis sharded
     over sp, ring attention (conf-level ring_axis) runs per tick, the

@@ -84,6 +84,41 @@ class TestPipelinedSolverParity:
         s = tr.fit(ds)
         assert abs(s - float(net_sd.score_value)) < 1e-4
 
+    def test_masked_time_series_solver_matches_single_device(self):
+        """Masked sequences through the pipelined solver: the masked
+        global-mean machinery is the SAME closure the SGD step uses
+        (make_loss_fn), so CG line-search probes see the exact masked
+        loss the single-device FlatProblem computes."""
+        from deeplearning4j_tpu.models.zoo import lstm_classifier
+
+        def build():
+            conf = lstm_classifier(n_in=6, n_hidden=8, n_classes=3,
+                                   lr=0.05)
+            for c in conf.confs:
+                c.optimization_algo = OA.CONJUGATE_GRADIENT
+            conf.confs[0].num_iterations = 3
+            return MultiLayerNetwork(conf).init()
+
+        rng = np.random.default_rng(1)
+        b, t = 8, 5
+        x = rng.normal(size=(b, 6, t)).astype(np.float32)
+        y = np.zeros((b, 3, t), np.float32)
+        idx = rng.integers(0, 3, (b, t))
+        for i in range(b):
+            y[i, idx[i], np.arange(t)] = 1.0
+        fm = np.ones((b, t), np.float32)
+        fm[b // 2:, 3:] = 0.0  # uneven masks across microbatches
+        ds = DataSet(x, y, features_mask=fm, labels_mask=fm.copy())
+
+        net_sd = build()
+        net_sd.fit(ds)
+        net_pp = build()
+        mesh = make_mesh(MeshSpec({"pp": 2}))
+        tr = PipelineTrainer(net_pp, mesh, n_microbatches=2,
+                             stage_ranges=[(0, 1), (1, 2)])
+        s = tr.fit(ds)
+        assert abs(s - float(net_sd.score_value)) < 1e-4
+
     def test_solver_descends_over_batches(self):
         """Multi-batch fit: each batch gets its own full solver run
         (reference Solver semantics: optimize() per batch)."""
