@@ -618,17 +618,22 @@ class ParallelTrainer:
                 f"sp_axis {self.sp_axis!r} must name a mesh axis "
                 "distinct from dp_axis: the batch axis shards over dp "
                 "and the time axis over sp")
-        if self.is_graph:
-            raise ValueError(
-                "sp_axis supports MultiLayerNetwork only (the time-axis "
-                "shard contract is defined on the sequential layer "
-                "chain)")
+        # ComputationGraph composes too (round 4): layer vertices obey
+        # the same bean rules as the sequential chain, and the graph's
+        # structural vertices are either per-timestep (Merge/
+        # ElementWise/Subset concatenate, combine, or slice the FEATURE
+        # dim) or cross-time and rejected in _validate_sp_graph
+        # (LastTimeStep gathers one global timestep; preprocessors
+        # reshape across time; DuplicateToTimeSeries reads a static 2D
+        # input, and every sp batch leaf must be time-sharded 3D).
         if self.ep_axis or self.fsdp_axis:
             raise ValueError(
                 "sp_axis composes with dp (manual batch/time axes) and "
                 "tp (params stay GSPMD-auto inside the partial-manual "
                 "shard_map), but not with ep/fsdp param sharding")
-        algo = net.conf.confs[0].optimization_algo
+        first = (next(iter(net._layer_vertices.values())).conf
+                 if self.is_graph else net.conf.confs[0])
+        algo = first.optimization_algo
         if algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
             raise ValueError(
                 f"sp_axis is a plain-SGD-family path (got {algo}); "
@@ -637,6 +642,9 @@ class ParallelTrainer:
             raise ValueError(
                 "sp_axis replaces tBPTT as the long-sequence device "
                 "(SURVEY.md §5.7): full-BPTT with the time axis sharded")
+        if self.is_graph:
+            self._validate_sp_graph(net, ATTENTION_BEANS, L, MoeDense)
+            return
         for i, c in enumerate(net.conf.confs):
             lc = c.layer
             if net.conf.preprocessor_for(i) is not None:
@@ -683,6 +691,64 @@ class ParallelTrainer:
                 "last layer must be an output layer to compute a score "
                 f"(got {type(net.conf.confs[-1].layer).__name__})")
 
+    def _validate_sp_graph(self, net, ATTENTION_BEANS, L,
+                           MoeDense) -> None:
+        """Vertex-level time-shardability walk for ComputationGraph
+        (same bean rules as the sequential chain; structural vertices
+        per the _validate_sp comment)."""
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            DuplicateToTimeSeriesVertex,
+            LastTimeStepVertex,
+            LayerVertex,
+            PreprocessorVertex,
+        )
+
+        for name, vertex in net.conf.vertices.items():
+            if isinstance(vertex, (LastTimeStepVertex,
+                                   PreprocessorVertex,
+                                   DuplicateToTimeSeriesVertex)):
+                raise ValueError(
+                    f"vertex {name!r} ({type(vertex).__name__}) "
+                    "crosses the sharded time axis (global-timestep "
+                    "gather / reshape / static-to-time broadcast) and "
+                    "cannot run under sp_axis")
+            if not isinstance(vertex, LayerVertex):
+                continue  # Merge/ElementWise/Subset/Duplicate/input:
+                # feature-dim ops, per-timestep under the shard
+            if vertex.preprocessor is not None:
+                raise ValueError(
+                    f"vertex {name!r}: input preprocessors reshape "
+                    "across the sharded time axis and are not "
+                    "supported under sp_axis")
+            lc = vertex.conf.layer
+            if isinstance(lc, ATTENTION_BEANS + (L.GravesLSTM, L.GRU)):
+                if lc.ring_axis != self.sp_axis:
+                    raise ValueError(
+                        f"vertex {name!r}: {type(lc).__name__}"
+                        f".ring_axis={lc.ring_axis!r} must equal "
+                        f"sp_axis={self.sp_axis!r} so the time axis "
+                        "runs the sp schedule over the mesh's sp "
+                        "devices")
+            elif isinstance(lc, (L.RnnOutputLayer, MoeDense,
+                                 L.LayerNormalization)):
+                pass  # per-timestep/per-token: shards trivially
+            else:
+                raise ValueError(
+                    f"vertex {name!r} ({type(lc).__name__}) is not "
+                    "time-shardable: sp_axis graphs support "
+                    "MultiHeadSelfAttention, TransformerBlock, "
+                    "GravesLSTM, and GRU (each with "
+                    "ring_axis=sp_axis), plus MoeDense, "
+                    "LayerNormalization, and RnnOutputLayer vertices")
+        stateful = [
+            si for si, st in (net.state or {}).items()
+            if not (isinstance(st, dict) and set(st) <= {"aux_loss"})
+        ]
+        if stateful:
+            raise ValueError(
+                f"vertices {stateful} carry running state; sp_axis "
+                "supports stateless / aux-only-state vertices")
+
     def _sp_body_core(self, params, state, upd_state, iteration, rng,
                       f, y, fm, lm):
         """One synchronous global step on local [N?, C, T_local] shards,
@@ -708,23 +774,44 @@ class ParallelTrainer:
                     + didx)
         rng = jax.random.fold_in(rng, didx)
 
-        def loss_fn(p):
-            out, new_state, _ = net._forward_fn(
-                p, state, f, rng, True, fm)
-            if net._compute_dtype is not None:
-                out = _cast_floating(out, net._dtype)
-            data = net._impls[-1].loss(net.conf.confs[-1], out, y, lm)
-            rows = out.shape[0] * (out.shape[2] if out.ndim == 3 else 1)
-            if lm is None:
-                count = jnp.asarray(float(rows), data.dtype)
-            else:
-                count = jnp.sum(lm.astype(data.dtype))
+        def global_masked_term(data, out, lm_term):
             # data is the LOCAL masked mean = local_sum / max(count, 1);
             # recover the sum exactly (count 0 => data 0) and re-weight
             # by the global count.
+            rows = out.shape[0] * (out.shape[2] if out.ndim == 3 else 1)
+            if lm_term is None:
+                count = jnp.asarray(float(rows), data.dtype)
+            else:
+                count = jnp.sum(lm_term.astype(data.dtype))
             local_sum = data * jnp.maximum(count, 1.0)
             total = jnp.maximum(lax.psum(count, axes), 1.0)
-            local = local_sum / total
+            return local_sum / total
+
+        def loss_fn(p):
+            if self.is_graph:
+                # Multi-output graph: each output contributes its own
+                # global masked mean (the per-output lm lives in a
+                # dict keyed by output name).
+                acts, new_state, _ = net._forward_fn(
+                    p, state, f, rng, True, fm)
+                local = jnp.zeros((), net._dtype)
+                for out_name, yy in zip(net.conf.network_outputs, y):
+                    v = net._layer_vertices[out_name]
+                    lm_o = None if lm is None else lm.get(out_name)
+                    out = acts[out_name]
+                    if net._compute_dtype is not None:
+                        out = _cast_floating(out, net._dtype)
+                    data = net._impls[out_name].loss(
+                        v.conf, out, yy, lm_o)
+                    local = local + global_masked_term(data, out, lm_o)
+            else:
+                out, new_state, _ = net._forward_fn(
+                    p, state, f, rng, True, fm)
+                if net._compute_dtype is not None:
+                    out = _cast_floating(out, net._dtype)
+                data = net._impls[-1].loss(
+                    net.conf.confs[-1], out, y, lm)
+                local = global_masked_term(data, out, lm)
             # reg is computed identically on every device and aux is a
             # per-shard estimate: divide by the device count so the
             # psum of per-device losses (and of their gradients) yields
@@ -792,7 +879,8 @@ class ParallelTrainer:
                     p, s, u, it, jax.random.fold_in(rng, k), f, y, fm, lm)
                 return (p, s, u, it + 1), score
 
-            xs = {"f": fs, "y": ys, "k": jnp.arange(fs.shape[0])}
+            k_steps = jax.tree.leaves(fs)[0].shape[0]
+            xs = {"f": fs, "y": ys, "k": jnp.arange(k_steps)}
             if fms is not None:
                 xs["fm"] = fms
             if lms is not None:
@@ -829,13 +917,40 @@ class ParallelTrainer:
             jnp.asarray(arr, self.net._dtype),
             NamedSharding(self.mesh, spec))
 
+    def _sp_place_multi(self, ds):
+        """Graph batch placement: every input/label leaf must be a
+        time-sharded [B, C, T] array (static 2D leaves have no time
+        axis to shard — rejected with a named error); masks are
+        per-name [B, T] dicts."""
+        net = self.net
+        _, _, _, xspec, mspec = self._sp_specs()
+        inputs, labels, fm, lm = net._coerce_multi(ds)
+        for what, leaves in (("input", inputs.items()),
+                             ("label", zip(net.conf.network_outputs,
+                                           labels))):
+            for name, a in leaves:
+                if a.ndim != 3:
+                    raise ValueError(
+                        f"sp_axis graph {what} {name!r} must be "
+                        f"[B, C, T] (got rank {a.ndim}); static "
+                        "inputs have no time axis to shard")
+        put = lambda a: self._put_spec(a, xspec)  # noqa: E731
+        putm = lambda a: self._put_spec(a, mspec)  # noqa: E731
+        return (jax.tree.map(put, inputs),
+                [put(a) for a in labels],
+                None if fm is None else jax.tree.map(putm, fm),
+                None if lm is None else jax.tree.map(putm, lm))
+
     def _fit_sp(self, ds) -> float:
         net = self.net
         _, _, _, xspec, mspec = self._sp_specs()
-        feats = self._put_spec(ds.features, xspec)
-        labels = self._put_spec(ds.labels, xspec)
-        fm = self._put_spec(ds.features_mask, mspec)
-        lm = self._put_spec(ds.labels_mask, mspec)
+        if self.is_graph:
+            feats, labels, fm, lm = self._sp_place_multi(ds)
+        else:
+            feats = self._put_spec(ds.features, xspec)
+            labels = self._put_spec(ds.labels, xspec)
+            fm = self._put_spec(ds.features_mask, mspec)
+            lm = self._put_spec(ds.labels_mask, mspec)
         net._key, sub = jax.random.split(net._key)
         net.params, net.state, net.updater_state, score = self._sp_step_fn(
             net.params, net.state, net.updater_state,
@@ -851,17 +966,26 @@ class ParallelTrainer:
         _, _, _, xspec, mspec = self._sp_specs()
         kx = P(*((None,) + tuple(xspec)))
         km = P(*((None,) + tuple(mspec)))
-        fs = self._put_spec(fs, kx)
-        ys = self._put_spec(ys, kx)
-        fms = self._put_spec(fms, km)
-        lms = self._put_spec(lms, km)
+        if self.is_graph:
+            # [K, B, C, T] leaves in input dicts / label lists
+            fs = jax.tree.map(lambda a: self._put_spec(a, kx), fs)
+            ys = jax.tree.map(lambda a: self._put_spec(a, kx), ys)
+            fms = (None if fms is None else jax.tree.map(
+                lambda a: self._put_spec(a, km), fms))
+            lms = (None if lms is None else jax.tree.map(
+                lambda a: self._put_spec(a, km), lms))
+        else:
+            fs = self._put_spec(fs, kx)
+            ys = self._put_spec(ys, kx)
+            fms = self._put_spec(fms, km)
+            lms = self._put_spec(lms, km)
         net._key, sub = jax.random.split(net._key)
         start = net.iteration
         net.params, net.state, net.updater_state, scores = (
             self._sp_scan_fn(
                 net.params, net.state, net.updater_state,
                 jnp.asarray(net.iteration), sub, fs, ys, fms, lms))
-        net.iteration += int(fs.shape[0])
+        net.iteration += int(jax.tree.leaves(fs)[0].shape[0])
         net.score_value = scores[-1]
         from deeplearning4j_tpu.optimize.listeners import fire_crossed
 

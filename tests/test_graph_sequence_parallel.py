@@ -1,0 +1,185 @@
+"""ComputationGraph sequence parallelism (round 4).
+
+The round-3 sp path supported MultiLayerNetwork only; graphs now train
+with the time axis sharded over sp too — layer vertices obey the same
+conf-level `ring_axis` rules as the sequential chain, structural
+vertices (Merge/ElementWise/Subset) are per-timestep, cross-time
+vertices (LastTimeStep/Preprocessor/DuplicateToTimeSeries) are
+rejected with named errors, and multi-output losses reduce with the
+per-output GLOBAL masked mean (reference ComputationGraph multi-output
+score semantics, ComputationGraph.java score aggregation).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers.attention import MultiHeadSelfAttention
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+B, T, C_IN, C_OUT = 4, 16, 6, 5
+
+
+def _attn_lstm_graph(ring):
+    conf = (NeuralNetConfiguration.Builder().seed(4).learning_rate(0.02)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("attn", MultiHeadSelfAttention(
+                n_in=C_IN, n_out=8, n_heads=2, causal=True,
+                ring_axis=ring), "in")
+            .add_layer("lstm", L.GravesLSTM(n_in=8, n_out=8,
+                                            ring_axis=ring), "attn")
+            .add_layer("out", L.RnnOutputLayer(
+                n_in=8, n_out=C_OUT, activation="softmax",
+                loss_function=LossFunction.MCXENT), "lstm")
+            .set_outputs("out").build())
+    return ComputationGraph(conf).init()
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(B, C_IN, T)).astype(np.float32)
+    ids = rng.integers(0, C_OUT, (B, T))
+    y = np.zeros((B, C_OUT, T), np.float32)
+    for i in range(B):
+        y[i, ids[i], np.arange(T)] = 1.0
+    return x, y
+
+
+def _assert_params_close(a, b, rtol=2e-3, atol=3e-5):
+    for k in b.params:
+        for name in b.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[k][name]),
+                np.asarray(b.params[k][name]),
+                rtol=rtol, atol=atol, err_msg=f"{k}/{name}")
+
+
+class TestGraphSpParity:
+    def _ref(self, steps=3):
+        x, y = _batch()
+        ref = _attn_lstm_graph(None)
+        for _ in range(steps):
+            ref.fit(DataSet(x, y))
+        return ref, x, y
+
+    @pytest.mark.parametrize("mesh_axes", [
+        {"sp": 4}, {"dp": 2, "sp": 4}])
+    def test_matches_single_device(self, mesh_axes):
+        """Attention (ring) + GravesLSTM (sp_scan carry ring) vertices
+        track the unsharded graph across sp and dp x sp. (tp stays a
+        MultiLayerNetwork-only axis for graphs — the pre-existing
+        Megatron-chaining exclusion, asserted elsewhere.)"""
+        ref, x, y = self._ref()
+        g = _attn_lstm_graph("sp")
+        tr = ParallelTrainer(
+            g, make_mesh(MeshSpec(mesh_axes)), sp_axis="sp")
+        s = float("nan")
+        for _ in range(3):
+            s = tr.fit(DataSet(x, y))
+        assert abs(s - float(ref.score_value)) < 1e-4
+        _assert_params_close(g, ref)
+
+    def test_fit_scan_matches_fit(self):
+        x, y = _batch()
+        a, b = _attn_lstm_graph("sp"), _attn_lstm_graph("sp")
+        mesh = make_mesh(MeshSpec({"dp": 2, "sp": 4}))
+        ta = ParallelTrainer(a, mesh, sp_axis="sp")
+        tb = ParallelTrainer(b, mesh, sp_axis="sp")
+        K = 3
+        fs = {"in": np.stack([x] * K)}
+        ys = [np.stack([y] * K)]
+        scores_scan = np.asarray(tb.fit_scan(fs, ys))
+        scores_fit = [ta.fit(DataSet(x, y)) for _ in range(K)]
+        np.testing.assert_allclose(scores_scan, scores_fit, rtol=2e-4)
+        _assert_params_close(b, a)
+
+    def test_multi_output_masked_global_mean(self):
+        """Two outputs with UNEVEN label masks across time shards: each
+        output's loss is its global masked mean, so the sp score
+        matches single-device exactly (per-output count correction)."""
+        def build(ring):
+            conf = (NeuralNetConfiguration.Builder().seed(7)
+                    .learning_rate(0.02)
+                    .graph_builder()
+                    .add_inputs("in")
+                    .add_layer("attn", MultiHeadSelfAttention(
+                        n_in=C_IN, n_out=8, n_heads=2, causal=True,
+                        ring_axis=ring), "in")
+                    .add_layer("o1", L.RnnOutputLayer(
+                        n_in=8, n_out=C_OUT, activation="softmax",
+                        loss_function=LossFunction.MCXENT), "attn")
+                    .add_layer("o2", L.RnnOutputLayer(
+                        n_in=8, n_out=3, activation="softmax",
+                        loss_function=LossFunction.MCXENT), "attn")
+                    .set_outputs("o1", "o2").build())
+            return ComputationGraph(conf).init()
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(B, C_IN, T)).astype(np.float32)
+        y1 = np.zeros((B, C_OUT, T), np.float32)
+        y2 = np.zeros((B, 3, T), np.float32)
+        i1 = rng.integers(0, C_OUT, (B, T))
+        i2 = rng.integers(0, 3, (B, T))
+        for i in range(B):
+            y1[i, i1[i], np.arange(T)] = 1.0
+            y2[i, i2[i], np.arange(T)] = 1.0
+        # masks concentrated on the FIRST time shards — uneven by design
+        m1 = np.ones((B, T), np.float32); m1[:, T // 2:] = 0.0
+        m2 = np.ones((B, T), np.float32); m2[:, : T // 4] = 0.0
+        mds = MultiDataSet([x], [y1, y2], labels_masks=[m1, m2])
+
+        ref = build(None)
+        for _ in range(3):
+            ref.fit(mds)
+        g = build("sp")
+        tr = ParallelTrainer(g, make_mesh(MeshSpec({"sp": 4})),
+                             sp_axis="sp")
+        s = float("nan")
+        for _ in range(3):
+            s = tr.fit(mds)
+        assert abs(s - float(ref.score_value)) < 1e-4
+        _assert_params_close(g, ref)
+
+
+class TestGraphSpValidation:
+    def test_last_time_step_vertex_rejected(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            LastTimeStepVertex,
+        )
+
+        conf = (NeuralNetConfiguration.Builder().seed(1)
+                .learning_rate(0.02)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", L.GravesLSTM(
+                    n_in=C_IN, n_out=8, ring_axis="sp"), "in")
+                .add_vertex("last", LastTimeStepVertex(mask_input="in"),
+                            "lstm")
+                .add_layer("out", L.OutputLayer(
+                    n_in=8, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT), "last")
+                .set_outputs("out").build())
+        g = ComputationGraph(conf).init()
+        with pytest.raises(ValueError, match="LastTimeStep"):
+            ParallelTrainer(g, make_mesh(MeshSpec({"sp": 4})),
+                            sp_axis="sp")
+
+    def test_missing_ring_axis_rejected(self):
+        g = _attn_lstm_graph(None)
+        with pytest.raises(ValueError, match="ring_axis"):
+            ParallelTrainer(g, make_mesh(MeshSpec({"sp": 4})),
+                            sp_axis="sp")
+
+    def test_static_2d_input_rejected(self):
+        g = _attn_lstm_graph("sp")
+        tr = ParallelTrainer(g, make_mesh(MeshSpec({"sp": 4})),
+                             sp_axis="sp")
+        with pytest.raises(ValueError, match=r"\[B, C, T\]"):
+            tr.fit(DataSet(np.zeros((B, C_IN), np.float32),
+                           np.zeros((B, C_OUT, T), np.float32)))
