@@ -182,6 +182,10 @@ def _cmd_train(args) -> int:
         if interleave > 1:
             # interleaved schedule is collision-free at M <= S
             pp_microbatches = min(pp_microbatches, spec["pp"])
+            if pp_microbatches < 4:
+                print(f"note: capped pipeline microbatches to "
+                      f"pp={pp_microbatches} for the interleaved "
+                      "schedule (changes microbatch size)")
         if "pp" in spec:
             bad = sorted(set(spec) & {"fsdp", "ep"})
             if bad:

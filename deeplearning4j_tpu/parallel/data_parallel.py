@@ -934,6 +934,13 @@ class ParallelTrainer:
                         f"sp_axis graph {what} {name!r} must be "
                         f"[B, C, T] (got rank {a.ndim}); static "
                         "inputs have no time axis to shard")
+        for what, masks in (("feature mask", fm), ("label mask", lm)):
+            for name, a in (masks or {}).items():
+                if a.ndim != 2:
+                    raise ValueError(
+                        f"sp_axis graph {what} {name!r} must be "
+                        f"[B, T] (got rank {a.ndim}) to shard its "
+                        "time axis")
         put = lambda a: self._put_spec(a, xspec)  # noqa: E731
         putm = lambda a: self._put_spec(a, mspec)  # noqa: E731
         return (jax.tree.map(put, inputs),

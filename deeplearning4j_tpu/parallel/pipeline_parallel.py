@@ -1172,6 +1172,13 @@ class PipelinedProblem:
     model like the SGD path (the property asserted in
     tests/test_pipeline_expert.py:634).
 
+    Listener visibility: ``write_back`` syncs ``net.params`` from the
+    packed buffer only when ``jax.process_count() == 1`` — under
+    multi-process runs, per-iteration listeners observe stale
+    ``net.params`` until the end of ``fit()`` (same contract as the
+    SGD path's listener sync; the gather would cost a cross-host
+    collective per solver iteration).
+
     Mirrors optimize/solver.py FlatProblem's surface: ``x0``,
     ``value_and_grad(x) -> (score, grad)``, ``value(x) -> score``,
     ``hessian_vector_product`` (forward-over-reverse jvp through the
