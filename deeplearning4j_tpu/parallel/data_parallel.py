@@ -917,6 +917,32 @@ class ParallelTrainer:
             jnp.asarray(arr, self.net._dtype),
             NamedSharding(self.mesh, spec))
 
+    def _sp_check_ranks(self, inputs, labels, fm, lm, stacked=False):
+        """Reject wrongly-shaped sp-graph leaves with a named error
+        before placement (a raw GSPMD sharding failure otherwise).
+        Covers both the per-batch fit path ([B, C, T] leaves, [B, T]
+        masks) and the fused fit_scan path (leading K axis on each)."""
+        net = self.net
+        rank = 4 if stacked else 3
+        shape_x = "[K, B, C, T]" if stacked else "[B, C, T]"
+        shape_m = "[K, B, T]" if stacked else "[B, T]"
+        for what, leaves in (("input", inputs.items()),
+                             ("label", zip(net.conf.network_outputs,
+                                           labels))):
+            for name, a in leaves:
+                if a.ndim != rank:
+                    raise ValueError(
+                        f"sp_axis graph {what} {name!r} must be "
+                        f"{shape_x} (got rank {a.ndim}); static "
+                        "inputs have no time axis to shard")
+        for what, masks in (("feature mask", fm), ("label mask", lm)):
+            for name, a in (masks or {}).items():
+                if a.ndim != rank - 1:
+                    raise ValueError(
+                        f"sp_axis graph {what} {name!r} must be "
+                        f"{shape_m} (got rank {a.ndim}) to shard "
+                        "its time axis")
+
     def _sp_place_multi(self, ds):
         """Graph batch placement: every input/label leaf must be a
         time-sharded [B, C, T] array (static 2D leaves have no time
@@ -925,22 +951,7 @@ class ParallelTrainer:
         net = self.net
         _, _, _, xspec, mspec = self._sp_specs()
         inputs, labels, fm, lm = net._coerce_multi(ds)
-        for what, leaves in (("input", inputs.items()),
-                             ("label", zip(net.conf.network_outputs,
-                                           labels))):
-            for name, a in leaves:
-                if a.ndim != 3:
-                    raise ValueError(
-                        f"sp_axis graph {what} {name!r} must be "
-                        f"[B, C, T] (got rank {a.ndim}); static "
-                        "inputs have no time axis to shard")
-        for what, masks in (("feature mask", fm), ("label mask", lm)):
-            for name, a in (masks or {}).items():
-                if a.ndim != 2:
-                    raise ValueError(
-                        f"sp_axis graph {what} {name!r} must be "
-                        f"[B, T] (got rank {a.ndim}) to shard its "
-                        "time axis")
+        self._sp_check_ranks(inputs, labels, fm, lm)
         put = lambda a: self._put_spec(a, xspec)  # noqa: E731
         putm = lambda a: self._put_spec(a, mspec)  # noqa: E731
         return (jax.tree.map(put, inputs),
@@ -975,6 +986,7 @@ class ParallelTrainer:
         km = P(*((None,) + tuple(mspec)))
         if self.is_graph:
             # [K, B, C, T] leaves in input dicts / label lists
+            self._sp_check_ranks(fs, ys, fms, lms, stacked=True)
             fs = jax.tree.map(lambda a: self._put_spec(a, kx), fs)
             ys = jax.tree.map(lambda a: self._put_spec(a, kx), ys)
             fms = (None if fms is None else jax.tree.map(
