@@ -61,7 +61,8 @@ def main():
     K = pool // B
     conf = transformer_lm_flagship(
         vocab=V, width=args.width, n_layers=args.layers, n_heads=8,
-        lr=3e-4, warmup_steps=K, total_steps=args.epochs * K)
+        lr=3e-4, warmup_steps=min(K, max(1, args.epochs * K // 4)),
+        total_steps=args.epochs * K)
     for c in conf.confs:
         c.compute_dtype = "bfloat16"
     net = MultiLayerNetwork(conf).init()

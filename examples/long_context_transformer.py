@@ -10,6 +10,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+if os.environ.get("DL4J_EXAMPLES_PLATFORM", "native") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+# DL4J_EXAMPLES_TINY=1: CI smoke mode (tests/test_examples_smoke.py)
+TINY = os.environ.get("DL4J_EXAMPLES_TINY") == "1"
+
 import numpy as np
 
 from deeplearning4j_tpu.models.zoo import transformer_lm
@@ -17,16 +24,17 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
 
 def main():
+    width, layers, T, steps = (64, 2, 256, 2) if TINY else (256, 4, 4096, 5)
     net = MultiLayerNetwork(transformer_lm(
-        n_in=64, width=256, n_layers=4, n_heads=8, n_classes=64,
+        n_in=64, width=width, n_layers=layers, n_heads=8, n_classes=64,
         remat=True)).init()
-    B, T = 2, 4096
+    B = 2
     rng = np.random.default_rng(0)
     x = rng.normal(size=(B, 64, T)).astype(np.float32)
     y = np.zeros((B, 64, T), np.float32)
     y[np.arange(B)[:, None], rng.integers(0, 64, (B, T)),
       np.arange(T)[None, :]] = 1.0
-    for step in range(5):
+    for step in range(steps):
         net.fit(x, y)
         print(f"step {step}: loss {float(net.score_value):.4f}")
 

@@ -9,6 +9,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+if os.environ.get("DL4J_EXAMPLES_PLATFORM", "native") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+# DL4J_EXAMPLES_TINY=1: CI smoke mode (tests/test_examples_smoke.py)
+TINY = os.environ.get("DL4J_EXAMPLES_TINY") == "1"
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
@@ -36,10 +43,11 @@ def main():
     net = MultiLayerNetwork(conf).init()
     net.set_listeners(ScoreIterationListener(50))
 
-    train = MnistDataSetIterator(128, train=True, num_examples=8192)
-    test = MnistDataSetIterator(256, train=False, num_examples=2048)
+    n_train, n_test, epochs = (1024, 512, 1) if TINY else (8192, 2048, 3)
+    train = MnistDataSetIterator(128, train=True, num_examples=n_train)
+    test = MnistDataSetIterator(256, train=False, num_examples=n_test)
 
-    for epoch in range(3):
+    for epoch in range(epochs):
         train.reset()
         net.fit(train)
         print(f"epoch {epoch}: score {float(net.score_value):.4f}")

@@ -8,6 +8,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+if os.environ.get("DL4J_EXAMPLES_PLATFORM", "native") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 import random
 
 from deeplearning4j_tpu.nlp.sentence_iterator import (

@@ -1,0 +1,55 @@
+"""Every example under examples/ runs to completion in CI.
+
+The reference treats runnable examples as tests (SURVEY.md §4: tests are
+small real runs); here each example executes as a subprocess in tiny-shape
+smoke mode (DL4J_EXAMPLES_TINY=1) on the CPU backend
+(DL4J_EXAMPLES_PLATFORM=cpu). XLA_FLAGS is dropped from the child env so
+each example picks its own virtual-device count (pipeline_4d needs 16,
+conftest pins 8 for in-process tests).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXAMPLES = [
+    ("distributed_data_parallel.py", []),
+    ("flagship_transformer.py", ["--width", "64", "--epochs", "1"]),
+    ("fsdp_zero3_training.py", []),
+    ("long_context_transformer.py", []),
+    ("mnist_mlp.py", []),
+    ("moe_expert_parallel.py", []),
+    ("native_pjrt_client.py", []),
+    ("pipeline_4d_training.py", []),
+    ("sequence_parallel_transformer.py", []),
+    ("streaming_decode.py", []),
+    ("word2vec_similarity.py", []),
+]
+
+
+def test_all_examples_listed():
+    on_disk = sorted(
+        f for f in os.listdir(os.path.join(REPO, "examples"))
+        if f.endswith(".py"))
+    assert on_disk == sorted(name for name, _ in EXAMPLES), (
+        "examples/ and the smoke list diverged — add the new example "
+        "(with a DL4J_EXAMPLES_TINY mode if it is heavy)")
+
+
+@pytest.mark.parametrize("name,args", EXAMPLES,
+                         ids=[n for n, _ in EXAMPLES])
+def test_example_runs(name, args):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["DL4J_EXAMPLES_PLATFORM"] = "cpu"
+    env["DL4J_EXAMPLES_TINY"] = "1"
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name), *args],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert p.returncode == 0, (
+        f"{name} exited {p.returncode}\n--- stdout\n{p.stdout[-4000:]}"
+        f"\n--- stderr\n{p.stderr[-4000:]}")
