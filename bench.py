@@ -322,6 +322,28 @@ class WideCnnBench(ScanBench):
         self.accuracy = round(float((out.argmax(1) == hc).mean()), 4)
         if self.accuracy < ACCURACY_GATE:
             _fail_gate(f"wide_cnn accuracy {self.accuracy}")
+        # REAL pixels through the REAL on-disk format: the same conv
+        # architecture trained on the bundled CIFAR-binary fixture of
+        # real photograph patches (datasets/fixtures/README.md) —
+        # native C++ decode -> fit -> held-out accuracy.
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.fixtures import (
+            real_patches_cifar,
+        )
+
+        rtr, rte = real_patches_cifar(n_test=40, seed=0)
+        pad = lambda y: np.pad(np.asarray(y), ((0, 0), (0, 8)))  # noqa
+        rnet = MultiLayerNetwork(wide_cnn(lr=0.01)).init()
+        rds = DataSet(rtr.features, pad(rtr.labels))
+        for _ in range(120):
+            rnet.fit(rds)
+        rout = np.asarray(rnet.output(rte.features))
+        self.accuracy_real_patches = round(float(
+            (rout.argmax(1) == np.asarray(rte.labels).argmax(1)).mean()),
+            4)
+        if self.accuracy_real_patches < 0.9:
+            _fail_gate(
+                f"wide_cnn real patches {self.accuracy_real_patches}")
 
     def _make(self, n, seed):
         r = np.random.default_rng(seed)
@@ -342,6 +364,7 @@ class WideCnnBench(ScanBench):
                 med * WIDE_CNN_FLOPS_PER_EXAMPLE / V5E_PEAK_BF16_FLOPS,
                 4),
             "accuracy": self.accuracy,
+            "accuracy_real_patches": self.accuracy_real_patches,
         }
 
 

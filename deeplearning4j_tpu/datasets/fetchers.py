@@ -30,11 +30,12 @@ LFW_DEFAULT_SHAPE = (1, 28, 28)  # reference test subset uses small crops
 # ---------------------------------------------------------------------------
 
 def _read_cifar_bin(path: str) -> Tuple[np.ndarray, np.ndarray]:
-    """CIFAR-10 binary batch: rows of [label u8][3072 pixel u8]."""
-    raw = np.fromfile(path, dtype=np.uint8)
-    rows = raw.reshape(-1, 3073)
-    return (rows[:, 1:].reshape(-1, *CIFAR_SHAPE),
-            rows[:, 0].astype(np.uint8))
+    """CIFAR-10 binary batch: rows of [label u8][3072 pixel u8].
+    Decodes through the native runtime (dl4j_read_cifar_bin) with a
+    numpy fallback — both live in native_rt.read_cifar_bin."""
+    from deeplearning4j_tpu.native_rt import read_cifar_bin
+
+    return read_cifar_bin(path)
 
 
 def _synthetic_images(n: int, shape, num_classes: int, seed: int,
@@ -114,26 +115,90 @@ class CifarDataSetIterator(BaseDataSetIterator):
 # LFW (faces)
 # ---------------------------------------------------------------------------
 
+def _resize_nchw(imgs: np.ndarray, shape) -> np.ndarray:
+    """Resize u8 [N,C,H,W] to (c,h,w), matching the PIL reader's
+    semantics (convert('L'/'RGB') + default resize filter) so native
+    and PIL load_lfw paths yield identical pixels for the same tree;
+    numpy nearest-neighbor + ITU-R 601 luma fallback without PIL."""
+    c, h, w = shape
+    n, ic, ih, iw = imgs.shape
+    if (ic, ih, iw) == (c, h, w):
+        return imgs
+    try:
+        from PIL import Image
+    except ImportError:
+        ri = (np.arange(h) * ih // h)
+        ci = (np.arange(w) * iw // w)
+        out = imgs[:, :, ri[:, None], ci[None, :]]
+        if ic != c:
+            if c == 1:  # ITU-R 601 luma, like PIL convert("L")
+                wts = (np.array([0.299, 0.587, 0.114], np.float32)
+                       if ic == 3 else np.full(ic, 1.0 / ic, np.float32))
+                out = (np.tensordot(out.astype(np.float32), wts,
+                                    axes=([1], [0]))[:, None]
+                       ).astype(np.uint8)
+            else:
+                out = np.repeat(out[:, :1], c, axis=1)
+        return out
+    mode = "L" if c == 1 else "RGB"
+    res = np.empty((n, c, h, w), np.uint8)
+    for i in range(n):
+        img = Image.fromarray(
+            imgs[i, 0] if ic == 1 else imgs[i].transpose(1, 2, 0))
+        img = img.convert(mode).resize((w, h))
+        arr = np.asarray(img, np.uint8)
+        res[i] = arr[None] if c == 1 else arr.transpose(2, 0, 1)
+    return res
+
+
 def load_lfw(num_examples: Optional[int] = None, num_people: int = 5,
-             image_shape=LFW_DEFAULT_SHAPE
+             image_shape=LFW_DEFAULT_SHAPE,
+             root: Optional[str] = None
              ) -> Tuple[np.ndarray, np.ndarray, list]:
     """-> (images u8 [N,C,H,W], labels u8 [N], person_names). Reads a
-    class-per-subdirectory image tree at $DL4J_TPU_DATA_DIR/lfw when
-    present (the reference's unpacked LFW layout), else synthesizes."""
-    root = os.path.join(_data_dir(), "lfw")
+    class-per-subdirectory image tree (the reference's unpacked LFW
+    layout, datasets/fetchers/LFWDataFetcher.java) at ``root`` or
+    $DL4J_TPU_DATA_DIR/lfw when present, else synthesizes. Netpbm trees
+    decode through the native runtime (dl4j_read_image_dir); JPEG/PNG
+    trees through PIL."""
+    root = root or os.path.join(_data_dir(), "lfw")
     if os.path.isdir(root):
+        from deeplearning4j_tpu.native_rt import read_image_dir
+
+        native = read_image_dir(root)
+        if native is not None:
+            imgs, labels = native
+            # same enumeration rule as the native reader: sorted,
+            # hidden ('.'-prefixed) directories skipped — labels and
+            # names stay aligned
+            names = sorted(d for d in os.listdir(root)
+                           if not d.startswith(".")
+                           and os.path.isdir(os.path.join(root, d)))
+            keep = labels < num_people
+            imgs, labels = imgs[keep], labels[keep]
+            names = names[:num_people]
+            imgs = _resize_nchw(imgs, image_shape)
+            if num_examples is not None:
+                imgs, labels = imgs[:num_examples], labels[:num_examples]
+            return imgs, labels.astype(np.uint8), names
+
         from PIL import Image
 
         c, h, w = image_shape
         mode = "L" if c == 1 else "RGB"
+        # same enumeration rule as the native reader (hidden dirs
+        # skipped) so the two paths assign identical labels
         names = sorted(d for d in os.listdir(root)
-                       if os.path.isdir(os.path.join(root, d)))[:num_people]
+                       if not d.startswith(".")
+                       and os.path.isdir(os.path.join(root, d))
+                       )[:num_people]
         img_list, lbl_list = [], []
         for li, name in enumerate(names):
             folder = os.path.join(root, name)
             for fn in sorted(os.listdir(folder)):
                 if os.path.splitext(fn)[1].lower() not in (
-                        ".png", ".jpg", ".jpeg", ".bmp"):
+                        ".png", ".jpg", ".jpeg", ".bmp",
+                        ".ppm", ".pgm", ".pnm"):
                     continue
                 img = Image.open(os.path.join(folder, fn)) \
                     .convert(mode).resize((w, h))

@@ -10,6 +10,15 @@ real datasets on a zero-egress machine:
 - ``raw_sentences()`` — 97k real English sentences (reference fixture
   raw_sentences.txt, the Word2VecTests corpus).
 - ``digits_dataset()`` — sklearn's 1,797 real 8x8 handwritten digits.
+
+Round-5 additions (real image pixels for the CNN/ingestion paths):
+
+- ``lfw_fixture_dir()`` — a REAL LFW subset (4 photos, 2 people), the
+  same fixture tree the reference bundles
+  (dl4j-test-resources/src/main/resources/lfwtest).
+- ``real_patches_cifar()`` — 200 real-photograph 32x32 patches in the
+  exact CIFAR-10 binary on-disk format (see
+  scripts/make_image_fixtures.py for provenance).
 """
 
 from __future__ import annotations
@@ -23,6 +32,15 @@ import numpy as np
 from deeplearning4j_tpu.datasets.dataset import DataSet
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _split(feats, onehot, n_test, seed):
+    """Seeded shuffle -> (train, test) DataSets (shared by every
+    fixture loader so split semantics cannot diverge)."""
+    order = np.random.default_rng(seed).permutation(feats.shape[0])
+    tr, te = order[n_test:], order[:n_test]
+    return (DataSet(feats[tr], onehot[tr]),
+            DataSet(feats[te], onehot[te]))
 
 
 def mnist200_datasets(n_test: int = 40, seed: int = 0
@@ -39,10 +57,29 @@ def mnist200_datasets(n_test: int = 40, seed: int = 0
     n = imgs.shape[0]
     feats = imgs.reshape(n, -1).astype(np.float32) / 255.0
     onehot = np.eye(10, dtype=np.float32)[labels]
-    order = np.random.default_rng(seed).permutation(n)
-    tr, te = order[n_test:], order[:n_test]
-    return (DataSet(feats[tr], onehot[tr]),
-            DataSet(feats[te], onehot[te]))
+    return _split(feats, onehot, n_test, seed)
+
+
+def lfw_fixture_dir() -> str:
+    """Root of the bundled real LFW subset (class-per-subdirectory jpg
+    tree: 2 people, 4 images) — feed to ``load_lfw(root=...)``."""
+    return os.path.join(_HERE, "lfw")
+
+
+def real_patches_cifar(n_test: int = 40, seed: int = 0
+                       ) -> Tuple[DataSet, DataSet]:
+    """(train, test) split of 200 REAL 32x32 photograph patches stored
+    in CIFAR-10 binary format (2 classes: which photo the patch came
+    from). Decodes through the same native/numpy CIFAR parser as
+    ``load_cifar``; features [N, 3, 32, 32] in [0, 1], labels one-hot
+    [N, 2]."""
+    from deeplearning4j_tpu.native_rt import read_cifar_bin, u8_to_f32
+
+    imgs, labels = read_cifar_bin(
+        os.path.join(_HERE, "real_patches_batch.bin"))
+    feats = u8_to_f32(imgs)
+    onehot = np.eye(2, dtype=np.float32)[labels]
+    return _split(feats, onehot, n_test, seed)
 
 
 def raw_sentences(limit: int = None) -> List[str]:
@@ -67,8 +104,4 @@ def digits_dataset(n_test: int = 360, seed: int = 0
     d = load_digits()
     feats = (d.data / 16.0).astype(np.float32)
     onehot = np.eye(10, dtype=np.float32)[d.target]
-    n = feats.shape[0]
-    order = np.random.default_rng(seed).permutation(n)
-    tr, te = order[n_test:], order[:n_test]
-    return (DataSet(feats[tr], onehot[tr]),
-            DataSet(feats[te], onehot[te]))
+    return _split(feats, onehot, n_test, seed)

@@ -13,6 +13,8 @@ from deeplearning4j_tpu.native_rt.lib import (
     native_available,
     read_idx,
     read_csv,
+    read_cifar_bin,
+    read_image_dir,
     u8_to_f32,
     one_hot,
     shuffle_indices,
@@ -25,6 +27,8 @@ __all__ = [
     "native_available",
     "read_idx",
     "read_csv",
+    "read_cifar_bin",
+    "read_image_dir",
     "u8_to_f32",
     "one_hot",
     "shuffle_indices",

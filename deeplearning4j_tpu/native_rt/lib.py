@@ -76,6 +76,21 @@ class NativeLib:
             self.has_mine_pairs = True
         except AttributeError:  # older prebuilt .so
             self.has_mine_pairs = False
+        try:  # ABI v4+: CIFAR binary batches + netpbm image trees
+            lib.dl4j_read_cifar_bin.restype = ctypes.c_void_p
+            lib.dl4j_read_cifar_bin.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+            lib.dl4j_read_image_dir.restype = ctypes.c_void_p
+            lib.dl4j_read_image_dir.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+            self.has_image_readers = True
+        except AttributeError:  # older prebuilt .so
+            self.has_image_readers = False
         try:  # ABI v3+: vocab hash + whitespace tokenizer
             lib.dl4j_vocab_new.restype = ctypes.c_void_p
             lib.dl4j_vocab_new.argtypes = [
@@ -232,6 +247,73 @@ def read_csv(path: str, delimiter: str = ",") -> np.ndarray:
         return view.reshape(rows.value, cols.value).copy()  # one copy
     finally:
         nl.lib.dl4j_free(ptr)
+
+
+def read_cifar_bin(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-10 binary batch file → (images u8 [N,3,32,32], labels u8
+    [N]). Native decode when available; numpy fallback otherwise.
+    Reference datasets/iterator/impl/CifarDataSetIterator.java."""
+    nl = NativeLib.load()
+    if nl is not None and getattr(nl, "has_image_readers", False):
+        n = ctypes.c_int64()
+        labels_ptr = ctypes.POINTER(ctypes.c_uint8)()
+        ptr = nl.lib.dl4j_read_cifar_bin(
+            path.encode(), ctypes.byref(n), ctypes.byref(labels_ptr))
+        if ptr:
+            try:
+                imgs = np.ctypeslib.as_array(
+                    ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+                    shape=(n.value * 3072,)).reshape(n.value, 3, 32, 32
+                                                     ).copy()
+                labels = np.ctypeslib.as_array(
+                    labels_ptr, shape=(n.value,)).copy()
+                return imgs, labels
+            finally:
+                nl.lib.dl4j_free(ptr)
+                nl.lib.dl4j_free(
+                    ctypes.cast(labels_ptr, ctypes.c_void_p))
+        # fall through: the numpy parser raises the authoritative error
+    raw = np.fromfile(path, dtype=np.uint8)
+    if raw.size == 0 or raw.size % 3073:
+        raise ValueError(
+            f"{path} is not a CIFAR-10 binary batch "
+            f"({raw.size} bytes, not a multiple of 3073)")
+    rows = raw.reshape(-1, 3073)
+    return (rows[:, 1:].reshape(-1, 3, 32, 32).copy(),
+            rows[:, 0].copy())
+
+
+def read_image_dir(root: str
+                   ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Class-per-subdirectory netpbm (P5/P6) image tree → (images u8
+    [N,C,H,W], labels u8 [N]); class ids follow sorted subdirectory
+    names. Returns None when the native library is unavailable or the
+    tree holds no readable netpbm images (callers fall back to the
+    PIL reader, which also handles JPEG/PNG)."""
+    nl = NativeLib.load()
+    if nl is None or not getattr(nl, "has_image_readers", False):
+        return None
+    n = ctypes.c_int64()
+    c = ctypes.c_int32()
+    h = ctypes.c_int32()
+    w = ctypes.c_int32()
+    labels_ptr = ctypes.POINTER(ctypes.c_uint8)()
+    ptr = nl.lib.dl4j_read_image_dir(
+        root.encode(), ctypes.byref(n), ctypes.byref(c),
+        ctypes.byref(h), ctypes.byref(w), ctypes.byref(labels_ptr))
+    if not ptr:
+        return None
+    try:
+        shape = (n.value, c.value, h.value, w.value)
+        imgs = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(int(np.prod(shape)),)).reshape(shape).copy()
+        labels = np.ctypeslib.as_array(
+            labels_ptr, shape=(n.value,)).copy()
+        return imgs, labels
+    finally:
+        nl.lib.dl4j_free(ptr)
+        nl.lib.dl4j_free(ctypes.cast(labels_ptr, ctypes.c_void_p))
 
 
 def u8_to_f32(src: np.ndarray, scale: float = 1.0 / 255.0) -> np.ndarray:

@@ -13,7 +13,12 @@
 // dl4j_free. Thread-safety: the ring buffer is internally locked;
 // loaders are reentrant.
 
+#include <dirent.h>
+#include <sys/stat.h>
+
+#include <algorithm>
 #include <atomic>
+#include <cctype>
 #include <charconv>
 #include <condition_variable>
 #include <cstdint>
@@ -87,6 +92,250 @@ void* dl4j_read_idx(const char* path, int32_t* ndim, int64_t* shape,
   *ndim = nd;
   *elem_size = 1;
   return buf;
+}
+
+// ---------------------------------------------------------------------
+// CIFAR-10 binary batch decoding — reference
+// datasets/iterator/impl/CifarDataSetIterator.java (the downloaded
+// cifar-10-binary.tar.gz batches). Row layout: [label u8][3072 px u8]
+// with pixels already channel-major (R plane, G plane, B plane) —
+// i.e. rows decode directly to [3, 32, 32] CHW.
+// ---------------------------------------------------------------------
+// Returns malloc'd image bytes [N, 3, 32, 32]; fills n; *labels_out is
+// a separately malloc'd u8[N] (free both with dl4j_free). NULL when the
+// file is missing or its size is not a multiple of 3073.
+
+void* dl4j_read_cifar_bin(const char* path, int64_t* n,
+                          uint8_t** labels_out) {
+  const int64_t kRow = 3073;  // 1 label byte + 3*32*32 pixels
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  int64_t size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size <= 0 || size % kRow != 0) {
+    std::fclose(f);
+    return nullptr;
+  }
+  int64_t rows = size / kRow;
+  uint8_t* imgs = (uint8_t*)std::malloc(size_t(rows) * 3072);
+  uint8_t* labels = (uint8_t*)std::malloc(size_t(rows));
+  if (!imgs || !labels) {
+    std::free(imgs);
+    std::free(labels);
+    std::fclose(f);
+    return nullptr;
+  }
+  std::vector<uint8_t> row(kRow);
+  for (int64_t i = 0; i < rows; ++i) {
+    if (std::fread(row.data(), 1, kRow, f) != size_t(kRow)) {
+      std::free(imgs);
+      std::free(labels);
+      std::fclose(f);
+      return nullptr;
+    }
+    labels[i] = row[0];
+    std::memcpy(imgs + i * 3072, row.data() + 1, 3072);
+  }
+  std::fclose(f);
+  *n = rows;
+  *labels_out = labels;
+  return imgs;
+}
+
+// ---------------------------------------------------------------------
+// Netpbm (P5/P6 binary) image decoding + class-per-subdirectory reader
+// — the native form of the reference's LFW image-tree ingestion
+// (datasets/fetchers/LFWDataFetcher.java walks person subdirectories;
+// util/ImageLoader.java decodes). JPEG stays Python-side (PIL); the
+// native path owns the uncompressed netpbm formats.
+// ---------------------------------------------------------------------
+
+namespace {
+
+// Reads one token, skipping whitespace and '#' comment lines.
+bool pnm_token(FILE* f, char* tok, size_t cap) {
+  int ch;
+  do {
+    ch = std::fgetc(f);
+    if (ch == '#') {
+      while (ch != '\n' && ch != EOF) ch = std::fgetc(f);
+    }
+  } while (ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r');
+  if (ch == EOF) return false;
+  size_t i = 0;
+  while (ch != EOF && !std::isspace(ch)) {
+    if (i + 1 < cap) tok[i++] = char(ch);
+    ch = std::fgetc(f);
+  }
+  tok[i] = 0;
+  return i > 0;
+}
+
+// Parses a P5/P6 header. maxval must be exactly 255 — the only value
+// u8 pixels can carry without rescaling (sub-255 maxvals are legal
+// netpbm but would silently decode ~maxval/255 darker than PIL; reject
+// so the caller falls back to PIL, which rescales correctly). On
+// success the stream is positioned at the first pixel byte.
+bool pnm_header(FILE* f, int32_t* c, int64_t* h, int64_t* w) {
+  char tok[32];
+  if (!pnm_token(f, tok, sizeof tok) ||
+      (std::strcmp(tok, "P5") != 0 && std::strcmp(tok, "P6") != 0))
+    return false;
+  int channels = tok[1] == '6' ? 3 : 1;
+  long vals[3];  // width, height, maxval
+  for (int i = 0; i < 3; ++i) {
+    if (!pnm_token(f, tok, sizeof tok)) return false;
+    vals[i] = std::strtol(tok, nullptr, 10);
+  }
+  if (vals[0] <= 0 || vals[1] <= 0 || vals[2] != 255 ||
+      vals[0] > 1 << 20 || vals[1] > 1 << 20)
+    return false;
+  *c = channels;
+  *h = vals[1];
+  *w = vals[0];
+  return true;
+}
+
+// Decodes one image's pixels straight into dst (CHW), verifying the
+// header matches (C, H, W). One image-sized HWC staging buffer only.
+bool pnm_decode_into(const char* path, int32_t C, int64_t H, int64_t W,
+                     uint8_t* dst) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  int32_t ic;
+  int64_t ih, iw;
+  if (!pnm_header(f, &ic, &ih, &iw) || ic != C || ih != H || iw != W) {
+    std::fclose(f);
+    return false;
+  }
+  int64_t npx = W * H;
+  std::vector<uint8_t> hwc(size_t(npx) * C);
+  bool ok = std::fread(hwc.data(), 1, hwc.size(), f) == hwc.size();
+  std::fclose(f);
+  if (!ok) return false;
+  for (int32_t ch = 0; ch < C; ++ch)
+    for (int64_t p = 0; p < npx; ++p)
+      dst[ch * npx + p] = hwc[p * C + ch];
+  return true;
+}
+
+// Case-insensitive (".JPG" must count as an image when deciding
+// whether a tree is mixed-format).
+bool has_suffix(const std::string& s, const char* suf) {
+  size_t n = std::strlen(suf);
+  if (s.size() < n) return false;
+  const char* tail = s.c_str() + s.size() - n;
+  for (size_t i = 0; i < n; ++i)
+    if (std::tolower((unsigned char)tail[i]) !=
+        std::tolower((unsigned char)suf[i]))
+      return false;
+  return true;
+}
+
+bool is_netpbm_name(const std::string& fn) {
+  return has_suffix(fn, ".ppm") || has_suffix(fn, ".pgm") ||
+         has_suffix(fn, ".pnm");
+}
+
+bool is_other_image_name(const std::string& fn) {
+  return has_suffix(fn, ".jpg") || has_suffix(fn, ".jpeg") ||
+         has_suffix(fn, ".png") || has_suffix(fn, ".bmp") ||
+         has_suffix(fn, ".gif") || has_suffix(fn, ".tif") ||
+         has_suffix(fn, ".tiff");
+}
+
+}  // namespace
+
+// Reads a class-per-subdirectory tree of binary netpbm images (the
+// unpacked-LFW layout: root/<person>/<img>.ppm). Subdirectories in
+// byte-order (matching Python sorted()) become labels 0..K-1; images
+// within a class are read in sorted order too. All images must share
+// (C, H, W). Returns malloc'd u8 [N, C, H, W]; fills n/c/h/w;
+// *labels_out is malloc'd u8[N]. NULL on error, no images, or a MIXED
+// tree (any .jpg/.png/... present): a partial native read would
+// silently drop the non-netpbm photos, so the whole tree is deferred
+// to the Python/PIL reader instead. Two-pass: file list first, one
+// exact-size allocation, then decode in place (peak native memory =
+// the output + one image).
+
+void* dl4j_read_image_dir(const char* root, int64_t* n, int32_t* c,
+                          int32_t* h, int32_t* w, uint8_t** labels_out) {
+  DIR* d = opendir(root);
+  if (!d) return nullptr;
+  std::vector<std::string> classes;
+  for (struct dirent* e = readdir(d); e; e = readdir(d)) {
+    if (e->d_name[0] == '.') continue;
+    std::string sub = std::string(root) + "/" + e->d_name;
+    struct stat st;
+    if (stat(sub.c_str(), &st) == 0 && S_ISDIR(st.st_mode))
+      classes.push_back(e->d_name);
+  }
+  closedir(d);
+  if (classes.empty() || classes.size() > 255) return nullptr;
+  std::sort(classes.begin(), classes.end());
+
+  // Pass 1: enumerate (path, label) pairs; refuse mixed-format trees.
+  std::vector<std::string> paths;
+  std::vector<uint8_t> labels;
+  for (size_t li = 0; li < classes.size(); ++li) {
+    std::string sub = std::string(root) + "/" + classes[li];
+    DIR* cd = opendir(sub.c_str());
+    if (!cd) return nullptr;
+    std::vector<std::string> files;
+    bool mixed = false;
+    for (struct dirent* e = readdir(cd); e; e = readdir(cd)) {
+      std::string fn = e->d_name;
+      if (is_netpbm_name(fn))
+        files.push_back(fn);
+      else if (is_other_image_name(fn))
+        mixed = true;
+    }
+    closedir(cd);
+    if (mixed) return nullptr;
+    std::sort(files.begin(), files.end());
+    for (const std::string& fn : files) {
+      paths.push_back(sub + "/" + fn);
+      labels.push_back(uint8_t(li));
+    }
+  }
+  if (paths.empty()) return nullptr;
+
+  // Shared dims from the first header.
+  int32_t C;
+  int64_t H, W;
+  {
+    FILE* f = std::fopen(paths[0].c_str(), "rb");
+    if (!f) return nullptr;
+    bool ok = pnm_header(f, &C, &H, &W);
+    std::fclose(f);
+    if (!ok) return nullptr;
+  }
+  int64_t per = int64_t(C) * H * W;
+  uint8_t* out = (uint8_t*)std::malloc(size_t(paths.size()) * per);
+  uint8_t* lab = (uint8_t*)std::malloc(labels.size());
+  if (!out || !lab) {
+    std::free(out);
+    std::free(lab);
+    return nullptr;
+  }
+
+  // Pass 2: decode each image straight into its output slot (shape
+  // mismatches fail here -> caller must pre-normalize sizes).
+  for (size_t i = 0; i < paths.size(); ++i) {
+    if (!pnm_decode_into(paths[i].c_str(), C, H, W, out + i * per)) {
+      std::free(out);
+      std::free(lab);
+      return nullptr;
+    }
+  }
+  std::memcpy(lab, labels.data(), labels.size());
+  *n = int64_t(labels.size());
+  *c = C;
+  *h = int32_t(H);
+  *w = int32_t(W);
+  *labels_out = lab;
+  return out;
 }
 
 // ---------------------------------------------------------------------
@@ -444,6 +693,6 @@ int64_t dl4j_tokenize(void* handle, const char* buf, int64_t len,
   return -1;
 }
 
-int32_t dl4j_native_abi_version() { return 3; }
+int32_t dl4j_native_abi_version() { return 4; }
 
 }  // extern "C"

@@ -1,0 +1,52 @@
+"""Regenerate the real-pixels image fixtures (deterministic).
+
+- ``real_patches_batch.bin``: a CIFAR-10-binary-format file (rows of
+  [label u8][3072 channel-major pixels u8]) whose pixels are 32x32
+  patches cut from sklearn's two bundled REAL photographs
+  (load_sample_images: china.jpg, flower.jpg). Labels are the source
+  photograph (0=china, 1=flower) — a genuine 2-class real-image task
+  on a zero-egress machine, in the exact on-disk format the reference's
+  CifarDataSetIterator consumes (the reference downloads
+  cifar-10-binary.tar.gz; we cannot).
+
+Run: python scripts/make_image_fixtures.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "deeplearning4j_tpu", "datasets", "fixtures")
+PER_CLASS = 100
+
+
+def main():
+    from sklearn.datasets import load_sample_images
+
+    images = load_sample_images().images  # [427, 640, 3] u8 each
+    rng = np.random.default_rng(42)
+    rows = []
+    for label, img in enumerate(images):
+        h, w, _ = img.shape
+        ys = rng.integers(0, h - 32, PER_CLASS)
+        xs = rng.integers(0, w - 32, PER_CLASS)
+        for y, x in zip(ys, xs):
+            patch = img[y:y + 32, x:x + 32]  # HWC u8
+            chw = np.ascontiguousarray(
+                patch.transpose(2, 0, 1), np.uint8)  # CIFAR channel-major
+            rows.append(np.concatenate(
+                [np.array([label], np.uint8), chw.ravel()]))
+    order = rng.permutation(len(rows))
+    out = np.concatenate([rows[i] for i in order])
+    path = os.path.join(FIXTURES, "real_patches_batch.bin")
+    out.tofile(path)
+    print(f"wrote {path}: {len(rows)} rows, {out.nbytes} bytes")
+
+
+if __name__ == "__main__":
+    main()
