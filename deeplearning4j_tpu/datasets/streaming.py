@@ -1,0 +1,170 @@
+"""Disk-streaming DataSetIterators: batches read from on-disk binaries
+at next() time, never materializing the dataset in memory.
+
+The reference's L3 design feeds ``fit()`` from iterators backed by
+files (datasets/iterator/impl/*DataSetIterator.java pulling from
+fetchers/Canova readers), with AsyncDataSetIterator overlapping the
+reads with training. These iterators are the disk half of that story on
+the TPU build — wrap them in
+``native_rt.NativeAsyncDataSetIterator`` (C++ prefetch ring) and feed
+``MultiLayerNetwork.fit_stream`` for the full host-fed pipeline:
+
+    disk -> producer thread -> C++ ring -> window stack -> one H2D
+    -> fused fit_scan dispatch
+
+Formats:
+- CIFAR-10 binary batches (rows of [label u8][3072 px u8]) — the same
+  files ``fetchers.load_cifar`` loads whole; here streamed by row range.
+- Token-sequence files: ``DL4JTOK1`` header + u8/u16 token-id rows
+  [n_seq, seq_len + 1] — the LM wire format (ids on disk and on the
+  wire; one-hot only on device).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+_TOK_MAGIC = b"DL4JTOK1"
+
+
+class CifarBinStreamIterator(DataSetIterator):
+    """Stream [label u8][3072 px u8] rows from CIFAR-binary files.
+
+    Yields DataSet(features u8 [B, 3, 32, 32], labels one-hot f32
+    [B, num_classes]); features stay u8 (the wire-minimal form —
+    normalize on device, e.g. via ``fit_stream``'s ingest hook).
+    Batches never span files (the on-disk batches are independent
+    shards, like the reference's data_batch_1..5)."""
+
+    def __init__(self, paths: Sequence[str], batch_size: int,
+                 num_classes: int = 10):
+        super().__init__(batch_size)
+        self.paths: List[str] = list(paths)
+        self.num_classes = num_classes
+        self._rows_per_file = []
+        for p in self.paths:
+            size = os.path.getsize(p)
+            if size == 0 or size % 3073:
+                raise ValueError(
+                    f"{p}: not a CIFAR-10 binary batch file")
+            self._rows_per_file.append(size // 3073)
+        self._file_idx = 0
+        self._row = 0
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        n = num or self.batch
+        while self._file_idx < len(self.paths):
+            avail = self._rows_per_file[self._file_idx] - self._row
+            if avail > 0:
+                take = min(n, avail)
+                mm = np.memmap(self.paths[self._file_idx],
+                               dtype=np.uint8, mode="r")
+                lo, hi = self._row * 3073, (self._row + take) * 3073
+                rows = np.asarray(mm[lo:hi]).reshape(take, 3073)
+                del mm
+                self._row += take
+                feats = rows[:, 1:].reshape(take, 3, 32, 32)
+                labels = np.zeros((take, self.num_classes), np.float32)
+                labels[np.arange(take), rows[:, 0]] = 1.0
+                return self._post(DataSet(feats, labels))
+            self._file_idx += 1
+            self._row = 0
+        return None
+
+    def reset(self) -> None:
+        self._file_idx = 0
+        self._row = 0
+
+    def total_examples(self) -> int:
+        return int(sum(self._rows_per_file))
+
+    def input_columns(self) -> int:
+        return 3 * 32 * 32
+
+    def total_outcomes(self) -> int:
+        return self.num_classes
+
+    def state_dict(self) -> dict:
+        return {"file_idx": self._file_idx, "row": self._row}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._file_idx = int(state["file_idx"])
+        self._row = int(state["row"])
+
+
+def write_token_file(path: str, tokens: np.ndarray, vocab: int) -> None:
+    """Write [n_seq, row_len] token ids as a DL4JTOK1 binary (u8 rows
+    for vocab <= 256, u16 otherwise)."""
+    tokens = np.asarray(tokens)
+    if tokens.ndim != 2:
+        raise ValueError("tokens must be [n_seq, row_len]")
+    if tokens.min() < 0 or tokens.max() >= vocab:
+        raise ValueError(f"token ids outside [0, {vocab})")
+    dtype = np.uint8 if vocab <= 256 else np.uint16
+    with open(path, "wb") as f:
+        f.write(_TOK_MAGIC)
+        f.write(struct.pack("<IIII", tokens.shape[0], tokens.shape[1],
+                            vocab, dtype().itemsize))
+        f.write(np.ascontiguousarray(tokens, dtype).tobytes())
+
+
+def read_token_file_header(path: str) -> Tuple[int, int, int, int]:
+    """-> (n_seq, row_len, vocab, itemsize)."""
+    with open(path, "rb") as f:
+        if f.read(8) != _TOK_MAGIC:
+            raise ValueError(f"{path}: not a DL4JTOK1 token file")
+        return struct.unpack("<IIII", f.read(16))
+
+
+class TokenSequenceFileIterator(DataSetIterator):
+    """Stream next-token LM batches from a DL4JTOK1 file.
+
+    Each row of [n_seq, T + 1] ids becomes (features = ids[:-1],
+    labels = ids[1:]), both [B, T] integer arrays — the minimal wire
+    form. One-hot/embedding happens on device (``fit_stream``'s
+    ingest/ingest_labels hooks)."""
+
+    def __init__(self, path: str, batch_size: int):
+        super().__init__(batch_size)
+        self.path = path
+        (self.n_seq, self.row_len, self.vocab,
+         self._itemsize) = read_token_file_header(path)
+        self._dtype = np.uint8 if self._itemsize == 1 else np.uint16
+        self._cursor = 0
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        n = num or self.batch
+        if self._cursor >= self.n_seq:
+            return None
+        take = min(n, self.n_seq - self._cursor)
+        offset = 24 + self._cursor * self.row_len * self._itemsize
+        rows = np.fromfile(self.path, dtype=self._dtype,
+                           count=take * self.row_len, offset=offset
+                           ).reshape(take, self.row_len)
+        self._cursor += take
+        return self._post(DataSet(rows[:, :-1], rows[:, 1:]))
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def total_examples(self) -> int:
+        return self.n_seq
+
+    def input_columns(self) -> int:
+        return self.row_len - 1
+
+    def total_outcomes(self) -> int:
+        return self.vocab
+
+    def state_dict(self) -> dict:
+        return {"cursor": self._cursor}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._cursor = int(state["cursor"])

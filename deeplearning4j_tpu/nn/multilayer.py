@@ -418,6 +418,101 @@ class MultiLayerNetwork:
         fire_crossed(self.listeners, self, start, self.iteration)
         return scores
 
+    def fit_stream(self, iterator, scan_steps: int = 16,
+                   ingest=None, ingest_labels=None,
+                   sync_each_window: bool = False):
+        """Host-fed training: consume a DataSetIterator (typically an
+        async prefetcher over on-disk binaries — the reference's
+        AsyncDataSetIterator role, datasets/iterator/
+        AsyncDataSetIterator.java:1) while keeping the chip busy.
+
+        ``scan_steps`` consecutive batches are stacked host-side,
+        shipped in ONE transfer, and trained in ONE fused ``fit_scan``
+        dispatch — so disk reads, host stacking, and the next window's
+        H2D ride under the previous window's device compute instead of
+        costing a per-batch host round-trip. ``ingest`` /
+        ``ingest_labels`` are optional jitted device-side transforms on
+        the stacked [K, B, ...] feature/label windows (e.g. u8 pixels →
+        normalized compute dtype, token ids → one-hot), keeping the
+        wire format minimal. ``sync_each_window`` fetches each window's
+        last score before uploading the next — on transports where H2D
+        cannot overlap compute (BENCHMARKS.md "host-fed" notes), a
+        serialized upload is faster than a degraded concurrent one for
+        byte-heavy windows.
+
+        A ragged tail (iterator exhausts mid-window, or a final batch
+        smaller than the rest) falls back to per-batch ``fit``. Returns
+        the last window's score array."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        scores = None
+
+        def flush(window, fused):
+            nonlocal scores
+            def stack_masks(attr):
+                ms = [getattr(b, attr) for b in window]
+                if all(m is None for m in ms):
+                    return None
+                if any(m is None for m in ms):
+                    raise ValueError(
+                        f"fit_stream window mixes batches with and "
+                        f"without {attr}")
+                return np.stack([np.asarray(m) for m in ms])
+
+            if fused:
+                feats = jax.device_put(
+                    np.stack([np.asarray(b.features) for b in window]))
+                labels = jax.device_put(
+                    np.stack([np.asarray(b.labels) for b in window]))
+                fms = stack_masks("features_mask")
+                lms = stack_masks("labels_mask")
+                if sync_each_window:
+                    # Materialize the upload BEFORE dispatching compute:
+                    # on transports where transfers degrade while a
+                    # computation is in flight, dispatching fit_scan
+                    # first would make the scan stall on a crawling
+                    # transfer of its own input.
+                    feats.block_until_ready()
+                    labels.block_until_ready()
+                if ingest is not None:
+                    feats = ingest(feats)
+                if ingest_labels is not None:
+                    labels = ingest_labels(labels)
+                scores = self.fit_scan(
+                    feats, labels, features_mask_stacked=fms,
+                    labels_mask_stacked=lms)
+                if sync_each_window:
+                    np.asarray(scores[-1])
+                return
+            for b in window:  # ragged: correctness over throughput
+                f = jnp.asarray(np.asarray(b.features)[None])
+                y = jnp.asarray(np.asarray(b.labels)[None])
+                if ingest is not None:
+                    f = ingest(f)
+                if ingest_labels is not None:
+                    y = ingest_labels(y)
+                self._fit_batch(DataSet(
+                    f[0], y[0], b.features_mask, b.labels_mask))
+            scores = jnp.asarray([self.score_value])
+
+        window = []
+        while True:
+            ds = iterator.next()
+            if ds is None:
+                if window:  # exhausted mid-window: always ragged here
+                    flush(window, fused=False)
+                break
+            if window and (np.shape(ds.features)
+                           != np.shape(window[0].features)):
+                # smaller tail batch can't stack with the window
+                flush(window, fused=False)
+                window = []
+            window.append(ds)
+            if len(window) == scan_steps:
+                flush(window, fused=True)
+                window = []
+        return scores
+
     @functools.cached_property
     def _grad_and_score(self):
         def gs(params, state, rng, features, labels, feature_mask, label_mask):
