@@ -1,18 +1,27 @@
-"""In-memory inverted index for the text pipeline.
+"""Inverted indexes for the text pipeline.
 
 Capability mirror of reference text/invertedindex/LuceneInvertedIndex
 (SURVEY.md §2.8): word → document postings over tokenized docs, document
 retrieval, mini-batch sampling for embedding training, and TF-IDF
-scoring — without the Lucene dependency (host-side dict/array store; the
-tensor work stays in XLA).
+scoring. Two stores behind one API:
+
+- ``InvertedIndex`` — in-memory dict/array store (the fast default for
+  corpora that fit in RAM).
+- ``DiskInvertedIndex`` — sqlite-backed store that persists across
+  process restarts and scales past RAM, the role Lucene's disk segments
+  play for the reference (LuceneInvertedIndex.java:1: index directory
+  on disk, reopened between runs). The tensor work stays in XLA either
+  way.
 """
 
 from __future__ import annotations
 
 import math
+import os
+import sqlite3
 import threading
-from collections import defaultdict
-from typing import Dict, List, Optional, Sequence, Tuple
+from collections import Counter, defaultdict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -99,3 +108,202 @@ class InvertedIndex:
     def all_documents(self) -> List[List[str]]:
         with self._lock:
             return [list(d) for d in self._docs]
+
+
+class DiskInvertedIndex:
+    """Sqlite-backed inverted index: same surface as ``InvertedIndex``
+    but persistent (reopen the same path to resume) and bounded by
+    disk, not RAM — the reference's Lucene directory role.
+
+    Postings carry term frequencies so TF-IDF never re-tokenizes the
+    document; searches aggregate in SQL. Tokens must not contain the
+    space character (true post-tokenization); they are stored
+    space-joined."""
+
+    _SCHEMA = """
+    CREATE TABLE IF NOT EXISTS docs(
+        id INTEGER PRIMARY KEY, n_tokens INTEGER NOT NULL,
+        tokens TEXT NOT NULL, label TEXT);
+    CREATE TABLE IF NOT EXISTS postings(
+        word TEXT NOT NULL, doc_id INTEGER NOT NULL,
+        tf INTEGER NOT NULL);
+    CREATE INDEX IF NOT EXISTS postings_word ON postings(word);
+    """
+
+    def __init__(self, path: str) -> None:
+        self._lock = threading.RLock()
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.executescript(self._SCHEMA)
+        self._conn.commit()
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            self._conn.commit()
+            self._conn.close()
+
+    def __enter__(self) -> "DiskInvertedIndex":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- building -------------------------------------------------------
+    def add_doc(self, tokens: Sequence[str],
+                label: Optional[str] = None) -> int:
+        with self._lock:
+            return self._insert(tokens, label, commit=True)
+
+    def add_docs(self, docs: Iterable[Sequence[str]],
+                 labels: Optional[Iterable[Optional[str]]] = None
+                 ) -> int:
+        """Bulk ingestion: one transaction for the whole stream (the
+        fast path for corpus-scale builds). Returns docs added."""
+        labels = iter(labels) if labels is not None else None
+        n = 0
+        with self._lock:
+            try:
+                for toks in docs:
+                    self._insert(
+                        toks,
+                        next(labels) if labels is not None else None,
+                        commit=False)
+                    n += 1
+            except BaseException:
+                # all-or-nothing: a later unrelated commit must not
+                # persist a half-ingested corpus
+                self._conn.rollback()
+                raise
+            self._conn.commit()
+        return n
+
+    def _insert(self, tokens, label, commit) -> int:
+        toks = list(tokens)
+        for t in toks:
+            if " " in t:
+                raise ValueError(
+                    f"token {t!r} contains a space; tokenize first")
+        cur = self._conn.execute(
+            "INSERT INTO docs(n_tokens, tokens, label) VALUES (?,?,?)",
+            (len(toks), " ".join(toks), label))
+        doc_id = cur.lastrowid - 1  # 0-based ids like InvertedIndex
+        self._conn.executemany(
+            "INSERT INTO postings(word, doc_id, tf) VALUES (?,?,?)",
+            [(w, doc_id, tf) for w, tf in Counter(toks).items()])
+        if commit:
+            self._conn.commit()
+        return doc_id
+
+    # -- retrieval ------------------------------------------------------
+    def num_documents(self) -> int:
+        with self._lock:
+            return self._conn.execute(
+                "SELECT COUNT(*) FROM docs").fetchone()[0]
+
+    def _doc_row(self, doc_id: int):
+        row = self._conn.execute(
+            "SELECT tokens, label FROM docs WHERE id=?",
+            (doc_id + 1,)).fetchone()
+        if row is None:
+            raise IndexError(f"no document {doc_id}")
+        return row
+
+    def document(self, doc_id: int) -> List[str]:
+        with self._lock:
+            toks = self._doc_row(doc_id)[0]
+            return toks.split(" ") if toks else []
+
+    def label(self, doc_id: int) -> Optional[str]:
+        with self._lock:
+            return self._doc_row(doc_id)[1]
+
+    def documents_containing(self, word: str) -> List[int]:
+        with self._lock:
+            return [r[0] for r in self._conn.execute(
+                "SELECT doc_id FROM postings WHERE word=? "
+                "ORDER BY doc_id", (word,))]
+
+    def document_frequency(self, word: str) -> int:
+        with self._lock:
+            return self._conn.execute(
+                "SELECT COUNT(*) FROM postings WHERE word=?",
+                (word,)).fetchone()[0]
+
+    def vocab(self) -> List[str]:
+        with self._lock:
+            return [r[0] for r in self._conn.execute(
+                "SELECT DISTINCT word FROM postings ORDER BY word")]
+
+    # -- scoring --------------------------------------------------------
+    def tfidf(self, word: str, doc_id: int) -> float:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT p.tf, d.n_tokens FROM postings p "
+                "JOIN docs d ON d.id = p.doc_id + 1 "
+                "WHERE p.word=? AND p.doc_id=?",
+                (word, doc_id)).fetchone()
+            if row is None or row[1] == 0:
+                return 0.0
+            df = self.document_frequency(word)
+            if df == 0:
+                return 0.0
+            return (row[0] / row[1]) * math.log(
+                self.num_documents() / df)
+
+    def search(self, query: Sequence[str], top_k: int = 10
+               ) -> List[Tuple[int, float]]:
+        """Rank documents by summed TF-IDF over query terms — one SQL
+        aggregation instead of a Python loop over postings."""
+        terms = list(query)
+        if not terms:
+            return []
+        with self._lock:
+            n = max(1, self.num_documents())
+            marks = ",".join("?" for _ in terms)
+            dfs = dict(self._conn.execute(
+                f"SELECT word, COUNT(*) FROM postings "
+                f"WHERE word IN ({marks}) GROUP BY word", terms))
+            scores: Dict[int, float] = defaultdict(float)
+            for word, doc_id, tf, n_tokens in self._conn.execute(
+                    f"SELECT p.word, p.doc_id, p.tf, d.n_tokens "
+                    f"FROM postings p JOIN docs d ON d.id = p.doc_id+1 "
+                    f"WHERE p.word IN ({marks})", terms):
+                if n_tokens:
+                    scores[doc_id] += (tf / n_tokens) * math.log(
+                        n / dfs[word])
+            ranked = sorted(scores.items(),
+                            key=lambda kv: (-kv[1], kv[0]))
+            return ranked[:top_k]
+
+    # -- training support ----------------------------------------------
+    def sample_batch(self, batch_size: int,
+                     rng: Optional[np.random.Generator] = None
+                     ) -> List[List[str]]:
+        rng = rng or np.random.default_rng()
+        n = self.num_documents()
+        if n == 0:
+            return []
+        idx = rng.integers(0, n, size=min(batch_size, n))
+        return [self.document(int(i)) for i in idx]
+
+    def iter_documents(self, batch_rows: int = 4096
+                       ) -> Iterable[List[str]]:
+        """Stream every document without materializing the corpus —
+        the RAM-bounded path all_documents() cannot offer."""
+        n = self.num_documents()
+        for lo in range(0, n, batch_rows):
+            with self._lock:
+                rows = self._conn.execute(
+                    "SELECT tokens FROM docs WHERE id > ? "
+                    "ORDER BY id LIMIT ?", (lo, batch_rows)).fetchall()
+            for (toks,) in rows:
+                yield toks.split(" ") if toks else []
+
+    def all_documents(self) -> List[List[str]]:
+        return list(self.iter_documents())
+
+    def size_bytes(self) -> int:
+        with self._lock:
+            self._conn.commit()
+        return os.path.getsize(self.path)

@@ -34,14 +34,37 @@ class HistogramIterationListener(IterationListener):
                           histogram(np.asarray(p), bins=self.bins))
 
 
-class FlowIterationListener(IterationListener):
-    """Model-structure snapshot: layer names, shapes, param counts."""
+def _act_stats(act) -> dict:
+    a = np.asarray(act)
+    return {"activation_mean": round(float(np.mean(np.abs(a))), 6),
+            "activation_std": round(float(np.std(a)), 6)}
 
-    def __init__(self, sink: Any, frequency: int = 1):
+
+class FlowIterationListener(IterationListener):
+    """Model-structure snapshot: MultiLayerNetwork chains render as the
+    linear flow view; ComputationGraphs ship their conf DAG (vertices +
+    input edges in topological order) so the dashboard draws the graph
+    the reference's flow view draws (flow/FlowIterationListener.java:1).
+    With ``probe_features`` set, every layer/vertex also carries
+    activation mean/std on that probe batch (the per-vertex ModelInfo
+    stats)."""
+
+    def __init__(self, sink: Any, frequency: int = 1,
+                 probe_features=None):
         self.sink = sink
         self.invoked_every = frequency
+        self.probe = probe_features
 
     def iteration_done(self, model, iteration: int) -> None:
+        if hasattr(model.conf, "vertices"):
+            self._graph_flow(model, iteration)
+        else:
+            self._chain_flow(model, iteration)
+
+    def _chain_flow(self, model, iteration: int) -> None:
+        acts = None
+        if self.probe is not None:
+            acts = model.feed_forward(self.probe, train=False)
         layers = []
         for i, conf in enumerate(model.conf.confs):
             bean = conf.layer
@@ -52,7 +75,7 @@ class FlowIterationListener(IterationListener):
             }
             n_par = int(sum(int(np.prod(s)) for s in shapes.values()))
             pp = model.conf.preprocessor_for(i)
-            layers.append({
+            entry = {
                 "index": i,
                 "type": type(bean).__name__,
                 "n_in": getattr(bean, "n_in", None),
@@ -65,11 +88,60 @@ class FlowIterationListener(IterationListener):
                 "param_shapes": shapes,
                 "preprocessor": type(pp).__name__ if pp else None,
                 "updater": str(conf.resolved("updater") or ""),
-            })
+            }
+            if acts is not None and i + 1 < len(acts):
+                entry.update(_act_stats(acts[i + 1]))  # acts[0] = input
+            layers.append(entry)
         n_params = int(sum(np.asarray(p).size
                            for p in model.param_table().values()))
         self.sink.put("flow", iteration,
                       {"layers": layers, "num_params": n_params})
+
+    def _graph_flow(self, model, iteration: int) -> None:
+        conf = model.conf
+        acts = None
+        if self.probe is not None:
+            probe = self.probe
+            if not isinstance(probe, dict):
+                probe = (probe,)
+                acts = model.feed_forward(*probe)
+            else:
+                acts = model.feed_forward(
+                    *[probe[k] for k in conf.network_inputs])
+        vertices = []
+        for name in conf.topological_order():
+            bean = conf.vertices[name]
+            shapes = {
+                pname: list(np.asarray(p).shape)
+                for pname, p in model.params.get(name, {}).items()
+            }
+            layer_conf = getattr(bean, "conf", None)
+            layer_bean = layer_conf.layer if layer_conf else None
+            entry = {
+                "name": name,
+                "type": (type(layer_bean).__name__ if layer_bean
+                         else type(bean).__name__),
+                "inputs": list(conf.vertex_inputs.get(name, [])),
+                "n_in": getattr(layer_bean, "n_in", None),
+                "n_out": getattr(layer_bean, "n_out", None),
+                "activation": getattr(layer_bean, "activation", None),
+                "n_params": int(sum(int(np.prod(s))
+                                    for s in shapes.values())),
+                "param_shapes": shapes,
+            }
+            if acts is not None and name in acts:
+                entry.update(_act_stats(acts[name]))
+            vertices.append(entry)
+        n_params = int(sum(
+            np.asarray(p).size
+            for group in model.params.values()
+            for p in group.values()))
+        self.sink.put("flow", iteration, {
+            "vertices": vertices,
+            "inputs": list(conf.network_inputs),
+            "outputs": list(conf.network_outputs),
+            "num_params": n_params,
+        })
 
 
 class ActivationIterationListener(IterationListener):

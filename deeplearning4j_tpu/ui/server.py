@@ -203,8 +203,151 @@ function flow(ctx, v, W, H, cv){
       return null;
     };
     const redraw = () => {
+      // dispatch on the LAST payload's shape: the same 'flow' key can
+      // switch between chain and DAG payloads across runs
       ctx.clearRect(0, 0, cv.width, cv.height);
-      flow(ctx, cv._flowLast, cv.width, cv.height, cv);
+      const f = (cv._flowLast && cv._flowLast.vertices) ? dagflow : flow;
+      f(ctx, cv._flowLast, cv.width, cv.height, cv);
+    };
+    cv.addEventListener('mousemove', ev => {
+      const i = hit(ev);
+      if (i !== cv._flowHover){ cv._flowHover = i; redraw(); }
+    });
+    cv.addEventListener('click', ev => {
+      const i = hit(ev);
+      cv._flowPin = (cv._flowPin === i) ? null : i;
+      redraw();
+    });
+    cv.addEventListener('mouseleave', () => {
+      if (cv._flowHover != null){ cv._flowHover = null; redraw(); }
+    });
+  }
+}
+function dagDepths(v){
+  // longest path from the network inputs -> column per vertex; also
+  // the widest column (for canvas sizing). Shared by dagflow() and
+  // render() so layout and height cannot diverge.
+  const depth = {}, count = {};
+  (v.inputs||[]).forEach(n => depth[n] = 0);
+  count[0] = (v.inputs||[]).length;
+  v.vertices.forEach(vert => {
+    let d = 1;
+    vert.inputs.forEach(inp => {
+      const di = (depth[inp] == null ? 0 : depth[inp]) + 1;
+      if (di > d) d = di;
+    });
+    depth[vert.name] = d;
+    count[d] = (count[d]||0)+1;
+  });
+  let maxCol = 1;
+  for (const k in count) if (count[k] > maxCol) maxCol = count[k];
+  return {depth: depth, maxCol: maxCol};
+}
+function dagflow(ctx, v, W, H, cv){
+  // ComputationGraph conf DAG: vertices in topological columns
+  // (longest path from the network inputs), edges drawn between
+  // boxes, hover/click detail like the chain flow view (the
+  // reference's graph flow render, flow/FlowIterationListener.java)
+  const depth = dagDepths(v).depth;
+  const nodes = v.inputs.map(n => ({name:n, type:'INPUT', inputs:[]}))
+                 .concat(v.vertices);
+  const cols = {};
+  let ncols = 1;
+  nodes.forEach(n => {
+    const d = depth[n.name] || 0;
+    (cols[d] = cols[d] || []).push(n);
+    if (d+1 > ncols) ncols = d+1;
+  });
+  const bw = Math.min(104, Math.floor((W-30)/ncols)-12), bh = 40;
+  const pos = {}, boxes = [];
+  const hov = cv._flowHover, pin = cv._flowPin;
+  Object.keys(cols).map(Number).sort((a,b)=>a-b).forEach(d => {
+    cols[d].forEach((n, r) => {
+      const rowH = Math.max(bh+10, Math.floor((H-30)/cols[d].length));
+      const x = 15 + d*(bw+14);
+      const y = 10 + r*rowH + Math.max(0, (rowH-bh-10)/2);
+      pos[n.name] = {x:x, y:y};
+    });
+  });
+  ctx.strokeStyle='#999';
+  v.vertices.forEach(vert => {
+    const t = pos[vert.name];
+    vert.inputs.forEach(inp => {
+      const s = pos[inp];
+      if (!s) return;
+      ctx.beginPath();
+      ctx.moveTo(s.x+bw, s.y+bh/2);
+      ctx.bezierCurveTo(s.x+bw+8, s.y+bh/2, t.x-8, t.y+bh/2,
+                        t.x, t.y+bh/2);
+      ctx.stroke();
+      ctx.beginPath(); ctx.moveTo(t.x-5, t.y+bh/2-3);
+      ctx.lineTo(t.x, t.y+bh/2); ctx.lineTo(t.x-5, t.y+bh/2+3);
+      ctx.stroke();
+    });
+  });
+  ctx.font='9px monospace';
+  nodes.forEach((n, i) => {
+    const p = pos[n.name];
+    boxes.push({x:p.x, y:p.y, w:bw, h:bh, layer:n});
+    const hot = (i === hov) || (i === pin);
+    const isOut = v.outputs.indexOf(n.name) >= 0;
+    ctx.fillStyle = hot ? '#cfe3fa'
+                  : (n.type === 'INPUT' ? '#f2f2f2'
+                  : (isOut ? '#e4f3e4' : '#eaf2fc'));
+    ctx.fillRect(p.x, p.y, bw, bh);
+    ctx.strokeStyle = isOut ? '#2d8a2d' : '#0a62c9';
+    ctx.lineWidth = hot ? 2 : 1;
+    ctx.strokeRect(p.x, p.y, bw, bh); ctx.lineWidth = 1;
+    ctx.fillStyle='#222';
+    ctx.fillText(String(n.name).slice(0, 14), p.x+3, p.y+12);
+    ctx.fillText(String(n.type).slice(0, 14), p.x+3, p.y+24);
+    if (n.activation_mean != null)
+      ctx.fillText('|a|='+Number(n.activation_mean).toPrecision(3),
+                   p.x+3, p.y+36);
+  });
+  ctx.fillStyle='#555';
+  ctx.fillText('params: '+v.num_params+
+               '   (hover a vertex; click to pin)', 15, H-6);
+  cv._flowBoxes = boxes;
+  cv._flowLast = v;
+  const detail = () => {
+    const idx = (cv._flowPin != null) ? cv._flowPin : cv._flowHover;
+    const pre = cv.parentElement.querySelector('pre');
+    if (idx == null || !cv._flowBoxes[idx]){
+      pre.style.display='none'; return;
+    }
+    const l = cv._flowBoxes[idx].layer;
+    pre.style.display='block';
+    pre.textContent =
+      l.name+': '+l.type+'\\n'+
+      'inputs: '+JSON.stringify(l.inputs||[])+'\\n'+
+      'in/out: '+l.n_in+' -> '+l.n_out+
+      (l.activation ? '   activation: '+l.activation : '')+'\\n'+
+      'params: '+(l.n_params==null?'?':l.n_params)+
+      '   shapes: '+JSON.stringify(l.param_shapes||{})+'\\n'+
+      (l.activation_mean != null ?
+        'act mean|.|: '+l.activation_mean+'  std: '+l.activation_std
+        : '');
+  };
+  detail();
+  if (!cv._flowWired){
+    cv._flowWired = true;
+    const hit = ev => {
+      const r = cv.getBoundingClientRect();
+      const mx = ev.clientX - r.left, my = ev.clientY - r.top;
+      const bs = cv._flowBoxes || [];
+      for (let i = 0; i < bs.length; i++){
+        const b = bs[i];
+        if (mx>=b.x && mx<=b.x+b.w && my>=b.y && my<=b.y+b.h) return i;
+      }
+      return null;
+    };
+    const redraw = () => {
+      // dispatch on payload shape (see flow(): the key can switch
+      // between chain and DAG payloads)
+      ctx.clearRect(0, 0, cv.width, cv.height);
+      const f = (cv._flowLast && cv._flowLast.vertices) ? dagflow : flow;
+      f(ctx, cv._flowLast, cv.width, cv.height, cv);
     };
     cv.addEventListener('mousemove', ev => {
       const i = hit(ev);
@@ -298,6 +441,11 @@ function render(key, pts){
   if (v && Array.isArray(v.layers)){
     setH(120); ctx.clearRect(0,0,cv.width,cv.height);
     showChart(true); flow(ctx, v, cv.width, cv.height, cv); return;
+  }
+  if (v && Array.isArray(v.vertices)){
+    setH(Math.max(150, 56*dagDepths(v).maxCol + 30));
+    ctx.clearRect(0,0,cv.width,cv.height);
+    showChart(true); dagflow(ctx, v, cv.width, cv.height, cv); return;
   }
   let counts = null;
   if (v && Array.isArray(v.counts)) counts = v.counts;
