@@ -135,6 +135,7 @@ class MultiLayerNetwork:
         self._impls = [get_impl(c.layer) for c in conf.confs]
         self._updaters = [make_layer_updater(c) for c in conf.confs]
         self._rnn_state: Dict[str, Any] = {}
+        self._generate_fns: Dict[int, Any] = {}
         self._initialized = False
         # Bumped by in-place param mutation APIs (set_param) so caches
         # that mirror params (e.g. PipelineTrainer's stage-sharded
@@ -753,6 +754,46 @@ class MultiLayerNetwork:
 
     def rnn_clear_previous_state(self) -> None:
         self._rnn_state = {}
+
+    def generate(self, prompt, n_tokens: int):
+        """Greedy autoregressive generation fused on device: prefill
+        the one-hot prompt [B, V, Tp] through ``rnn_time_step``, then
+        ONE jitted ``lax.scan`` emits ``n_tokens`` ids with the KV
+        cache riding in the scan carry — serving throughput without a
+        host round-trip per token. The per-token equivalent is a
+        ``rnn_time_step`` loop (reference rnnTimeStep streaming,
+        nn/layers/recurrent/BaseRecurrentLayer.java:1); numerics are
+        identical (tests/test_decode_generate.py).
+
+        Requires an LM-shaped net (n_classes == n_in, one-hot io).
+        Returns int32 ids [B, n_tokens]; leaves the rnn state at the
+        post-generation position."""
+        self.init()
+        vocab = self.conf.confs[0].layer.n_in
+        out = self.rnn_time_step(prompt)  # prefill (guards streamable)
+        tok0 = jnp.argmax(out[:, :, -1], axis=1).astype(jnp.int32)
+        if n_tokens == 1:
+            return tok0[:, None]
+        gen = self._generate_fns.get(n_tokens)
+        if gen is None:
+            def gen_fn(params, state, rnn_state, tok0):
+                def body(carry, _):
+                    rnn, tok = carry
+                    x = jax.nn.one_hot(
+                        tok, vocab, dtype=self._dtype)[:, :, None]
+                    o, _, new_rnn = self._forward_fn(
+                        params, state, x, None, False, rnn_state=rnn)
+                    nxt = jnp.argmax(o[:, :, -1], axis=1).astype(
+                        jnp.int32)
+                    return (new_rnn, nxt), nxt
+                (rnn, _), toks = jax.lax.scan(
+                    body, (rnn_state, tok0), None, length=n_tokens - 1)
+                return jnp.swapaxes(toks, 0, 1), rnn
+
+            gen = self._generate_fns[n_tokens] = jax.jit(gen_fn)
+        toks, self._rnn_state = gen(
+            self.params, self.state, self._rnn_state, tok0)
+        return jnp.concatenate([tok0[:, None], toks], axis=1)
 
     # ------------------------------------------------------------------
     # Parameter pack/unpack (reference params() :984-1063)

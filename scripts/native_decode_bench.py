@@ -41,6 +41,12 @@ EXPORT = textwrap.dedent("""
     net = MultiLayerNetwork(transformer_lm(
         n_in=64, width=256, n_layers=4, n_heads=8, n_classes=64,
         seed=7)).init()
+    # serving window matched to the bench_decode row (2048 tokens);
+    # width stays 256: width-1024 bakes ~400 MB of f32 constants into
+    # the exported program, beyond the tunnel's remote-compile path
+    for c in net.conf.confs:
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = 2048
     code, copts, template, _ = export_decode_step_for_native(net)
     d = sys.argv[1]
     open(d + "/dec.vhlo", "wb").write(code)
