@@ -153,7 +153,13 @@ class DiskInvertedIndex:
     def add_doc(self, tokens: Sequence[str],
                 label: Optional[str] = None) -> int:
         with self._lock:
-            return self._insert(tokens, label, commit=True)
+            try:
+                return self._insert(tokens, label, commit=True)
+            except BaseException:
+                # a docs row without its postings must not survive to
+                # be flushed by a later unrelated commit
+                self._conn.rollback()
+                raise
 
     def add_docs(self, docs: Iterable[Sequence[str]],
                  labels: Optional[Iterable[Optional[str]]] = None
@@ -254,8 +260,10 @@ class DiskInvertedIndex:
     def search(self, query: Sequence[str], top_k: int = 10
                ) -> List[Tuple[int, float]]:
         """Rank documents by summed TF-IDF over query terms — one SQL
-        aggregation instead of a Python loop over postings."""
-        terms = list(query)
+        aggregation instead of a Python loop over postings. Repeated
+        query terms weight per OCCURRENCE, matching InvertedIndex."""
+        term_counts = Counter(query)
+        terms = list(term_counts)
         if not terms:
             return []
         with self._lock:
@@ -270,8 +278,9 @@ class DiskInvertedIndex:
                     f"FROM postings p JOIN docs d ON d.id = p.doc_id+1 "
                     f"WHERE p.word IN ({marks})", terms):
                 if n_tokens:
-                    scores[doc_id] += (tf / n_tokens) * math.log(
-                        n / dfs[word])
+                    scores[doc_id] += (
+                        term_counts[word] * (tf / n_tokens)
+                        * math.log(n / dfs[word]))
             ranked = sorted(scores.items(),
                             key=lambda kv: (-kv[1], kv[0]))
             return ranked[:top_k]

@@ -559,7 +559,10 @@ class ComputationGraph:
             self.score_value = score
             self.iteration += 1
             for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+                if listener.invoked_every <= 1 or (
+                    self.iteration % listener.invoked_every == 0
+                ):
+                    listener.iteration_done(self, self.iteration)
 
     # ------------------------------------------------------------------
     # Truncated BPTT (reference ComputationGraph.doTruncatedBPTT :1349):
@@ -595,7 +598,10 @@ class ComputationGraph:
             self.score_value = score
             self.iteration += 1
             for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+                if listener.invoked_every <= 1 or (
+                    self.iteration % listener.invoked_every == 0
+                ):
+                    listener.iteration_done(self, self.iteration)
 
     @functools.cached_property
     def _tbptt_step(self):

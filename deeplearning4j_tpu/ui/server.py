@@ -190,38 +190,41 @@ function flow(ctx, v, W, H, cv){
       (l.updater ? 'updater: '+l.updater : '');
   };
   detail();  // keep a pinned/hovered panel alive across poll redraws
-  if (!cv._flowWired){
-    cv._flowWired = true;
-    const hit = ev => {
-      const r = cv.getBoundingClientRect();
-      const mx = ev.clientX - r.left, my = ev.clientY - r.top;
-      const bs = cv._flowBoxes || [];
-      for (let i = 0; i < bs.length; i++){
-        const b = bs[i];
-        if (mx>=b.x && mx<=b.x+b.w && my>=b.y && my<=b.y+b.h) return i;
-      }
-      return null;
-    };
-    const redraw = () => {
-      // dispatch on the LAST payload's shape: the same 'flow' key can
-      // switch between chain and DAG payloads across runs
-      ctx.clearRect(0, 0, cv.width, cv.height);
-      const f = (cv._flowLast && cv._flowLast.vertices) ? dagflow : flow;
-      f(ctx, cv._flowLast, cv.width, cv.height, cv);
-    };
-    cv.addEventListener('mousemove', ev => {
-      const i = hit(ev);
-      if (i !== cv._flowHover){ cv._flowHover = i; redraw(); }
-    });
-    cv.addEventListener('click', ev => {
-      const i = hit(ev);
-      cv._flowPin = (cv._flowPin === i) ? null : i;
-      redraw();
-    });
-    cv.addEventListener('mouseleave', () => {
-      if (cv._flowHover != null){ cv._flowHover = null; redraw(); }
-    });
-  }
+  wireFlowCanvas(cv, ctx);
+}
+function wireFlowCanvas(cv, ctx){
+  // shared hover/click wiring for both flow renderers; redraw
+  // dispatches on the LAST payload's shape because the same 'flow'
+  // key can switch between chain and DAG payloads across runs
+  if (cv._flowWired) return;
+  cv._flowWired = true;
+  const hit = ev => {
+    const r = cv.getBoundingClientRect();
+    const mx = ev.clientX - r.left, my = ev.clientY - r.top;
+    const bs = cv._flowBoxes || [];
+    for (let i = 0; i < bs.length; i++){
+      const b = bs[i];
+      if (mx>=b.x && mx<=b.x+b.w && my>=b.y && my<=b.y+b.h) return i;
+    }
+    return null;
+  };
+  const redraw = () => {
+    ctx.clearRect(0, 0, cv.width, cv.height);
+    const f = (cv._flowLast && cv._flowLast.vertices) ? dagflow : flow;
+    f(ctx, cv._flowLast, cv.width, cv.height, cv);
+  };
+  cv.addEventListener('mousemove', ev => {
+    const i = hit(ev);
+    if (i !== cv._flowHover){ cv._flowHover = i; redraw(); }
+  });
+  cv.addEventListener('click', ev => {
+    const i = hit(ev);
+    cv._flowPin = (cv._flowPin === i) ? null : i;
+    redraw();
+  });
+  cv.addEventListener('mouseleave', () => {
+    if (cv._flowHover != null){ cv._flowHover = null; redraw(); }
+  });
 }
 function dagDepths(v){
   // longest path from the network inputs -> column per vertex; also
@@ -239,9 +242,12 @@ function dagDepths(v){
     depth[vert.name] = d;
     count[d] = (count[d]||0)+1;
   });
-  let maxCol = 1;
-  for (const k in count) if (count[k] > maxCol) maxCol = count[k];
-  return {depth: depth, maxCol: maxCol};
+  let maxCol = 1, ncols = 1;
+  for (const k in count){
+    if (count[k] > maxCol) maxCol = count[k];
+    if (Number(k)+1 > ncols) ncols = Number(k)+1;
+  }
+  return {depth: depth, maxCol: maxCol, ncols: ncols};
 }
 function dagflow(ctx, v, W, H, cv){
   // ComputationGraph conf DAG: vertices in topological columns
@@ -258,7 +264,10 @@ function dagflow(ctx, v, W, H, cv){
     (cols[d] = cols[d] || []).push(n);
     if (d+1 > ncols) ncols = d+1;
   });
-  const bw = Math.min(104, Math.floor((W-30)/ncols)-12), bh = 40;
+  // deep chains: boxes never shrink below readable width — the
+  // canvas grows instead (render() sizes it from ncols)
+  const bw = Math.max(24, Math.min(104, Math.floor((W-30)/ncols)-12));
+  const bh = 40;
   const pos = {}, boxes = [];
   const hov = cv._flowHover, pin = cv._flowPin;
   Object.keys(cols).map(Number).sort((a,b)=>a-b).forEach(d => {
@@ -330,38 +339,7 @@ function dagflow(ctx, v, W, H, cv){
         : '');
   };
   detail();
-  if (!cv._flowWired){
-    cv._flowWired = true;
-    const hit = ev => {
-      const r = cv.getBoundingClientRect();
-      const mx = ev.clientX - r.left, my = ev.clientY - r.top;
-      const bs = cv._flowBoxes || [];
-      for (let i = 0; i < bs.length; i++){
-        const b = bs[i];
-        if (mx>=b.x && mx<=b.x+b.w && my>=b.y && my<=b.y+b.h) return i;
-      }
-      return null;
-    };
-    const redraw = () => {
-      // dispatch on payload shape (see flow(): the key can switch
-      // between chain and DAG payloads)
-      ctx.clearRect(0, 0, cv.width, cv.height);
-      const f = (cv._flowLast && cv._flowLast.vertices) ? dagflow : flow;
-      f(ctx, cv._flowLast, cv.width, cv.height, cv);
-    };
-    cv.addEventListener('mousemove', ev => {
-      const i = hit(ev);
-      if (i !== cv._flowHover){ cv._flowHover = i; redraw(); }
-    });
-    cv.addEventListener('click', ev => {
-      const i = hit(ev);
-      cv._flowPin = (cv._flowPin === i) ? null : i;
-      redraw();
-    });
-    cv.addEventListener('mouseleave', () => {
-      if (cv._flowHover != null){ cv._flowHover = null; redraw(); }
-    });
-  }
+  wireFlowCanvas(cv, ctx);
 }
 function wireScrub(el, cv, pts, draw){
   // iteration scrubber for per-iteration payload drops (the reference
@@ -443,7 +421,12 @@ function render(key, pts){
     showChart(true); flow(ctx, v, cv.width, cv.height, cv); return;
   }
   if (v && Array.isArray(v.vertices)){
-    setH(Math.max(150, 56*dagDepths(v).maxCol + 30));
+    const dd = dagDepths(v);
+    setH(Math.max(150, 56*dd.maxCol + 30));
+    // grow the canvas sideways for deep graphs so columns past the
+    // default width are drawn, not clipped
+    const needW = 30 + dd.ncols*(24+14);
+    if (cv.width < needW) cv.width = needW;
     ctx.clearRect(0,0,cv.width,cv.height);
     showChart(true); dagflow(ctx, v, cv.width, cv.height, cv); return;
   }

@@ -52,6 +52,13 @@ class TestDiskParity:
                 assert disk.search(q) == pytest.approx(mem.search(q))
             assert disk.all_documents() == mem.all_documents()
 
+    def test_repeated_query_terms_match_memory_semantics(self, tmp_path):
+        """Repeated query terms weight per occurrence in BOTH stores."""
+        mem = _fill(InvertedIndex())
+        with _fill(DiskInvertedIndex(str(tmp_path / "ix.db"))) as disk:
+            q = ["cat", "cat", "mat"]
+            assert disk.search(q) == pytest.approx(mem.search(q))
+
     def test_sample_batch(self, tmp_path):
         with _fill(DiskInvertedIndex(str(tmp_path / "ix.db"))) as disk:
             batch = disk.sample_batch(3, np.random.default_rng(0))
