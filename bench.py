@@ -926,7 +926,8 @@ def _long_context_row(metric, width, n_heads, batch, seq, mfu_gate,
         final = _sync(net.score_value)
         rates.append(timed_steps * batch * seq
                      / (time.perf_counter() - t0))
-    assert np.isfinite(final)
+    if not np.isfinite(final):  # not assert: must survive python -O
+        _fail_gate(f"{metric} non-finite loss {final}")
     med = float(np.median(rates))
     mfu = (med * flagship_flops_per_token(
         width, n_layers, seq, 64, causal_flash=True)
@@ -961,6 +962,23 @@ def bench_transformer_32k_context():
         width=1024, n_heads=8, batch=2, seq=32768, mfu_gate=0.30)
 
 
+def _release_device_memory(benches=None) -> None:
+    """Free finished rows' device state before the next heavy row: the
+    16 GB chip must hold the width-2048 16k-context row (~14 GB), so
+    dead nets/windows/executables from earlier rows cannot linger (the
+    round-5 full-run OOM: the interleaved family's ~3 GB of resident
+    windows starved every later row)."""
+    import gc
+
+    import jax
+
+    if benches is not None:
+        for b in benches:
+            b.__dict__.clear()
+    gc.collect()
+    jax.clear_caches()
+
+
 def main() -> None:
     benches = [LenetBench(), WideCnnBench(), TransformerBench(),
                MlpBench()]
@@ -968,6 +986,7 @@ def main() -> None:
     mlp_row = rows.pop()  # headline printed LAST
     for r in rows:
         print(json.dumps(r))
+    _release_device_memory(benches)
     for fn in (bench_transformer_long_context,
                bench_transformer_32k_context, bench_flagship,
                bench_hostfed_cnn, bench_decode, bench_w2v, bench_dbn,
@@ -979,6 +998,7 @@ def main() -> None:
             out = None
         for row in ([out] if isinstance(out, dict) else (out or [])):
             print(json.dumps(row))
+        _release_device_memory()
     print(json.dumps(mlp_row))
     if _GATE_FAILED:
         raise SystemExit(1)

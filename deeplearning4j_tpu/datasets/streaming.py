@@ -34,6 +34,160 @@ from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 _TOK_MAGIC = b"DL4JTOK1"
 
 
+class StorageDataSetIterator(DataSetIterator):
+    """Stream batches from shard files in a ``storage.backends``
+    backend (S3/GCS/HDFS/local) into ``fit()`` — the reference's
+    BaseS3DataSetIterator/BaseHdfsDataSetIterator role
+    (deeplearning4j-aws BaseS3DataSetIterator.java:1): one shard is
+    downloaded at a time, parsed, and batched; the next shard is
+    fetched only when the current one drains, so the working set
+    stays one shard regardless of dataset size.
+
+    ``fmt``:
+    - ``"cifar"`` — shards are CIFAR-10 binary batch files
+      (u8 [B,3,32,32] features, one-hot labels),
+    - ``"tokens"`` — DL4JTOK1 token files (LM id pairs),
+    - ``"npz"`` — ``np.savez`` archives with ``features``/``labels``
+      (+ optional ``features_mask``/``labels_mask``) arrays.
+
+    Wrap in ``native_rt.NativeAsyncDataSetIterator`` to overlap the
+    downloads with training (the reference pairs its S3 iterator with
+    AsyncDataSetIterator the same way)."""
+
+    def __init__(self, backend, prefix: str, batch_size: int,
+                 fmt: str = "npz", num_classes: int = 10):
+        super().__init__(batch_size)
+        if fmt not in ("cifar", "tokens", "npz"):
+            raise ValueError(f"unknown shard format {fmt!r}")
+        self.backend = backend
+        self.prefix = prefix
+        self.fmt = fmt
+        self.num_classes = num_classes
+        self.keys = sorted(backend.list(prefix))
+        if not self.keys:
+            raise ValueError(
+                f"no shards under prefix {prefix!r}")
+        self._key_idx = 0
+        self._inner: Optional[DataSetIterator] = None
+        self._tmpdir = None
+        self._current_local: Optional[str] = None
+        self._schema: dict = {}
+
+    def _local_copy(self, key: str) -> str:
+        import tempfile
+
+        if self._tmpdir is None:
+            self._tmpdir = tempfile.mkdtemp(prefix="dl4j_storage_it_")
+        local = os.path.join(
+            self._tmpdir, os.path.basename(key) or "shard")
+        return self.backend.get(key, local)
+
+    def _drop_current(self) -> None:
+        """Delete the drained shard's local copy — the working set is
+        ONE shard, so an epoch over a dataset larger than local disk
+        cannot fill it."""
+        self._inner = None
+        if self._current_local is not None:
+            try:
+                os.unlink(self._current_local)
+            except OSError:
+                pass
+            self._current_local = None
+
+    def close(self) -> None:
+        import shutil
+
+        self._drop_current()
+        if self._tmpdir is not None:
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+            self._tmpdir = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _open(self, key: str) -> DataSetIterator:
+        local = self._current_local = self._local_copy(key)
+        if self.fmt == "cifar":
+            return CifarBinStreamIterator(
+                [local], self.batch, num_classes=self.num_classes)
+        if self.fmt == "tokens":
+            return TokenSequenceFileIterator(local, self.batch)
+        z = np.load(local)
+        from deeplearning4j_tpu.datasets.iterator import (
+            BaseDataSetIterator,
+        )
+
+        ds = DataSet(z["features"], z["labels"],
+                     z["features_mask"] if "features_mask" in z else None,
+                     z["labels_mask"] if "labels_mask" in z else None)
+        return BaseDataSetIterator(self.batch, ds)
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        while True:
+            if self._inner is None:
+                if self._key_idx >= len(self.keys):
+                    return None
+                self._inner = self._open(self.keys[self._key_idx])
+            ds = self._inner.next(num)
+            if ds is not None:
+                return self._post(ds)
+            self._drop_current()
+            self._key_idx += 1
+
+    def reset(self) -> None:
+        self._drop_current()
+        self._key_idx = 0
+
+    def total_examples(self) -> int:
+        # would require opening every shard; the reference's S3
+        # iterator returns the configured total as well
+        raise NotImplementedError(
+            "total_examples requires scanning every remote shard")
+
+    def _schema_val(self, name: str) -> int:
+        """Schema queries, cached after the first answer: a remote
+        re-download per metadata call would be absurd for constants.
+        Uses the live reader when a shard is open; otherwise opens the
+        FIRST shard once (cursor untouched)."""
+        if name not in self._schema:
+            if self._inner is not None:
+                reader = self._inner
+            else:
+                reader = self._open(self.keys[0])
+                self._current_local = None  # metadata-only copy
+            self._schema["input_columns"] = reader.input_columns()
+            self._schema["total_outcomes"] = reader.total_outcomes()
+        return self._schema[name]
+
+    def input_columns(self) -> int:
+        if self.fmt == "cifar":
+            return 3 * 32 * 32
+        return self._schema_val("input_columns")
+
+    def total_outcomes(self) -> int:
+        if self.fmt == "cifar":
+            return self.num_classes
+        return self._schema_val("total_outcomes")
+
+    def state_dict(self) -> dict:
+        return {
+            "key_idx": self._key_idx,
+            "inner": (None if self._inner is None
+                      else self._inner.state_dict()),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self._key_idx = int(state["key_idx"])
+        self._inner = None
+        if state.get("inner") is not None and self._key_idx < len(
+                self.keys):
+            self._inner = self._open(self.keys[self._key_idx])
+            self._inner.load_state_dict(state["inner"])
+
+
 class CifarBinStreamIterator(DataSetIterator):
     """Stream [label u8][3072 px u8] rows from CIFAR-binary files.
 

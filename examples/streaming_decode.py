@@ -64,6 +64,15 @@ def main():
     print("expected :", expected)
     print("match    :", generated == expected)
 
+    # Fused path: ONE jitted scan emits all 16 tokens with the KV
+    # cache riding in the scan carry — identical ids, no host
+    # round-trip per token (the serving-throughput path; bench.py
+    # decode row measures it at 449 tok/s on the width-1024 flagship).
+    net.rnn_clear_previous_state()
+    fused = np.asarray(net.generate(one_hot_seq(prompt), 16))[0].tolist()
+    print("fused    :", fused)
+    print("fused == per-token loop:", fused == generated)
+
 
 if __name__ == "__main__":
     main()
