@@ -110,6 +110,9 @@ class StorageDataSetIterator(DataSetIterator):
 
     def _open(self, key: str) -> DataSetIterator:
         local = self._current_local = self._local_copy(key)
+        return self._open_local(local)
+
+    def _open_local(self, local: str) -> DataSetIterator:
         if self.fmt == "cifar":
             return CifarBinStreamIterator(
                 [local], self.batch, num_classes=self.num_classes)
@@ -148,18 +151,30 @@ class StorageDataSetIterator(DataSetIterator):
             "total_examples requires scanning every remote shard")
 
     def _schema_val(self, name: str) -> int:
-        """Schema queries, cached after the first answer: a remote
-        re-download per metadata call would be absurd for constants.
-        Uses the live reader when a shard is open; otherwise opens the
-        FIRST shard once (cursor untouched)."""
+        """Schema queries, cached after the first answer. A LIVE
+        reader answers for free (its schema accessors are pure — safe
+        even while a producer thread drives next()); with no shard
+        open, the first shard is probed into a PRIVATE temp dir so
+        nothing here mutates iterator state (an async producer may be
+        mid-_open concurrently)."""
         if name not in self._schema:
-            if self._inner is not None:
-                reader = self._inner
+            inner = self._inner  # snapshot: producer may swap it
+            if inner is not None:
+                schema = {"input_columns": inner.input_columns(),
+                          "total_outcomes": inner.total_outcomes()}
             else:
-                reader = self._open(self.keys[0])
-                self._current_local = None  # metadata-only copy
-            self._schema["input_columns"] = reader.input_columns()
-            self._schema["total_outcomes"] = reader.total_outcomes()
+                import tempfile
+
+                with tempfile.TemporaryDirectory(
+                        prefix="dl4j_storage_meta_") as d:
+                    local = self.backend.get(
+                        self.keys[0], os.path.join(d, "meta_shard"))
+                    reader = self._open_local(local)
+                    schema = {
+                        "input_columns": reader.input_columns(),
+                        "total_outcomes": reader.total_outcomes(),
+                    }
+            self._schema.update(schema)
         return self._schema[name]
 
     def input_columns(self) -> int:
@@ -180,8 +195,8 @@ class StorageDataSetIterator(DataSetIterator):
         }
 
     def load_state_dict(self, state: dict) -> None:
+        self._drop_current()  # unlink the open shard's local copy
         self._key_idx = int(state["key_idx"])
-        self._inner = None
         if state.get("inner") is not None and self._key_idx < len(
                 self.keys):
             self._inner = self._open(self.keys[self._key_idx])

@@ -67,7 +67,7 @@ def main():
     # Fused path: ONE jitted scan emits all 16 tokens with the KV
     # cache riding in the scan carry — identical ids, no host
     # round-trip per token (the serving-throughput path; bench.py
-    # decode row measures it at 449 tok/s on the width-1024 flagship).
+    # decode row measures ~450-550 tok/s on the width-1024 flagship).
     net.rnn_clear_previous_state()
     fused = np.asarray(net.generate(one_hot_seq(prompt), 16))[0].tolist()
     print("fused    :", fused)
