@@ -514,6 +514,148 @@ class ComputationGraph:
         }
         return inputs, [jnp.asarray(y, self._dtype) for y in labels], None, None
 
+    def _host_multi(self, data):
+        """Host-side sibling of ``_coerce_multi``: same name mapping,
+        NO device transfer or dtype cast — the windowing/stacking path
+        must keep batches in their minimal wire format (u8 pixels,
+        int token ids) until the one per-window upload."""
+        import numpy as _np
+
+        from deeplearning4j_tpu.datasets.dataset import (
+            DataSet,
+            MultiDataSet,
+        )
+
+        def name_masks(names, masks):
+            if masks is None:
+                return None
+            return {n: _np.asarray(m)
+                    for n, m in zip(names, masks)
+                    if m is not None} or None
+
+        if isinstance(data, MultiDataSet):
+            inputs = {n: _np.asarray(f) for n, f in zip(
+                self.conf.network_inputs, data.features)}
+            labels = [_np.asarray(y) for y in data.labels]
+            return (inputs, labels,
+                    name_masks(self.conf.network_inputs,
+                               data.features_masks),
+                    name_masks(self.conf.network_outputs,
+                               data.labels_masks))
+        if isinstance(data, DataSet):
+            fm = (None if data.features_mask is None else
+                  {self.conf.network_inputs[0]:
+                   _np.asarray(data.features_mask)})
+            lm = (None if data.labels_mask is None else
+                  {self.conf.network_outputs[0]:
+                   _np.asarray(data.labels_mask)})
+            return ({self.conf.network_inputs[0]:
+                     _np.asarray(data.features)},
+                    [_np.asarray(data.labels)], fm, lm)
+        feats, labels = data
+        return ({n: _np.asarray(f) for n, f in zip(
+                    self.conf.network_inputs, feats)},
+                [_np.asarray(y) for y in labels], None, None)
+
+    def fit_stream(self, iterator, scan_steps: int = 16,
+                   ingest=None, ingest_labels=None,
+                   sync_each_window: bool = False):
+        """Host-fed graph training: the ComputationGraph counterpart of
+        ``MultiLayerNetwork.fit_stream`` (see its docstring for the
+        windowing/transport rationale; reference AsyncDataSetIterator,
+        datasets/iterator/AsyncDataSetIterator.java:1). Consumes
+        DataSet/MultiDataSet batches from the iterator, stacks
+        ``scan_steps`` of them into [K, B, ...] pytrees host-side (wire
+        format preserved until the one per-window upload), and trains
+        each window in ONE fused ``fit_scan`` dispatch. ``ingest`` /
+        ``ingest_labels`` receive the stacked input DICT / label LIST
+        — and also apply on ragged tails (stacked [1, B, ...], then
+        trained per-batch via ``fit``). Returns the last window's score
+        array."""
+        import numpy as _np
+
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.nn.streaming_fit import (
+            drive_stream_windows,
+        )
+
+        self.init()
+        scores = None
+        in_names = self.conf.network_inputs
+
+        def stack_masks(masks_per_batch, what):
+            if all(m is None for m in masks_per_batch):
+                return None
+            if any(m is None for m in masks_per_batch):
+                raise ValueError(
+                    f"fit_stream window mixes batches with and "
+                    f"without {what}")
+            names = set(masks_per_batch[0])
+            if any(set(m) != names for m in masks_per_batch):
+                raise ValueError(
+                    f"fit_stream window mixes {what} name sets")
+            return {k: _np.stack([m[k] for m in masks_per_batch])
+                    for k in names}
+
+        def stacked(coerced):
+            inputs = {
+                k: _np.stack([c[0][k] for c in coerced])
+                for k in coerced[0][0]
+            }
+            labels = [
+                _np.stack([c[1][i] for c in coerced])
+                for i in range(len(coerced[0][1]))
+            ]
+            fm = stack_masks([c[2] for c in coerced], "feature masks")
+            lm = stack_masks([c[3] for c in coerced], "label masks")
+            return inputs, labels, fm, lm
+
+        def transform(inputs, labels):
+            inputs = {k: jax.device_put(v) for k, v in inputs.items()}
+            labels = [jax.device_put(y) for y in labels]
+            if sync_each_window:
+                # materialize uploads BEFORE dispatching compute (see
+                # MultiLayerNetwork.fit_stream transport note)
+                for leaf in jax.tree.leaves((inputs, labels)):
+                    leaf.block_until_ready()
+            if ingest is not None:
+                inputs = ingest(inputs)
+            if ingest_labels is not None:
+                labels = ingest_labels(labels)
+            return inputs, labels
+
+        def flush(window, fused):
+            nonlocal scores
+            if fused:
+                inputs, labels, fm, lm = stacked(
+                    [self._host_multi(b) for b in window])
+                inputs, labels = transform(inputs, labels)
+                scores = self.fit_scan(
+                    inputs, labels, masks_stacked=fm,
+                    label_masks_stacked=lm)
+                if sync_each_window:
+                    _np.asarray(scores[-1])
+                return
+            for b in window:  # ragged: correctness over throughput
+                inputs, labels, fm, lm = stacked([self._host_multi(b)])
+                inputs, labels = transform(inputs, labels)
+                self._fit_one(MultiDataSet(
+                    [_np.asarray(inputs[n])[0] for n in in_names],
+                    [_np.asarray(y)[0] for y in labels],
+                    None if fm is None else
+                    [fm.get(n, [None])[0] for n in in_names],
+                    None if lm is None else
+                    [lm.get(n, [None])[0]
+                     for n in self.conf.network_outputs]))
+            scores = jnp.asarray([self.score_value])
+
+        def batch_shape(ds):
+            inputs, _, _, _ = self._host_multi(ds)
+            return {k: _np.shape(v) for k, v in inputs.items()}
+
+        drive_stream_windows(iterator, scan_steps, flush, batch_shape)
+        return scores
+
     def fit(self, data, labels=None) -> None:
         self.init()
         from deeplearning4j_tpu.datasets.dataset import DataSet

@@ -496,22 +496,13 @@ class MultiLayerNetwork:
                     f[0], y[0], b.features_mask, b.labels_mask))
             scores = jnp.asarray([self.score_value])
 
-        window = []
-        while True:
-            ds = iterator.next()
-            if ds is None:
-                if window:  # exhausted mid-window: always ragged here
-                    flush(window, fused=False)
-                break
-            if window and (np.shape(ds.features)
-                           != np.shape(window[0].features)):
-                # smaller tail batch can't stack with the window
-                flush(window, fused=False)
-                window = []
-            window.append(ds)
-            if len(window) == scan_steps:
-                flush(window, fused=True)
-                window = []
+        from deeplearning4j_tpu.nn.streaming_fit import (
+            drive_stream_windows,
+        )
+
+        drive_stream_windows(
+            iterator, scan_steps, flush,
+            lambda ds: np.shape(ds.features))
         return scores
 
     @functools.cached_property

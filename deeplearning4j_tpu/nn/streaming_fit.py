@@ -1,0 +1,38 @@
+"""Shared window-driving loop for the host-fed ``fit_stream`` paths
+(MultiLayerNetwork + ComputationGraph — one copy so transport tweaks
+cannot silently diverge between them).
+
+The loop accumulates batches from a DataSetIterator into windows of
+``scan_steps``; a full uniform window flushes fused (one fit_scan
+dispatch), while a ragged tail — iterator exhaustion mid-window or a
+batch whose shape differs from the window's first — flushes per-batch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def drive_stream_windows(iterator, scan_steps: int,
+                         flush: Callable, batch_shape: Callable) -> None:
+    """``flush(window, fused)`` trains a list of batches;
+    ``batch_shape(ds)`` returns a comparable shape signature (host-side
+    only — no device transfers)."""
+    window, first_shape = [], None
+    while True:
+        ds = iterator.next()
+        if ds is None:
+            if window:  # exhausted mid-window: always ragged here
+                flush(window, False)
+            break
+        shape = batch_shape(ds)
+        if window and shape != first_shape:
+            # smaller tail batch can't stack with the window
+            flush(window, False)
+            window = []
+        if not window:
+            first_shape = shape
+        window.append(ds)
+        if len(window) == scan_steps:
+            flush(window, True)
+            window = []

@@ -232,6 +232,111 @@ class TestFitStream:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(c), rtol=1e-5, atol=1e-6)
 
+    def test_graph_fit_stream_matches_sequential(self):
+        """ComputationGraph.fit_stream == sequential graph fit on the
+        same batches — including a multi-input graph and a ragged
+        tail."""
+        import jax
+
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.datasets.iterator import (
+            ListDataSetIterator,
+        )
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        def net():
+            conf = (
+                NeuralNetConfiguration.Builder()
+                .seed(9).learning_rate(0.05)
+                .graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("da", L.DenseLayer(n_in=4, n_out=5,
+                                              activation="relu"), "a")
+                .add_layer("db", L.DenseLayer(n_in=3, n_out=5,
+                                              activation="tanh"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("out", L.OutputLayer(
+                    n_in=10, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT), "m")
+                .set_outputs("out").build())
+            return ComputationGraph(conf).init()
+
+        rng = np.random.default_rng(8)
+        batches = []
+        for n in [6, 6, 6, 6, 6, 4]:  # ragged final batch
+            xa = rng.normal(size=(n, 4)).astype(np.float32)
+            xb = rng.normal(size=(n, 3)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+            batches.append(MultiDataSet([xa, xb], [y]))
+
+        stream_net = net()
+        scores = stream_net.fit_stream(
+            ListDataSetIterator(batches), scan_steps=2)
+        assert np.isfinite(np.asarray(scores)).all()
+        seq_net = net()
+        for b in batches:
+            seq_net.fit(b)
+        assert stream_net.iteration == seq_net.iteration
+        for x, y2 in zip(jax.tree.leaves(stream_net.params),
+                         jax.tree.leaves(seq_net.params)):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y2), rtol=1e-5, atol=1e-6)
+
+    def test_graph_ragged_tail_applies_ingest(self):
+        """Ragged tails must go through the SAME ingest transforms as
+        fused windows — otherwise a u8/ids stream trains its tail on
+        raw wire data."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.datasets.iterator import (
+            ListDataSetIterator,
+        )
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        def net():
+            conf = (
+                NeuralNetConfiguration.Builder()
+                .seed(4).learning_rate(0.05)
+                .graph_builder().add_inputs("x")
+                .add_layer("d", L.DenseLayer(
+                    n_in=6, n_out=8, activation="relu"), "x")
+                .add_layer("out", L.OutputLayer(
+                    n_in=8, n_out=2, activation="softmax",
+                    loss_function=LossFunction.MCXENT), "d")
+                .set_outputs("out").build())
+            return ComputationGraph(conf).init()
+
+        rng = np.random.default_rng(1)
+        u8_batches, f32_batches = [], []
+        for n in [8, 8, 8, 4]:  # 1 fused window of 2 + ragged (8, 4)
+            xu8 = rng.integers(0, 255, (n, 6), np.uint8)
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+            u8_batches.append(DataSet(xu8, y))
+            f32_batches.append(
+                DataSet(xu8.astype(np.float32) / 255.0, y))
+
+        ingest = jax.jit(lambda d: {
+            k: v.astype(jnp.float32) / 255.0 for k, v in d.items()})
+        stream_net = net()
+        stream_net.fit_stream(ListDataSetIterator(u8_batches),
+                              scan_steps=2, ingest=ingest)
+        seq_net = net()
+        for b in f32_batches:
+            seq_net.fit(b)
+        assert stream_net.iteration == seq_net.iteration == 4
+        for a, c in zip(jax.tree.leaves(stream_net.params),
+                        jax.tree.leaves(seq_net.params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(c), rtol=1e-5, atol=1e-6)
+
     def test_token_stream_lm_learns(self, tmp_path):
         """End-to-end LM host-fed path: token ids on disk, one-hot on
         device, loss decreases on a learnable Markov language."""
