@@ -75,6 +75,7 @@ class ComputationGraph:
         self.score_value = float("nan")
         self.listeners: List = []
         self._rnn_state: Dict[str, Any] = {}
+        self._generate_fns: Dict[int, Any] = {}
         self._layer_vertices = {
             name: v
             for name, v in conf.vertices.items()
@@ -534,6 +535,16 @@ class ComputationGraph:
                     if m is not None} or None
 
         if isinstance(data, MultiDataSet):
+            if len(data.features) != len(self.conf.network_inputs):
+                raise ValueError(
+                    f"MultiDataSet has {len(data.features)} feature "
+                    f"arrays but graph has "
+                    f"{len(self.conf.network_inputs)} inputs")
+            if len(data.labels) != len(self.conf.network_outputs):
+                raise ValueError(
+                    f"MultiDataSet has {len(data.labels)} label arrays "
+                    f"but graph has {len(self.conf.network_outputs)} "
+                    f"outputs")
             inputs = {n: _np.asarray(f) for n, f in zip(
                 self.conf.network_inputs, data.features)}
             labels = [_np.asarray(y) for y in data.labels]
@@ -650,8 +661,11 @@ class ComputationGraph:
             scores = jnp.asarray([self.score_value])
 
         def batch_shape(ds):
-            inputs, _, _, _ = self._host_multi(ds)
-            return {k: _np.shape(v) for k, v in inputs.items()}
+            # full signature: label shapes too — identical features
+            # with variable-length labels must also break a window
+            inputs, labels, _, _ = self._host_multi(ds)
+            return ({k: _np.shape(v) for k, v in inputs.items()},
+                    tuple(_np.shape(y) for y in labels))
 
         drive_stream_windows(iterator, scan_steps, flush, batch_shape)
         return scores
@@ -828,6 +842,63 @@ class ComputationGraph:
 
     def rnn_clear_previous_state(self) -> None:
         self._rnn_state = {}
+
+    def generate(self, prompt, n_tokens: int):
+        """Greedy autoregressive generation fused on device — the
+        ComputationGraph counterpart of
+        ``MultiLayerNetwork.generate`` (see its docstring): prefill
+        the one-hot prompt [B, V, Tp] through ``rnn_time_step``, then
+        ONE jitted ``lax.scan`` emits ``n_tokens`` ids with the
+        per-vertex streaming state in the scan carry.
+
+        Requires an LM-shaped single-input/single-output graph
+        (input n_in == output n_out). Returns int32 ids
+        [B, n_tokens]."""
+        self.init()
+        if (len(self.conf.network_inputs) != 1
+                or len(self.conf.network_outputs) != 1):
+            raise ValueError(
+                "generate requires a single-input/single-output "
+                "LM-shaped graph")
+        in_name = self.conf.network_inputs[0]
+        first = None
+        for vname, ins in self.conf.vertex_inputs.items():
+            if in_name in ins and vname in self._layer_vertices:
+                first = self._layer_vertices[vname]
+                break
+        vocab = getattr(first.conf.layer, "n_in", None) if first else None
+        out_bean = self._layer_vertices[
+            self.conf.network_outputs[0]].conf.layer
+        if vocab is None or vocab != getattr(out_bean, "n_out", None):
+            raise ValueError(
+                "generate requires input n_in == output n_out "
+                f"(got {vocab} vs {getattr(out_bean, 'n_out', None)})")
+        out = self.rnn_time_step(prompt)[0]
+        tok0 = jnp.argmax(out[:, :, -1], axis=1).astype(jnp.int32)
+        if n_tokens == 1:
+            return tok0[:, None]
+        gen = self._generate_fns.get(n_tokens)
+        if gen is None:
+            def gen_fn(params, state, rnn_state, tok0):
+                def body(carry, _):
+                    rnn, tok = carry
+                    x = jax.nn.one_hot(
+                        tok, vocab, dtype=self._dtype)[:, :, None]
+                    acts, _, new_rnn = self._forward_fn(
+                        params, state, {in_name: x}, None, False,
+                        rnn_state=rnn)
+                    o = acts[self.conf.network_outputs[0]]
+                    nxt = jnp.argmax(o[:, :, -1], axis=1).astype(
+                        jnp.int32)
+                    return (new_rnn, nxt), nxt
+                (rnn, _), toks = jax.lax.scan(
+                    body, (rnn_state, tok0), None, length=n_tokens - 1)
+                return jnp.swapaxes(toks, 0, 1), rnn
+
+            gen = self._generate_fns[n_tokens] = jax.jit(gen_fn)
+        toks, self._rnn_state = gen(
+            self.params, self.state, self._rnn_state, tok0)
+        return jnp.concatenate([tok0[:, None], toks], axis=1)
 
     # ------------------------------------------------------------------
     # Greedy layer-wise pretraining (reference ComputationGraph.pretrain

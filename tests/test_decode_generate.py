@@ -3,6 +3,7 @@
 exactly — same ids, same final cache position."""
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.models.zoo import transformer_lm
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -66,6 +67,69 @@ class TestGenerate:
         b.rnn_clear_previous_state()
         ids_b = np.asarray(b.generate(_one_hot_seq([5, 2]), 5))
         assert int(ids_b[0, -1]) == nxt_a
+
+    def test_graph_generate_matches_per_token_loop(self):
+        """ComputationGraph.generate == its rnn_time_step loop (the
+        graph counterpart of the MLN contract)."""
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadSelfAttention,
+        )
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        def gnet():
+            conf = (
+                NeuralNetConfiguration.Builder()
+                .seed(6).learning_rate(0.01)
+                .graph_builder().add_inputs("in")
+                .add_layer("attn", MultiHeadSelfAttention(
+                    n_in=V, n_out=16, n_heads=2, causal=True,
+                    stream_max_t=32), "in")
+                .add_layer("out", L.RnnOutputLayer(
+                    n_in=16, n_out=V, activation="softmax",
+                    loss_function=LossFunction.MCXENT), "attn")
+                .set_outputs("out").build())
+            return ComputationGraph(conf).init()
+
+        prompt = [2, 5, 9]
+        n = 8
+        loop_net = gnet()
+        loop_net.rnn_clear_previous_state()
+        out = loop_net.rnn_time_step(_one_hot_seq(prompt))[0]
+        tok = int(np.asarray(out)[0, :, -1].argmax())
+        loop_ids = [tok]
+        for _ in range(n - 1):
+            out = loop_net.rnn_time_step(_one_hot_seq([tok]))[0]
+            tok = int(np.asarray(out)[0, :, -1].argmax())
+            loop_ids.append(tok)
+
+        gen_net = gnet()
+        gen_net.rnn_clear_previous_state()
+        ids = np.asarray(gen_net.generate(_one_hot_seq(prompt), n))
+        assert ids.shape == (1, n)
+        assert ids[0].tolist() == loop_ids
+
+    def test_graph_generate_rejects_multi_io(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .seed(1).learning_rate(0.01)
+            .graph_builder().add_inputs("a", "b")
+            .add_layer("da", L.DenseLayer(n_in=2, n_out=3), "a")
+            .add_layer("db", L.DenseLayer(n_in=2, n_out=3), "b")
+            .add_layer("out", L.OutputLayer(
+                n_in=3, n_out=2, activation="softmax",
+                loss_function=LossFunction.MCXENT), "da")
+            .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        with pytest.raises(ValueError, match="single-input"):
+            net.generate(np.zeros((1, 2, 3), np.float32), 4)
 
     def test_batched_prompts(self):
         net = _net()
