@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.util.jax_compat import enable_x64
+
 
 def check_gradients(
     net,
@@ -40,7 +42,7 @@ def check_gradients(
     from jax.flatten_util import ravel_pytree
 
     net.init()
-    with jax.enable_x64(True):
+    with enable_x64(True):
         params64 = jax.tree.map(
             lambda p: jnp.asarray(np.asarray(p), jnp.float64), net.params
         )

@@ -158,7 +158,9 @@ def serialize_for_pjrt(fn, *example_args) -> Tuple[bytes, bytes]:
     CompiledProgram consume."""
     import jax
 
-    exported = jax.export.export(jax.jit(fn))(*example_args)
+    from deeplearning4j_tpu.util.jax_compat import jax_export
+
+    exported = jax_export.export(jax.jit(fn))(*example_args)
     from jax._src import compiler
 
     copts = compiler.get_compile_options(

@@ -840,8 +840,38 @@ class ComputationGraph:
 
         return jax.jit(f)
 
-    def rnn_clear_previous_state(self) -> None:
-        self._rnn_state = {}
+    def rnn_clear_previous_state(self, slots=None) -> None:
+        """Reset streaming state (reference rnnClearPreviousState).
+        ``slots=[...]`` zeroes only those batch rows across every
+        vertex's carried state — the per-slot eviction hook shared
+        with MultiLayerNetwork (nn/streaming.py)."""
+        from deeplearning4j_tpu.nn.streaming import reset_streaming_state
+
+        self._rnn_state = reset_streaming_state(self._rnn_state, slots)
+
+    def lm_shape(self):
+        """(input name, output name, vocab) for an LM-shaped graph:
+        single input, single output, first-layer n_in == output n_out.
+        Shared by ``generate`` and ``serving.DecodeEngine``; raises
+        ValueError for any other topology."""
+        if (len(self.conf.network_inputs) != 1
+                or len(self.conf.network_outputs) != 1):
+            raise ValueError(
+                "requires a single-input/single-output LM-shaped graph")
+        in_name = self.conf.network_inputs[0]
+        out_name = self.conf.network_outputs[0]
+        first = None
+        for vname, ins in self.conf.vertex_inputs.items():
+            if in_name in ins and vname in self._layer_vertices:
+                first = self._layer_vertices[vname]
+                break
+        vocab = getattr(first.conf.layer, "n_in", None) if first else None
+        out_bean = self._layer_vertices[out_name].conf.layer
+        if vocab is None or vocab != getattr(out_bean, "n_out", None):
+            raise ValueError(
+                "LM-shaped graph requires input n_in == output n_out "
+                f"(got {vocab} vs {getattr(out_bean, 'n_out', None)})")
+        return in_name, out_name, vocab
 
     def generate(self, prompt, n_tokens: int):
         """Greedy autoregressive generation fused on device — the
@@ -854,51 +884,38 @@ class ComputationGraph:
         Requires an LM-shaped single-input/single-output graph
         (input n_in == output n_out). Returns int32 ids
         [B, n_tokens]."""
+        if n_tokens < 1:
+            raise ValueError(f"n_tokens {n_tokens} < 1")
         self.init()
-        if (len(self.conf.network_inputs) != 1
-                or len(self.conf.network_outputs) != 1):
-            raise ValueError(
-                "generate requires a single-input/single-output "
-                "LM-shaped graph")
-        in_name = self.conf.network_inputs[0]
-        first = None
-        for vname, ins in self.conf.vertex_inputs.items():
-            if in_name in ins and vname in self._layer_vertices:
-                first = self._layer_vertices[vname]
-                break
-        vocab = getattr(first.conf.layer, "n_in", None) if first else None
-        out_bean = self._layer_vertices[
-            self.conf.network_outputs[0]].conf.layer
-        if vocab is None or vocab != getattr(out_bean, "n_out", None):
-            raise ValueError(
-                "generate requires input n_in == output n_out "
-                f"(got {vocab} vs {getattr(out_bean, 'n_out', None)})")
+        in_name, _, vocab = self.lm_shape()
         out = self.rnn_time_step(prompt)[0]
         tok0 = jnp.argmax(out[:, :, -1], axis=1).astype(jnp.int32)
         if n_tokens == 1:
             return tok0[:, None]
-        gen = self._generate_fns.get(n_tokens)
-        if gen is None:
-            def gen_fn(params, state, rnn_state, tok0):
-                def body(carry, _):
-                    rnn, tok = carry
-                    x = jax.nn.one_hot(
-                        tok, vocab, dtype=self._dtype)[:, :, None]
-                    acts, _, new_rnn = self._forward_fn(
-                        params, state, {in_name: x}, None, False,
-                        rnn_state=rnn)
-                    o = acts[self.conf.network_outputs[0]]
-                    nxt = jnp.argmax(o[:, :, -1], axis=1).astype(
-                        jnp.int32)
-                    return (new_rnn, nxt), nxt
-                (rnn, _), toks = jax.lax.scan(
-                    body, (rnn_state, tok0), None, length=n_tokens - 1)
-                return jnp.swapaxes(toks, 0, 1), rnn
+        # Scan length bucketed to pow2 with the true length traced —
+        # bounded compile count under varied request lengths, same ids
+        # and final state (mirrors MultiLayerNetwork.generate).
+        from deeplearning4j_tpu.nn.streaming import (
+            make_bucketed_generate,
+            scan_length_bucket,
+        )
 
-            gen = self._generate_fns[n_tokens] = jax.jit(gen_fn)
+        n_rem = n_tokens - 1
+        bucket = scan_length_bucket(n_rem)
+        gen = self._generate_fns.get(bucket)
+        if gen is None:
+            def step(params, state, x, rnn):
+                acts, _, new_rnn = self._forward_fn(
+                    params, state, {in_name: x}, None, False,
+                    rnn_state=rnn)
+                return acts[self.conf.network_outputs[0]], new_rnn
+
+            gen = self._generate_fns[bucket] = make_bucketed_generate(
+                step, vocab, self._dtype, bucket)
         toks, self._rnn_state = gen(
-            self.params, self.state, self._rnn_state, tok0)
-        return jnp.concatenate([tok0[:, None], toks], axis=1)
+            self.params, self.state, self._rnn_state, tok0,
+            jnp.asarray(n_rem, jnp.int32))
+        return jnp.concatenate([tok0[:, None], toks[:, :n_rem]], axis=1)
 
     # ------------------------------------------------------------------
     # Greedy layer-wise pretraining (reference ComputationGraph.pretrain

@@ -163,22 +163,47 @@ class AttentionImpl(LayerImplBase):
             # streaming call reaches _stream_attend's explicit
             # cannot-stream error instead of silently attending
             # chunk-locally.)
-            new_state = cls._prefill_cache(lc, k, v)
+            new_state = cls._prefill_cache(lc, k, v, mask)
         return o, new_state
 
     # -- rnn_time_step streaming (fixed-size sliding KV cache) ---------
     @classmethod
-    def _prefill_cache(cls, lc, k, v):
+    def _prefill_cache(cls, lc, k, v, mask=None):
         """Right-align the last ``stream_max_t`` K/V positions into the
-        fixed-size cache (zeros pad the left when underfilled)."""
+        fixed-size cache (zeros pad the left when underfilled).
+
+        ``filled`` is a PER-ROW int32 vector [N]: each batch row is an
+        independent streaming slot with its own valid-length, so ragged
+        requests can share one batched cache (serving/engine.py slots).
+        With ``mask`` (right-padded prompts, [N, T] 1/0 over the valid
+        prefix) each row's real K/V are rotated to the right edge of
+        the window and ``filled`` counts only real tokens — the padded
+        tail wraps into the left region that the per-row window mask
+        already invalidates, so a bucket-padded prefill streams
+        identically to an unpadded prefill of the same prompt (the
+        masked left region may hold wrapped pad instead of zeros; it
+        never receives attention weight). Works for any T, including
+        T > stream_max_t (ordinary masked inference on long padded
+        batches): the window then keeps each row's last
+        ``min(length, stream_max_t)`` valid positions."""
         tm = lc.stream_max_t
         n, h, t, dh = k.shape
+        if mask is None:
+            filled = jnp.full((n,), min(t, tm), jnp.int32)
+        else:
+            # right-rotate each row's pad out of view BEFORE windowing:
+            # valid K/V land contiguous at the right edge for any T
+            # (window-sized or longer), the wrapped pad falls into the
+            # left region that the per-row `filled` mask invalidates
+            lengths = jnp.sum(mask.astype(jnp.int32), axis=1)  # [N]
+            shift = t - lengths
+            roll = jax.vmap(lambda a, s: jnp.roll(a, s, axis=1))
+            k, v = roll(k, shift), roll(v, shift)
+            filled = jnp.minimum(lengths, tm)
         zk = jnp.zeros((n, h, tm, dh), k.dtype)
-        return {
-            "k": jnp.concatenate([zk, k], axis=2)[:, :, -tm:, :],
-            "v": jnp.concatenate([zk, v], axis=2)[:, :, -tm:, :],
-            "filled": jnp.asarray(min(t, tm), jnp.int32),
-        }
+        ck = jnp.concatenate([zk, k], axis=2)[:, :, -tm:, :]
+        cv = jnp.concatenate([zk, v], axis=2)[:, :, -tm:, :]
+        return {"k": ck, "v": cv, "filled": filled}
 
     @classmethod
     def _stream_attend(cls, lc, q, k, v, cache):
@@ -206,7 +231,7 @@ class AttentionImpl(LayerImplBase):
         # one-token-at-a-time streaming once the window saturates).
         ek = jnp.concatenate([cache["k"], k], axis=2)   # [N,H,tm+t,dh]
         ev = jnp.concatenate([cache["v"], v], axis=2)
-        prev = cache["filled"]
+        prev = cache["filled"]                    # [N] per-slot lengths
         filled = jnp.minimum(prev + t, tm)
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, ek) / jnp.sqrt(
             jnp.asarray(q.shape[-1], q.dtype)
@@ -216,10 +241,14 @@ class AttentionImpl(LayerImplBase):
         ok = (
             (j[None, :] <= tm + i[:, None])       # causal
             & (j[None, :] >= i[:, None] + 1)      # its last-tm window
-            & (j[None, :] >= tm - prev)           # cache zeros invalid
-        )
+        )                                         # [t, tm+t]
+        # per-slot validity: cache zeros (or an idle/evicted slot's
+        # stale rows — filled == 0 invalidates the whole window) never
+        # receive weight, so slots at different fill levels share one
+        # batched step without contaminating each other
+        ok = ok[None] & (j[None, None, :] >= tm - prev[:, None, None])
         neg = jnp.asarray(-1e30, q.dtype)
-        scores = jnp.where(ok[None, None], scores, neg)
+        scores = jnp.where(ok[:, None], scores, neg)
         w = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", w, ev)
         return o, {"k": ek[:, :, -tm:, :], "v": ev[:, :, -tm:, :],

@@ -743,8 +743,17 @@ class MultiLayerNetwork:
         self._rnn_state = new_rnn
         return out
 
-    def rnn_clear_previous_state(self) -> None:
-        self._rnn_state = {}
+    def rnn_clear_previous_state(self, slots=None) -> None:
+        """Reset streaming state (reference rnnClearPreviousState).
+
+        ``slots=None`` wipes the whole batch. ``slots=[...]`` zeroes
+        only those batch rows — the serving engine's per-slot eviction
+        hook (nn/streaming.py: a zeroed attention row IS the
+        empty-cache state, so the cleared slot streams as fresh while
+        its neighbours keep decoding mid-flight)."""
+        from deeplearning4j_tpu.nn.streaming import reset_streaming_state
+
+        self._rnn_state = reset_streaming_state(self._rnn_state, slots)
 
     def generate(self, prompt, n_tokens: int):
         """Greedy autoregressive generation fused on device: prefill
@@ -756,35 +765,45 @@ class MultiLayerNetwork:
         nn/layers/recurrent/BaseRecurrentLayer.java:1); numerics are
         identical (tests/test_decode_generate.py).
 
+        The scan length is BUCKETED to the next power of two
+        (nn/streaming.py scan_length_bucket) and the true length rides
+        as a traced operand: steps past it freeze the carry, so the
+        compiled-executable count stays O(log max_tokens) under varied
+        request lengths instead of one compile per distinct
+        ``n_tokens``, and the rnn state still lands exactly at the
+        post-generation position.
+
         Requires an LM-shaped net (n_classes == n_in, one-hot io).
         Returns int32 ids [B, n_tokens]; leaves the rnn state at the
         post-generation position."""
+        from deeplearning4j_tpu.nn.streaming import (
+            make_bucketed_generate,
+            scan_length_bucket,
+        )
+
+        if n_tokens < 1:
+            raise ValueError(f"n_tokens {n_tokens} < 1")
         self.init()
         vocab = self.conf.confs[0].layer.n_in
         out = self.rnn_time_step(prompt)  # prefill (guards streamable)
         tok0 = jnp.argmax(out[:, :, -1], axis=1).astype(jnp.int32)
         if n_tokens == 1:
             return tok0[:, None]
-        gen = self._generate_fns.get(n_tokens)
+        n_rem = n_tokens - 1
+        bucket = scan_length_bucket(n_rem)
+        gen = self._generate_fns.get(bucket)
         if gen is None:
-            def gen_fn(params, state, rnn_state, tok0):
-                def body(carry, _):
-                    rnn, tok = carry
-                    x = jax.nn.one_hot(
-                        tok, vocab, dtype=self._dtype)[:, :, None]
-                    o, _, new_rnn = self._forward_fn(
-                        params, state, x, None, False, rnn_state=rnn)
-                    nxt = jnp.argmax(o[:, :, -1], axis=1).astype(
-                        jnp.int32)
-                    return (new_rnn, nxt), nxt
-                (rnn, _), toks = jax.lax.scan(
-                    body, (rnn_state, tok0), None, length=n_tokens - 1)
-                return jnp.swapaxes(toks, 0, 1), rnn
+            def step(params, state, x, rnn):
+                o, _, new_rnn = self._forward_fn(
+                    params, state, x, None, False, rnn_state=rnn)
+                return o, new_rnn
 
-            gen = self._generate_fns[n_tokens] = jax.jit(gen_fn)
+            gen = self._generate_fns[bucket] = make_bucketed_generate(
+                step, vocab, self._dtype, bucket)
         toks, self._rnn_state = gen(
-            self.params, self.state, self._rnn_state, tok0)
-        return jnp.concatenate([tok0[:, None], toks], axis=1)
+            self.params, self.state, self._rnn_state, tok0,
+            jnp.asarray(n_rem, jnp.int32))
+        return jnp.concatenate([tok0[:, None], toks[:, :n_rem]], axis=1)
 
     # ------------------------------------------------------------------
     # Parameter pack/unpack (reference params() :984-1063)

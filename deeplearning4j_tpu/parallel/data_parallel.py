@@ -38,7 +38,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from deeplearning4j_tpu.util.jax_compat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn.conf import layers as L
@@ -770,7 +772,7 @@ class ParallelTrainer:
         # single-device draw pattern under any keying.
         didx = lax.axis_index(self.sp_axis)
         if len(axes) == 2:
-            didx = (lax.axis_index(axes[0]) * lax.axis_size(axes[1])
+            didx = (lax.axis_index(axes[0]) * axis_size(axes[1])
                     + didx)
         rng = jax.random.fold_in(rng, didx)
 

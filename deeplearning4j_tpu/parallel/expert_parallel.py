@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.util.jax_compat import shard_map
 
 Array = jax.Array
 

@@ -31,7 +31,9 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from deeplearning4j_tpu.util.jax_compat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
@@ -139,7 +141,7 @@ def pipeline_apply(
 
     Returns [B, D_out] — the last stage's outputs, broadcast to the ring.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m = n_microbatches
     b = x.shape[0]

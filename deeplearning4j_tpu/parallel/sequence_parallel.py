@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.util.jax_compat import axis_size, shard_map
 
 Array = jax.Array
 
@@ -89,7 +89,7 @@ def ring_attention(
     score matrix. None = whole block at once (exact same math either
     way; tests assert equality).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, t, d = q.shape
     bs = t if block_size is None else min(block_size, t)
@@ -188,7 +188,7 @@ def ulysses_attention(
     ``key_mask`` [B, T_local]: all-gathered over the ring so padded
     keys are excluded from the full-sequence softmax.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     b, h, t, d = q.shape
     if h % n:
         raise ValueError(
@@ -272,7 +272,7 @@ def sp_scan(
 
     Returns (final_carry_on_every_device, ys_local).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
 
     def body(dev, state):

@@ -76,6 +76,12 @@ class Tracer:
                 "pid": os.getpid(), "args": {name: value},
             })
 
+    def rate(self, name: str, count: float, seconds: float) -> None:
+        """Counter expressed as events/sec over a measured window —
+        the serving engine's tokens/sec stream
+        (serving/engine.py)."""
+        self.counter(name, count / max(seconds, 1e-9))
+
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._events)

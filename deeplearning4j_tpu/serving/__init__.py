@@ -1,0 +1,19 @@
+"""Serving subsystem: continuous-batching decode over slot-based KV
+caches (ISSUE 1 tentpole; the layer that multiplexes many concurrent
+requests onto one compiled batched decode step)."""
+
+from deeplearning4j_tpu.serving.engine import DecodeEngine
+from deeplearning4j_tpu.serving.sampler import sample_tokens
+from deeplearning4j_tpu.serving.scheduler import (
+    GenerationResult,
+    Request,
+    Scheduler,
+)
+
+__all__ = [
+    "DecodeEngine",
+    "GenerationResult",
+    "Request",
+    "Scheduler",
+    "sample_tokens",
+]

@@ -1,0 +1,353 @@
+"""Continuous-batching decode engine over a slot-based KV cache.
+
+The serving counterpart of ``MultiLayerNetwork.generate``: instead of
+one request owning the whole batch (and the chip), a fixed pool of
+``n_slots`` KV-cache slots is multiplexed across many concurrent
+requests — the continuous-batching pattern of modern inference stacks,
+grown out of the reference's streaming ``rnnTimeStep`` contract
+(SURVEY §1 L1).
+
+Dataflow per scheduling round:
+
+1. **Admit** — while a slot is free and requests are queued, prefill
+   the next prompt at batch 1 (right-padded to a pow2 length bucket,
+   masked — streams identically to an unpadded prefill, see
+   ``AttentionImpl._prefill_cache``), then scatter the resulting cache
+   row and first sampled token into the pool at the free slot index
+   (one ``dynamic_update_slice`` computation; the slot index is a
+   traced operand, so admission never retraces).
+2. **Decode** — ONE jitted ``lax.scan`` advances ALL slots
+   ``decode_chunk`` tokens with the pool cache in the scan carry and
+   sampling on device (serving/sampler.py). Idle slots ride along
+   harmlessly: their ``filled == 0`` row masks every cached position
+   (nn/layers/attention.py), so live slots are never contaminated.
+3. **Evict** — requests that hit ``max_new_tokens`` (or ``eos_id``)
+   free their slot without stalling the batch; the slot's rows are
+   zeroed via the per-slot state reset
+   (``rnn_clear_previous_state(slots=...)`` semantics,
+   nn/streaming.py) and the next admission overwrites them.
+
+Compile-count guarantees (asserted in tests/test_serving_engine.py):
+ONE decode-step executable total, ONE admit executable total, and one
+prefill executable per pow2 prompt-length bucket — admission order,
+slot index, request length, and sampling config never retrace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers.attention import (
+    ATTENTION_BEANS,
+    guard_streamable,
+)
+from deeplearning4j_tpu.nn.streaming import clear_state_rows
+from deeplearning4j_tpu.serving.sampler import sample_tokens
+from deeplearning4j_tpu.serving.scheduler import (
+    GenerationResult,
+    Request,
+    Scheduler,
+)
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    tokens: List[int]
+
+
+def _lm_shape_of(net):
+    """(forward, vocab, named layer beans) for a MultiLayerNetwork or
+    an LM-shaped single-input/single-output ComputationGraph. The
+    forward signature is ``(params, state, x, mask, rnn) ->
+    (out [B, V, T], new_rnn)``."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    if isinstance(net, ComputationGraph):
+        in_name, out_name, vocab = net.lm_shape()
+
+        def forward(params, state, x, mask, rnn):
+            acts, _, new_rnn = net._forward_fn(
+                params, state, {in_name: x}, None, False,
+                masks=None if mask is None else {in_name: mask},
+                rnn_state=rnn)
+            return acts[out_name], new_rnn
+
+        beans = [(name, lv.conf.layer)
+                 for name, lv in net._layer_vertices.items()]
+        return forward, vocab, beans
+
+    vocab = net.conf.confs[0].layer.n_in
+    out_bean = net.conf.confs[-1].layer
+    if vocab != getattr(out_bean, "n_out", None):
+        raise ValueError(
+            "DecodeEngine requires an LM-shaped net (first-layer n_in "
+            f"== output n_out; got {vocab} vs "
+            f"{getattr(out_bean, 'n_out', None)})")
+
+    def forward(params, state, x, mask, rnn):
+        out, _, new_rnn = net._forward_fn(
+            params, state, x, None, False, feature_mask=mask,
+            rnn_state=rnn)
+        return out, new_rnn
+
+    beans = [(str(i), c.layer) for i, c in enumerate(net.conf.confs)]
+    return forward, vocab, beans
+
+
+class DecodeEngine:
+    """Slot-multiplexed batched decoding for one LM-shaped network.
+
+    Submit requests (``submit``), then ``run()`` drains queue + slots
+    and returns ``{request_id: GenerationResult}``. Greedy requests
+    (temperature 0, the default) produce ids bit-identical to a
+    sequential ``net.generate(prompt, n)`` call per request.
+
+    ``decode_chunk`` is the continuous-batching granularity: the batch
+    advances that many tokens per dispatch (amortizing host round
+    trips) and admissions/evictions happen at chunk boundaries. An
+    optional ``profiler.tracer.Tracer`` receives prefill/admit/decode
+    spans plus ``serving_tokens_per_sec`` and ``slot_occupancy``
+    counters."""
+
+    def __init__(self, net, n_slots: int = 8, decode_chunk: int = 8,
+                 min_prompt_bucket: int = 8, tracer=None, seed: int = 0):
+        if n_slots < 1:
+            raise ValueError(f"n_slots {n_slots} < 1")
+        if decode_chunk < 1:
+            raise ValueError(f"decode_chunk {decode_chunk} < 1")
+        net.init()
+        self.net = net
+        self.n_slots = int(n_slots)
+        self.decode_chunk = int(decode_chunk)
+        self.tracer = tracer
+        self._forward, self.vocab, beans = _lm_shape_of(net)
+        guard_streamable(iter(beans))
+        from deeplearning4j_tpu.nn.conf.layers import BaseRecurrentLayer
+
+        windows = []
+        for name, bean in beans:
+            # carried-state recurrents only: RnnOutputLayer is
+            # recurrent-typed but stateless, so it streams fine
+            if not isinstance(bean, BaseRecurrentLayer):
+                continue
+            if not isinstance(bean, ATTENTION_BEANS):
+                raise ValueError(
+                    f"DecodeEngine streams through the attention KV "
+                    f"cache; layer {name} "
+                    f"({type(bean).__name__}) carries a recurrent "
+                    "state this engine's masked slot prefill does not "
+                    "support")
+            windows.append(bean.stream_max_t)
+        if not windows:
+            raise ValueError(
+                "DecodeEngine requires at least one attention layer")
+        self.window = min(windows)
+        self.scheduler = Scheduler(self.window,
+                                   min_bucket=min_prompt_bucket)
+
+        self._key = jax.random.key(seed)
+        self._slots: List[Optional[_Slot]] = [None] * self.n_slots
+        self._pool = None                 # rnn-state pytree, [B, ...]
+        self._toks = None                 # [B] int32 current tokens
+        self._temps = np.zeros(self.n_slots, np.float32)
+        self._top_ks = np.full(self.n_slots, self.vocab, np.int32)
+        self.stats: Dict[str, Any] = {
+            "tokens_generated": 0, "requests_finished": 0,
+            "decode_time_s": 0.0, "chunks": 0, "occupancy_sum": 0.0,
+        }
+        self._build_jits()
+
+    # -- jitted computations (fixed executables; see module docstring) -
+    def _build_jits(self):
+        forward, chunk = self._forward, self.decode_chunk
+
+        def prefill(params, state, x, mask, temp, top_k, key):
+            out, rnn = forward(params, state, x, mask, None)
+            length = jnp.sum(mask.astype(jnp.int32), axis=1)
+            probs = jnp.take_along_axis(
+                out, (length - 1)[:, None, None], axis=2)[:, :, 0]
+            tok = sample_tokens(probs, temp, top_k, key)
+            return tok, rnn
+
+        def admit(pool, toks, rnn1, tok1, slot):
+            def put(p, o):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    p, o.astype(p.dtype), slot, axis=0)
+
+            return (jax.tree_util.tree_map(put, pool, rnn1),
+                    jax.lax.dynamic_update_slice(
+                        toks, tok1.astype(toks.dtype), (slot,)))
+
+        def decode(params, state, pool, toks, temps, top_ks, key):
+            keys = jax.random.split(key, chunk)
+
+            def body(carry, k):
+                rnn, tok = carry
+                x = jax.nn.one_hot(
+                    tok, self.vocab, dtype=self.net._dtype)[:, :, None]
+                out, new_rnn = forward(params, state, x, None, rnn)
+                nxt = sample_tokens(out[:, :, -1], temps, top_ks, k)
+                return (new_rnn, nxt), nxt
+
+            (pool, tok), seq = jax.lax.scan(body, (pool, toks), keys)
+            return pool, tok, jnp.swapaxes(seq, 0, 1)  # [B, chunk]
+
+        self._prefill_jit = jax.jit(prefill)
+        self._admit_jit = jax.jit(admit)
+        self._decode_jit = jax.jit(decode)
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Executable counts per jitted computation (the no-retrace
+        guarantee: decode and admit stay at 1; prefill equals the
+        number of distinct prompt-length buckets seen)."""
+        def n(f):
+            return int(getattr(f, "_cache_size", lambda: -1)())
+
+        return {"prefill": n(self._prefill_jit),
+                "admit": n(self._admit_jit),
+                "decode": n(self._decode_jit)}
+
+    # -- request lifecycle ---------------------------------------------
+    def submit(self, request: Request) -> int:
+        """Queue a request; returns its id (``run()`` drains)."""
+        bad = [t for t in request.prompt
+               if not 0 <= int(t) < self.vocab]
+        if bad:
+            raise ValueError(
+                f"prompt ids {bad[:4]} outside vocab [0, {self.vocab})")
+        return self.scheduler.submit(request)
+
+    def _span(self, name, **args):
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **args)
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _one_hot_prompt(self, prompt, bucket):
+        x = np.zeros((1, self.vocab, bucket), np.float32)
+        x[0, list(prompt), np.arange(len(prompt))] = 1.0
+        mask = np.zeros((1, bucket), np.float32)
+        mask[0, :len(prompt)] = 1.0
+        return jnp.asarray(x), jnp.asarray(mask)
+
+    def _admit_one(self, request: Request, slot: int, results):
+        bucket = self.scheduler.bucket_of(len(request.prompt))
+        x, mask = self._one_hot_prompt(request.prompt, bucket)
+        temp = jnp.asarray([request.temperature], jnp.float32)
+        top_k = jnp.asarray(
+            [request.top_k or self.vocab], jnp.int32)
+        with self._span("serving.prefill", bucket=bucket,
+                        prompt_len=len(request.prompt)):
+            tok, rnn1 = self._prefill_jit(
+                self.net.params, self.net.state, x, mask, temp, top_k,
+                self._next_key())
+        if self._pool is None:
+            self._pool = jax.tree_util.tree_map(
+                lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
+                                    a.dtype), rnn1)
+            self._toks = jnp.zeros((self.n_slots,), jnp.int32)
+        with self._span("serving.admit", slot=slot):
+            self._pool, self._toks = self._admit_jit(
+                self._pool, self._toks, rnn1, tok,
+                jnp.asarray(slot, jnp.int32))
+        first = int(np.asarray(tok)[0])
+        state = _Slot(request, [first])
+        self.stats["tokens_generated"] += 1
+        if self._finished(state):
+            self._finish(state, slot, results, evict=False)
+        else:
+            self._slots[slot] = state
+            self._temps[slot] = request.temperature
+            self._top_ks[slot] = request.top_k or self.vocab
+
+    @staticmethod
+    def _hit_eos(slot_state: _Slot) -> bool:
+        req = slot_state.request
+        return bool(req.eos_id is not None
+                    and slot_state.tokens
+                    and slot_state.tokens[-1] == req.eos_id)
+
+    def _finished(self, slot_state: _Slot) -> bool:
+        if len(slot_state.tokens) >= slot_state.request.max_new_tokens:
+            return True
+        return self._hit_eos(slot_state)
+
+    def _finish(self, slot_state: _Slot, slot: int, results,
+                evict: bool = True):
+        req = slot_state.request
+        # eos wins even when it lands exactly on the max_new_tokens-th
+        # token: the response terminated cleanly, not by truncation
+        reason = "eos" if self._hit_eos(slot_state) else "length"
+        results[req.id] = GenerationResult(
+            id=req.id, tokens=list(slot_state.tokens),
+            finish_reason=reason, prompt_len=len(req.prompt))
+        self.stats["requests_finished"] += 1
+        self.scheduler.release(req.id)
+        if evict:
+            # zero the slot's rows (per-slot eviction — the whole-pool
+            # analogue of rnn_clear_previous_state(slots=[slot])); the
+            # next admission overwrites them, this keeps stale K/V from
+            # ever being observable
+            self._pool = clear_state_rows(self._pool, [slot])
+            self._slots[slot] = None
+            self._temps[slot] = 0.0
+            self._top_ks[slot] = self.vocab
+
+    # -- the serving loop ----------------------------------------------
+    def run(self) -> Dict[int, GenerationResult]:
+        """Drain the queue: admit into free slots, decode in chunks,
+        evict finished requests — until no work remains."""
+        results: Dict[int, GenerationResult] = {}
+        while self.scheduler.pending or any(
+                s is not None for s in self._slots):
+            for slot in range(self.n_slots):
+                if self._slots[slot] is None and self.scheduler.pending:
+                    self._admit_one(self.scheduler.pop(), slot, results)
+            active = [i for i, s in enumerate(self._slots)
+                      if s is not None]
+            if not active:
+                continue
+            t0 = time.perf_counter()
+            with self._span("serving.decode_chunk",
+                            active=len(active)):
+                self._pool, self._toks, seq = self._decode_jit(
+                    self.net.params, self.net.state, self._pool,
+                    self._toks, jnp.asarray(self._temps),
+                    jnp.asarray(self._top_ks), self._next_key())
+                seq = np.asarray(seq)  # [B, chunk]; forces completion
+            dt = time.perf_counter() - t0
+            emitted = 0
+            for slot in active:
+                state = self._slots[slot]
+                for tok in seq[slot]:
+                    state.tokens.append(int(tok))
+                    emitted += 1
+                    if self._finished(state):
+                        break
+                if self._finished(state):
+                    self._finish(state, slot, results)
+            self.stats["tokens_generated"] += emitted
+            self.stats["decode_time_s"] += dt
+            self.stats["chunks"] += 1
+            occ = len(active) / self.n_slots
+            self.stats["occupancy_sum"] += occ
+            if self.tracer is not None:
+                self.tracer.counter("slot_occupancy", occ)
+                self.tracer.rate("serving_tokens_per_sec", emitted, dt)
+        return results
+
+    @property
+    def mean_occupancy(self) -> float:
+        chunks = self.stats["chunks"]
+        return self.stats["occupancy_sum"] / chunks if chunks else 0.0

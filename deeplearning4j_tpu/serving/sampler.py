@@ -1,0 +1,47 @@
+"""On-device token sampling for the batched decode step.
+
+One jitted computation covers every slot's sampling config: greedy,
+temperature, and top-k ride as PER-SLOT vectors (``temps[B]``,
+``top_ks[B]``) so heterogeneous requests share the single compiled
+decode step instead of forcing a retrace per config combination.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Probability floor before the log: the output layer emits exact zeros
+# for impossible classes under masking; log(0) would poison categorical.
+_PROB_FLOOR = 1e-30
+
+
+def sample_tokens(probs, temps, top_ks, key):
+    """Sample one token per slot from softmax row outputs.
+
+    probs: [B, V] per-slot class probabilities (the RnnOutputLayer
+    softmax at the last position).
+    temps: [B] float — 0 means greedy; greedy rows take the SAME
+    ``argmax(probs)`` the fused ``generate()`` path takes, so greedy
+    engine output is bit-identical to ``generate()``.
+    top_ks: [B] int32 — keep only each row's k highest-probability
+    classes before sampling (V = unfiltered).
+    key: PRNG key for this step.
+
+    Returns int32 [B]. Dividing log-probabilities by the temperature
+    differs from dividing logits only by a per-row constant, which
+    ``jax.random.categorical`` is invariant to, and top-k on
+    log-probabilities equals top-k on logits (monotone map)."""
+    greedy = jnp.argmax(probs, axis=1).astype(jnp.int32)
+    logits = jnp.log(jnp.maximum(probs, _PROB_FLOOR))
+    # rank-based top-k (not value-threshold): ties at the k-th value
+    # would otherwise let MORE than k classes through, breaking the
+    # top_k=1 == greedy guarantee. Stable argsort breaks ties by class
+    # index — the same winner argmax picks.
+    order = jnp.argsort(-logits, axis=1)
+    ranks = jnp.argsort(order, axis=1)
+    filtered = jnp.where(ranks < top_ks[:, None], logits, -jnp.inf)
+    scaled = filtered / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(
+        jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)

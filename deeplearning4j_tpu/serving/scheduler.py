@@ -1,0 +1,113 @@
+"""Request queue + admission policy for the continuous-batching engine.
+
+The scheduler owns everything host-side about a request's lifecycle
+BEFORE it holds a slot: validation against the cache window, FIFO
+ordering, and the pow2 prompt-length bucketing that bounds prefill
+compilations (one XLA executable per bucket, O(log window) buckets
+total, instead of one per distinct prompt length).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Deque, List, Optional, Sequence
+
+from deeplearning4j_tpu.nn.streaming import scan_length_bucket
+
+
+@dataclasses.dataclass
+class Request:
+    """One decode request. ``temperature == 0`` means greedy (the
+    default — bit-identical to ``MultiLayerNetwork.generate``);
+    ``top_k=None`` means unfiltered. ``eos_id`` optionally ends the
+    request early (the eos token is included in the output)."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    eos_id: Optional[int] = None
+    id: Optional[int] = None
+
+    def __post_init__(self):
+        if len(self.prompt) == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens {self.max_new_tokens} < 1")
+        if self.temperature < 0:
+            raise ValueError(f"temperature {self.temperature} < 0")
+        if self.top_k is not None and self.top_k < 1:
+            # top_k=0 would otherwise fall through `top_k or vocab`
+            # as unfiltered sampling — the opposite of the caller's
+            # plausible intent
+            raise ValueError(
+                f"top_k {self.top_k} < 1 (use None for unfiltered)")
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    """A finished request: generated ids (prompt excluded) and why it
+    stopped ('length' or 'eos')."""
+
+    id: int
+    tokens: List[int]
+    finish_reason: str
+    prompt_len: int
+
+
+class Scheduler:
+    """FIFO admission queue with pow2 prompt-length bucketing.
+
+    ``max_prompt_len`` is the engine's cache window: a prompt longer
+    than the window cannot prefill losslessly (its oldest tokens would
+    slide out before decoding starts), so it is rejected at submit
+    time rather than silently truncated."""
+
+    def __init__(self, max_prompt_len: int, min_bucket: int = 8):
+        self.max_prompt_len = int(max_prompt_len)
+        self.min_bucket = int(min_bucket)
+        self._queue: Deque[Request] = deque()
+        self._ids = itertools.count()
+        self._issued = set()
+
+    def bucket_of(self, prompt_len: int) -> int:
+        """Compiled-prefill bucket for a prompt length: next pow2,
+        clamped to the window (the pad past the prompt is masked, so a
+        clamped bucket still fits any admissible prompt)."""
+        return min(scan_length_bucket(prompt_len, self.min_bucket),
+                   self.max_prompt_len)
+
+    def submit(self, request: Request) -> int:
+        if len(request.prompt) > self.max_prompt_len:
+            raise ValueError(
+                f"prompt of {len(request.prompt)} tokens exceeds the "
+                f"cache window ({self.max_prompt_len}): raise "
+                "stream_max_t or shorten the prompt")
+        if request.id is None:
+            request.id = next(self._ids)
+        elif request.id in self._issued:
+            # results are keyed by id: a duplicate (e.g. the same
+            # Request object submitted twice) would silently overwrite
+            # the earlier request's output
+            raise ValueError(
+                f"request id {request.id} already submitted; construct "
+                "a new Request (or leave id=None)")
+        self._issued.add(request.id)
+        self._queue.append(request)
+        return request.id
+
+    def pop(self) -> Request:
+        return self._queue.popleft()
+
+    def release(self, request_id: int) -> None:
+        """Forget a finished request's id: ``_issued`` then tracks only
+        queued/in-flight requests (bounded memory over a long-lived
+        engine) while still rejecting concurrent duplicate ids."""
+        self._issued.discard(request_id)
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)

@@ -1,11 +1,19 @@
 """Autoregressive streaming decode on the transformer flagship.
 
-Trains a tiny causal LM on a repeating token pattern, then generates
-greedily one token at a time through ``rnn_time_step`` — each step runs
-ONE compiled computation against the fixed-size KV cache
-(`MultiHeadSelfAttention.stream_max_t`), so decode latency stays flat no
-matter how much context has streamed (the reference's rnnTimeStep
-serving contract, extended to attention).
+Trains a tiny causal LM on a repeating token pattern, then decodes it
+three ways, fastest first:
+
+1. **Fused ``generate()``** — ONE jitted ``lax.scan`` emits every token
+   with the fixed-size KV cache (`MultiHeadSelfAttention.stream_max_t`)
+   riding in the scan carry; no host round-trip per token. This is the
+   serving-throughput path (bench.py ``decode_tokens_per_sec``).
+2. **One ``rnn_time_step`` step** — the per-token path (the reference's
+   rnnTimeStep serving contract, extended to attention), kept as a
+   parity check that the fused scan streams the same computation.
+3. **``serving.DecodeEngine``** — several concurrent requests share one
+   compiled batched decode step over a pool of KV-cache slots
+   (continuous batching); each request's greedy ids are identical to
+   its own solo ``generate()`` call.
 
 Run: python examples/streaming_decode.py
 """
@@ -48,30 +56,50 @@ def main():
         net.fit(DataSet(x, y))
     print(f"train loss {float(net.score_value):.4f}")
 
-    # Prefill the prompt, then decode 16 tokens greedily.
+    # Fused decode: prefill the prompt, then ONE jitted scan emits all
+    # 16 tokens (bench.py decode row measures ~450-550 tok/s on the
+    # width-1024 flagship; the per-token loop is tunnel-RTT-bound).
     prompt = PATTERN[:3]
     net.rnn_clear_previous_state()
-    out = net.rnn_time_step(one_hot_seq(prompt))
-    tok = int(np.asarray(out)[0, :, -1].argmax())
-    generated = [tok]
-    for _ in range(15):
-        out = net.rnn_time_step(one_hot_seq([tok]))
-        tok = int(np.asarray(out)[0, :, 0].argmax())
-        generated.append(tok)
+    generated = np.asarray(net.generate(one_hot_seq(prompt), 16))[0].tolist()
     expected = [PATTERN[(3 + i) % len(PATTERN)] for i in range(16)]
     print("prompt   :", prompt)
     print("generated:", generated)
     print("expected :", expected)
     print("match    :", generated == expected)
 
-    # Fused path: ONE jitted scan emits all 16 tokens with the KV
-    # cache riding in the scan carry — identical ids, no host
-    # round-trip per token (the serving-throughput path; bench.py
-    # decode row measures ~450-550 tok/s on the width-1024 flagship).
+    # Parity check: ONE per-token rnn_time_step must produce the same
+    # next id the fused scan produced — same computation, different
+    # dispatch granularity.
     net.rnn_clear_previous_state()
-    fused = np.asarray(net.generate(one_hot_seq(prompt), 16))[0].tolist()
-    print("fused    :", fused)
-    print("fused == per-token loop:", fused == generated)
+    out = net.rnn_time_step(one_hot_seq(prompt))
+    tok0 = int(np.asarray(out)[0, :, -1].argmax())
+    out = net.rnn_time_step(one_hot_seq([tok0]))
+    tok1 = int(np.asarray(out)[0, :, 0].argmax())
+    print("per-token step parity:", [tok0, tok1] == generated[:2])
+
+    # Continuous batching: the engine multiplexes several requests
+    # (ragged prompts, ragged decode lengths) onto one compiled batched
+    # decode step over 4 KV-cache slots. Greedy ids per request are
+    # identical to a solo generate() of the same prompt.
+    from deeplearning4j_tpu.serving import DecodeEngine, Request
+
+    engine = DecodeEngine(net, n_slots=4, decode_chunk=4)
+    reqs = {
+        engine.submit(Request(prompt=PATTERN[:k], max_new_tokens=n)): k
+        for k, n in [(3, 16), (5, 8), (2, 12), (4, 10), (6, 6)]
+    }
+    results = engine.run()
+    ok = True
+    for rid, result in sorted(results.items()):
+        k = reqs[rid]
+        net.rnn_clear_previous_state()
+        solo = np.asarray(net.generate(
+            one_hot_seq(PATTERN[:k]), len(result.tokens)))[0].tolist()
+        ok &= result.tokens == solo
+        print(f"engine req {rid} (prompt {k} toks): {result.tokens}")
+    print("engine == solo generate per request:", ok)
+    print("engine compile counts:", engine.compile_counts())
 
 
 if __name__ == "__main__":

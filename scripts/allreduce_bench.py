@@ -71,7 +71,7 @@ def main():
     # axis — the executor-local fit of the reference's phase structure.
     # A plain jitted grad would let GSPMD fuse the all-reduce INTO the
     # compute phase and the decomposition would time a no-op reduce.
-    from jax import shard_map
+    from deeplearning4j_tpu.util.jax_compat import shard_map
     from jax.sharding import PartitionSpec
 
     def _local_grads(p, f, y):

@@ -19,3 +19,10 @@ import jax
 # jax.config.update("jax_platforms", ...); override it back to CPU for
 # deterministic, parallel-safe unit tests.
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak variants excluded from the tier-1 budget "
+        "(deselected via -m 'not slow')")

@@ -21,6 +21,7 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
 )
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.util.jax_compat import enable_x64
 
 
 def _simple_graph_conf():
@@ -202,7 +203,7 @@ class TestGraphGradients:
         y = np.zeros((6, 3), np.float64)
         y[np.arange(6), rng.integers(0, 3, 6)] = 1.0
 
-        with jax.enable_x64(True):
+        with enable_x64(True):
             params64 = jax.tree.map(
                 lambda p: jnp.asarray(np.asarray(p), jnp.float64), graph.params
             )

@@ -14,6 +14,8 @@ import sys
 
 import pytest
 
+from deeplearning4j_tpu.util.jax_compat import NATIVE_SHARD_MAP
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXAMPLES = [
@@ -43,6 +45,12 @@ def test_all_examples_listed():
 @pytest.mark.parametrize("name,args", EXAMPLES,
                          ids=[n for n, _ in EXAMPLES])
 def test_example_runs(name, args):
+    if name == "pipeline_4d_training.py" and not NATIVE_SHARD_MAP:
+        # dp x pp x sp x tp lowers through partial-manual shard_map,
+        # which the jax<0.6 experimental fallback cannot SPMD-partition
+        # (util/jax_compat.py)
+        pytest.skip("partial-manual shard_map broken on jax<0.6 "
+                    "fallback")
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["DL4J_EXAMPLES_PLATFORM"] = "cpu"
     env["DL4J_EXAMPLES_TINY"] = "1"

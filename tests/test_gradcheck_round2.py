@@ -19,6 +19,7 @@ from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.util.jax_compat import enable_x64
 
 
 def _rnn_ds(n=4, c_in=3, c_out=4, t_in=6, t_out=None, seed=0):
@@ -113,7 +114,7 @@ class TestRecursiveAutoEncoderGradients:
         rng = np.random.default_rng(1)
         x64 = jnp.asarray(rng.normal(size=(6, 5)), jnp.float64)
 
-        with jax.enable_x64(True):
+        with enable_x64(True):
             params = jax.tree.map(
                 lambda p: jnp.asarray(np.asarray(p), jnp.float64),
                 net.params["0"])

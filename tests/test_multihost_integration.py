@@ -17,11 +17,22 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.scaleout.coordinator import (
     CoordinatorClient,
     CoordinatorServer,
 )
+from deeplearning4j_tpu.util.jax_compat import (
+    CPU_MULTIPROCESS_COLLECTIVES,
+)
+
+# every test here gang-schedules 2 OS processes on the CPU backend,
+# which jax<0.5 cannot do ("Multiprocess computations aren't
+# implemented on the CPU backend" — util/jax_compat.py)
+pytestmark = pytest.mark.skipif(
+    not CPU_MULTIPROCESS_COLLECTIVES,
+    reason="jax<0.5 CPU backend has no cross-process collectives")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -137,6 +137,6 @@ def test_export_decode_step_serializes():
     # 2 attention layers x {k, v, filled}
     assert len(template) == 6
     shapes = sorted(t.shape for t in template)
-    assert shapes[0] == ()  # filled counters
+    assert shapes[0] == (1,)  # per-slot filled counters, [N] at N=1
     assert any(len(s) == 4 and s[2] == 16 for s in shapes)  # [1,H,16,dh]
     assert all(t.dtype == np.float32 for t in template)
