@@ -892,6 +892,178 @@ def bench_decode_batched():
     }
 
 
+def bench_prefix_cache():
+    """Serving rows (ISSUE 2 tentpole): radix prefix cache + chunked
+    prefill on the SAME width-1024 flagship / 2048-window / 8-slot
+    config as the continuous-batching row.
+
+    Workload: 16 requests whose prompts share an 80% prefix (1024
+    shared "system prompt" tokens + 256 distinct tail tokens), run
+    twice on one engine — round 1 populates the radix cache (its first
+    admission wave is the COLD sample: every prompt misses and chunk-
+    prefills from token 0), round 2 is the WARM sample (every prompt
+    hits; only the 256-token suffix prefills). TTFT is compared between
+    the matched first-``n_slots`` admission waves of each round so
+    queue position cancels out.
+
+    Gates:
+    - parity: round-2 (warm-path) greedy ids match the sequential B=1
+      ``generate()`` ids (>= 0.9 over the decoded window — the same
+      bf16 argmax-tie bar as the batched row; the cache-off engine is
+      pinned to generate() by that row's gate, so this is on-vs-off
+      parity by transitivity);
+    - TTFT: median warm TTFT < median cold TTFT;
+    - reuse: >= 0.7 of round-2 prompt tokens served from the cache,
+      round-2 hit rate >= 0.7;
+    - throughput under churn: the warm round's aggregate tokens/sec
+      must EXCEED the B=1 fused rate (PR 1's batched-decode gate);
+    - compile counts: decode/admit/prefix_fetch/prefix_store/
+      chunk_prefill all 1 after round 1, unchanged by round 2."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import DecodeEngine, Request
+
+    V, width, n_layers, window = 64, 1024, 8, 2048
+    n_slots, n_gen = 8, 64
+    shared_len, tail_len, n_reqs = 1024, 256, 16
+    prompt_len = shared_len + tail_len
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, V, shared_len).tolist()
+    prompts = [shared + rng.integers(0, V, tail_len).tolist()
+               for _ in range(n_reqs)]
+
+    def one_hot(ids):
+        x = np.zeros((1, V, len(ids)), np.float32)
+        x[0, ids, np.arange(len(ids))] = 1.0
+        return x
+
+    # --- B=1 fused reference: throughput gate + parity ids -----------
+    solo_ids = []
+    b1_rates = []
+    for i, p in enumerate(prompts[:n_slots]):
+        net.rnn_clear_previous_state()
+        ids = np.asarray(net.generate(one_hot(p), n_gen))  # warm
+        if i < 3:
+            net.rnn_clear_previous_state()
+            t0 = time.perf_counter()
+            ids = np.asarray(net.generate(one_hot(p), n_gen))
+            b1_rates.append(n_gen / (time.perf_counter() - t0))
+        solo_ids.append(ids[0].tolist())
+    b1 = float(np.median(b1_rates))
+
+    engine = DecodeEngine(net, n_slots=n_slots, decode_chunk=32,
+                          prefix_cache_rows=4, prefill_chunk=256,
+                          admission_policy="ttft")
+
+    def one_round():
+        ids = [engine.submit(Request(prompt=p, max_new_tokens=n_gen))
+               for p in prompts]
+        t0 = time.perf_counter()
+        results = engine.run()
+        dt = time.perf_counter() - t0
+        ordered = [results[i] for i in ids]
+        toks = sum(len(r.tokens) for r in ordered)
+        return ordered, toks / dt
+
+    # warmup on a DIFFERENT shared prefix (first token forced distinct,
+    # so the measured cold round still misses): compiles every
+    # executable — incl. prefix_fetch via the second request's hit —
+    # leaving the cold round to measure admission, not XLA compiles.
+    # The two requests run in SEPARATE run() calls: submitted together
+    # they would both start admission before either inserts, and the
+    # second would miss instead of compiling the fetch path
+    other = rng.integers(0, V, shared_len).tolist()
+    other[0] = (shared[0] + 1) % V
+    for _ in range(2):
+        engine.submit(Request(
+            prompt=other + rng.integers(0, V, tail_len).tolist(),
+            max_new_tokens=n_gen))
+        engine.run()
+
+    cold_res, _ = one_round()       # round 1: populates the cache
+    counts_warm = engine.compile_counts()
+    skipped_r1 = engine.stats["prefill_tokens_skipped"]
+    hits_r1 = engine.prefix_cache.stats["hits"]
+    warm_res, warm_rate = one_round()   # round 2: every prompt hits
+    counts_after = engine.compile_counts()
+
+    for key in ("decode", "admit", "prefix_fetch", "prefix_store",
+                "chunk_prefill"):
+        if counts_after.get(key) not in (1, -1):
+            _fail_gate(f"prefix-cache engine {key} executable count "
+                       f"{counts_after.get(key)} != 1")
+    if counts_after != counts_warm:
+        _fail_gate(f"prefix-cache engine retraced between rounds: "
+                   f"{counts_warm} -> {counts_after}")
+
+    matches = [float(np.mean(np.asarray(r.tokens)
+                             == np.asarray(solo)))
+               for r, solo in zip(warm_res[:n_slots], solo_ids)]
+    match = float(np.mean(matches))
+    if match < 0.9:
+        _fail_gate(f"warm-path/sequential id match {match:.2f}")
+
+    cold_wave = [r.ttft_s for r in cold_res[:n_slots]
+                 if r.prefix_tokens_reused == 0]
+    warm_wave = [r.ttft_s for r in warm_res[:n_slots]]
+    cold_ttft = float(np.median(cold_wave))
+    warm_ttft = float(np.median(warm_wave))
+    if not warm_ttft < cold_ttft:
+        _fail_gate(f"warm TTFT {warm_ttft * 1e3:.1f} ms not below "
+                   f"cold {cold_ttft * 1e3:.1f} ms")
+
+    skipped_r2 = engine.stats["prefill_tokens_skipped"] - skipped_r1
+    skip_ratio = skipped_r2 / float(n_reqs * prompt_len)
+    hit_rate_r2 = (engine.prefix_cache.stats["hits"] - hits_r1) / float(
+        n_reqs)
+    if skip_ratio < 0.7:
+        _fail_gate(f"prefill-tokens-skipped ratio {skip_ratio:.2f} "
+                   "< 0.7 on the 80%-shared workload")
+    if hit_rate_r2 < 0.7:
+        _fail_gate(f"warm-round hit rate {hit_rate_r2:.2f} < 0.7")
+    if warm_rate <= b1:
+        _fail_gate(f"warm churn decode {warm_rate:.0f} tok/s <= B=1 "
+                   f"fused {b1:.0f}")
+
+    return [{
+        "metric": "decode_prefix_ttft_ms",
+        "value": round(warm_ttft * 1e3, 1),
+        "unit": ("ms median submit-to-first-token, warm admission "
+                 f"wave ({shared_len}-token shared prefix cached, "
+                 f"{tail_len}-token suffix chunk-prefilled; width-1024 "
+                 "flagship, 2048-token window)"),
+        "vs_baseline": None,  # reference rnnTimeStep has no LM serving
+        "cold_ttft_ms": round(cold_ttft * 1e3, 1),
+        "warm_vs_cold": round(warm_ttft / cold_ttft, 3),
+        "trials": len(warm_wave),
+        "spread": [round(min(warm_wave) * 1e3, 1),
+                   round(max(warm_wave) * 1e3, 1)],
+    }, {
+        "metric": "decode_prefix_cached_tokens_per_sec",
+        "value": round(warm_rate, 1),
+        "unit": (f"aggregate tokens/sec under churn ({n_reqs} reqs x "
+                 f"{n_gen} tokens over {n_slots} slots, radix prefix "
+                 "cache + 256-token chunked prefill, width-1024 "
+                 "flagship)"),
+        "vs_baseline": None,
+        "trials": 1,
+        "vs_b1_fused": round(warm_rate / b1, 2),
+        "b1_fused_tokens_per_sec": round(b1, 1),
+        "prefill_tokens_skipped_ratio": round(skip_ratio, 4),
+        "warm_hit_rate": round(hit_rate_r2, 4),
+        "warm_sequential_id_match": round(match, 4),
+        "compile_counts": counts_after,
+    }]
+
+
 def bench_w2v():
     """BASELINE row 3: Word2Vec skip-gram words/sec with a semantic
     quality gate on the bundled REAL corpus (the reference's
@@ -1131,7 +1303,7 @@ def main() -> None:
     for fn in (bench_transformer_long_context,
                bench_transformer_32k_context, bench_flagship,
                bench_hostfed_cnn, bench_decode, bench_decode_batched,
-               bench_w2v, bench_dbn,
+               bench_prefix_cache, bench_w2v, bench_dbn,
                bench_allreduce):
         try:
             out = fn()

@@ -117,8 +117,12 @@ class AttentionImpl(LayerImplBase):
             # carried KV cache + this chunk — the attention analogue of
             # the LSTM carried (h, c) (reference BaseRecurrentLayer
             # stateMap). Always causal (the future is unwritten when
-            # decoding); masks don't apply (reference streams unmasked).
-            return cls._stream_attend(lc, q, k, v, state)
+            # decoding). An optional right-padded chunk mask lets a
+            # bucket-padded suffix chunk resume a partially-filled
+            # cache (serving chunked prefill); unmasked streaming (the
+            # reference contract, and the decode hot path) is the
+            # mask=None fast path.
+            return cls._stream_attend(lc, q, k, v, state, mask)
         if lc.ring_axis:
             from deeplearning4j_tpu.parallel.sequence_parallel import (
                 ring_attention,
@@ -167,6 +171,19 @@ class AttentionImpl(LayerImplBase):
         return o, new_state
 
     # -- rnn_time_step streaming (fixed-size sliding KV cache) ---------
+    @staticmethod
+    def _right_align(shift, *arrays):
+        """Right-rotate each batch row of ``[N, H, T, dh]`` arrays by
+        its per-row ``shift`` along the time axis — the
+        pad-out-of-view trick shared by bucket-padded prefill and
+        masked chunk continuation: after rotation a ``[:, :, -tm:, :]``
+        window slice keeps real tokens contiguous at the right edge,
+        and the wrapped pad lands in the left region the per-row
+        ``filled`` mask invalidates (it must never receive attention
+        weight — both call sites rely on exactly this invariant)."""
+        roll = jax.vmap(lambda a, s: jnp.roll(a, s, axis=1))
+        return tuple(roll(a, shift) for a in arrays)
+
     @classmethod
     def _prefill_cache(cls, lc, k, v, mask=None):
         """Right-align the last ``stream_max_t`` K/V positions into the
@@ -191,14 +208,11 @@ class AttentionImpl(LayerImplBase):
         if mask is None:
             filled = jnp.full((n,), min(t, tm), jnp.int32)
         else:
-            # right-rotate each row's pad out of view BEFORE windowing:
+            # rotate each row's pad out of view BEFORE windowing:
             # valid K/V land contiguous at the right edge for any T
-            # (window-sized or longer), the wrapped pad falls into the
-            # left region that the per-row `filled` mask invalidates
+            # (window-sized or longer) — see _right_align
             lengths = jnp.sum(mask.astype(jnp.int32), axis=1)  # [N]
-            shift = t - lengths
-            roll = jax.vmap(lambda a, s: jnp.roll(a, s, axis=1))
-            k, v = roll(k, shift), roll(v, shift)
+            k, v = cls._right_align(t - lengths, k, v)
             filled = jnp.minimum(lengths, tm)
         zk = jnp.zeros((n, h, tm, dh), k.dtype)
         ck = jnp.concatenate([zk, k], axis=2)[:, :, -tm:, :]
@@ -206,12 +220,23 @@ class AttentionImpl(LayerImplBase):
         return {"k": ck, "v": cv, "filled": filled}
 
     @classmethod
-    def _stream_attend(cls, lc, q, k, v, cache):
+    def _stream_attend(cls, lc, q, k, v, cache, mask=None):
         """Dense attention of the current chunk's queries over
         cache + chunk. The cache stays ``stream_max_t`` long (static
         shapes — one compiled decode step regardless of how much
         context has streamed); the oldest tokens slide out when the
-        window is exceeded."""
+        window is exceeded.
+
+        ``mask`` (``[N, T]`` 1/0, right-padded) marks the chunk's valid
+        prefix per row: this is the resume-from-a-partially-filled-cache
+        path (serving chunked prefill — a pow2/fixed-width padded
+        suffix chunk continues a prefix-cache hit). Pad keys never
+        receive weight, pad positions never enter the cache (the same
+        roll-the-pad-out-of-view trick as ``_prefill_cache``), and
+        ``filled`` advances by each row's true chunk length — so a
+        padded chunked continuation streams identically to an unpadded
+        one-shot prefill of the same tokens. ``mask=None`` (the decode
+        hot path) keeps the original, roll-free program."""
         tm = lc.stream_max_t
         t = q.shape[2]
         if not lc.causal:
@@ -232,7 +257,11 @@ class AttentionImpl(LayerImplBase):
         ek = jnp.concatenate([cache["k"], k], axis=2)   # [N,H,tm+t,dh]
         ev = jnp.concatenate([cache["v"], v], axis=2)
         prev = cache["filled"]                    # [N] per-slot lengths
-        filled = jnp.minimum(prev + t, tm)
+        if mask is None:
+            lengths = jnp.full(q.shape[:1], t, jnp.int32)
+        else:
+            lengths = jnp.sum(mask.astype(jnp.int32), axis=1)  # [N]
+        filled = jnp.minimum(prev + lengths, tm)
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, ek) / jnp.sqrt(
             jnp.asarray(q.shape[-1], q.dtype)
         )
@@ -247,12 +276,25 @@ class AttentionImpl(LayerImplBase):
         # receive weight, so slots at different fill levels share one
         # batched step without contaminating each other
         ok = ok[None] & (j[None, None, :] >= tm - prev[:, None, None])
+        if mask is not None:
+            # chunk pad (positions past each row's true chunk length)
+            # is invalid too — a padded chunk attends exactly like its
+            # unpadded counterpart
+            ok = ok & ((j[None, None, :] < tm)
+                       | (j[None, None, :] - tm
+                          < lengths[:, None, None]))
         neg = jnp.asarray(-1e30, q.dtype)
         scores = jnp.where(ok[:, None], scores, neg)
         w = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", w, ev)
-        return o, {"k": ek[:, :, -tm:, :], "v": ev[:, :, -tm:, :],
-                   "filled": filled}
+        if mask is None:
+            ck, cv = ek[:, :, -tm:, :], ev[:, :, -tm:, :]
+        else:
+            # rotate each row's chunk pad out of view before windowing
+            # (see _right_align — shared with _prefill_cache)
+            ek, ev = cls._right_align(t - lengths, ek, ev)
+            ck, cv = ek[:, :, -tm:, :], ev[:, :, -tm:, :]
+        return o, {"k": ck, "v": cv, "filled": filled}
 
 
 @register_bean("TransformerBlock")

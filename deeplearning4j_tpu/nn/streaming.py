@@ -87,6 +87,37 @@ def reset_streaming_state(rnn_state: Any, slots) -> Any:
     return clear_state_rows(rnn_state, slots)
 
 
+def drop_newest_tokens(rnn_state: Any, drop) -> Any:
+    """Rewind every attention KV-cache in a streaming-state pytree by
+    ``drop`` tokens (0 or more, static or traced), returning the state
+    as it was before the newest ``drop`` tokens streamed in.
+
+    Valid because K/V at a position are per-token projections of that
+    token alone: removing the newest entries and re-right-aligning
+    reproduces the shorter prefix's cache exactly. The roll wraps the
+    dropped K/V into the left region that the decremented ``filled``
+    already invalidates (the same mask argument as
+    ``AttentionImpl._prefill_cache``), so they never receive attention
+    weight. Used by the serving prefix cache: an exact-match prompt
+    rewinds the cached state one token so the final prompt token can be
+    re-streamed to produce first-token logits. The caller guarantees
+    ``drop <= min(filled)``. Raises on non-attention state (an LSTM
+    carry has no per-token axis to rewind)."""
+    out = {}
+    for name, st in (rnn_state or {}).items():
+        if not (isinstance(st, dict) and "filled" in st):
+            raise ValueError(
+                f"streaming state for layer {name!r} carries no "
+                "KV-cache 'filled' vector — only attention caches can "
+                "be rewound by token")
+        out[name] = {
+            "k": jnp.roll(st["k"], drop, axis=2),
+            "v": jnp.roll(st["v"], drop, axis=2),
+            "filled": st["filled"] - drop,
+        }
+    return out
+
+
 def clear_state_rows(rnn_state: Any, slots: Iterable[int]) -> Any:
     """Zero the given batch rows of every leaf in a streaming-state
     pytree, leaving all other rows untouched.

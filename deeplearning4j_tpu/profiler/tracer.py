@@ -90,6 +90,25 @@ class Tracer:
         return [e for e in self.events()
                 if e["ph"] == "X" and (name is None or e["name"] == name)]
 
+    def counter_values(self, name: str) -> List[float]:
+        """All values recorded for one counter track, in order — the
+        in-process assertion hook for serving observability (e.g. the
+        chunked-admission stall bound: every
+        ``serving_round_prefill_chunks`` sample must stay within the
+        scheduler's budget)."""
+        return [e["args"][name] for e in self.events()
+                if e["ph"] == "C" and e["name"] == name]
+
+    def latest_counters(self) -> Dict[str, float]:
+        """Final value of every counter track (a serving run's
+        end-state snapshot: admitted, evicted, prefix hits/misses,
+        chunks scheduled, tokens decoded, ...)."""
+        out: Dict[str, float] = {}
+        for e in self.events():
+            if e["ph"] == "C":
+                out[e["name"]] = e["args"][e["name"]]
+        return out
+
     def save(self, path: str) -> None:
         with open(path, "w") as f:
             json.dump({"traceEvents": self.events()}, f)

@@ -1,8 +1,14 @@
 """Serving subsystem: continuous-batching decode over slot-based KV
 caches (ISSUE 1 tentpole; the layer that multiplexes many concurrent
-requests onto one compiled batched decode step)."""
+requests onto one compiled batched decode step), plus the radix prefix
+cache and chunked-prefill admission that make admissions prefix-aware
+and non-blocking (ISSUE 2 tentpole)."""
 
 from deeplearning4j_tpu.serving.engine import DecodeEngine
+from deeplearning4j_tpu.serving.prefix_cache import (
+    PrefixHit,
+    RadixPrefixCache,
+)
 from deeplearning4j_tpu.serving.sampler import sample_tokens
 from deeplearning4j_tpu.serving.scheduler import (
     GenerationResult,
@@ -13,6 +19,8 @@ from deeplearning4j_tpu.serving.scheduler import (
 __all__ = [
     "DecodeEngine",
     "GenerationResult",
+    "PrefixHit",
+    "RadixPrefixCache",
     "Request",
     "Scheduler",
     "sample_tokens",

@@ -15,22 +15,38 @@ Dataflow per scheduling round:
    ``AttentionImpl._prefill_cache``), then scatter the resulting cache
    row and first sampled token into the pool at the free slot index
    (one ``dynamic_update_slice`` computation; the slot index is a
-   traced operand, so admission never retraces).
-2. **Decode** — ONE jitted ``lax.scan`` advances ALL slots
+   traced operand, so admission never retraces). With the radix prefix
+   cache enabled (``prefix_cache_rows``, serving/prefix_cache.py), the
+   longest cached prefix of the prompt is fetched from a second
+   device-resident row pool instead of recomputed, and only the
+   *suffix* prefills; every completed admission stores its post-prefill
+   state back, so shared system prompts/templates prefill once.
+2. **Chunked prefill** (``prefill_chunk > 0``) — suffix prefill splits
+   into fixed-width masked chunks that resume the carried cache
+   (``AttentionImpl._stream_attend`` with a chunk mask), scheduled
+   BETWEEN decode rounds under the scheduler's per-round token budget
+   (``Scheduler.plan_chunks``; policy knob ``decode``- vs
+   ``ttft``-priority), so a long prompt never stalls running slots
+   longer than the budget — one chunk, under decode priority.
+3. **Decode** — ONE jitted ``lax.scan`` advances ALL slots
    ``decode_chunk`` tokens with the pool cache in the scan carry and
    sampling on device (serving/sampler.py). Idle slots ride along
    harmlessly: their ``filled == 0`` row masks every cached position
    (nn/layers/attention.py), so live slots are never contaminated.
-3. **Evict** — requests that hit ``max_new_tokens`` (or ``eos_id``)
+4. **Evict** — requests that hit ``max_new_tokens`` (or ``eos_id``)
    free their slot without stalling the batch; the slot's rows are
    zeroed via the per-slot state reset
    (``rnn_clear_previous_state(slots=...)`` semantics,
    nn/streaming.py) and the next admission overwrites them.
 
-Compile-count guarantees (asserted in tests/test_serving_engine.py):
-ONE decode-step executable total, ONE admit executable total, and one
-prefill executable per pow2 prompt-length bucket — admission order,
-slot index, request length, and sampling config never retrace.
+Compile-count guarantees (asserted in tests/test_serving_engine.py and
+tests/test_serving_prefix_cache.py): ONE decode-step executable, ONE
+admit executable, ONE prefix-fetch and ONE prefix-store executable,
+ONE chunk-continuation executable per distinct suffix width (exactly
+one in chunked mode — every chunk is ``prefill_chunk`` wide; one per
+pow2 suffix bucket otherwise), and one cold-prefill executable per
+pow2 prompt bucket — admission order, slot index, request length,
+cache hits, and sampling config never retrace.
 """
 
 from __future__ import annotations
@@ -49,6 +65,7 @@ from deeplearning4j_tpu.nn.layers.attention import (
     guard_streamable,
 )
 from deeplearning4j_tpu.nn.streaming import clear_state_rows
+from deeplearning4j_tpu.serving.prefix_cache import RadixPrefixCache
 from deeplearning4j_tpu.serving.sampler import sample_tokens
 from deeplearning4j_tpu.serving.scheduler import (
     GenerationResult,
@@ -61,6 +78,28 @@ from deeplearning4j_tpu.serving.scheduler import (
 class _Slot:
     request: Request
     tokens: List[int]
+    prefix_reused: int = 0
+    ttft_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _Pending:
+    """An admission in flight: the slot is reserved, the suffix is
+    part-way through (chunked) prefill, and ``rnn`` carries the B=1
+    streaming state accumulated so far (None before the first cold
+    chunk; the fetched prefix state on a cache hit)."""
+
+    request: Request
+    slot: int
+    rnn: Any
+    tok: Any                      # last chunk's sampled token, [1]
+    done: int                     # suffix tokens already prefilled
+    matched: int                  # prompt tokens reused from the cache
+    hit: Any                      # PrefixHit lease to release, or None
+
+    @property
+    def remaining(self) -> int:
+        return len(self.request.prompt) - self.matched - self.done
 
 
 def _lm_shape_of(net):
@@ -112,13 +151,29 @@ class DecodeEngine:
 
     ``decode_chunk`` is the continuous-batching granularity: the batch
     advances that many tokens per dispatch (amortizing host round
-    trips) and admissions/evictions happen at chunk boundaries. An
-    optional ``profiler.tracer.Tracer`` receives prefill/admit/decode
-    spans plus ``serving_tokens_per_sec`` and ``slot_occupancy``
-    counters."""
+    trips) and admissions/evictions happen at chunk boundaries.
+
+    ``prefix_cache_rows > 0`` enables the radix prefix cache (a second
+    device pool of that many KV rows; serving/prefix_cache.py):
+    admissions reuse the longest cached prefix of their prompt and
+    prefill only the suffix. ``prefill_chunk > 0`` enables chunked
+    (non-blocking) admission: suffix prefill runs in fixed-width chunks
+    between decode rounds, paced by ``admission_policy`` ("ttft" or
+    "decode") and ``prefill_budget`` (tokens per round; see
+    ``Scheduler.plan_chunks``). Both default off, which is bit-for-bit
+    the original blocking engine.
+
+    An optional ``profiler.tracer.Tracer`` receives prefill/admit/
+    decode/prefix-fetch spans plus per-round counters (admitted,
+    evicted, prefix hits/misses, chunks scheduled, tokens decoded,
+    occupancy, tokens/sec) so a serving run is observable without
+    print-debugging."""
 
     def __init__(self, net, n_slots: int = 8, decode_chunk: int = 8,
-                 min_prompt_bucket: int = 8, tracer=None, seed: int = 0):
+                 min_prompt_bucket: int = 8, tracer=None, seed: int = 0,
+                 prefix_cache_rows: int = 0, prefill_chunk: int = 0,
+                 admission_policy: str = "ttft",
+                 prefill_budget: Optional[int] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
@@ -150,11 +205,20 @@ class DecodeEngine:
             raise ValueError(
                 "DecodeEngine requires at least one attention layer")
         self.window = min(windows)
+        self.prefill_chunk = int(prefill_chunk)
         self.scheduler = Scheduler(self.window,
-                                   min_bucket=min_prompt_bucket)
+                                   min_bucket=min_prompt_bucket,
+                                   prefill_chunk=self.prefill_chunk,
+                                   prefill_budget=prefill_budget,
+                                   policy=admission_policy)
+        self.prefix_cache = (RadixPrefixCache(prefix_cache_rows)
+                             if prefix_cache_rows else None)
 
         self._key = jax.random.key(seed)
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
+        self._pending: List[_Pending] = []
+        self._reserved: set = set()       # slots held by _pending
+        self._submit_t: Dict[int, float] = {}
         self._pool = None                 # rnn-state pytree, [B, ...]
         self._toks = None                 # [B] int32 current tokens
         self._temps = np.zeros(self.n_slots, np.float32)
@@ -162,6 +226,8 @@ class DecodeEngine:
         self.stats: Dict[str, Any] = {
             "tokens_generated": 0, "requests_finished": 0,
             "decode_time_s": 0.0, "chunks": 0, "occupancy_sum": 0.0,
+            "admitted": 0, "evicted": 0, "prefill_tokens": 0,
+            "prefill_tokens_skipped": 0, "chunks_scheduled": 0,
         }
         self._build_jits()
 
@@ -169,13 +235,24 @@ class DecodeEngine:
     def _build_jits(self):
         forward, chunk = self._forward, self.decode_chunk
 
-        def prefill(params, state, x, mask, temp, top_k, key):
-            out, rnn = forward(params, state, x, mask, None)
+        def chunk_prefill(params, state, x, mask, rnn, temp, top_k,
+                          key):
+            # masked prefill resuming a carried cache (a prefix-cache
+            # hit's fetched state, or the previous chunk's): forward,
+            # then sample at each row's last VALID position
+            out, new_rnn = forward(params, state, x, mask, rnn)
             length = jnp.sum(mask.astype(jnp.int32), axis=1)
             probs = jnp.take_along_axis(
                 out, (length - 1)[:, None, None], axis=2)[:, :, 0]
             tok = sample_tokens(probs, temp, top_k, key)
-            return tok, rnn
+            return tok, new_rnn
+
+        def prefill(params, state, x, mask, temp, top_k, key):
+            # cold prefill = the continuation body with no carried
+            # cache (separate jit wrapper keeps its own executable
+            # cache, so compile_counts stays per-path)
+            return chunk_prefill(params, state, x, mask, None, temp,
+                                 top_k, key)
 
         def admit(pool, toks, rnn1, tok1, slot):
             def put(p, o):
@@ -201,19 +278,26 @@ class DecodeEngine:
             return pool, tok, jnp.swapaxes(seq, 0, 1)  # [B, chunk]
 
         self._prefill_jit = jax.jit(prefill)
+        self._chunk_jit = jax.jit(chunk_prefill)
         self._admit_jit = jax.jit(admit)
         self._decode_jit = jax.jit(decode)
 
     def compile_counts(self) -> Dict[str, int]:
         """Executable counts per jitted computation (the no-retrace
-        guarantee: decode and admit stay at 1; prefill equals the
-        number of distinct prompt-length buckets seen)."""
+        guarantee: decode, admit, prefix_fetch, and prefix_store stay
+        at 1; prefill equals the number of distinct cold prompt-length
+        buckets seen; chunk_prefill equals the number of distinct
+        suffix widths — exactly 1 in chunked mode)."""
         def n(f):
             return int(getattr(f, "_cache_size", lambda: -1)())
 
-        return {"prefill": n(self._prefill_jit),
-                "admit": n(self._admit_jit),
-                "decode": n(self._decode_jit)}
+        counts = {"prefill": n(self._prefill_jit),
+                  "chunk_prefill": n(self._chunk_jit),
+                  "admit": n(self._admit_jit),
+                  "decode": n(self._decode_jit)}
+        if self.prefix_cache is not None:
+            counts.update(self.prefix_cache.compile_counts())
+        return counts
 
     # -- request lifecycle ---------------------------------------------
     def submit(self, request: Request) -> int:
@@ -223,7 +307,9 @@ class DecodeEngine:
         if bad:
             raise ValueError(
                 f"prompt ids {bad[:4]} outside vocab [0, {self.vocab})")
-        return self.scheduler.submit(request)
+        rid = self.scheduler.submit(request)
+        self._submit_t[rid] = time.perf_counter()
+        return rid
 
     def _span(self, name, **args):
         if self.tracer is None:
@@ -241,29 +327,98 @@ class DecodeEngine:
         mask[0, :len(prompt)] = 1.0
         return jnp.asarray(x), jnp.asarray(mask)
 
-    def _admit_one(self, request: Request, slot: int, results):
-        bucket = self.scheduler.bucket_of(len(request.prompt))
-        x, mask = self._one_hot_prompt(request.prompt, bucket)
-        temp = jnp.asarray([request.temperature], jnp.float32)
-        top_k = jnp.asarray(
-            [request.top_k or self.vocab], jnp.int32)
-        with self._span("serving.prefill", bucket=bucket,
-                        prompt_len=len(request.prompt)):
-            tok, rnn1 = self._prefill_jit(
-                self.net.params, self.net.state, x, mask, temp, top_k,
-                self._next_key())
+    def _start_admission(self, request: Request, slot: int, results):
+        """Begin admitting ``request`` into ``slot``: look up the radix
+        prefix cache, fetch the matched prefix's state, and either
+        prefill the whole suffix now (blocking mode) or enqueue a
+        pending admission for chunk-by-chunk progress between decode
+        rounds (chunked mode)."""
+        rnn, matched, hit = None, 0, None
+        if self.prefix_cache is not None:
+            hit = self.prefix_cache.lookup(request.prompt)
+            if hit is not None:
+                matched = hit.matched
+                with self._span("serving.prefix_fetch", row=hit.row,
+                                matched=matched, drop=hit.drop):
+                    rnn = self.prefix_cache.fetch(hit)
+                self.stats["prefill_tokens_skipped"] += matched
+        pending = _Pending(request, slot, rnn, None, 0, matched, hit)
+        if self.prefill_chunk:
+            self._reserved.add(slot)
+            self._pending.append(pending)
+            return
+        # blocking mode: the whole suffix in ONE pow2-bucketed prefill
+        # (cold: the original admission path, bit for bit; warm: one
+        # continuation chunk at the suffix's bucket)
+        self._advance_prefill(pending, pending.remaining)
+        self._complete_admission(pending, results)
+
+    def _advance_prefill(self, pending: _Pending, max_tokens: int):
+        """Prefill the next ``<= max_tokens`` suffix tokens of a
+        pending admission, padded+masked to a fixed width so repeat
+        widths never retrace: ``prefill_chunk`` in chunked mode, the
+        pow2 suffix bucket in blocking mode."""
+        req = pending.request
+        lo = pending.matched + pending.done
+        seg = list(req.prompt[lo:lo + max_tokens])
+        width = (self.prefill_chunk
+                 or self.scheduler.bucket_of(len(seg)))
+        x, mask = self._one_hot_prompt(seg, width)
+        temp = jnp.asarray([req.temperature], jnp.float32)
+        top_k = jnp.asarray([req.top_k or self.vocab], jnp.int32)
+        if pending.rnn is None:
+            # first cold segment: no carried state yet — the bucketed
+            # cold-prefill executable establishes it
+            with self._span("serving.prefill", bucket=width,
+                            tokens=len(seg)):
+                tok, rnn = self._prefill_jit(
+                    self.net.params, self.net.state, x, mask, temp,
+                    top_k, self._next_key())
+        else:
+            with self._span("serving.prefill_chunk", width=width,
+                            tokens=len(seg), done=pending.done):
+                tok, rnn = self._chunk_jit(
+                    self.net.params, self.net.state, x, mask,
+                    pending.rnn, temp, top_k, self._next_key())
+        pending.rnn, pending.tok = rnn, tok
+        pending.done += len(seg)
+        self.stats["prefill_tokens"] += len(seg)
+        self.stats["chunks_scheduled"] += 1
+
+    def _complete_admission(self, pending: _Pending, results):
+        """Suffix fully prefilled: scatter the state + first token into
+        the slot pool, store the prompt's state in the prefix cache,
+        and release the hit lease."""
+        request, slot = pending.request, pending.slot
         if self._pool is None:
             self._pool = jax.tree_util.tree_map(
                 lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
-                                    a.dtype), rnn1)
+                                    a.dtype), pending.rnn)
             self._toks = jnp.zeros((self.n_slots,), jnp.int32)
         with self._span("serving.admit", slot=slot):
             self._pool, self._toks = self._admit_jit(
-                self._pool, self._toks, rnn1, tok,
+                self._pool, self._toks, pending.rnn, pending.tok,
                 jnp.asarray(slot, jnp.int32))
-        first = int(np.asarray(tok)[0])
-        state = _Slot(request, [first])
+        if self.prefix_cache is not None:
+            # release BEFORE insert: the fetched state is an immutable
+            # snapshot, and on a tight cache the freed row lets the
+            # insert evict the stale ancestor instead of declining
+            if pending.hit is not None:
+                self.prefix_cache.release(pending.hit)
+            self.prefix_cache.insert(request.prompt, pending.rnn)
+        self._reserved.discard(slot)
+        # fetch the first token BEFORE stamping TTFT: the value fetch
+        # is the sync point that forces the in-flight prefill/admit
+        # dispatches to completion (async dispatch would otherwise
+        # report host-side dispatch time as time-to-first-token)
+        first = int(np.asarray(pending.tok)[0])
+        submit_t = self._submit_t.pop(request.id, None)
+        ttft = (time.perf_counter() - submit_t
+                if submit_t is not None else None)
+        state = _Slot(request, [first], prefix_reused=pending.matched,
+                      ttft_s=ttft)
         self.stats["tokens_generated"] += 1
+        self.stats["admitted"] += 1
         if self._finished(state):
             self._finish(state, slot, results, evict=False)
         else:
@@ -291,7 +446,9 @@ class DecodeEngine:
         reason = "eos" if self._hit_eos(slot_state) else "length"
         results[req.id] = GenerationResult(
             id=req.id, tokens=list(slot_state.tokens),
-            finish_reason=reason, prompt_len=len(req.prompt))
+            finish_reason=reason, prompt_len=len(req.prompt),
+            prefix_tokens_reused=slot_state.prefix_reused,
+            ttft_s=slot_state.ttft_s)
         self.stats["requests_finished"] += 1
         self.scheduler.release(req.id)
         if evict:
@@ -303,17 +460,36 @@ class DecodeEngine:
             self._slots[slot] = None
             self._temps[slot] = 0.0
             self._top_ks[slot] = self.vocab
+            self.stats["evicted"] += 1
 
     # -- the serving loop ----------------------------------------------
     def run(self) -> Dict[int, GenerationResult]:
-        """Drain the queue: admit into free slots, decode in chunks,
+        """Drain the queue: admit into free slots (advancing chunked
+        prefills under the scheduler's round budget), decode in chunks,
         evict finished requests — until no work remains."""
         results: Dict[int, GenerationResult] = {}
-        while self.scheduler.pending or any(
-                s is not None for s in self._slots):
+        while (self.scheduler.pending or self._pending
+               or any(s is not None for s in self._slots)):
             for slot in range(self.n_slots):
-                if self._slots[slot] is None and self.scheduler.pending:
-                    self._admit_one(self.scheduler.pop(), slot, results)
+                if (self._slots[slot] is None
+                        and slot not in self._reserved
+                        and self.scheduler.pending):
+                    self._start_admission(self.scheduler.pop(), slot,
+                                          results)
+            if self._pending:
+                grants = self.scheduler.plan_chunks(
+                    [p.remaining for p in self._pending])
+                for i in grants:
+                    self._advance_prefill(self._pending[i],
+                                          self.prefill_chunk)
+                if self.tracer is not None:
+                    self.tracer.counter("serving_round_prefill_chunks",
+                                        len(grants))
+                finished = [p for p in self._pending
+                            if p.remaining == 0]
+                for p in finished:
+                    self._complete_admission(p, results)
+                    self._pending.remove(p)
             active = [i for i, s in enumerate(self._slots)
                       if s is not None]
             if not active:
@@ -345,7 +521,21 @@ class DecodeEngine:
             if self.tracer is not None:
                 self.tracer.counter("slot_occupancy", occ)
                 self.tracer.rate("serving_tokens_per_sec", emitted, dt)
+                self._emit_counters()
         return results
+
+    def _emit_counters(self) -> None:
+        """Mirror the engine's cumulative counters into the tracer
+        (one Chrome-trace counter track each) so a serving run is
+        observable from the trace alone."""
+        for key in ("admitted", "evicted", "chunks_scheduled",
+                    "tokens_generated", "prefill_tokens",
+                    "prefill_tokens_skipped"):
+            self.tracer.counter(f"serving_{key}", self.stats[key])
+        if self.prefix_cache is not None:
+            for key in ("hits", "misses", "evictions"):
+                self.tracer.counter(f"serving_prefix_{key}",
+                                    self.prefix_cache.stats[key])
 
     @property
     def mean_occupancy(self) -> float:

@@ -1,0 +1,356 @@
+"""Radix prefix cache: host-side trie over prompt token ids mapping
+matched prefixes to device-resident KV rows (ISSUE 2 tentpole).
+
+The serving observation (RadixAttention — SGLang, Zheng et al. 2023):
+real traffic shares long prompt prefixes (system prompts, few-shot
+templates), so the KV state of a prefix computed for one request can
+seed the next request's admission, leaving only the divergent *suffix*
+to prefill. This module owns both halves of that reuse:
+
+- **Host side** — a radix trie (path-compressed: edges carry token
+  runs, split on divergence) keyed by prompt token ids. Stored nodes
+  map a prefix to one row of the device pool, with LRU eviction over
+  unleased rows and ref-count leases that pin a row while an in-flight
+  admission still reads it.
+- **Device side** — a second fixed pool alongside the engine's slot
+  pool: one row per cached prefix, same pytree structure as the
+  network's streaming state (per attention layer ``k``/``v``/
+  ``filled``), allocated lazily from the first stored state. TWO jitted
+  executables move rows, each compiled exactly once (the engine's
+  bounded-compile-count invariant): ``prefix_store`` scatters a B=1
+  post-prefill state into a row (``dynamic_update_slice`` at a traced
+  row index), ``prefix_fetch`` gathers a row back to B=1
+  (``dynamic_slice``), rewinding ``drop`` trailing tokens in the same
+  program (``nn.streaming.drop_newest_tokens``).
+
+Why ``drop``: K/V at a position are projections of that token alone,
+so a stored state rewinds EXACTLY to any shorter prefix of itself.
+That serves two purposes. (1) A prompt that diverges ``m`` tokens into
+a cached entry still reuses those ``m`` tokens — the entry's divergent
+tail is rewound away — so the hit criterion is any-shared-prefix, not
+whole-stored-prompt. (2) Sampling a request's first token needs the
+logits at its LAST prompt position, which a cached state does not
+carry — so a lookup never consumes the whole prompt: an exact match
+rewinds one token and the engine re-streams the final prompt token as
+a one-token suffix, producing those logits on the regular suffix path.
+
+Leases and JAX immutability: fetched states are snapshots (device
+arrays are immutable — a later eviction/overwrite builds a NEW pool and
+cannot corrupt an earlier fetch). The lease exists for bookkeeping
+honesty: an admission that matched a prefix holds its row until the
+admission completes, so LRU eviction never recycles a row the engine
+still considers live (asserted in tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class PrefixHit:
+    """One successful lookup: ``matched`` prompt tokens are served from
+    cache row ``row`` (after rewinding ``drop`` trailing tokens); the
+    row stays leased until ``release``."""
+
+    row: int
+    matched: int
+    drop: int
+
+
+class _Node:
+    """Radix-trie node: ``edge`` is the token run from the parent,
+    ``depth`` the total prefix length here, ``row`` the device pool row
+    when this exact prefix is cached (structural nodes carry None)."""
+
+    __slots__ = ("edge", "children", "parent", "depth", "row",
+                 "last_use")
+
+    def __init__(self, edge: Tuple[int, ...], parent: "_Node | None",
+                 depth: int):
+        self.edge = edge
+        self.children: Dict[int, _Node] = {}
+        self.parent = parent
+        self.depth = depth
+        self.row: Optional[int] = None
+        self.last_use = 0
+
+
+class RadixPrefixCache:
+    """Fixed-capacity prefix cache: ``rows`` device-resident KV rows
+    behind a radix trie over prompt token ids.
+
+    ``lookup`` returns the longest cached prefix of a prompt (capped at
+    ``len(prompt) - 1`` — see module docstring) and leases its row;
+    ``fetch`` copies the row to a B=1 streaming state; ``insert``
+    stores a post-prefill state under its full prompt, evicting the
+    least-recently-used unleased row when full (declining, not
+    evicting, when every row is leased). All device movement happens in
+    two jitted executables compiled once each."""
+
+    def __init__(self, rows: int):
+        if rows < 1:
+            raise ValueError(f"prefix cache rows {rows} < 1")
+        self.rows = int(rows)
+        self.pool = None                      # [rows, ...] pytree
+        self._root = _Node((), None, 0)
+        self._free: List[int] = list(range(self.rows))
+        self._by_row: Dict[int, _Node] = {}
+        self._ref: Dict[int, int] = {}
+        self._clock = 0
+        self.stats: Dict[str, int] = {
+            "hits": 0, "misses": 0, "inserts": 0, "evictions": 0,
+            "declined": 0, "tokens_matched": 0,
+        }
+        self._build_jits()
+
+    # -- jitted row movement (one executable each) ---------------------
+    def _build_jits(self):
+        from deeplearning4j_tpu.nn.streaming import drop_newest_tokens
+
+        def fetch(pool, row, drop):
+            one = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, row, 1,
+                                                       axis=0), pool)
+            return drop_newest_tokens(one, drop)
+
+        def store(pool, rnn1, row):
+            def put(p, o):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    p, o.astype(p.dtype), row, axis=0)
+
+            return jax.tree_util.tree_map(put, pool, rnn1)
+
+        self._fetch_jit = jax.jit(fetch)
+        self._store_jit = jax.jit(store)
+
+    def compile_counts(self) -> Dict[str, int]:
+        def n(f):
+            return int(getattr(f, "_cache_size", lambda: -1)())
+
+        return {"prefix_fetch": n(self._fetch_jit),
+                "prefix_store": n(self._store_jit)}
+
+    # -- trie ----------------------------------------------------------
+    def _walk(self, tokens: Tuple[int, ...]):
+        """Descend as far as whole edges match ``tokens``; returns the
+        final fully-matched (node, depth)."""
+        node, depth = self._root, 0
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                break
+            n = len(child.edge)
+            if (len(tokens) - depth < n
+                    or tokens[depth:depth + n] != child.edge):
+                break  # tokens end or diverge inside the edge
+            node, depth = child, depth + n
+        return node, depth
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.last_use = self._clock
+
+    def _shallowest_stored(self, node: _Node) -> Optional[_Node]:
+        """Closest stored node at or below ``node`` (the one needing
+        the smallest rewind when its subtree shares a prefix with a
+        query that diverged above it)."""
+        frontier = [node]
+        best: Optional[_Node] = None
+        while frontier:
+            nd = frontier.pop()
+            if nd.row is not None:
+                if best is None or nd.depth < best.depth:
+                    best = nd
+                continue  # anything below is deeper still
+            frontier.extend(nd.children.values())
+        return best
+
+    def lookup(self, prompt: Sequence[int]) -> Optional[PrefixHit]:
+        """Longest reusable cached prefix of ``prompt``; leases the row
+        (pair every hit with ``release``).
+
+        A stored state need not BE a prefix of the prompt to serve it:
+        when the prompt diverges ``m`` tokens into a cached entry (or
+        ends inside it), ``fetch`` rewinds the entry's trailing
+        ``depth - m`` tokens (``drop_newest_tokens`` — K/V are
+        per-token, so the rewound state is exactly the state after
+        ``prompt[:m]``). That makes the hit criterion RadixAttention's:
+        any shared prefix with anything cached, not just whole stored
+        prompts. Returns None on miss, or when the reusable part is
+        empty (a 1-token prompt can never hit: its first token's
+        logits must come from a real prefill)."""
+        tokens = tuple(int(t) for t in prompt)
+        node, depth = self._root, 0
+        best: Optional[_Node] = None      # stored node to fetch from
+        best_m = 0                        # prompt tokens it covers
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                break
+            limit = min(len(child.edge), len(tokens) - depth)
+            common = 0
+            while (common < limit
+                   and child.edge[common] == tokens[depth + common]):
+                common += 1
+            if common == len(child.edge):
+                node, depth = child, depth + common
+                if node.row is not None:
+                    best, best_m = node, node.depth
+                continue
+            # query diverged (or ended) inside the edge: every stored
+            # node under `child` shares exactly depth+common tokens
+            if common and depth + common > best_m:
+                cand = self._shallowest_stored(child)
+                if cand is not None:
+                    best, best_m = cand, depth + common
+            break
+        else:
+            child = None
+        if child is None and depth > best_m:
+            # the walk ended at a node boundary (no continuing edge, or
+            # the query ran out): every stored node under `node` —
+            # siblings diverging here, or longer prompts extending the
+            # query — shares exactly `depth` tokens with the query
+            cand = self._shallowest_stored(node)
+            if cand is not None:
+                best, best_m = cand, depth
+        if best is not None:
+            matched = min(best_m, len(tokens) - 1)
+            if matched >= 1:
+                self._touch(best)
+                self._ref[best.row] = self._ref.get(best.row, 0) + 1
+                self.stats["hits"] += 1
+                self.stats["tokens_matched"] += matched
+                return PrefixHit(row=best.row, matched=matched,
+                                 drop=best.depth - matched)
+        self.stats["misses"] += 1
+        return None
+
+    def fetch(self, hit: PrefixHit):
+        """Jitted gather: cache row -> B=1 streaming state, rewound by
+        ``hit.drop`` tokens."""
+        return self._fetch_jit(self.pool,
+                               jnp.asarray(hit.row, jnp.int32),
+                               jnp.asarray(hit.drop, jnp.int32))
+
+    def release(self, hit: PrefixHit) -> None:
+        """Drop the lease taken by ``lookup`` (the row becomes
+        evictable again once unreferenced)."""
+        left = self._ref.get(hit.row, 0) - 1
+        if left > 0:
+            self._ref[hit.row] = left
+        else:
+            self._ref.pop(hit.row, None)
+
+    def _evict_lru(self) -> Optional[int]:
+        victims = [nd for row, nd in self._by_row.items()
+                   if self._ref.get(row, 0) == 0]
+        if not victims:
+            return None
+        node = min(victims, key=lambda nd: nd.last_use)
+        row = node.row
+        node.row = None
+        del self._by_row[row]
+        self.stats["evictions"] += 1
+        # prune now-empty leaf chains so the trie stays proportional to
+        # what is actually cached
+        while (node.parent is not None and node.row is None
+               and not node.children):
+            parent = node.parent
+            del parent.children[node.edge[0]]
+            node = parent
+        return row
+
+    def _alloc_row(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        return self._evict_lru()
+
+    def insert(self, prompt: Sequence[int], rnn1: Any) -> bool:
+        """Store a B=1 post-prefill state under its full prompt.
+        Duplicate prompts refresh LRU only; a full cache with every row
+        leased declines (never blocks, never evicts a leased row)."""
+        tokens = tuple(int(t) for t in prompt)
+        if not tokens:
+            return False
+        node, depth = self._walk(tokens)
+        if depth == len(tokens) and node.row is not None:
+            self._touch(node)  # already cached: refresh recency
+            return False
+        row = self._alloc_row()
+        if row is None:
+            self.stats["declined"] += 1
+            return False
+        # re-walk AFTER allocation: evicting the LRU row may have
+        # pruned nodes on the first walk's path — grafting from the
+        # stale node would extend a detached subtree (unreachable
+        # entry now, corrupted prune bookkeeping later)
+        node, depth = self._walk(tokens)
+        if self.pool is None:
+            self.pool = jax.tree_util.tree_map(
+                lambda a: jnp.zeros((self.rows,) + a.shape[1:],
+                                    a.dtype), rnn1)
+        self.pool = self._store_jit(self.pool, rnn1,
+                                    jnp.asarray(row, jnp.int32))
+        node = self._graft(node, depth, tokens)
+        node.row = row
+        self._by_row[row] = node
+        self._touch(node)
+        self.stats["inserts"] += 1
+        return True
+
+    def _graft(self, node: _Node, depth: int,
+               tokens: Tuple[int, ...]) -> _Node:
+        """Extend the trie from ``node`` (which matched ``tokens`` up
+        to ``depth``) until a node for the full prompt exists, splitting
+        a partially-shared edge at the divergence point."""
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                leaf = _Node(tokens[depth:], node, len(tokens))
+                node.children[tokens[depth]] = leaf
+                return leaf
+            common = 0
+            limit = min(len(child.edge), len(tokens) - depth)
+            while (common < limit
+                   and child.edge[common] == tokens[depth + common]):
+                common += 1
+            if common == len(child.edge):
+                node, depth = child, depth + common
+                continue
+            # split child's edge at the divergence (or at prompt end)
+            mid = _Node(child.edge[:common], node, node.depth + common)
+            child.edge = child.edge[common:]
+            child.parent = mid
+            mid.children[child.edge[0]] = child
+            node.children[tokens[depth]] = mid
+            node, depth = mid, depth + common
+        return node
+
+    # -- introspection -------------------------------------------------
+    @property
+    def hit_rate(self) -> float:
+        seen = self.stats["hits"] + self.stats["misses"]
+        return self.stats["hits"] / seen if seen else 0.0
+
+    def cached_prefixes(self) -> List[Tuple[int, ...]]:
+        """Every stored prefix (tests/debugging)."""
+        out: List[Tuple[int, ...]] = []
+
+        def rec(node, prefix):
+            prefix = prefix + node.edge
+            if node.row is not None:
+                out.append(prefix)
+            for child in node.children.values():
+                rec(child, prefix)
+
+        rec(self._root, ())
+        return sorted(out)
+
+    def leased_rows(self) -> Dict[int, int]:
+        return dict(self._ref)

@@ -2,10 +2,12 @@
 
 The scheduler owns everything host-side about a request's lifecycle
 BEFORE it holds a slot: validation against the cache window, FIFO
-ordering, and the pow2 prompt-length bucketing that bounds prefill
+ordering, the pow2 prompt-length bucketing that bounds prefill
 compilations (one XLA executable per bucket, O(log window) buckets
-total, instead of one per distinct prompt length).
-"""
+total, instead of one per distinct prompt length), and — with chunked
+prefill enabled — the per-round token budget that decides how much
+prefill work may run between two decode rounds (the Sarathi-Serve
+stall-vs-TTFT tradeoff, Agrawal et al. 2024)."""
 
 from __future__ import annotations
 
@@ -50,12 +52,17 @@ class Request:
 @dataclasses.dataclass
 class GenerationResult:
     """A finished request: generated ids (prompt excluded) and why it
-    stopped ('length' or 'eos')."""
+    stopped ('length' or 'eos'). ``prefix_tokens_reused`` counts prompt
+    tokens served from the radix prefix cache instead of prefilled;
+    ``ttft_s`` is submit-to-first-token wall time (None when the engine
+    predates the request's submit, e.g. hand-built results)."""
 
     id: int
     tokens: List[int]
     finish_reason: str
     prompt_len: int
+    prefix_tokens_reused: int = 0
+    ttft_s: Optional[float] = None
 
 
 class Scheduler:
@@ -66,9 +73,31 @@ class Scheduler:
     slide out before decoding starts), so it is rejected at submit
     time rather than silently truncated."""
 
-    def __init__(self, max_prompt_len: int, min_bucket: int = 8):
+    #: valid chunked-prefill scheduling policies (see ``plan_chunks``)
+    POLICIES = ("ttft", "decode")
+
+    def __init__(self, max_prompt_len: int, min_bucket: int = 8,
+                 prefill_chunk: int = 0,
+                 prefill_budget: Optional[int] = None,
+                 policy: str = "ttft"):
         self.max_prompt_len = int(max_prompt_len)
         self.min_bucket = int(min_bucket)
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"admission policy {policy!r}: expected one of "
+                f"{self.POLICIES}")
+        if prefill_chunk < 0:
+            raise ValueError(f"prefill_chunk {prefill_chunk} < 0")
+        self.policy = policy
+        self.prefill_chunk = int(prefill_chunk)
+        if prefill_budget is None:
+            # decode-priority: ONE chunk between decode rounds — the
+            # minimum that still makes admission progress, so a running
+            # slot never stalls longer than one chunk. ttft-priority:
+            # 4 chunks' worth, front-loaded on the oldest admission.
+            prefill_budget = (self.prefill_chunk if policy == "decode"
+                              else 4 * self.prefill_chunk)
+        self.prefill_budget = int(prefill_budget)
         self._queue: Deque[Request] = deque()
         self._ids = itertools.count()
         self._issued = set()
@@ -107,6 +136,39 @@ class Scheduler:
         queued/in-flight requests (bounded memory over a long-lived
         engine) while still rejecting concurrent duplicate ids."""
         self._issued.discard(request_id)
+
+    def plan_chunks(self, remaining: Sequence[int]) -> List[int]:
+        """Grant prefill chunks for one scheduling round.
+
+        ``remaining`` is the suffix-tokens-left count per in-flight
+        admission, oldest first. Returns indices into ``remaining``,
+        one entry per granted chunk, in execution order. Grants go to
+        the oldest admission until its suffix is done, then the next
+        (finishing one TTFT beats starting many), each grant costing a
+        full ``prefill_chunk`` of budget (a padded partial chunk costs
+        chunk-shaped compute — budget tracks the stall, not the
+        tokens). The budget floors at one chunk so a round always makes
+        admission progress:
+
+        - ``decode`` priority: budget == one chunk — between two decode
+          rounds at most ONE prefill chunk runs, so the decode stall of
+          any admission is bounded by one chunk (the engine's
+          non-blocking-admission guarantee).
+        - ``ttft`` priority: budget defaults to 4 chunks — admissions
+          reach their first token up to 4x sooner per round at the cost
+          of a longer decode gap."""
+        if not remaining or self.prefill_chunk < 1:
+            return []
+        budget = max(self.prefill_budget, self.prefill_chunk)
+        grants: List[int] = []
+        for i, left in enumerate(remaining):
+            while left > 0 and budget >= self.prefill_chunk:
+                grants.append(i)
+                left -= min(self.prefill_chunk, left)
+                budget -= self.prefill_chunk
+            if budget < self.prefill_chunk:
+                break
+        return grants
 
     @property
     def pending(self) -> int:

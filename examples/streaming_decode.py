@@ -14,6 +14,12 @@ three ways, fastest first:
    compiled batched decode step over a pool of KV-cache slots
    (continuous batching); each request's greedy ids are identical to
    its own solo ``generate()`` call.
+4. **Warm admission** — the same engine family with the radix prefix
+   cache + chunked prefill (``prefix_cache_rows``/``prefill_chunk``):
+   requests sharing a system prompt admit by fetching the cached
+   prefix KV state and prefilling only their suffix, in chunks
+   interleaved with decode rounds — same greedy ids, a fraction of the
+   prefill work (the counters printed at the end show the reuse).
 
 Run: python examples/streaming_decode.py
 """
@@ -100,6 +106,42 @@ def main():
         print(f"engine req {rid} (prompt {k} toks): {result.tokens}")
     print("engine == solo generate per request:", ok)
     print("engine compile counts:", engine.compile_counts())
+
+    # Shared-system-prompt serving: every request carries the same
+    # long "system prompt" followed by a short user-specific tail —
+    # the workload the radix prefix cache exists for. The first
+    # admission prefills the whole prompt (cold, in chunks between
+    # decode rounds so neighbours never stall); every later admission
+    # fetches the shared prefix's KV rows from the cache and prefills
+    # ONLY its tail. Greedy ids stay identical to solo generate().
+    warm = DecodeEngine(net, n_slots=4, decode_chunk=4,
+                        prefix_cache_rows=4, prefill_chunk=8)
+    system_prompt = (PATTERN * 3)[:20]
+    tails = [[t] for t in range(5)] + [[2, 4], [6, 0, 1]]
+    warm_reqs = {
+        warm.submit(Request(prompt=system_prompt + tail,
+                            max_new_tokens=8)): tail
+        for tail in tails
+    }
+    warm_results = warm.run()
+    ok = True
+    for rid, result in sorted(warm_results.items()):
+        prompt = system_prompt + warm_reqs[rid]
+        net.rnn_clear_previous_state()
+        solo = np.asarray(net.generate(
+            one_hot_seq(prompt), 8))[0].tolist()
+        ok &= result.tokens == solo
+        print(f"warm req {rid} (tail {warm_reqs[rid]}): reused "
+              f"{result.prefix_tokens_reused}/{len(prompt)} prompt "
+              f"tokens, ttft {result.ttft_s * 1e3:.1f} ms")
+    print("warm engine == solo generate per request:", ok)
+    stats = warm.prefix_cache.stats
+    total_prompt = sum(len(system_prompt) + len(t) for t in tails)
+    print(f"prefix cache: {stats['hits']} hits / "
+          f"{stats['misses']} misses, "
+          f"{warm.stats['prefill_tokens_skipped']}/{total_prompt} "
+          "prompt tokens served from cache")
+    print("warm compile counts:", warm.compile_counts())
 
 
 if __name__ == "__main__":

@@ -13,7 +13,10 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import contextlib
+
 import jax
+import pytest
 
 # The axon sitecustomize force-registers the TPU platform via
 # jax.config.update("jax_platforms", ...); override it back to CPU for
@@ -26,3 +29,45 @@ def pytest_configure(config):
         "markers",
         "slow: long soak variants excluded from the tier-1 budget "
         "(deselected via -m 'not slow')")
+
+
+def _compile_counts_of(target):
+    """Executable counts for a no-retrace target: a jitted callable
+    (``jax.jit`` cache size), anything exposing ``compile_counts()``
+    (DecodeEngine, RadixPrefixCache), or a zero-arg callable returning
+    a counts dict."""
+    if hasattr(target, "compile_counts"):
+        return dict(target.compile_counts())
+    if hasattr(target, "_cache_size"):
+        return {"jit": int(target._cache_size())}
+    if callable(target):
+        return dict(target())
+    raise TypeError(
+        f"assert_no_retrace target {target!r} is neither a jitted "
+        "callable, nor exposes compile_counts(), nor is a zero-arg "
+        "counts callable")
+
+
+@contextlib.contextmanager
+def _assert_no_retrace(*targets):
+    before = [_compile_counts_of(t) for t in targets]
+    yield
+    after = [_compile_counts_of(t) for t in targets]
+    assert after == before, (
+        "jit cache grew inside an assert_no_retrace block (a retrace "
+        f"slipped into a warmed path): {before} -> {after}")
+
+
+@pytest.fixture
+def assert_no_retrace():
+    """Context manager asserting that warmed jitted computations do not
+    compile new executables inside the block::
+
+        with assert_no_retrace(engine):          # compile_counts()
+            engine.run()
+        with assert_no_retrace(fn_jit, other):   # jax.jit callables
+            fn_jit(x)
+
+    The serving engine's bounded-compile-count invariant fails tier-1
+    through this helper, not just the on-chip bench gate."""
+    return _assert_no_retrace

@@ -107,9 +107,15 @@ class TestEndToEnd:
         assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-4)
 
     def test_train_on_properties_and_iris(self, tmp_path, capsys):
+        # seed pinned (and exercising the properties `seed` key): the
+        # driver's default 12345 init lands in a marginal basin on jax
+        # 0.4.37 CPU (0.80-0.83 accuracy, flaky vs the 0.85 gate);
+        # seed 0 converges to ~0.99 at 60 epochs, so a failure here
+        # means a real regression, not env noise
         props = tmp_path / "net.properties"
         props.write_text("layers=4,16,3\nactivation=tanh\n"
-                         "learning_rate=0.1\nupdater=nesterovs\n")
+                         "learning_rate=0.1\nupdater=nesterovs\n"
+                         "seed=0\n")
         model = str(tmp_path / "iris.zip")
         rc = main(["train", "--conf", str(props), "--input", "iris",
                    "--output", model, "--epochs", "60"])

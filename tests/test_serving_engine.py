@@ -95,19 +95,24 @@ class TestEngineParity:
 
 
 class TestRaggedAdmissionEviction:
-    def test_requests_join_and_leave_mid_flight(self):
+    def test_requests_join_and_leave_mid_flight(self,
+                                                assert_no_retrace):
         """Ragged prompt AND decode lengths on a small pool: short
         requests finish and free their slot while long ones keep
         decoding; late admissions join a half-decoded batch. Every
-        request must still match its solo run exactly."""
+        request must still match its solo run exactly — with zero
+        retraces once the first wave warmed all buckets."""
         cases = [([1, 2, 3], 3), ([4, 5, 6, 7, 8, 9, 10, 11, 1], 21),
                  ([7], 5), ([2, 9, 4, 6], 13), ([10, 10], 2),
                  ([0, 1, 2, 3, 4, 5], 8), ([8, 6, 4], 17)]
         eng = DecodeEngine(_net(seed=11), n_slots=3, decode_chunk=2,
                            seed=5)
-        ids = [eng.submit(Request(p, n)) for p, n in cases]
-        res = eng.run()
-        for rid, (p, n) in zip(ids, cases):
+        warm_ids = [eng.submit(Request(p, n)) for p, n in cases[:2]]
+        res = eng.run()  # warms decode/admit + both buckets
+        with assert_no_retrace(eng):
+            ids = [eng.submit(Request(p, n)) for p, n in cases[2:]]
+            res.update(eng.run())
+        for rid, (p, n) in zip(warm_ids + ids, cases):
             assert res[rid].tokens == _solo_generate(p, n, seed=11), (
                 f"request {rid} diverged from its solo decode")
         assert eng.stats["requests_finished"] == len(cases)
@@ -158,7 +163,8 @@ class TestRaggedAdmissionEviction:
 
 
 class TestCompileCounts:
-    def test_no_retrace_after_warmup_across_admissions(self):
+    def test_no_retrace_after_warmup_across_admissions(
+            self, assert_no_retrace):
         """The tentpole's compile guarantee: one decode executable,
         one admit executable, one prefill executable per prompt-length
         bucket — further admissions (any slot, any order, any length
@@ -173,11 +179,11 @@ class TestCompileCounts:
         assert warm["admit"] == 1
         assert warm["prefill"] == 2
         # same buckets, new lengths/slots/configs: no new executables
-        eng.submit(Request([5] * 7, 9, temperature=0.7, top_k=4))
-        eng.submit(Request([2] * 13, 3))
-        eng.submit(Request([8], 5))
-        eng.run()
-        assert eng.compile_counts() == warm
+        with assert_no_retrace(eng):
+            eng.submit(Request([5] * 7, 9, temperature=0.7, top_k=4))
+            eng.submit(Request([2] * 13, 3))
+            eng.submit(Request([8], 5))
+            eng.run()
 
     def test_generate_scan_is_bucketed(self):
         """Satellite: generate() keys its jit cache on the pow2 bucket
@@ -302,7 +308,7 @@ class TestPerSlotStateReset:
 
 @pytest.mark.slow
 class TestSoak:
-    def test_many_ragged_requests_soak(self):
+    def test_many_ragged_requests_soak(self, assert_no_retrace):
         """Long-running churn: 40 requests with varied prompt/decode
         lengths over 4 slots, every one parity-checked."""
         rng = np.random.default_rng(0)
@@ -310,8 +316,13 @@ class TestSoak:
                   int(rng.integers(1, 40))) for _ in range(40)]
         eng = DecodeEngine(_net(seed=13), n_slots=4, decode_chunk=4,
                            seed=1)
+        warm = [([i % V for i in range(n)], 2) for n in (8, 9, 17)]
+        for p, n in warm:  # one admission per bucket (8, 16, 32)
+            eng.submit(Request(p, n))
+        eng.run()
         ids = [eng.submit(Request(p, n)) for p, n in cases]
-        res = eng.run()
+        with assert_no_retrace(eng):
+            res = eng.run()
         for rid, (p, n) in zip(ids, cases):
             assert res[rid].tokens == _solo_generate(p, n, seed=13)
         counts = eng.compile_counts()
