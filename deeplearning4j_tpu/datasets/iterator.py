@@ -70,6 +70,22 @@ class DataSetIterator:
     def load_state_dict(self, state: dict) -> None:
         pass
 
+    def skip_batches(self, n: int) -> int:
+        """Advance past ``n`` batches without delivering them — the
+        replay primitive async wrappers use to restore an exactly-once
+        position (native_rt/iterator.py): rewind the base to a known
+        point, then skip what the consumer already trained on.
+        Default reads and discards; iterators with a seekable cursor
+        override with O(1) arithmetic (datasets/streaming.py). Returns
+        the number of batches actually skipped (short at end of
+        data)."""
+        skipped = 0
+        for _ in range(int(n)):
+            if self.next() is None:
+                break
+            skipped += 1
+        return skipped
+
 
 class BaseDataSetIterator(DataSetIterator):
     """Cursor-over-in-memory-arrays base (reference BaseDatasetIterator +

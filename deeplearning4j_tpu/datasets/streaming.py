@@ -251,6 +251,23 @@ class CifarBinStreamIterator(DataSetIterator):
         self._file_idx = 0
         self._row = 0
 
+    def skip_batches(self, n: int) -> int:
+        """Seek-based skip: batches never span files, so the cursor
+        advances with row arithmetic — no pixel is read (the async
+        wrapper's exactly-once replay stays O(1) per batch)."""
+        skipped = 0
+        for _ in range(int(n)):
+            while (self._file_idx < len(self.paths)
+                   and self._row >= self._rows_per_file[self._file_idx]):
+                self._file_idx += 1
+                self._row = 0
+            if self._file_idx >= len(self.paths):
+                break
+            avail = self._rows_per_file[self._file_idx] - self._row
+            self._row += min(self.batch, avail)
+            skipped += 1
+        return skipped
+
     def total_examples(self) -> int:
         return int(sum(self._rows_per_file))
 
@@ -322,6 +339,17 @@ class TokenSequenceFileIterator(DataSetIterator):
 
     def reset(self) -> None:
         self._cursor = 0
+
+    def skip_batches(self, n: int) -> int:
+        """Seek-based skip: pure cursor arithmetic over the fixed-row
+        file — no token is read."""
+        skipped = 0
+        for _ in range(int(n)):
+            if self._cursor >= self.n_seq:
+                break
+            self._cursor = min(self.n_seq, self._cursor + self.batch)
+            skipped += 1
+        return skipped
 
     def total_examples(self) -> int:
         return self.n_seq

@@ -7,10 +7,22 @@ LinkedBlockingQueue. Here the blocking queue is the native MPMC ring
 base iterator, parks them in a token table, and pushes the token; the
 consumer pops tokens — so the queue discipline (bounded, blocking,
 close-wakes-waiters) runs in C++ while batch payloads stay in Python.
+
+Exactly-once checkpointing (the ADVICE.md fix): the producer's cursor
+runs up to ``queue_size`` batches AHEAD of what training consumed, so
+snapshotting the base iterator's position (the old behaviour) silently
+skipped every in-ring batch on resume. The wrapper instead anchors the
+base's state at the start of counting (epoch start or last restore) and
+counts CONSUMED batches; ``state_dict`` is ``(anchor, consumed)`` and
+``load_state_dict`` rewinds the base to the anchor and replays
+``consumed`` batches via ``skip_batches`` (O(1) arithmetic for
+seekable iterators, read-and-discard otherwise) — the resumed stream
+continues at exactly the first untrained batch.
 """
 
 from __future__ import annotations
 
+import copy
 import threading
 from typing import Dict, Optional
 
@@ -32,13 +44,25 @@ class NativeAsyncDataSetIterator(DataSetIterator):
         self._base_lock = threading.Lock()
         self._producer: Optional[threading.Thread] = None
         self._producer_error: Optional[BaseException] = None
+        # exactly-once position: base state at the point counting
+        # started, plus batches CONSUMED (not produced) since then
+        self._anchor: dict = {}
+        self._consumed = 0
         self._start()
 
     # -- producer -------------------------------------------------------
     def _start(self, reset: bool = True) -> None:
         self._stop_producer()
         if reset:
-            self.base.reset()
+            # reset + anchor capture under the SAME lock hold: a stale
+            # producer that outlived the join timeout may still be
+            # inside base.next() — serializing on the lock means the
+            # reset applies after that in-flight advance and the
+            # anchor matches the true epoch start (never one batch in)
+            with self._base_lock:
+                self.base.reset()
+                self._anchor = copy.deepcopy(self.base.state_dict())
+            self._consumed = 0
         self._ring = RingBuffer(self.queue_size)
         self._table = {}
         self._producer_error = None
@@ -102,6 +126,7 @@ class NativeAsyncDataSetIterator(DataSetIterator):
             return None
         with self._table_lock:
             ds = self._table.pop(token)
+        self._consumed += 1
         return self._post(ds)
 
     def reset(self) -> None:
@@ -117,13 +142,30 @@ class NativeAsyncDataSetIterator(DataSetIterator):
         return self.base.total_outcomes()
 
     def state_dict(self) -> dict:
-        with self._base_lock:
-            return self.base.state_dict()
+        """Exactly-once position: the base state where counting began
+        plus the consumed-batch count. Deliberately NOT the base's
+        live cursor — the producer has prefetched up to ``queue_size``
+        batches past what training consumed, and those in-ring batches
+        must be replayed after resume, not skipped."""
+        return {"anchor": copy.deepcopy(self._anchor),
+                "consumed": self._consumed}
 
     def load_state_dict(self, state: dict) -> None:
         # Stop the producer BEFORE touching base state so an in-flight
         # next() cannot overwrite the restored cursor.
         self._stop_producer()
         with self._base_lock:
-            self.base.load_state_dict(state)
+            if "consumed" in state:
+                # rewind to the anchor, replay exactly what training
+                # consumed: the next delivered batch is the first one
+                # it never saw
+                self._anchor = copy.deepcopy(state["anchor"])
+                self.base.load_state_dict(
+                    copy.deepcopy(state["anchor"]))
+                self.base.skip_batches(int(state["consumed"]))
+                self._consumed = int(state["consumed"])
+            else:  # legacy checkpoint (pre-fix): raw base state
+                self.base.load_state_dict(state)
+                self._anchor = copy.deepcopy(self.base.state_dict())
+                self._consumed = 0
         self._start(reset=False)  # keep the restored position

@@ -27,6 +27,7 @@ class Tracer:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
+        self._cum: Dict[str, float] = {}
         self._t0 = time.perf_counter()
 
     def _us(self) -> float:
@@ -82,6 +83,18 @@ class Tracer:
         (serving/engine.py)."""
         self.counter(name, count / max(seconds, 1e-9))
 
+    def incr(self, name: str, delta: float = 1.0) -> None:
+        """Cumulative event counter: each call adds ``delta`` to the
+        track's running total and emits the new value, so sparse
+        events (the serving engine's deadline expiries, sheds,
+        quarantines, retries — serving/engine.py failure events) read
+        as monotone step functions in the trace without the caller
+        keeping its own totals."""
+        with self._lock:
+            self._cum[name] = self._cum.get(name, 0.0) + delta
+            value = self._cum[name]
+        self.counter(name, value)
+
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._events)
@@ -116,6 +129,7 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._cum.clear()
 
 
 class ProfilerIterationListener(IterationListener):

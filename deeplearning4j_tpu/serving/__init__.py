@@ -1,16 +1,25 @@
 """Serving subsystem: continuous-batching decode over slot-based KV
 caches (ISSUE 1 tentpole; the layer that multiplexes many concurrent
-requests onto one compiled batched decode step), plus the radix prefix
+requests onto one compiled batched decode step), the radix prefix
 cache and chunked-prefill admission that make admissions prefix-aware
-and non-blocking (ISSUE 2 tentpole)."""
+and non-blocking (ISSUE 2 tentpole), and the fault-tolerant runtime —
+deadlines, cancellation, load shedding, deterministic fault injection,
+and crash-safe snapshot/resume (ISSUE 3 tentpole)."""
 
 from deeplearning4j_tpu.serving.engine import DecodeEngine
+from deeplearning4j_tpu.serving.faults import (
+    FAULT_KINDS,
+    FaultEvent,
+    FaultPlan,
+    ManualClock,
+)
 from deeplearning4j_tpu.serving.prefix_cache import (
     PrefixHit,
     RadixPrefixCache,
 )
 from deeplearning4j_tpu.serving.sampler import sample_tokens
 from deeplearning4j_tpu.serving.scheduler import (
+    FINISH_REASONS,
     GenerationResult,
     Request,
     Scheduler,
@@ -18,7 +27,12 @@ from deeplearning4j_tpu.serving.scheduler import (
 
 __all__ = [
     "DecodeEngine",
+    "FAULT_KINDS",
+    "FINISH_REASONS",
+    "FaultEvent",
+    "FaultPlan",
     "GenerationResult",
+    "ManualClock",
     "PrefixHit",
     "RadixPrefixCache",
     "Request",

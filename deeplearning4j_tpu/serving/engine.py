@@ -7,8 +7,14 @@ requests — the continuous-batching pattern of modern inference stacks,
 grown out of the reference's streaming ``rnnTimeStep`` contract
 (SURVEY §1 L1).
 
-Dataflow per scheduling round:
+Dataflow per scheduling round (one ``step()``):
 
+0. **Failure handling** (ISSUE 3; every knob defaults off = the
+   bit-identical PR 2 engine) — requeue fault victims whose backoff
+   elapsed, apply the round's scheduled :class:`FaultPlan` events,
+   sweep deadlines/queue-timeouts (expired requests terminate wherever
+   they are: queued, mid-admission, or mid-decode — eviction reuses
+   the per-slot row-zeroing path, so neighbours never stall).
 1. **Admit** — while a slot is free and requests are queued, prefill
    the next prompt at batch 1 (right-padded to a pow2 length bucket,
    masked — streams identically to an unpadded prefill, see
@@ -27,34 +33,56 @@ Dataflow per scheduling round:
    BETWEEN decode rounds under the scheduler's per-round token budget
    (``Scheduler.plan_chunks``; policy knob ``decode``- vs
    ``ttft``-priority), so a long prompt never stalls running slots
-   longer than the budget — one chunk, under decode priority.
+   longer than the budget — one chunk, under decode priority. With
+   ``adaptive_prefill=True`` the budget steps down/up with queue
+   pressure (``Scheduler.adapt_budget``) so decode latency degrades
+   smoothly under overload instead of cliffing.
 3. **Decode** — ONE jitted ``lax.scan`` advances ALL slots
    ``decode_chunk`` tokens with the pool cache in the scan carry and
    sampling on device (serving/sampler.py). Idle slots ride along
    harmlessly: their ``filled == 0`` row masks every cached position
    (nn/layers/attention.py), so live slots are never contaminated.
-4. **Evict** — requests that hit ``max_new_tokens`` (or ``eos_id``)
+4. **Detect & quarantine** (``paranoid=True``) — ONE extra jitted
+   finiteness check over the pool + sampled ids (the single new
+   executable of the failure-handling layer). A non-finite slot is
+   quarantined: rows zeroed, poisoned prefix-cache entries
+   invalidated, the victim re-queued with capped retry + exponential
+   backoff (terminal ``finish_reason="fault"`` past the cap). Healthy
+   slots are bit-unaffected — the same row-independence that lets
+   idle slots ride along.
+5. **Evict** — requests that hit ``max_new_tokens`` (or ``eos_id``)
    free their slot without stalling the batch; the slot's rows are
    zeroed via the per-slot state reset
    (``rnn_clear_previous_state(slots=...)`` semantics,
    nn/streaming.py) and the next admission overwrites them.
 
-Compile-count guarantees (asserted in tests/test_serving_engine.py and
-tests/test_serving_prefix_cache.py): ONE decode-step executable, ONE
-admit executable, ONE prefix-fetch and ONE prefix-store executable,
-ONE chunk-continuation executable per distinct suffix width (exactly
-one in chunked mode — every chunk is ``prefill_chunk`` wide; one per
-pow2 suffix bucket otherwise), and one cold-prefill executable per
-pow2 prompt bucket — admission order, slot index, request length,
-cache hits, and sampling config never retrace.
+``snapshot()`` captures everything host-side (queue, per-slot request
+metadata + generated ids, RNG key, prefix-trie prefixes, retry state)
+as a plain dict; ``DecodeEngine.restore`` rebuilds the device-side KV
+state by re-prefilling the recorded tokens through the SAME chunked
+prefill path, so a restarted process finishes the same ids (greedy:
+bit-identical — asserted by the chaos gate in
+tests/test_serving_faults.py).
+
+Compile-count guarantees (asserted in tests/test_serving_engine.py,
+tests/test_serving_prefix_cache.py and tests/test_serving_faults.py):
+ONE decode-step executable, ONE admit executable, ONE prefix-fetch and
+ONE prefix-store executable, ONE health-check executable (paranoid mode
+only — the only addition of the failure layer), ONE chunk-continuation
+executable per distinct suffix width (exactly one in chunked mode —
+every chunk is ``prefill_chunk`` wide; one per pow2 suffix bucket
+otherwise), and one cold-prefill executable per pow2 prompt bucket —
+admission order, slot index, request length, cache hits, sampling
+config, faults, deadlines, and retries never retrace.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import functools
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -65,6 +93,7 @@ from deeplearning4j_tpu.nn.layers.attention import (
     guard_streamable,
 )
 from deeplearning4j_tpu.nn.streaming import clear_state_rows
+from deeplearning4j_tpu.serving.faults import FaultEvent, FaultPlan, poison_rows
 from deeplearning4j_tpu.serving.prefix_cache import RadixPrefixCache
 from deeplearning4j_tpu.serving.sampler import sample_tokens
 from deeplearning4j_tpu.serving.scheduler import (
@@ -80,6 +109,9 @@ class _Slot:
     tokens: List[int]
     prefix_reused: int = 0
     ttft_s: Optional[float] = None
+    #: prefix-cache row this admission fetched from (quarantine scrubs
+    #: it if the slot turns out poisoned), or None on a cold admission
+    hit_row: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -87,7 +119,9 @@ class _Pending:
     """An admission in flight: the slot is reserved, the suffix is
     part-way through (chunked) prefill, and ``rnn`` carries the B=1
     streaming state accumulated so far (None before the first cold
-    chunk; the fetched prefix state on a cache hit)."""
+    chunk; the fetched prefix state on a cache hit). ``seq`` is the
+    token sequence being prefilled — the request's prompt for a live
+    admission, prompt + generated ids for a snapshot-restore rebuild."""
 
     request: Request
     slot: int
@@ -96,10 +130,33 @@ class _Pending:
     done: int                     # suffix tokens already prefilled
     matched: int                  # prompt tokens reused from the cache
     hit: Any                      # PrefixHit lease to release, or None
+    seq: List[int] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.seq:
+            self.seq = [int(t) for t in self.request.prompt]
 
     @property
     def remaining(self) -> int:
-        return len(self.request.prompt) - self.matched - self.done
+        return len(self.seq) - self.matched - self.done
+
+
+def _request_dict(req: Request) -> Dict[str, Any]:
+    """Plain-dict form of a Request (snapshot wire format)."""
+    return {
+        "prompt": [int(t) for t in req.prompt],
+        "max_new_tokens": int(req.max_new_tokens),
+        "temperature": float(req.temperature),
+        "top_k": None if req.top_k is None else int(req.top_k),
+        "eos_id": None if req.eos_id is None else int(req.eos_id),
+        "id": req.id,
+        "deadline_s": req.deadline_s,
+        "queue_timeout_s": req.queue_timeout_s,
+    }
+
+
+def _request_from(d: Dict[str, Any]) -> Request:
+    return Request(**d)
 
 
 def _lm_shape_of(net):
@@ -145,7 +202,9 @@ class DecodeEngine:
     """Slot-multiplexed batched decoding for one LM-shaped network.
 
     Submit requests (``submit``), then ``run()`` drains queue + slots
-    and returns ``{request_id: GenerationResult}``. Greedy requests
+    and returns ``{request_id: GenerationResult}`` — or drive one
+    scheduling round at a time with ``step()`` to interleave
+    ``cancel()``/``snapshot()`` with progress. Greedy requests
     (temperature 0, the default) produce ids bit-identical to a
     sequential ``net.generate(prompt, n)`` call per request.
 
@@ -160,24 +219,94 @@ class DecodeEngine:
     (non-blocking) admission: suffix prefill runs in fixed-width chunks
     between decode rounds, paced by ``admission_policy`` ("ttft" or
     "decode") and ``prefill_budget`` (tokens per round; see
-    ``Scheduler.plan_chunks``). Both default off, which is bit-for-bit
-    the original blocking engine.
+    ``Scheduler.plan_chunks``).
+
+    Failure-handling knobs (ISSUE 3; ALL default off — the engine is
+    then bit-identical to the PR 2 engine):
+
+    - ``Request.deadline_s`` / ``Request.queue_timeout_s`` — per-
+      request end-to-end and queue-wait budgets; expiry terminates the
+      request wherever it is with partial tokens and
+      ``finish_reason="deadline"`` (or ``"shed"`` for queue timeout).
+    - ``cancel(rid)`` — terminate a queued, retrying, admitting, or
+      running request (``finish_reason="cancelled"``, partial tokens).
+    - ``max_queue`` + ``shed_policy`` ("reject-new" | "shed-oldest") —
+      bounded admission queue; the shed victim's result is
+      ``finish_reason="shed"``.
+    - ``adaptive_prefill`` — queue pressure (depth x estimated
+      suffix-prefill tokens) steps the per-round prefill budget
+      down/up (``Scheduler.adapt_budget``) so decode latency degrades
+      smoothly under overload.
+    - ``paranoid`` — per-round jitted finiteness check over the slot
+      pool (the failure layer's ONE new executable); non-finite slots
+      are quarantined and retried (``max_retries``, exponential
+      ``retry_backoff_rounds``), with poisoned prefix-cache entries
+      invalidated before the retry.
+    - ``fault_plan`` — a seeded :class:`FaultPlan` injecting NaN
+      slots, admission failures, stalls, and prefix-cache corruption
+      at chosen rounds (serving/faults.py), for chaos testing.
+    - ``stall_threshold_s`` — rounds slower than this count as
+      ``slow_steps`` (mirrored to the tracer).
+    - ``clock`` — injectable time source (``faults.ManualClock`` makes
+      deadline/stall tests deterministic); defaults to
+      ``time.perf_counter``.
+
+    ``snapshot()``/``DecodeEngine.restore()`` round-trip the full
+    host-side state through a plain dict and rebuild device KV state
+    by re-prefilling recorded tokens — crash recovery that finishes
+    the same ids.
 
     An optional ``profiler.tracer.Tracer`` receives prefill/admit/
     decode/prefix-fetch spans plus per-round counters (admitted,
     evicted, prefix hits/misses, chunks scheduled, tokens decoded,
-    occupancy, tokens/sec) so a serving run is observable without
-    print-debugging."""
+    occupancy, tokens/sec) and cumulative failure-event tracks
+    (``serving_deadline_expired``, ``serving_shed``,
+    ``serving_cancelled``, ``serving_faults_injected``,
+    ``serving_faults_detected``, ``serving_quarantined``,
+    ``serving_retries``, ``serving_retry_failures``,
+    ``serving_slow_steps``) so a serving run — and its failures — are
+    observable without print-debugging."""
+
+    #: valid shed policies for a full admission queue: reject the new
+    #: arrival, or shed the oldest queued request in its favour
+    SHED_POLICIES = ("reject-new", "shed-oldest")
+
+    #: stats keys that count failure events (each mirrors into a
+    #: cumulative tracer track named ``serving_<key>``)
+    FAILURE_KEYS = ("deadline_expired", "queue_timeouts", "cancelled",
+                    "shed", "faults_injected", "faults_detected",
+                    "quarantined", "retries", "retry_failures",
+                    "slow_steps")
 
     def __init__(self, net, n_slots: int = 8, decode_chunk: int = 8,
                  min_prompt_bucket: int = 8, tracer=None, seed: int = 0,
                  prefix_cache_rows: int = 0, prefill_chunk: int = 0,
                  admission_policy: str = "ttft",
-                 prefill_budget: Optional[int] = None):
+                 prefill_budget: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 shed_policy: str = "reject-new",
+                 adaptive_prefill: bool = False,
+                 pressure_high: Optional[int] = None,
+                 pressure_low: Optional[int] = None,
+                 paranoid: bool = False,
+                 fault_plan: Optional[FaultPlan] = None,
+                 max_retries: int = 2,
+                 retry_backoff_rounds: int = 1,
+                 stall_threshold_s: Optional[float] = None,
+                 clock=None):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk {decode_chunk} < 1")
+        if shed_policy not in self.SHED_POLICIES:
+            raise ValueError(
+                f"shed_policy {shed_policy!r}: expected one of "
+                f"{self.SHED_POLICIES}")
+        if max_retries < 0:
+            raise ValueError(f"max_retries {max_retries} < 0")
+        if retry_backoff_rounds < 0:
+            raise ValueError(
+                f"retry_backoff_rounds {retry_backoff_rounds} < 0")
         net.init()
         self.net = net
         self.n_slots = int(n_slots)
@@ -210,9 +339,20 @@ class DecodeEngine:
                                    min_bucket=min_prompt_bucket,
                                    prefill_chunk=self.prefill_chunk,
                                    prefill_budget=prefill_budget,
-                                   policy=admission_policy)
+                                   policy=admission_policy,
+                                   max_queue=max_queue,
+                                   pressure_high=pressure_high,
+                                   pressure_low=pressure_low)
         self.prefix_cache = (RadixPrefixCache(prefix_cache_rows)
                              if prefix_cache_rows else None)
+        self.shed_policy = shed_policy
+        self.adaptive_prefill = bool(adaptive_prefill)
+        self.paranoid = bool(paranoid)
+        self.fault_plan = fault_plan
+        self.max_retries = int(max_retries)
+        self.retry_backoff_rounds = int(retry_backoff_rounds)
+        self.stall_threshold_s = stall_threshold_s
+        self._clock = clock if clock is not None else time.perf_counter
 
         self._key = jax.random.key(seed)
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
@@ -223,12 +363,24 @@ class DecodeEngine:
         self._toks = None                 # [B] int32 current tokens
         self._temps = np.zeros(self.n_slots, np.float32)
         self._top_ks = np.full(self.n_slots, self.vocab, np.int32)
+        self._round = 0
+        self._terminal: Dict[int, GenerationResult] = {}
+        self._retries: Dict[int, int] = {}
+        self._requeue: List[Tuple[int, Request]] = []  # (ready_round, req)
+        self._admit_fail_pending = 0
+        self._has_deadlines = False
+        #: ids whose admission has started at least once —
+        #: queue_timeout_s bounds time-to-FIRST-admission only, so a
+        #: fault-retried request waiting in the queue again is exempt
+        self._started: set = set()
         self.stats: Dict[str, Any] = {
             "tokens_generated": 0, "requests_finished": 0,
             "decode_time_s": 0.0, "chunks": 0, "occupancy_sum": 0.0,
             "admitted": 0, "evicted": 0, "prefill_tokens": 0,
             "prefill_tokens_skipped": 0, "chunks_scheduled": 0,
         }
+        for key in self.FAILURE_KEYS:
+            self.stats[key] = 0
         self._build_jits()
 
     # -- jitted computations (fixed executables; see module docstring) -
@@ -281,13 +433,32 @@ class DecodeEngine:
         self._chunk_jit = jax.jit(chunk_prefill)
         self._admit_jit = jax.jit(admit)
         self._decode_jit = jax.jit(decode)
+        self._health_jit = None
+        if self.paranoid:
+            vocab = self.vocab
+
+            def health(pool, toks):
+                # per-slot finiteness over every pool leaf + sampled-id
+                # range check: ONE masked reduction executable — the
+                # failure layer's only compile-count addition
+                def row_ok(a):
+                    fin = jnp.isfinite(a.astype(jnp.float32))
+                    return jnp.all(fin.reshape(a.shape[0], -1), axis=1)
+
+                oks = [row_ok(leaf)
+                       for leaf in jax.tree_util.tree_leaves(pool)]
+                ok = functools.reduce(jnp.logical_and, oks)
+                return ok & (toks >= 0) & (toks < vocab)
+
+            self._health_jit = jax.jit(health)
 
     def compile_counts(self) -> Dict[str, int]:
         """Executable counts per jitted computation (the no-retrace
-        guarantee: decode, admit, prefix_fetch, and prefix_store stay
-        at 1; prefill equals the number of distinct cold prompt-length
-        buckets seen; chunk_prefill equals the number of distinct
-        suffix widths — exactly 1 in chunked mode)."""
+        guarantee: decode, admit, prefix_fetch, prefix_store, and the
+        paranoid health_check stay at 1; prefill equals the number of
+        distinct cold prompt-length buckets seen; chunk_prefill equals
+        the number of distinct suffix widths — exactly 1 in chunked
+        mode)."""
         def n(f):
             return int(getattr(f, "_cache_size", lambda: -1)())
 
@@ -295,21 +466,73 @@ class DecodeEngine:
                   "chunk_prefill": n(self._chunk_jit),
                   "admit": n(self._admit_jit),
                   "decode": n(self._decode_jit)}
+        if self._health_jit is not None:
+            counts["health_check"] = n(self._health_jit)
         if self.prefix_cache is not None:
             counts.update(self.prefix_cache.compile_counts())
         return counts
 
     # -- request lifecycle ---------------------------------------------
     def submit(self, request: Request) -> int:
-        """Queue a request; returns its id (``run()`` drains)."""
+        """Queue a request; returns its id (``run()`` drains). With a
+        bounded queue (``max_queue``), a full queue sheds per
+        ``shed_policy``: the result for a shed request (this one under
+        "reject-new", the oldest queued one under "shed-oldest") is
+        delivered with ``finish_reason="shed"`` at the next
+        ``run()``/``step()`` drain."""
         bad = [t for t in request.prompt
                if not 0 <= int(t) < self.vocab]
         if bad:
             raise ValueError(
                 f"prompt ids {bad[:4]} outside vocab [0, {self.vocab})")
+        self.scheduler.validate(request)
+        if self.scheduler.full:
+            if self.shed_policy == "reject-new":
+                rid = self.scheduler.assign_id(request)
+                self._submit_t[rid] = self._clock()
+                self._shed(request)
+                return rid
+            self._shed(self.scheduler.pop())
         rid = self.scheduler.submit(request)
-        self._submit_t[rid] = time.perf_counter()
+        self._submit_t[rid] = self._clock()
+        if (request.deadline_s is not None
+                or request.queue_timeout_s is not None):
+            self._has_deadlines = True
         return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Terminate a request wherever it is — queued, waiting out a
+        retry backoff, mid-admission, or decoding in a slot. Running
+        requests return their partial tokens; the result
+        (``finish_reason="cancelled"``) is delivered at the next
+        ``run()``/``step()`` drain. Returns False when the id is
+        unknown or already terminal."""
+        req = self.scheduler.remove(request_id)
+        if req is not None:
+            self._record_terminal(req, [], "cancelled")
+            self._failure_event("cancelled")
+            return True
+        for i, (_, queued) in enumerate(self._requeue):
+            if queued.id == request_id:
+                del self._requeue[i]
+                self._record_terminal(queued, [], "cancelled")
+                self._failure_event("cancelled")
+                return True
+        for pending in list(self._pending):
+            if pending.request.id == request_id:
+                self._abort_pending(pending)
+                self._record_terminal(pending.request, [], "cancelled")
+                self._failure_event("cancelled")
+                return True
+        for slot, state in enumerate(self._slots):
+            if state is not None and state.request.id == request_id:
+                self._record_terminal(
+                    state.request, state.tokens, "cancelled",
+                    state.prefix_reused, state.ttft_s)
+                self._failure_event("cancelled")
+                self._evict_slot(slot)
+                return True
+        return False
 
     def _span(self, name, **args):
         if self.tracer is None:
@@ -320,6 +543,51 @@ class DecodeEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _failure_event(self, kind: str) -> None:
+        self.stats[kind] += 1
+        if self.tracer is not None:
+            self.tracer.incr(f"serving_{kind}")
+
+    def _record_terminal(self, request: Request, tokens, reason: str,
+                         prefix_reused: int = 0,
+                         ttft: Optional[float] = None) -> None:
+        """Write a request's terminal result (drained into the caller's
+        dict by the next ``step()``), and drop every piece of host
+        bookkeeping keyed by its id."""
+        self._terminal[request.id] = GenerationResult(
+            id=request.id, tokens=list(tokens), finish_reason=reason,
+            prompt_len=len(request.prompt),
+            prefix_tokens_reused=prefix_reused, ttft_s=ttft,
+            retries=self._retries.pop(request.id, 0))
+        self.stats["requests_finished"] += 1
+        self._submit_t.pop(request.id, None)
+        self._started.discard(request.id)
+        self.scheduler.release(request.id)
+
+    def _shed(self, request: Request) -> None:
+        self._record_terminal(request, [], "shed")
+        self._failure_event("shed")
+
+    def _abort_pending(self, pending: _Pending) -> None:
+        """Drop an in-flight admission (cancel/deadline): release the
+        prefix-cache lease and free the reserved slot."""
+        if pending.hit is not None and self.prefix_cache is not None:
+            self.prefix_cache.release(pending.hit)
+        self._reserved.discard(pending.slot)
+        self._pending.remove(pending)
+
+    def _evict_slot(self, slot: int) -> None:
+        """Zero the slot's rows (per-slot eviction — the whole-pool
+        analogue of ``rnn_clear_previous_state(slots=[slot])``); the
+        next admission overwrites them. This keeps stale K/V from ever
+        being observable, and doubles as quarantine: a zeroed row is
+        finite and masked, so a poisoned slot stops existing."""
+        self._pool = clear_state_rows(self._pool, [slot])
+        self._slots[slot] = None
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = self.vocab
+        self.stats["evicted"] += 1
+
     def _one_hot_prompt(self, prompt, bucket):
         x = np.zeros((1, self.vocab, bucket), np.float32)
         x[0, list(prompt), np.arange(len(prompt))] = 1.0
@@ -327,12 +595,13 @@ class DecodeEngine:
         mask[0, :len(prompt)] = 1.0
         return jnp.asarray(x), jnp.asarray(mask)
 
-    def _start_admission(self, request: Request, slot: int, results):
+    def _start_admission(self, request: Request, slot: int):
         """Begin admitting ``request`` into ``slot``: look up the radix
         prefix cache, fetch the matched prefix's state, and either
         prefill the whole suffix now (blocking mode) or enqueue a
         pending admission for chunk-by-chunk progress between decode
         rounds (chunked mode)."""
+        self._started.add(request.id)
         rnn, matched, hit = None, 0, None
         if self.prefix_cache is not None:
             hit = self.prefix_cache.lookup(request.prompt)
@@ -351,16 +620,16 @@ class DecodeEngine:
         # (cold: the original admission path, bit for bit; warm: one
         # continuation chunk at the suffix's bucket)
         self._advance_prefill(pending, pending.remaining)
-        self._complete_admission(pending, results)
+        self._complete_admission(pending)
 
     def _advance_prefill(self, pending: _Pending, max_tokens: int):
-        """Prefill the next ``<= max_tokens`` suffix tokens of a
-        pending admission, padded+masked to a fixed width so repeat
+        """Prefill the next ``<= max_tokens`` tokens of a pending
+        admission's sequence, padded+masked to a fixed width so repeat
         widths never retrace: ``prefill_chunk`` in chunked mode, the
-        pow2 suffix bucket in blocking mode."""
+        pow2 bucket of the segment in blocking mode."""
         req = pending.request
         lo = pending.matched + pending.done
-        seg = list(req.prompt[lo:lo + max_tokens])
+        seg = list(pending.seq[lo:lo + max_tokens])
         width = (self.prefill_chunk
                  or self.scheduler.bucket_of(len(seg)))
         x, mask = self._one_hot_prompt(seg, width)
@@ -385,7 +654,7 @@ class DecodeEngine:
         self.stats["prefill_tokens"] += len(seg)
         self.stats["chunks_scheduled"] += 1
 
-    def _complete_admission(self, pending: _Pending, results):
+    def _complete_admission(self, pending: _Pending):
         """Suffix fully prefilled: scatter the state + first token into
         the slot pool, store the prompt's state in the prefix cache,
         and release the hit lease."""
@@ -399,11 +668,13 @@ class DecodeEngine:
             self._pool, self._toks = self._admit_jit(
                 self._pool, self._toks, pending.rnn, pending.tok,
                 jnp.asarray(slot, jnp.int32))
+        hit_row = None
         if self.prefix_cache is not None:
             # release BEFORE insert: the fetched state is an immutable
             # snapshot, and on a tight cache the freed row lets the
             # insert evict the stale ancestor instead of declining
             if pending.hit is not None:
+                hit_row = pending.hit.row
                 self.prefix_cache.release(pending.hit)
             self.prefix_cache.insert(request.prompt, pending.rnn)
         self._reserved.discard(slot)
@@ -412,15 +683,15 @@ class DecodeEngine:
         # dispatches to completion (async dispatch would otherwise
         # report host-side dispatch time as time-to-first-token)
         first = int(np.asarray(pending.tok)[0])
-        submit_t = self._submit_t.pop(request.id, None)
-        ttft = (time.perf_counter() - submit_t
+        submit_t = self._submit_t.get(request.id)
+        ttft = (self._clock() - submit_t
                 if submit_t is not None else None)
         state = _Slot(request, [first], prefix_reused=pending.matched,
-                      ttft_s=ttft)
+                      ttft_s=ttft, hit_row=hit_row)
         self.stats["tokens_generated"] += 1
         self.stats["admitted"] += 1
         if self._finished(state):
-            self._finish(state, slot, results, evict=False)
+            self._finish(state, slot, evict=False)
         else:
             self._slots[slot] = state
             self._temps[slot] = request.temperature
@@ -438,62 +709,253 @@ class DecodeEngine:
             return True
         return self._hit_eos(slot_state)
 
-    def _finish(self, slot_state: _Slot, slot: int, results,
+    def _finish(self, slot_state: _Slot, slot: int,
                 evict: bool = True):
-        req = slot_state.request
         # eos wins even when it lands exactly on the max_new_tokens-th
         # token: the response terminated cleanly, not by truncation
         reason = "eos" if self._hit_eos(slot_state) else "length"
-        results[req.id] = GenerationResult(
-            id=req.id, tokens=list(slot_state.tokens),
-            finish_reason=reason, prompt_len=len(req.prompt),
-            prefix_tokens_reused=slot_state.prefix_reused,
-            ttft_s=slot_state.ttft_s)
-        self.stats["requests_finished"] += 1
-        self.scheduler.release(req.id)
+        self._record_terminal(slot_state.request, slot_state.tokens,
+                              reason, slot_state.prefix_reused,
+                              slot_state.ttft_s)
         if evict:
-            # zero the slot's rows (per-slot eviction — the whole-pool
-            # analogue of rnn_clear_previous_state(slots=[slot])); the
-            # next admission overwrites them, this keeps stale K/V from
-            # ever being observable
-            self._pool = clear_state_rows(self._pool, [slot])
-            self._slots[slot] = None
-            self._temps[slot] = 0.0
-            self._top_ks[slot] = self.vocab
-            self.stats["evicted"] += 1
+            self._evict_slot(slot)
+
+    # -- failure handling ----------------------------------------------
+    def _elapsed(self, request_id: int, now: float) -> Optional[float]:
+        t0 = self._submit_t.get(request_id)
+        return None if t0 is None else now - t0
+
+    def _sweep_deadlines(self) -> None:
+        """Expire deadlines/queue-timeouts wherever the request is.
+        Queued: removed before any device work. Mid-admission: the
+        reserved slot is freed and the lease released. Running: the
+        slot evicts via the normal row-zeroing path (neighbours keep
+        decoding), partial tokens are returned. No-op (and zero cost)
+        unless some submitted request carried a deadline."""
+        if not self._has_deadlines:
+            return
+        now = self._clock()
+        for req in self.scheduler.queued_requests():
+            el = self._elapsed(req.id, now)
+            if el is None:
+                continue
+            if req.deadline_s is not None and el > req.deadline_s:
+                self.scheduler.remove(req.id)
+                self._record_terminal(req, [], "deadline")
+                self._failure_event("deadline_expired")
+            elif (req.queue_timeout_s is not None
+                  and req.id not in self._started
+                  and el > req.queue_timeout_s):
+                # first-admission wait only: a fault-retried request
+                # back in the queue already started once — shedding it
+                # here would break the retry the quarantine promised
+                self.scheduler.remove(req.id)
+                self._shed(req)
+                self._failure_event("queue_timeouts")
+        for ready, req in list(self._requeue):
+            el = self._elapsed(req.id, now)
+            if (el is not None and req.deadline_s is not None
+                    and el > req.deadline_s):
+                self._requeue.remove((ready, req))
+                self._record_terminal(req, [], "deadline")
+                self._failure_event("deadline_expired")
+        for pending in list(self._pending):
+            el = self._elapsed(pending.request.id, now)
+            if (el is not None and pending.request.deadline_s is not None
+                    and el > pending.request.deadline_s):
+                self._abort_pending(pending)
+                self._record_terminal(pending.request, [], "deadline")
+                self._failure_event("deadline_expired")
+        for slot, state in enumerate(self._slots):
+            if state is None:
+                continue
+            el = self._elapsed(state.request.id, now)
+            if (el is not None and state.request.deadline_s is not None
+                    and el > state.request.deadline_s):
+                self._record_terminal(
+                    state.request, state.tokens, "deadline",
+                    state.prefix_reused, state.ttft_s)
+                self._failure_event("deadline_expired")
+                self._evict_slot(slot)
+
+    def _inject_faults(self) -> None:
+        if self.fault_plan is None:
+            return
+        for event in self.fault_plan.events_at(self._round):
+            self._inject(event)
+
+    def _inject(self, event: FaultEvent) -> None:
+        """Apply one scheduled fault. All injection is host-side (see
+        serving/faults.py) — compile counts cannot change. Events whose
+        target does not exist this round (no active slot to NaN, no
+        stored cache row to corrupt) are skipped and NOT recorded."""
+        if event.kind == "stall":
+            if hasattr(self._clock, "advance"):
+                self._clock.advance(event.seconds)
+            else:
+                time.sleep(event.seconds)
+        elif event.kind == "admit_fail":
+            self._admit_fail_pending += 1
+        elif event.kind == "nan":
+            slot = event.slot
+            if slot is None:
+                active = [i for i, s in enumerate(self._slots)
+                          if s is not None]
+                slot = active[0] if active else None
+            if (slot is None or slot >= self.n_slots
+                    or self._slots[slot] is None or self._pool is None):
+                return
+            self._pool = poison_rows(self._pool, [slot])
+        elif event.kind == "cache_corrupt":
+            if self.prefix_cache is None or self.prefix_cache.pool is None:
+                return
+            rows = self.prefix_cache.stored_rows()
+            row = event.row if event.row is not None else (
+                rows[0] if rows else None)
+            if row is None or row not in rows:
+                return
+            self.prefix_cache.pool = poison_rows(
+                self.prefix_cache.pool, [row])
+        self.fault_plan.record(event)
+        self._failure_event("faults_injected")
+
+    def _requeue_victim(self, request: Request) -> None:
+        """Schedule a fault victim's re-admission: capped retries with
+        exponential backoff (in rounds); past the cap the request
+        terminates with ``finish_reason="fault"``."""
+        attempts = self._retries.get(request.id, 0) + 1
+        if attempts > self.max_retries:
+            self._retries[request.id] = attempts - 1
+            self._record_terminal(request, [], "fault")
+            self._failure_event("retry_failures")
+            return
+        self._retries[request.id] = attempts
+        self._failure_event("retries")
+        ready = self._round + max(
+            1, self.retry_backoff_rounds * (2 ** (attempts - 1)))
+        self._requeue.append((ready, request))
+
+    def _drain_requeue(self) -> None:
+        if not self._requeue:
+            return
+        ready = [(r, q) for r, q in self._requeue if r <= self._round]
+        if not ready:
+            return
+        self._requeue = [(r, q) for r, q in self._requeue
+                         if r > self._round]
+        for _, req in ready:
+            self.scheduler.requeue(req)
+
+    def _quarantine(self, active: List[int]) -> List[int]:
+        """Paranoid sweep after decode: one jitted finiteness check
+        over the pool + sampled ids. Poisoned slots are evicted (rows
+        zeroed — the pool is finite again), their prefix-cache
+        footprint invalidated (both the row the admission fetched from
+        and the entry it inserted, since either end may carry the
+        corruption), and the victim re-queued. Returns the healthy
+        subset of ``active`` — the poisoned round's tokens never reach
+        a result."""
+        ok = np.asarray(self._health_jit(self._pool, self._toks))
+        healthy = [s for s in active if bool(ok[s])]
+        for slot in active:
+            if bool(ok[slot]):
+                continue
+            state = self._slots[slot]
+            self._failure_event("faults_detected")
+            self._failure_event("quarantined")
+            if self.prefix_cache is not None:
+                if state.hit_row is not None:
+                    # only scrub the fetched row if it still shares
+                    # the matched prefix with this prompt (the stored
+                    # entry may extend past it — rewind semantics) —
+                    # LRU may have recycled the row for an unrelated
+                    # healthy entry since the admission fetched it
+                    held = self.prefix_cache.row_prefix(state.hit_row)
+                    prompt = tuple(int(t)
+                                   for t in state.request.prompt)
+                    m = state.prefix_reused
+                    if (held is not None and len(held) >= m
+                            and held[:m] == prompt[:m]):
+                        self.prefix_cache.invalidate_row(state.hit_row)
+                self.prefix_cache.invalidate(state.request.prompt)
+            self._evict_slot(slot)
+            self._requeue_victim(state.request)
+        return healthy
 
     # -- the serving loop ----------------------------------------------
-    def run(self) -> Dict[int, GenerationResult]:
-        """Drain the queue: admit into free slots (advancing chunked
-        prefills under the scheduler's round budget), decode in chunks,
-        evict finished requests — until no work remains."""
-        results: Dict[int, GenerationResult] = {}
-        while (self.scheduler.pending or self._pending
-               or any(s is not None for s in self._slots)):
-            for slot in range(self.n_slots):
-                if (self._slots[slot] is None
-                        and slot not in self._reserved
-                        and self.scheduler.pending):
-                    self._start_admission(self.scheduler.pop(), slot,
-                                          results)
-            if self._pending:
-                grants = self.scheduler.plan_chunks(
-                    [p.remaining for p in self._pending])
-                for i in grants:
-                    self._advance_prefill(self._pending[i],
-                                          self.prefill_chunk)
+    def has_work(self) -> bool:
+        """True while anything is queued, admitting, decoding, or
+        waiting out a retry backoff."""
+        return bool(self.scheduler.pending or self._pending
+                    or self._requeue
+                    or any(s is not None for s in self._slots))
+
+    def _drain_terminal(self, results: Dict[int, GenerationResult]):
+        if self._terminal:
+            results.update(self._terminal)
+            self._terminal.clear()
+
+    def step(self, results: Optional[Dict[int, GenerationResult]] = None
+             ) -> Dict[int, GenerationResult]:
+        """One scheduling round: requeue/faults/deadline sweeps, admit
+        into free slots (advancing chunked prefills under the
+        scheduler's round budget), one decode chunk, paranoid
+        quarantine, evictions. Public so a caller can interleave
+        ``cancel()`` / ``snapshot()`` / fault assertions with progress;
+        ``run()`` is exactly a ``step()`` loop. Terminal results
+        accumulate into (and are returned via) ``results``."""
+        if results is None:
+            results = {}
+        t_start = (self._clock()
+                   if self.stall_threshold_s is not None else None)
+        # an admit_fail is scoped to ITS round ("the next admission
+        # this round fails"): one left unconsumed — no admission ran —
+        # expires rather than ambushing an unrelated later workload
+        self._admit_fail_pending = 0
+        self._drain_requeue()
+        self._inject_faults()
+        self._sweep_deadlines()
+        for slot in range(self.n_slots):
+            if (self._slots[slot] is None
+                    and slot not in self._reserved
+                    and self.scheduler.pending):
+                if self._admit_fail_pending > 0:
+                    # injected admission-time allocation failure: the
+                    # victim re-queues with backoff, no device work
+                    # ran. It still counts as STARTED — service was
+                    # attempted, so queue_timeout_s (a bound on
+                    # time-to-first-service) no longer sheds its retry
+                    self._admit_fail_pending -= 1
+                    victim = self.scheduler.pop()
+                    self._started.add(victim.id)
+                    self._failure_event("faults_detected")
+                    self._requeue_victim(victim)
+                    continue
+                self._start_admission(self.scheduler.pop(), slot)
+        if self._pending:
+            if self.adaptive_prefill:
+                budget = self.scheduler.adapt_budget()
                 if self.tracer is not None:
-                    self.tracer.counter("serving_round_prefill_chunks",
-                                        len(grants))
-                finished = [p for p in self._pending
-                            if p.remaining == 0]
-                for p in finished:
-                    self._complete_admission(p, results)
-                    self._pending.remove(p)
-            active = [i for i, s in enumerate(self._slots)
-                      if s is not None]
-            if not active:
-                continue
+                    self.tracer.counter("serving_prefill_budget",
+                                        budget)
+                    self.tracer.counter("serving_pressure",
+                                        self.scheduler.pressure())
+            grants = self.scheduler.plan_chunks(
+                [p.remaining for p in self._pending])
+            for i in grants:
+                self._advance_prefill(self._pending[i],
+                                      self.prefill_chunk)
+            if self.tracer is not None:
+                self.tracer.counter("serving_round_prefill_chunks",
+                                    len(grants))
+            finished = [p for p in self._pending
+                        if p.remaining == 0]
+            for p in finished:
+                self._complete_admission(p)
+                self._pending.remove(p)
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None]
+        if active:
             t0 = time.perf_counter()
             with self._span("serving.decode_chunk",
                             active=len(active)):
@@ -503,6 +965,8 @@ class DecodeEngine:
                     jnp.asarray(self._top_ks), self._next_key())
                 seq = np.asarray(seq)  # [B, chunk]; forces completion
             dt = time.perf_counter() - t0
+            if self.paranoid:
+                active = self._quarantine(active)
             emitted = 0
             for slot in active:
                 state = self._slots[slot]
@@ -512,7 +976,7 @@ class DecodeEngine:
                     if self._finished(state):
                         break
                 if self._finished(state):
-                    self._finish(state, slot, results)
+                    self._finish(state, slot)
             self.stats["tokens_generated"] += emitted
             self.stats["decode_time_s"] += dt
             self.stats["chunks"] += 1
@@ -522,12 +986,31 @@ class DecodeEngine:
                 self.tracer.counter("slot_occupancy", occ)
                 self.tracer.rate("serving_tokens_per_sec", emitted, dt)
                 self._emit_counters()
+        self._round += 1
+        if t_start is not None:
+            if self._clock() - t_start > self.stall_threshold_s:
+                self._failure_event("slow_steps")
+        self._drain_terminal(results)
+        return results
+
+    def run(self) -> Dict[int, GenerationResult]:
+        """Drain the queue: admit into free slots (advancing chunked
+        prefills under the scheduler's round budget), decode in chunks,
+        evict finished requests — until no work remains. Terminal
+        results produced outside a run (sheds at submit, cancels while
+        idle) are delivered here too."""
+        results: Dict[int, GenerationResult] = {}
+        self._drain_terminal(results)
+        while self.has_work():
+            self.step(results)
         return results
 
     def _emit_counters(self) -> None:
         """Mirror the engine's cumulative counters into the tracer
         (one Chrome-trace counter track each) so a serving run is
-        observable from the trace alone."""
+        observable from the trace alone. Failure events mirror at
+        event time instead (``Tracer.incr`` in ``_failure_event``) —
+        they must be visible even in rounds that never decode."""
         for key in ("admitted", "evicted", "chunks_scheduled",
                     "tokens_generated", "prefill_tokens",
                     "prefill_tokens_skipped"):
@@ -541,3 +1024,205 @@ class DecodeEngine:
     def mean_occupancy(self) -> float:
         chunks = self.stats["chunks"]
         return self.stats["occupancy_sum"] / chunks if chunks else 0.0
+
+    # -- crash-safe snapshot / resume ----------------------------------
+    def _prefill_sequence(self, seq: List[int], temperature: float = 0.0,
+                          top_k: Optional[int] = None):
+        """Prefill an arbitrary token sequence to a B=1 streaming state
+        through the regular (chunked) prefill path — the rebuild
+        primitive for ``restore``. Segments are capped at the cache
+        window, so sequences longer than the window roll exactly the
+        way live decoding rolled them. Returns ``(rnn, tok)``."""
+        probe = Request(list(seq), 1, temperature=temperature,
+                        top_k=top_k)
+        pending = _Pending(probe, -1, None, None, 0, 0, None,
+                           seq=[int(t) for t in seq])
+        step_max = min(self.prefill_chunk or self.window, self.window)
+        while pending.remaining:
+            self._advance_prefill(pending,
+                                  min(step_max, pending.remaining))
+        return pending.rnn, pending.tok
+
+    def _prime_prefix(self, prefix) -> None:
+        """Recompute one snapshotted prefix-cache entry: prefill is
+        deterministic, so the re-primed row is bit-identical to the
+        stored state the crash destroyed."""
+        if self.prefix_cache is None or not len(prefix):
+            return
+        rnn, _ = self._prefill_sequence([int(t) for t in prefix])
+        self.prefix_cache.insert(prefix, rnn)
+
+    def _rebuild_slot(self, slot: int, request: Request,
+                      tokens: List[int], prefix_reused: int) -> None:
+        """Rebuild a snapshotted in-flight slot: re-prefill
+        prompt + generated ids minus the last (exactly the cache a
+        mid-decode slot holds — the newest id is the slot's current
+        token, not yet in cache), scatter it in, and resume decoding
+        where the crash happened."""
+        seq = [int(t) for t in request.prompt] + [int(t)
+                                                 for t in tokens[:-1]]
+        rnn, _ = self._prefill_sequence(seq, request.temperature,
+                                        request.top_k)
+        tok = jnp.asarray([int(tokens[-1])], jnp.int32)
+        if self._pool is None:
+            self._pool = jax.tree_util.tree_map(
+                lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
+                                    a.dtype), rnn)
+            self._toks = jnp.zeros((self.n_slots,), jnp.int32)
+        with self._span("serving.admit", slot=slot):
+            self._pool, self._toks = self._admit_jit(
+                self._pool, self._toks, rnn, tok,
+                jnp.asarray(slot, jnp.int32))
+        self._slots[slot] = _Slot(request, [int(t) for t in tokens],
+                                  prefix_reused=prefix_reused,
+                                  ttft_s=None)
+        self._started.add(request.id)
+        self._temps[slot] = request.temperature
+        self._top_ks[slot] = request.top_k or self.vocab
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Everything needed to finish this engine's work in a fresh
+        process, as a plain (JSON-serializable) dict: config, RNG key,
+        scheduler queue, per-slot request metadata + generated ids,
+        in-flight admissions (restored as queued — their partial
+        device state is recomputed), retry/backoff state, prefix-trie
+        prefixes, and undelivered terminal results. Device arrays are
+        deliberately NOT captured: ``restore`` rebuilds KV state by
+        re-prefilling recorded tokens, which is smaller, portable, and
+        exactly reproducible."""
+        now = self._clock()
+
+        def entry(req: Request) -> Dict[str, Any]:
+            return {"request": _request_dict(req),
+                    "elapsed_s": self._elapsed(req.id, now),
+                    "started": req.id in self._started}
+
+        slots: List[Optional[Dict[str, Any]]] = []
+        for state in self._slots:
+            if state is None:
+                slots.append(None)
+            else:
+                slots.append({
+                    "request": _request_dict(state.request),
+                    "tokens": list(state.tokens),
+                    "prefix_reused": state.prefix_reused,
+                    "elapsed_s": self._elapsed(state.request.id, now),
+                })
+        return {
+            "version": 1,
+            "config": {
+                "n_slots": self.n_slots,
+                "decode_chunk": self.decode_chunk,
+                "min_prompt_bucket": self.scheduler.min_bucket,
+                "prefix_cache_rows": (self.prefix_cache.rows
+                                      if self.prefix_cache else 0),
+                "prefill_chunk": self.prefill_chunk,
+                "admission_policy": self.scheduler.policy,
+                "prefill_budget": self.scheduler._budget_ceiling,
+                "max_queue": self.scheduler.max_queue,
+                "shed_policy": self.shed_policy,
+                "adaptive_prefill": self.adaptive_prefill,
+                "paranoid": self.paranoid,
+                "max_retries": self.max_retries,
+                "retry_backoff_rounds": self.retry_backoff_rounds,
+                "stall_threshold_s": self.stall_threshold_s,
+            },
+            "rng_key": np.asarray(
+                jax.random.key_data(self._key)).tolist(),
+            "round": self._round,
+            "slots": slots,
+            "pending": [entry(p.request) for p in self._pending],
+            "queue": [entry(r)
+                      for r in self.scheduler.queued_requests()],
+            "requeue": [dict(entry(req),
+                             delay_rounds=max(0, ready - self._round))
+                        for ready, req in self._requeue],
+            "retries": {str(k): v for k, v in self._retries.items()},
+            "prefix_prompts": (
+                [list(p) for p in self.prefix_cache.cached_prefixes()]
+                if self.prefix_cache is not None else []),
+            "terminal": [dataclasses.asdict(r)
+                         for r in self._terminal.values()],
+        }
+
+    @classmethod
+    def restore(cls, net, snapshot: Dict[str, Any], tracer=None,
+                fault_plan: Optional[FaultPlan] = None, clock=None,
+                seed: int = 0) -> "DecodeEngine":
+        """Rebuild an engine from ``snapshot()`` output in a fresh
+        process: same config, prefix cache re-primed (deterministic
+        prefill reproduces each stored row), every in-flight slot's KV
+        state re-prefilled from its recorded ids, queue/retry state and
+        RNG key restored — ``run()`` then finishes the same ids a
+        crash-free engine would have (greedy: bit-identical). In-flight
+        chunked admissions restart from the queue front (their partial
+        prefill is recomputed); deadlines keep their already-elapsed
+        time."""
+        cfg = snapshot["config"]
+        eng = cls(
+            net, n_slots=cfg["n_slots"],
+            decode_chunk=cfg["decode_chunk"],
+            min_prompt_bucket=cfg["min_prompt_bucket"], tracer=tracer,
+            seed=seed, prefix_cache_rows=cfg["prefix_cache_rows"],
+            prefill_chunk=cfg["prefill_chunk"],
+            admission_policy=cfg["admission_policy"],
+            prefill_budget=cfg["prefill_budget"],
+            max_queue=cfg["max_queue"], shed_policy=cfg["shed_policy"],
+            adaptive_prefill=cfg["adaptive_prefill"],
+            paranoid=cfg["paranoid"], fault_plan=fault_plan,
+            max_retries=cfg["max_retries"],
+            retry_backoff_rounds=cfg["retry_backoff_rounds"],
+            stall_threshold_s=cfg["stall_threshold_s"], clock=clock)
+        now = eng._clock()
+        max_id = -1
+
+        def arm(req: Request, elapsed) -> None:
+            nonlocal max_id
+            eng._submit_t[req.id] = now - (elapsed or 0.0)
+            if (req.deadline_s is not None
+                    or req.queue_timeout_s is not None):
+                eng._has_deadlines = True
+            max_id = max(max_id, req.id)
+
+        for prefix in snapshot.get("prefix_prompts", []):
+            eng._prime_prefix(prefix)
+        for slot, sd in enumerate(snapshot["slots"]):
+            if sd is None:
+                continue
+            req = _request_from(sd["request"])
+            eng._rebuild_slot(slot, req, list(sd["tokens"]),
+                              int(sd.get("prefix_reused", 0)))
+            # in-flight ids stay issued: the duplicate-id guard must
+            # survive the restart exactly like the queue's ids do
+            eng.scheduler._issued.add(req.id)
+            arm(req, sd.get("elapsed_s"))
+        # in-flight admissions were the oldest waiters: they re-enter
+        # at the queue front, ahead of the queued requests
+        for entry in list(snapshot.get("pending", [])) + list(
+                snapshot["queue"]):
+            req = _request_from(entry["request"])
+            eng.scheduler.requeue(req)
+            arm(req, entry.get("elapsed_s"))
+            if entry.get("started"):
+                eng._started.add(req.id)
+        for entry in snapshot.get("requeue", []):
+            req = _request_from(entry["request"])
+            eng._requeue.append(
+                (eng._round + int(entry.get("delay_rounds", 0)), req))
+            eng.scheduler._issued.add(req.id)
+            arm(req, entry.get("elapsed_s"))
+            if entry.get("started"):
+                eng._started.add(req.id)
+        eng._retries = {int(k): int(v)
+                        for k, v in snapshot.get("retries", {}).items()}
+        for rd in snapshot.get("terminal", []):
+            eng._terminal[rd["id"]] = GenerationResult(**rd)
+            max_id = max(max_id, rd["id"])
+        if max_id >= 0:
+            eng.scheduler.reserve_ids_through(max_id)
+        key_data = np.asarray(snapshot["rng_key"], np.uint32)
+        try:
+            eng._key = jax.random.wrap_key_data(jnp.asarray(key_data))
+        except AttributeError:  # ancient jax: fresh key (greedy
+            pass                # requests are unaffected by the key)
+        return eng

@@ -104,7 +104,7 @@ class RadixPrefixCache:
         self._clock = 0
         self.stats: Dict[str, int] = {
             "hits": 0, "misses": 0, "inserts": 0, "evictions": 0,
-            "declined": 0, "tokens_matched": 0,
+            "declined": 0, "tokens_matched": 0, "invalidations": 0,
         }
         self._build_jits()
 
@@ -240,12 +240,84 @@ class RadixPrefixCache:
 
     def release(self, hit: PrefixHit) -> None:
         """Drop the lease taken by ``lookup`` (the row becomes
-        evictable again once unreferenced)."""
+        evictable again once unreferenced). A row invalidated WHILE
+        leased (fault quarantine) was only unmapped at that point; the
+        last release returns it to the free list."""
         left = self._ref.get(hit.row, 0) - 1
         if left > 0:
             self._ref[hit.row] = left
         else:
             self._ref.pop(hit.row, None)
+            if (hit.row not in self._by_row
+                    and hit.row not in self._free):
+                self._free.append(hit.row)
+
+    def _drop_node(self, node: _Node) -> int:
+        """Unmap a stored node (any already-fetched snapshot stays
+        valid — device arrays are immutable) and prune now-empty leaf
+        chains. The row returns to the free list immediately when
+        unleased; a row another in-flight admission still leases is
+        only UNMAPPED here (no new lookups can hit it) and ``release``
+        frees it when the last lease drops — freeing it now would let
+        an insert reuse a row whose lease bookkeeping still points at
+        the old occupant. The quarantine path for corrupted entries."""
+        row = node.row
+        node.row = None
+        del self._by_row[row]
+        if self._ref.get(row, 0) == 0:
+            self._ref.pop(row, None)
+            self._free.append(row)
+        while (node.parent is not None and node.row is None
+               and not node.children):
+            parent = node.parent
+            del parent.children[node.edge[0]]
+            node = parent
+        return row
+
+    def invalidate_row(self, row: int) -> bool:
+        """Drop the entry stored in ``row`` (fault quarantine: the
+        engine detected NaN state traced back to this row). Returns
+        False when the row holds nothing."""
+        node = self._by_row.get(row)
+        if node is None:
+            return False
+        self._drop_node(node)
+        self.stats["invalidations"] += 1
+        return True
+
+    def invalidate(self, prompt: Sequence[int]) -> bool:
+        """Drop the entry stored under exactly ``prompt`` (fault
+        quarantine: an admission built on a corrupt fetch re-inserted
+        its poisoned state under its full prompt — both ends must be
+        scrubbed before the retry, or the retry re-fetches the
+        poison)."""
+        tokens = tuple(int(t) for t in prompt)
+        node, depth = self._walk(tokens)
+        if depth != len(tokens) or node.row is None:
+            return False
+        self._drop_node(node)
+        self.stats["invalidations"] += 1
+        return True
+
+    def stored_rows(self) -> List[int]:
+        """Rows currently holding entries (fault injection picks its
+        corruption target from these)."""
+        return sorted(self._by_row)
+
+    def row_prefix(self, row: int) -> Optional[Tuple[int, ...]]:
+        """The token prefix currently stored in ``row`` (None when the
+        row holds nothing). Quarantine uses this to confirm a
+        suspect row still holds an ancestor of the poisoned prompt
+        before invalidating — the row may have been LRU-recycled for
+        an unrelated healthy entry since the admission fetched it."""
+        node = self._by_row.get(row)
+        if node is None:
+            return None
+        parts = []
+        while node is not None:
+            parts.append(node.edge)
+            node = node.parent
+        return tuple(t for edge in reversed(parts) for t in edge)
 
     def _evict_lru(self) -> Optional[int]:
         victims = [nd for row, nd in self._by_row.items()
@@ -253,17 +325,12 @@ class RadixPrefixCache:
         if not victims:
             return None
         node = min(victims, key=lambda nd: nd.last_use)
-        row = node.row
-        node.row = None
-        del self._by_row[row]
+        # one prune implementation: _drop_node unmaps + prunes, and —
+        # the victim being unleased — puts the row on the free list;
+        # take it straight back for the caller's immediate reuse
+        row = self._drop_node(node)
+        self._free.remove(row)
         self.stats["evictions"] += 1
-        # prune now-empty leaf chains so the trie stays proportional to
-        # what is actually cached
-        while (node.parent is not None and node.row is None
-               and not node.children):
-            parent = node.parent
-            del parent.children[node.edge[0]]
-            node = parent
         return row
 
     def _alloc_row(self) -> Optional[int]:

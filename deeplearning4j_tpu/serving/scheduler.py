@@ -24,7 +24,15 @@ class Request:
     """One decode request. ``temperature == 0`` means greedy (the
     default — bit-identical to ``MultiLayerNetwork.generate``);
     ``top_k=None`` means unfiltered. ``eos_id`` optionally ends the
-    request early (the eos token is included in the output)."""
+    request early (the eos token is included in the output).
+
+    ``deadline_s`` is an END-TO-END budget: measured from submit, a
+    request past it is terminated wherever it is (queued, mid-
+    admission, or mid-decode) with ``finish_reason="deadline"`` and
+    whatever tokens it produced. ``queue_timeout_s`` bounds QUEUE WAIT
+    only: a request that has not started admission within it is shed
+    (``finish_reason="shed"``) — the backpressure contract that a
+    request which waited too long is cheaper to drop than to start."""
 
     prompt: Sequence[int]
     max_new_tokens: int
@@ -32,6 +40,8 @@ class Request:
     top_k: Optional[int] = None
     eos_id: Optional[int] = None
     id: Optional[int] = None
+    deadline_s: Optional[float] = None
+    queue_timeout_s: Optional[float] = None
 
     def __post_init__(self):
         if len(self.prompt) == 0:
@@ -47,15 +57,32 @@ class Request:
             # plausible intent
             raise ValueError(
                 f"top_k {self.top_k} < 1 (use None for unfiltered)")
+        for name in ("deadline_s", "queue_timeout_s"):
+            val = getattr(self, name)
+            if val is not None and val <= 0:
+                raise ValueError(
+                    f"{name} {val} <= 0 (use None for no limit)")
+
+
+#: every terminal state a request can reach. 'length'/'eos' are the
+#: healthy outcomes; the rest are the failure-handling layer's:
+#: 'deadline' (end-to-end budget blown, partial tokens returned),
+#: 'cancelled' (engine.cancel, partial tokens returned), 'shed'
+#: (admission-queue backpressure or queue timeout, no tokens), 'fault'
+#: (an injected/detected fault exhausted the retry cap).
+FINISH_REASONS = ("length", "eos", "deadline", "cancelled", "shed",
+                  "fault")
 
 
 @dataclasses.dataclass
 class GenerationResult:
     """A finished request: generated ids (prompt excluded) and why it
-    stopped ('length' or 'eos'). ``prefix_tokens_reused`` counts prompt
-    tokens served from the radix prefix cache instead of prefilled;
-    ``ttft_s`` is submit-to-first-token wall time (None when the engine
-    predates the request's submit, e.g. hand-built results)."""
+    stopped (one of :data:`FINISH_REASONS`). ``prefix_tokens_reused``
+    counts prompt tokens served from the radix prefix cache instead of
+    prefilled; ``ttft_s`` is submit-to-first-token wall time (None when
+    the engine predates the request's submit, e.g. hand-built results,
+    or the request never produced a token); ``retries`` counts fault
+    re-admissions the request survived before this terminal state."""
 
     id: int
     tokens: List[int]
@@ -63,6 +90,7 @@ class GenerationResult:
     prompt_len: int
     prefix_tokens_reused: int = 0
     ttft_s: Optional[float] = None
+    retries: int = 0
 
 
 class Scheduler:
@@ -79,7 +107,10 @@ class Scheduler:
     def __init__(self, max_prompt_len: int, min_bucket: int = 8,
                  prefill_chunk: int = 0,
                  prefill_budget: Optional[int] = None,
-                 policy: str = "ttft"):
+                 policy: str = "ttft",
+                 max_queue: Optional[int] = None,
+                 pressure_high: Optional[int] = None,
+                 pressure_low: Optional[int] = None):
         self.max_prompt_len = int(max_prompt_len)
         self.min_bucket = int(min_bucket)
         if policy not in self.POLICIES:
@@ -88,6 +119,8 @@ class Scheduler:
                 f"{self.POLICIES}")
         if prefill_chunk < 0:
             raise ValueError(f"prefill_chunk {prefill_chunk} < 0")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue {max_queue} < 1")
         self.policy = policy
         self.prefill_chunk = int(prefill_chunk)
         if prefill_budget is None:
@@ -98,6 +131,16 @@ class Scheduler:
             prefill_budget = (self.prefill_chunk if policy == "decode"
                               else 4 * self.prefill_chunk)
         self.prefill_budget = int(prefill_budget)
+        # adaptive-degradation bounds (see adapt_budget): the budget
+        # never adapts above its configured value or below one chunk
+        self._budget_ceiling = self.prefill_budget
+        self.pressure_high = (int(pressure_high)
+                              if pressure_high is not None
+                              else 4 * max(self._budget_ceiling, 1))
+        self.pressure_low = (int(pressure_low)
+                             if pressure_low is not None
+                             else max(self._budget_ceiling, 1))
+        self.max_queue = None if max_queue is None else int(max_queue)
         self._queue: Deque[Request] = deque()
         self._ids = itertools.count()
         self._issued = set()
@@ -109,12 +152,19 @@ class Scheduler:
         return min(scan_length_bucket(prompt_len, self.min_bucket),
                    self.max_prompt_len)
 
-    def submit(self, request: Request) -> int:
+    def validate(self, request: Request) -> None:
+        """Reject prompts the engine could never serve losslessly."""
         if len(request.prompt) > self.max_prompt_len:
             raise ValueError(
                 f"prompt of {len(request.prompt)} tokens exceeds the "
                 f"cache window ({self.max_prompt_len}): raise "
                 "stream_max_t or shorten the prompt")
+
+    def assign_id(self, request: Request) -> int:
+        """Issue (or verify) the request's id WITHOUT enqueueing — the
+        engine uses this for requests it must answer at submit time
+        (e.g. shed under the reject-new policy), so even a rejected
+        request has a stable id its result can be keyed by."""
         if request.id is None:
             request.id = next(self._ids)
         elif request.id in self._issued:
@@ -125,11 +175,44 @@ class Scheduler:
                 f"request id {request.id} already submitted; construct "
                 "a new Request (or leave id=None)")
         self._issued.add(request.id)
-        self._queue.append(request)
         return request.id
+
+    def submit(self, request: Request) -> int:
+        self.validate(request)
+        rid = self.assign_id(request)
+        self._queue.append(request)
+        return rid
+
+    def requeue(self, request: Request) -> None:
+        """Put an already-issued request back in line (fault retry,
+        snapshot restore): no re-validation, no duplicate check — the
+        id stays issued across its whole retry lifetime."""
+        self._issued.add(request.id)
+        self._queue.append(request)
 
     def pop(self) -> Request:
         return self._queue.popleft()
+
+    def remove(self, request_id: int) -> Optional[Request]:
+        """Pull a specific queued request out of line (cancellation,
+        deadline expiry). Returns it, or None if not queued."""
+        for req in self._queue:
+            if req.id == request_id:
+                self._queue.remove(req)
+                return req
+        return None
+
+    def queued_requests(self) -> List[Request]:
+        """Snapshot of the queue, oldest first (deadline sweeps and
+        engine snapshots; mutating the list does not touch the
+        queue)."""
+        return list(self._queue)
+
+    def reserve_ids_through(self, max_id: int) -> None:
+        """Advance the id counter past ``max_id`` (snapshot restore:
+        replayed requests keep their original ids, and future submits
+        must not collide with them)."""
+        self._ids = itertools.count(int(max_id) + 1)
 
     def release(self, request_id: int) -> None:
         """Forget a finished request's id: ``_issued`` then tracks only
@@ -173,3 +256,40 @@ class Scheduler:
     @property
     def pending(self) -> int:
         return len(self._queue)
+
+    @property
+    def full(self) -> bool:
+        """Bounded-admission check: True when the queue has reached
+        ``max_queue`` and the next submit must shed (engine policy
+        decides whom). ``max_queue=None`` never sheds."""
+        return (self.max_queue is not None
+                and len(self._queue) >= self.max_queue)
+
+    def pressure(self) -> int:
+        """Backpressure signal: total estimated suffix-prefill tokens
+        queued (= queue depth x mean prompt tokens; the prompt length
+        is an upper bound per request — prefix-cache hits only lower
+        it). This is the prefill work the engine owes before the queue
+        drains."""
+        return sum(len(r.prompt) for r in self._queue)
+
+    def adapt_budget(self) -> int:
+        """Graceful-degradation step (engine calls once per round when
+        ``adaptive_prefill`` is on): pressure above ``pressure_high``
+        steps the per-round prefill budget DOWN one chunk (decode
+        latency stays smooth while admissions slow), pressure below
+        ``pressure_low`` steps it back UP toward the configured
+        ceiling. The budget never leaves [one chunk, ceiling], so
+        admission always progresses and recovery is automatic."""
+        if self.prefill_chunk < 1:
+            return self.prefill_budget
+        p = self.pressure()
+        if p > self.pressure_high:
+            self.prefill_budget = max(
+                self.prefill_chunk,
+                self.prefill_budget - self.prefill_chunk)
+        elif p < self.pressure_low:
+            self.prefill_budget = min(
+                self._budget_ceiling,
+                self.prefill_budget + self.prefill_chunk)
+        return self.prefill_budget

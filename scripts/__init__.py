@@ -1,0 +1,2 @@
+"""Repo tooling scripts. Importable as a package so CI can register
+script-backed checks (e.g. the chaos soak) as tests."""

@@ -1,0 +1,23 @@
+"""The chaos soak (scripts/chaos_soak.py) registered as tests: the
+fast variant rides tier-1 (< 60 s), the full 200-request soak is
+``slow``. The soak itself asserts the chaos-parity gates (terminal
+accounting, bit-identical healthy finishes vs a fault-free run,
+bounded compile counts, mid-run snapshot/restore)."""
+
+import pytest
+
+from scripts.chaos_soak import run_soak
+
+
+def test_chaos_soak_fast():
+    summary = run_soak(n_requests=24, seed=0, fault_rate=0.15)
+    assert summary["faults_injected"] >= 3
+    assert summary["faults_detected"] >= 1
+    assert summary["restored_mid_run"]
+
+
+@pytest.mark.slow
+def test_chaos_soak_full():
+    summary = run_soak(n_requests=200, seed=0)
+    assert summary["faults_injected"] >= 10
+    assert summary["quarantined"] >= 1

@@ -198,6 +198,45 @@ class TestRadixTrie:
                 np.asarray(st["v"])[:, :, -n_valid:, :], rtol=1e-6)
         cache.release(hit)
 
+    def test_invalidate_scrubs_entry(self):
+        """Fault quarantine: invalidate drops exactly the named entry
+        (exact prompt or row) and frees its row for reuse."""
+        cache = RadixPrefixCache(rows=2)
+        cache.insert([1, 2, 3], _fake_state(3))
+        cache.insert([1, 2, 3, 4, 5], _fake_state(5))
+        assert cache.invalidate([1, 2, 3])
+        assert not cache.invalidate([1, 2, 3])   # already gone
+        assert cache.cached_prefixes() == [(1, 2, 3, 4, 5)]
+        assert cache.stats["invalidations"] == 1
+        (row,) = cache.stored_rows()
+        assert cache.row_prefix(row) == (1, 2, 3, 4, 5)
+        assert cache.invalidate_row(row)
+        assert cache.cached_prefixes() == []
+        # both rows free again: two fresh inserts succeed, no eviction
+        assert cache.insert([7, 7], _fake_state(2))
+        assert cache.insert([8, 8], _fake_state(2))
+        assert cache.stats["evictions"] == 0
+
+    def test_invalidate_leased_row_defers_free(self):
+        """Invalidating a row another in-flight admission still leases
+        must NOT hand the row to the free list: a concurrent insert
+        reusing it would corrupt the old lease's bookkeeping. The row
+        is unmapped immediately (no new lookups hit it) and freed by
+        the LAST release."""
+        cache = RadixPrefixCache(rows=2)
+        cache.insert([1, 2, 3, 4], _fake_state(4))
+        hit = cache.lookup([1, 2, 3, 4, 9])      # leases the row
+        assert cache.invalidate([1, 2, 3, 4])
+        assert cache.lookup([1, 2, 3, 4, 9]) is None  # unmapped now
+        assert hit.row not in cache._free        # ...but NOT freed
+        # an insert while the lease is live must take the OTHER row
+        assert cache.insert([5, 5, 5], _fake_state(3))
+        assert cache.stored_rows() != [hit.row]
+        cache.release(hit)                       # last lease frees it
+        assert hit.row in cache._free
+        assert cache.insert([6, 6], _fake_state(2))
+        assert sorted(cache.stored_rows()) == [0, 1]
+
 
 class TestSchedulerChunkPlanning:
     def test_decode_priority_grants_one_chunk_per_round(self):
