@@ -1064,6 +1064,172 @@ def bench_prefix_cache():
     }]
 
 
+def bench_decode_spec():
+    """Serving row (ISSUE 4 tentpole): self-speculative decoding —
+    n-gram drafting + single-pass K-token verification — on the SAME
+    width-1024 flagship / 2048-window / 8-slot config as the
+    continuous-batching row, under churn (24 requests over 8 slots, so
+    slots freed early by accepted drafts admit new work sooner).
+
+    Workload ("repetitive wave"): each prompt is a 64-token random
+    head followed by the model's OWN 128-token greedy continuation —
+    the prompt-lookup regime, where the output re-treads material
+    present in the prompt (for this random-weight LM, its repetition
+    cycles). Candidates whose continuation drifts chaotically are
+    filtered out up front by simulating the n-gram table against the
+    known true stream (the row advertises the favourable-workload
+    ceiling; the acceptance-rate annotation reports what speculation
+    actually contributed on it). A speculative round PREPENDS one
+    batched verify pass to the decode chunk in the same host
+    round-trip: accepted draft tokens + the bonus token are extra
+    committed tokens on top of the chunk, so a speculative round never
+    commits fewer tokens (nor costs more host round-trips) than a
+    plain round — the win degrades toward zero on hostile workloads
+    instead of inverting.
+
+    Gates:
+    - throughput: the speculative engine's aggregate tokens/sec must
+      EXCEED the non-speculative engine measured in the same process
+      on the same workload (trials interleaved so a transport-phase
+      change cannot favour either side);
+    - parity: spec-on greedy ids match the spec-off engine's ids
+      (>= 0.9 over the decoded window — the same bf16 argmax-tie bar
+      as the batched row; exact-id equality is asserted at f32 in
+      tests/test_serving_spec.py);
+    - compile counts: verify executables stay within the pow2
+      draft-width buckets (<= log2(K)+1) and NOTHING retraces between
+      the warmed timed runs of either engine."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import DecodeEngine, Request
+
+    from deeplearning4j_tpu.serving.spec import NgramDraftTable
+
+    V, width, n_layers, window = 64, 1024, 8, 2048
+    n_slots, n_reqs, n_gen, draft_k = 8, 24, 128, 32
+    head_len, cont_len, n_cands = 64, 128, 32
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    def one_hot(ids):
+        x = np.zeros((1, V, len(ids)), np.float32)
+        x[0, ids, np.arange(len(ids))] = 1.0
+        return x
+
+    # candidate prompts = head + the model's own continuation; score
+    # each candidate's TAIL predictability by replaying the n-gram
+    # table against the known true stream, keep the best n_reqs (the
+    # same greedy stream the engines will decode — filtering is pure
+    # workload construction, not measurement)
+    rng = np.random.default_rng(0)
+    cands = []
+    for _ in range(n_cands):
+        head = rng.integers(0, V, head_len).tolist()
+        net.rnn_clear_previous_state()
+        stream = np.asarray(net.generate(
+            one_hot(head), cont_len + n_gen))[0].tolist()
+        prompt = head + stream[:cont_len]
+        table = NgramDraftTable()
+        table.seed(0, prompt)
+        hits = 0
+        for tok in stream[cont_len:]:
+            d = table.draft(0, 1)
+            hits += bool(d and d[0] == tok)
+            table.extend(0, [tok])
+        cands.append((hits, prompt))
+    cands.sort(key=lambda c: -c[0])
+    prompts = [p for _, p in cands[:n_reqs]]
+    net.rnn_clear_previous_state()
+
+    base = DecodeEngine(net, n_slots=n_slots, decode_chunk=32)
+    spec = DecodeEngine(net, n_slots=n_slots, decode_chunk=32,
+                        spec_draft_len=draft_k)
+
+    def one_round(engine):
+        ids = [engine.submit(Request(prompt=list(p),
+                                     max_new_tokens=n_gen))
+               for p in prompts]
+        t0 = time.perf_counter()
+        results = engine.run()
+        dt = time.perf_counter() - t0
+        ordered = [results[i] for i in ids]
+        toks = sum(len(r.tokens) for r in ordered)
+        return ordered, toks / dt
+
+    base_res, _ = one_round(base)       # warm: compiles + parity ids
+    spec_res, _ = one_round(spec)
+    matches = [float(np.mean(np.asarray(s.tokens)
+                             == np.asarray(b.tokens)))
+               for s, b in zip(spec_res, base_res)]
+    match = float(np.mean(matches))
+    if match < 0.9:
+        _fail_gate(f"spec/non-spec greedy id match {match:.2f}")
+
+    counts0 = {"base": base.compile_counts(),
+               "spec": spec.compile_counts()}
+    max_buckets = int(np.log2(draft_k)) + 1
+    if not 1 <= counts0["spec"]["verify"] <= max_buckets:
+        _fail_gate(f"verify executables {counts0['spec']['verify']} "
+                   f"outside [1, {max_buckets}] pow2 buckets")
+
+    drafted0 = spec.stats["spec_drafted"]
+    accepted0 = spec.stats["spec_accepted"]
+    base_rates, spec_rates = [], []
+    for _ in range(3):
+        _, r = one_round(base)
+        base_rates.append(r)
+        _, r = one_round(spec)
+        spec_rates.append(r)
+    counts1 = {"base": base.compile_counts(),
+               "spec": spec.compile_counts()}
+    if counts1 != counts0:
+        _fail_gate(f"speculative bench retraced after warmup: "
+                   f"{counts0} -> {counts1}")
+
+    drafted = spec.stats["spec_drafted"] - drafted0
+    accepted = spec.stats["spec_accepted"] - accepted0
+    acceptance = accepted / max(drafted, 1)
+    base_rate = float(np.median(base_rates))
+    spec_rate = float(np.median(spec_rates))
+    if spec_rate <= base_rate:
+        _fail_gate(f"speculative decode {spec_rate:.0f} tok/s <= "
+                   f"non-speculative {base_rate:.0f} on the "
+                   "repetitive workload")
+    rounds = (spec.stats["spec_rounds"]
+              + spec.stats["spec_fallback_rounds"])
+    return {
+        "metric": "decode_spec_tokens_per_sec",
+        "value": round(spec_rate, 1),
+        "unit": (f"aggregate tokens/sec (width-1024 flagship, "
+                 f"2048-token KV window, {n_reqs} reqs x {n_gen} "
+                 f"tokens over {n_slots} slots, n-gram drafting "
+                 f"K={draft_k} + single-pass verification riding the "
+                 "decode round, predictability-filtered "
+                 "self-continuation workload)"),
+        "vs_baseline": None,  # reference rnnTimeStep has no LM serving
+        "spread": [round(min(spec_rates), 1),
+                   round(max(spec_rates), 1)],
+        "trials": len(spec_rates),
+        "vs_nonspec_engine": round(spec_rate / base_rate, 2),
+        "nonspec_tokens_per_sec": round(base_rate, 1),
+        "acceptance_rate": round(acceptance, 4),
+        "workload_tail_predictability": round(
+            float(np.mean([h for h, _ in cands[:n_reqs]])) / n_gen,
+            4),
+        "tokens_per_round": round(
+            spec.stats["tokens_generated"] / max(rounds, 1), 2),
+        "spec_round_share": round(
+            spec.stats["spec_rounds"] / max(rounds, 1), 4),
+        "spec_nonspec_id_match": round(match, 4),
+        "compile_counts": counts1["spec"],
+    }
+
+
 def bench_w2v():
     """BASELINE row 3: Word2Vec skip-gram words/sec with a semantic
     quality gate on the bundled REAL corpus (the reference's
@@ -1306,8 +1472,8 @@ def main() -> None:
     for fn in (bench_transformer_long_context,
                bench_transformer_32k_context, bench_flagship,
                bench_hostfed_cnn, bench_decode, bench_decode_batched,
-               bench_prefix_cache, bench_w2v, bench_dbn,
-               bench_allreduce):
+               bench_prefix_cache, bench_decode_spec, bench_w2v,
+               bench_dbn, bench_allreduce):
         try:
             out = fn()
         except Exception as e:  # a broken row must not hide the rest

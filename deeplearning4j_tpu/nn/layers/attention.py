@@ -229,14 +229,24 @@ class AttentionImpl(LayerImplBase):
 
         ``mask`` (``[N, T]`` 1/0, right-padded) marks the chunk's valid
         prefix per row: this is the resume-from-a-partially-filled-cache
-        path (serving chunked prefill — a pow2/fixed-width padded
-        suffix chunk continues a prefix-cache hit). Pad keys never
-        receive weight, pad positions never enter the cache (the same
-        roll-the-pad-out-of-view trick as ``_prefill_cache``), and
-        ``filled`` advances by each row's true chunk length — so a
-        padded chunked continuation streams identically to an unpadded
-        one-shot prefill of the same tokens. ``mask=None`` (the decode
-        hot path) keeps the original, roll-free program."""
+        path, shared by TWO serving callers — chunked prefill (a
+        pow2/fixed-width padded suffix chunk continues a prefix-cache
+        hit) and the speculative verify attend (every slot's
+        [current token | draft] chunk scores in one batched pass, each
+        row masked to its own draft length — B rows at B different
+        lengths AND different ``filled`` levels share one executable).
+        Pad keys never receive weight, pad positions never enter the
+        cache (the same roll-the-pad-out-of-view trick as
+        ``_prefill_cache``), and ``filled`` advances by each row's true
+        chunk length — so a padded chunked continuation streams
+        identically to an unpadded one-shot prefill of the same
+        tokens, and output position ``i`` of a verify chunk holds
+        exactly the logits sequential decode would have produced after
+        its first ``i`` chunk tokens (the property speculative
+        acceptance rests on — serving/engine.py rewinds rejected
+        tails afterwards via ``nn.streaming.drop_newest_tokens``).
+        ``mask=None`` (the decode hot path) keeps the original,
+        roll-free program."""
         tm = lc.stream_max_t
         t = q.shape[2]
         if not lc.causal:

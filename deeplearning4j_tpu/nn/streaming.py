@@ -90,7 +90,11 @@ def reset_streaming_state(rnn_state: Any, slots) -> Any:
 def drop_newest_tokens(rnn_state: Any, drop) -> Any:
     """Rewind every attention KV-cache in a streaming-state pytree by
     ``drop`` tokens (0 or more, static or traced), returning the state
-    as it was before the newest ``drop`` tokens streamed in.
+    as it was before the newest ``drop`` tokens streamed in. ``drop``
+    may be a scalar (every batch row rewinds equally — the prefix-cache
+    fetch path) or a per-row ``[N]`` vector (each row rewinds its own
+    count — the speculative-verify path, where every slot keeps its
+    accepted prefix and sheds its own rejected tail).
 
     Valid because K/V at a position are per-token projections of that
     token alone: removing the newest entries and re-right-aligning
@@ -98,11 +102,26 @@ def drop_newest_tokens(rnn_state: Any, drop) -> Any:
     dropped K/V into the left region that the decremented ``filled``
     already invalidates (the same mask argument as
     ``AttentionImpl._prefill_cache``), so they never receive attention
-    weight. Used by the serving prefix cache: an exact-match prompt
+    weight. Used by the serving prefix cache (an exact-match prompt
     rewinds the cached state one token so the final prompt token can be
-    re-streamed to produce first-token logits. The caller guarantees
-    ``drop <= min(filled)``. Raises on non-attention state (an LSTM
-    carry has no per-token axis to rewind)."""
+    re-streamed to produce first-token logits) and by the speculative
+    verify step (rejected draft tails roll back before the bonus token
+    commits). The caller guarantees ``drop <= filled`` per row AND that
+    none of the dropped tokens pushed an older token out of the sliding
+    window (a slid-out token cannot be recovered by rewind; the serving
+    engine caps draft lengths at ``window - filled - 1`` for exactly
+    this reason). Raises on non-attention state (an LSTM carry has no
+    per-token axis to rewind)."""
+    drop = jnp.asarray(drop)
+    if drop.ndim > 1:
+        raise ValueError(
+            f"drop must be a scalar or per-row vector; got shape "
+            f"{drop.shape}")
+    if drop.ndim == 1:
+        roll = jax.vmap(lambda a, s: jnp.roll(a, s, axis=1))
+    else:
+        def roll(a, s):
+            return jnp.roll(a, s, axis=2)
     out = {}
     for name, st in (rnn_state or {}).items():
         if not (isinstance(st, dict) and "filled" in st):
@@ -111,8 +130,8 @@ def drop_newest_tokens(rnn_state: Any, drop) -> Any:
                 "KV-cache 'filled' vector — only attention caches can "
                 "be rewound by token")
         out[name] = {
-            "k": jnp.roll(st["k"], drop, axis=2),
-            "v": jnp.roll(st["v"], drop, axis=2),
+            "k": roll(st["k"], drop),
+            "v": roll(st["v"], drop),
             "filled": st["filled"] - drop,
         }
     return out

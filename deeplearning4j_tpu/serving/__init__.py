@@ -2,9 +2,11 @@
 caches (ISSUE 1 tentpole; the layer that multiplexes many concurrent
 requests onto one compiled batched decode step), the radix prefix
 cache and chunked-prefill admission that make admissions prefix-aware
-and non-blocking (ISSUE 2 tentpole), and the fault-tolerant runtime —
+and non-blocking (ISSUE 2 tentpole), the fault-tolerant runtime —
 deadlines, cancellation, load shedding, deterministic fault injection,
-and crash-safe snapshot/resume (ISSUE 3 tentpole)."""
+and crash-safe snapshot/resume (ISSUE 3 tentpole) — and
+self-speculative decoding: n-gram drafting with single-pass K-token
+verification (ISSUE 4 tentpole)."""
 
 from deeplearning4j_tpu.serving.engine import DecodeEngine
 from deeplearning4j_tpu.serving.faults import (
@@ -17,13 +19,17 @@ from deeplearning4j_tpu.serving.prefix_cache import (
     PrefixHit,
     RadixPrefixCache,
 )
-from deeplearning4j_tpu.serving.sampler import sample_tokens
+from deeplearning4j_tpu.serving.sampler import (
+    greedy_acceptance,
+    sample_tokens,
+)
 from deeplearning4j_tpu.serving.scheduler import (
     FINISH_REASONS,
     GenerationResult,
     Request,
     Scheduler,
 )
+from deeplearning4j_tpu.serving.spec import NgramDraftTable
 
 __all__ = [
     "DecodeEngine",
@@ -33,9 +39,11 @@ __all__ = [
     "FaultPlan",
     "GenerationResult",
     "ManualClock",
+    "NgramDraftTable",
     "PrefixHit",
     "RadixPrefixCache",
     "Request",
     "Scheduler",
+    "greedy_acceptance",
     "sample_tokens",
 ]

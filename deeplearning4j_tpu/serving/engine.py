@@ -42,6 +42,31 @@ Dataflow per scheduling round (one ``step()``):
    sampling on device (serving/sampler.py). Idle slots ride along
    harmlessly: their ``filled == 0`` row masks every cached position
    (nn/layers/attention.py), so live slots are never contaminated.
+   With **speculative decoding** on (``spec_draft_len=K``, ISSUE 4) a
+   round whose n-gram tables propose anything PREPENDS one batched
+   verify pass to the decode scan: each greedy slot's host-side draft
+   table (serving/spec.py) proposes up to K next tokens, a single
+   masked chunk-continuation forward (the same
+   ``AttentionImpl._stream_attend`` path chunked prefill uses) scores
+   all B slots' drafts at once, per-slot accepted-prefix lengths are
+   computed on device (serving/sampler.py ``greedy_acceptance``),
+   rejected tails are rolled back with the per-row
+   ``drop_newest_tokens`` rewind, the model's own token at the first
+   divergence commits as the bonus token, and the decode scan resumes
+   from the verified state — both dispatches land in ONE host
+   round-trip, so a speculative round commits
+   ``decode_chunk + accepted + 1`` tokens per slot where a plain round
+   commits ``decode_chunk``: the accepted drafts ride free on the
+   round's weight reads, and the round COUNT never exceeds the
+   spec-off engine's (the win degrades to zero under hostile
+   workloads instead of inverting). Greedy output is bit-identical to
+   plain decode (accepted tokens ARE the greedy tokens, by
+   construction). Rounds with no drafts anywhere run the plain decode
+   executable alone; acceptance rates feed
+   ``Scheduler.record_acceptance``, which steps the live K down
+   (never below 1) when acceptance is poor and back up when it
+   recovers, and verify width bills against the same per-round budget
+   prefill chunks do (``Scheduler.plan_chunks``).
 4. **Detect & quarantine** (``paranoid=True``) — ONE extra jitted
    finiteness check over the pool + sampled ids (the single new
    executable of the failure-handling layer). A non-finite slot is
@@ -65,15 +90,18 @@ bit-identical — asserted by the chaos gate in
 tests/test_serving_faults.py).
 
 Compile-count guarantees (asserted in tests/test_serving_engine.py,
-tests/test_serving_prefix_cache.py and tests/test_serving_faults.py):
-ONE decode-step executable, ONE admit executable, ONE prefix-fetch and
-ONE prefix-store executable, ONE health-check executable (paranoid mode
-only — the only addition of the failure layer), ONE chunk-continuation
-executable per distinct suffix width (exactly one in chunked mode —
-every chunk is ``prefill_chunk`` wide; one per pow2 suffix bucket
-otherwise), and one cold-prefill executable per pow2 prompt bucket —
-admission order, slot index, request length, cache hits, sampling
-config, faults, deadlines, and retries never retrace.
+tests/test_serving_prefix_cache.py, tests/test_serving_faults.py and
+tests/test_serving_spec.py): ONE decode-step executable, ONE admit
+executable, ONE prefix-fetch and ONE prefix-store executable, ONE
+health-check executable (paranoid mode only — the only addition of the
+failure layer), ONE verify executable per pow2 draft-width bucket
+(speculative mode only — O(log spec_draft_len) total), ONE
+chunk-continuation executable per distinct suffix width (exactly one
+in chunked mode — every chunk is ``prefill_chunk`` wide; one per pow2
+suffix bucket otherwise), and one cold-prefill executable per pow2
+prompt bucket — admission order, slot index, request length, cache
+hits, sampling config, faults, deadlines, retries, and draft content
+never retrace.
 """
 
 from __future__ import annotations
@@ -92,15 +120,23 @@ from deeplearning4j_tpu.nn.layers.attention import (
     ATTENTION_BEANS,
     guard_streamable,
 )
-from deeplearning4j_tpu.nn.streaming import clear_state_rows
+from deeplearning4j_tpu.nn.streaming import (
+    clear_state_rows,
+    drop_newest_tokens,
+    scan_length_bucket,
+)
 from deeplearning4j_tpu.serving.faults import FaultEvent, FaultPlan, poison_rows
 from deeplearning4j_tpu.serving.prefix_cache import RadixPrefixCache
-from deeplearning4j_tpu.serving.sampler import sample_tokens
+from deeplearning4j_tpu.serving.sampler import (
+    greedy_acceptance,
+    sample_tokens,
+)
 from deeplearning4j_tpu.serving.scheduler import (
     GenerationResult,
     Request,
     Scheduler,
 )
+from deeplearning4j_tpu.serving.spec import NgramDraftTable
 
 
 @dataclasses.dataclass
@@ -112,6 +148,10 @@ class _Slot:
     #: prefix-cache row this admission fetched from (quarantine scrubs
     #: it if the slot turns out poisoned), or None on a cold admission
     hit_row: Optional[int] = None
+    #: speculative-decoding counters: tokens drafted for / accepted by
+    #: this request (surface on its GenerationResult)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 @dataclasses.dataclass
@@ -221,6 +261,24 @@ class DecodeEngine:
     "decode") and ``prefill_budget`` (tokens per round; see
     ``Scheduler.plan_chunks``).
 
+    ``spec_draft_len=K`` (default 0 = off, the bit-identical PR 3
+    engine) enables self-speculative decoding (ISSUE 4): per-slot
+    n-gram draft tables (``draft_source="ngram"``, serving/spec.py)
+    propose up to K next tokens per greedy slot per round, ONE batched
+    verify pass scores every slot's draft (masked chunk continuation —
+    one weight read for up to K+1 tokens per slot), accepted prefixes
+    commit, rejected tails rewind out of the KV cache, the model's
+    own token at the divergence point rides along as the bonus token,
+    and the round's decode chunk resumes from the verified state in
+    the same host round-trip (accepted tokens are pure profit per
+    round; a hostile workload degrades to plain-decode throughput
+    instead of below it). Greedy output is bit-identical to the
+    spec-off engine (acceptance IS greedy-match); rounds with no
+    drafts run plain decode alone; the live K adapts to measured
+    acceptance between 1 and the configured ceiling
+    (``Scheduler.record_acceptance``). Per-request acceptance counters
+    surface on ``GenerationResult.spec_drafted`` / ``spec_accepted``.
+
     Failure-handling knobs (ISSUE 3; ALL default off — the engine is
     then bit-identical to the PR 2 engine):
 
@@ -271,6 +329,11 @@ class DecodeEngine:
     #: arrival, or shed the oldest queued request in its favour
     SHED_POLICIES = ("reject-new", "shed-oldest")
 
+    #: valid speculative draft sources. "ngram" = host-side per-slot
+    #: prompt-lookup tables (serving/spec.py) — free drafts, no second
+    #: model; the knob exists so a draft-model source can slot in later
+    DRAFT_SOURCES = ("ngram",)
+
     #: stats keys that count failure events (each mirrors into a
     #: cumulative tracer track named ``serving_<key>``)
     FAILURE_KEYS = ("deadline_expired", "queue_timeouts", "cancelled",
@@ -293,7 +356,9 @@ class DecodeEngine:
                  max_retries: int = 2,
                  retry_backoff_rounds: int = 1,
                  stall_threshold_s: Optional[float] = None,
-                 clock=None):
+                 clock=None,
+                 spec_draft_len: int = 0,
+                 draft_source: str = "ngram"):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
@@ -302,6 +367,12 @@ class DecodeEngine:
             raise ValueError(
                 f"shed_policy {shed_policy!r}: expected one of "
                 f"{self.SHED_POLICIES}")
+        if spec_draft_len < 0:
+            raise ValueError(f"spec_draft_len {spec_draft_len} < 0")
+        if draft_source not in self.DRAFT_SOURCES:
+            raise ValueError(
+                f"draft_source {draft_source!r}: expected one of "
+                f"{self.DRAFT_SOURCES}")
         if max_retries < 0:
             raise ValueError(f"max_retries {max_retries} < 0")
         if retry_backoff_rounds < 0:
@@ -334,6 +405,15 @@ class DecodeEngine:
             raise ValueError(
                 "DecodeEngine requires at least one attention layer")
         self.window = min(windows)
+        self.spec_draft_len = int(spec_draft_len)
+        self.draft_source = draft_source
+        if self.spec_draft_len >= self.window:
+            raise ValueError(
+                f"spec_draft_len {spec_draft_len} must stay below the "
+                f"cache window ({self.window}): a verify chunk carries "
+                "the draft plus the current token, and a rejected tail "
+                "can only be rewound while nothing slid out of the "
+                "window")
         self.prefill_chunk = int(prefill_chunk)
         self.scheduler = Scheduler(self.window,
                                    min_bucket=min_prompt_bucket,
@@ -342,9 +422,14 @@ class DecodeEngine:
                                    policy=admission_policy,
                                    max_queue=max_queue,
                                    pressure_high=pressure_high,
-                                   pressure_low=pressure_low)
+                                   pressure_low=pressure_low,
+                                   spec_draft_len=self.spec_draft_len)
         self.prefix_cache = (RadixPrefixCache(prefix_cache_rows)
                              if prefix_cache_rows else None)
+        #: host-side per-slot n-gram draft tables (None = spec off —
+        #: the engine is then the bit-identical PR 3 engine)
+        self.spec = (NgramDraftTable() if self.spec_draft_len
+                     else None)
         self.shed_policy = shed_policy
         self.adaptive_prefill = bool(adaptive_prefill)
         self.paranoid = bool(paranoid)
@@ -378,6 +463,8 @@ class DecodeEngine:
             "decode_time_s": 0.0, "chunks": 0, "occupancy_sum": 0.0,
             "admitted": 0, "evicted": 0, "prefill_tokens": 0,
             "prefill_tokens_skipped": 0, "chunks_scheduled": 0,
+            "spec_rounds": 0, "spec_fallback_rounds": 0,
+            "spec_drafted": 0, "spec_accepted": 0,
         }
         for key in self.FAILURE_KEYS:
             self.stats[key] = 0
@@ -433,6 +520,53 @@ class DecodeEngine:
         self._chunk_jit = jax.jit(chunk_prefill)
         self._admit_jit = jax.jit(admit)
         self._decode_jit = jax.jit(decode)
+        self._verify_jit = None
+        if self.spec_draft_len:
+            vocab, dtype = self.vocab, self.net._dtype
+
+            def verify(params, state, pool, toks, draft, lens, temps,
+                       top_ks, key):
+                # ONE forward scores every slot's draft: the chunk fed
+                # per row is [current token | draft], right-padded to
+                # the round's pow2 width bucket; the mask keeps each
+                # row's pad out of attention AND out of the cache (the
+                # _stream_attend ragged-chunk contract), so B slots
+                # with different draft lengths share this executable.
+                # Output position i holds the logits AFTER
+                # context + draft[:i] — exactly what sequential decode
+                # would have seen — so greedy-matching drafts against
+                # argmax targets accepts precisely the tokens plain
+                # greedy decode would emit.
+                seq = jnp.concatenate([toks[:, None], draft], axis=1)
+                x = jnp.swapaxes(
+                    jax.nn.one_hot(seq, vocab, dtype=dtype), 1, 2)
+                pos = jnp.arange(seq.shape[1])
+                mask = (pos[None, :]
+                        <= lens[:, None]).astype(jnp.float32)
+                out, new_pool = forward(params, state, x, mask, pool)
+                targets = jnp.argmax(out, axis=1).astype(jnp.int32)
+                acc = greedy_acceptance(targets[:, :-1], draft, lens)
+                # bonus token AFTER the accepted prefix, sampled with
+                # each slot's config (greedy slots: argmax == target —
+                # the correction token at the first divergence, or the
+                # free extra token on full acceptance)
+                probs = jnp.take_along_axis(
+                    out, acc[:, None, None], axis=2)[:, :, 0]
+                bonus = sample_tokens(probs, temps, top_ks, key)
+                # roll each row's rejected tail back out of the cache;
+                # the committed cache then holds exactly
+                # context + accepted prefix, with the bonus token as
+                # the slot's new current (not-yet-cached) token
+                new_pool = drop_newest_tokens(new_pool, lens - acc)
+                dpad = jnp.concatenate(
+                    [draft, jnp.zeros_like(draft[:, :1])], axis=1)
+                emitted = jnp.where(
+                    pos[None, :] < acc[:, None], dpad,
+                    jnp.where(pos[None, :] == acc[:, None],
+                              bonus[:, None], 0))
+                return new_pool, bonus, emitted, acc
+
+            self._verify_jit = jax.jit(verify)
         self._health_jit = None
         if self.paranoid:
             vocab = self.vocab
@@ -458,7 +592,9 @@ class DecodeEngine:
         paranoid health_check stay at 1; prefill equals the number of
         distinct cold prompt-length buckets seen; chunk_prefill equals
         the number of distinct suffix widths — exactly 1 in chunked
-        mode)."""
+        mode; verify, in speculative mode, equals the number of
+        distinct pow2 draft-width buckets seen — at most
+        O(log spec_draft_len))."""
         def n(f):
             return int(getattr(f, "_cache_size", lambda: -1)())
 
@@ -466,6 +602,8 @@ class DecodeEngine:
                   "chunk_prefill": n(self._chunk_jit),
                   "admit": n(self._admit_jit),
                   "decode": n(self._decode_jit)}
+        if self._verify_jit is not None:
+            counts["verify"] = n(self._verify_jit)
         if self._health_jit is not None:
             counts["health_check"] = n(self._health_jit)
         if self.prefix_cache is not None:
@@ -489,7 +627,6 @@ class DecodeEngine:
         if self.scheduler.full:
             if self.shed_policy == "reject-new":
                 rid = self.scheduler.assign_id(request)
-                self._submit_t[rid] = self._clock()
                 self._shed(request)
                 return rid
             self._shed(self.scheduler.pop())
@@ -528,7 +665,8 @@ class DecodeEngine:
             if state is not None and state.request.id == request_id:
                 self._record_terminal(
                     state.request, state.tokens, "cancelled",
-                    state.prefix_reused, state.ttft_s)
+                    state.prefix_reused, state.ttft_s,
+                    state.spec_drafted, state.spec_accepted)
                 self._failure_event("cancelled")
                 self._evict_slot(slot)
                 return True
@@ -550,7 +688,9 @@ class DecodeEngine:
 
     def _record_terminal(self, request: Request, tokens, reason: str,
                          prefix_reused: int = 0,
-                         ttft: Optional[float] = None) -> None:
+                         ttft: Optional[float] = None,
+                         spec_drafted: int = 0,
+                         spec_accepted: int = 0) -> None:
         """Write a request's terminal result (drained into the caller's
         dict by the next ``step()``), and drop every piece of host
         bookkeeping keyed by its id."""
@@ -558,7 +698,8 @@ class DecodeEngine:
             id=request.id, tokens=list(tokens), finish_reason=reason,
             prompt_len=len(request.prompt),
             prefix_tokens_reused=prefix_reused, ttft_s=ttft,
-            retries=self._retries.pop(request.id, 0))
+            retries=self._retries.pop(request.id, 0),
+            spec_drafted=spec_drafted, spec_accepted=spec_accepted)
         self.stats["requests_finished"] += 1
         self._submit_t.pop(request.id, None)
         self._started.discard(request.id)
@@ -581,11 +722,15 @@ class DecodeEngine:
         analogue of ``rnn_clear_previous_state(slots=[slot])``); the
         next admission overwrites them. This keeps stale K/V from ever
         being observable, and doubles as quarantine: a zeroed row is
-        finite and masked, so a poisoned slot stops existing."""
+        finite and masked, so a poisoned slot stops existing. The
+        slot's speculative draft state dies with it (a quarantined or
+        cancelled slot must never donate drafts to its successor)."""
         self._pool = clear_state_rows(self._pool, [slot])
         self._slots[slot] = None
         self._temps[slot] = 0.0
         self._top_ks[slot] = self.vocab
+        if self.spec is not None:
+            self.spec.drop(slot)
         self.stats["evicted"] += 1
 
     def _one_hot_prompt(self, prompt, bucket):
@@ -691,11 +836,25 @@ class DecodeEngine:
         self.stats["tokens_generated"] += 1
         self.stats["admitted"] += 1
         if self._finished(state):
+            # PR 3 blind spot (ISSUE 4 satellite): a request finishing
+            # AT admission never reaches the post-decode health sweep,
+            # so a fault injected the same round (poisoned prefix row
+            # riding the fetch in) would be delivered as a healthy
+            # terminal. Check the admitted row BEFORE draining its
+            # terminal — same health executable, same shapes, so
+            # compile counts are untouched.
+            if (self._health_jit is not None
+                    and not self._row_healthy(slot)):
+                self._quarantine_victim(slot, state)
+                return
             self._finish(state, slot, evict=False)
         else:
             self._slots[slot] = state
             self._temps[slot] = request.temperature
             self._top_ks[slot] = request.top_k or self.vocab
+            if self.spec is not None:
+                self.spec.seed(slot, [int(t) for t in request.prompt]
+                               + state.tokens)
 
     @staticmethod
     def _hit_eos(slot_state: _Slot) -> bool:
@@ -716,7 +875,9 @@ class DecodeEngine:
         reason = "eos" if self._hit_eos(slot_state) else "length"
         self._record_terminal(slot_state.request, slot_state.tokens,
                               reason, slot_state.prefix_reused,
-                              slot_state.ttft_s)
+                              slot_state.ttft_s,
+                              slot_state.spec_drafted,
+                              slot_state.spec_accepted)
         if evict:
             self._evict_slot(slot)
 
@@ -774,7 +935,8 @@ class DecodeEngine:
                     and el > state.request.deadline_s):
                 self._record_terminal(
                     state.request, state.tokens, "deadline",
-                    state.prefix_reused, state.ttft_s)
+                    state.prefix_reused, state.ttft_s,
+                    state.spec_drafted, state.spec_accepted)
                 self._failure_event("deadline_expired")
                 self._evict_slot(slot)
 
@@ -846,41 +1008,143 @@ class DecodeEngine:
         for _, req in ready:
             self.scheduler.requeue(req)
 
+    def _row_healthy(self, slot: int) -> bool:
+        """One slot's verdict from the (single) jitted health check —
+        the at-admission probe for requests that finish before any
+        decode round could sweep them."""
+        ok = np.asarray(self._health_jit(self._pool, self._toks))
+        return bool(ok[slot])
+
+    def _quarantine_victim(self, slot: int, state: _Slot) -> None:
+        """Quarantine one poisoned slot: rows zeroed (the pool is
+        finite again), its prefix-cache footprint invalidated (both
+        the row the admission fetched from and the entry it inserted,
+        since either end may carry the corruption), draft state
+        dropped, and the victim re-queued with backoff. Shared by the
+        post-decode sweep and the finish-at-admission probe."""
+        self._failure_event("faults_detected")
+        self._failure_event("quarantined")
+        if self.prefix_cache is not None:
+            if state.hit_row is not None:
+                # only scrub the fetched row if it still shares
+                # the matched prefix with this prompt (the stored
+                # entry may extend past it — rewind semantics) —
+                # LRU may have recycled the row for an unrelated
+                # healthy entry since the admission fetched it
+                held = self.prefix_cache.row_prefix(state.hit_row)
+                prompt = tuple(int(t)
+                               for t in state.request.prompt)
+                m = state.prefix_reused
+                if (held is not None and len(held) >= m
+                        and held[:m] == prompt[:m]):
+                    self.prefix_cache.invalidate_row(state.hit_row)
+            self.prefix_cache.invalidate(state.request.prompt)
+        self._evict_slot(slot)
+        self._requeue_victim(state.request)
+
     def _quarantine(self, active: List[int]) -> List[int]:
-        """Paranoid sweep after decode: one jitted finiteness check
-        over the pool + sampled ids. Poisoned slots are evicted (rows
-        zeroed — the pool is finite again), their prefix-cache
-        footprint invalidated (both the row the admission fetched from
-        and the entry it inserted, since either end may carry the
-        corruption), and the victim re-queued. Returns the healthy
-        subset of ``active`` — the poisoned round's tokens never reach
-        a result."""
+        """Paranoid sweep after decode/verify: one jitted finiteness
+        check over the pool + sampled ids. Poisoned slots are handed to
+        ``_quarantine_victim``. Returns the healthy subset of
+        ``active`` — the poisoned round's tokens never reach a
+        result."""
         ok = np.asarray(self._health_jit(self._pool, self._toks))
         healthy = [s for s in active if bool(ok[s])]
         for slot in active:
             if bool(ok[slot]):
                 continue
-            state = self._slots[slot]
-            self._failure_event("faults_detected")
-            self._failure_event("quarantined")
-            if self.prefix_cache is not None:
-                if state.hit_row is not None:
-                    # only scrub the fetched row if it still shares
-                    # the matched prefix with this prompt (the stored
-                    # entry may extend past it — rewind semantics) —
-                    # LRU may have recycled the row for an unrelated
-                    # healthy entry since the admission fetched it
-                    held = self.prefix_cache.row_prefix(state.hit_row)
-                    prompt = tuple(int(t)
-                                   for t in state.request.prompt)
-                    m = state.prefix_reused
-                    if (held is not None and len(held) >= m
-                            and held[:m] == prompt[:m]):
-                        self.prefix_cache.invalidate_row(state.hit_row)
-                self.prefix_cache.invalidate(state.request.prompt)
-            self._evict_slot(slot)
-            self._requeue_victim(state.request)
+            self._quarantine_victim(slot, self._slots[slot])
         return healthy
+
+    # -- speculative draft & verify (ISSUE 4) --------------------------
+    def _plan_drafts(self, active: List[int]) -> Dict[int, List[int]]:
+        """Per-slot draft proposals for this round from the n-gram
+        tables. Greedy slots only (the acceptance rule is greedy-match;
+        a sampling slot still rides the verify pass and advances one
+        sampled token). Each draft is capped at the live K
+        (``Scheduler.draft_len`` — acceptance-adapted), the tokens the
+        round's decode chunk won't already deliver (a request the
+        chunk alone finishes gains nothing from drafting — its verify
+        lanes would be pure waste), and the slot's window headroom: a
+        rejected tail can only be rewound while no token slid out of
+        the sliding window, so a slot within K+1 tokens of saturation
+        drafts less (down to zero at the brim — the chunk still
+        advances it exactly like plain decode)."""
+        k = self.scheduler.draft_len
+        drafts: Dict[int, List[int]] = {}
+        for slot in active:
+            state = self._slots[slot]
+            req = state.request
+            if req.temperature > 0:
+                drafts[slot] = []
+                continue
+            filled = min(len(req.prompt) + len(state.tokens) - 1,
+                         self.window)
+            cap = min(k,
+                      req.max_new_tokens - len(state.tokens)
+                      - self.decode_chunk,
+                      self.window - filled - 1)
+            drafts[slot] = (self.spec.draft(slot, cap) if cap > 0
+                            else [])
+        return drafts
+
+    def _dispatch_verify(self, drafts: Dict[int, List[int]]):
+        """Dispatch one batched draft-verify pass over the whole slot
+        pool: pad every slot's draft to the round's pow2 width bucket
+        (compile counts stay O(log K)) and run the single verify
+        executable (forward + greedy acceptance + per-slot rewind +
+        bonus token in one program). The pool/current-token state is
+        updated in place with the (still in-flight) device outputs so
+        the round's decode chunk chains onto the committed state —
+        NOTHING syncs here; ``_land_verify`` fetches the results after
+        the decode dispatch so a speculative round still costs ONE
+        host round-trip."""
+        max_len = max(len(d) for d in drafts.values())
+        width = min(scan_length_bucket(max_len, minimum=1),
+                    self.window - 1)
+        draft = np.zeros((self.n_slots, width), np.int32)
+        lens = np.zeros(self.n_slots, np.int32)
+        for slot, toks in drafts.items():
+            toks = list(toks)[:width]
+            if toks:
+                draft[slot, :len(toks)] = toks
+            lens[slot] = len(toks)
+        with self._span("serving.spec_verify", width=width,
+                        drafted=int(lens.sum())):
+            self._pool, self._toks, emitted, acc = self._verify_jit(
+                self.net.params, self.net.state, self._pool,
+                self._toks, jnp.asarray(draft), jnp.asarray(lens),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                self._next_key())
+        return lens, emitted, acc
+
+    def _land_verify(self, drafts: Dict[int, List[int]], lens,
+                     emitted, acc):
+        """Fetch a dispatched verify pass's results (the decode sync
+        already forced them) and do the host-side accounting: per-slot
+        and cumulative acceptance counters, and the K-adaptation
+        feedback. Returns ``(rows, n_emit)``: ``rows[slot][:n_emit]``
+        are the slot's speculative tokens this round — its accepted
+        draft prefix plus the model's own token at the first
+        divergence (or the free extra token on full acceptance)."""
+        emitted = np.asarray(emitted)  # [B, W+1]
+        acc = np.asarray(acc)
+        drafted = int(lens.sum())
+        accepted = int(acc.sum())  # undrafted rows contribute 0
+        self.stats["spec_rounds"] += 1
+        self.stats["spec_drafted"] += drafted
+        self.stats["spec_accepted"] += accepted
+        for slot in drafts:
+            state = self._slots[slot]
+            state.spec_drafted += int(lens[slot])
+            state.spec_accepted += int(acc[slot])
+        self.scheduler.record_acceptance(drafted, accepted)
+        if self.tracer is not None:
+            self.tracer.counter("serving_spec_accept_rate",
+                                accepted / max(drafted, 1))
+            self.tracer.counter("serving_spec_draft_len",
+                                self.scheduler.draft_len)
+        return emitted, acc + 1
 
     # -- the serving loop ----------------------------------------------
     def has_work(self) -> bool:
@@ -940,8 +1204,17 @@ class DecodeEngine:
                                         budget)
                     self.tracer.counter("serving_pressure",
                                         self.scheduler.pressure())
+            # a verify pass occupies the same between-decode gap that
+            # prefill chunks do: bill its width (current K + the
+            # current token) against the round's prefill budget so the
+            # admission policies' decode-gap promises still hold
+            verify_reserve = 0
+            if (self.spec is not None
+                    and any(s is not None for s in self._slots)):
+                verify_reserve = self.scheduler.draft_len + 1
             grants = self.scheduler.plan_chunks(
-                [p.remaining for p in self._pending])
+                [p.remaining for p in self._pending],
+                verify_tokens=verify_reserve)
             for i in grants:
                 self._advance_prefill(self._pending[i],
                                       self.prefill_chunk)
@@ -956,27 +1229,56 @@ class DecodeEngine:
         active = [i for i, s in enumerate(self._slots)
                   if s is not None]
         if active:
+            drafts = (self._plan_drafts(active)
+                      if self.spec is not None else None)
+            spec_round = drafts is not None and any(drafts.values())
             t0 = time.perf_counter()
+            verify_out = None
+            if spec_round:
+                # verify dispatch chains into the decode dispatch
+                # below (the scan resumes from the verified state), so
+                # a speculative round commits accepted drafts + bonus
+                # + a full decode chunk in ONE host round-trip — the
+                # round count can never exceed the spec-off engine's
+                verify_out = self._dispatch_verify(drafts)
+            elif self.spec is not None:
+                # no slot drafted anything (no n-gram match, or every
+                # slot samples): plain decode — speculation is an
+                # accelerator, never a requirement
+                self.stats["spec_fallback_rounds"] += 1
             with self._span("serving.decode_chunk",
                             active=len(active)):
                 self._pool, self._toks, seq = self._decode_jit(
                     self.net.params, self.net.state, self._pool,
                     self._toks, jnp.asarray(self._temps),
                     jnp.asarray(self._top_ks), self._next_key())
-                seq = np.asarray(seq)  # [B, chunk]; forces completion
+                seq = np.asarray(seq)  # [B, chunk]; forces the whole
+                #                        round (verify included) done
+            if verify_out is not None:
+                v_rows, v_n = self._land_verify(drafts, *verify_out)
+                rows = [list(v_rows[s][:int(v_n[s])]) + list(seq[s])
+                        for s in range(self.n_slots)]
+            else:
+                rows = seq
             dt = time.perf_counter() - t0
             if self.paranoid:
                 active = self._quarantine(active)
             emitted = 0
             for slot in active:
                 state = self._slots[slot]
-                for tok in seq[slot]:
+                appended = []
+                for tok in rows[slot]:
                     state.tokens.append(int(tok))
+                    appended.append(int(tok))
                     emitted += 1
                     if self._finished(state):
                         break
                 if self._finished(state):
                     self._finish(state, slot)
+                elif self.spec is not None:
+                    # committed ids extend the slot's n-gram context;
+                    # finished slots dropped theirs in _evict_slot
+                    self.spec.extend(slot, appended)
             self.stats["tokens_generated"] += emitted
             self.stats["decode_time_s"] += dt
             self.stats["chunks"] += 1
@@ -1013,7 +1315,9 @@ class DecodeEngine:
         they must be visible even in rounds that never decode."""
         for key in ("admitted", "evicted", "chunks_scheduled",
                     "tokens_generated", "prefill_tokens",
-                    "prefill_tokens_skipped"):
+                    "prefill_tokens_skipped", "spec_rounds",
+                    "spec_fallback_rounds", "spec_drafted",
+                    "spec_accepted"):
             self.tracer.counter(f"serving_{key}", self.stats[key])
         if self.prefix_cache is not None:
             for key in ("hits", "misses", "evictions"):
@@ -1053,12 +1357,17 @@ class DecodeEngine:
         self.prefix_cache.insert(prefix, rnn)
 
     def _rebuild_slot(self, slot: int, request: Request,
-                      tokens: List[int], prefix_reused: int) -> None:
+                      tokens: List[int], prefix_reused: int,
+                      spec_drafted: int = 0,
+                      spec_accepted: int = 0) -> None:
         """Rebuild a snapshotted in-flight slot: re-prefill
         prompt + generated ids minus the last (exactly the cache a
         mid-decode slot holds — the newest id is the slot's current
         token, not yet in cache), scatter it in, and resume decoding
-        where the crash happened."""
+        where the crash happened. The n-gram draft table is pure
+        derived state, so it rebuilds deterministically from the same
+        recorded ids (no device arrays, nothing extra in the wire
+        format)."""
         seq = [int(t) for t in request.prompt] + [int(t)
                                                  for t in tokens[:-1]]
         rnn, _ = self._prefill_sequence(seq, request.temperature,
@@ -1075,10 +1384,15 @@ class DecodeEngine:
                 jnp.asarray(slot, jnp.int32))
         self._slots[slot] = _Slot(request, [int(t) for t in tokens],
                                   prefix_reused=prefix_reused,
-                                  ttft_s=None)
+                                  ttft_s=None,
+                                  spec_drafted=spec_drafted,
+                                  spec_accepted=spec_accepted)
         self._started.add(request.id)
         self._temps[slot] = request.temperature
         self._top_ks[slot] = request.top_k or self.vocab
+        if self.spec is not None:
+            self.spec.seed(slot, [int(t) for t in request.prompt]
+                           + [int(t) for t in tokens])
 
     def snapshot(self) -> Dict[str, Any]:
         """Everything needed to finish this engine's work in a fresh
@@ -1107,6 +1421,8 @@ class DecodeEngine:
                     "tokens": list(state.tokens),
                     "prefix_reused": state.prefix_reused,
                     "elapsed_s": self._elapsed(state.request.id, now),
+                    "spec_drafted": state.spec_drafted,
+                    "spec_accepted": state.spec_accepted,
                 })
         return {
             "version": 1,
@@ -1126,7 +1442,16 @@ class DecodeEngine:
                 "max_retries": self.max_retries,
                 "retry_backoff_rounds": self.retry_backoff_rounds,
                 "stall_threshold_s": self.stall_threshold_s,
+                "spec_draft_len": self.spec_draft_len,
+                "draft_source": self.draft_source,
             },
+            # draft TABLES are derived state (rebuilt from recorded
+            # ids); only the adaptation point needs the wire format
+            "spec": ({"draft_len": self.scheduler.draft_len,
+                      "drafted": self.scheduler._spec_drafted,
+                      "accepted": self.scheduler._spec_accepted,
+                      "rounds": self.scheduler._spec_rounds}
+                     if self.spec is not None else None),
             "rng_key": np.asarray(
                 jax.random.key_data(self._key)).tolist(),
             "round": self._round,
@@ -1172,7 +1497,20 @@ class DecodeEngine:
             paranoid=cfg["paranoid"], fault_plan=fault_plan,
             max_retries=cfg["max_retries"],
             retry_backoff_rounds=cfg["retry_backoff_rounds"],
-            stall_threshold_s=cfg["stall_threshold_s"], clock=clock)
+            stall_threshold_s=cfg["stall_threshold_s"], clock=clock,
+            spec_draft_len=cfg.get("spec_draft_len", 0),
+            draft_source=cfg.get("draft_source", "ngram"))
+        spec_state = snapshot.get("spec")
+        if spec_state and eng.spec is not None:
+            # resume K-adaptation where the crash left it (final ids
+            # are K-independent under greedy; this preserves cadence)
+            eng.scheduler.draft_len = int(spec_state["draft_len"])
+            eng.scheduler._spec_drafted = int(
+                spec_state.get("drafted", 0))
+            eng.scheduler._spec_accepted = int(
+                spec_state.get("accepted", 0))
+            eng.scheduler._spec_rounds = int(
+                spec_state.get("rounds", 0))
         now = eng._clock()
         max_id = -1
 
@@ -1191,7 +1529,9 @@ class DecodeEngine:
                 continue
             req = _request_from(sd["request"])
             eng._rebuild_slot(slot, req, list(sd["tokens"]),
-                              int(sd.get("prefix_reused", 0)))
+                              int(sd.get("prefix_reused", 0)),
+                              int(sd.get("spec_drafted", 0)),
+                              int(sd.get("spec_accepted", 0)))
             # in-flight ids stay issued: the duplicate-id guard must
             # survive the restart exactly like the queue's ids do
             eng.scheduler._issued.add(req.id)

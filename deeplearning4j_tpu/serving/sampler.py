@@ -4,6 +4,13 @@ One jitted computation covers every slot's sampling config: greedy,
 temperature, and top-k ride as PER-SLOT vectors (``temps[B]``,
 ``top_ks[B]``) so heterogeneous requests share the single compiled
 decode step instead of forcing a retrace per config combination.
+
+Also home of the speculative-decoding acceptance rule
+(:func:`greedy_acceptance`): given the model's verify-pass targets and
+a batch of right-padded drafts, compute each slot's accepted-prefix
+length on device — the piece a future stochastic (rejection-sampling)
+acceptance rule would swap out while the draft/verify plumbing in the
+engine stays unchanged.
 """
 
 from __future__ import annotations
@@ -45,3 +52,32 @@ def sample_tokens(probs, temps, top_ks, key):
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(
         jnp.int32)
     return jnp.where(temps > 0, sampled, greedy)
+
+
+def greedy_acceptance(targets, draft, lens):
+    """Accepted-prefix lengths for speculative verification under the
+    GREEDY acceptance rule: draft token ``i`` is accepted iff it equals
+    the model's argmax target at its position AND every earlier draft
+    token was accepted (the leading-prefix reduction — one rejection
+    invalidates everything after it, because later drafts were scored
+    against a context containing the rejected token).
+
+    targets: [B, W] int32 — argmax next-token id at each draft
+    position (position ``i`` scores context + draft[:i]).
+    draft: [B, W] int32, right-padded.
+    lens: [B] int32 — valid draft length per row (pad never accepts).
+
+    Returns int32 [B] accepted counts in ``[0, lens]``. Accepted
+    tokens are by construction EXACTLY the tokens plain greedy decode
+    would have emitted — the engine's bit-parity invariant rests on
+    this equality, not on the draft's quality.
+
+    Structured for future stochastic acceptance (Leviathan et al.'s
+    p/q rejection sampling): swap the equality below for a per-position
+    accept draw and keep the same cumulative-product prefix reduction.
+    """
+    w = draft.shape[1]
+    pos = jnp.arange(w)
+    ok = (draft == targets) & (pos[None, :] < lens[:, None])
+    return jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1),
+                   axis=1).astype(jnp.int32)

@@ -91,6 +91,13 @@ class GenerationResult:
     prefix_tokens_reused: int = 0
     ttft_s: Optional[float] = None
     retries: int = 0
+    #: speculative-decoding counters (``spec_draft_len > 0`` engines):
+    #: tokens the n-gram table proposed for this request, and how many
+    #: of them verification accepted — acceptance rate per request is
+    #: ``spec_accepted / spec_drafted`` (0/0 when the request never
+    #: drafted, e.g. sampling requests or spec-off engines)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 class Scheduler:
@@ -104,13 +111,24 @@ class Scheduler:
     #: valid chunked-prefill scheduling policies (see ``plan_chunks``)
     POLICIES = ("ttft", "decode")
 
+    #: speculative K-adaptation policy (see ``record_acceptance``):
+    #: acceptance is averaged over this many verify rounds before K
+    #: moves, so one unlucky round cannot whipsaw the draft length
+    SPEC_ADAPT_ROUNDS = 8
+    #: mean acceptance below this halves K (floor 1 — at K=1 a round
+    #: with no n-gram match at all already IS plain decode)
+    SPEC_ACCEPT_LOW = 0.4
+    #: mean acceptance above this doubles K back toward the ceiling
+    SPEC_ACCEPT_HIGH = 0.8
+
     def __init__(self, max_prompt_len: int, min_bucket: int = 8,
                  prefill_chunk: int = 0,
                  prefill_budget: Optional[int] = None,
                  policy: str = "ttft",
                  max_queue: Optional[int] = None,
                  pressure_high: Optional[int] = None,
-                 pressure_low: Optional[int] = None):
+                 pressure_low: Optional[int] = None,
+                 spec_draft_len: int = 0):
         self.max_prompt_len = int(max_prompt_len)
         self.min_bucket = int(min_bucket)
         if policy not in self.POLICIES:
@@ -141,6 +159,16 @@ class Scheduler:
                              if pressure_low is not None
                              else max(self._budget_ceiling, 1))
         self.max_queue = None if max_queue is None else int(max_queue)
+        if spec_draft_len < 0:
+            raise ValueError(f"spec_draft_len {spec_draft_len} < 0")
+        #: speculative drafting: ``spec_ceiling`` is the configured K;
+        #: ``draft_len`` is the CURRENT K the engine drafts with, which
+        #: ``record_acceptance`` adapts inside [1, spec_ceiling]
+        self.spec_ceiling = int(spec_draft_len)
+        self.draft_len = self.spec_ceiling
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_rounds = 0
         self._queue: Deque[Request] = deque()
         self._ids = itertools.count()
         self._issued = set()
@@ -220,7 +248,8 @@ class Scheduler:
         engine) while still rejecting concurrent duplicate ids."""
         self._issued.discard(request_id)
 
-    def plan_chunks(self, remaining: Sequence[int]) -> List[int]:
+    def plan_chunks(self, remaining: Sequence[int],
+                    verify_tokens: int = 0) -> List[int]:
         """Grant prefill chunks for one scheduling round.
 
         ``remaining`` is the suffix-tokens-left count per in-flight
@@ -239,10 +268,22 @@ class Scheduler:
           non-blocking-admission guarantee).
         - ``ttft`` priority: budget defaults to 4 chunks — admissions
           reach their first token up to 4x sooner per round at the cost
-          of a longer decode gap."""
+          of a longer decode gap.
+
+        ``verify_tokens`` is the round's speculative-verify width (the
+        draft length + the current token, when the engine will run a
+        verify pass this round): the verify pass grows the round's
+        device work just like an extra prefill chunk would, so it
+        bills against the SAME budget — a speculative engine under
+        ttft priority grants fewer chunks per round rather than
+        silently stretching the round past what the policy promised.
+        The one-chunk floor survives the charge, so admissions always
+        progress and the decode-priority stall bound (<= 1 chunk/round)
+        is unchanged."""
         if not remaining or self.prefill_chunk < 1:
             return []
-        budget = max(self.prefill_budget, self.prefill_chunk)
+        budget = max(self.prefill_budget - max(int(verify_tokens), 0),
+                     self.prefill_chunk)
         grants: List[int] = []
         for i, left in enumerate(remaining):
             while left > 0 and budget >= self.prefill_chunk:
@@ -272,6 +313,37 @@ class Scheduler:
         it). This is the prefill work the engine owes before the queue
         drains."""
         return sum(len(r.prompt) for r in self._queue)
+
+    def record_acceptance(self, drafted: int, accepted: int) -> int:
+        """Feed one speculative verify round's outcome into the
+        K-adaptation policy and return the draft length the engine
+        should use next (the adaptive scheduler of ISSUE 4: K steps
+        DOWN when acceptance is poor — wasted verify lanes are wasted
+        decode-gap budget — and recovers when acceptance improves).
+
+        Acceptance is averaged over ``SPEC_ADAPT_ROUNDS`` verify rounds
+        (rounds that drafted nothing don't count — they already ran as
+        plain decode); mean rate below ``SPEC_ACCEPT_LOW`` halves
+        ``draft_len`` (floor 1 = one drafted token, the minimum that is
+        still speculative; no-match rounds below that are plain
+        decode), above ``SPEC_ACCEPT_HIGH`` doubles it back toward the
+        configured ``spec_ceiling``."""
+        if self.spec_ceiling < 1 or drafted < 1:
+            return self.draft_len
+        self._spec_drafted += int(drafted)
+        self._spec_accepted += int(accepted)
+        self._spec_rounds += 1
+        if self._spec_rounds >= self.SPEC_ADAPT_ROUNDS:
+            rate = self._spec_accepted / self._spec_drafted
+            if rate < self.SPEC_ACCEPT_LOW:
+                self.draft_len = max(1, self.draft_len // 2)
+            elif rate > self.SPEC_ACCEPT_HIGH:
+                self.draft_len = min(self.spec_ceiling,
+                                     2 * self.draft_len)
+            self._spec_drafted = 0
+            self._spec_accepted = 0
+            self._spec_rounds = 0
+        return self.draft_len
 
     def adapt_budget(self) -> int:
         """Graceful-degradation step (engine calls once per round when

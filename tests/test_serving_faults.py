@@ -348,6 +348,35 @@ class TestFaultInjection:
         assert res[victim].tokens == _solo_generate(
             shared + [10, 11], 6)
 
+    def test_fault_caught_when_request_finishes_at_admission(self):
+        """PR 3's documented blind spot, closed (ISSUE 4 satellite): a
+        request that finishes AT admission (max_new_tokens=1) in the
+        same round its poisoned prefix row rides in used to elude the
+        paranoid sweep (checks ran post-decode only) and deliver a
+        garbage terminal. The finiteness check now runs over admitted
+        rows before their terminals drain: the victim is quarantined,
+        both poisoned cache entries are scrubbed, and the retry
+        prefills cold to the exact ids."""
+        shared = [1, 4, 7, 2, 5, 3]
+        eng = DecodeEngine(_net(), n_slots=2, decode_chunk=2,
+                           prefix_cache_rows=4, paranoid=True)
+        warm = eng.submit(Request(shared + [8, 9], 4))
+        res = eng.run()
+        assert res[warm].tokens == _solo_generate(shared + [8, 9], 4)
+        row = eng.prefix_cache.stored_rows()[0]
+        eng.fault_plan = FaultPlan(
+            [FaultEvent(eng._round, "cache_corrupt", row=row)])
+        victim = eng.submit(Request(shared + [10, 11], 1))
+        res = eng.run()
+        assert eng.stats["quarantined"] == 1
+        assert eng.prefix_cache.stats["invalidations"] >= 1
+        assert res[victim].finish_reason == "length"
+        assert res[victim].retries == 1
+        assert res[victim].tokens == _solo_generate(
+            shared + [10, 11], 1)
+        # and the health check stayed the ONE extra executable
+        assert eng.compile_counts()["health_check"] == 1
+
     def test_queue_timeout_exempts_fault_retries(self):
         """queue_timeout_s bounds time-to-FIRST-service: a fault
         victim waiting out its retry backoff in the queue again must
