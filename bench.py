@@ -1230,6 +1230,160 @@ def bench_decode_spec():
     }
 
 
+def bench_gateway_streaming():
+    """Serving row (ISSUE 5 tentpole): aggregate throughput through
+    the HTTP serving gateway — 8 concurrent SSE streaming clients over
+    localhost against the SAME width-1024 flagship / 2048-window /
+    8-slot engine config as the in-process batched row. The gateway
+    adds a stepping thread, per-delta fan-out queues, SSE framing, and
+    socket writes on top of the engine; this row prices that stack.
+
+    Gates:
+    - overhead: the HTTP-path aggregate tokens/sec must stay >= 0.9x
+      the in-process ``run()`` aggregate measured in the same process
+      with interleaved trials (the gateway is a translation layer —
+      10% is the allowance for framing + loopback, not for stalling
+      the engine);
+    - parity: every streamed request's ids are bit-identical to the
+      in-process engine's for the same seeded workload (same config,
+      same greedy computation — HTTP must change nothing);
+    - compile counts: identical before/after the timed HTTP rounds —
+      the network layer never retraces an executable."""
+    import threading
+
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        GatewayClient,
+        Request,
+        ServingGateway,
+    )
+
+    V, width, n_layers, window = 64, 1024, 8, 2048
+    n_slots, n_gen, prompt_len = 8, 128, 128
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_slots)]
+
+    inproc = DecodeEngine(net, n_slots=n_slots, decode_chunk=32)
+
+    def inproc_round():
+        ids = [inproc.submit(Request(prompt=list(p),
+                                     max_new_tokens=n_gen))
+               for p in prompts]
+        t0 = time.perf_counter()
+        results = inproc.run()
+        dt = time.perf_counter() - t0
+        toks = sum(len(results[i].tokens) for i in ids)
+        return toks / dt, [results[i].tokens for i in ids]
+
+    _, ref_tokens = inproc_round()  # warm: compiles + reference ids
+
+    # admission_grace_s: the 8 clients submit over ~ms of thread
+    # scheduling jitter; the batch-formation window keeps round 1 from
+    # running at 1/8 occupancy because one submit won the lock first
+    # (in-process run() gets the same full slate by construction)
+    gw_engine = DecodeEngine(net, n_slots=n_slots, decode_chunk=32)
+    gateway = ServingGateway(gw_engine, keepalive_s=1.0,
+                             admission_grace_s=0.25).start()
+    client = GatewayClient(gateway.address, timeout_s=600.0)
+
+    def http_round():
+        outs = [None] * n_slots
+        ttfts = [None] * n_slots
+        errors = [None] * n_slots
+
+        def one(i):
+            try:
+                t_sub = time.perf_counter()
+                s = client.stream(prompts[i], n_gen)
+                toks, t_first = [], None
+                for delta in s:
+                    if t_first is None:
+                        t_first = time.perf_counter() - t_sub
+                    toks.extend(delta)
+                outs[i] = toks
+                ttfts[i] = t_first
+            except Exception as e:  # surface WHICH client died & why
+                errors[i] = e
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_slots)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        failed = {i: repr(e) for i, e in enumerate(errors) if e}
+        if failed:
+            raise RuntimeError(f"gateway stream clients failed: "
+                               f"{failed}")
+        toks = sum(len(o) for o in outs)
+        return toks / dt, outs, ttfts, dt / max(toks, 1)
+
+    # try/finally: a gate failure must not leave the gateway's stepper
+    # thread + HTTP server alive to tax every later bench row
+    try:
+        _, outs, _, _ = http_round()  # warm the gateway engine
+        id_match = float(np.mean([outs[i] == ref_tokens[i]
+                                  for i in range(n_slots)]))
+        if id_match < 1.0:
+            _fail_gate(f"gateway stream ids diverged from the "
+                       f"in-process engine (match {id_match:.2f})")
+
+        counts0 = gw_engine.compile_counts()
+        in_rates, http_rates, per_tok, ttft_all = [], [], [], []
+        for _ in range(3):  # interleaved: drift hits both alike
+            r, _ = inproc_round()
+            in_rates.append(r)
+            r, _, ttfts, tok_s = http_round()
+            http_rates.append(r)
+            per_tok.append(tok_s)
+            ttft_all.extend(t for t in ttfts if t is not None)
+        counts1 = gw_engine.compile_counts()
+        if counts1 != counts0:
+            _fail_gate(f"gateway engine retraced under HTTP traffic: "
+                       f"{counts0} -> {counts1}")
+    finally:
+        gateway.close()
+    inproc_rate = float(np.median(in_rates))
+    http_rate = float(np.median(http_rates))
+    ratio = http_rate / inproc_rate
+    if ratio < 0.9:
+        _fail_gate(
+            f"gateway streaming {http_rate:.0f} tok/s < 0.9x "
+            f"in-process {inproc_rate:.0f} (ratio {ratio:.2f})")
+    return {
+        "metric": "gateway_streaming_tokens_per_sec",
+        "value": round(http_rate, 1),
+        "unit": (f"aggregate tokens/sec through the HTTP gateway "
+                 f"(width-1024 flagship, 2048-token KV window, "
+                 f"{n_slots} concurrent SSE streams x {n_gen} tokens, "
+                 "localhost)"),
+        "vs_baseline": None,  # reference has no serving frontend
+        "spread": [round(min(http_rates), 1),
+                   round(max(http_rates), 1)],
+        "trials": len(http_rates),
+        "vs_in_process": round(ratio, 3),
+        "in_process_tokens_per_sec": round(inproc_rate, 1),
+        "per_token_latency_ms": round(
+            1e3 * float(np.median(per_tok)), 3),
+        "mean_ttft_ms": round(1e3 * float(np.mean(ttft_all)), 1),
+        "gateway_http_id_match": round(id_match, 4),
+        "compile_counts": counts1,
+    }
+
+
 def bench_w2v():
     """BASELINE row 3: Word2Vec skip-gram words/sec with a semantic
     quality gate on the bundled REAL corpus (the reference's
@@ -1472,7 +1626,8 @@ def main() -> None:
     for fn in (bench_transformer_long_context,
                bench_transformer_32k_context, bench_flagship,
                bench_hostfed_cnn, bench_decode, bench_decode_batched,
-               bench_prefix_cache, bench_decode_spec, bench_w2v,
+               bench_prefix_cache, bench_decode_spec,
+               bench_gateway_streaming, bench_w2v,
                bench_dbn, bench_allreduce):
         try:
             out = fn()

@@ -1,4 +1,4 @@
-"""CLI driver: ``dl4j-tpu {train,test,predict}``.
+"""CLI driver: ``dl4j-tpu {train,test,predict,worker,serve}``.
 
 Reference parity (deeplearning4j-cli, SURVEY.md §2.8 + §5.6 plane 4):
 - ``train``  — build a net from a conf JSON (the model-config-is-the-
@@ -8,6 +8,10 @@ Reference parity (deeplearning4j-cli, SURVEY.md §2.8 + §5.6 plane 4):
   Evaluation.stats() (reference subcommands/Test.java).
 - ``predict``— load a model zip, write argmax class predictions (or raw
   probabilities with --raw) as CSV (reference subcommands/Predict.java).
+- ``serve``  — load an LM-shaped model zip and run the streaming HTTP
+  serving gateway over it (serving/gateway.py, ISSUE 5): blocking +
+  SSE generation, cancel, metrics, drain-to-snapshot on shutdown,
+  restore-on-boot when the snapshot exists.
 
 Input sources (reference FileScheme → RecordReader resolution):
 - ``mnist`` / ``mnist-test`` / ``iris``  — built-in datasets
@@ -368,6 +372,52 @@ def _cmd_worker(args) -> int:
     return 0
 
 
+def gateway_from_args(args):
+    """Build (or restore) the serving gateway the ``serve`` subcommand
+    runs — factored out so tests can drive the exact CLI path without
+    the serve-forever loop. Restore-on-boot: when ``--snapshot`` names
+    an existing drain snapshot, the engine resumes that state (same
+    ids) instead of starting fresh."""
+    from deeplearning4j_tpu.serving import DecodeEngine, ServingGateway
+    from deeplearning4j_tpu.util.model_serializer import restore_model
+
+    def engine():
+        return DecodeEngine(
+            restore_model(args.model), n_slots=args.slots,
+            decode_chunk=args.decode_chunk,
+            prefix_cache_rows=args.prefix_cache_rows,
+            prefill_chunk=args.prefill_chunk,
+            admission_policy=args.admission_policy,
+            max_queue=args.max_queue,
+            paranoid=args.paranoid,
+            spec_draft_len=args.spec_draft_len)
+
+    return ServingGateway.boot(
+        engine, snapshot_path=args.snapshot,
+        net_factory=lambda: restore_model(args.model),
+        host=args.host, port=args.port)
+
+
+def _cmd_serve(args) -> int:
+    import time as _time
+
+    gw = gateway_from_args(args).start()
+    print(f"serving on {gw.address} "
+          f"(POST /v1/generate, GET /v1/healthz, GET /v1/metrics)")
+    try:
+        while True:
+            _time.sleep(0.5)
+    except KeyboardInterrupt:
+        print("draining...")
+    finally:
+        summary = gw.drain(timeout_s=args.drain_timeout)
+        gw.close()
+        if summary["snapshot"]:
+            print(f"snapshot ({summary['carried']} in-flight "
+                  f"requests) -> {summary['snapshot']}")
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
@@ -436,6 +486,35 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--worker-id", type=int, default=0)
     w.add_argument("--poll-interval", type=float, default=0.5)
     w.set_defaults(fn=_cmd_worker)
+
+    s = sub.add_parser(
+        "serve",
+        help="serve an LM model zip over the streaming HTTP gateway")
+    s.add_argument("--model", required=True,
+                   help="LM-shaped model zip from train")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8421)
+    s.add_argument("--slots", type=int, default=8,
+                   help="concurrent KV-cache slots (batch width)")
+    s.add_argument("--decode-chunk", type=int, default=8)
+    s.add_argument("--prefix-cache-rows", type=int, default=0,
+                   help="radix prefix cache rows (0 = off)")
+    s.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked-admission width (0 = blocking)")
+    s.add_argument("--admission-policy", default="ttft",
+                   choices=("ttft", "decode"))
+    s.add_argument("--max-queue", type=int, default=None,
+                   help="bounded admission queue (full => HTTP 429)")
+    s.add_argument("--paranoid", action="store_true",
+                   help="per-round health check + quarantine")
+    s.add_argument("--spec-draft-len", type=int, default=0,
+                   help="speculative n-gram draft length K (0 = off)")
+    s.add_argument("--snapshot", default=None,
+                   help="drain-snapshot path: written on shutdown, "
+                        "restored on boot when present")
+    s.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="seconds to settle in-flight work on shutdown")
+    s.set_defaults(fn=_cmd_serve)
     return p
 
 

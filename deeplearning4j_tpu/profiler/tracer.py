@@ -7,7 +7,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.optimize.listeners import IterationListener
 
@@ -24,11 +24,27 @@ class Tracer:
         tracer.save("trace.json")
     """
 
-    def __init__(self) -> None:
+    #: ``max_events=None`` keeps every event (the Chrome-trace use
+    #: case: finite runs you dump with ``save``). A long-lived SERVER
+    #: (the serving gateway attaches a tracer for /v1/metrics) passes
+    #: a cap: when the buffer fills, the oldest half is dropped —
+    #: counter tracks stay correct because ``latest_counters`` reads
+    #: the O(#tracks) last-value table, not the event log.
+    def __init__(self, max_events: Optional[int] = None) -> None:
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
         self._cum: Dict[str, float] = {}
+        self._last: Dict[str, float] = {}
+        self.max_events = max_events
         self._t0 = time.perf_counter()
+
+    def _push(self, event: Dict[str, Any]) -> None:
+        """Append one event under the caller-held lock, enforcing the
+        ``max_events`` cap (drop-oldest-half, amortized O(1))."""
+        self._events.append(event)
+        if (self.max_events is not None
+                and len(self._events) > self.max_events):
+            del self._events[:len(self._events) // 2]
 
     def _us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
@@ -40,7 +56,7 @@ class Tracer:
                  **args: Any) -> None:
         """Append a completed span recorded by the caller."""
         with self._lock:
-            self._events.append({
+            self._push({
                 "name": name, "ph": "X", "ts": start_us,
                 "dur": duration_us, "pid": os.getpid(),
                 "tid": threading.get_ident() % 2 ** 31, "args": args,
@@ -54,7 +70,7 @@ class Tracer:
         finally:
             end = self._us()
             with self._lock:
-                self._events.append({
+                self._push({
                     "name": name, "ph": "X", "ts": start,
                     "dur": end - start, "pid": os.getpid(),
                     "tid": threading.get_ident() % 2 ** 31,
@@ -63,7 +79,7 @@ class Tracer:
 
     def instant(self, name: str, **args: Any) -> None:
         with self._lock:
-            self._events.append({
+            self._push({
                 "name": name, "ph": "i", "ts": self._us(),
                 "pid": os.getpid(),
                 "tid": threading.get_ident() % 2 ** 31, "s": "t",
@@ -72,7 +88,8 @@ class Tracer:
 
     def counter(self, name: str, value: float) -> None:
         with self._lock:
-            self._events.append({
+            self._last[name] = value
+            self._push({
                 "name": name, "ph": "C", "ts": self._us(),
                 "pid": os.getpid(), "args": {name: value},
             })
@@ -115,12 +132,46 @@ class Tracer:
     def latest_counters(self) -> Dict[str, float]:
         """Final value of every counter track (a serving run's
         end-state snapshot: admitted, evicted, prefix hits/misses,
-        chunks scheduled, tokens decoded, ...)."""
-        out: Dict[str, float] = {}
-        for e in self.events():
-            if e["ph"] == "C":
-                out[e["name"]] = e["args"][e["name"]]
-        return out
+        chunks scheduled, tokens decoded, ...). Reads the O(#tracks)
+        last-value table, NOT the event log — a /v1/metrics scrape
+        stays cheap however long the server has been up."""
+        with self._lock:
+            return dict(self._last)
+
+    def prometheus_text(self, prefix: Optional[str] = None) -> str:
+        """Prometheus exposition-format text for every counter track
+        (the serving gateway's ``GET /v1/metrics`` body). Cumulative
+        tracks fed through :meth:`incr` (the serving failure events)
+        are typed ``counter``; everything else (occupancy, rates,
+        budgets) is a ``gauge``. ``prefix`` filters track names (e.g.
+        ``"serving_"``). Names are sanitized to the metric charset
+        ([a-zA-Z0-9_:]); tracks sharing a sanitized name keep their
+        latest value."""
+        latest = self.latest_counters()
+        with self._lock:
+            cumulative = set(self._cum)
+        # collapse tracks whose names sanitize to the same metric name
+        # (sorted order ⇒ the lexically-last raw name wins): Prometheus
+        # rejects an entire scrape over one duplicate sample
+        merged: Dict[str, Tuple[str, float]] = {}
+        for name in sorted(latest):
+            if prefix is not None and not name.startswith(prefix):
+                continue
+            safe = "".join(
+                c if (c.isalnum() or c in "_:") else "_"
+                for c in name)
+            if safe and safe[0].isdigit():
+                safe = "_" + safe
+            kind = "counter" if name in cumulative else "gauge"
+            merged[safe] = (kind, latest[name])
+        lines: List[str] = []
+        for safe in sorted(merged):
+            kind, value = merged[safe]
+            text = ("%d" % value if float(value).is_integer()
+                    else repr(float(value)))
+            lines.append(f"# TYPE {safe} {kind}")
+            lines.append(f"{safe} {text}")
+        return "\n".join(lines) + ("\n" if lines else "")
 
     def save(self, path: str) -> None:
         with open(path, "w") as f:
@@ -130,6 +181,7 @@ class Tracer:
         with self._lock:
             self._events.clear()
             self._cum.clear()
+            self._last.clear()
 
 
 class ProfilerIterationListener(IterationListener):

@@ -6,14 +6,25 @@ and non-blocking (ISSUE 2 tentpole), the fault-tolerant runtime —
 deadlines, cancellation, load shedding, deterministic fault injection,
 and crash-safe snapshot/resume (ISSUE 3 tentpole) — and
 self-speculative decoding: n-gram drafting with single-pass K-token
-verification (ISSUE 4 tentpole)."""
+verification (ISSUE 4 tentpole) — and the streaming HTTP serving
+gateway + client that turn the engine into a deployable server
+(ISSUE 5 tentpole)."""
 
+from deeplearning4j_tpu.serving.client import (
+    GatewayClient,
+    GatewayError,
+    GatewayStream,
+)
 from deeplearning4j_tpu.serving.engine import DecodeEngine
 from deeplearning4j_tpu.serving.faults import (
     FAULT_KINDS,
     FaultEvent,
     FaultPlan,
     ManualClock,
+)
+from deeplearning4j_tpu.serving.gateway import (
+    STATUS_OF_REASON,
+    ServingGateway,
 )
 from deeplearning4j_tpu.serving.prefix_cache import (
     PrefixHit,
@@ -37,13 +48,18 @@ __all__ = [
     "FINISH_REASONS",
     "FaultEvent",
     "FaultPlan",
+    "GatewayClient",
+    "GatewayError",
+    "GatewayStream",
     "GenerationResult",
     "ManualClock",
     "NgramDraftTable",
     "PrefixHit",
     "RadixPrefixCache",
     "Request",
+    "STATUS_OF_REASON",
     "Scheduler",
+    "ServingGateway",
     "greedy_acceptance",
     "sample_tokens",
 ]

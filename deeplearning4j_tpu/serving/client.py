@@ -1,0 +1,212 @@
+"""Stdlib client for the serving gateway (serving/gateway.py).
+
+``GatewayClient`` speaks the gateway's five endpoints over plain
+``http.client`` — no dependencies, so the same class serves tests, the
+soak harness (scripts/gateway_soak.py), benches, and examples. The
+streaming call returns a :class:`GatewayStream`: an iterator of
+per-delta token lists that exposes the request id immediately (so the
+caller can cancel mid-stream) and the full terminal result after
+exhaustion. Closing the stream early — or just dropping the connection
+— is the disconnect-cancel path: the gateway notices the dead socket
+and frees the request's slot.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class GatewayError(RuntimeError):
+    """Non-2xx gateway reply. ``status`` is the HTTP code;
+    ``payload`` the decoded JSON body (when there was one);
+    ``retry_after_s`` the Retry-After hint on 429s (None
+    otherwise)."""
+
+    def __init__(self, status: int, payload: Dict[str, Any],
+                 retry_after_s: Optional[int] = None):
+        super().__init__(f"gateway returned {status}: {payload}")
+        self.status = status
+        self.payload = payload
+        self.retry_after_s = retry_after_s
+
+
+def _split(address: str):
+    address = address.split("://", 1)[-1]
+    host, _, port = address.partition(":")
+    return host, int(port or 80)
+
+
+class GatewayStream:
+    """One live SSE generation stream. Iterate for per-delta token
+    lists; after iteration ends, ``result`` holds the terminal dict
+    (tokens, finish_reason, status, ...). ``close()`` abandons the
+    stream — the server cancels the request when it notices."""
+
+    def __init__(self, conn: http.client.HTTPConnection, resp):
+        self._conn = conn
+        self._resp = resp
+        self.id: Optional[int] = None
+        self.result: Optional[Dict[str, Any]] = None
+        self._read_head()
+
+    def _read_head(self) -> None:
+        # the gateway's first event carries the request id before any
+        # token exists, so cancellation needs no token to have flowed
+        first = self._next_event()
+        if first is not None:
+            self.id = first.get("id")
+            if first.get("done"):
+                self.result = first
+
+    def _next_event(self) -> Optional[Dict[str, Any]]:
+        """Next ``data:`` event (comment pings skipped), or None at
+        end of stream."""
+        data_lines: List[bytes] = []
+        while True:
+            line = self._resp.readline()
+            if not line:  # connection/stream ended
+                return None
+            line = line.rstrip(b"\r\n")
+            if not line:  # blank line = event boundary
+                if data_lines:
+                    return json.loads(b"".join(data_lines))
+                continue  # boundary after a comment ping
+            if line.startswith(b":"):
+                continue  # keep-alive comment
+            if line.startswith(b"data:"):
+                data_lines.append(line[5:].strip())
+
+    def __iter__(self) -> Iterator[List[int]]:
+        if self.result is not None:
+            return
+        while True:
+            event = self._next_event()
+            if event is None:
+                raise GatewayError(
+                    0, {"error": "stream ended without terminal "
+                                 f"event (request {self.id})"})
+            if event.get("done"):
+                self.result = event
+                self.close()
+                return
+            tokens = event.get("tokens")
+            if tokens is not None:
+                yield [int(t) for t in tokens]
+
+    def close(self) -> None:
+        # close the RESPONSE too: its ``makefile`` holds a reference
+        # to the socket fd, so ``conn.close()`` alone would never send
+        # FIN and the server would keep streaming into the void
+        # instead of noticing the disconnect
+        try:
+            self._resp.close()
+        except OSError:
+            pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class GatewayClient:
+    """Blocking + streaming client for one gateway address.
+
+    Every call opens its own connection (the gateway closes one-shot
+    responses anyway — util/httpjson ``Connection: close``), so one
+    client instance is safe to share across threads."""
+
+    def __init__(self, address: str, timeout_s: float = 60.0):
+        self.host, self.port = _split(address)
+        self.timeout_s = timeout_s
+
+    # -- plumbing ------------------------------------------------------
+    def _connect(self) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s)
+
+    def _call(self, method: str, path: str,
+              body: Optional[Dict[str, Any]] = None,
+              ok=(200,)) -> Dict[str, Any]:
+        conn = self._connect()
+        try:
+            payload = (None if body is None
+                       else json.dumps(body).encode())
+            headers = ({"Content-Type": "application/json"}
+                       if payload is not None else {})
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            data = json.loads(raw) if raw else {}
+            if resp.status not in ok:
+                retry = resp.getheader("Retry-After")
+                raise GatewayError(
+                    resp.status, data,
+                    retry_after_s=(int(retry) if retry else None))
+            return data
+        finally:
+            conn.close()
+
+    # -- endpoints -----------------------------------------------------
+    def generate(self, prompt: List[int], max_new_tokens: int,
+                 **kwargs: Any) -> Dict[str, Any]:
+        """Blocking generation. Returns the terminal result dict on
+        any 2xx; raises :class:`GatewayError` carrying the mapped
+        failure status (429 shed, 504 deadline, 500 fault) — partial
+        tokens, when the engine produced any, ride
+        ``err.payload["tokens"]``."""
+        body = dict(prompt=list(prompt),
+                    max_new_tokens=int(max_new_tokens), **kwargs)
+        return self._call("POST", "/v1/generate", body)
+
+    def stream(self, prompt: List[int], max_new_tokens: int,
+               **kwargs: Any) -> GatewayStream:
+        """Start a streaming generation; returns the live
+        :class:`GatewayStream` (its ``id`` is already populated)."""
+        body = dict(prompt=list(prompt),
+                    max_new_tokens=int(max_new_tokens), **kwargs)
+        conn = self._connect()
+        conn.request("POST", "/v1/generate?stream=1",
+                     body=json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raw = resp.read()
+            conn.close()
+            data = json.loads(raw) if raw else {}
+            retry = resp.getheader("Retry-After")
+            raise GatewayError(
+                resp.status, data,
+                retry_after_s=(int(retry) if retry else None))
+        return GatewayStream(conn, resp)
+
+    def cancel(self, request_id: int) -> Dict[str, Any]:
+        return self._call("DELETE", f"/v1/requests/{request_id}",
+                          ok=(200, 404))
+
+    def poll(self, request_id: int) -> Dict[str, Any]:
+        """Result by id: terminal dict (done), ``{"running": true}``
+        while in flight, raises 404 for unknown ids."""
+        return self._call("GET", f"/v1/requests/{request_id}",
+                          ok=(200, 202))
+
+    def healthz(self) -> Dict[str, Any]:
+        return self._call("GET", "/v1/healthz")
+
+    def metrics(self) -> str:
+        conn = self._connect()
+        try:
+            conn.request("GET", "/v1/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            if resp.status != 200:
+                raise GatewayError(resp.status, {"body": body})
+            return body
+        finally:
+            conn.close()
+
+    def drain(self, timeout_s: Optional[float] = None
+              ) -> Dict[str, Any]:
+        body = {} if timeout_s is None else {"timeout_s": timeout_s}
+        return self._call("POST", "/v1/drain", body)

@@ -81,6 +81,20 @@ Dataflow per scheduling round (one ``step()``):
    (``rnn_clear_previous_state(slots=...)`` semantics,
    nn/streaming.py) and the next admission overwrites them.
 
+**Incremental delivery** (ISSUE 5; default off = bit-identical): with
+``on_delta=callback`` (or ``emit_deltas=True`` + ``drain_deltas()``),
+every COMMITTED token surfaces the round it commits — the admission's
+first token, decode-chunk tokens, and verify-accepted speculative
+tokens, but never a rejected draft tail (emission happens after the
+rewind and after the paranoid sweep) and never a duplicate across
+fault retries (per-request high-water mark, snapshotted as
+``delta_sent``; greedy retries reproduce the streamed prefix
+bit-identically, so suppression is exact — a SAMPLING victim that
+already streamed terminates ``"fault"`` instead of retrying, since a
+redrawn sequence could not be spliced onto the streamed prefix). This
+is what the serving gateway (serving/gateway.py) fans out to
+streaming HTTP clients.
+
 ``snapshot()`` captures everything host-side (queue, per-slot request
 metadata + generated ids, RNG key, prefix-trie prefixes, retry state)
 as a plain dict; ``DecodeEngine.restore`` rebuilds the device-side KV
@@ -358,7 +372,9 @@ class DecodeEngine:
                  stall_threshold_s: Optional[float] = None,
                  clock=None,
                  spec_draft_len: int = 0,
-                 draft_source: str = "ngram"):
+                 draft_source: str = "ngram",
+                 on_delta=None,
+                 emit_deltas: bool = False):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
@@ -438,6 +454,26 @@ class DecodeEngine:
         self.retry_backoff_rounds = int(retry_backoff_rounds)
         self.stall_threshold_s = stall_threshold_s
         self._clock = clock if clock is not None else time.perf_counter
+        #: incremental-delivery hook (ISSUE 5): when ``on_delta`` is a
+        #: callable (or ``emit_deltas`` is True), every COMMITTED token
+        #: is surfaced the round it commits — the first token at
+        #: admission, each decode-chunk token, and accepted speculative
+        #: tokens (never a rejected draft tail: ``rows`` only ever
+        #: carries the accepted prefix + bonus token, and the paranoid
+        #: sweep runs before any append). ``on_delta(rid, tokens)``
+        #: fires inside ``step()``; with no callback, deltas accumulate
+        #: for ``drain_deltas()``. Both default off, and the tracking
+        #: is pure host bookkeeping — a delta-less engine is
+        #: bit-identical to the PR 4 engine.
+        self.on_delta = on_delta
+        self.emit_deltas = bool(emit_deltas)
+        #: per-request high-water mark of delivered tokens: a fault
+        #: retry restarts a request's token list from scratch, but its
+        #: already-streamed prefix must not be re-delivered (greedy
+        #: retries reproduce the prefix bit-identically, so suppressing
+        #: duplicates is exact)
+        self._delta_sent: Dict[int, int] = {}
+        self._delta_buf: Dict[int, List[int]] = {}
 
         self._key = jax.random.key(seed)
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
@@ -686,6 +722,39 @@ class DecodeEngine:
         if self.tracer is not None:
             self.tracer.incr(f"serving_{kind}")
 
+    def _note_progress(self, state: _Slot) -> None:
+        """Surface a slot's newly committed tokens as a delta (see
+        ``on_delta``). Called only where tokens are COMMITTED — after
+        admission's first token and after the round's appends (which
+        post-date the paranoid quarantine sweep and contain only
+        verify-accepted speculative tokens) — so a streaming consumer
+        can never observe a token the engine later disowns."""
+        self._emit_delta(state.request.id, state.tokens)
+
+    def _emit_delta(self, rid: int, tokens: List[int]) -> None:
+        cb = self.on_delta
+        if cb is None and not self.emit_deltas:
+            return
+        sent = self._delta_sent.get(rid, 0)
+        fresh = tokens[sent:]
+        if not fresh:
+            return
+        self._delta_sent[rid] = len(tokens)
+        if cb is not None:
+            cb(rid, [int(t) for t in fresh])
+        else:
+            self._delta_buf.setdefault(rid, []).extend(
+                int(t) for t in fresh)
+
+    def drain_deltas(self) -> Dict[int, List[int]]:
+        """Return (and clear) the per-request committed-token deltas
+        accumulated since the last drain (``emit_deltas=True`` engines
+        without an ``on_delta`` callback). Keys are request ids; values
+        are the tokens committed since the previous drain, in order."""
+        buf = self._delta_buf
+        self._delta_buf = {}
+        return buf
+
     def _record_terminal(self, request: Request, tokens, reason: str,
                          prefix_reused: int = 0,
                          ttft: Optional[float] = None,
@@ -693,7 +762,14 @@ class DecodeEngine:
                          spec_accepted: int = 0) -> None:
         """Write a request's terminal result (drained into the caller's
         dict by the next ``step()``), and drop every piece of host
-        bookkeeping keyed by its id."""
+        bookkeeping keyed by its id. Any committed-but-unstreamed tail
+        (a request cancelled between its admission round's first token
+        and the decode that would have streamed it) flushes as a final
+        delta first, so concatenated deltas equal the terminal's token
+        list — with ONE exception: a capped-retry ``"fault"`` terminal
+        delivers no tokens (the PR 3 contract; its earlier streamed
+        attempts were disowned by quarantine)."""
+        self._emit_delta(request.id, list(tokens))
         self._terminal[request.id] = GenerationResult(
             id=request.id, tokens=list(tokens), finish_reason=reason,
             prompt_len=len(request.prompt),
@@ -703,6 +779,7 @@ class DecodeEngine:
         self.stats["requests_finished"] += 1
         self._submit_t.pop(request.id, None)
         self._started.discard(request.id)
+        self._delta_sent.pop(request.id, None)
         self.scheduler.release(request.id)
 
     def _shed(self, request: Request) -> None:
@@ -1040,6 +1117,26 @@ class DecodeEngine:
                     self.prefix_cache.invalidate_row(state.hit_row)
             self.prefix_cache.invalidate(state.request.prompt)
         self._evict_slot(slot)
+        if ((self.on_delta is not None or self.emit_deltas)
+                and state.request.temperature > 0
+                and self._delta_sent.get(state.request.id, 0) > 0):
+            # a SAMPLING victim that already streamed tokens cannot be
+            # retried under incremental delivery: the retry redraws
+            # RNG, so its tokens diverge from the streamed prefix and
+            # the high-water dedup would splice two different
+            # sequences into one stream. Greedy retries reproduce the
+            # prefix bit-identically (they requeue below); a sampled
+            # stream fails honestly instead of lying token-by-token —
+            # and its terminal carries the already-streamed tokens
+            # (state.tokens == exactly what was delivered: the
+            # poisoned round's output never appended), keeping the
+            # concat(deltas)==terminal invariant even on this path
+            self._record_terminal(state.request, state.tokens, "fault",
+                                  state.prefix_reused, state.ttft_s,
+                                  state.spec_drafted,
+                                  state.spec_accepted)
+            self._failure_event("retry_failures")
+            return
         self._requeue_victim(state.request)
 
     def _quarantine(self, active: List[int]) -> List[int]:
@@ -1273,6 +1370,12 @@ class DecodeEngine:
                     emitted += 1
                     if self._finished(state):
                         break
+                # deltas flow AFTER the paranoid sweep filtered
+                # ``active`` (a quarantined slot's round never streams)
+                # and cover the admission's first token too — the
+                # diff-based high-water mark picks it up here, where
+                # this round's health verdict is already in
+                self._note_progress(state)
                 if self._finished(state):
                     self._finish(state, slot)
                 elif self.spec is not None:
@@ -1359,7 +1462,8 @@ class DecodeEngine:
     def _rebuild_slot(self, slot: int, request: Request,
                       tokens: List[int], prefix_reused: int,
                       spec_drafted: int = 0,
-                      spec_accepted: int = 0) -> None:
+                      spec_accepted: int = 0,
+                      delta_sent: Optional[int] = None) -> None:
         """Rebuild a snapshotted in-flight slot: re-prefill
         prompt + generated ids minus the last (exactly the cache a
         mid-decode slot holds — the newest id is the slot's current
@@ -1387,6 +1491,8 @@ class DecodeEngine:
                                   ttft_s=None,
                                   spec_drafted=spec_drafted,
                                   spec_accepted=spec_accepted)
+        self._delta_sent[request.id] = (len(tokens) if delta_sent is None
+                                        else int(delta_sent))
         self._started.add(request.id)
         self._temps[slot] = request.temperature
         self._top_ks[slot] = request.top_k or self.vocab
@@ -1423,6 +1529,15 @@ class DecodeEngine:
                     "elapsed_s": self._elapsed(state.request.id, now),
                     "spec_drafted": state.spec_drafted,
                     "spec_accepted": state.spec_accepted,
+                    # tokens the pre-crash process already STREAMED to
+                    # a consumer (undrained buffered deltas count as
+                    # un-streamed): the restored engine re-emits only
+                    # what never left the building
+                    "delta_sent": (
+                        self._delta_sent.get(state.request.id,
+                                             len(state.tokens))
+                        - len(self._delta_buf.get(state.request.id,
+                                                  []))),
                 })
         return {
             "version": 1,
@@ -1531,7 +1646,8 @@ class DecodeEngine:
             eng._rebuild_slot(slot, req, list(sd["tokens"]),
                               int(sd.get("prefix_reused", 0)),
                               int(sd.get("spec_drafted", 0)),
-                              int(sd.get("spec_accepted", 0)))
+                              int(sd.get("spec_accepted", 0)),
+                              delta_sent=sd.get("delta_sent"))
             # in-flight ids stay issued: the duplicate-id guard must
             # survive the restart exactly like the queue's ids do
             eng.scheduler._issued.add(req.id)

@@ -1,0 +1,753 @@
+"""Serving gateway: the streaming HTTP front door for the decode
+engine (ISSUE 5 tentpole).
+
+After PRs 1-4 the :class:`~deeplearning4j_tpu.serving.DecodeEngine` is
+a complete serving runtime — continuous batching, prefix cache, chunked
+admission, deadlines/cancel/shedding, fault quarantine, speculative
+decoding, crash-safe snapshot — but purely in-process: a Python caller
+drives ``run()``/``step()`` and sees tokens only at request terminal.
+This module is the network surface that turns it into a deployable
+server, pairing the engine with a threaded stdlib HTTP frontend the way
+production stacks pair an iteration-level scheduler with a streaming
+RPC layer (Orca, Yu et al. OSDI'22; vLLM's OpenAI-style frontend,
+Kwon et al. SOSP'23). Everything rides the existing machinery: the
+gateway owns ONE background engine-stepping thread, translates engine
+semantics into HTTP semantics, and adds no device work of its own —
+gateway off, the engine is bit-identical to before.
+
+Endpoints (see :class:`GatewayClient` in serving/client.py for the
+matching stdlib client):
+
+==========================================  =========================
+``POST /v1/generate``                       blocking JSON generation
+``POST /v1/generate?stream=1``              chunked/SSE per-token
+                                            streaming
+``DELETE /v1/requests/<id>``                ``engine.cancel``
+``GET /v1/requests/<id>``                   poll a result by id
+                                            (200 done / 202 running /
+                                            404 unknown)
+``GET /v1/metrics``                         Prometheus-style text
+                                            (Tracer counter tracks)
+``GET /v1/healthz``                         liveness + occupancy
+``POST /v1/drain``                          stop admission, settle
+                                            in-flight, snapshot
+==========================================  =========================
+
+Request lifecycle (the failure mappings are the engine's terminal
+states wearing HTTP status codes):
+
+- connection → **queue**: a full admission queue (``max_queue`` +
+  "reject-new") answers **429** with a ``Retry-After`` hint derived
+  from queue depth × measured round time
+  (``Scheduler.retry_after_s``); a drained gateway answers **503**.
+- queue → **slot** → **deltas**: the engine streams committed-token
+  deltas (``DecodeEngine.on_delta`` — decode-chunk tokens, accepted
+  speculative tokens, chunked-admission first tokens; never a rejected
+  draft tail) which the gateway fans out to each request's connection
+  as SSE ``data:`` events.
+- client disconnect → **cancel**: a failed stream write (or a failed
+  keep-alive ping while the request is still queued) cancels the
+  request, freeing its slot for the next admission.
+- terminal: ``length``/``eos`` → **200**; ``shed`` → **429**;
+  ``deadline``/queue timeout → **504** (partial tokens included);
+  ``fault`` (retries exhausted) → **500**; ``cancelled`` → **499**
+  (the de-facto client-closed-request code). Streaming responses have
+  already sent 200 headers, so the mapped status rides the final SSE
+  event's ``status`` field instead.
+- drain → snapshot → restore: ``POST /v1/drain`` stops admission,
+  lets in-flight work settle (bounded by ``timeout_s``), pauses the
+  stepping loop, and writes ``engine.snapshot()`` to
+  ``snapshot_path``; :meth:`ServingGateway.boot` on the next process
+  restores it and finishes the same ids
+  (``DecodeEngine.restore`` semantics — greedy: bit-identical).
+
+Threading model: HTTP handler threads (one per connection,
+``ThreadingHTTPServer`` with bounded socket timeouts — util/httpjson)
+NEVER touch the engine directly except under ``self._lock``; the
+stepping thread holds the same lock for exactly one ``step()`` at a
+time. Delta fan-out crosses threads through per-request
+``queue.Queue``s, so a slow-reading client backs up only its own
+stream, never the engine. All socket writes happen OUTSIDE the lock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from queue import Empty, Queue
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.serving.engine import DecodeEngine
+from deeplearning4j_tpu.serving.scheduler import (
+    GenerationResult,
+    Request,
+)
+from deeplearning4j_tpu.util.httpjson import HttpService, JsonHandler
+
+#: engine terminal state → HTTP status for the one-shot JSON endpoint
+#: (streaming responses carry the status in the final SSE event)
+STATUS_OF_REASON = {
+    "length": 200, "eos": 200,
+    "shed": 429,        # backpressure: queue full or queue timeout
+    "deadline": 504,    # end-to-end budget blown; partial tokens ride
+    "fault": 500,       # quarantine retries exhausted
+    "cancelled": 499,   # client closed request (nginx convention)
+}
+
+
+def _result_dict(res: GenerationResult) -> Dict[str, Any]:
+    return {
+        "id": res.id,
+        "tokens": [int(t) for t in res.tokens],
+        "finish_reason": res.finish_reason,
+        "prompt_len": res.prompt_len,
+        "prefix_tokens_reused": res.prefix_tokens_reused,
+        "ttft_s": res.ttft_s,
+        "retries": res.retries,
+        "spec_drafted": res.spec_drafted,
+        "spec_accepted": res.spec_accepted,
+        "status": STATUS_OF_REASON.get(res.finish_reason, 200),
+    }
+
+
+class _Live:
+    """Gateway-side state of one in-flight request: the bridge between
+    the stepping thread (producer: deltas, terminal) and the handler
+    thread serving its connection (consumer)."""
+
+    __slots__ = ("events", "result", "done")
+
+    def __init__(self):
+        #: delta token lists and, last, the GenerationResult terminal
+        self.events: Queue = Queue()
+        self.result: Optional[GenerationResult] = None
+        self.done = threading.Event()
+
+
+class _GatewayHandler(JsonHandler):
+    """One instance per connection (ThreadingHTTPServer). The owning
+    :class:`ServingGateway` is attached as the ``gateway`` class
+    attribute by HttpService."""
+
+    protocol_version = "HTTP/1.1"  # chunked transfer for streaming
+    gateway: "ServingGateway"
+
+    # -- routing -------------------------------------------------------
+    def do_POST(self):
+        path, _, query = self.path.partition("?")
+        if path == "/v1/generate":
+            stream = "stream=1" in query.split("&")
+            self.gateway._handle_generate(self, stream)
+        elif path == "/v1/drain":
+            self.gateway._handle_drain(self)
+        else:
+            self.send_json({"error": f"no such endpoint {path}"}, 404,
+                           close=True)
+
+    def do_GET(self):
+        path = self.path.partition("?")[0]
+        if path == "/v1/healthz":
+            self.send_json(self.gateway._health(), 200, close=True)
+        elif path == "/v1/metrics":
+            self.send_bytes(self.gateway._metrics_text().encode(),
+                            "text/plain; version=0.0.4", 200,
+                            close=True)
+        elif path.startswith("/v1/requests/"):
+            self.gateway._handle_poll(self, path)
+        else:
+            self.send_json({"error": f"no such endpoint {path}"}, 404,
+                           close=True)
+
+    def do_DELETE(self):
+        path = self.path.partition("?")[0]
+        if path.startswith("/v1/requests/"):
+            self.gateway._handle_cancel(self, path)
+        else:
+            self.send_json({"error": f"no such endpoint {path}"}, 404,
+                           close=True)
+
+    # -- SSE framing ---------------------------------------------------
+    def send_event(self, obj: Dict[str, Any]) -> None:
+        self.send_chunk(b"data: " + json.dumps(obj).encode() + b"\n\n")
+
+    def send_ping(self) -> None:
+        # SSE comment line: ignored by clients, but the write probes
+        # whether the peer is still there (a vanished client surfaces
+        # as a send error, which cancels the request)
+        self.send_chunk(b": ping\n\n")
+
+
+class ServingGateway:
+    """Streaming HTTP front door over one :class:`DecodeEngine`.
+
+    The gateway takes ownership of the engine: it attaches the
+    ``on_delta`` hook, ensures a tracer (so ``/v1/metrics`` always has
+    counter tracks to export), and drives all progress from ONE
+    background stepping thread — callers must not call
+    ``engine.run()/step()`` themselves while the gateway is live.
+
+    Parameters:
+
+    - ``engine`` — a configured DecodeEngine (any knob combination:
+      prefix cache, chunked admission, speculation, fault plan, ...).
+    - ``host``/``port`` — bind address (port 0 = ephemeral).
+    - ``snapshot_path`` — where ``/v1/drain`` persists
+      ``engine.snapshot()``; :meth:`boot` restores from it.
+    - ``keepalive_s`` — idle-stream ping interval: bounds how long a
+      vanished streaming client can hold a slot before the failed ping
+      cancels it.
+    - ``request_timeout_s`` — cap on a BLOCKING generate's wait
+      (streaming requests are bounded by disconnect-cancel instead);
+      None = wait for the engine terminal however long it takes.
+    - ``admission_grace_s`` — batch-formation window (default 0 =
+      off): when requests start arriving at an IDLE engine, the
+      stepper holds the first round up to this long (or until a full
+      slate of ``n_slots`` is queued) so a burst of near-simultaneous
+      arrivals shares round 1 instead of the first arrival monopolizing
+      a whole decode round at 1/B occupancy. Never delays an engine
+      that is already decoding, draining terminals, or retrying.
+
+    ``with ServingGateway(engine) as gw: ...`` serves on entry and
+    closes on exit; or ``start()``/``close()`` explicitly."""
+
+    def __init__(self, engine: DecodeEngine, host: str = "127.0.0.1",
+                 port: int = 0, snapshot_path: Optional[str] = None,
+                 keepalive_s: float = 0.5,
+                 request_timeout_s: Optional[float] = None,
+                 handler_timeout_s: float = 30.0,
+                 admission_grace_s: float = 0.0,
+                 results_cap: int = 4096):
+        if engine.on_delta is not None:
+            raise ValueError(
+                "engine already has an on_delta consumer; the gateway "
+                "must own delta delivery")
+        self.engine = engine
+        if engine.tracer is None:
+            from deeplearning4j_tpu.profiler.tracer import Tracer
+
+            # a SERVER tracer must not grow with uptime: cap the event
+            # log (latest_counters reads the last-value table, so
+            # /v1/metrics is unaffected by the drop-oldest policy)
+            engine.tracer = Tracer(max_events=65536)
+        elif getattr(engine.tracer, "max_events", 0) is None:
+            # same reasoning for a caller-supplied uncapped Tracer:
+            # the gateway turns it into a server-lifetime object
+            engine.tracer.max_events = 65536
+        self.snapshot_path = snapshot_path
+        self.keepalive_s = float(keepalive_s)
+        self.request_timeout_s = request_timeout_s
+        self.admission_grace_s = float(admission_grace_s)
+        self._grace_t0: Optional[float] = None
+        #: guards ALL engine access (stepping thread + handler threads)
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        #: handler threads queued for the lock: the stepping loop
+        #: re-acquires the lock the instant it releases it, and Python
+        #: locks are not fair, so without an explicit yield a busy
+        #: engine can starve submits/cancels/drains for entire
+        #: workloads. Guarded by its own mutex — `+=` is not atomic,
+        #: and a torn increment would leave the count skewed FOREVER
+        #: (a permanent -1 reads truthy and taxes every round with the
+        #: yield sleep)
+        self._waiters = 0
+        self._waiters_lock = threading.Lock()
+        self._live: Dict[int, _Live] = {}
+        #: terminal results retained for GET /v1/requests/<id> —
+        #: BOUNDED (insertion-ordered dict, oldest evicted past
+        #: ``results_cap``): a long-running server must not grow by
+        #: one token list per finished request forever. Streaming and
+        #: blocking clients receive their result through ``_Live``
+        #: regardless; this store only serves late polls (restored
+        #: requests, retries of the poll endpoint).
+        self._results: Dict[int, GenerationResult] = {}
+        self.results_cap = int(results_cap)
+        self._draining = False
+        self._paused = False
+        self._stopped = False
+        self._round_s = 0.01  # EMA of step wall time (Retry-After)
+        self._step_sink: Dict[int, GenerationResult] = {}
+        self.stats = {"connections": 0, "streams": 0,
+                      "disconnect_cancels": 0, "rejected_429": 0,
+                      "rejected_503": 0}
+        self._service = HttpService(_GatewayHandler, host, port,
+                                    gateway=self,
+                                    timeout=float(handler_timeout_s))
+        # claim the engine's delta hook only AFTER the bind succeeded:
+        # a port-in-use OSError above must not leave the engine
+        # permanently marked as owned by a gateway that never existed
+        engine.on_delta = self._on_delta
+        self._stepper = threading.Thread(target=self._loop,
+                                         daemon=True,
+                                         name="gateway-stepper")
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def address(self) -> str:
+        return self._service.address
+
+    def start(self) -> "ServingGateway":
+        self._service.start()
+        self._stepper.start()
+        return self
+
+    def __enter__(self) -> "ServingGateway":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Stop serving: wake and join the stepping thread, stop the
+        HTTP service, release waiting blocking handlers (503). Does NOT
+        drain or snapshot — call :meth:`drain` first for a graceful
+        shutdown."""
+        with self._wake:
+            self._stopped = True
+            self._wake.notify_all()
+        if self._stepper.is_alive():
+            self._stepper.join(timeout=10.0)
+        # unblock every handler still waiting on a terminal
+        for live in list(self._live.values()):
+            live.done.set()
+        self._service.stop()
+        # release the engine: it can be wrapped by a fresh gateway
+        # (or driven in-process again) after this one is gone
+        self.engine.on_delta = None
+
+    @classmethod
+    def boot(cls, engine_factory, snapshot_path: Optional[str] = None,
+             net_factory=None,
+             restore_kwargs: Optional[Dict[str, Any]] = None,
+             **gateway_kwargs) -> "ServingGateway":
+        """Build-or-restore on process start: when ``snapshot_path``
+        holds a drain snapshot, the engine is rebuilt around the net
+        with ``DecodeEngine.restore`` (same config, same ids — the
+        restored gateway finishes exactly what the drained one left)
+        and the file is consumed (renamed ``.restored`` so a crash
+        during restore cannot half-replay it twice); otherwise
+        ``engine_factory()`` builds a fresh engine.
+
+        ``engine_factory`` is a zero-arg callable returning a
+        configured DecodeEngine. On restore, the net to rebuild around
+        comes from ``net_factory()`` when given, else from the fresh
+        engine's ``.net`` (the snapshot's config wins over the fresh
+        engine's knobs; the discarded engine is host-cheap — KV pools
+        allocate lazily at first admission, so nothing device-side is
+        wasted). ``restore_kwargs`` forwards to
+        ``DecodeEngine.restore`` (``tracer``, ``fault_plan``,
+        ``clock``, ``seed``)."""
+        if snapshot_path and os.path.exists(snapshot_path):
+            with open(snapshot_path) as f:
+                snap = json.load(f)
+            net = (net_factory() if net_factory is not None
+                   else engine_factory().net)
+            engine = DecodeEngine.restore(net, snap,
+                                          **(restore_kwargs or {}))
+            os.replace(snapshot_path, snapshot_path + ".restored")
+        else:
+            engine = engine_factory()
+            if not isinstance(engine, DecodeEngine):
+                raise TypeError(
+                    "engine_factory must return a DecodeEngine; got "
+                    f"{type(engine).__name__}")
+        return cls(engine, snapshot_path=snapshot_path,
+                   **gateway_kwargs)
+
+    # -- the stepping loop ---------------------------------------------
+    @contextlib.contextmanager
+    def _engine_access(self):
+        """Handler-thread engine access: same lock as the stepper,
+        plus a waiter count the stepper checks so it yields between
+        rounds instead of starving the control plane."""
+        with self._waiters_lock:
+            self._waiters += 1
+        try:
+            with self._wake:
+                yield
+        finally:
+            with self._waiters_lock:
+                self._waiters -= 1
+
+    def _hold_for_grace(self) -> bool:
+        """True while the batch-formation window is open: the engine's
+        ONLY work is freshly queued admissions, fewer than a full
+        slate, and the window hasn't elapsed (see
+        ``admission_grace_s``). Lock held by the caller."""
+        if self.admission_grace_s <= 0 or self._grace_t0 is None:
+            return False
+        eng = self.engine
+        if (eng._terminal or eng._pending or eng._requeue
+                or any(s is not None for s in eng._slots)):
+            self._grace_t0 = None
+            return False
+        if eng.scheduler.pending >= eng.n_slots:
+            self._grace_t0 = None
+            return False
+        if time.monotonic() - self._grace_t0 > self.admission_grace_s:
+            self._grace_t0 = None
+            return False
+        return True
+
+    def _loop(self) -> None:
+        while True:
+            if self._waiters:
+                # hand the lock to queued submits/cancels/drains
+                # before the next round grabs it again
+                time.sleep(0.001)
+            with self._wake:
+                # terminals minted while idle (cancel of a queued
+                # request, shed-oldest victims) must drain without
+                # waiting for new work — ``step()`` with an empty
+                # engine is exactly the drain
+                while not self._stopped and (
+                        self._paused
+                        or not (self.engine.has_work()
+                                or self.engine._terminal)
+                        or self._hold_for_grace()):
+                    self._wake.wait(timeout=0.005
+                                    if self._grace_t0 is not None
+                                    else 0.05)
+                if self._stopped:
+                    return
+                t0 = time.perf_counter()
+                self.engine.step(self._step_sink)
+                self._round_s = (0.8 * self._round_s
+                                 + 0.2 * (time.perf_counter() - t0))
+                for rid, res in self._step_sink.items():
+                    self._deliver_terminal(rid, res)
+                self._step_sink.clear()
+
+    def _bump(self, key: str) -> None:
+        # handler threads increment concurrently; '+=' is not atomic
+        # and a torn increment skews the exported stat forever (same
+        # reason _waiters has a lock — reuse it, contention is nil)
+        with self._waiters_lock:
+            self.stats[key] += 1
+
+    def _on_delta(self, rid: int, tokens: List[int]) -> None:
+        # called inside engine.step() (stepping thread, lock held);
+        # Queue.put hands off to the handler thread without blocking
+        live = self._live.get(rid)
+        if live is not None:
+            live.events.put(list(tokens))
+
+    def _deliver_terminal(self, rid: int,
+                          res: GenerationResult) -> None:
+        # lock already held (stepping loop / drain); no socket writes
+        # happen here — handlers pick the result up on their side
+        self._results[rid] = res
+        while len(self._results) > self.results_cap:
+            self._results.pop(next(iter(self._results)))
+        live = self._live.get(rid)
+        if live is not None:
+            live.result = res
+            live.events.put(res)
+            live.done.set()
+
+    def _forget(self, rid: int) -> None:
+        with self._engine_access():
+            self._live.pop(rid, None)
+
+    # -- request plumbing ----------------------------------------------
+    def _submit(self, body: Dict[str, Any]):
+        """Parse + admit one generate body under the lock. Returns
+        ``(rid, live, None)`` or ``(None, None, (code, payload,
+        headers))`` for an immediate rejection."""
+        try:
+            req = Request(
+                prompt=[int(t) for t in body.get("prompt", [])],
+                max_new_tokens=int(body.get("max_new_tokens", 16)),
+                temperature=float(body.get("temperature", 0.0)),
+                top_k=(None if body.get("top_k") is None
+                       else int(body["top_k"])),
+                eos_id=(None if body.get("eos_id") is None
+                        else int(body["eos_id"])),
+                deadline_s=(None if body.get("deadline_s") is None
+                            else float(body["deadline_s"])),
+                queue_timeout_s=(
+                    None if body.get("queue_timeout_s") is None
+                    else float(body["queue_timeout_s"])))
+        except (TypeError, ValueError) as e:
+            return None, None, (400, {"error": str(e)}, ())
+        with self._engine_access():
+            if self._draining or self._stopped:
+                self._bump("rejected_503")
+                return None, None, (503, {"error": "draining"}, ())
+            sched = self.engine.scheduler
+            if sched.full and self.engine.shed_policy == "reject-new":
+                # answer the shed synchronously, BEFORE the engine
+                # would mint a terminal for it: the client gets 429 +
+                # Retry-After and the engine never hears about it
+                retry = sched.retry_after_s(self.engine.n_slots,
+                                            self._round_s)
+                self._bump("rejected_429")
+                if self.engine.tracer is not None:
+                    self.engine.tracer.incr("serving_gateway_429")
+                return None, None, (
+                    429, {"error": "queue full",
+                          "retry_after_s": retry},
+                    (("Retry-After", retry),))
+            try:
+                rid = self.engine.submit(req)
+            except ValueError as e:
+                return None, None, (400, {"error": str(e)}, ())
+            live = _Live()
+            self._live[rid] = live
+            if (self.admission_grace_s > 0 and self._grace_t0 is None
+                    and not any(s is not None
+                                for s in self.engine._slots)):
+                # first arrival at an idle engine opens the
+                # batch-formation window (_hold_for_grace)
+                self._grace_t0 = time.monotonic()
+            # under shed-oldest a full queue just evicted someone
+            # else; their terminal flows through the normal drain
+            self._wake.notify_all()
+        return rid, live, None
+
+    def cancel(self, rid: int) -> bool:
+        with self._engine_access():
+            ok = self.engine.cancel(rid)
+            if ok:
+                self._wake.notify_all()
+        return ok
+
+    # -- endpoint bodies (called from handler threads) ------------------
+    def _handle_generate(self, handler: _GatewayHandler,
+                         stream: bool) -> None:
+        self._bump("connections")
+        try:
+            body = handler.read_json()
+            if not isinstance(body, dict):
+                raise ValueError(
+                    f"expected a JSON object, got "
+                    f"{type(body).__name__}")
+        except (ValueError, UnicodeDecodeError) as e:
+            handler.send_json({"error": f"bad JSON body: {e}"}, 400,
+                              close=True)
+            return
+        rid, live, reject = self._submit(body)
+        if reject is not None:
+            code, payload, headers = reject
+            handler.send_json(payload, code, close=True,
+                              headers=headers)
+            return
+        if stream:
+            self._stream_response(handler, rid, live)
+        else:
+            self._blocking_response(handler, rid, live)
+
+    def _blocking_response(self, handler, rid: int,
+                           live: _Live) -> None:
+        deadline = (None if self.request_timeout_s is None
+                    else time.monotonic() + self.request_timeout_s)
+        try:
+            while not live.done.is_set():
+                if self._stopped:
+                    handler.send_json(
+                        {"error": "gateway closed", "id": rid}, 503,
+                        close=True)
+                    return
+                if deadline is not None and time.monotonic() > deadline:
+                    self.cancel(rid)
+                    live.done.wait(timeout=5.0)
+                    break
+                live.done.wait(timeout=0.05)
+            res = live.result
+            if res is None:  # gateway closed or drained mid-request
+                handler.send_json(
+                    {"error": "gateway closed or drained; poll "
+                              "/v1/requests/<id> after the next boot",
+                     "id": rid}, 503, close=True)
+                return
+            headers = ()
+            if res.finish_reason == "shed":
+                # shed-oldest victims and queue timeouts learn when to
+                # come back, same as the synchronous reject-new 429
+                with self._engine_access():
+                    headers = (("Retry-After",
+                                self.engine.scheduler.retry_after_s(
+                                    self.engine.n_slots,
+                                    self._round_s)),)
+            handler.send_json(_result_dict(res),
+                              STATUS_OF_REASON.get(res.finish_reason,
+                                                   200),
+                              close=True, headers=headers)
+        finally:
+            self._forget(rid)
+
+    def _stream_response(self, handler, rid: int, live: _Live) -> None:
+        """Chunked SSE: an initial ``{"id": ...}`` event (so the client
+        can DELETE /v1/requests/<id> mid-stream), one ``{"id",
+        "tokens"}`` event per engine delta, keep-alive comment pings
+        while idle, and a final ``{"done": true, ...}`` event carrying
+        the full result + mapped status. Any write failure means the
+        client vanished: the request is cancelled and its slot freed."""
+        self._bump("streams")
+        try:
+            handler.start_stream("text/event-stream")
+            handler.send_event({"id": rid})
+            while True:
+                try:
+                    item = live.events.get(timeout=self.keepalive_s)
+                except Empty:
+                    if self._stopped:
+                        break
+                    handler.send_ping()
+                    continue
+                if item is None:
+                    # drained mid-request: the stream ends without a
+                    # terminal event (the request finishes after the
+                    # next boot — poll GET /v1/requests/<id> there)
+                    break
+                if isinstance(item, GenerationResult):
+                    out = _result_dict(item)
+                    out["done"] = True
+                    handler.send_event(out)
+                    break
+                handler.send_event({"id": rid, "tokens": item})
+            handler.end_stream()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the peer is gone: release its compute immediately
+            self._bump("disconnect_cancels")
+            if self.engine.tracer is not None:
+                self.engine.tracer.incr(
+                    "serving_gateway_disconnect_cancelled")
+            self.cancel(rid)
+        finally:
+            self._forget(rid)
+
+    def _handle_cancel(self, handler, path: str) -> None:
+        rid = self._rid_of(handler, path)
+        if rid is None:
+            return
+        ok = self.cancel(rid)
+        with self._engine_access():
+            done = rid in self._results
+        handler.send_json({"id": rid, "cancelled": ok, "done": done},
+                          200 if (ok or done) else 404, close=True)
+
+    def _handle_poll(self, handler, path: str) -> None:
+        rid = self._rid_of(handler, path)
+        if rid is None:
+            return
+        with self._engine_access():
+            res = self._results.get(rid)
+            # a request is "running" if a connection still owns it OR
+            # the engine still tracks its id (restored requests have
+            # no connection: their results become pollable when done)
+            running = (rid in self._live
+                       or rid in self.engine.scheduler._issued)
+        if res is not None:
+            handler.send_json(_result_dict(res), 200, close=True)
+        elif running:
+            handler.send_json({"id": rid, "running": True}, 202,
+                              close=True)
+        else:
+            handler.send_json({"error": f"unknown request {rid}"},
+                              404, close=True)
+
+    @staticmethod
+    def _rid_of(handler, path: str) -> Optional[int]:
+        tail = path.rsplit("/", 1)[-1]
+        try:
+            return int(tail)
+        except ValueError:
+            handler.send_json({"error": f"bad request id {tail!r}"},
+                              400, close=True)
+            return None
+
+    def _health(self) -> Dict[str, Any]:
+        with self._engine_access():
+            eng = self.engine
+            return {
+                "ok": not self._stopped,
+                "draining": self._draining,
+                "round": eng._round,
+                "queued": eng.scheduler.pending,
+                "active_slots": sum(s is not None for s in eng._slots),
+                "n_slots": eng.n_slots,
+                "requests_finished": eng.stats["requests_finished"],
+            }
+
+    def _metrics_text(self) -> str:
+        with self._engine_access():
+            # refresh gateway gauges right before export so the text
+            # reflects this instant, not the last decode round
+            tracer = self.engine.tracer
+            tracer.counter("serving_gateway_queue_depth",
+                           self.engine.scheduler.pending)
+            tracer.counter("serving_gateway_active_slots",
+                           sum(s is not None
+                               for s in self.engine._slots))
+            tracer.counter("serving_gateway_round_time_s",
+                           self._round_s)
+            for key, value in self.stats.items():
+                tracer.counter(f"serving_gateway_{key}", value)
+            return tracer.prometheus_text()
+
+    # -- drain / snapshot ----------------------------------------------
+    def drain(self, timeout_s: Optional[float] = None
+              ) -> Dict[str, Any]:
+        """Graceful-shutdown phase 1: stop admitting (new generates get
+        503), let the stepping loop settle in-flight work for up to
+        ``timeout_s`` seconds (None = until idle), then PAUSE stepping
+        and persist ``engine.snapshot()`` to ``snapshot_path`` (when
+        configured). Whatever had not finished inside the budget is in
+        the snapshot — :meth:`boot` on the next process finishes those
+        very ids. Returns a summary: requests finished here, requests
+        carried in the snapshot, the snapshot path."""
+        with self._engine_access():
+            self._draining = True
+        t0 = time.monotonic()
+        while True:
+            with self._engine_access():
+                idle = not self.engine.has_work()
+            if idle:
+                break
+            if (timeout_s is not None
+                    and time.monotonic() - t0 > timeout_s):
+                break
+            time.sleep(0.005)
+        with self._engine_access():
+            self._paused = True
+            carried = (self.engine.scheduler.pending
+                       + len(self.engine._pending)
+                       + len(self.engine._requeue)
+                       + sum(s is not None
+                             for s in self.engine._slots))
+            snap_path = None
+            if self.snapshot_path is not None:
+                snap = self.engine.snapshot()
+                tmp = self.snapshot_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(snap, f)
+                os.replace(tmp, self.snapshot_path)
+                snap_path = self.snapshot_path
+            # carried requests will finish in the NEXT process — their
+            # still-connected handlers must not ping/spin until this
+            # one exits: end their streams (no terminal event) and
+            # release their blocking waits (result None → 503)
+            for live in self._live.values():
+                if live.result is None:
+                    live.events.put(None)
+                    live.done.set()
+        if self.engine.tracer is not None:
+            self.engine.tracer.incr("serving_gateway_drained")
+        return {"drained": carried == 0, "carried": carried,
+                "snapshot": snap_path,
+                "finished": self.engine.stats["requests_finished"]}
+
+    def _handle_drain(self, handler) -> None:
+        try:
+            body = handler.read_json()
+            timeout = body.get("timeout_s")
+            timeout = None if timeout is None else float(timeout)
+        except (ValueError, UnicodeDecodeError, AttributeError) as e:
+            handler.send_json({"error": f"bad drain body: {e}"}, 400,
+                              close=True)
+            return
+        summary = self.drain(timeout)
+        handler.send_json(summary, 200, close=True)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import math
 from collections import deque
 from typing import Deque, List, Optional, Sequence
 
@@ -313,6 +314,19 @@ class Scheduler:
         it). This is the prefill work the engine owes before the queue
         drains."""
         return sum(len(r.prompt) for r in self._queue)
+
+    def retry_after_s(self, n_slots: int, round_time_s: float) -> int:
+        """Whole-seconds backpressure hint for a shedding front door's
+        ``Retry-After`` header (ISSUE 5): with ``depth`` requests
+        queued ahead of a would-be arrival and ``n_slots`` of them
+        admitted per drain wave, capacity is roughly
+        ``ceil(depth / n_slots)`` scheduling rounds away; scaled by the
+        measured per-round wall time and floored at 1 s (the header's
+        useful minimum — a sub-second hint just invites an immediate
+        re-shed). The estimate is deliberately coarse: its job is to
+        spread retries out, not to promise a slot."""
+        waves = math.ceil(max(len(self._queue), 1) / max(n_slots, 1))
+        return max(1, math.ceil(waves * max(round_time_s, 0.0)))
 
     def record_acceptance(self, drafted: int, accepted: int) -> int:
         """Feed one speculative verify round's outcome into the

@@ -1,11 +1,22 @@
 """Shared JSON-over-HTTP scaffolding for control-plane services.
 
-One base for the coordinator (scaleout/coordinator.py) and the UI server
-(ui/server.py): a silenced BaseHTTPRequestHandler with JSON helpers and a
-threaded server lifecycle wrapper. Handlers must compute their response
-payload first (holding any state lock) and only then call ``send_json`` —
-never write the socket while holding a lock, or one slow-reading client
-stalls every other request (including heartbeats).
+One base for the coordinator (scaleout/coordinator.py), the UI server
+(ui/server.py), and the serving gateway (serving/gateway.py): a silenced
+BaseHTTPRequestHandler with JSON helpers, chunked-transfer streaming,
+and a threaded server lifecycle wrapper. Handlers must compute their
+response payload first (holding any state lock) and only then call
+``send_json`` — never write the socket while holding a lock, or one
+slow-reading client stalls every other request (including heartbeats).
+
+Connection lifetime is BOUNDED (ISSUE 5 satellite): every handler
+carries a socket ``timeout`` (class attribute, overridable per service
+via ``HttpService(..., timeout=...)``), so a half-open client that
+connects and never sends a request — or stops reading mid-response —
+cannot pin a ``ThreadingHTTPServer`` thread forever: the blocked read
+times out, ``BaseHTTPRequestHandler`` flags ``close_connection``, and
+the thread exits. One-shot responses can additionally advertise
+``Connection: close`` (``send_json(..., close=True)``) so well-behaved
+clients don't hold keep-alive sockets the service will never reuse.
 """
 
 from __future__ import annotations
@@ -17,7 +28,17 @@ from typing import Any, Dict, Optional, Tuple
 
 
 class JsonHandler(BaseHTTPRequestHandler):
-    """Request handler base: JSON body parsing + JSON/bytes replies."""
+    """Request handler base: JSON body parsing + JSON/bytes replies +
+    chunked-transfer streaming (``start_stream``/``send_chunk``/
+    ``end_stream`` — requires ``protocol_version = "HTTP/1.1"`` on the
+    subclass; under HTTP/1.0 the stream falls back to
+    read-until-close framing)."""
+
+    #: per-connection socket timeout in seconds (socketserver applies
+    #: it in ``setup()``): bounds how long a stalled or vanished client
+    #: can hold a server thread between reads. None = unbounded (the
+    #: pre-ISSUE-5 behavior; no service uses it).
+    timeout: Optional[float] = 30.0
 
     def log_message(self, fmt: str, *args: Any) -> None:  # silence
         pass
@@ -28,16 +49,69 @@ class JsonHandler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(n))
 
-    def send_json(self, obj: Dict[str, Any], code: int = 200) -> None:
-        self.send_bytes(json.dumps(obj).encode(), "application/json", code)
+    def send_json(self, obj: Dict[str, Any], code: int = 200,
+                  close: bool = False,
+                  headers: Tuple[Tuple[str, str], ...] = ()) -> None:
+        self.send_bytes(json.dumps(obj).encode(), "application/json",
+                        code, close=close, headers=headers)
 
     def send_bytes(self, body: bytes, content_type: str,
-                   code: int = 200) -> None:
+                   code: int = 200, close: bool = False,
+                   headers: Tuple[Tuple[str, str], ...] = ()) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in headers:
+            self.send_header(name, str(value))
+        if close:
+            # explicit is kinder than implicit: the client learns the
+            # socket is one-shot instead of discovering it at EOF
+            self.send_header("Connection", "close")
+            self.close_connection = True
         self.end_headers()
         self.wfile.write(body)
+
+    # -- incremental (chunked-transfer) responses ----------------------
+    def start_stream(self, content_type: str = "text/event-stream",
+                     code: int = 200,
+                     headers: Tuple[Tuple[str, str], ...] = ()) -> None:
+        """Open an incremental response: headers go out now, the body
+        arrives in ``send_chunk`` pieces, ``end_stream`` terminates it.
+        When BOTH sides speak HTTP/1.1 the body is
+        chunked-transfer-encoded (each piece is a delimited chunk a
+        client can act on as it lands); for an HTTP/1.0 peer — where
+        chunked framing does not exist and RFC 7230 forbids sending
+        it — the pieces stream raw and end-of-body is the connection
+        closing."""
+        self._stream_chunked = (self.protocol_version >= "HTTP/1.1"
+                                and self.request_version >= "HTTP/1.1")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Cache-Control", "no-cache")
+        for name, value in headers:
+            self.send_header(name, str(value))
+        if self._stream_chunked:
+            self.send_header("Transfer-Encoding", "chunked")
+        else:
+            self.send_header("Connection", "close")
+        # a stream monopolizes its connection until it ends; never
+        # leave it open for a pipelined follow-up request
+        self.close_connection = True
+        self.end_headers()
+
+    def send_chunk(self, data: bytes) -> None:
+        if not data:
+            return  # a zero-length chunk would terminate the stream
+        if self._stream_chunked:
+            self.wfile.write(b"%X\r\n" % len(data) + data + b"\r\n")
+        else:
+            self.wfile.write(data)
+        self.wfile.flush()
+
+    def end_stream(self) -> None:
+        if self._stream_chunked:
+            self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
 
 
 class HttpService:
@@ -45,7 +119,8 @@ class HttpService:
 
     Subclasses (or callers) provide a concrete handler class; extra
     attributes are attached to a per-instance handler subclass so one
-    process can run several services."""
+    process can run several services (e.g. ``timeout=5.0`` to tighten
+    the per-connection read timeout for a test)."""
 
     def __init__(self, handler_cls, host: str = "127.0.0.1", port: int = 0,
                  **handler_attrs: Any):

@@ -28,6 +28,7 @@ EXAMPLES = [
     ("native_pjrt_client.py", []),
     ("pipeline_4d_training.py", []),
     ("sequence_parallel_transformer.py", []),
+    ("serving_gateway.py", []),
     ("streaming_decode.py", []),
     ("word2vec_similarity.py", []),
 ]

@@ -1,0 +1,26 @@
+"""The gateway soak (scripts/gateway_soak.py) registered as tests: the
+fast variant rides tier-1, the full churn is ``slow``. The soak itself
+asserts the gateway-parity gates (every request terminal, completed
+streams bit-identical to the fault-free in-process reference, zero
+leaked threads/slots, in-process compile budget)."""
+
+import pytest
+
+from scripts.gateway_soak import run_soak
+
+
+def test_gateway_soak_fast():
+    summary = run_soak(n_clients=14, seed=0, fault_rate=0.08)
+    assert summary["completed"] >= 4
+    assert summary["parity_ok"] == summary["completed"]
+    assert summary["disconnected"] + summary["cancelled"] >= 1
+    assert summary["leaked_threads"] == 0
+
+
+@pytest.mark.slow
+def test_gateway_soak_full():
+    summary = run_soak(n_clients=48, seed=0)
+    assert summary["completed"] >= 10
+    assert summary["disconnected"] >= 3
+    assert summary["faults_injected"] >= 5
+    assert summary["leaked_threads"] == 0
