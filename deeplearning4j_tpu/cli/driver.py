@@ -390,7 +390,10 @@ def gateway_from_args(args):
             admission_policy=args.admission_policy,
             max_queue=args.max_queue,
             paranoid=args.paranoid,
-            spec_draft_len=args.spec_draft_len)
+            spec_draft_len=args.spec_draft_len,
+            paged_kv=args.paged_kv,
+            block_tokens=args.block_tokens,
+            kv_blocks=args.kv_blocks)
 
     return ServingGateway.boot(
         engine, snapshot_path=args.snapshot,
@@ -509,6 +512,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-round health check + quarantine")
     s.add_argument("--spec-draft-len", type=int, default=0,
                    help="speculative n-gram draft length K (0 = off)")
+    s.add_argument("--paged-kv", action="store_true",
+                   help="paged KV memory: one block pool shared by "
+                        "slots and the prefix trie (zero-copy prefix "
+                        "hits, more concurrent slots per byte)")
+    s.add_argument("--block-tokens", type=int, default=16,
+                   help="tokens per KV block (pow2; paged mode)")
+    s.add_argument("--kv-blocks", type=int, default=None,
+                   help="block-pool size (default: the dense "
+                        "layout's byte budget)")
     s.add_argument("--snapshot", default=None,
                    help="drain-snapshot path: written on shutdown, "
                         "restored on boot when present")

@@ -220,6 +220,128 @@ class AttentionImpl(LayerImplBase):
         return {"k": ck, "v": cv, "filled": filled}
 
     @classmethod
+    def _paged_attend(cls, lc, q, k, v, cache, mask=None):
+        """Gather-by-block-table attention over the shared KV block
+        pool (the serving engine's ``paged_kv=True`` layout — vLLM's
+        PagedAttention memory model on the XLA level: the pallas
+        double-buffered kernel in boom_attention_tricks.md is the TPU
+        hot-path successor, this program is its semantics).
+
+        The cache dict is NOT a per-slot row but a view into one pool
+        shared by every slot and the radix prefix trie:
+
+        - ``pk``/``pv`` [n_blocks, block_tokens, H, dh] — the device
+          pool; a block holds ``block_tokens`` consecutive tokens of
+          exactly one logical sequence (possibly shared by several
+          slots/trie entries via host-side refcounts).
+        - ``table`` [B, S] int32 — each row's ring-addressed block
+          table: logical block ``g`` (covering absolute token
+          positions ``[g*bt, (g+1)*bt)``) lives at ring slot
+          ``g % S``; -1 = unmapped.
+        - ``base`` [B, S] int32 — ``g*bt`` for the block each ring
+          slot currently holds (validates ring-slot occupancy: a slot
+          whose base disagrees with the probed logical block is stale
+          and masked).
+        - ``floor`` [B] int32 — minimum valid absolute position (a
+          prefix-trie splice of a window-slid entry exposes only the
+          positions the entry actually stored).
+        - ``filled`` [B] int32 — absolute length = the next write
+          position (NOT capped at the window, unlike the dense cache).
+
+        Per call: the chunk's K/V scatter into the pool at their
+        absolute positions THROUGH the table (one flat scatter; pad
+        positions and unmapped rows drop), then every query gathers
+        the ``<= window + t`` tokens its sliding window can reach and
+        attends under exactly the dense path's validity rule — causal,
+        last-``stream_max_t`` window, per-row floor. Writes precede
+        the gather inside one program, so position ``p``'s content is
+        committed before any query with ``qpos >= p`` reads it; stale
+        garbage past ``filled`` is causally masked and overwritten by
+        the next append (the rewind contract of
+        ``nn.streaming.drop_newest_tokens``). The host guarantees
+        every block written here has refcount 1 (copy-on-write happens
+        before dispatch), so shared prefix blocks are never mutated."""
+        tm = lc.stream_max_t
+        b, h, t, dh = q.shape
+        if not lc.causal:
+            raise ValueError(
+                "non-causal (bidirectional) attention cannot stream: "
+                "rnn_time_step continuation would need future tokens; "
+                "use causal=True or run output() on full sequences")
+        pk, pv = cache["pk"], cache["pv"]
+        table, base = cache["table"], cache["base"]
+        floor, filled = cache["floor"], cache["filled"]
+        nb, bt = pk.shape[0], pk.shape[1]
+        n_tok = nb * bt
+        s_ring = table.shape[1]
+        pkf = pk.reshape(n_tok, h, dh)
+        pvf = pv.reshape(n_tok, h, dh)
+        if mask is None:
+            lengths = jnp.full((b,), t, jnp.int32)
+        else:
+            lengths = jnp.sum(mask.astype(jnp.int32), axis=1)
+        # -- scatter the chunk's K/V to their absolute positions ------
+        pos = filled[:, None] + jnp.arange(t)[None, :]        # [B, t]
+        blk = jnp.take_along_axis(table, (pos // bt) % s_ring, axis=1)
+        writable = (jnp.arange(t)[None, :] < lengths[:, None]) & (
+            blk >= 0)
+        widx = jnp.where(writable, blk * bt + pos % bt, n_tok)
+        kt = jnp.swapaxes(k, 1, 2).reshape(b * t, h, dh)
+        vt = jnp.swapaxes(v, 1, 2).reshape(b * t, h, dh)
+        pkf = pkf.at[widx.reshape(-1)].set(kt.astype(pkf.dtype),
+                                           mode="drop")
+        pvf = pvf.at[widx.reshape(-1)].set(vt.astype(pvf.dtype),
+                                           mode="drop")
+        # -- gather each row's reachable window -----------------------
+        # consecutive logical blocks from the earliest any query needs
+        # (bounded per-executable: ~window + chunk tokens, NOT the
+        # whole ring — the decode step reads ~window keys like dense)
+        ntab = min(s_ring, (tm + t - 2) // bt + 2)
+        lo = jnp.maximum(floor, jnp.maximum(filled - tm + 1, 0))
+        g = lo[:, None] // bt + jnp.arange(ntab)[None, :]  # [B, ntab]
+        tb = jnp.take_along_axis(table, g % s_ring, axis=1)
+        bb = jnp.take_along_axis(base, g % s_ring, axis=1)
+        bval = (tb >= 0) & (bb == g * bt)          # ring slot holds g
+        off = jnp.arange(bt)
+        gidx = (jnp.where(bval, tb, 0)[:, :, None] * bt
+                + off[None, None, :]).reshape(b, ntab * bt)
+        kpos = (g[:, :, None] * bt
+                + off[None, None, :]).reshape(b, ntab * bt)
+        kval = jnp.repeat(bval, bt, axis=1)        # [B, ntab*bt]
+        ek = jnp.swapaxes(pkf[gidx], 1, 2)         # [B, H, K, dh]
+        ev = jnp.swapaxes(pvf[gidx], 1, 2)
+        # gather lanes outside each row's WRITTEN span carry foreign
+        # data: invalid-block lanes read a placeholder block, and a
+        # freshly (re)allocated tail block holds whatever its previous
+        # owner left there — possibly NaN under fault injection, since
+        # eviction releases blocks by reference without scrubbing. A
+        # NaN value survives a zero softmax weight (0 * NaN = NaN), so
+        # values must be zeroed at the VALUE level over the full
+        # validity rule — block mapped AND position inside
+        # [floor, filled + written) — or a recycled dirty block
+        # silently corrupts its next owner through masked lanes
+        # (caught by the chaos gate and the paranoid-off regression)
+        vlive = (kval
+                 & (kpos < (filled + lengths)[:, None])
+                 & (kpos >= floor[:, None]))
+        ev = jnp.where(vlive[:, None, :, None], ev, 0)
+        qpos = filled[:, None] + jnp.arange(t)[None, :]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ek) / jnp.sqrt(
+            jnp.asarray(dh, q.dtype))
+        ok = (kval[:, None, :]
+              & (kpos[:, None, :] <= qpos[:, :, None])      # causal
+              & (kpos[:, None, :] > qpos[:, :, None] - tm)  # window
+              & (kpos[:, None, :] >= floor[:, None, None]))
+        neg = jnp.asarray(-1e30, q.dtype)
+        scores = jnp.where(ok[:, None], scores, neg)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w, ev)
+        return o, {"pk": pkf.reshape(nb, bt, h, dh),
+                   "pv": pvf.reshape(nb, bt, h, dh),
+                   "table": table, "base": base, "floor": floor,
+                   "filled": filled + lengths}
+
+    @classmethod
     def _stream_attend(cls, lc, q, k, v, cache, mask=None):
         """Dense attention of the current chunk's queries over
         cache + chunk. The cache stays ``stream_max_t`` long (static
@@ -247,6 +369,12 @@ class AttentionImpl(LayerImplBase):
         tails afterwards via ``nn.streaming.drop_newest_tokens``).
         ``mask=None`` (the decode hot path) keeps the original,
         roll-free program."""
+        if isinstance(cache, dict) and "pk" in cache:
+            # paged block-pool layout (serving paged_kv engines): same
+            # streaming contract, storage indirected through per-row
+            # block tables — the dense row path below stays untouched
+            # for paged=False
+            return cls._paged_attend(lc, q, k, v, cache, mask)
         tm = lc.stream_max_t
         t = q.shape[2]
         if not lc.causal:

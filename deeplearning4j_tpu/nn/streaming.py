@@ -129,6 +129,16 @@ def drop_newest_tokens(rnn_state: Any, drop) -> Any:
                 f"streaming state for layer {name!r} carries no "
                 "KV-cache 'filled' vector — only attention caches can "
                 "be rewound by token")
+        if "pk" in st:
+            # paged block-pool cache (serving/block_pool.py): tokens
+            # live at fixed absolute positions in pool blocks, so a
+            # rewind is "pop blocks + mask tail" — the length counter
+            # moves back and the stale tail is masked by the causal
+            # position check in AttentionImpl._paged_attend (the next
+            # append overwrites it in place). Block bookkeeping (the
+            # pop) is host-side, in the engine's BlockTable.
+            out[name] = dict(st, filled=st["filled"] - drop)
+            continue
         out[name] = {
             "k": roll(st["k"], drop),
             "v": roll(st["v"], drop),

@@ -8,7 +8,11 @@ and crash-safe snapshot/resume (ISSUE 3 tentpole) — and
 self-speculative decoding: n-gram drafting with single-pass K-token
 verification (ISSUE 4 tentpole) — and the streaming HTTP serving
 gateway + client that turn the engine into a deployable server
-(ISSUE 5 tentpole)."""
+(ISSUE 5 tentpole) — and paged KV memory: one block-pool cache shared
+by decode slots and the prefix trie, with zero-copy prefix splices and
+copy-on-write divergence (ISSUE 6 tentpole, ``paged_kv=True``)."""
+
+from deeplearning4j_tpu.serving.block_pool import BlockPool, BlockTable
 
 from deeplearning4j_tpu.serving.client import (
     GatewayClient,
@@ -27,6 +31,7 @@ from deeplearning4j_tpu.serving.gateway import (
     ServingGateway,
 )
 from deeplearning4j_tpu.serving.prefix_cache import (
+    PagedPrefixCache,
     PrefixHit,
     RadixPrefixCache,
 )
@@ -43,6 +48,8 @@ from deeplearning4j_tpu.serving.scheduler import (
 from deeplearning4j_tpu.serving.spec import NgramDraftTable
 
 __all__ = [
+    "BlockPool",
+    "BlockTable",
     "DecodeEngine",
     "FAULT_KINDS",
     "FINISH_REASONS",
@@ -54,6 +61,7 @@ __all__ = [
     "GenerationResult",
     "ManualClock",
     "NgramDraftTable",
+    "PagedPrefixCache",
     "PrefixHit",
     "RadixPrefixCache",
     "Request",

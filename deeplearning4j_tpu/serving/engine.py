@@ -139,8 +139,12 @@ from deeplearning4j_tpu.nn.streaming import (
     drop_newest_tokens,
     scan_length_bucket,
 )
+from deeplearning4j_tpu.serving.block_pool import BlockPool, BlockTable
 from deeplearning4j_tpu.serving.faults import FaultEvent, FaultPlan, poison_rows
-from deeplearning4j_tpu.serving.prefix_cache import RadixPrefixCache
+from deeplearning4j_tpu.serving.prefix_cache import (
+    PagedPrefixCache,
+    RadixPrefixCache,
+)
 from deeplearning4j_tpu.serving.sampler import (
     greedy_acceptance,
     sample_tokens,
@@ -185,6 +189,11 @@ class _Pending:
     matched: int                  # prompt tokens reused from the cache
     hit: Any                      # PrefixHit lease to release, or None
     seq: List[int] = dataclasses.field(default_factory=list)
+    #: paged admissions (``paged_kv=True``): the slot's block table —
+    #: spliced trie blocks on a warm hit (suffix chunks then append
+    #: THROUGH it, zero-copy), or None until a cold admission's dense
+    #: prefill completes and scatters into freshly allocated blocks
+    tab: Optional[BlockTable] = None
 
     def __post_init__(self):
         if not self.seq:
@@ -374,7 +383,10 @@ class DecodeEngine:
                  spec_draft_len: int = 0,
                  draft_source: str = "ngram",
                  on_delta=None,
-                 emit_deltas: bool = False):
+                 emit_deltas: bool = False,
+                 paged_kv: bool = False,
+                 block_tokens: int = 16,
+                 kv_blocks: Optional[int] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
@@ -440,8 +452,69 @@ class DecodeEngine:
                                    pressure_high=pressure_high,
                                    pressure_low=pressure_low,
                                    spec_draft_len=self.spec_draft_len)
-        self.prefix_cache = (RadixPrefixCache(prefix_cache_rows)
-                             if prefix_cache_rows else None)
+        # -- paged KV block pool (ISSUE 6; default off = the
+        # bit-identical dense engine) ---------------------------------
+        self.paged_kv = bool(paged_kv)
+        self.block_tokens = int(block_tokens)
+        self._wmax = max(windows)      # widest layer window (block
+        #                                lifetimes honour every layer)
+        self.block_pool: Optional[BlockPool] = None
+        self._kv_tabs: List[Optional[BlockTable]] = (
+            [None] * self.n_slots)
+        self._ring_slots = 0
+        self.kv_blocks = 0
+        if self.paged_kv:
+            bt = self.block_tokens
+            if bt < 1 or (bt & (bt - 1)):
+                raise ValueError(
+                    f"block_tokens {bt} must be a power of two")
+            if bt > self.window:
+                raise ValueError(
+                    f"block_tokens {bt} exceeds the cache window "
+                    f"({self.window}) — a block must fit inside it")
+            # ring width: the window, plus the widest single dispatch
+            # (a blocking-mode suffix chunk can be a whole window) plus
+            # one round's decode/verify writes — sized so a logical
+            # block is never recycled while any in-flight query can
+            # still reach it (see AttentionImpl._paged_attend)
+            round_write = (self.decode_chunk + self.spec_draft_len + 1)
+            self._ring_slots = (
+                -(-self._wmax // bt) + -(-self.window // bt)
+                + -(-round_write // bt) + 3)
+            # one slot's worst-case residency: a full window of
+            # blocks, one round of decode/verify appends, plus
+            # boundary slack (ring width above is ADDRESSING span,
+            # not occupancy — slid-out blocks free as they expire)
+            slot_worst = (-(-self._wmax // bt)
+                          + -(-round_write // bt) + 3)
+            if kv_blocks is None:
+                # default: the DENSE layout's device bytes — n_slots
+                # window rows plus the dense prefix pool's rows — with
+                # per-slot append slack, so paged-on is an
+                # apples-to-apples swap that frees capacity instead of
+                # consuming more
+                kv_blocks = max(
+                    -(-self._wmax // bt)
+                    * (self.n_slots + int(prefix_cache_rows))
+                    + self.n_slots * (-(-round_write // bt) + 2),
+                    slot_worst)
+            self.kv_blocks = int(kv_blocks)
+            if self.kv_blocks < slot_worst:
+                raise ValueError(
+                    f"kv_blocks {self.kv_blocks} cannot hold one "
+                    f"slot's window + one round of writes "
+                    f"({slot_worst} blocks of {bt} tokens)")
+            self.block_pool = BlockPool(self.kv_blocks, bt)
+        if prefix_cache_rows and self.paged_kv:
+            # paged trie: entries lease pool BLOCKS (zero-copy); the
+            # row count caps entries, the block pool caps bytes
+            self.prefix_cache = PagedPrefixCache(
+                prefix_cache_rows, self.block_tokens,
+                ref_block=self.block_pool.ref,
+                release_block=self._release_block)
+        else:
+            self.prefix_cache = (RadixPrefixCache(prefix_cache_rows)
+                                 if prefix_cache_rows else None)
         #: host-side per-slot n-gram draft tables (None = spec off —
         #: the engine is then the bit-identical PR 3 engine)
         self.spec = (NgramDraftTable() if self.spec_draft_len
@@ -501,6 +574,12 @@ class DecodeEngine:
             "prefill_tokens_skipped": 0, "chunks_scheduled": 0,
             "spec_rounds": 0, "spec_fallback_rounds": 0,
             "spec_drafted": 0, "spec_accepted": 0,
+            # paged block-pool gauges (always present; nonzero only
+            # with paged_kv=True — gateway /v1/metrics exports them)
+            "blocks_free": self.kv_blocks, "blocks_used": 0,
+            "cow_copies": 0, "prefix_blocks_spliced": 0,
+            "frag_tokens": 0, "preempted": 0,
+            "paged_admit_deferred": 0,
         }
         for key in self.FAILURE_KEYS:
             self.stats[key] = 0
@@ -553,9 +632,21 @@ class DecodeEngine:
             return pool, tok, jnp.swapaxes(seq, 0, 1)  # [B, chunk]
 
         self._prefill_jit = jax.jit(prefill)
-        self._chunk_jit = jax.jit(chunk_prefill)
+        if self.paged_kv:
+            # donate the carried cache: the block pool rides EVERY
+            # paged dispatch as an operand, and without input-output
+            # aliasing each call would copy the whole pool just to
+            # write one round's blocks (measured 1.8x warm-TTFT
+            # regression on the CPU proxy; the dense path keeps its
+            # original no-donation behavior — callers there may hold
+            # the old buffers)
+            self._chunk_jit = jax.jit(chunk_prefill,
+                                      donate_argnums=(4,))
+            self._decode_jit = jax.jit(decode, donate_argnums=(2,))
+        else:
+            self._chunk_jit = jax.jit(chunk_prefill)
+            self._decode_jit = jax.jit(decode)
         self._admit_jit = jax.jit(admit)
-        self._decode_jit = jax.jit(decode)
         self._verify_jit = None
         if self.spec_draft_len:
             vocab, dtype = self.vocab, self.net._dtype
@@ -602,9 +693,73 @@ class DecodeEngine:
                               bonus[:, None], 0))
                 return new_pool, bonus, emitted, acc
 
-            self._verify_jit = jax.jit(verify)
+            self._verify_jit = (jax.jit(verify, donate_argnums=(2,))
+                                if self.paged_kv else jax.jit(verify))
+        self._scatter_jit = None
+        self._tok_jit = None
+        if self.paged_kv:
+            bt, s_ring = self.block_tokens, self._ring_slots
+
+            def scatter_row(pool, rnn1, table_row, length):
+                # paged admit: write a dense B=1 post-prefill row's
+                # valid window tokens to their ABSOLUTE positions in
+                # the slot's freshly-allocated blocks (the one
+                # whole-row write a COLD admission pays — dense mode
+                # pays the same row scatter into its slot pool, so
+                # cold-path cost is unchanged; warm admissions skip
+                # this entirely via the zero-copy splice)
+                out = {}
+                for name, st in pool.items():
+                    k1, v1 = rnn1[name]["k"], rnn1[name]["v"]
+                    fd = rnn1[name]["filled"][0]
+                    w = k1.shape[2]
+                    nbk = st["pk"].shape[0]
+                    n_tok = nbk * bt
+                    absp = length - w + jnp.arange(w)
+                    safe = jnp.clip(absp, 0)
+                    blk = table_row[(safe // bt) % s_ring]
+                    idx = jnp.where((absp >= length - fd) & (blk >= 0),
+                                    blk * bt + safe % bt, n_tok)
+                    kt = jnp.transpose(k1[0], (1, 0, 2))   # [W, H, dh]
+                    vt = jnp.transpose(v1[0], (1, 0, 2))
+                    h, dh = kt.shape[1], kt.shape[2]
+                    pkf = st["pk"].reshape(n_tok, h, dh).at[idx].set(
+                        kt.astype(st["pk"].dtype), mode="drop")
+                    pvf = st["pv"].reshape(n_tok, h, dh).at[idx].set(
+                        vt.astype(st["pv"].dtype), mode="drop")
+                    out[name] = {"pk": pkf.reshape(nbk, bt, h, dh),
+                                 "pv": pvf.reshape(nbk, bt, h, dh)}
+                return out
+
+            def put_tok(toks, tok1, slot):
+                return jax.lax.dynamic_update_slice(
+                    toks, tok1.astype(toks.dtype), (slot,))
+
+            self._scatter_jit = jax.jit(scatter_row,
+                                        donate_argnums=(0,))
+            self._tok_jit = jax.jit(put_tok)
         self._health_jit = None
-        if self.paranoid:
+        if self.paranoid and self.paged_kv:
+            vocab = self.vocab
+
+            def paged_health(pool, toks):
+                # per-BLOCK finiteness (ISSUE 6 satellite): the pool
+                # axis is blocks, not slots, so the sweep's verdict is
+                # per block and the HOST maps blocks -> victims via
+                # the block tables — quarantining a victim then
+                # releases references without scrubbing blocks shared
+                # with innocent slots
+                oks = []
+                for st in pool.values():
+                    for leaf in (st["pk"], st["pv"]):
+                        fin = jnp.isfinite(leaf.astype(jnp.float32))
+                        oks.append(jnp.all(
+                            fin.reshape(leaf.shape[0], -1), axis=1))
+                blocks_ok = functools.reduce(jnp.logical_and, oks)
+                return blocks_ok, (toks >= 0) & (toks < vocab)
+
+            self._health_jit = jax.jit(paged_health)
+        elif self.paranoid:
             vocab = self.vocab
 
             def health(pool, toks):
@@ -642,6 +797,10 @@ class DecodeEngine:
             counts["verify"] = n(self._verify_jit)
         if self._health_jit is not None:
             counts["health_check"] = n(self._health_jit)
+        if self.paged_kv:
+            counts["paged_scatter"] = n(self._scatter_jit)
+            counts["paged_tok"] = n(self._tok_jit)
+            counts.update(self.block_pool.compile_counts())
         if self.prefix_cache is not None:
             counts.update(self.prefix_cache.compile_counts())
         return counts
@@ -791,6 +950,8 @@ class DecodeEngine:
         prefix-cache lease and free the reserved slot."""
         if pending.hit is not None and self.prefix_cache is not None:
             self.prefix_cache.release(pending.hit)
+        self._free_table(pending.tab)
+        pending.tab = None
         self._reserved.discard(pending.slot)
         self._pending.remove(pending)
 
@@ -802,13 +963,207 @@ class DecodeEngine:
         finite and masked, so a poisoned slot stops existing. The
         slot's speculative draft state dies with it (a quarantined or
         cancelled slot must never donate drafts to its successor)."""
-        self._pool = clear_state_rows(self._pool, [slot])
+        if self.paged_kv:
+            # paged eviction releases REFERENCES: exclusively-owned
+            # blocks return to the free list (scrubbed there if the
+            # paranoid sweep poisoned them), blocks shared with the
+            # trie or other slots stay resident and untouched — the
+            # per-block quarantine contract (ISSUE 6 satellite)
+            tab = self._kv_tabs[slot]
+            self._kv_tabs[slot] = None
+            self._free_table(tab)
+        else:
+            self._pool = clear_state_rows(self._pool, [slot])
         self._slots[slot] = None
         self._temps[slot] = 0.0
         self._top_ks[slot] = self.vocab
         if self.spec is not None:
             self.spec.drop(slot)
         self.stats["evicted"] += 1
+
+    # -- paged block-pool plumbing (ISSUE 6) ---------------------------
+    def _release_block(self, bid: int) -> None:
+        """Drop one reference to a pool block; a block whose LAST
+        reference drops is returned to the free list — scrubbed first
+        if the paranoid sweep flagged it (never scrubbed while an
+        innocent sharer still reads it)."""
+        if self.block_pool.deref(bid):
+            if bid in self.block_pool.poisoned and self._pool is not None:
+                self._pool = self.block_pool.scrub_block_device(
+                    self._pool, bid)
+
+    def _free_table(self, tab: Optional[BlockTable]) -> None:
+        if tab is None:
+            return
+        for bid in list(tab.blocks.values()):
+            self._release_block(bid)
+        tab.blocks.clear()
+
+    def _paged_reserve(self, n: int, protect=()) -> bool:
+        """Make ``n`` blocks allocatable: first evict LRU prefix-trie
+        entries (references only — shared blocks stay resident), then
+        preempt the youngest unprotected slot(s), requeueing their
+        requests (greedy re-admissions regenerate identical ids, so
+        preemption is invisible to results — the continuous-batching
+        analogue of vLLM's recompute preemption)."""
+        pool = self.block_pool
+        while pool.free_blocks < n and self.prefix_cache is not None:
+            if not self.prefix_cache.evict_one():
+                break
+        while pool.free_blocks < n:
+            victim = None
+            for slot in range(self.n_slots - 1, -1, -1):
+                if (self._slots[slot] is not None
+                        and slot not in protect):
+                    victim = slot
+                    break
+            if victim is None:
+                return pool.free_blocks >= n
+            self._preempt_slot(victim)
+        return True
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Release a running slot's blocks under pool pressure and
+        requeue its request (no retry charge — nothing failed). The
+        re-admission prefills the prompt from scratch; a greedy
+        request regenerates bit-identical tokens, and the delta
+        high-water mark suppresses re-streaming. A SAMPLING request
+        that already streamed cannot be preempted honestly (the RNG
+        redraw would splice two sequences) — it terminates ``fault``,
+        the same contract quarantine applies."""
+        state = self._slots[slot]
+        self.stats["preempted"] += 1
+        if self.tracer is not None:
+            self.tracer.incr("serving_preempted")
+        self._slots[slot] = None
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = self.vocab
+        if self.spec is not None:
+            self.spec.drop(slot)
+        tab = self._kv_tabs[slot]
+        self._kv_tabs[slot] = None
+        self._free_table(tab)
+        if ((self.on_delta is not None or self.emit_deltas)
+                and state.request.temperature > 0
+                and self._delta_sent.get(state.request.id, 0) > 0):
+            self._record_terminal(state.request, state.tokens, "fault",
+                                  state.prefix_reused, state.ttft_s,
+                                  state.spec_drafted,
+                                  state.spec_accepted)
+            return
+        self._requeue.append((self._round + 1, state.request))
+
+    def _ensure_tab(self, tab: BlockTable, n_tokens: int,
+                    protect=()) -> bool:
+        """Make ``tab`` writable for the next ``n_tokens`` appends:
+        copy-on-write the partial tail block if the trie or another
+        slot still references it (the ONLY device copy sharing ever
+        costs — one block, not one row), and allocate the fresh blocks
+        the appends will cross into. False = the pool could not be
+        relieved (caller defers or preempts).
+
+        Invariant the sizing math rests on: no single append exceeds
+        the window (prompts are validated <= window at submit, chunk
+        widths are window-clamped), so one append's new blocks always
+        fit the ``slot_worst`` floor enforced on ``kv_blocks`` at
+        construction — after evicting/preempting everything else a
+        lone admission can always proceed (no defer livelock) — and
+        one dispatch can never wrap the ring onto itself."""
+        pool = self.block_pool
+        tail = tab.tail_block() if n_tokens > 0 else None
+        cow = tail is not None and pool.refcount(tail[1]) > 1
+        need = len(tab.new_logical_blocks(n_tokens)) + (1 if cow else 0)
+        if need and not self._paged_reserve(need, protect):
+            return False
+        if cow:
+            g, src = tab.tail_block()
+            dst = pool.alloc()
+            with self._span("serving.cow_copy", src=src, dst=dst):
+                self._pool = pool.copy_block_device(self._pool, src,
+                                                    dst)
+            tab.blocks[g] = dst
+            self._release_block(src)
+        for g in tab.new_logical_blocks(n_tokens):
+            old = g - self._ring_slots
+            if old in tab.blocks:   # safety: expired ring predecessor
+                self._release_block(tab.blocks.pop(old))
+            bid = pool.alloc()
+            if bid is None:
+                raise AssertionError("reserved allocation failed")
+            tab.blocks[g] = bid
+        return True
+
+    def _free_expired_blocks(self, tab: BlockTable) -> None:
+        """Release blocks that slid entirely out of every layer's
+        window (length is monotone within a round — the verify rewind
+        lands before this runs — so a released block can never swing
+        back into reach)."""
+        for g in sorted(tab.blocks):
+            if (g + 1) * self.block_tokens <= tab.length - self._wmax:
+                self._release_block(tab.blocks.pop(g))
+            else:
+                break
+
+    def _paged_rnn_rows(self, tabs):
+        """Assemble the paged rnn-state operand for a dispatch: the
+        shared pool leaves plus each row's ring-projected block table
+        (None rows — idle slots — map nothing; their writes drop and
+        their keys all mask)."""
+        b = len(tabs)
+        s_ring = self._ring_slots
+        table = np.full((b, s_ring), -1, np.int32)
+        base = np.full((b, s_ring), -1, np.int32)
+        floor = np.zeros(b, np.int32)
+        filled = np.zeros(b, np.int32)
+        for i, tab in enumerate(tabs):
+            if tab is None:
+                continue
+            table[i], base[i] = tab.arrays(s_ring)
+            floor[i] = tab.floor
+            filled[i] = tab.length
+        # per-layer COPIES of the (tiny) table operands: the paged
+        # dispatches donate their cache operand, and XLA rejects the
+        # same buffer donated through two pytree leaves
+        return {name: dict(st,
+                           table=jnp.asarray(table),
+                           base=jnp.asarray(base),
+                           floor=jnp.asarray(floor),
+                           filled=jnp.asarray(filled))
+                for name, st in self._pool.items()}
+
+    def _strip_pool(self, rnn):
+        """Back out the per-dispatch table operands, keeping only the
+        device pool leaves the engine owns between rounds."""
+        if not self.paged_kv:
+            return rnn
+        return {name: {"pk": st["pk"], "pv": st["pv"]}
+                for name, st in rnn.items()}
+
+    def _alloc_window_tab(self, length: int) -> Optional[BlockTable]:
+        """A fresh BlockTable covering the last ``min(length, wmax)``
+        absolute positions (what a dense B=1 prefill row holds) —
+        the cold-admission / restore-rebuild target for the jitted
+        scatter. None when the pool cannot be relieved."""
+        bt = self.block_tokens
+        floor = max(0, length - self._wmax)
+        gs = list(range(floor // bt, (length - 1) // bt + 1))
+        if not self._paged_reserve(len(gs)):
+            return None
+        tab = BlockTable(bt, length=length, floor=floor)
+        for g in gs:
+            tab.blocks[g] = self.block_pool.alloc()
+        return tab
+
+    def _paged_stats_refresh(self) -> None:
+        pool = self.block_pool
+        self.stats["blocks_free"] = pool.free_blocks
+        self.stats["blocks_used"] = pool.used_blocks
+        self.stats["cow_copies"] = pool.stats["cow_copies"]
+        self.stats["prefix_blocks_spliced"] = pool.stats["spliced"]
+        tabs = list(self._kv_tabs) + [p.tab for p in self._pending]
+        if isinstance(self.prefix_cache, PagedPrefixCache):
+            tabs.extend(self.prefix_cache._payloads.values())
+        self.stats["frag_tokens"] = pool.fragmentation_tokens(tabs)
 
     def _one_hot_prompt(self, prompt, bucket):
         x = np.zeros((1, self.vocab, bucket), np.float32)
@@ -824,16 +1179,47 @@ class DecodeEngine:
         pending admission for chunk-by-chunk progress between decode
         rounds (chunked mode)."""
         self._started.add(request.id)
-        rnn, matched, hit = None, 0, None
+        rnn, matched, hit, tab = None, 0, None, None
         if self.prefix_cache is not None:
             hit = self.prefix_cache.lookup(request.prompt)
-            if hit is not None:
+            if hit is not None and self.paged_kv:
+                payload = self.prefix_cache.payload(hit.row)
+                if hit.matched > payload.floor:
+                    # ZERO-COPY warm hit: reference the entry's blocks
+                    # up to the matched length — no prefix_fetch
+                    # gather, no row copy; the dense path's exact
+                    # one-token rewind is subsumed by referencing only
+                    # blocks below `matched` (suffix chunks append
+                    # through the table, CoW-ing the boundary block on
+                    # first write if it is still shared)
+                    matched = hit.matched
+                    bt = self.block_tokens
+                    tab = BlockTable(bt, length=matched,
+                                     floor=payload.floor)
+                    spliced = 0
+                    for g, bid in payload.blocks.items():
+                        if (g * bt < matched
+                                and (g + 1) * bt > payload.floor):
+                            tab.blocks[g] = bid
+                            self.block_pool.ref(bid)
+                            spliced += 1
+                    self.block_pool.stats["spliced"] += spliced
+                    self.stats["prefill_tokens_skipped"] += matched
+                    with self._span("serving.prefix_splice",
+                                    row=hit.row, matched=matched,
+                                    blocks=spliced):
+                        pass
+                else:
+                    self.prefix_cache.release(hit)
+                    hit = None
+            elif hit is not None:
                 matched = hit.matched
                 with self._span("serving.prefix_fetch", row=hit.row,
                                 matched=matched, drop=hit.drop):
                     rnn = self.prefix_cache.fetch(hit)
                 self.stats["prefill_tokens_skipped"] += matched
-        pending = _Pending(request, slot, rnn, None, 0, matched, hit)
+        pending = _Pending(request, slot, rnn, None, 0, matched, hit,
+                           tab=tab)
         if self.prefill_chunk:
             self._reserved.add(slot)
             self._pending.append(pending)
@@ -841,8 +1227,27 @@ class DecodeEngine:
         # blocking mode: the whole suffix in ONE pow2-bucketed prefill
         # (cold: the original admission path, bit for bit; warm: one
         # continuation chunk at the suffix's bucket)
-        self._advance_prefill(pending, pending.remaining)
+        if not self._advance_prefill(pending, pending.remaining):
+            self._defer_admission(pending)
+            return
         self._complete_admission(pending)
+
+    def _defer_admission(self, pending: _Pending) -> None:
+        """Back out an admission the block pool cannot currently hold
+        (paged mode only): release the trie lease and any spliced or
+        written blocks, free the reserved slot, and requeue the
+        request for the next round — decode drains slots and frees
+        blocks, so capacity recovers without shedding."""
+        if pending.hit is not None and self.prefix_cache is not None:
+            self.prefix_cache.release(pending.hit)
+            pending.hit = None
+        self._free_table(pending.tab)
+        pending.tab = None
+        self._reserved.discard(pending.slot)
+        if pending in self._pending:
+            self._pending.remove(pending)
+        self.stats["paged_admit_deferred"] += 1
+        self._requeue.append((self._round + 1, pending.request))
 
     def _advance_prefill(self, pending: _Pending, max_tokens: int):
         """Prefill the next ``<= max_tokens`` tokens of a pending
@@ -857,6 +1262,28 @@ class DecodeEngine:
         x, mask = self._one_hot_prompt(seg, width)
         temp = jnp.asarray([req.temperature], jnp.float32)
         top_k = jnp.asarray([req.top_k or self.vocab], jnp.int32)
+        if pending.tab is not None:
+            # paged WARM admission: the suffix chunk streams straight
+            # into the slot's block table (spliced trie blocks +
+            # freshly allocated ones) — no dense scratch row ever
+            # materializes, which is what makes the warm path
+            # zero-whole-row-copy
+            if not self._ensure_tab(pending.tab, len(seg)):
+                return False
+            rnn_in = self._paged_rnn_rows([pending.tab])
+            with self._span("serving.prefill_chunk", width=width,
+                            tokens=len(seg), done=pending.done,
+                            paged=True):
+                tok, rnn = self._chunk_jit(
+                    self.net.params, self.net.state, x, mask, rnn_in,
+                    temp, top_k, self._next_key())
+            self._pool = self._strip_pool(rnn)
+            pending.tab.length += len(seg)
+            pending.tok = tok
+            pending.done += len(seg)
+            self.stats["prefill_tokens"] += len(seg)
+            self.stats["chunks_scheduled"] += 1
+            return True
         if pending.rnn is None:
             # first cold segment: no carried state yet — the bucketed
             # cold-prefill executable establishes it
@@ -875,31 +1302,87 @@ class DecodeEngine:
         pending.done += len(seg)
         self.stats["prefill_tokens"] += len(seg)
         self.stats["chunks_scheduled"] += 1
+        return True
+
+    def _ensure_paged_pool(self, rnn1) -> None:
+        """Create the device block pool lazily from the first dense
+        B=1 streaming state (mirrors the dense pool's lazy creation;
+        shapes per layer: ``[kv_blocks, block_tokens, H, dh]``)."""
+        if self._pool is not None:
+            return
+        bt = self.block_tokens
+
+        def make(st):
+            k = st["k"]                          # [1, H, W, dh]
+            shape = (self.kv_blocks, bt, k.shape[1], k.shape[3])
+            return {"pk": jnp.zeros(shape, k.dtype),
+                    "pv": jnp.zeros(shape, st["v"].dtype)}
+
+        self._pool = {name: make(st) for name, st in rnn1.items()}
+        self._toks = jnp.zeros((self.n_slots,), jnp.int32)
 
     def _complete_admission(self, pending: _Pending):
         """Suffix fully prefilled: scatter the state + first token into
         the slot pool, store the prompt's state in the prefix cache,
-        and release the hit lease."""
+        and release the hit lease. Paged mode stores nothing twice:
+        the slot's blocks ARE the cache entry (zero-copy insert via
+        refcount bumps), and a cold admission's one scatter replaces
+        the dense admit row-write."""
         request, slot = pending.request, pending.slot
-        if self._pool is None:
-            self._pool = jax.tree_util.tree_map(
-                lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
-                                    a.dtype), pending.rnn)
-            self._toks = jnp.zeros((self.n_slots,), jnp.int32)
-        with self._span("serving.admit", slot=slot):
-            self._pool, self._toks = self._admit_jit(
-                self._pool, self._toks, pending.rnn, pending.tok,
-                jnp.asarray(slot, jnp.int32))
-        hit_row = None
-        if self.prefix_cache is not None:
-            # release BEFORE insert: the fetched state is an immutable
-            # snapshot, and on a tight cache the freed row lets the
-            # insert evict the stale ancestor instead of declining
-            if pending.hit is not None:
-                hit_row = pending.hit.row
-                self.prefix_cache.release(pending.hit)
-            self.prefix_cache.insert(request.prompt, pending.rnn)
-        self._reserved.discard(slot)
+        if self.paged_kv:
+            if pending.tab is None:
+                # cold: the dense B=1 prefill row scatters into
+                # freshly allocated blocks (cost parity with the
+                # dense admit scatter)
+                self._ensure_paged_pool(pending.rnn)
+                tab = self._alloc_window_tab(len(pending.seq))
+                if tab is None:
+                    self._defer_admission(pending)
+                    return
+                table_row, _ = tab.arrays(self._ring_slots)
+                with self._span("serving.admit", slot=slot,
+                                paged=True):
+                    self._pool = self._scatter_jit(
+                        self._pool, pending.rnn,
+                        jnp.asarray(table_row),
+                        jnp.asarray(tab.length, jnp.int32))
+            else:
+                tab = pending.tab
+                pending.tab = None
+            self._toks = self._tok_jit(self._toks, pending.tok,
+                                       jnp.asarray(slot, jnp.int32))
+            hit_row = None
+            if self.prefix_cache is not None:
+                if pending.hit is not None:
+                    hit_row = pending.hit.row
+                    self.prefix_cache.release(pending.hit)
+                # zero-copy insert: the trie references the slot's own
+                # blocks; the slot's next append CoWs the shared
+                # boundary block instead of corrupting the entry
+                self.prefix_cache.insert_blocks(request.prompt, tab)
+            self._kv_tabs[slot] = tab
+            self._reserved.discard(slot)
+        else:
+            if self._pool is None:
+                self._pool = jax.tree_util.tree_map(
+                    lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
+                                        a.dtype), pending.rnn)
+                self._toks = jnp.zeros((self.n_slots,), jnp.int32)
+            with self._span("serving.admit", slot=slot):
+                self._pool, self._toks = self._admit_jit(
+                    self._pool, self._toks, pending.rnn, pending.tok,
+                    jnp.asarray(slot, jnp.int32))
+            hit_row = None
+            if self.prefix_cache is not None:
+                # release BEFORE insert: the fetched state is an
+                # immutable snapshot, and on a tight cache the freed
+                # row lets the insert evict the stale ancestor instead
+                # of declining
+                if pending.hit is not None:
+                    hit_row = pending.hit.row
+                    self.prefix_cache.release(pending.hit)
+                self.prefix_cache.insert(request.prompt, pending.rnn)
+            self._reserved.discard(slot)
         # fetch the first token BEFORE stamping TTFT: the value fetch
         # is the sync point that forces the in-flight prefill/admit
         # dispatches to completion (async dispatch would otherwise
@@ -1044,17 +1527,48 @@ class DecodeEngine:
             if (slot is None or slot >= self.n_slots
                     or self._slots[slot] is None or self._pool is None):
                 return
-            self._pool = poison_rows(self._pool, [slot])
+            if self.paged_kv:
+                # poison the slot's EXCLUSIVELY-owned blocks (the ones
+                # its own decode writes touch — a sampler NaN lands
+                # there); shared prefix blocks model a different fault
+                # (cache_corrupt) and are immutable to this slot
+                tab = self._kv_tabs[slot]
+                excl = [b for b in (tab.blocks.values() if tab else [])
+                        if self.block_pool.refcount(b) == 1]
+                if not excl:
+                    return
+                self._pool = poison_rows(self._pool, excl)
+            else:
+                self._pool = poison_rows(self._pool, [slot])
         elif event.kind == "cache_corrupt":
-            if self.prefix_cache is None or self.prefix_cache.pool is None:
+            if self.prefix_cache is None:
                 return
-            rows = self.prefix_cache.stored_rows()
-            row = event.row if event.row is not None else (
-                rows[0] if rows else None)
-            if row is None or row not in rows:
-                return
-            self.prefix_cache.pool = poison_rows(
-                self.prefix_cache.pool, [row])
+            if self.paged_kv:
+                if self._pool is None:
+                    return
+                rows = self.prefix_cache.stored_rows()
+                row = event.row if event.row is not None else (
+                    rows[0] if rows else None)
+                if row is None or row not in rows:
+                    return
+                # bit-rot one block of the stored entry; the paranoid
+                # per-block sweep (or the splice victim's probe)
+                # catches it and invalidates the entry
+                blocks = self.prefix_cache.payload(row).blocks
+                if not blocks:
+                    return
+                bid = blocks[min(blocks)]
+                self._pool = poison_rows(self._pool, [bid])
+            else:
+                if self.prefix_cache.pool is None:
+                    return
+                rows = self.prefix_cache.stored_rows()
+                row = event.row if event.row is not None else (
+                    rows[0] if rows else None)
+                if row is None or row not in rows:
+                    return
+                self.prefix_cache.pool = poison_rows(
+                    self.prefix_cache.pool, [row])
         self.fault_plan.record(event)
         self._failure_event("faults_injected")
 
@@ -1085,10 +1599,32 @@ class DecodeEngine:
         for _, req in ready:
             self.scheduler.requeue(req)
 
+    def _paged_health(self):
+        """Run the per-block health executable and fold the verdict
+        back through the host block tables: returns
+        ``(bad_blocks: set, toks_ok: np.ndarray[B])``. Bad blocks are
+        remembered in the pool's poisoned set so they are scrubbed the
+        moment their last reference drops — never while an innocent
+        sharer still reads them."""
+        blocks_ok, toks_ok = self._health_jit(self._pool, self._toks)
+        blocks_ok = np.asarray(blocks_ok)
+        bad = {b for b in np.nonzero(~blocks_ok)[0].tolist()
+               if self.block_pool.refcount(b) > 0}
+        self.block_pool.poisoned.update(bad)
+        return bad, np.asarray(toks_ok)
+
+    def _slot_blocks_bad(self, slot: int, bad: set) -> bool:
+        tab = self._kv_tabs[slot]
+        return bool(tab and (set(tab.blocks.values()) & bad))
+
     def _row_healthy(self, slot: int) -> bool:
         """One slot's verdict from the (single) jitted health check —
         the at-admission probe for requests that finish before any
         decode round could sweep them."""
+        if self.paged_kv:
+            bad, toks_ok = self._paged_health()
+            return bool(toks_ok[slot]) and not self._slot_blocks_bad(
+                slot, bad)
         ok = np.asarray(self._health_jit(self._pool, self._toks))
         return bool(ok[slot])
 
@@ -1145,6 +1681,28 @@ class DecodeEngine:
         ``_quarantine_victim``. Returns the healthy subset of
         ``active`` — the poisoned round's tokens never reach a
         result."""
+        if self.paged_kv:
+            bad, toks_ok = self._paged_health()
+            healthy, victims = [], []
+            for slot in active:
+                if bool(toks_ok[slot]) and not self._slot_blocks_bad(
+                        slot, bad):
+                    healthy.append(slot)
+                else:
+                    victims.append(slot)
+            for slot in victims:
+                self._quarantine_victim(slot, self._slots[slot])
+            if bad and self.prefix_cache is not None:
+                # entries still holding poisoned blocks (cache bit-rot
+                # caught BEFORE any splice — the shared pool makes
+                # corruption visible immediately, a strictly smaller
+                # blast radius than the dense fetch-then-detect path)
+                for row in list(self.prefix_cache.stored_rows()):
+                    payload = self.prefix_cache.payload(row)
+                    if set(payload.blocks.values()) & bad:
+                        self.prefix_cache.invalidate_row(row)
+                        self._failure_event("faults_detected")
+            return healthy
         ok = np.asarray(self._health_jit(self._pool, self._toks))
         healthy = [s for s in active if bool(ok[s])]
         for slot in active:
@@ -1185,7 +1743,7 @@ class DecodeEngine:
                             else [])
         return drafts
 
-    def _dispatch_verify(self, drafts: Dict[int, List[int]]):
+    def _dispatch_verify(self, drafts: Dict[int, List[int]], pool_op):
         """Dispatch one batched draft-verify pass over the whole slot
         pool: pad every slot's draft to the round's pow2 width bucket
         (compile counts stay O(log K)) and run the single verify
@@ -1208,12 +1766,12 @@ class DecodeEngine:
             lens[slot] = len(toks)
         with self._span("serving.spec_verify", width=width,
                         drafted=int(lens.sum())):
-            self._pool, self._toks, emitted, acc = self._verify_jit(
-                self.net.params, self.net.state, self._pool,
+            pool_op, self._toks, emitted, acc = self._verify_jit(
+                self.net.params, self.net.state, pool_op,
                 self._toks, jnp.asarray(draft), jnp.asarray(lens),
                 jnp.asarray(self._temps), jnp.asarray(self._top_ks),
                 self._next_key())
-        return lens, emitted, acc
+        return pool_op, (lens, emitted, acc)
 
     def _land_verify(self, drafts: Dict[int, List[int]], lens,
                      emitted, acc):
@@ -1312,9 +1870,16 @@ class DecodeEngine:
             grants = self.scheduler.plan_chunks(
                 [p.remaining for p in self._pending],
                 verify_tokens=verify_reserve)
-            for i in grants:
-                self._advance_prefill(self._pending[i],
-                                      self.prefill_chunk)
+            targets = [self._pending[i] for i in grants]
+            deferred: set = set()
+            for p in targets:
+                if id(p) in deferred:
+                    continue
+                if not self._advance_prefill(p, self.prefill_chunk):
+                    # paged pool pressure: back the admission out and
+                    # retry next round (decode keeps its cadence)
+                    self._defer_admission(p)
+                    deferred.add(id(p))
             if self.tracer is not None:
                 self.tracer.counter("serving_round_prefill_chunks",
                                     len(grants))
@@ -1322,22 +1887,64 @@ class DecodeEngine:
                         if p.remaining == 0]
             for p in finished:
                 self._complete_admission(p)
-                self._pending.remove(p)
+                if p in self._pending:
+                    self._pending.remove(p)
         active = [i for i, s in enumerate(self._slots)
                   if s is not None]
         if active:
             drafts = (self._plan_drafts(active)
                       if self.spec is not None else None)
             spec_round = drafts is not None and any(drafts.values())
+            if self.paged_kv:
+                # allocation on demand: reserve every block this
+                # round's writes will cross into (verify width + the
+                # decode chunk), CoW-ing tail blocks still shared with
+                # the trie — under pool pressure the youngest slot is
+                # preempted (requeued, ids regenerate identically)
+                ensured: set = set()
+                for slot in list(active):
+                    if self._slots[slot] is None:
+                        continue   # preempted by an earlier reserve
+                    n_tok = self.decode_chunk
+                    if spec_round:
+                        n_tok += len(drafts.get(slot, ())) + 1
+                    if self._ensure_tab(self._kv_tabs[slot], n_tok,
+                                        protect=ensured | {slot}):
+                        ensured.add(slot)
+                    else:
+                        self._preempt_slot(slot)
+                # preemption (by _ensure_tab or explicit) may have
+                # emptied slots mid-list — rebuild the round's view
+                active = [s for s in active
+                          if self._slots[s] is not None]
+                if drafts is not None:
+                    drafts = {s: d for s, d in drafts.items()
+                              if s in active}
+                    spec_round = any(drafts.values())
+                if not active:
+                    # every slot was preempted for blocks: the round
+                    # ends with no decode (requeues drain next round)
+                    self._round += 1
+                    if (t_start is not None and self._clock() - t_start
+                            > self.stall_threshold_s):
+                        self._failure_event("slow_steps")
+                    self._drain_terminal(results)
+                    return results
             t0 = time.perf_counter()
             verify_out = None
+            pool_op = (self._paged_rnn_rows(self._kv_tabs)
+                       if self.paged_kv else self._pool)
             if spec_round:
                 # verify dispatch chains into the decode dispatch
                 # below (the scan resumes from the verified state), so
                 # a speculative round commits accepted drafts + bonus
                 # + a full decode chunk in ONE host round-trip — the
                 # round count can never exceed the spec-off engine's
-                verify_out = self._dispatch_verify(drafts)
+                # (paged: the rewind travels inside the executable as
+                # a filled decrement, and the post-verify filled rides
+                # the chained pytree into the decode scan)
+                pool_op, verify_out = self._dispatch_verify(drafts,
+                                                            pool_op)
             elif self.spec is not None:
                 # no slot drafted anything (no n-gram match, or every
                 # slot samples): plain decode — speculation is an
@@ -1345,12 +1952,13 @@ class DecodeEngine:
                 self.stats["spec_fallback_rounds"] += 1
             with self._span("serving.decode_chunk",
                             active=len(active)):
-                self._pool, self._toks, seq = self._decode_jit(
-                    self.net.params, self.net.state, self._pool,
+                pool_op, self._toks, seq = self._decode_jit(
+                    self.net.params, self.net.state, pool_op,
                     self._toks, jnp.asarray(self._temps),
                     jnp.asarray(self._top_ks), self._next_key())
                 seq = np.asarray(seq)  # [B, chunk]; forces the whole
                 #                        round (verify included) done
+            self._pool = self._strip_pool(pool_op)
             if verify_out is not None:
                 v_rows, v_n = self._land_verify(drafts, *verify_out)
                 rows = [list(v_rows[s][:int(v_n[s])]) + list(seq[s])
@@ -1358,6 +1966,17 @@ class DecodeEngine:
             else:
                 rows = seq
             dt = time.perf_counter() - t0
+            if self.paged_kv:
+                # mirror the device-side filled advance (decode chunk
+                # + verify's accepted+bonus) into the host tables, and
+                # release blocks that slid out of every window — the
+                # "pop blocks" half of the paged rewind contract
+                for slot in active:
+                    tab = self._kv_tabs[slot]
+                    tab.length += self.decode_chunk + (
+                        int(v_n[slot]) if verify_out is not None
+                        else 0)
+                    self._free_expired_blocks(tab)
             if self.paranoid:
                 active = self._quarantine(active)
             emitted = 0
@@ -1391,6 +2010,8 @@ class DecodeEngine:
                 self.tracer.counter("slot_occupancy", occ)
                 self.tracer.rate("serving_tokens_per_sec", emitted, dt)
                 self._emit_counters()
+        if self.paged_kv:
+            self._paged_stats_refresh()
         self._round += 1
         if t_start is not None:
             if self._clock() - t_start > self.stall_threshold_s:
@@ -1422,6 +2043,15 @@ class DecodeEngine:
                     "spec_fallback_rounds", "spec_drafted",
                     "spec_accepted"):
             self.tracer.counter(f"serving_{key}", self.stats[key])
+        if self.paged_kv:
+            # block-pool gauges (ISSUE 6 satellite): the gateway's
+            # /v1/metrics exports these tracks verbatim, so pool
+            # health is visible from the HTTP front door
+            self._paged_stats_refresh()
+            for key in ("blocks_free", "blocks_used", "cow_copies",
+                        "prefix_blocks_spliced", "frag_tokens",
+                        "preempted", "paged_admit_deferred"):
+                self.tracer.counter(f"serving_{key}", self.stats[key])
         if self.prefix_cache is not None:
             for key in ("hits", "misses", "evictions"):
                 self.tracer.counter(f"serving_prefix_{key}",
@@ -1457,6 +2087,21 @@ class DecodeEngine:
         if self.prefix_cache is None or not len(prefix):
             return
         rnn, _ = self._prefill_sequence([int(t) for t in prefix])
+        if self.paged_kv:
+            # re-prime into fresh blocks, hand ownership to the trie
+            # (the restore-path twin of the zero-copy live insert)
+            self._ensure_paged_pool(rnn)
+            tab = self._alloc_window_tab(len(prefix))
+            if tab is None:
+                return    # pool too small for this entry: skip —
+                #           the cache is a cache, not state
+            table_row, _ = tab.arrays(self._ring_slots)
+            self._pool = self._scatter_jit(
+                self._pool, rnn, jnp.asarray(table_row),
+                jnp.asarray(tab.length, jnp.int32))
+            self.prefix_cache.insert_blocks(prefix, tab)
+            self._free_table(tab)
+            return
         self.prefix_cache.insert(prefix, rnn)
 
     def _rebuild_slot(self, slot: int, request: Request,
@@ -1477,15 +2122,32 @@ class DecodeEngine:
         rnn, _ = self._prefill_sequence(seq, request.temperature,
                                         request.top_k)
         tok = jnp.asarray([int(tokens[-1])], jnp.int32)
-        if self._pool is None:
-            self._pool = jax.tree_util.tree_map(
-                lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
-                                    a.dtype), rnn)
-            self._toks = jnp.zeros((self.n_slots,), jnp.int32)
-        with self._span("serving.admit", slot=slot):
-            self._pool, self._toks = self._admit_jit(
-                self._pool, self._toks, rnn, tok,
-                jnp.asarray(slot, jnp.int32))
+        if self.paged_kv:
+            self._ensure_paged_pool(rnn)
+            tab = self._alloc_window_tab(len(seq))
+            if tab is None:
+                raise RuntimeError(
+                    "paged restore could not allocate blocks for a "
+                    "snapshotted slot — kv_blocks is smaller than the "
+                    "snapshot's working set")
+            table_row, _ = tab.arrays(self._ring_slots)
+            with self._span("serving.admit", slot=slot, paged=True):
+                self._pool = self._scatter_jit(
+                    self._pool, rnn, jnp.asarray(table_row),
+                    jnp.asarray(tab.length, jnp.int32))
+            self._toks = self._tok_jit(self._toks, tok,
+                                       jnp.asarray(slot, jnp.int32))
+            self._kv_tabs[slot] = tab
+        else:
+            if self._pool is None:
+                self._pool = jax.tree_util.tree_map(
+                    lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
+                                        a.dtype), rnn)
+                self._toks = jnp.zeros((self.n_slots,), jnp.int32)
+            with self._span("serving.admit", slot=slot):
+                self._pool, self._toks = self._admit_jit(
+                    self._pool, self._toks, rnn, tok,
+                    jnp.asarray(slot, jnp.int32))
         self._slots[slot] = _Slot(request, [int(t) for t in tokens],
                                   prefix_reused=prefix_reused,
                                   ttft_s=None,
@@ -1559,7 +2221,30 @@ class DecodeEngine:
                 "stall_threshold_s": self.stall_threshold_s,
                 "spec_draft_len": self.spec_draft_len,
                 "draft_source": self.draft_source,
+                "paged_kv": self.paged_kv,
+                "block_tokens": self.block_tokens,
+                "kv_blocks": self.kv_blocks,
             },
+            # paged bookkeeping rides the snapshot for inspection and
+            # exact-capacity restores (restore REBUILDS device blocks
+            # by re-prefilling recorded tokens — same as the dense
+            # engine — so tables here are provenance, not payload)
+            "paged": ({
+                "block_tokens": self.block_tokens,
+                "kv_blocks": self.kv_blocks,
+                "tables": {
+                    str(slot): {"length": tab.length,
+                                "floor": tab.floor,
+                                "blocks": {str(g): int(b)
+                                           for g, b
+                                           in tab.blocks.items()}}
+                    for slot, tab in enumerate(self._kv_tabs)
+                    if tab is not None},
+                "refcounts": {
+                    str(b): self.block_pool.refcount(b)
+                    for b in range(self.kv_blocks)
+                    if self.block_pool.refcount(b) > 0},
+            } if self.paged_kv else None),
             # draft TABLES are derived state (rebuilt from recorded
             # ids); only the adaptation point needs the wire format
             "spec": ({"draft_len": self.scheduler.draft_len,
@@ -1614,7 +2299,10 @@ class DecodeEngine:
             retry_backoff_rounds=cfg["retry_backoff_rounds"],
             stall_threshold_s=cfg["stall_threshold_s"], clock=clock,
             spec_draft_len=cfg.get("spec_draft_len", 0),
-            draft_source=cfg.get("draft_source", "ngram"))
+            draft_source=cfg.get("draft_source", "ngram"),
+            paged_kv=cfg.get("paged_kv", False),
+            block_tokens=cfg.get("block_tokens", 16),
+            kv_blocks=cfg.get("kv_blocks") or None)
         spec_state = snapshot.get("spec")
         if spec_state and eng.spec is not None:
             # resume K-adaptation where the crash left it (final ids

@@ -399,6 +399,19 @@ class RadixPrefixCache:
             node, depth = mid, depth + common
         return node
 
+    def clear(self) -> int:
+        """Drop every stored entry (rows still leased by an in-flight
+        admission are unmapped now and freed at the last release).
+        Returns the number of entries dropped — the soak's
+        pool-fully-free gate empties the trie through this."""
+        dropped = 0
+        for row in list(self._by_row):
+            node = self._by_row.get(row)
+            if node is not None:
+                self._drop_node(node)
+                dropped += 1
+        return dropped
+
     # -- introspection -------------------------------------------------
     @property
     def hit_rate(self) -> float:
@@ -421,3 +434,124 @@ class RadixPrefixCache:
 
     def leased_rows(self) -> Dict[int, int]:
         return dict(self._ref)
+
+
+class PagedPrefixCache(RadixPrefixCache):
+    """Radix prefix trie over the SHARED paged KV block pool (ISSUE 6):
+    the same path-compressed trie, leases, LRU and invalidation
+    machinery as the dense cache, but an entry's payload is a list of
+    block ids leased from the engine's :class:`~.block_pool.BlockPool`
+    instead of a private device row.
+
+    Consequences of the paged payload:
+
+    - **insert is zero-copy** — the entry references the admitted
+      slot's own blocks (refcount bumps via ``ref_block``); no
+      ``prefix_store`` executable exists, and the slot's subsequent
+      appends copy-on-write the shared boundary block instead of
+      mutating it.
+    - **a hit is zero-copy** — the engine splices the payload's block
+      ids into the new slot's table (no ``prefix_fetch`` gather); the
+      dense cache's exact one-token rewind survives as "reference one
+      block fewer / CoW the boundary block" (drop_newest_tokens
+      semantics moved to the host).
+    - **eviction frees references, not bytes** — dropping an entry
+      derefs its blocks via ``release_block``; a block shared with a
+      live slot stays resident until the slot finishes, so evicting an
+      entry mid-use can never corrupt a reader.
+
+    ``rows`` caps the number of ENTRIES (ids recycle through the base
+    machinery); device capacity is governed by the block pool itself.
+    The base class's jitted row movers are never invoked —
+    ``compile_counts`` is empty, which the bench's zero-whole-row-copy
+    gate asserts."""
+
+    def __init__(self, rows: int, block_tokens: int, ref_block,
+                 release_block):
+        super().__init__(rows)
+        self.block_tokens = int(block_tokens)
+        self._ref_block = ref_block
+        self._release_block = release_block
+        self._payloads: Dict[int, Any] = {}
+
+    def compile_counts(self) -> Dict[str, int]:
+        return {}
+
+    def fetch(self, hit: PrefixHit):
+        raise NotImplementedError(
+            "paged prefix hits are spliced (zero-copy block-table "
+            "reference), not fetched — see DecodeEngine paged "
+            "admission")
+
+    def insert(self, prompt: Sequence[int], rnn1: Any) -> bool:
+        raise NotImplementedError(
+            "paged prefix entries reference pool blocks — use "
+            "insert_blocks")
+
+    def payload(self, row: int):
+        """The :class:`~.block_pool.BlockTable` payload stored under
+        an entry id returned by ``lookup``."""
+        return self._payloads[row]
+
+    def insert_blocks(self, prompt: Sequence[int], tab) -> bool:
+        """Store a prompt's KV footprint as references to ``tab``'s
+        blocks (a frozen snapshot of the admitted slot's table —
+        refcount +1 per block, zero device work). Duplicate prompts
+        refresh recency only; an exhausted entry table evicts LRU
+        unleased entries exactly like the dense cache."""
+        tokens = tuple(int(t) for t in prompt)
+        if not tokens:
+            return False
+        node, depth = self._walk(tokens)
+        if depth == len(tokens) and node.row is not None:
+            self._touch(node)
+            return False
+        row = self._alloc_row()
+        if row is None:
+            self.stats["declined"] += 1
+            return False
+        # re-walk after allocation (LRU eviction may have pruned the
+        # first walk's path — same hazard as the dense insert)
+        node, depth = self._walk(tokens)
+        from deeplearning4j_tpu.serving.block_pool import BlockTable
+
+        frozen = BlockTable(self.block_tokens, dict(tab.blocks),
+                            tab.length, tab.floor)
+        for bid in frozen.blocks.values():
+            self._ref_block(bid)
+        self._payloads[row] = frozen
+        node = self._graft(node, depth, tokens)
+        node.row = row
+        self._by_row[row] = node
+        self._touch(node)
+        self.stats["inserts"] += 1
+        return True
+
+    def _drop_node(self, node: _Node) -> int:
+        payload = self._payloads.pop(node.row, None)
+        if payload is not None:
+            for bid in payload.blocks.values():
+                self._release_block(bid)
+        return super()._drop_node(node)
+
+    def evict_one(self) -> bool:
+        """Evict the LRU unleased entry to relieve BLOCK-pool pressure
+        (the engine calls this when allocation fails). Returns False
+        when nothing is evictable. Unlike the dense path the freed
+        resource is the blocks' references — the entry id goes back to
+        the free list."""
+        row = self._evict_lru()
+        if row is None:
+            return False
+        # _evict_lru pulls the row off the free list for immediate
+        # dense-pool reuse; here the id itself is the only resource
+        self._free.append(row)
+        return True
+
+    def block_ids(self) -> List[int]:
+        """Every block id currently referenced by a stored entry
+        (soak accounting + fault-injection targeting)."""
+        out: List[int] = []
+        for payload in self._payloads.values():
+            out.extend(payload.blocks.values())
+        return out

@@ -1,0 +1,179 @@
+"""Fragmentation soak for the paged KV block pool (ISSUE 6).
+
+Churns seeded ragged-length requests — several shared-prefix cohorts
+plus unique-prompt traffic — through a ``paged_kv=True`` engine on a
+DELIBERATELY tight ``kv_blocks`` budget, so every pressure path runs
+hot: zero-copy splices, boundary-block CoW, trie evictions for blocks,
+admission defers, and youngest-slot preemption. The pass criteria:
+
+- every request reaches a terminal state and every greedy finish is
+  BIT-IDENTICAL to the same workload on the DENSE engine (preemption,
+  deferral, and sharing must all be invisible in ids);
+- zero leaked blocks: once idle, the pool holds exactly the prefix
+  trie's references — and after clearing the trie it is FULLY free,
+  with every refcount at zero;
+- compile counts stay at the paged budget (one paged decode, one
+  scatter, one token put, <= 2 chunk-continuation variants).
+
+Run standalone (``python scripts/paged_soak.py [--fast]``) or via the
+registered tests (tests/test_paged_soak.py: fast variant tier-1, the
+full churn ``-m slow``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _build_net(vocab: int, seed: int, stream_max_t: int):
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(transformer_lm(
+        n_in=vocab, width=32, n_layers=2, n_heads=4, n_classes=vocab,
+        seed=seed)).init()
+    for c in net.conf.confs:
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = stream_max_t
+    return net
+
+
+def _workload(rng, n_requests: int, vocab: int, window: int):
+    """Ragged prompts/lengths: three shared-prefix cohorts of
+    different lengths (block-aligned and not, so splices hit both the
+    CoW and the no-CoW boundary case) interleaved with unique
+    prompts."""
+    cohorts = [rng.integers(0, vocab, ln).tolist()
+               for ln in (8, 11, 5)]
+    cases = []
+    for i in range(n_requests):
+        if i % 2 == 0:
+            head = cohorts[(i // 2) % len(cohorts)]
+            prompt = head + rng.integers(
+                0, vocab, int(rng.integers(1, 6))).tolist()
+        else:
+            prompt = rng.integers(
+                0, vocab, int(rng.integers(1, 15))).tolist()
+        cases.append((prompt, int(rng.integers(2, 15))))
+    return cases
+
+
+def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
+             n_slots: int = 4, window: int = 32, block_tokens: int = 4,
+             kv_blocks: int = 18,
+             verbose: bool = False) -> Dict[str, Any]:
+    """One seeded soak; returns a summary dict and raises
+    AssertionError on any gate violation."""
+    from deeplearning4j_tpu.serving import DecodeEngine, Request
+
+    rng = np.random.default_rng(seed)
+    cases = _workload(rng, n_requests, vocab, window)
+
+    def build(paged: bool):
+        return DecodeEngine(
+            _build_net(vocab, 7, window), n_slots=n_slots,
+            decode_chunk=4, prefix_cache_rows=8, prefill_chunk=4,
+            admission_policy="decode", max_queue=4 * n_requests,
+            paged_kv=paged, block_tokens=block_tokens,
+            kv_blocks=kv_blocks if paged else None)
+
+    # dense reference: the ids every paged finish must match
+    ref_eng = build(False)
+    ref_ids = [ref_eng.submit(Request(list(p), n)) for p, n in cases]
+    ref = ref_eng.run()
+
+    eng = build(True)
+    ids = [eng.submit(Request(list(p), n)) for p, n in cases]
+    t0 = time.perf_counter()
+    results: Dict[int, Any] = {}
+    frag_peak = used_peak = 0
+    while eng.has_work():
+        eng.step(results)
+        frag_peak = max(frag_peak, eng.stats["frag_tokens"])
+        used_peak = max(used_peak, eng.stats["blocks_used"])
+    wall_s = time.perf_counter() - t0
+
+    # -- gates ---------------------------------------------------------
+    assert set(results) == set(ids), (
+        f"lost requests: {sorted(set(ids) - set(results))[:5]}")
+    mismatched = []
+    for rid, ref_rid in zip(ids, ref_ids):
+        r = results[rid]
+        assert r.finish_reason in ("length", "eos"), (
+            f"request {rid}: unexpected terminal {r.finish_reason!r}")
+        if r.tokens != ref[ref_rid].tokens:
+            mismatched.append(rid)
+    assert not mismatched, (
+        f"{len(mismatched)} paged finishes diverged from the dense "
+        f"engine: {mismatched[:5]}")
+
+    # zero leaked blocks: idle pool holds exactly the trie's blocks;
+    # clearing the trie frees EVERYTHING and every refcount is zero
+    pool = eng.block_pool
+    trie_blocks = set(eng.prefix_cache.block_ids())
+    assert pool.used_blocks == len(trie_blocks), (
+        f"leak: {pool.used_blocks} blocks used while the trie holds "
+        f"{len(trie_blocks)} — a slot or pending admission leaked "
+        "references")
+    eng.prefix_cache.clear()
+    assert pool.used_blocks == 0, "blocks survived a trie clear"
+    assert pool.free_blocks == eng.kv_blocks
+    assert all(pool.refcount(b) == 0 for b in range(eng.kv_blocks))
+
+    counts = eng.compile_counts()
+    assert counts["decode"] == 1, counts
+    assert counts["admit"] == 0, counts
+    assert counts["paged_scatter"] == 1, counts
+    assert counts["paged_tok"] == 1, counts
+    assert counts["chunk_prefill"] <= 2, counts
+
+    summary = {
+        "n_requests": n_requests,
+        "seed": seed,
+        "wall_s": round(wall_s, 2),
+        "kv_blocks": eng.kv_blocks,
+        "used_blocks_peak": used_peak,
+        "frag_tokens_peak": frag_peak,
+        "prefix_blocks_spliced": eng.stats["prefix_blocks_spliced"],
+        "cow_copies": eng.stats["cow_copies"],
+        "preempted": eng.stats["preempted"],
+        "admissions_deferred": eng.stats["paged_admit_deferred"],
+        "trie_evictions": eng.prefix_cache.stats["evictions"],
+        "prefill_tokens_skipped": eng.stats["prefill_tokens_skipped"],
+        "compile_counts": counts,
+    }
+    if verbose:
+        for k, v in summary.items():
+            print(f"  {k}: {v}")
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="small tier-1 variant (same gates, fewer "
+                         "requests)")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kv-blocks", type=int, default=18)
+    args = ap.parse_args(argv)
+    n = args.requests or (24 if args.fast else 160)
+    print(f"paged soak: {n} requests, seed {args.seed}, "
+          f"{args.kv_blocks} blocks")
+    summary = run_soak(n_requests=n, seed=args.seed,
+                       kv_blocks=args.kv_blocks, verbose=True)
+    print(f"PASS in {summary['wall_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -604,3 +604,92 @@ class TestChaosParityGate:
         assert all(res2[m].finish_reason in ("length", "eos")
                    for m in more)
         assert eng2.compile_counts() == warm_counts
+
+    def test_chaos_parity_with_snapshot_resume_paged(
+            self, assert_no_retrace):
+        """The ISSUE 6 satellite gate: the SAME chaos scenario on the
+        paged block-pool layout. The seeded plan poisons slot blocks,
+        fails an admission, and bit-rots a stored prefix entry's block
+        inside the shared pool; victims quarantine per-BLOCK (shared
+        blocks are released by reference, never scrubbed under an
+        innocent), a mid-run snapshot carries block tables +
+        refcounts, and the restored paged engine finishes the same
+        ids within the paged compile budget."""
+        cases = ([([1, 4, 7, 2, 5] + [i % V], 8) for i in range(4)]
+                 + [([9, 3, 3], 12), ([5, 2, 8, 1, 6, 0, 4], 6),
+                    ([2, 2], 10), ([11, 0, 6], 7)])
+
+        def build(plan):
+            return DecodeEngine(_net(), n_slots=2, decode_chunk=2,
+                                prefix_cache_rows=4, prefill_chunk=4,
+                                admission_policy="decode",
+                                paranoid=True, fault_plan=plan,
+                                max_retries=3, paged_kv=True,
+                                block_tokens=8)
+
+        ref_eng = build(None)
+        ref_ids = [ref_eng.submit(Request(p, n)) for p, n in cases]
+        ref = ref_eng.run()
+        assert all(r.finish_reason in ("length", "eos")
+                   for r in ref.values())
+
+        plan = FaultPlan([FaultEvent(2, "nan", slot=0),
+                          FaultEvent(3, "admit_fail"),
+                          FaultEvent(4, "cache_corrupt"),
+                          FaultEvent(6, "nan", slot=1)])
+        eng = build(plan)
+        ids = [eng.submit(Request(p, n)) for p, n in cases]
+        res = {}
+        for _ in range(8):
+            eng.step(res)
+        assert len(plan.injected) >= 3
+        assert {"nan", "admit_fail"} <= {e.kind for e in plan.injected}
+        snap = eng.snapshot()
+        json.dumps(snap)
+        assert snap["config"]["paged_kv"] is True
+        assert snap["paged"]["tables"]          # block tables ride
+        assert snap["paged"]["refcounts"]       # refcounts ride
+
+        eng2 = DecodeEngine.restore(_net(), snap)
+        assert eng2.paged_kv
+        res.update(eng2.run())
+        warm_counts = dict(eng2.compile_counts())
+
+        assert set(res) == set(ids)
+        n_victims = 0
+        for rid, ref_rid in zip(ids, ref_ids):
+            r = res[rid]
+            if r.retries > 0:
+                n_victims += 1
+            if r.finish_reason == "fault":
+                continue
+            assert r.finish_reason in ("length", "eos")
+            assert r.tokens == ref[ref_rid].tokens, (
+                f"request {rid} (retries={r.retries}) diverged from "
+                "the no-fault paged run")
+        assert n_victims >= 1
+        # paged compile budget: ONE paged decode, ONE scatter, ONE
+        # token put, ONE per-block health check; chunk_prefill covers
+        # at most a dense cold + a paged warm continuation; the paged
+        # trie owns no movers at all
+        for counts in (eng.compile_counts(), eng2.compile_counts()):
+            assert counts["decode"] == 1
+            assert counts["admit"] == 0
+            assert counts["paged_scatter"] == 1
+            assert counts["paged_tok"] == 1
+            assert counts["health_check"] == 1
+            assert counts["prefill"] == 1
+            assert 1 <= counts["chunk_prefill"] <= 2
+            assert counts["paged_copy"] <= 1
+            assert counts["paged_zero"] <= 1
+            assert "prefix_store" not in counts
+            assert "prefix_fetch" not in counts
+        # no poisoned block survives once its references drop, and a
+        # warmed paged engine never retraces under continued churn
+        assert eng2.block_pool.poisoned == set()
+        with assert_no_retrace(eng2):
+            more = [eng2.submit(Request(p, n)) for p, n in cases[:3]]
+            res2 = eng2.run()
+        assert all(res2[m].finish_reason in ("length", "eos")
+                   for m in more)
+        assert eng2.compile_counts() == warm_counts
