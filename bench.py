@@ -1591,6 +1591,129 @@ def bench_gateway_streaming():
     }
 
 
+def bench_observability_overhead():
+    """Observability row (ISSUE 7 acceptance): the request-scoped
+    flight recorder must be cheap enough to leave ON. Same width-1024
+    flagship / 2048-window / 8-slot engine config as the serving rows,
+    16-request churn; the observed engine runs with EVERYTHING on —
+    capped tracer (request-id'd spans + request_done instants),
+    latency histograms, phase clocks, 256-deep flight recorder —
+    against a ``tracer=None, record_timing=False`` twin.
+
+    Gates:
+    - overhead: observed throughput >= 0.97x the dark engine's
+      (interleaved median-of-3 — observability is host bookkeeping,
+      ~60 ns clock stamps per dispatch, and must price like it);
+    - parity: greedy ids bit-identical observed-vs-dark (the phase
+      clock touches no RNG, no device work);
+    - zero retrace: compile counts identical before/after the timed
+      trials, and equal across the two engines;
+    - the instruments actually recorded: every histogram populated,
+      every request's trace in the flight recorder with phase sums
+      <= e2e."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.profiler.tracer import Tracer
+    from deeplearning4j_tpu.serving import DecodeEngine, Request
+
+    V, width, n_layers, window = 64, 1024, 8, 2048
+    n_slots, n_req, n_gen, prompt_len = 8, 16, 48, 96
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_req)]
+
+    dark = DecodeEngine(net, n_slots=n_slots, decode_chunk=32,
+                        tracer=None, record_timing=False,
+                        flight_recorder=0)
+    observed = DecodeEngine(net, n_slots=n_slots, decode_chunk=32,
+                            tracer=Tracer(max_events=65536),
+                            record_timing=True, flight_recorder=256)
+
+    def churn(eng):
+        ids = [eng.submit(Request(prompt=list(p),
+                                  max_new_tokens=n_gen))
+               for p in prompts]
+        t0 = time.perf_counter()
+        results = eng.run()
+        dt = time.perf_counter() - t0
+        toks = sum(len(results[i].tokens) for i in ids)
+        return toks / dt, [results[i].tokens for i in ids], ids
+
+    _, ref_ids, _ = churn(dark)      # warm: compiles
+    _, obs_ids, rids = churn(observed)
+    id_match = float(np.mean([a == b
+                              for a, b in zip(ref_ids, obs_ids)]))
+    if id_match < 1.0:
+        _fail_gate(f"observability changed greedy ids "
+                   f"(match {id_match:.3f})")
+    for rid in rids:
+        trace = observed.request_trace(rid)
+        if trace is None:
+            _fail_gate(f"request {rid} missing from the flight "
+                       "recorder")
+            continue
+        t = trace["timing"]
+        phase_sum = (t["queue_wait_s"] + t["admission_s"]
+                     + t["decode_s"] + t["verify_s"] + t["stall_s"])
+        if phase_sum > t["e2e_s"]:
+            _fail_gate(f"request {rid} phase sum {phase_sum} > e2e "
+                       f"{t['e2e_s']}")
+    empty = [k for k, h in observed.histograms.items()
+             if h.count == 0]
+    if empty:
+        _fail_gate(f"histograms never observed: {empty}")
+
+    counts0 = (dark.compile_counts(), observed.compile_counts())
+    dark_rates, obs_rates = [], []
+    for _ in range(3):  # interleaved: drift hits both alike
+        r, _, _ = churn(dark)
+        dark_rates.append(r)
+        r, _, _ = churn(observed)
+        obs_rates.append(r)
+    counts1 = (dark.compile_counts(), observed.compile_counts())
+    if counts1 != counts0 or counts0[0] != counts0[1]:
+        _fail_gate(f"observability retraced: {counts0} -> {counts1}")
+    dark_rate = float(np.median(dark_rates))
+    obs_rate = float(np.median(obs_rates))
+    ratio = obs_rate / dark_rate
+    if ratio < 0.97:
+        _fail_gate(
+            f"observability overhead: {obs_rate:.0f} tok/s < 0.97x "
+            f"dark {dark_rate:.0f} (ratio {ratio:.3f})")
+    ttft_hist = observed.histograms["serving_ttft_s"]
+    itl_hist = observed.histograms["serving_itl_s"]
+    return {
+        "metric": "observability_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": ("tokens/sec with tracer + histograms + flight "
+                 "recorder ON / tokens/sec dark (width-1024 "
+                 f"flagship, 2048-token KV window, {n_slots} slots, "
+                 f"{n_req}-request churn x {n_gen} tokens)"),
+        "vs_baseline": None,  # reference has no serving stack at all
+        "spread": [round(min(o / d for o, d
+                             in zip(obs_rates, dark_rates)), 4),
+                   round(max(o / d for o, d
+                             in zip(obs_rates, dark_rates)), 4)],
+        "trials": len(obs_rates),
+        "observed_tokens_per_sec": round(obs_rate, 1),
+        "dark_tokens_per_sec": round(dark_rate, 1),
+        "id_match": round(id_match, 4),
+        "ttft_p50_ms": round(1e3 * ttft_hist.quantile(0.5), 2),
+        "ttft_p99_ms": round(1e3 * ttft_hist.quantile(0.99), 2),
+        "itl_p50_ms": round(1e3 * itl_hist.quantile(0.5), 3),
+        "itl_p99_ms": round(1e3 * itl_hist.quantile(0.99), 3),
+        "compile_counts": counts1[1],
+    }
+
+
 def bench_w2v():
     """BASELINE row 3: Word2Vec skip-gram words/sec with a semantic
     quality gate on the bundled REAL corpus (the reference's
@@ -1835,8 +1958,8 @@ def main() -> None:
                bench_hostfed_cnn, bench_decode, bench_decode_batched,
                bench_prefix_cache, bench_decode_paged,
                bench_decode_spec,
-               bench_gateway_streaming, bench_w2v,
-               bench_dbn, bench_allreduce):
+               bench_gateway_streaming, bench_observability_overhead,
+               bench_w2v, bench_dbn, bench_allreduce):
         try:
             out = fn()
         except Exception as e:  # a broken row must not hide the rest

@@ -1,15 +1,125 @@
-"""Host-side span tracer (Chrome trace format) + device trace hook."""
+"""Host-side span tracer (Chrome trace format) + device trace hook,
+plus the streaming :class:`Histogram` track type the serving stack's
+latency distributions ride on (ISSUE 7)."""
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import json
+import math
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+class Histogram:
+    """Streaming histogram over FIXED log-spaced bucket bounds:
+    constant memory however many values flow through, thread-safe
+    ``observe``, quantile estimation, and Prometheus ``histogram``
+    exposition — the track type behind the serving engine's TTFT /
+    inter-token-latency distributions (serving/engine.py), where a
+    last-value gauge cannot answer "what is p99 under load".
+
+    The default bounds span 100 µs … 100 s at four buckets per decade
+    (latency seconds); any strictly-increasing bound list works. A
+    value lands in the first bucket whose upper bound is >= it
+    (Prometheus ``le`` semantics — a value exactly on a bound belongs
+    to that bound's bucket); values above the top bound land in the
+    implicit ``+Inf`` bucket. ``quantile`` interpolates linearly
+    inside the winning bucket, so its error is bounded by one bucket
+    width — the classic HdrHistogram/Prometheus tradeoff."""
+
+    #: 100 µs .. 100 s, four log-spaced buckets per decade (25 bounds
+    #: + the implicit +Inf bucket). Wide enough for queue waits under
+    #: heavy shedding, fine enough that p50/p99 are meaningful.
+    DEFAULT_BOUNDS = tuple(10.0 ** (e / 4.0) for e in range(-16, 9))
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, bounds=None):
+        bounds = tuple(float(b) for b in
+                       (self.DEFAULT_BOUNDS if bounds is None
+                        else bounds))
+        if not bounds or any(b2 <= b1 for b1, b2
+                             in zip(bounds, bounds[1:])):
+            raise ValueError(
+                "histogram bounds must be non-empty and strictly "
+                f"increasing; got {bounds!r}")
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # [-1] = +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, n: int = 1) -> None:
+        """Record ``value`` (``n`` times — one lock acquisition for a
+        round's worth of identical per-token gaps, so the serving hot
+        path pays O(1) per round, not O(decode_chunk))."""
+        value = float(value)
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += n
+            self._sum += value * n
+            self._count += n
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        """Consistent (per-bucket counts, sum, count) triple."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def quantile(self, q: float) -> float:
+        """Estimated ``q``-quantile (0 <= q <= 1): find the bucket
+        holding the target rank, interpolate linearly inside it (the
+        +Inf bucket clamps to the top bound). NaN with no
+        observations."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        counts, _, total = self.snapshot()
+        if total == 0:
+            return math.nan
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c and cum + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else self.bounds[-1])
+                return lo + (hi - lo) * max(rank - cum, 0.0) / c
+            cum += c
+        return self.bounds[-1]
+
+    def prometheus_lines(self, name: str,
+                         help_text: Optional[str] = None) -> List[str]:
+        """Prometheus text-format exposition: cumulative
+        ``_bucket{le=...}`` samples (monotone by construction), the
+        ``+Inf`` bucket equal to ``_count``, plus ``_sum`` and
+        ``_count``."""
+        counts, total_sum, total = self.snapshot()
+        lines = []
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for bound, c in zip(self.bounds, counts):
+            cum += c
+            lines.append(
+                f'{name}_bucket{{le="{format(bound, ".6g")}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{name}_sum {repr(float(total_sum))}")
+        lines.append(f"{name}_count {total}")
+        return lines
 
 
 class Tracer:
@@ -35,6 +145,8 @@ class Tracer:
         self._events: List[Dict[str, Any]] = []
         self._cum: Dict[str, float] = {}
         self._last: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._help: Dict[str, str] = {}
         self.max_events = max_events
         self._t0 = time.perf_counter()
 
@@ -94,23 +206,75 @@ class Tracer:
                 "pid": os.getpid(), "args": {name: value},
             })
 
+    def gauge(self, name: str, value: float) -> None:
+        """Update a track's LAST VALUE without pushing an event. The
+        scrape-path counterpart of :meth:`counter`: a ``/v1/metrics``
+        handler refreshing per-scrape gauges (serving/gateway.py) must
+        not append to the capped event log — a tight scrape loop would
+        otherwise evict real span history (ISSUE 7 satellite)."""
+        with self._lock:
+            self._last[name] = float(value)
+
     def rate(self, name: str, count: float, seconds: float) -> None:
         """Counter expressed as events/sec over a measured window —
         the serving engine's tokens/sec stream
         (serving/engine.py)."""
         self.counter(name, count / max(seconds, 1e-9))
 
-    def incr(self, name: str, delta: float = 1.0) -> None:
+    def incr(self, name: str, delta: float = 1.0) -> float:
         """Cumulative event counter: each call adds ``delta`` to the
-        track's running total and emits the new value, so sparse
-        events (the serving engine's deadline expiries, sheds,
+        track's running total, emits the new value, and RETURNS it, so
+        sparse events (the serving engine's deadline expiries, sheds,
         quarantines, retries — serving/engine.py failure events) read
         as monotone step functions in the trace without the caller
-        keeping its own totals."""
+        keeping its own totals — and a caller branching on the total
+        (rate limiters, test assertions) needn't re-read the track."""
         with self._lock:
             self._cum[name] = self._cum.get(name, 0.0) + delta
             value = self._cum[name]
         self.counter(name, value)
+        return value
+
+    def describe(self, name: str, help_text: str) -> None:
+        """Attach a human-readable description to a track;
+        :meth:`prometheus_text` emits it as the metric's ``# HELP``
+        line (the serving engine describes its tracks at init)."""
+        with self._lock:
+            self._help[name] = " ".join(str(help_text).split())
+
+    # -- histogram tracks (ISSUE 7) ------------------------------------
+    def observe(self, name: str, value: float, n: int = 1,
+                bounds=None) -> Histogram:
+        """Record one value (``n`` times) into the named
+        :class:`Histogram` track, creating it on first use (``bounds``
+        applies only then). Unlike :meth:`counter` this pushes no
+        event: the histogram IS the aggregate, so high-frequency
+        observations (every token's latency) cost O(1) memory."""
+        hist = self._hists.get(name)
+        if hist is None:
+            with self._lock:
+                hist = self._hists.setdefault(name, Histogram(bounds))
+        hist.observe(value, n)
+        return hist
+
+    def register_histogram(self, name: str,
+                           hist: Histogram) -> Histogram:
+        """Adopt an externally-owned :class:`Histogram` as a track
+        (the serving engine owns its latency histograms — works with
+        ``tracer=None`` — and registers them here so
+        :meth:`prometheus_text` exports them by reference, no double
+        bookkeeping)."""
+        with self._lock:
+            self._hists[name] = hist
+        return hist
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        with self._lock:
+            return self._hists.get(name)
+
+    def histograms(self) -> Dict[str, Histogram]:
+        with self._lock:
+            return dict(self._hists)
 
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -146,31 +310,52 @@ class Tracer:
         budgets) is a ``gauge``. ``prefix`` filters track names (e.g.
         ``"serving_"``). Names are sanitized to the metric charset
         ([a-zA-Z0-9_:]); tracks sharing a sanitized name keep their
-        latest value."""
+        latest value. Tracks with a :meth:`describe` description get a
+        ``# HELP`` line; :class:`Histogram` tracks render as
+        Prometheus ``histogram`` families
+        (``_bucket``/``_sum``/``_count``)."""
         latest = self.latest_counters()
         with self._lock:
             cumulative = set(self._cum)
-        # collapse tracks whose names sanitize to the same metric name
-        # (sorted order ⇒ the lexically-last raw name wins): Prometheus
-        # rejects an entire scrape over one duplicate sample
-        merged: Dict[str, Tuple[str, float]] = {}
-        for name in sorted(latest):
-            if prefix is not None and not name.startswith(prefix):
-                continue
+            hists = dict(self._hists)
+            helps = dict(self._help)
+
+        def sanitize(name: str) -> str:
             safe = "".join(
                 c if (c.isalnum() or c in "_:") else "_"
                 for c in name)
             if safe and safe[0].isdigit():
                 safe = "_" + safe
+            return safe
+
+        hist_safe: Dict[str, Tuple[str, Histogram]] = {}
+        for name in sorted(hists):
+            if prefix is None or name.startswith(prefix):
+                hist_safe[sanitize(name)] = (name, hists[name])
+        # collapse tracks whose names sanitize to the same metric name
+        # (sorted order ⇒ the lexically-last raw name wins): Prometheus
+        # rejects an entire scrape over one duplicate sample
+        merged: Dict[str, Tuple[str, float, Optional[str]]] = {}
+        for name in sorted(latest):
+            if prefix is not None and not name.startswith(prefix):
+                continue
+            safe = sanitize(name)
+            if safe in hist_safe:  # the histogram family owns the name
+                continue
             kind = "counter" if name in cumulative else "gauge"
-            merged[safe] = (kind, latest[name])
+            merged[safe] = (kind, latest[name], helps.get(name))
         lines: List[str] = []
         for safe in sorted(merged):
-            kind, value = merged[safe]
+            kind, value, help_text = merged[safe]
             text = ("%d" % value if float(value).is_integer()
                     else repr(float(value)))
+            if help_text:
+                lines.append(f"# HELP {safe} {help_text}")
             lines.append(f"# TYPE {safe} {kind}")
             lines.append(f"{safe} {text}")
+        for safe in sorted(hist_safe):
+            raw, hist = hist_safe[safe]
+            lines.extend(hist.prometheus_lines(safe, helps.get(raw)))
         return "\n".join(lines) + ("\n" if lines else "")
 
     def save(self, path: str) -> None:
@@ -182,6 +367,8 @@ class Tracer:
             self._events.clear()
             self._cum.clear()
             self._last.clear()
+            self._hists.clear()  # descriptions survive: they are
+            #                      registrations, not measurements
 
 
 class ProfilerIterationListener(IterationListener):

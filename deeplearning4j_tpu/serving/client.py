@@ -1,6 +1,6 @@
 """Stdlib client for the serving gateway (serving/gateway.py).
 
-``GatewayClient`` speaks the gateway's five endpoints over plain
+``GatewayClient`` speaks the gateway's endpoints over plain
 ``http.client`` — no dependencies, so the same class serves tests, the
 soak harness (scripts/gateway_soak.py), benches, and examples. The
 streaming call returns a :class:`GatewayStream`: an iterator of
@@ -190,6 +190,21 @@ class GatewayClient:
         while in flight, raises 404 for unknown ids."""
         return self._call("GET", f"/v1/requests/{request_id}",
                           ok=(200, 202))
+
+    def trace(self, request_id: int) -> Dict[str, Any]:
+        """Flight-recorder trace for one terminal request (ISSUE 7):
+        ``{"id", "finish_reason", "timing": {...phase breakdown...},
+        "attempts": [{"events": [...]}, ...]}``; ``{"running": true}``
+        while in flight; raises 404 once evicted/unknown."""
+        return self._call("GET", f"/v1/requests/{request_id}/trace",
+                          ok=(200, 202))
+
+    def trace_events(self) -> Dict[str, Any]:
+        """``GET /v1/trace`` — the server tracer's current event
+        window as a Chrome trace-event document
+        (``{"traceEvents": [...]}``), ready to save and load into
+        Perfetto/chrome://tracing."""
+        return self._call("GET", "/v1/trace")
 
     def healthz(self) -> Dict[str, Any]:
         return self._call("GET", "/v1/healthz")

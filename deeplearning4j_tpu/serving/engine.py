@@ -124,6 +124,7 @@ import contextlib
 import dataclasses
 import functools
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -202,6 +203,129 @@ class _Pending:
     @property
     def remaining(self) -> int:
         return len(self.seq) - self.matched - self.done
+
+
+class _PhaseClock:
+    """Host-side per-request phase clock (ISSUE 7 tentpole): every
+    request accumulates a monotone, DISJOINT-interval phase breakdown
+    — queue wait, admission (split cold-prefill / chunked-suffix /
+    prefix-splice / prefix-fetch), per-round decode / verify / stall —
+    plus an ordered event timeline, one entry per phase transition
+    (capped: a pathological million-round request cannot grow the
+    recorder without bound). Because every attributed interval is a
+    sub-interval of [submit, terminal] and no two overlap, the phase
+    sums can never exceed the request's end-to-end wall time — the
+    invariant the gateway soak gates over HTTP.
+
+    Fault retries and paged preemptions open a NEW attempt (the
+    timeline keeps absolute ``t_s`` offsets from submit, so attempts
+    read as consecutive chapters of one request), and ``enqueue_t``
+    resets so each attempt's queue wait is its own."""
+
+    #: ordered-event cap PER ATTEMPT; past it, events are counted
+    #: (``events_dropped``) instead of stored — phase totals stay exact
+    MAX_EVENTS = 512
+
+    __slots__ = ("submit_t", "enqueue_t", "attempts", "ttft_s",
+                 "last_commit_t", "rounds")
+
+    def __init__(self, submit_t: float):
+        self.submit_t = submit_t
+        self.enqueue_t = submit_t
+        self.attempts: List[Dict[str, Any]] = [self._attempt()]
+        self.ttft_s: Optional[float] = None
+        self.last_commit_t: Optional[float] = None
+        self.rounds = 0
+
+    @staticmethod
+    def _attempt() -> Dict[str, Any]:
+        return {"phases": {}, "events": [], "events_dropped": 0}
+
+    def add(self, now: float, phase: str, dur_s: float,
+            **detail: Any) -> None:
+        """Accumulate ``dur_s`` into ``phase`` and append a timeline
+        event at ``now`` (offsets are relative to submit)."""
+        att = self.attempts[-1]
+        phases = att["phases"]
+        phases[phase] = phases.get(phase, 0.0) + dur_s
+        if len(att["events"]) < self.MAX_EVENTS:
+            event = {"t_s": now - self.submit_t, "phase": phase,
+                     "dur_s": dur_s}
+            if detail:
+                event.update(detail)
+            att["events"].append(event)
+        else:
+            att["events_dropped"] += 1
+
+    def event(self, now: float, phase: str, **detail: Any) -> None:
+        self.add(now, phase, 0.0, **detail)
+
+    def new_attempt(self, now: float, reason: str) -> None:
+        """A retry/preemption/defer requeued the request: close the
+        current attempt and start the next (distinct attempts in the
+        timeline — the soak's retried-request gate)."""
+        self.event(now, "requeue", reason=reason)
+        self.attempts.append(self._attempt())
+        self.enqueue_t = now
+
+    def phase_totals(self) -> Dict[str, float]:
+        totals: Dict[str, float] = {}
+        for att in self.attempts:
+            for phase, dur in att["phases"].items():
+                totals[phase] = totals.get(phase, 0.0) + dur
+        return totals
+
+    def summary(self, now: float, tokens: int) -> Dict[str, Any]:
+        """The terminal timing breakdown (``GenerationResult.timing``
+        + the flight-recorder record)."""
+        p = self.phase_totals()
+        admission = (p.get("admit_cold", 0.0)
+                     + p.get("admit_chunk", 0.0)
+                     + p.get("admit_splice", 0.0)
+                     + p.get("admit_fetch", 0.0))
+        return {
+            "queue_wait_s": p.get("queue_wait", 0.0),
+            "admission_s": admission,
+            "admission_cold_s": p.get("admit_cold", 0.0),
+            "admission_chunked_s": p.get("admit_chunk", 0.0),
+            "admission_splice_s": (p.get("admit_splice", 0.0)
+                                   + p.get("admit_fetch", 0.0)),
+            "decode_s": p.get("decode", 0.0),
+            "verify_s": p.get("verify", 0.0),
+            "stall_s": p.get("stall", 0.0),
+            "ttft_s": self.ttft_s,
+            "e2e_s": now - self.submit_t,
+            "attempts": len(self.attempts),
+            "rounds": self.rounds,
+            "tokens": int(tokens),
+        }
+
+
+#: one-line HELP text per serving track, emitted on /v1/metrics via
+#: ``Tracer.describe`` (registered by ``DecodeEngine`` at init)
+SERVING_TRACK_HELP = {
+    "serving_ttft_s": "submit-to-first-token latency distribution",
+    "serving_itl_s": "inter-token latency distribution (per-round "
+                     "commit gap / tokens committed)",
+    "serving_queue_wait_s": "queue-entry-to-admission-start wait "
+                            "distribution (per attempt)",
+    "serving_round_s": "scheduling-round wall-time distribution",
+    "serving_e2e_s": "submit-to-terminal latency distribution",
+    "serving_tokens_generated": "tokens committed across all requests",
+    "serving_admitted": "requests admitted into a slot",
+    "serving_evicted": "slots freed (finish, cancel, quarantine)",
+    "serving_tokens_per_sec": "per-round decode throughput",
+    "serving_prefill_tokens": "prompt tokens prefilled",
+    "serving_prefill_tokens_skipped": "prompt tokens served from the "
+                                      "prefix cache instead",
+    "serving_deadline_expired": "requests past their end-to-end "
+                                "deadline",
+    "serving_shed": "requests shed by backpressure",
+    "serving_cancelled": "requests cancelled by the caller",
+    "serving_quarantined": "slots quarantined by the paranoid sweep",
+    "serving_retries": "fault-retry re-admissions",
+    "serving_retry_failures": "requests that exhausted the retry cap",
+}
 
 
 def _request_dict(req: Request) -> Dict[str, Any]:
@@ -346,7 +470,31 @@ class DecodeEngine:
     ``serving_faults_detected``, ``serving_quarantined``,
     ``serving_retries``, ``serving_retry_failures``,
     ``serving_slow_steps``) so a serving run — and its failures — are
-    observable without print-debugging."""
+    observable without print-debugging.
+
+    Request-scoped observability (ISSUE 7; pure host bookkeeping —
+    greedy ids, RNG consumption, and compile counts are bit-identical
+    with it on or off):
+
+    - ``record_timing=True`` (default) stamps a monotone phase clock
+      onto every request (:class:`_PhaseClock`): queue wait, admission
+      split cold/chunked/splice, per-round decode/verify/stall, and
+      per-round commit timestamps. The breakdown surfaces on
+      ``GenerationResult.timing`` and feeds five engine-OWNED
+      latency histograms (``self.histograms``: ``serving_ttft_s``,
+      ``serving_itl_s``, ``serving_queue_wait_s``,
+      ``serving_round_s``, ``serving_e2e_s`` —
+      :class:`profiler.tracer.Histogram`, registered into the tracer
+      when one is attached so ``/v1/metrics`` exports them).
+    - ``flight_recorder=256`` keeps the last N TERMINAL requests'
+      full traces (ordered phase-event timelines, one chapter per
+      retry attempt) in a bounded ring; ``request_trace(rid)`` reads
+      one back — the gateway's ``GET /v1/requests/<id>/trace``.
+    - every serving span carries the request id(s) in its args
+      (``serving.admit``/``prefill``/``prefill_chunk``/
+      ``decode_chunk``/``spec_verify``/``prefix_fetch``/
+      ``prefix_splice``/``cow_copy``), so a Chrome trace is
+      filterable by request."""
 
     #: valid shed policies for a full admission queue: reject the new
     #: arrival, or shed the oldest queued request in its favour
@@ -386,7 +534,9 @@ class DecodeEngine:
                  emit_deltas: bool = False,
                  paged_kv: bool = False,
                  block_tokens: int = 16,
-                 kv_blocks: Optional[int] = None):
+                 kv_blocks: Optional[int] = None,
+                 record_timing: bool = True,
+                 flight_recorder: int = 256):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
@@ -547,6 +697,30 @@ class DecodeEngine:
         #: duplicates is exact)
         self._delta_sent: Dict[int, int] = {}
         self._delta_buf: Dict[int, List[int]] = {}
+        # -- request-scoped observability (ISSUE 7; pure host
+        # bookkeeping — ids, compile counts, and RNG consumption are
+        # bit-identical with it on or off) --------------------------
+        if flight_recorder < 0:
+            raise ValueError(f"flight_recorder {flight_recorder} < 0")
+        self.record_timing = bool(record_timing)
+        self.flight_recorder = int(flight_recorder)
+        #: per-live-request phase clocks (popped at terminal)
+        self._clocks: Dict[int, _PhaseClock] = {}
+        #: ring of the last ``flight_recorder`` TERMINAL requests'
+        #: traces, keyed by id (insertion-ordered: oldest evicted)
+        self._flight: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        #: engine-OWNED latency histograms (work with tracer=None;
+        #: registered into the tracer for /v1/metrics exposition)
+        self.histograms: Dict[str, Any] = {}
+        if self.record_timing:
+            from deeplearning4j_tpu.profiler.tracer import Histogram
+
+            self.histograms = {
+                name: Histogram()
+                for name in ("serving_ttft_s", "serving_itl_s",
+                             "serving_queue_wait_s", "serving_round_s",
+                             "serving_e2e_s")}
+        self.describe_metrics()
 
         self._key = jax.random.key(seed)
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
@@ -822,11 +996,13 @@ class DecodeEngine:
         if self.scheduler.full:
             if self.shed_policy == "reject-new":
                 rid = self.scheduler.assign_id(request)
+                self._mint_clock(rid)
                 self._shed(request)
                 return rid
             self._shed(self.scheduler.pop())
         rid = self.scheduler.submit(request)
         self._submit_t[rid] = self._clock()
+        self._mint_clock(rid, self._submit_t[rid])
         if (request.deadline_s is not None
                 or request.queue_timeout_s is not None):
             self._has_deadlines = True
@@ -871,6 +1047,41 @@ class DecodeEngine:
         if self.tracer is None:
             return contextlib.nullcontext()
         return self.tracer.span(name, **args)
+
+    # -- request-scoped observability (ISSUE 7) ------------------------
+    def describe_metrics(self) -> None:
+        """Register the engine's histogram tracks + HELP text with the
+        attached tracer (no-op without one). Idempotent; the gateway
+        calls it again after attaching its own tracer."""
+        if self.tracer is None:
+            return
+        if hasattr(self.tracer, "register_histogram"):
+            for name, hist in self.histograms.items():
+                self.tracer.register_histogram(name, hist)
+        if hasattr(self.tracer, "describe"):
+            for name, help_text in SERVING_TRACK_HELP.items():
+                self.tracer.describe(name, help_text)
+
+    def _mint_clock(self, rid: int,
+                    submit_t: Optional[float] = None) -> None:
+        if self.record_timing:
+            self._clocks[rid] = _PhaseClock(
+                self._clock() if submit_t is None else submit_t)
+
+    def _clock_of(self, rid) -> Optional[_PhaseClock]:
+        return self._clocks.get(rid) if self.record_timing else None
+
+    def _observe(self, name: str, value, n: int = 1) -> None:
+        hist = self.histograms.get(name)
+        if hist is not None and value is not None:
+            hist.observe(value, n)
+
+    def request_trace(self, rid: int) -> Optional[Dict[str, Any]]:
+        """Flight-recorder record for one TERMINAL request: the timing
+        breakdown plus the ordered per-attempt phase timeline. None
+        once evicted from the ring (or for unknown/live ids, or with
+        ``record_timing=False``) — the gateway maps that to 404/202."""
+        return self._flight.get(rid)
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
@@ -929,12 +1140,34 @@ class DecodeEngine:
         delivers no tokens (the PR 3 contract; its earlier streamed
         attempts were disowned by quarantine)."""
         self._emit_delta(request.id, list(tokens))
+        timing = None
+        clock = self._clocks.pop(request.id, None)
+        if clock is not None:
+            now = self._clock()
+            clock.ttft_s = ttft  # the EXACT value the result carries
+            clock.event(now, "terminal", reason=reason)
+            timing = clock.summary(now, len(tokens))
+            self._observe("serving_e2e_s", timing["e2e_s"])
+            if self.flight_recorder:
+                self._flight[request.id] = {
+                    "id": request.id, "finish_reason": reason,
+                    "timing": timing, "attempts": clock.attempts,
+                }
+                while len(self._flight) > self.flight_recorder:
+                    self._flight.popitem(last=False)
+            if self.tracer is not None:
+                # a self-describing trace: latency_report.py reads
+                # these instants back out of a saved Chrome trace
+                self.tracer.instant("serving.request_done",
+                                    rid=request.id, reason=reason,
+                                    timing=timing)
         self._terminal[request.id] = GenerationResult(
             id=request.id, tokens=list(tokens), finish_reason=reason,
             prompt_len=len(request.prompt),
             prefix_tokens_reused=prefix_reused, ttft_s=ttft,
             retries=self._retries.pop(request.id, 0),
-            spec_drafted=spec_drafted, spec_accepted=spec_accepted)
+            spec_drafted=spec_drafted, spec_accepted=spec_accepted,
+            timing=timing)
         self.stats["requests_finished"] += 1
         self._submit_t.pop(request.id, None)
         self._started.discard(request.id)
@@ -1051,10 +1284,13 @@ class DecodeEngine:
                                   state.spec_drafted,
                                   state.spec_accepted)
             return
+        clock = self._clock_of(state.request.id)
+        if clock is not None:
+            clock.new_attempt(self._clock(), "preempted")
         self._requeue.append((self._round + 1, state.request))
 
     def _ensure_tab(self, tab: BlockTable, n_tokens: int,
-                    protect=()) -> bool:
+                    protect=(), rid: Optional[int] = None) -> bool:
         """Make ``tab`` writable for the next ``n_tokens`` appends:
         copy-on-write the partial tail block if the trie or another
         slot still references it (the ONLY device copy sharing ever
@@ -1078,7 +1314,8 @@ class DecodeEngine:
         if cow:
             g, src = tab.tail_block()
             dst = pool.alloc()
-            with self._span("serving.cow_copy", src=src, dst=dst):
+            with self._span("serving.cow_copy", rid=rid, src=src,
+                            dst=dst):
                 self._pool = pool.copy_block_device(self._pool, src,
                                                     dst)
             tab.blocks[g] = dst
@@ -1179,6 +1416,13 @@ class DecodeEngine:
         pending admission for chunk-by-chunk progress between decode
         rounds (chunked mode)."""
         self._started.add(request.id)
+        clock = self._clock_of(request.id)
+        if clock is not None:
+            now = self._clock()
+            self._observe("serving_queue_wait_s",
+                          now - clock.enqueue_t)
+            clock.add(now, "queue_wait", now - clock.enqueue_t,
+                      slot=slot)
         rnn, matched, hit, tab = None, 0, None, None
         if self.prefix_cache is not None:
             hit = self.prefix_cache.lookup(request.prompt)
@@ -1206,17 +1450,26 @@ class DecodeEngine:
                     self.block_pool.stats["spliced"] += spliced
                     self.stats["prefill_tokens_skipped"] += matched
                     with self._span("serving.prefix_splice",
-                                    row=hit.row, matched=matched,
-                                    blocks=spliced):
+                                    rid=request.id, row=hit.row,
+                                    matched=matched, blocks=spliced):
                         pass
+                    if clock is not None:
+                        clock.event(self._clock(), "admit_splice",
+                                    matched=matched, blocks=spliced)
                 else:
                     self.prefix_cache.release(hit)
                     hit = None
             elif hit is not None:
                 matched = hit.matched
-                with self._span("serving.prefix_fetch", row=hit.row,
+                t_fetch = self._clock()
+                with self._span("serving.prefix_fetch",
+                                rid=request.id, row=hit.row,
                                 matched=matched, drop=hit.drop):
                     rnn = self.prefix_cache.fetch(hit)
+                if clock is not None:
+                    now = self._clock()
+                    clock.add(now, "admit_fetch", now - t_fetch,
+                              matched=matched)
                 self.stats["prefill_tokens_skipped"] += matched
         pending = _Pending(request, slot, rnn, None, 0, matched, hit,
                            tab=tab)
@@ -1247,6 +1500,9 @@ class DecodeEngine:
         if pending in self._pending:
             self._pending.remove(pending)
         self.stats["paged_admit_deferred"] += 1
+        clock = self._clock_of(pending.request.id)
+        if clock is not None:
+            clock.new_attempt(self._clock(), "admit_deferred")
         self._requeue.append((self._round + 1, pending.request))
 
     def _advance_prefill(self, pending: _Pending, max_tokens: int):
@@ -1262,21 +1518,28 @@ class DecodeEngine:
         x, mask = self._one_hot_prompt(seg, width)
         temp = jnp.asarray([req.temperature], jnp.float32)
         top_k = jnp.asarray([req.top_k or self.vocab], jnp.int32)
+        clock = self._clock_of(req.id)
         if pending.tab is not None:
             # paged WARM admission: the suffix chunk streams straight
             # into the slot's block table (spliced trie blocks +
             # freshly allocated ones) — no dense scratch row ever
             # materializes, which is what makes the warm path
             # zero-whole-row-copy
-            if not self._ensure_tab(pending.tab, len(seg)):
+            if not self._ensure_tab(pending.tab, len(seg),
+                                    rid=req.id):
                 return False
             rnn_in = self._paged_rnn_rows([pending.tab])
-            with self._span("serving.prefill_chunk", width=width,
-                            tokens=len(seg), done=pending.done,
-                            paged=True):
+            t0 = self._clock()
+            with self._span("serving.prefill_chunk", rid=req.id,
+                            width=width, tokens=len(seg),
+                            done=pending.done, paged=True):
                 tok, rnn = self._chunk_jit(
                     self.net.params, self.net.state, x, mask, rnn_in,
                     temp, top_k, self._next_key())
+            if clock is not None:
+                now = self._clock()
+                clock.add(now, "admit_chunk", now - t0,
+                          tokens=len(seg))
             self._pool = self._strip_pool(rnn)
             pending.tab.length += len(seg)
             pending.tok = tok
@@ -1284,20 +1547,30 @@ class DecodeEngine:
             self.stats["prefill_tokens"] += len(seg)
             self.stats["chunks_scheduled"] += 1
             return True
+        t0 = self._clock()
         if pending.rnn is None:
             # first cold segment: no carried state yet — the bucketed
             # cold-prefill executable establishes it
-            with self._span("serving.prefill", bucket=width,
-                            tokens=len(seg)):
+            with self._span("serving.prefill", rid=req.id,
+                            bucket=width, tokens=len(seg)):
                 tok, rnn = self._prefill_jit(
                     self.net.params, self.net.state, x, mask, temp,
                     top_k, self._next_key())
+            if clock is not None:
+                now = self._clock()
+                clock.add(now, "admit_cold", now - t0,
+                          tokens=len(seg))
         else:
-            with self._span("serving.prefill_chunk", width=width,
-                            tokens=len(seg), done=pending.done):
+            with self._span("serving.prefill_chunk", rid=req.id,
+                            width=width, tokens=len(seg),
+                            done=pending.done):
                 tok, rnn = self._chunk_jit(
                     self.net.params, self.net.state, x, mask,
                     pending.rnn, temp, top_k, self._next_key())
+            if clock is not None:
+                now = self._clock()
+                clock.add(now, "admit_chunk", now - t0,
+                          tokens=len(seg))
         pending.rnn, pending.tok = rnn, tok
         pending.done += len(seg)
         self.stats["prefill_tokens"] += len(seg)
@@ -1340,8 +1613,8 @@ class DecodeEngine:
                     self._defer_admission(pending)
                     return
                 table_row, _ = tab.arrays(self._ring_slots)
-                with self._span("serving.admit", slot=slot,
-                                paged=True):
+                with self._span("serving.admit", rid=request.id,
+                                slot=slot, paged=True):
                     self._pool = self._scatter_jit(
                         self._pool, pending.rnn,
                         jnp.asarray(table_row),
@@ -1368,7 +1641,8 @@ class DecodeEngine:
                     lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
                                         a.dtype), pending.rnn)
                 self._toks = jnp.zeros((self.n_slots,), jnp.int32)
-            with self._span("serving.admit", slot=slot):
+            with self._span("serving.admit", rid=request.id,
+                            slot=slot):
                 self._pool, self._toks = self._admit_jit(
                     self._pool, self._toks, pending.rnn, pending.tok,
                     jnp.asarray(slot, jnp.int32))
@@ -1391,6 +1665,13 @@ class DecodeEngine:
         submit_t = self._submit_t.get(request.id)
         ttft = (self._clock() - submit_t
                 if submit_t is not None else None)
+        clock = self._clock_of(request.id)
+        if clock is not None:
+            now = self._clock()
+            clock.event(now, "first_token", ttft_s=ttft,
+                        prefix_reused=pending.matched)
+            clock.last_commit_t = now  # ITL starts after this token
+            self._observe("serving_ttft_s", ttft)
         state = _Slot(request, [first], prefix_reused=pending.matched,
                       ttft_s=ttft, hit_row=hit_row)
         self.stats["tokens_generated"] += 1
@@ -1584,6 +1865,9 @@ class DecodeEngine:
             return
         self._retries[request.id] = attempts
         self._failure_event("retries")
+        clock = self._clock_of(request.id)
+        if clock is not None:
+            clock.new_attempt(self._clock(), "fault_retry")
         ready = self._round + max(
             1, self.retry_backoff_rounds * (2 ** (attempts - 1)))
         self._requeue.append((ready, request))
@@ -1765,7 +2049,9 @@ class DecodeEngine:
                 draft[slot, :len(toks)] = toks
             lens[slot] = len(toks)
         with self._span("serving.spec_verify", width=width,
-                        drafted=int(lens.sum())):
+                        drafted=int(lens.sum()),
+                        rids=[self._slots[s].request.id
+                              for s, d in drafts.items() if d]):
             pool_op, self._toks, emitted, acc = self._verify_jit(
                 self.net.params, self.net.state, pool_op,
                 self._toks, jnp.asarray(draft), jnp.asarray(lens),
@@ -1825,6 +2111,15 @@ class DecodeEngine:
         accumulate into (and are returned via) ``results``."""
         if results is None:
             results = {}
+        # phase-clock round anchors (ISSUE 7): the pre-decode gap —
+        # sweeps, fault handling, OTHER requests' admission chunks —
+        # is the "stall" phase of every slot that was already running
+        # when the round began (captured as (slot, rid) pairs so a
+        # same-round evict+readmit cannot misattribute)
+        rt0 = self._clock() if self.record_timing else None
+        running_at_start = (
+            [(i, s.request.id) for i, s in enumerate(self._slots)
+             if s is not None] if self.record_timing else ())
         t_start = (self._clock()
                    if self.stall_threshold_s is not None else None)
         # an admit_fail is scoped to ITS round ("the next admission
@@ -1908,8 +2203,10 @@ class DecodeEngine:
                     n_tok = self.decode_chunk
                     if spec_round:
                         n_tok += len(drafts.get(slot, ())) + 1
-                    if self._ensure_tab(self._kv_tabs[slot], n_tok,
-                                        protect=ensured | {slot}):
+                    if self._ensure_tab(
+                            self._kv_tabs[slot], n_tok,
+                            protect=ensured | {slot},
+                            rid=self._slots[slot].request.id):
                         ensured.add(slot)
                     else:
                         self._preempt_slot(slot)
@@ -1932,6 +2229,20 @@ class DecodeEngine:
                     return results
             t0 = time.perf_counter()
             verify_out = None
+            ver_dt = 0.0
+            if self.record_timing:
+                # stall phase: round start → decode dispatch, for
+                # slots that were running the whole time (disjoint
+                # from their own decode/verify attribution below)
+                t_pre = self._clock()
+                if t_pre > rt0:
+                    for slot, rid0 in running_at_start:
+                        state = self._slots[slot]
+                        if state is None or state.request.id != rid0:
+                            continue
+                        clock = self._clocks.get(rid0)
+                        if clock is not None:
+                            clock.add(t_pre, "stall", t_pre - rt0)
             pool_op = (self._paged_rnn_rows(self._kv_tabs)
                        if self.paged_kv else self._pool)
             if spec_round:
@@ -1943,21 +2254,29 @@ class DecodeEngine:
                 # (paged: the rewind travels inside the executable as
                 # a filled decrement, and the post-verify filled rides
                 # the chained pytree into the decode scan)
+                tv0 = self._clock() if self.record_timing else 0.0
                 pool_op, verify_out = self._dispatch_verify(drafts,
                                                             pool_op)
+                if self.record_timing:
+                    ver_dt = self._clock() - tv0
             elif self.spec is not None:
                 # no slot drafted anything (no n-gram match, or every
                 # slot samples): plain decode — speculation is an
                 # accelerator, never a requirement
                 self.stats["spec_fallback_rounds"] += 1
+            td0 = self._clock() if self.record_timing else 0.0
             with self._span("serving.decode_chunk",
-                            active=len(active)):
+                            active=len(active),
+                            rids=[self._slots[s].request.id
+                                  for s in active]):
                 pool_op, self._toks, seq = self._decode_jit(
                     self.net.params, self.net.state, pool_op,
                     self._toks, jnp.asarray(self._temps),
                     jnp.asarray(self._top_ks), self._next_key())
                 seq = np.asarray(seq)  # [B, chunk]; forces the whole
                 #                        round (verify included) done
+            dec_dt = (self._clock() - td0 if self.record_timing
+                      else 0.0)
             self._pool = self._strip_pool(pool_op)
             if verify_out is not None:
                 v_rows, v_n = self._land_verify(drafts, *verify_out)
@@ -1995,6 +2314,21 @@ class DecodeEngine:
                 # diff-based high-water mark picks it up here, where
                 # this round's health verdict is already in
                 self._note_progress(state)
+                if self.record_timing and appended:
+                    clock = self._clocks.get(state.request.id)
+                    if clock is not None:
+                        now_c = self._clock()
+                        if ver_dt:
+                            clock.add(now_c, "verify", ver_dt)
+                        clock.add(now_c, "decode", dec_dt)
+                        if clock.last_commit_t is not None:
+                            self._observe(
+                                "serving_itl_s",
+                                (now_c - clock.last_commit_t)
+                                / len(appended), n=len(appended))
+                        clock.last_commit_t = now_c
+                        clock.rounds += 1
+                        clock.event(now_c, "commit", n=len(appended))
                 if self._finished(state):
                     self._finish(state, slot)
                 elif self.spec is not None:
@@ -2004,6 +2338,8 @@ class DecodeEngine:
             self.stats["tokens_generated"] += emitted
             self.stats["decode_time_s"] += dt
             self.stats["chunks"] += 1
+            if self.record_timing:
+                self._observe("serving_round_s", self._clock() - rt0)
             occ = len(active) / self.n_slots
             self.stats["occupancy_sum"] += occ
             if self.tracer is not None:
@@ -2131,7 +2467,8 @@ class DecodeEngine:
                     "snapshotted slot — kv_blocks is smaller than the "
                     "snapshot's working set")
             table_row, _ = tab.arrays(self._ring_slots)
-            with self._span("serving.admit", slot=slot, paged=True):
+            with self._span("serving.admit", rid=request.id,
+                            slot=slot, paged=True):
                 self._pool = self._scatter_jit(
                     self._pool, rnn, jnp.asarray(table_row),
                     jnp.asarray(tab.length, jnp.int32))
@@ -2144,7 +2481,8 @@ class DecodeEngine:
                     lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
                                         a.dtype), rnn)
                 self._toks = jnp.zeros((self.n_slots,), jnp.int32)
-            with self._span("serving.admit", slot=slot):
+            with self._span("serving.admit", rid=request.id,
+                            slot=slot):
                 self._pool, self._toks = self._admit_jit(
                     self._pool, self._toks, rnn, tok,
                     jnp.asarray(slot, jnp.int32))
@@ -2224,6 +2562,8 @@ class DecodeEngine:
                 "paged_kv": self.paged_kv,
                 "block_tokens": self.block_tokens,
                 "kv_blocks": self.kv_blocks,
+                "record_timing": self.record_timing,
+                "flight_recorder": self.flight_recorder,
             },
             # paged bookkeeping rides the snapshot for inspection and
             # exact-capacity restores (restore REBUILDS device blocks
@@ -2302,7 +2642,9 @@ class DecodeEngine:
             draft_source=cfg.get("draft_source", "ngram"),
             paged_kv=cfg.get("paged_kv", False),
             block_tokens=cfg.get("block_tokens", 16),
-            kv_blocks=cfg.get("kv_blocks") or None)
+            kv_blocks=cfg.get("kv_blocks") or None,
+            record_timing=cfg.get("record_timing", True),
+            flight_recorder=cfg.get("flight_recorder", 256))
         spec_state = snapshot.get("spec")
         if spec_state and eng.spec is not None:
             # resume K-adaptation where the crash left it (final ids
@@ -2320,6 +2662,16 @@ class DecodeEngine:
         def arm(req: Request, elapsed) -> None:
             nonlocal max_id
             eng._submit_t[req.id] = now - (elapsed or 0.0)
+            # restored phase clock: e2e keeps the pre-crash elapsed
+            # time (submit_t back-dated), the timeline marks the
+            # restore boundary, and queue wait restarts here — the
+            # pre-crash breakdown died with the old process
+            eng._mint_clock(req.id, eng._submit_t[req.id])
+            clock = eng._clock_of(req.id)
+            if clock is not None:
+                clock.event(now, "restored",
+                            elapsed_s=float(elapsed or 0.0))
+                clock.enqueue_t = now
             if (req.deadline_s is not None
                     or req.queue_timeout_s is not None):
                 eng._has_deadlines = True

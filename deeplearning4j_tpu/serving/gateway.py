@@ -26,8 +26,15 @@ matching stdlib client):
 ``GET /v1/requests/<id>``                   poll a result by id
                                             (200 done / 202 running /
                                             404 unknown)
+``GET /v1/requests/<id>/trace``             flight-recorder timeline
+                                            + phase breakdown for one
+                                            terminal request (ISSUE 7)
+``GET /v1/trace``                           Chrome trace-event JSON of
+                                            the tracer's event window
+                                            (Perfetto-loadable)
 ``GET /v1/metrics``                         Prometheus-style text
-                                            (Tracer counter tracks)
+                                            (counter/gauge tracks +
+                                            latency histograms)
 ``GET /v1/healthz``                         liveness + occupancy
 ``POST /v1/drain``                          stop admission, settle
                                             in-flight, snapshot
@@ -109,6 +116,7 @@ def _result_dict(res: GenerationResult) -> Dict[str, Any]:
         "retries": res.retries,
         "spec_drafted": res.spec_drafted,
         "spec_accepted": res.spec_accepted,
+        "timing": res.timing,
         "status": STATUS_OF_REASON.get(res.finish_reason, 200),
     }
 
@@ -155,6 +163,11 @@ class _GatewayHandler(JsonHandler):
             self.send_bytes(self.gateway._metrics_text().encode(),
                             "text/plain; version=0.0.4", 200,
                             close=True)
+        elif path == "/v1/trace":
+            self.gateway._handle_trace_export(self)
+        elif (path.startswith("/v1/requests/")
+                and path.endswith("/trace")):
+            self.gateway._handle_request_trace(self, path)
         elif path.startswith("/v1/requests/"):
             self.gateway._handle_poll(self, path)
         else:
@@ -236,6 +249,11 @@ class ServingGateway:
             # same reasoning for a caller-supplied uncapped Tracer:
             # the gateway turns it into a server-lifetime object
             engine.tracer.max_events = 65536
+        # (re-)register the engine's latency histograms + HELP text
+        # with whichever tracer the gateway just ensured, so
+        # /v1/metrics exports serving_ttft_s/serving_itl_s/... even
+        # when the engine was built with tracer=None
+        engine.describe_metrics()
         self.snapshot_path = snapshot_path
         self.keepalive_s = float(keepalive_s)
         self.request_timeout_s = request_timeout_s
@@ -649,6 +667,60 @@ class ServingGateway:
             handler.send_json({"error": f"unknown request {rid}"},
                               404, close=True)
 
+    # -- flight-recorder / trace endpoints (ISSUE 7) --------------------
+    def _handle_request_trace(self, handler, path: str) -> None:
+        """``GET /v1/requests/<id>/trace``: the flight recorder's
+        per-request timeline + timing breakdown — 200 with the trace,
+        202 while the request is still in flight, 404 once evicted
+        from the ring (or unknown, or ``record_timing=False``)."""
+        tail = path[len("/v1/requests/"):-len("/trace")]
+        try:
+            rid = int(tail)
+        except ValueError:
+            handler.send_json({"error": f"bad request id {tail!r}"},
+                              400, close=True)
+            return
+        with self._engine_access():
+            trace = self.engine.request_trace(rid)
+            running = trace is None and (
+                rid in self._live
+                or rid in self.engine.scheduler._issued)
+            if trace is not None:
+                trace = dict(trace)  # detach before leaving the lock
+        if trace is not None:
+            handler.send_json(trace, 200, close=True)
+        elif running:
+            handler.send_json({"id": rid, "running": True}, 202,
+                              close=True)
+        else:
+            handler.send_json(
+                {"error": f"no trace for request {rid} (unknown, "
+                          "evicted from the flight recorder, or "
+                          "record_timing off)"}, 404, close=True)
+
+    def _handle_trace_export(self, handler) -> None:
+        """``GET /v1/trace``: the tracer's current event window as
+        Chrome trace-event JSON (Perfetto/chrome://tracing loadable),
+        streamed with the chunked helpers so a large window never
+        materializes as one giant bytes object. The tracer snapshot
+        is taken under ITS lock (``Tracer.events`` copies); no
+        gateway lock is held while writing the socket."""
+        tracer = self.engine.tracer
+        events = tracer.events() if tracer is not None else []
+        try:
+            handler.start_stream("application/json")
+            handler.send_chunk(b'{"traceEvents":[')
+            for lo in range(0, len(events), 512):
+                piece = ",".join(json.dumps(e)
+                                 for e in events[lo:lo + 512])
+                if lo:
+                    piece = "," + piece
+                handler.send_chunk(piece.encode())
+            handler.send_chunk(b"]}")
+            handler.end_stream()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client vanished mid-export; nothing to release
+
     @staticmethod
     def _rid_of(handler, path: str) -> Optional[int]:
         tail = path.rsplit("/", 1)[-1]
@@ -675,17 +747,22 @@ class ServingGateway:
     def _metrics_text(self) -> str:
         with self._engine_access():
             # refresh gateway gauges right before export so the text
-            # reflects this instant, not the last decode round
+            # reflects this instant, not the last decode round — via
+            # ``Tracer.gauge`` (last-value table only), NOT
+            # ``counter``: a scrape must never append to the capped
+            # event log, or a tight scrape loop evicts real span
+            # history (ISSUE 7 satellite; regression-tested).
+            # Duck-typed tracers without gauge() fall back to
+            # counter() — the pre-ISSUE-7 behavior.
             tracer = self.engine.tracer
-            tracer.counter("serving_gateway_queue_depth",
-                           self.engine.scheduler.pending)
-            tracer.counter("serving_gateway_active_slots",
-                           sum(s is not None
-                               for s in self.engine._slots))
-            tracer.counter("serving_gateway_round_time_s",
-                           self._round_s)
+            gauge = getattr(tracer, "gauge", tracer.counter)
+            gauge("serving_gateway_queue_depth",
+                  self.engine.scheduler.pending)
+            gauge("serving_gateway_active_slots",
+                  sum(s is not None for s in self.engine._slots))
+            gauge("serving_gateway_round_time_s", self._round_s)
             for key, value in self.stats.items():
-                tracer.counter(f"serving_gateway_{key}", value)
+                gauge(f"serving_gateway_{key}", value)
             return tracer.prometheus_text()
 
     # -- drain / snapshot ----------------------------------------------

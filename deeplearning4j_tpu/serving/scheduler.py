@@ -15,7 +15,7 @@ import dataclasses
 import itertools
 import math
 from collections import deque
-from typing import Deque, List, Optional, Sequence
+from typing import Any, Deque, Dict, List, Optional, Sequence
 
 from deeplearning4j_tpu.nn.streaming import scan_length_bucket
 
@@ -99,6 +99,15 @@ class GenerationResult:
     #: drafted, e.g. sampling requests or spec-off engines)
     spec_drafted: int = 0
     spec_accepted: int = 0
+    #: per-request phase breakdown from the engine's phase clock
+    #: (ISSUE 7; ``record_timing=True`` engines): a plain JSON-able
+    #: dict — ``queue_wait_s``, ``admission_s`` (+ its cold / chunked /
+    #: splice split), ``decode_s``, ``verify_s``, ``stall_s``,
+    #: ``ttft_s`` (identical to the top-level field), ``e2e_s``,
+    #: ``attempts``, ``rounds``, ``tokens``. The disjoint-interval
+    #: attribution guarantees the phase sums never exceed ``e2e_s``.
+    #: None when timing was off or the engine predates the request.
+    timing: Optional[Dict[str, Any]] = None
 
 
 class Scheduler:

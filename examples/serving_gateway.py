@@ -17,8 +17,11 @@ localhost sockets:
    long-running request; the stream terminates with the partial
    tokens and ``finish_reason="cancelled"``.
 4. **Metrics** — ``GET /v1/metrics`` exports every engine counter
-   track Prometheus-style.
-5. **Drain** — ``POST /v1/drain`` stops admission and settles
+   track Prometheus-style (plus the TTFT/ITL latency histograms).
+5. **Flight recorder** — ``GET /v1/requests/<id>/trace`` returns one
+   request's phase timeline (queue → admission → decode rounds), and
+   the engine's histograms answer p50/p99 TTFT (ISSUE 7).
+6. **Drain** — ``POST /v1/drain`` stops admission and settles
    in-flight work; with a ``snapshot_path`` configured the engine
    state would persist for ``ServingGateway.boot`` to restore.
 
@@ -113,7 +116,27 @@ def main():
             line for line in metrics.splitlines()
             if line.split(" ")[0] in wanted))
 
-        # 5. graceful drain (no snapshot_path configured here — with
+        # 5. request-scoped observability (ISSUE 7): the flight
+        # recorder keeps every terminal request's phase timeline —
+        # one curl (or client.trace) shows where a request's life
+        # went — and the engine's latency histograms answer p50/p99
+        # questions the last-value metrics above cannot
+        trace = client.trace(out["id"])
+        timing = trace["timing"]
+        print(f"trace    : req {out['id']} "
+              f"({trace['finish_reason']}) "
+              f"queue {timing['queue_wait_s'] * 1e3:.1f} ms | "
+              f"admit {timing['admission_s'] * 1e3:.1f} ms | "
+              f"decode {timing['decode_s'] * 1e3:.1f} ms | "
+              f"e2e {timing['e2e_s'] * 1e3:.1f} ms "
+              f"over {timing['rounds']} rounds")
+        ttft = engine.histograms["serving_ttft_s"]
+        print(f"ttft     : p50 {ttft.quantile(0.5) * 1e3:.1f} ms  "
+              f"p99 {ttft.quantile(0.99) * 1e3:.1f} ms  "
+              f"({ttft.count} requests; full table: "
+              f"scripts/latency_report.py {gw.address})")
+
+        # 6. graceful drain (no snapshot_path configured here — with
         # one, in-flight state would persist for boot() to restore)
         print("drain    :", client.drain(timeout_s=5.0))
 

@@ -26,7 +26,12 @@ Pass criteria (the gateway-parity gate):
   pre-gateway thread count (handler threads bounded by the
   util/httpjson socket timeout, stepper joined);
 - compile counts stay at the in-process budget — the HTTP layer never
-  retraces anything.
+  retraces anything;
+- observability (ISSUE 7): every terminal request's
+  ``GET /v1/requests/<id>/trace`` parses, its phase sums fit inside
+  its e2e wall time, its TTFT equals the terminal's ``ttft_s``,
+  retried requests show distinct attempts, ``GET /v1/trace`` exports
+  a non-empty Chrome trace, and neither endpoint ever answers 5xx.
 
 Run standalone (``python scripts/gateway_soak.py [--fast]``) or via
 the registered tests (tests/test_gateway_soak.py: fast variant tier-1,
@@ -189,6 +194,44 @@ def run_soak(n_clients: int = 48, seed: int = 0, vocab: int = 12,
                if rid not in gw._results]
     assert not missing, f"requests without terminal: {missing[:5]}"
 
+    # -- flight-recorder trace gates (ISSUE 7 satellite): every
+    # terminal request's /v1/requests/<id>/trace must parse, its
+    # phase sums must fit inside its e2e wall time, its TTFT must be
+    # the terminal's exact ttft_s, retries must show as distinct
+    # attempts — and the new endpoints must never 5xx under churn
+    traced = 0
+    for rid in rid_of.values():
+        try:
+            trace = client.trace(rid)
+        except GatewayError as e:
+            assert e.status < 500, (
+                f"trace endpoint 5xx for request {rid}: {e}")
+            raise AssertionError(
+                f"terminal request {rid} has no trace: {e}")
+        assert not trace.get("running"), (
+            f"request {rid} terminal but trace says running")
+        timing = trace["timing"]
+        phase_sum = (timing["queue_wait_s"] + timing["admission_s"]
+                     + timing["decode_s"] + timing["verify_s"]
+                     + timing["stall_s"])
+        assert phase_sum <= timing["e2e_s"] + 1e-9, (
+            f"request {rid}: phase sum {phase_sum} exceeds e2e "
+            f"{timing['e2e_s']}")
+        term = gw._results[rid]
+        assert timing["ttft_s"] == term.ttft_s, (
+            f"request {rid}: trace ttft {timing['ttft_s']} != "
+            f"terminal ttft {term.ttft_s}")
+        assert len(trace["attempts"]) == term.retries + 1, (
+            f"request {rid}: {term.retries} retries but "
+            f"{len(trace['attempts'])} attempts in the timeline")
+        traced += 1
+    assert traced == len(rid_of)
+    try:
+        trace_doc = client.trace_events()
+    except GatewayError as e:
+        raise AssertionError(f"/v1/trace failed: {e}")
+    assert trace_doc["traceEvents"], "empty /v1/trace export"
+
     completed = parity_ok = 0
     disconnected = cancelled = deadline_hits = faulted = 0
     for i, out in outcomes.items():
@@ -247,6 +290,8 @@ def run_soak(n_clients: int = 48, seed: int = 0, vocab: int = 12,
         "faults_injected": eng.stats["faults_injected"],
         "disconnect_cancels": gw.stats["disconnect_cancels"],
         "engine_cancelled": eng.stats["cancelled"],
+        "traced": traced,
+        "trace_events": len(trace_doc["traceEvents"]),
         "leaked_threads": max(leaked, 0),
         "compile_counts": counts,
     }

@@ -1,0 +1,223 @@
+"""Per-phase serving-latency report (ISSUE 7 satellite).
+
+Reads either a SAVED Chrome trace (``Tracer.save`` output, or a
+``GET /v1/trace`` download) or a LIVE gateway URL, and prints one
+latency table: p50/p90/p99 for TTFT, inter-token latency, queue wait,
+round time, and end-to-end — the numbers a serving stack is judged on.
+
+Two sources, same table:
+
+- **Live gateway** (``http://host:port``): scrapes ``/v1/metrics`` and
+  computes quantiles from the Prometheus ``histogram`` families the
+  engine exports (``serving_ttft_s``, ``serving_itl_s``,
+  ``serving_queue_wait_s``, ``serving_round_s``, ``serving_e2e_s``) —
+  bucket-interpolated, exactly what a PromQL ``histogram_quantile``
+  would answer.
+- **Saved trace** (``trace.json``): exact per-request quantiles from
+  the ``serving.request_done`` instant events the engine stamps at
+  every terminal (each carries the request's full timing breakdown),
+  plus the round-time distribution from ``serving.decode_chunk`` span
+  durations. ITL here is each request's mean inter-token gap
+  ``(e2e - ttft) / (tokens - 1)`` — per-request, where the live
+  histogram is per-token.
+
+Usage::
+
+    python scripts/latency_report.py trace.json
+    python scripts/latency_report.py http://127.0.0.1:8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+QUANTILES = (0.5, 0.9, 0.99)
+
+#: histogram-track → table-row label, in print order
+LIVE_ROWS = (
+    ("serving_ttft_s", "ttft"),
+    ("serving_itl_s", "itl"),
+    ("serving_queue_wait_s", "queue_wait"),
+    ("serving_round_s", "round"),
+    ("serving_e2e_s", "e2e"),
+)
+
+_BUCKET_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{le="([^"]+)"\}\s+(\d+)\s*$')
+_SCALAR_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)_(sum|count)\s+(\S+)\s*$")
+
+
+def parse_prometheus_histograms(
+        text: str) -> Dict[str, Dict[str, object]]:
+    """Prometheus text → ``{name: {"buckets": [(le, cum)],
+    "sum": float, "count": int}}``. Only ``histogram`` families are
+    collected; the ``le`` bounds keep text order (the exposition is
+    monotone by contract — the histogram-math tests assert it)."""
+    hists: Dict[str, Dict[str, object]] = {}
+
+    def entry(name: str) -> Dict[str, object]:
+        return hists.setdefault(
+            name, {"buckets": [], "sum": 0.0, "count": 0})
+
+    for line in text.splitlines():
+        m = _BUCKET_RE.match(line)
+        if m:
+            name, le, cum = m.group(1), m.group(2), int(m.group(3))
+            bound = math.inf if le == "+Inf" else float(le)
+            entry(name)["buckets"].append((bound, cum))
+            continue
+        m = _SCALAR_RE.match(line)
+        if m:
+            name, kind, value = m.group(1), m.group(2), m.group(3)
+            if name in hists:
+                entry(name)[kind] = (float(value) if kind == "sum"
+                                     else int(value))
+    return {n: h for n, h in hists.items() if h["buckets"]}
+
+
+def histogram_quantile(buckets: List[Tuple[float, int]],
+                       q: float) -> float:
+    """PromQL-style ``histogram_quantile`` over cumulative
+    ``(le, count)`` buckets: linear interpolation inside the winning
+    bucket, +Inf clamped to the highest finite bound."""
+    total = buckets[-1][1]
+    if total == 0:
+        return math.nan
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in buckets:
+        if cum >= rank and cum > prev_cum:
+            hi = bound
+            if math.isinf(hi):
+                hi = prev_bound if prev_bound > 0 else 1.0
+            return (prev_bound
+                    + (hi - prev_bound)
+                    * max(rank - prev_cum, 0.0) / (cum - prev_cum))
+        prev_bound, prev_cum = bound, cum
+    return prev_bound
+
+
+def _exact_quantile(values: List[float], q: float) -> float:
+    if not values:
+        return math.nan
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def report_from_metrics_text(text: str) -> List[Dict[str, object]]:
+    """Table rows from a ``/v1/metrics`` scrape (live-gateway mode)."""
+    hists = parse_prometheus_histograms(text)
+    rows = []
+    for track, label in LIVE_ROWS:
+        h = hists.get(track)
+        if h is None:
+            continue
+        rows.append({
+            "phase": label,
+            "count": h["count"],
+            **{f"p{int(q * 100)}_ms":
+               1e3 * histogram_quantile(h["buckets"], q)
+               for q in QUANTILES},
+        })
+    return rows
+
+
+def report_from_events(events) -> List[Dict[str, object]]:
+    """Table rows from a Chrome trace's event list (saved-trace
+    mode): exact quantiles over the per-request
+    ``serving.request_done`` timing instants + decode-span round
+    times."""
+    series: Dict[str, List[float]] = {
+        "ttft": [], "itl": [], "queue_wait": [], "round": [],
+        "e2e": []}
+    for event in events:
+        args = event.get("args") or {}
+        if (event.get("ph") == "i"
+                and event.get("name") == "serving.request_done"):
+            timing = args.get("timing") or {}
+            if timing.get("ttft_s") is not None:
+                series["ttft"].append(timing["ttft_s"])
+            series["queue_wait"].append(
+                timing.get("queue_wait_s", 0.0))
+            if timing.get("e2e_s") is not None:
+                series["e2e"].append(timing["e2e_s"])
+            tokens = timing.get("tokens") or 0
+            if (tokens > 1 and timing.get("ttft_s") is not None
+                    and timing.get("e2e_s") is not None):
+                series["itl"].append(
+                    (timing["e2e_s"] - timing["ttft_s"])
+                    / (tokens - 1))
+        elif (event.get("ph") == "X"
+                and event.get("name") == "serving.decode_chunk"):
+            series["round"].append(event.get("dur", 0.0) * 1e-6)
+    return [{
+        "phase": label,
+        "count": len(series[label]),
+        **{f"p{int(q * 100)}_ms":
+           1e3 * _exact_quantile(series[label], q)
+           for q in QUANTILES},
+    } for label in ("ttft", "itl", "queue_wait", "round", "e2e")
+        if series[label]]
+
+
+def render(rows: List[Dict[str, object]], source: str) -> str:
+    lines = [f"serving latency report — {source}",
+             f"{'phase':<12} {'count':>7} "
+             + " ".join(f"{'p%d' % int(q * 100) + ' (ms)':>12}"
+                        for q in QUANTILES)]
+    for row in rows:
+        cells = " ".join(
+            f"{row[f'p{int(q * 100)}_ms']:>12.3f}"
+            for q in QUANTILES)
+        lines.append(f"{row['phase']:<12} {row['count']:>7} {cells}")
+    return "\n".join(lines)
+
+
+def run_report(source: str) -> List[Dict[str, object]]:
+    """Rows for one source: a gateway base URL or a trace-file path."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source.rstrip("/") + "/v1/metrics",
+                                    timeout=30) as resp:
+            return report_from_metrics_text(
+                resp.read().decode("utf-8", "replace"))
+    with open(source) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) \
+        else doc
+    return report_from_events(events)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("source",
+                    help="saved Chrome trace path, or gateway base "
+                         "URL (http://host:port)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the rows as JSON instead of a table")
+    args = ap.parse_args(argv)
+    rows = run_report(args.source)
+    if not rows:
+        print("no serving latency data found in "
+              f"{args.source}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        print(render(rows, args.source))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

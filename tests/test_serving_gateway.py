@@ -540,6 +540,16 @@ class TestDrainSnapshotRestore:
                 # what the dead gateway streamed is a PREFIX of the
                 # final ids — no divergence, no duplication
                 assert streamed[i] == ref[i].tokens[:len(streamed[i])]
+                # restored requests keep the trace contract (ISSUE 7):
+                # the timeline leads with the restore boundary and the
+                # phase sums still fit inside e2e
+                trace = client.trace(rid)
+                timing = trace["timing"]
+                assert (timing["queue_wait_s"] + timing["admission_s"]
+                        + timing["decode_s"] + timing["verify_s"]
+                        + timing["stall_s"]) <= timing["e2e_s"]
+                assert trace["attempts"][0]["events"][0]["phase"] == \
+                    "restored"
             assert carried >= 1
         finally:
             gw2.close()
