@@ -1714,6 +1714,140 @@ def bench_observability_overhead():
     }
 
 
+def bench_train_observability_overhead():
+    """Training-observability row (ISSUE 8 acceptance): the tracing
+    listener + phase clock + gradient-health outputs must be cheap
+    enough to leave ON. MLP 784-500-10 (the BASELINE headline config)
+    trained via fused 16-step fit_scan windows; the observed net runs a
+    ``TracingIterationListener`` with a capped tracer, all six
+    histograms, and a JSONL metrics log firing every window, against a
+    listener-free twin.
+
+    Gates:
+    - overhead: observed examples/sec >= 0.97x the dark net's
+      (interleaved median-of-3 — the health scalars ride the SAME
+      executable, so the only cost is host bookkeeping + the per-window
+      score sync the listener performs);
+    - parity: final params BIT-IDENTICAL dark-vs-observed (same seed,
+      same batches, same executable — telemetry touches no RNG and no
+      device math);
+    - zero retrace: the fit_scan executable count is identical
+      before/after the timed trials and equal across the two nets
+      (the health outputs exist in both: no listener-conditional
+      tracing);
+    - the instruments recorded: every histogram populated, every JSONL
+      record's phase sums <= window wall."""
+    import tempfile
+
+    from deeplearning4j_tpu.models.zoo import mlp
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.listeners import (
+        TracingIterationListener,
+    )
+    from deeplearning4j_tpu.optimize.telemetry import MetricsLog
+    from deeplearning4j_tpu.profiler.tracer import Tracer
+
+    K, B, windows = 16, 128, 4
+    rng = np.random.default_rng(7)
+    feats = rng.normal(size=(K, B, 784)).astype(np.float32)
+    labels = np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, (K, B))]
+
+    dark = MultiLayerNetwork(mlp()).init()
+    observed = MultiLayerNetwork(mlp()).init()
+    tracer = Tracer(max_events=65536)
+    log_path = tempfile.mktemp(suffix=".jsonl")
+    metrics_log = MetricsLog(log_path)
+    listener = TracingIterationListener(tracer=tracer,
+                                        metrics_log=metrics_log)
+    observed.set_listeners(listener)
+
+    def run_windows(net, n):
+        for _ in range(n):
+            net.fit_scan(feats, labels)
+        return _sync(net.score_value)
+
+    run_windows(dark, 1)      # warm: compiles
+    run_windows(observed, 1)
+    counts0 = (dark._train_steps_scan._cache_size(),
+               observed._train_steps_scan._cache_size())
+
+    dark_rates, obs_rates = [], []
+    for _ in range(3):  # interleaved: drift hits both alike
+        t0 = time.perf_counter()
+        run_windows(dark, windows)
+        dark_rates.append(
+            windows * K * B / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        run_windows(observed, windows)
+        obs_rates.append(
+            windows * K * B / (time.perf_counter() - t0))
+    counts1 = (dark._train_steps_scan._cache_size(),
+               observed._train_steps_scan._cache_size())
+    metrics_log.close()
+
+    if counts1 != counts0 or counts0[0] != counts0[1]:
+        _fail_gate(
+            f"training observability retraced: {counts0} -> {counts1}")
+    import jax
+
+    p_dark = jax.tree.leaves(dark.params)
+    p_obs = jax.tree.leaves(observed.params)
+    params_equal = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(p_dark, p_obs))
+    if not params_equal:
+        _fail_gate("training observability changed the param "
+                   "trajectory (final params differ)")
+    empty = [name for name, h in listener.hists.items()
+             if h.count == 0]
+    if empty:
+        _fail_gate(f"training histograms never observed: {empty}")
+    bad_sums = 0
+    for rec in MetricsLog.read(log_path):
+        if "wall_s" not in rec:
+            continue
+        phase_sum = (rec.get("data_wait_s", 0.0)
+                     + rec.get("dispatch_s", 0.0)
+                     + rec.get("sync_s", 0.0))
+        if phase_sum > rec["wall_s"] + 1e-9:
+            bad_sums += 1
+    if bad_sums:
+        _fail_gate(f"{bad_sums} JSONL records with phase sums > wall")
+    os.unlink(log_path)
+
+    dark_rate = float(np.median(dark_rates))
+    obs_rate = float(np.median(obs_rates))
+    ratio = obs_rate / dark_rate
+    if ratio < 0.97:
+        _fail_gate(
+            f"training observability overhead: {obs_rate:.0f} ex/s < "
+            f"0.97x dark {dark_rate:.0f} (ratio {ratio:.3f})")
+    step_hist = listener.hists["train_step_s"]
+    grad_hist = listener.hists["train_grad_norm"]
+    return {
+        "metric": "train_observability_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": ("examples/sec with tracing listener + histograms + "
+                 "JSONL log ON / examples/sec dark (MLP 784-500-10, "
+                 f"{windows}x fused {K}-step fit_scan windows, "
+                 f"batch {B})"),
+        "vs_baseline": None,  # reference listeners carry no timing
+        "spread": [round(min(o / d for o, d
+                             in zip(obs_rates, dark_rates)), 4),
+                   round(max(o / d for o, d
+                             in zip(obs_rates, dark_rates)), 4)],
+        "trials": len(obs_rates),
+        "observed_examples_per_sec": round(obs_rate, 1),
+        "dark_examples_per_sec": round(dark_rate, 1),
+        "params_bit_identical": params_equal,
+        "step_p50_ms": round(1e3 * step_hist.quantile(0.5), 3),
+        "step_p99_ms": round(1e3 * step_hist.quantile(0.99), 3),
+        "grad_norm_p50": round(grad_hist.quantile(0.5), 4),
+        "compile_counts": {"fit_scan": counts1[1]},
+    }
+
+
 def bench_w2v():
     """BASELINE row 3: Word2Vec skip-gram words/sec with a semantic
     quality gate on the bundled REAL corpus (the reference's
@@ -1959,6 +2093,7 @@ def main() -> None:
                bench_prefix_cache, bench_decode_paged,
                bench_decode_spec,
                bench_gateway_streaming, bench_observability_overhead,
+               bench_train_observability_overhead,
                bench_w2v, bench_dbn, bench_allreduce):
         try:
             out = fn()

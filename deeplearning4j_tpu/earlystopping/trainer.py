@@ -29,15 +29,32 @@ class EarlyStoppingTrainer:
         net,
         train_iterator,
         listener=None,
+        tracer=None,
     ):
         self.config = config
         self.net = net
         self.train_iterator = train_iterator
         self.listener = listener
+        # Optional training Tracer (ISSUE 8): epoch spans, per-epoch
+        # score counters, and a ``train_early_stop`` cumulative counter
+        # + ``train.early_stop`` instant at termination — an
+        # early-stopped run is diagnosable from the trace alone (which
+        # epoch, which condition, what score).
+        self.tracer = tracer
 
     def set_listener(self, listener) -> None:
         """Lifecycle callbacks (reference EarlyStoppingListener SPI)."""
         self.listener = listener
+
+    def _trace_stop(self, reason, details, epoch, score) -> None:
+        if self.tracer is None or reason is None:
+            return
+        self.tracer.incr("train_early_stop")
+        self.tracer.instant(
+            "train.early_stop", reason=str(getattr(reason, "name",
+                                                   reason)),
+            details=details, epoch=int(epoch),
+            score=None if score is None else float(score))
 
     def _fit_batch(self, ds) -> None:
         """One training call; distributed trainers override this."""
@@ -66,6 +83,8 @@ class EarlyStoppingTrainer:
 
         try:
             while reason is None:
+                epoch_start_us = (self.tracer.now_us()
+                                  if self.tracer is not None else 0.0)
                 self.train_iterator.reset()
                 for ds in self.train_iterator:
                     self._fit_batch(ds)
@@ -79,6 +98,8 @@ class EarlyStoppingTrainer:
                                 TerminationReason.ITERATION_TERMINATION_CONDITION
                             )
                             details = f"{type(cond).__name__} at epoch {epoch}"
+                            self._trace_stop(reason, details, epoch,
+                                             score)
                             break
                     if reason is not None:
                         break
@@ -115,7 +136,21 @@ class EarlyStoppingTrainer:
                     if cond.terminate(epoch, last_score):
                         reason = TerminationReason.EPOCH_TERMINATION_CONDITION
                         details = f"{type(cond).__name__} at epoch {epoch}"
+                        self._trace_stop(reason, details, epoch,
+                                         last_score)
                         break
+                if self.tracer is not None:
+                    end_us = self.tracer.now_us()
+                    self.tracer.complete(
+                        "train.epoch", epoch_start_us,
+                        end_us - epoch_start_us, epoch=epoch,
+                        score=(None if not math.isfinite(last_score)
+                               else float(last_score)),
+                        best_epoch=best_epoch,
+                        terminated=reason is not None)
+                    if math.isfinite(last_score):
+                        self.tracer.counter("train_epoch_score",
+                                            float(last_score))
                 if reason is not None:
                     break
                 epoch += 1
@@ -123,6 +158,7 @@ class EarlyStoppingTrainer:
             log.exception("Early stopping training failed")
             reason = TerminationReason.ERROR
             details = f"{type(e).__name__}: {e}"
+            self._trace_stop(reason, details, epoch, None)
 
         best = cfg.model_saver.get_best_model()
         if best is None:
@@ -155,9 +191,11 @@ class ParallelEarlyStoppingTrainer(EarlyStoppingTrainer):
     """
 
     def __init__(self, config, parallel_trainer, train_iterator,
-                 listener=None):
+                 listener=None, tracer=None):
         super().__init__(config, parallel_trainer.net, train_iterator,
-                         listener=listener)
+                         listener=listener,
+                         tracer=tracer or getattr(parallel_trainer,
+                                                  "tracer", None))
         self.trainer = parallel_trainer
         self._has_fit = False
         self._last_fit_score = float("nan")

@@ -13,6 +13,7 @@ from __future__ import annotations
 import functools
 import os
 import pickle
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -49,6 +50,12 @@ from deeplearning4j_tpu.nn.updater.updaters import (
     normalize_gradients,
     resolve_lr,
 )
+from deeplearning4j_tpu.optimize.telemetry import (
+    TrainTelemetry,
+    batch_counts,
+    grad_health,
+    window_counts,
+)
 
 Array = jax.Array
 
@@ -74,6 +81,8 @@ class ComputationGraph:
         self.iteration = 0
         self.score_value = float("nan")
         self.listeners: List = []
+        # Per-step phase clock (see MultiLayerNetwork.train_telemetry).
+        self.train_telemetry = TrainTelemetry()
         self._rnn_state: Dict[str, Any] = {}
         self._generate_fns: Dict[int, Any] = {}
         self._layer_vertices = {
@@ -323,7 +332,10 @@ class ComputationGraph:
         )(params, state, rng, inputs, labels, masks, label_masks)
         new_params, new_upd = self._apply_updates(
             params, upd_state, grads, iteration, grad_scale)
-        return new_params, new_state, new_upd, score
+        # Same-executable gradient-health outputs (see
+        # MultiLayerNetwork._step_body).
+        health = grad_health(grads, params, new_params)
+        return new_params, new_state, new_upd, score, health
 
     @functools.cached_property
     def _train_step(self):
@@ -345,14 +357,14 @@ class ComputationGraph:
                 p, s, u, it, key = carry
                 key, sub = jax.random.split(key)
                 xs, ys, m, lm = inp
-                p, s, u, score = self._step_body(
+                p, s, u, score, health = self._step_body(
                     p, s, u, it, sub, xs, ys, m, lm, grad_scale)
-                return (p, s, u, it + 1, key), score
+                return (p, s, u, it + 1, key), (score, health)
 
-            (p, s, u, it, _), scores = jax.lax.scan(
+            (p, s, u, it, _), (scores, health) = jax.lax.scan(
                 body, (params, state, upd_state, iteration, rng),
                 (inputs_k, labels_k, masks_k, lmasks_k))
-            return p, s, u, scores
+            return p, s, u, scores, health
 
         return jax.jit(steps, donate_argnums=(0, 1, 2))
 
@@ -422,12 +434,17 @@ class ComputationGraph:
                     for k, v in (label_masks_stacked or {}).items()}
         self._key, sub = jax.random.split(self._key)
         start = self.iteration
-        self.params, self.state, self.updater_state, scores = (
+        t0 = time.perf_counter()
+        self.params, self.state, self.updater_state, scores, health = (
             self._train_steps_scan(
                 self.params, self.state, self.updater_state,
                 self.iteration, sub, inputs_k, labels_k,
                 masks_k, lmasks_k, grad_scale))
-        k = int(next(iter(inputs_k.values())).shape[0])
+        k, examples, tokens = window_counts(
+            next(iter(inputs_k.values())).shape)
+        self.train_telemetry.record_step(
+            dispatch_s=time.perf_counter() - t0, steps=k,
+            examples=examples, tokens=tokens, health=health)
         self.iteration += k
         self.score_value = scores[-1]
         from deeplearning4j_tpu.optimize.listeners import fire_crossed
@@ -667,7 +684,8 @@ class ComputationGraph:
             return ({k: _np.shape(v) for k, v in inputs.items()},
                     tuple(_np.shape(y) for y in labels))
 
-        drive_stream_windows(iterator, scan_steps, flush, batch_shape)
+        drive_stream_windows(iterator, scan_steps, flush, batch_shape,
+                             telemetry=self.train_telemetry)
         return scores
 
     def fit(self, data, labels=None) -> None:
@@ -683,7 +701,14 @@ class ComputationGraph:
                 data.reset()
             if not self.conf.backprop:
                 return
-            for ds in data:
+            it = iter(data)
+            while True:
+                t0 = time.perf_counter()
+                ds = next(it, None)
+                self.train_telemetry.add_data_wait(
+                    time.perf_counter() - t0)
+                if ds is None:
+                    break
                 self._fit_one(ds)
         else:
             self._fit_one(data)
@@ -701,17 +726,23 @@ class ComputationGraph:
             return
         inputs, labels, masks, lmasks = self._coerce_multi(data)
         n_iter = max(1, first_conf.num_iterations)
+        examples, tokens = batch_counts(next(iter(inputs.values())))
         for _ in range(n_iter):
             self._key, sub = jax.random.split(self._key)
+            t0 = time.perf_counter()
             (
                 self.params,
                 self.state,
                 self.updater_state,
                 score,
+                health,
             ) = self._train_step(
                 self.params, self.state, self.updater_state,
                 self.iteration, sub, inputs, labels, masks, lmasks,
             )
+            self.train_telemetry.record_step(
+                dispatch_s=time.perf_counter() - t0, examples=examples,
+                tokens=tokens, health=health)
             self.score_value = score
             self.iteration += 1
             for listener in self.listeners:
@@ -747,10 +778,17 @@ class ComputationGraph:
             lmw = (None if lmasks is None
                    else {k: m[:, start:end] for k, m in lmasks.items()})
             self._key, sub = jax.random.split(self._key)
+            t0 = time.perf_counter()
             (self.params, self.state, self.updater_state, rnn_state,
-             score) = self._tbptt_step(
+             score, health) = self._tbptt_step(
                 self.params, self.state, self.updater_state,
                 self.iteration, sub, iw, lw, mw, lmw, rnn_state)
+            first_in = next(iter(iw.values()))
+            self.train_telemetry.record_step(
+                dispatch_s=time.perf_counter() - t0,
+                examples=int(first_in.shape[0]),
+                tokens=int(first_in.shape[0]) * (end - start),
+                health=health)
             self.score_value = score
             self.iteration += 1
             for listener in self.listeners:
@@ -769,7 +807,8 @@ class ComputationGraph:
             new_params, new_upd = self._apply_updates(
                 params, upd_state, grads, iteration)
             new_rnn = jax.lax.stop_gradient(new_rnn)
-            return new_params, new_state, new_upd, new_rnn, score
+            health = grad_health(grads, params, new_params)
+            return new_params, new_state, new_upd, new_rnn, score, health
 
         return jax.jit(step)
 

@@ -20,6 +20,7 @@ import io
 import json
 import os
 import pickle
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -28,6 +29,12 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.optimize.telemetry import (
+    TrainTelemetry,
+    batch_counts,
+    grad_health,
+    window_counts,
+)
 from deeplearning4j_tpu.nn.conf.enums import BackpropType, OptimizationAlgorithm
 from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.gradient import Gradient
@@ -132,6 +139,10 @@ class MultiLayerNetwork:
         self.iteration = 0
         self.score_value = float("nan")
         self.listeners: List = []
+        # Host-side per-step phase clock (data-wait/dispatch walls,
+        # throughput counts, latest gradient-health outputs) — stamped
+        # by every fit path, drained by TracingIterationListener.
+        self.train_telemetry = TrainTelemetry()
         self._impls = [get_impl(c.layer) for c in conf.confs]
         self._updaters = [make_layer_updater(c) for c in conf.confs]
         self._rnn_state: Dict[str, Any] = {}
@@ -318,7 +329,12 @@ class MultiLayerNetwork:
         )(params, state, rng, features, labels, feature_mask, label_mask)
         new_params, new_upd = self._apply_updates(
             params, upd_state, grads, iteration, grad_scale)
-        return new_params, new_state, new_upd, score
+        # Gradient-health scalars ride as extra outputs of THE SAME
+        # executable whether a listener is attached or not: telemetry
+        # on/off cannot change compile counts or the param trajectory
+        # (ISSUE 8 invariant). Unfetched, they cost a few reduction ops.
+        health = grad_health(grads, params, new_params)
+        return new_params, new_state, new_upd, score, health
 
     @functools.cached_property
     def _train_step(self):
@@ -337,14 +353,14 @@ class MultiLayerNetwork:
                 p, s, u, it, key = carry
                 key, sub = jax.random.split(key)
                 f, y = inp
-                p, s, u, score = self._step_body(
+                p, s, u, score, health = self._step_body(
                     p, s, u, it, sub, f, y, None, None, grad_scale)
-                return (p, s, u, it + 1, key), score
+                return (p, s, u, it + 1, key), (score, health)
 
-            (p, s, u, it, _), scores = jax.lax.scan(
+            (p, s, u, it, _), (scores, health) = jax.lax.scan(
                 body, (params, state, upd_state, iteration, rng),
                 (feats, labels))
-            return p, s, u, scores
+            return p, s, u, scores, health
 
         return jax.jit(steps, donate_argnums=(0, 1, 2))
 
@@ -360,14 +376,14 @@ class MultiLayerNetwork:
                 p, s, u, it, key = carry
                 key, sub = jax.random.split(key)
                 f, y, fm, lm = inp
-                p, s, u, score = self._step_body(
+                p, s, u, score, health = self._step_body(
                     p, s, u, it, sub, f, y, fm, lm, grad_scale)
-                return (p, s, u, it + 1, key), score
+                return (p, s, u, it + 1, key), (score, health)
 
-            (p, s, u, it, _), scores = jax.lax.scan(
+            (p, s, u, it, _), (scores, health) = jax.lax.scan(
                 body, (params, state, upd_state, iteration, rng),
                 (feats, labels, fms, lms))
-            return p, s, u, scores
+            return p, s, u, scores, health
 
         return jax.jit(steps, donate_argnums=(0, 1, 2))
 
@@ -409,10 +425,16 @@ class MultiLayerNetwork:
         else:
             step_fn = self._train_steps_scan
             extra = ()
-        self.params, self.state, self.updater_state, scores = step_fn(
+        t0 = time.perf_counter()
+        (self.params, self.state, self.updater_state, scores,
+         health) = step_fn(
             self.params, self.state, self.updater_state,
             self.iteration, sub, feats, labels, *extra, grad_scale)
-        self.iteration += int(feats.shape[0])
+        k, examples, tokens = window_counts(feats.shape)
+        self.train_telemetry.record_step(
+            dispatch_s=time.perf_counter() - t0, steps=k,
+            examples=examples, tokens=tokens, health=health)
+        self.iteration += k
         self.score_value = scores[-1]  # lazy device scalar, like _fit_batch
         from deeplearning4j_tpu.optimize.listeners import fire_crossed
 
@@ -502,7 +524,8 @@ class MultiLayerNetwork:
 
         drive_stream_windows(
             iterator, scan_steps, flush,
-            lambda ds: np.shape(ds.features))
+            lambda ds: np.shape(ds.features),
+            telemetry=self.train_telemetry)
         return scores
 
     @functools.cached_property
@@ -540,7 +563,14 @@ class MultiLayerNetwork:
                 self.pretrain(data)
                 data.reset()
             if self.conf.backprop:
-                for ds in data:
+                it = iter(data)
+                while True:
+                    t0 = time.perf_counter()
+                    ds = next(it, None)
+                    self.train_telemetry.add_data_wait(
+                        time.perf_counter() - t0)
+                    if ds is None:
+                        break
                     self._fit_batch(ds)
 
     def _fit_batch(self, ds) -> None:
@@ -558,14 +588,19 @@ class MultiLayerNetwork:
         labels = jnp.asarray(ds.labels, self._dtype)
         fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
         lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        examples, tokens = batch_counts(feats)
         for _ in range(n_iter):
             self._key, sub = jax.random.split(self._key)
-            self.params, self.state, self.updater_state, score = (
+            t0 = time.perf_counter()
+            self.params, self.state, self.updater_state, score, health = (
                 self._train_step(
                     self.params, self.state, self.updater_state,
                     self.iteration, sub, feats, labels, fm, lm,
                 )
             )
+            self.train_telemetry.record_step(
+                dispatch_s=time.perf_counter() - t0, examples=examples,
+                tokens=tokens, health=health)
             self.score_value = score
             self.iteration += 1
             for listener in self.listeners:
@@ -597,16 +632,23 @@ class MultiLayerNetwork:
                 else jnp.asarray(ds.labels_mask)[:, start:end]
             )
             self._key, sub = jax.random.split(self._key)
+            t0 = time.perf_counter()
             (
                 self.params,
                 self.state,
                 self.updater_state,
                 rnn_state,
                 score,
+                health,
             ) = self._tbptt_step(
                 self.params, self.state, self.updater_state,
                 self.iteration, sub, fw, lw, fmw, lmw, rnn_state,
             )
+            self.train_telemetry.record_step(
+                dispatch_s=time.perf_counter() - t0,
+                examples=int(fw.shape[0]),
+                tokens=int(fw.shape[0]) * int(fw.shape[2]),
+                health=health)
             self.score_value = score
             self.iteration += 1
             for listener in self.listeners:
@@ -634,7 +676,8 @@ class MultiLayerNetwork:
             new_params, new_upd = self._apply_updates(
                 params, upd_state, grads, iteration)
             new_rnn = jax.lax.stop_gradient(new_rnn)
-            return new_params, new_state, new_upd, new_rnn, score
+            health = grad_health(grads, params, new_params)
+            return new_params, new_state, new_upd, new_rnn, score, health
 
         return jax.jit(step)
 

@@ -10,17 +10,25 @@ batch whose shape differs from the window's first — flushes per-batch.
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 
 def drive_stream_windows(iterator, scan_steps: int,
-                         flush: Callable, batch_shape: Callable) -> None:
+                         flush: Callable, batch_shape: Callable,
+                         telemetry=None) -> None:
     """``flush(window, fused)`` trains a list of batches;
     ``batch_shape(ds)`` returns a comparable shape signature (host-side
-    only — no device transfers)."""
+    only — no device transfers). ``telemetry`` (a TrainTelemetry)
+    accumulates the host wait on ``iterator.next()`` as the data-wait
+    phase — with an async prefetcher keeping up, this reads near zero;
+    a disk-bound run shows exactly where its step time went."""
     window, first_shape = [], None
     while True:
+        t0 = time.perf_counter()
         ds = iterator.next()
+        if telemetry is not None:
+            telemetry.add_data_wait(time.perf_counter() - t0)
         if ds is None:
             if window:  # exhausted mid-window: always ragged here
                 flush(window, False)

@@ -12,6 +12,11 @@ from deeplearning4j_tpu.optimize.listeners import (
     ComposableIterationListener,
     IterationListener,
     ScoreIterationListener,
+    TracingIterationListener,
+)
+from deeplearning4j_tpu.optimize.telemetry import (
+    MetricsLog,
+    TrainTelemetry,
 )
 from deeplearning4j_tpu.optimize.stepfunctions import (
     DefaultStepFunction,

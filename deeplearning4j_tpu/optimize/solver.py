@@ -13,6 +13,8 @@ t-SNE, RBM fine-tuning experiments), not as the TPU hot loop.
 
 from __future__ import annotations
 
+import functools
+import time
 from typing import Callable, List, Optional, Tuple
 
 import jax
@@ -20,6 +22,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+from deeplearning4j_tpu.optimize.telemetry import (
+    batch_counts,
+    host_grad_health,
+)
 from deeplearning4j_tpu.optimize.terminations import DEFAULT_CONDITIONS
 
 Array = jax.Array
@@ -254,7 +260,18 @@ class BaseOptimizer:
         x = problem.x0
         score = None
         self.reset()
+        # Per-iteration telemetry: the solver loop is host-composed (it
+        # syncs the score every iteration anyway), so phases merge into
+        # one dispatch+eval wall and gradient health is lazy host-side
+        # numpy on the flat vectors — zero extra executables.
+        telemetry = getattr(self.net, "train_telemetry", None)
+        feats = getattr(ds, "features", None)
+        if isinstance(feats, (list, tuple)):
+            feats = feats[0] if feats else None
+        examples, tokens = batch_counts(feats)
         for it in range(self.max_iterations):
+            t_step = time.perf_counter()
+            x_prev = x
             score, grad = problem.value_and_grad(x)
             score = float(score)
             direction = self.direction(x, grad, it)
@@ -291,6 +308,12 @@ class BaseOptimizer:
             self._ls_scores = (score, new_score)  # for adaptive hooks
             self._post_step(x, grad, direction, step)
             problem.write_back(x)
+            if telemetry is not None:
+                telemetry.record_step(
+                    dispatch_s=time.perf_counter() - t_step,
+                    examples=examples, tokens=tokens,
+                    health=functools.partial(
+                        host_grad_health, grad, x_prev, x))
             self.net.score_value = new_score
             self.net.iteration += 1
             for listener in self.net.listeners:

@@ -33,6 +33,7 @@ memory allows, with single-device trajectory parity. Composes with dp
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -44,6 +45,14 @@ from deeplearning4j_tpu.util.jax_compat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.optimize.telemetry import (
+    HEALTH_KEYS,
+    batch_counts,
+    emit_step_span,
+    grad_health,
+    mesh_args,
+    window_counts,
+)
 
 
 def _layer_items(net):
@@ -215,11 +224,16 @@ class ParallelTrainer:
         local_steps: int = 1,
         accumulate_gradients: bool = False,
         divide_gradient: bool = True,
+        tracer=None,
     ):
         net.init()
         self.net = net
         self.mesh = mesh
         self.dp_axis = dp_axis
+        # Optional span sink: every step emits a ``train.parallel_step``
+        # span annotated with the mesh config (ISSUE 8), so a MULTICHIP
+        # sweep's per-combo Chrome traces are comparable in Perfetto.
+        self.tracer = tracer
         # ComputationGraph duck type: multi-input coercion + dict params
         self.is_graph = hasattr(net, "_coerce_multi")
         self.tp_axis = tp_axis if (tp_axis and tp_axis in mesh.axis_names) else None
@@ -399,6 +413,19 @@ class ParallelTrainer:
             NamedSharding(self.mesh, P(None, self._batch_axes)),
         )
 
+    def _trace_args(self, **extra):
+        """Mesh-config span annotation for this trainer's steps."""
+        axes = {name: ax for name, ax in (
+            ("dp", self.dp_axis), ("tp", self.tp_axis),
+            ("ep", self.ep_axis), ("fsdp", self.fsdp_axis),
+            ("sp", self.sp_axis)) if ax}
+        return mesh_args(self.mesh, "data", **axes, **extra)
+
+    def _emit_step_span(self, dispatch_s: float, **extra) -> None:
+        if self.tracer is not None:
+            emit_step_span(self.tracer, dispatch_s,
+                           self._trace_args(**extra))
+
     def fit_scan(self, features_stacked, labels_stacked,
                  features_mask_stacked=None, labels_mask_stacked=None):
         """K fused global steps: ``lax.scan`` over pre-stacked sharded
@@ -414,6 +441,19 @@ class ParallelTrainer:
             raise ValueError(
                 "fit_scan is the per-step-synchronous path; "
                 "K-local-steps mode already fuses via local_steps")
+        t0 = time.perf_counter()
+        scores = self._fit_scan_impl(
+            features_stacked, labels_stacked,
+            features_mask_stacked, labels_mask_stacked)
+        self._emit_step_span(
+            time.perf_counter() - t0,
+            steps=int(jax.tree.leaves(features_stacked)[0].shape[0]),
+            iteration=self.net.iteration, fused="scan")
+        return scores
+
+    def _fit_scan_impl(self, features_stacked, labels_stacked,
+                       features_mask_stacked=None,
+                       labels_mask_stacked=None):
         if self.sp_axis:
             return self._fit_scan_sp(
                 features_stacked, labels_stacked,
@@ -487,10 +527,18 @@ class ParallelTrainer:
             fm = self._shard_batch(ds.features_mask)
             lm = self._shard_batch(ds.labels_mask)
         net._key, sub = jax.random.split(net._key)
-        net.params, net.state, net.updater_state, score = net._train_step(
+        t0 = time.perf_counter()
+        (net.params, net.state, net.updater_state, score,
+         health) = net._train_step(
             net.params, net.state, net.updater_state,
             net.iteration, sub, inputs, labels, fm, lm, self._grad_scale(),
         )
+        dispatch_s = time.perf_counter() - t0
+        examples, tokens = batch_counts(jax.tree.leaves(inputs)[0])
+        net.train_telemetry.record_step(
+            dispatch_s=dispatch_s, examples=examples, tokens=tokens,
+            health=health)
+        self._emit_step_span(dispatch_s, iteration=net.iteration + 1)
         net.score_value = score
         net.iteration += 1
         for listener in net.listeners:
@@ -518,10 +566,23 @@ class ParallelTrainer:
             fm = self._shard_batch(ds.features_mask)
             lm = self._shard_batch(ds.labels_mask)
         net._key, sub = jax.random.split(net._key)
+        t0 = time.perf_counter()
         net.params, net.updater_state, score = step(
             net.params, net.updater_state, jnp.asarray(net.iteration),
             sub, feats, labels, fm, lm,
         )
+        dispatch_s = time.perf_counter() - t0
+        examples, tokens = batch_counts(jax.tree.leaves(feats)[0])
+        # K-local-steps fuses its own update rule (no per-step health
+        # outputs); phase/throughput telemetry still lands.
+        net.train_telemetry.record_step(
+            dispatch_s=dispatch_s, steps=self.local_steps,
+            examples=examples * self.local_steps,
+            tokens=tokens * self.local_steps)
+        self._emit_step_span(
+            dispatch_s, steps=self.local_steps,
+            iteration=net.iteration + self.local_steps,
+            mode="local_then_average")
         net.score_value = score
         net.iteration += self.local_steps
         for listener in net.listeners:
@@ -830,7 +891,10 @@ class ParallelTrainer:
             params, upd_state, grads, iteration)
         new_state = jax.tree.map(
             lambda s: lax.pmean(s, axes), new_state)
-        return new_params, new_state, new_upd, score
+        # Health from the GLOBAL (psum'd) gradient and the replicated
+        # params: identical on every device, out-spec P().
+        health = grad_health(grads, params, new_params)
+        return new_params, new_state, new_upd, score, health
 
     def _sp_specs(self):
         dp = self._sp_axes[0] if len(self._sp_axes) == 2 else None
@@ -855,7 +919,8 @@ class ParallelTrainer:
             mesh=self.mesh,
             in_specs=(pspec, sspec, uspec, P(), P(),
                       xspec, xspec, mspec, mspec),
-            out_specs=(pspec, sspec, uspec, P()),
+            out_specs=(pspec, sspec, uspec, P(),
+                       {k: P() for k in HEALTH_KEYS}),
             check_vma=False,
             axis_names=frozenset(self._sp_axes),
         )
@@ -877,9 +942,9 @@ class ParallelTrainer:
                 f, y, fm, lm, k = (
                     inp.get("f"), inp.get("y"), inp.get("fm"),
                     inp.get("lm"), inp["k"])
-                p, s, u, score = self._sp_body_core(
+                p, s, u, score, health = self._sp_body_core(
                     p, s, u, it, jax.random.fold_in(rng, k), f, y, fm, lm)
-                return (p, s, u, it + 1), score
+                return (p, s, u, it + 1), (score, health)
 
             k_steps = jax.tree.leaves(fs)[0].shape[0]
             xs = {"f": fs, "y": ys, "k": jnp.arange(k_steps)}
@@ -887,15 +952,16 @@ class ParallelTrainer:
                 xs["fm"] = fms
             if lms is not None:
                 xs["lm"] = lms
-            (params, state, upd_state, _), scores = jax.lax.scan(
+            (params, state, upd_state, _), (scores, health) = jax.lax.scan(
                 body, (params, state, upd_state, iteration), xs)
-            return params, state, upd_state, scores
+            return params, state, upd_state, scores, health
 
         fn = shard_map(
             steps,
             mesh=self.mesh,
             in_specs=(pspec, sspec, uspec, P(), P(), kx, kx, km, km),
-            out_specs=(pspec, sspec, uspec, P()),
+            out_specs=(pspec, sspec, uspec, P(),
+                       {k: P() for k in HEALTH_KEYS}),
             check_vma=False,
             axis_names=frozenset(self._sp_axes),
         )
@@ -972,9 +1038,17 @@ class ParallelTrainer:
             fm = self._put_spec(ds.features_mask, mspec)
             lm = self._put_spec(ds.labels_mask, mspec)
         net._key, sub = jax.random.split(net._key)
-        net.params, net.state, net.updater_state, score = self._sp_step_fn(
+        t0 = time.perf_counter()
+        (net.params, net.state, net.updater_state, score,
+         health) = self._sp_step_fn(
             net.params, net.state, net.updater_state,
             jnp.asarray(net.iteration), sub, feats, labels, fm, lm)
+        dispatch_s = time.perf_counter() - t0
+        examples, tokens = batch_counts(jax.tree.leaves(feats)[0])
+        net.train_telemetry.record_step(
+            dispatch_s=dispatch_s, examples=examples, tokens=tokens,
+            health=health)
+        self._emit_step_span(dispatch_s, iteration=net.iteration + 1)
         net.score_value = score
         net.iteration += 1
         for listener in net.listeners:
@@ -1002,11 +1076,17 @@ class ParallelTrainer:
             lms = self._put_spec(lms, km)
         net._key, sub = jax.random.split(net._key)
         start = net.iteration
-        net.params, net.state, net.updater_state, scores = (
+        t0 = time.perf_counter()
+        net.params, net.state, net.updater_state, scores, health = (
             self._sp_scan_fn(
                 net.params, net.state, net.updater_state,
                 jnp.asarray(net.iteration), sub, fs, ys, fms, lms))
-        net.iteration += int(jax.tree.leaves(fs)[0].shape[0])
+        k, examples, tokens = window_counts(
+            jax.tree.leaves(fs)[0].shape)
+        net.train_telemetry.record_step(
+            dispatch_s=time.perf_counter() - t0, steps=k,
+            examples=examples, tokens=tokens, health=health)
+        net.iteration += k
         net.score_value = scores[-1]
         from deeplearning4j_tpu.optimize.listeners import fire_crossed
 

@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 from typing import Optional
 
 import jax
@@ -73,6 +74,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from deeplearning4j_tpu.optimize.telemetry import (
+    batch_counts,
+    emit_step_span,
+    mesh_args,
+    window_counts,
+)
 from deeplearning4j_tpu.util.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -162,11 +169,16 @@ class HomogeneousPipelineTrainer:
         sp_axis: Optional[str] = None,
         n_microbatches: int = 4,
         interleave: int = 1,
+        tracer=None,
     ):
         from deeplearning4j_tpu.nn.conf.enums import (
             BackpropType,
             OptimizationAlgorithm,
         )
+
+        # Optional span sink (ISSUE 8): per-step train.parallel_step
+        # spans annotated with the mesh config.
+        self.tracer = tracer
         from deeplearning4j_tpu.nn.layers.attention import (
             TransformerBlock,
         )
@@ -818,6 +830,21 @@ class HomogeneousPipelineTrainer:
                     else P(self.dp_axis))
         return NamedSharding(self.mesh, spec)
 
+    def _trace_args(self, **extra):
+        axes = {"pp": self.pp_axis}
+        for name, ax in (("dp", self.dp_axis), ("tp", self.tp_axis),
+                         ("sp", self.sp_axis)):
+            if ax:
+                axes[name] = ax
+        return mesh_args(self.mesh, "homogeneous_pipeline",
+                         n_microbatches=self.M, interleave=self.V,
+                         **axes, **extra)
+
+    def _emit_step_span(self, dispatch_s: float, **extra) -> None:
+        if self.tracer is not None:
+            emit_step_span(self.tracer, dispatch_s,
+                           self._trace_args(**extra))
+
     def fit(self, data, labels=None) -> float:
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
@@ -844,8 +871,15 @@ class HomogeneousPipelineTrainer:
                 self._step_cache[key] = self._build_step(
                     feats.shape, labs.shape)
             net._key, sub = jax.random.split(net._key)
+            t0 = time.perf_counter()
             (*state, s) = self._step_cache[key](
                 *self._state, net.iteration, sub, feats, labs)
+            dispatch_s = time.perf_counter() - t0
+            examples, tokens = batch_counts(feats)
+            net.train_telemetry.record_step(
+                dispatch_s=dispatch_s, examples=examples, tokens=tokens)
+            self._emit_step_span(dispatch_s,
+                                 iteration=net.iteration + 1)
             self._state = tuple(state)
             net.score_value = s
             net.iteration += 1
@@ -868,8 +902,16 @@ class HomogeneousPipelineTrainer:
             self._step_cache[key] = self._build_step(
                 fs.shape[1:], ys.shape[1:], scan=True)
         net._key, sub = jax.random.split(net._key)
+        t0 = time.perf_counter()
         (*state, scores) = self._step_cache[key](
             *self._state, net.iteration, sub, fs, ys)
+        dispatch_s = time.perf_counter() - t0
+        k, examples, tokens = window_counts(fs.shape)
+        net.train_telemetry.record_step(
+            dispatch_s=dispatch_s, steps=k, examples=examples,
+            tokens=tokens)
+        self._emit_step_span(dispatch_s, steps=k,
+                             iteration=net.iteration + k, fused="scan")
         self._state = tuple(state)
         net.iteration += int(fs.shape[0])
         net.score_value = scores[-1]

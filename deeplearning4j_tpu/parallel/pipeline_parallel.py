@@ -26,6 +26,7 @@ Two levels:
 from __future__ import annotations
 
 import math
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -37,6 +38,12 @@ from deeplearning4j_tpu.util.jax_compat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+from deeplearning4j_tpu.optimize.telemetry import (
+    batch_counts,
+    emit_step_span,
+    mesh_args,
+    window_counts,
+)
 
 Array = jax.Array
 
@@ -344,8 +351,13 @@ class PipelineTrainer:
         n_microbatches: int = 4,
         stage_ranges: Optional[Sequence[Tuple[int, int]]] = None,
         dp_axis: Optional[str] = None,
+        tracer=None,
     ):
         from deeplearning4j_tpu.nn.conf.enums import BackpropType
+
+        # Optional span sink (ISSUE 8): every pipelined step emits a
+        # ``train.parallel_step`` span with the mesh config in its args.
+        self.tracer = tracer
 
         net.init()
         # Aux-only state (MoeDense load-balance loss) is step-local and
@@ -982,6 +994,19 @@ class PipelineTrainer:
         return jax.device_put(
             jnp.zeros(rnn_shape, self.net._dtype), self._rnn_sharding())
 
+    def _trace_args(self, **extra):
+        axes = {"pp": self.pp_axis}
+        if self.dp_axis:
+            axes["dp"] = self.dp_axis
+        return mesh_args(self.mesh, "pipeline",
+                         n_microbatches=self.n_microbatches,
+                         n_stages=self.n_stages, **axes, **extra)
+
+    def _emit_step_span(self, dispatch_s: float, **extra) -> None:
+        if self.tracer is not None:
+            emit_step_span(self.tracer, dispatch_s,
+                           self._trace_args(**extra))
+
     def _run_step(self, key, build_args, step_args, rnn):
         """Build-or-fetch the step for ``key``, zero-init the RNN
         buffer when absent, run one step. Returns (rnn', score)."""
@@ -992,9 +1017,15 @@ class PipelineTrainer:
         if rnn is None:
             rnn = self._zero_rnn(rnn_shape)
         net._key, sub = jax.random.split(net._key)
+        t0 = time.perf_counter()
         self._theta, self._ustate, self._sstate, rnn, s = step(
             self._theta, self._ustate, self._sstate, rnn,
             net.iteration, sub, *step_args)
+        dispatch_s = time.perf_counter() - t0
+        examples, tokens = batch_counts(step_args[0])
+        net.train_telemetry.record_step(
+            dispatch_s=dispatch_s, examples=examples, tokens=tokens)
+        self._emit_step_span(dispatch_s, iteration=net.iteration + 1)
         net.score_value = s
         net.iteration += 1
         return rnn, s
@@ -1148,11 +1179,19 @@ class PipelineTrainer:
             self._rnn_dummy = self._zero_rnn(rnn_shape)
         net._key, sub = jax.random.split(net._key)
         start = net.iteration
+        t0 = time.perf_counter()
         (self._theta, self._ustate, self._sstate, self._rnn_dummy,
          scores) = step(
             self._theta, self._ustate, self._sstate, self._rnn_dummy,
             net.iteration, sub, fs, ys, fms, lms,
         )
+        dispatch_s = time.perf_counter() - t0
+        _, examples, tokens = window_counts(fs.shape)
+        net.train_telemetry.record_step(
+            dispatch_s=dispatch_s, steps=K, examples=examples,
+            tokens=tokens)
+        self._emit_step_span(dispatch_s, steps=K,
+                             iteration=net.iteration + K, fused="scan")
         net.iteration += K
         net.score_value = scores[-1]
         self._sync_to_net()

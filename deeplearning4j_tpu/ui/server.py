@@ -14,7 +14,17 @@ Endpoints:
   POST /vectors            {labels, vectors}             → {ok}
       (the Word2Vec nearest-neighbors upload; VPTree-indexed)
   GET  /nearest?word=…&k=…                               → {neighbors}
+  GET  /train/metrics      Prometheus exposition text    (ISSUE 8)
+  GET  /train/trace        Chrome trace-event JSON       (ISSUE 8)
   GET  /                                                 → HTML dashboard
+
+The two ``/train/*`` endpoints render an attached
+:class:`~deeplearning4j_tpu.profiler.tracer.Tracer` (``UiServer(...,
+tracer=)`` or :meth:`UiServer.attach_tracer`) with the SAME renderers
+the serving gateway uses — ``Tracer.prometheus_text`` for a scrape
+target and the Chrome trace-event event list for Perfetto — so a
+training run is observable with the exact tooling the serving stack
+already taught (scripts/latency_report.py reads either).
 """
 
 from __future__ import annotations
@@ -511,6 +521,22 @@ class _Handler(JsonHandler):
                     {"neighbors": self.server_ref.nearest(word, k)})
             except KeyError:
                 self.send_json({"error": f"unknown word {word!r}"}, 404)
+        elif parsed.path == "/train/metrics":
+            tracer = self.server_ref.tracer
+            if tracer is None:
+                self.send_json(
+                    {"error": "no tracer attached (UiServer(tracer=) "
+                              "or attach_tracer)"}, 404)
+            else:
+                self.send_bytes(
+                    tracer.prometheus_text().encode(),
+                    "text/plain; version=0.0.4")
+        elif parsed.path == "/train/trace":
+            tracer = self.server_ref.tracer
+            if tracer is None:
+                self.send_json({"error": "no tracer attached"}, 404)
+            else:
+                self.send_json({"traceEvents": tracer.events()})
         else:
             self.send_json({"error": "not found"}, 404)
 
@@ -530,13 +556,21 @@ class UiServer(HttpService):
     """Threaded observability server over a HistoryStorage."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 storage: Optional[HistoryStorage] = None):
+                 storage: Optional[HistoryStorage] = None,
+                 tracer=None):
         self.storage = storage or HistoryStorage()
+        self.tracer = tracer
         super().__init__(_Handler, host, port,
                          storage=self.storage, server_ref=self)
         self._vec_lock = threading.Lock()
         self._labels: List[str] = []
         self._tree = None
+
+    def attach_tracer(self, tracer) -> None:
+        """Expose a (training) Tracer at ``/train/metrics`` +
+        ``/train/trace`` — attach the same tracer the
+        TracingIterationListener feeds."""
+        self.tracer = tracer
 
     # -- word2vec nearest neighbors (reference nearestneighbors/word2vec) --
     def set_vectors(self, labels: List[str], vectors) -> None:
@@ -592,3 +626,15 @@ class UiClient:
                + urllib.parse.urlencode({"word": word, "k": k}))
         with urllib.request.urlopen(url, timeout=self.timeout) as resp:
             return json.loads(resp.read())["neighbors"]
+
+    def get_train_metrics(self) -> str:
+        """Prometheus exposition text from ``/train/metrics``."""
+        with urllib.request.urlopen(self.address + "/train/metrics",
+                                    timeout=self.timeout) as resp:
+            return resp.read().decode("utf-8", "replace")
+
+    def get_train_trace(self) -> Dict[str, Any]:
+        """Chrome trace-event document from ``/train/trace``."""
+        with urllib.request.urlopen(self.address + "/train/trace",
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read())

@@ -1,4 +1,9 @@
-"""Train the BASELINE row-1 MLP on MNIST and evaluate.
+"""Train the BASELINE row-1 MLP on MNIST and evaluate — with the
+training telemetry (ISSUE 8) attached: a ``TracingIterationListener``
+drains the per-step phase clock every iteration, and the run ends by
+printing the per-step breakdown (data-wait / dispatch / sync), the
+gradient-health scalars, and p50/p99 step time straight from the
+listener-owned histograms (no server needed).
 
 Run: python examples/mnist_mlp.py
 (The MNIST loader falls back to a deterministic synthetic set offline.)
@@ -24,7 +29,11 @@ from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, Updater
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.ops.losses import LossFunction
-from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+from deeplearning4j_tpu.optimize.listeners import (
+    ScoreIterationListener,
+    TracingIterationListener,
+)
+from deeplearning4j_tpu.profiler.tracer import Tracer
 
 
 def main():
@@ -41,7 +50,9 @@ def main():
         .build()
     )
     net = MultiLayerNetwork(conf).init()
-    net.set_listeners(ScoreIterationListener(50))
+    tracer = Tracer(max_events=65536)
+    telemetry = TracingIterationListener(tracer=tracer)
+    net.set_listeners(ScoreIterationListener(50), telemetry)
 
     n_train, n_test, epochs = (1024, 512, 1) if TINY else (8192, 2048, 3)
     train = MnistDataSetIterator(128, train=True, num_examples=n_train)
@@ -54,6 +65,24 @@ def main():
 
     evaluation: Evaluation = net.evaluate(test)
     print(evaluation.stats())
+
+    # -- per-step breakdown from the listener-owned histograms --------
+    counters = tracer.latest_counters()
+    print(f"\ntraining telemetry over "
+          f"{int(counters['train_steps_total'])} steps:")
+    for track, label in (("train_step_s", "step"),
+                         ("train_data_wait_s", "data-wait"),
+                         ("train_sync_s", "host-sync")):
+        hist = telemetry.hists[track]
+        print(f"  {label:<10} p50 {1e3 * hist.quantile(0.5):8.3f} ms   "
+              f"p99 {1e3 * hist.quantile(0.99):8.3f} ms   "
+              f"(n={hist.count})")
+    print(f"  throughput {counters['train_examples_per_sec']:,.0f} "
+          f"examples/s (last window)")
+    print("  gradient health: "
+          f"grad-norm p50 {telemetry.quantile('train_grad_norm', 0.5):.4f}, "
+          f"update/param p50 "
+          f"{telemetry.quantile('train_update_ratio', 0.5):.5f}")
 
 
 if __name__ == "__main__":

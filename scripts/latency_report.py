@@ -1,30 +1,38 @@
-"""Per-phase serving-latency report (ISSUE 7 satellite).
+"""Per-phase latency report for the serving AND training stacks
+(ISSUE 7 satellite; training tracks added by ISSUE 8).
 
-Reads either a SAVED Chrome trace (``Tracer.save`` output, or a
-``GET /v1/trace`` download) or a LIVE gateway URL, and prints one
-latency table: p50/p90/p99 for TTFT, inter-token latency, queue wait,
-round time, and end-to-end — the numbers a serving stack is judged on.
+Reads either a SAVED Chrome trace (``Tracer.save`` output, a
+``GET /v1/trace`` download, or a ``/train/trace`` download) or a LIVE
+metrics URL, auto-detects which track families are present, and prints
+one latency table:
+
+- **serving rows** — p50/p90/p99 for TTFT, inter-token latency, queue
+  wait, round time, and end-to-end (``serving_*`` histogram families /
+  ``serving.request_done`` instants).
+- **training rows** — p50/p90/p99 for per-step wall (``step``),
+  iterator wait (``data_wait``), and host-sync wall (``sync``)
+  (``train_*`` histogram families / ``train.step`` span args).
 
 Two sources, same table:
 
-- **Live gateway** (``http://host:port``): scrapes ``/v1/metrics`` and
-  computes quantiles from the Prometheus ``histogram`` families the
-  engine exports (``serving_ttft_s``, ``serving_itl_s``,
-  ``serving_queue_wait_s``, ``serving_round_s``, ``serving_e2e_s``) —
-  bucket-interpolated, exactly what a PromQL ``histogram_quantile``
-  would answer.
-- **Saved trace** (``trace.json``): exact per-request quantiles from
-  the ``serving.request_done`` instant events the engine stamps at
-  every terminal (each carries the request's full timing breakdown),
-  plus the round-time distribution from ``serving.decode_chunk`` span
-  durations. ITL here is each request's mean inter-token gap
-  ``(e2e - ttft) / (tokens - 1)`` — per-request, where the live
-  histogram is per-token.
+- **Live URL**: a full metrics endpoint
+  (``http://host:port/v1/metrics`` or ``http://host:port/train/
+  metrics``) is scraped as-is; a BASE url tries the serving gateway's
+  ``/v1/metrics`` and the UiServer's ``/train/metrics`` and merges
+  whatever answers. Quantiles are bucket-interpolated from the
+  Prometheus ``histogram`` families — exactly what a PromQL
+  ``histogram_quantile`` would answer.
+- **Saved trace** (``trace.json``): exact quantiles from the
+  ``serving.request_done`` instants / ``serving.decode_chunk`` spans
+  (serving) and from the per-window ``train.step`` spans, whose args
+  carry the phase breakdown; a fused K-step window contributes K
+  per-step samples (window value / steps, K times).
 
 Usage::
 
     python scripts/latency_report.py trace.json
     python scripts/latency_report.py http://127.0.0.1:8000
+    python scripts/latency_report.py http://127.0.0.1:9000/train/metrics
 """
 
 from __future__ import annotations
@@ -50,6 +58,14 @@ LIVE_ROWS = (
     ("serving_queue_wait_s", "queue_wait"),
     ("serving_round_s", "round"),
     ("serving_e2e_s", "e2e"),
+)
+
+#: training histogram-track → table-row label (ISSUE 8): auto-detected
+#: beside the serving families — a scrape carrying both prints both.
+TRAIN_LIVE_ROWS = (
+    ("train_step_s", "step"),
+    ("train_data_wait_s", "data_wait"),
+    ("train_sync_s", "sync"),
 )
 
 _BUCKET_RE = re.compile(
@@ -117,10 +133,11 @@ def _exact_quantile(values: List[float], q: float) -> float:
 
 
 def report_from_metrics_text(text: str) -> List[Dict[str, object]]:
-    """Table rows from a ``/v1/metrics`` scrape (live-gateway mode)."""
+    """Table rows from a metrics scrape (live mode): serving and/or
+    training histogram families, whichever the text carries."""
     hists = parse_prometheus_histograms(text)
     rows = []
-    for track, label in LIVE_ROWS:
+    for track, label in LIVE_ROWS + TRAIN_LIVE_ROWS:
         h = hists.get(track)
         if h is None:
             continue
@@ -138,13 +155,25 @@ def report_from_events(events) -> List[Dict[str, object]]:
     """Table rows from a Chrome trace's event list (saved-trace
     mode): exact quantiles over the per-request
     ``serving.request_done`` timing instants + decode-span round
-    times."""
+    times (serving), and over the ``train.step`` span args (training —
+    a K-step fused window contributes K per-step samples)."""
     series: Dict[str, List[float]] = {
         "ttft": [], "itl": [], "queue_wait": [], "round": [],
         "e2e": []}
+    train: Dict[str, List[float]] = {
+        "step": [], "data_wait": [], "sync": []}
     for event in events:
         args = event.get("args") or {}
-        if (event.get("ph") == "i"
+        if (event.get("ph") == "X"
+                and event.get("name") == "train.step"):
+            steps = max(1, int(args.get("steps") or 1))
+            dur_s = float(event.get("dur", 0.0)) * 1e-6
+            train["step"].extend([dur_s / steps] * steps)
+            train["data_wait"].extend(
+                [float(args.get("data_wait_s", 0.0)) / steps] * steps)
+            if args.get("sync_s") is not None:
+                train["sync"].append(float(args["sync_s"]))
+        elif (event.get("ph") == "i"
                 and event.get("name") == "serving.request_done"):
             timing = args.get("timing") or {}
             if timing.get("ttft_s") is not None:
@@ -162,7 +191,7 @@ def report_from_events(events) -> List[Dict[str, object]]:
         elif (event.get("ph") == "X"
                 and event.get("name") == "serving.decode_chunk"):
             series["round"].append(event.get("dur", 0.0) * 1e-6)
-    return [{
+    rows = [{
         "phase": label,
         "count": len(series[label]),
         **{f"p{int(q * 100)}_ms":
@@ -170,10 +199,18 @@ def report_from_events(events) -> List[Dict[str, object]]:
            for q in QUANTILES},
     } for label in ("ttft", "itl", "queue_wait", "round", "e2e")
         if series[label]]
+    rows.extend({
+        "phase": label,
+        "count": len(train[label]),
+        **{f"p{int(q * 100)}_ms":
+           1e3 * _exact_quantile(train[label], q)
+           for q in QUANTILES},
+    } for label in ("step", "data_wait", "sync") if train[label])
+    return rows
 
 
 def render(rows: List[Dict[str, object]], source: str) -> str:
-    lines = [f"serving latency report — {source}",
+    lines = [f"latency report — {source}",
              f"{'phase':<12} {'count':>7} "
              + " ".join(f"{'p%d' % int(q * 100) + ' (ms)':>12}"
                         for q in QUANTILES)]
@@ -185,13 +222,30 @@ def render(rows: List[Dict[str, object]], source: str) -> str:
     return "\n".join(lines)
 
 
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
 def run_report(source: str) -> List[Dict[str, object]]:
-    """Rows for one source: a gateway base URL or a trace-file path."""
+    """Rows for one source: a live URL (a full metrics endpoint, or a
+    base URL probed for the serving gateway's ``/v1/metrics`` and the
+    UiServer's ``/train/metrics``) or a trace-file path."""
     if source.startswith(("http://", "https://")):
-        with urllib.request.urlopen(source.rstrip("/") + "/v1/metrics",
-                                    timeout=30) as resp:
-            return report_from_metrics_text(
-                resp.read().decode("utf-8", "replace"))
+        base = source.rstrip("/")
+        if base.endswith("/metrics"):
+            return report_from_metrics_text(_scrape(base))
+        texts, errors = [], []
+        for path in ("/v1/metrics", "/train/metrics"):
+            try:
+                texts.append(_scrape(base + path))
+            except Exception as e:  # probe: either endpoint may 404
+                errors.append(f"{path}: {e}")
+        if not texts:
+            raise RuntimeError(
+                f"no metrics endpoint answered at {base} "
+                f"({'; '.join(errors)})")
+        return report_from_metrics_text("\n".join(texts))
     with open(source) as f:
         doc = json.load(f)
     events = doc.get("traceEvents", doc) if isinstance(doc, dict) \
@@ -209,7 +263,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     rows = run_report(args.source)
     if not rows:
-        print("no serving latency data found in "
+        print("no serving or training latency data found in "
               f"{args.source}", file=sys.stderr)
         return 1
     if args.json:
