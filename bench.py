@@ -1591,6 +1591,188 @@ def bench_gateway_streaming():
     }
 
 
+def bench_router_overhead():
+    """Router-tier row (ISSUE 9): the multi-replica router must be a
+    near-free translation layer. 8 concurrent SSE streams over TWO
+    gateway replicas (width-1024 flagship, 2048-token window, 4 slots
+    each), once DIRECT to the gateways (4 streams each — the same
+    engines, no router) and once THROUGH the router, interleaved
+    trials. The delta is exactly the router's relay cost: journaling,
+    high-water bookkeeping, a second SSE hop per delta.
+
+    Gates:
+    - overhead: router-path aggregate tokens/sec >= 0.9x the
+      direct-to-gateway aggregate on the same replicas;
+    - parity: every routed stream's ids bit-identical to the
+      in-process single-engine reference (id match 1.0) — the router
+      changes nothing about the computation;
+    - compile counts: identical before/after routed traffic on both
+      replica engines.
+
+    Annotation: affinity hit rate on an 80%-shared-prefix workload —
+    the fraction of warm-eligible requests that landed on the replica
+    holding their prefix warm (measured by per-request
+    ``prefix_tokens_reused`` through the router)."""
+    import threading
+
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        GatewayClient,
+        Request,
+        RouterClient,
+        ServingGateway,
+        ServingRouter,
+    )
+
+    V, width, n_layers, window = 64, 1024, 8, 2048
+    n_streams, n_gen, prompt_len = 8, 64, 128
+    per_replica_slots = 4
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_streams)]
+
+    # in-process single-engine reference: the ids every routed stream
+    # must match bit for bit (greedy parity across batch topologies
+    # is an engine guarantee the serving suite gates)
+    ref_eng = DecodeEngine(net, n_slots=n_streams, decode_chunk=32)
+    ref_ids = [ref_eng.submit(Request(prompt=list(p),
+                                      max_new_tokens=n_gen))
+               for p in prompts]
+    ref_res = ref_eng.run()
+    ref_tokens = [ref_res[i].tokens for i in ref_ids]
+
+    engines = [DecodeEngine(net, n_slots=per_replica_slots,
+                            decode_chunk=32, prefix_cache_rows=8)
+               for _ in range(2)]
+    gateways = [ServingGateway(e, keepalive_s=1.0,
+                               admission_grace_s=0.25,
+                               replica_id=f"bench-rep-{i}").start()
+                for i, e in enumerate(engines)]
+    router = ServingRouter([g.address for g in gateways],
+                           health_interval_s=0.25,
+                           affinity_block_tokens=16).start()
+    direct_clients = [GatewayClient(g.address, timeout_s=600.0)
+                      for g in gateways]
+    routed_client = RouterClient(router.address, timeout_s=600.0)
+
+    def stream_round(client_of):
+        """8 concurrent streams; client_of(i) picks the connection
+        target per stream index."""
+        outs = [None] * n_streams
+        errors = [None] * n_streams
+
+        def one(i):
+            try:
+                s = client_of(i).stream(prompts[i], n_gen)
+                toks = []
+                for delta in s:
+                    toks.extend(delta)
+                outs[i] = toks
+            except Exception as e:
+                errors[i] = e
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_streams)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        failed = {i: repr(e) for i, e in enumerate(errors) if e}
+        if failed:
+            raise RuntimeError(f"stream clients failed: {failed}")
+        toks = sum(len(o) for o in outs)
+        return toks / dt, outs
+
+    # direct mode pins stream i to replica i%2 — the same 4/4 split
+    # the router's rendezvous would have to beat
+    def direct_of(i):
+        return direct_clients[i % 2]
+
+    def routed_of(i):
+        return routed_client
+
+    try:
+        _, outs = stream_round(routed_of)  # warm both replicas + ref
+        id_match = float(np.mean([outs[i] == ref_tokens[i]
+                                  for i in range(n_streams)]))
+        if id_match < 1.0:
+            _fail_gate(f"routed stream ids diverged from the "
+                       f"in-process reference (match "
+                       f"{id_match:.2f})")
+        stream_round(direct_of)  # warm the direct path alike
+        counts0 = [e.compile_counts() for e in engines]
+        direct_rates, routed_rates = [], []
+        for _ in range(3):  # interleaved: drift hits both alike
+            r, _ = stream_round(direct_of)
+            direct_rates.append(r)
+            r, _ = stream_round(routed_of)
+            routed_rates.append(r)
+        counts1 = [e.compile_counts() for e in engines]
+        if counts1 != counts0:
+            _fail_gate(f"replica engines retraced under routed "
+                       f"traffic: {counts0} -> {counts1}")
+
+        # affinity annotation: 80%-shared-prefix workload — 8 of 10
+        # prompts share a 64-token system prefix (4 affinity blocks)
+        shared = rng.integers(0, V, 64).tolist()
+        aff_prompts = [shared + rng.integers(0, V, 8).tolist()
+                       for _ in range(8)]
+        aff_prompts += [rng.integers(0, V, 72).tolist()
+                        for _ in range(2)]
+        aff_outs = []
+        for p in aff_prompts:
+            aff_outs.append(routed_client.generate(p, 8))
+        warm_eligible = aff_outs[1:8]  # shared cohort minus cold fill
+        aff_hits = sum(1 for o in warm_eligible
+                       if o["prefix_tokens_reused"] > 0)
+        affinity_hit_rate = aff_hits / len(warm_eligible)
+        if affinity_hit_rate < 0.7:
+            _fail_gate(f"affinity hit rate {affinity_hit_rate:.2f} "
+                       "< 0.7 on the 80%-shared-prefix workload")
+    finally:
+        router.close()
+        for g in gateways:
+            g.close()
+    direct_rate = float(np.median(direct_rates))
+    routed_rate = float(np.median(routed_rates))
+    ratio = routed_rate / direct_rate
+    if ratio < 0.9:
+        _fail_gate(
+            f"router streaming {routed_rate:.0f} tok/s < 0.9x "
+            f"direct-to-gateway {direct_rate:.0f} "
+            f"(ratio {ratio:.2f})")
+    return {
+        "metric": "router_streaming_tokens_per_sec",
+        "value": round(routed_rate, 1),
+        "unit": (f"aggregate tokens/sec through the multi-replica "
+                 f"router (width-1024 flagship, 2048-token KV "
+                 f"window, 2 replicas x {per_replica_slots} slots, "
+                 f"{n_streams} concurrent SSE streams x {n_gen} "
+                 "tokens, localhost)"),
+        "vs_baseline": None,  # reference has no serving frontend
+        "spread": [round(min(routed_rates), 1),
+                   round(max(routed_rates), 1)],
+        "trials": len(routed_rates),
+        "vs_direct_gateway": round(ratio, 3),
+        "direct_tokens_per_sec": round(direct_rate, 1),
+        "router_http_id_match": round(id_match, 4),
+        "affinity_hit_rate": round(affinity_hit_rate, 3),
+        "compile_counts": counts1,
+    }
+
+
 def bench_observability_overhead():
     """Observability row (ISSUE 7 acceptance): the request-scoped
     flight recorder must be cheap enough to leave ON. Same width-1024
@@ -2092,7 +2274,8 @@ def main() -> None:
                bench_hostfed_cnn, bench_decode, bench_decode_batched,
                bench_prefix_cache, bench_decode_paged,
                bench_decode_spec,
-               bench_gateway_streaming, bench_observability_overhead,
+               bench_gateway_streaming, bench_router_overhead,
+               bench_observability_overhead,
                bench_train_observability_overhead,
                bench_w2v, bench_dbn, bench_allreduce):
         try:

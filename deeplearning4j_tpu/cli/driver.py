@@ -401,6 +401,41 @@ def gateway_from_args(args):
         host=args.host, port=args.port)
 
 
+def router_from_args(args):
+    """Build the multi-replica serving router the ``route``
+    subcommand runs — factored out so tests can drive the exact CLI
+    path without the serve-forever loop."""
+    from deeplearning4j_tpu.serving import ServingRouter
+
+    replicas = [a.strip() for a in args.replicas.split(",")
+                if a.strip()]
+    return ServingRouter(
+        replicas, host=args.host, port=args.port,
+        affinity_block_tokens=args.affinity_block_tokens,
+        health_interval_s=args.health_interval,
+        failure_threshold=args.failure_threshold,
+        probe_interval_s=args.probe_interval,
+        max_replays=args.max_replays)
+
+
+def _cmd_route(args) -> int:
+    import time as _time
+
+    router = router_from_args(args).start()
+    print(f"routing on {router.address} over "
+          f"{len(router._replicas)} replicas "
+          f"(POST /v1/generate, GET /v1/healthz, GET /v1/metrics, "
+          f"POST /v1/replicas/drain)")
+    try:
+        while True:
+            _time.sleep(0.5)
+    except KeyboardInterrupt:
+        print("stopping router (replicas keep serving)...")
+    finally:
+        router.close()
+    return 0
+
+
 def _cmd_serve(args) -> int:
     import time as _time
 
@@ -527,6 +562,32 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--drain-timeout", type=float, default=30.0,
                    help="seconds to settle in-flight work on shutdown")
     s.set_defaults(fn=_cmd_serve)
+
+    rt = sub.add_parser(
+        "route",
+        help="front N serve replicas with the failure-tolerant "
+             "prefix-aware router")
+    rt.add_argument("--replicas", required=True,
+                    help="comma-separated replica addresses "
+                         "(host:port of running `serve` gateways — "
+                         "all must serve the SAME model/seed)")
+    rt.add_argument("--host", default="127.0.0.1")
+    rt.add_argument("--port", type=int, default=8420)
+    rt.add_argument("--affinity-block-tokens", type=int, default=16,
+                    help="prefix-affinity hash granularity (match "
+                         "the replicas' --block-tokens under paged "
+                         "KV)")
+    rt.add_argument("--health-interval", type=float, default=0.25,
+                    help="seconds between /v1/healthz scrapes")
+    rt.add_argument("--failure-threshold", type=int, default=3,
+                    help="consecutive failures before a replica's "
+                         "circuit breaker opens")
+    rt.add_argument("--probe-interval", type=float, default=1.0,
+                    help="half-open probe period for dead replicas")
+    rt.add_argument("--max-replays", type=int, default=3,
+                    help="replay budget per request across replica "
+                         "deaths")
+    rt.set_defaults(fn=_cmd_route)
     return p
 
 

@@ -10,7 +10,10 @@ verification (ISSUE 4 tentpole) — and the streaming HTTP serving
 gateway + client that turn the engine into a deployable server
 (ISSUE 5 tentpole) — and paged KV memory: one block-pool cache shared
 by decode slots and the prefix trie, with zero-copy prefix splices and
-copy-on-write divergence (ISSUE 6 tentpole, ``paged_kv=True``)."""
+copy-on-write divergence (ISSUE 6 tentpole, ``paged_kv=True``) — and
+the multi-replica router tier: a failure-tolerant prefix-affinity
+front door over N gateway replicas with journaled in-flight replay
+onto survivors (ISSUE 9 tentpole)."""
 
 from deeplearning4j_tpu.serving.block_pool import BlockPool, BlockTable
 
@@ -29,6 +32,11 @@ from deeplearning4j_tpu.serving.faults import (
 from deeplearning4j_tpu.serving.gateway import (
     STATUS_OF_REASON,
     ServingGateway,
+)
+from deeplearning4j_tpu.serving.router import (
+    REPLICA_STATES,
+    RouterClient,
+    ServingRouter,
 )
 from deeplearning4j_tpu.serving.prefix_cache import (
     PagedPrefixCache,
@@ -63,11 +71,14 @@ __all__ = [
     "NgramDraftTable",
     "PagedPrefixCache",
     "PrefixHit",
+    "REPLICA_STATES",
     "RadixPrefixCache",
     "Request",
+    "RouterClient",
     "STATUS_OF_REASON",
     "Scheduler",
     "ServingGateway",
+    "ServingRouter",
     "greedy_acceptance",
     "sample_tokens",
 ]

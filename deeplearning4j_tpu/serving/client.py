@@ -2,20 +2,51 @@
 
 ``GatewayClient`` speaks the gateway's endpoints over plain
 ``http.client`` — no dependencies, so the same class serves tests, the
-soak harness (scripts/gateway_soak.py), benches, and examples. The
-streaming call returns a :class:`GatewayStream`: an iterator of
-per-delta token lists that exposes the request id immediately (so the
-caller can cancel mid-stream) and the full terminal result after
-exhaustion. Closing the stream early — or just dropping the connection
-— is the disconnect-cancel path: the gateway notices the dead socket
-and frees the request's slot.
+soak harness (scripts/gateway_soak.py), benches, examples, and the
+multi-replica router (serving/router.py). The streaming call returns a
+:class:`GatewayStream`: an iterator of per-delta token lists that
+exposes the request id immediately (so the caller can cancel
+mid-stream) and the full terminal result after exhaustion. Closing the
+stream early — or just dropping the connection — is the
+disconnect-cancel path: the gateway notices the dead socket and frees
+the request's slot.
+
+Failure-tolerance knobs (ISSUE 9 satellite — the router needs them and
+so does any bare client talking to a replica that might die):
+
+- ``connect_timeout_s`` bounds the TCP connect separately from reads —
+  a DEAD host (SYN black hole) fails in bounded time instead of
+  hanging the caller on the socket default;
+- ``read_timeout_s`` bounds each blocking read once connected — a
+  replica that accepted the request and then froze surfaces as
+  ``socket.timeout`` instead of a forever-stalled caller;
+- ``retries`` + ``backoff_s`` add bounded, jittered-backoff retry on
+  connection-refused/reset — but ONLY for the idempotent GETs
+  (``healthz``/``metrics``/``poll``/``trace``): a generate POST is
+  never retried here, because blind resubmission could double-run a
+  request (that replay discipline lives in the router's journal,
+  where dedup is possible).
 """
 
 from __future__ import annotations
 
 import http.client
 import json
-from typing import Any, Dict, Iterator, List, Optional
+import random
+import socket
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+#: connection-level failures worth a retry for idempotent calls: the
+#: peer was unreachable or vanished BEFORE a full response arrived.
+#: (socket.timeout subclasses OSError; HTTPException covers a peer
+#: that accepted then died mid-exchange — BadStatusLine and
+#: RemoteDisconnected at the handshake, IncompleteRead when a
+#: Content-Length body is cut short by a SIGKILL.)
+RETRYABLE_ERRORS = (ConnectionRefusedError, ConnectionResetError,
+                    ConnectionAbortedError, BrokenPipeError,
+                    socket.timeout, http.client.HTTPException,
+                    OSError)
 
 
 class GatewayError(RuntimeError):
@@ -60,23 +91,55 @@ class GatewayStream:
             if first.get("done"):
                 self.result = first
 
-    def _next_event(self) -> Optional[Dict[str, Any]]:
-        """Next ``data:`` event (comment pings skipped), or None at
-        end of stream."""
+    def _read_frame(self) -> Tuple[str, Optional[Dict[str, Any]]]:
+        """Lowest-level SSE read: one ``("event", dict)``,
+        ``("ping", None)`` keep-alive comment, or ``("eof", None)``
+        when the stream/connection ended."""
         data_lines: List[bytes] = []
         while True:
             line = self._resp.readline()
             if not line:  # connection/stream ended
-                return None
+                return "eof", None
             line = line.rstrip(b"\r\n")
             if not line:  # blank line = event boundary
                 if data_lines:
-                    return json.loads(b"".join(data_lines))
+                    return "event", json.loads(b"".join(data_lines))
                 continue  # boundary after a comment ping
             if line.startswith(b":"):
-                continue  # keep-alive comment
+                return "ping", None  # keep-alive comment
             if line.startswith(b"data:"):
                 data_lines.append(line[5:].strip())
+
+    def _next_event(self) -> Optional[Dict[str, Any]]:
+        """Next ``data:`` event (comment pings skipped), or None at
+        end of stream."""
+        while True:
+            kind, event = self._read_frame()
+            if kind == "eof":
+                return None
+            if kind == "event":
+                return event
+
+    def raw_events(self) -> Iterator[Tuple[str,
+                                           Optional[Dict[str, Any]]]]:
+        """Relay-mode iterator (the router's view of a replica
+        stream): yields ``("ping", None)`` for every keep-alive the
+        server sends — so a proxy can forward liveness to ITS client —
+        and ``("event", dict)`` for data events, ending at stream end.
+        A stream that ends without a ``done`` event means the server
+        died or drained mid-request; the CALLER decides what that
+        means (the router replays, a bare client raises)."""
+        if self.result is not None:
+            yield "event", self.result
+            return
+        while True:
+            kind, event = self._read_frame()
+            if kind == "eof":
+                return
+            yield kind, event
+            if kind == "event" and event.get("done"):
+                self.result = event
+                return
 
     def __iter__(self) -> Iterator[List[int]]:
         if self.result is not None:
@@ -115,16 +178,62 @@ class GatewayClient:
 
     Every call opens its own connection (the gateway closes one-shot
     responses anyway — util/httpjson ``Connection: close``), so one
-    client instance is safe to share across threads."""
+    client instance is safe to share across threads.
 
-    def __init__(self, address: str, timeout_s: float = 60.0):
+    ``timeout_s`` is the legacy single knob (connect AND read);
+    ``connect_timeout_s``/``read_timeout_s`` override it separately.
+    ``retries > 0`` retries the idempotent GET endpoints on
+    connection-level failures with jittered exponential backoff
+    (``backoff_s * 2^attempt``, capped at ``backoff_cap_s``, each
+    sleep scaled by a uniform [0.5, 1.0) jitter so a fleet of callers
+    does not reconverge on the dead peer in lockstep)."""
+
+    def __init__(self, address: str, timeout_s: float = 60.0,
+                 connect_timeout_s: Optional[float] = None,
+                 read_timeout_s: Optional[float] = None,
+                 retries: int = 0, backoff_s: float = 0.05,
+                 backoff_cap_s: float = 2.0):
         self.host, self.port = _split(address)
         self.timeout_s = timeout_s
+        self.connect_timeout_s = (timeout_s if connect_timeout_s is None
+                                  else float(connect_timeout_s))
+        self.read_timeout_s = (timeout_s if read_timeout_s is None
+                               else float(read_timeout_s))
+        if retries < 0:
+            raise ValueError(f"retries {retries} < 0")
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._rng = random.Random()
 
     # -- plumbing ------------------------------------------------------
     def _connect(self) -> http.client.HTTPConnection:
-        return http.client.HTTPConnection(
-            self.host, self.port, timeout=self.timeout_s)
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.connect_timeout_s)
+        conn.connect()
+        # once connected, every blocking read (status line, body,
+        # stream deltas) is bounded by the READ timeout instead
+        conn.sock.settimeout(self.read_timeout_s)
+        return conn
+
+    def _with_retry(self, fn):
+        """Run ``fn`` (an IDEMPOTENT call), retrying connection-level
+        failures up to ``self.retries`` times with jittered backoff.
+        GatewayError (a real HTTP reply) is never retried here — the
+        peer is alive and said no."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except GatewayError:
+                raise
+            except RETRYABLE_ERRORS:
+                if attempt >= self.retries:
+                    raise
+                delay = min(self.backoff_s * (2 ** attempt),
+                            self.backoff_cap_s)
+                time.sleep(delay * (0.5 + self._rng.random() / 2))
+                attempt += 1
 
     def _call(self, method: str, path: str,
               body: Optional[Dict[str, Any]] = None,
@@ -155,7 +264,10 @@ class GatewayClient:
         any 2xx; raises :class:`GatewayError` carrying the mapped
         failure status (429 shed, 504 deadline, 500 fault) — partial
         tokens, when the engine produced any, ride
-        ``err.payload["tokens"]``."""
+        ``err.payload["tokens"]``. NEVER retried on connection
+        failure: resubmitting a generate is a replay decision the
+        caller must make (see serving/router.py for the journaled
+        version)."""
         body = dict(prompt=list(prompt),
                     max_new_tokens=int(max_new_tokens), **kwargs)
         return self._call("POST", "/v1/generate", body)
@@ -187,17 +299,18 @@ class GatewayClient:
 
     def poll(self, request_id: int) -> Dict[str, Any]:
         """Result by id: terminal dict (done), ``{"running": true}``
-        while in flight, raises 404 for unknown ids."""
-        return self._call("GET", f"/v1/requests/{request_id}",
-                          ok=(200, 202))
+        while in flight, raises 404 for unknown ids. Idempotent —
+        retried per the client's retry policy."""
+        return self._with_retry(lambda: self._call(
+            "GET", f"/v1/requests/{request_id}", ok=(200, 202)))
 
     def trace(self, request_id: int) -> Dict[str, Any]:
         """Flight-recorder trace for one terminal request (ISSUE 7):
         ``{"id", "finish_reason", "timing": {...phase breakdown...},
         "attempts": [{"events": [...]}, ...]}``; ``{"running": true}``
         while in flight; raises 404 once evicted/unknown."""
-        return self._call("GET", f"/v1/requests/{request_id}/trace",
-                          ok=(200, 202))
+        return self._with_retry(lambda: self._call(
+            "GET", f"/v1/requests/{request_id}/trace", ok=(200, 202)))
 
     def trace_events(self) -> Dict[str, Any]:
         """``GET /v1/trace`` — the server tracer's current event
@@ -207,19 +320,23 @@ class GatewayClient:
         return self._call("GET", "/v1/trace")
 
     def healthz(self) -> Dict[str, Any]:
-        return self._call("GET", "/v1/healthz")
+        return self._with_retry(
+            lambda: self._call("GET", "/v1/healthz"))
 
     def metrics(self) -> str:
-        conn = self._connect()
-        try:
-            conn.request("GET", "/v1/metrics")
-            resp = conn.getresponse()
-            body = resp.read().decode()
-            if resp.status != 200:
-                raise GatewayError(resp.status, {"body": body})
-            return body
-        finally:
-            conn.close()
+        def once() -> str:
+            conn = self._connect()
+            try:
+                conn.request("GET", "/v1/metrics")
+                resp = conn.getresponse()
+                body = resp.read().decode()
+                if resp.status != 200:
+                    raise GatewayError(resp.status, {"body": body})
+                return body
+            finally:
+                conn.close()
+
+        return self._with_retry(once)
 
     def drain(self, timeout_s: Optional[float] = None
               ) -> Dict[str, Any]:

@@ -182,15 +182,8 @@ class _GatewayHandler(JsonHandler):
             self.send_json({"error": f"no such endpoint {path}"}, 404,
                            close=True)
 
-    # -- SSE framing ---------------------------------------------------
-    def send_event(self, obj: Dict[str, Any]) -> None:
-        self.send_chunk(b"data: " + json.dumps(obj).encode() + b"\n\n")
-
-    def send_ping(self) -> None:
-        # SSE comment line: ignored by clients, but the write probes
-        # whether the peer is still there (a vanished client surfaces
-        # as a send error, which cancels the request)
-        self.send_chunk(b": ping\n\n")
+    # SSE framing (send_event / send_ping) is inherited from
+    # JsonHandler — one wire-format definition shared with the router
 
 
 class ServingGateway:
@@ -232,7 +225,8 @@ class ServingGateway:
                  request_timeout_s: Optional[float] = None,
                  handler_timeout_s: float = 30.0,
                  admission_grace_s: float = 0.0,
-                 results_cap: int = 4096):
+                 results_cap: int = 4096,
+                 replica_id: Optional[str] = None):
         if engine.on_delta is not None:
             raise ValueError(
                 "engine already has an on_delta consumer; the gateway "
@@ -293,6 +287,14 @@ class ServingGateway:
         self._service = HttpService(_GatewayHandler, host, port,
                                     gateway=self,
                                     timeout=float(handler_timeout_s))
+        #: stable identity a router tier keys replica state by
+        #: (ISSUE 9): defaults to the bound host:port — unique per
+        #: live process on one machine, and survives the gateway
+        #: restarting on the same address (so affinity hashing stays
+        #: put across a replica bounce)
+        self.replica_id = (replica_id if replica_id is not None
+                           else f"{self._service.host}:"
+                                f"{self._service.port}")
         # claim the engine's delta hook only AFTER the bind succeeded:
         # a port-in-use OSError above must not leave the engine
         # permanently marked as owned by a gateway that never existed
@@ -334,6 +336,30 @@ class ServingGateway:
         # release the engine: it can be wrapped by a fresh gateway
         # (or driven in-process again) after this one is gone
         self.engine.on_delta = None
+
+    def hard_kill(self) -> None:
+        """Chaos helper (ISSUE 9): die like a SIGKILL from the
+        network's perspective — stop stepping immediately (in-flight
+        requests freeze mid-decode), close the listening socket so
+        new connections are refused, and end every open stream
+        WITHOUT a terminal event. No drain, no snapshot, no engine
+        release: the wreck stays exactly as the crash left it, the
+        way a killed process's state would. The tier-1 router soak
+        uses this to rehearse replica death without paying a
+        subprocess; the full soak (scripts/router_soak.py) sends a
+        real SIGKILL.
+
+        Acquires the lock through ``_engine_access`` (the
+        waiter-counted path) on purpose: a busy stepper re-grabs the
+        unfair lock every round, and a plain ``with self._wake:``
+        here would not run until the engine ran OUT of work — the
+        opposite of a kill."""
+        with self._engine_access():
+            self._stopped = True
+            self._wake.notify_all()
+        if self._stepper.is_alive():
+            self._stepper.join(timeout=10.0)
+        self._service.hard_stop()
 
     @classmethod
     def boot(cls, engine_factory, snapshot_path: Optional[str] = None,
@@ -732,38 +758,60 @@ class ServingGateway:
             return None
 
     def _health(self) -> Dict[str, Any]:
-        with self._engine_access():
-            eng = self.engine
-            return {
-                "ok": not self._stopped,
-                "draining": self._draining,
-                "round": eng._round,
-                "queued": eng.scheduler.pending,
-                "active_slots": sum(s is not None for s in eng._slots),
-                "n_slots": eng.n_slots,
-                "requests_finished": eng.stats["requests_finished"],
-            }
+        # deliberately LOCK-FREE (ISSUE 9): a liveness probe answered
+        # under the engine lock stalls for the whole current step —
+        # which can be SECONDS while an executable compiles — and a
+        # router's short-timeout scrape then reads a busy-but-healthy
+        # replica as dead. Every field here is a GIL-atomic read
+        # (ints, len, fixed-size list scan); slight staleness is the
+        # correct trade for a probe that always answers instantly.
+        eng = self.engine
+        # one-word lifecycle state (ISSUE 9 satellite): before this,
+        # a DRAINING gateway looked healthy to a naive probe (``ok``
+        # stayed true) until a request bounced with 503 — a router
+        # must see the transition in the payload itself, together
+        # with the live load figures its least-loaded fallback weighs
+        state = ("stopped" if self._stopped
+                 else "draining" if self._draining else "live")
+        return {
+            "ok": not self._stopped,
+            "state": state,
+            "replica_id": self.replica_id,
+            "draining": self._draining,
+            "round": eng._round,
+            "queued": eng.scheduler.pending,
+            "active_slots": sum(s is not None for s in eng._slots),
+            "n_slots": eng.n_slots,
+            "requests_finished": eng.stats["requests_finished"],
+            # prompt tokens served from the prefix cache instead of
+            # prefilled: the router's affinity gate reads this per
+            # replica to prove warm traffic landed warm
+            "prefix_tokens_reused":
+                eng.stats["prefill_tokens_skipped"],
+        }
 
     def _metrics_text(self) -> str:
-        with self._engine_access():
-            # refresh gateway gauges right before export so the text
-            # reflects this instant, not the last decode round — via
-            # ``Tracer.gauge`` (last-value table only), NOT
-            # ``counter``: a scrape must never append to the capped
-            # event log, or a tight scrape loop evicts real span
-            # history (ISSUE 7 satellite; regression-tested).
-            # Duck-typed tracers without gauge() fall back to
-            # counter() — the pre-ISSUE-7 behavior.
-            tracer = self.engine.tracer
-            gauge = getattr(tracer, "gauge", tracer.counter)
-            gauge("serving_gateway_queue_depth",
-                  self.engine.scheduler.pending)
-            gauge("serving_gateway_active_slots",
-                  sum(s is not None for s in self.engine._slots))
-            gauge("serving_gateway_round_time_s", self._round_s)
-            for key, value in self.stats.items():
-                gauge(f"serving_gateway_{key}", value)
-            return tracer.prometheus_text()
+        # refresh gateway gauges right before export so the text
+        # reflects this instant, not the last decode round — via
+        # ``Tracer.gauge`` (last-value table only), NOT ``counter``:
+        # a scrape must never append to the capped event log, or a
+        # tight scrape loop evicts real span history (ISSUE 7
+        # satellite; regression-tested). Duck-typed tracers without
+        # gauge() fall back to counter() — the pre-ISSUE-7 behavior.
+        # Like ``_health`` this runs WITHOUT the engine lock
+        # (ISSUE 9): every read is GIL-atomic and the tracer carries
+        # its own lock, so a scrape answers promptly even while the
+        # stepper is deep in a long compile.
+        tracer = self.engine.tracer
+        gauge = getattr(tracer, "gauge", tracer.counter)
+        gauge("serving_gateway_queue_depth",
+              self.engine.scheduler.pending)
+        gauge("serving_gateway_active_slots",
+              sum(s is not None for s in self.engine._slots))
+        gauge("serving_gateway_round_time_s", self._round_s)
+        for key, value in self.stats.items():
+            gauge(f"serving_gateway_{key}", value)
+        return tracer.prometheus_text()
 
     # -- drain / snapshot ----------------------------------------------
     def drain(self, timeout_s: Optional[float] = None
@@ -790,11 +838,18 @@ class ServingGateway:
             time.sleep(0.005)
         with self._engine_access():
             self._paused = True
-            carried = (self.engine.scheduler.pending
-                       + len(self.engine._pending)
-                       + len(self.engine._requeue)
-                       + sum(s is not None
-                             for s in self.engine._slots))
+            eng = self.engine
+            # the drain HANDOFF surface (ISSUE 9): which request ids
+            # ride the snapshot instead of finishing here — a router
+            # scaling this replica down replays exactly these onto a
+            # survivor (and cross-checks its journal against the list)
+            carried_ids = sorted(
+                [r.id for r in eng.scheduler.queued_requests()]
+                + [p.request.id for p in eng._pending]
+                + [q.id for _, q in eng._requeue]
+                + [s.request.id for s in eng._slots
+                   if s is not None])
+            carried = len(carried_ids)
             snap_path = None
             if self.snapshot_path is not None:
                 snap = self.engine.snapshot()
@@ -814,6 +869,7 @@ class ServingGateway:
         if self.engine.tracer is not None:
             self.engine.tracer.incr("serving_gateway_drained")
         return {"drained": carried == 0, "carried": carried,
+                "carried_ids": carried_ids,
                 "snapshot": snap_path,
                 "finished": self.engine.stats["requests_finished"]}
 

@@ -22,6 +22,7 @@ clients don't hold keep-alive sockets the service will never reuse.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
@@ -113,6 +114,38 @@ class JsonHandler(BaseHTTPRequestHandler):
             self.wfile.write(b"0\r\n\r\n")
         self.wfile.flush()
 
+    # -- SSE framing (one definition for every streaming service:
+    # the gateway and the router must never drift on the wire format)
+    def send_event(self, obj: Dict[str, Any]) -> None:
+        self.send_chunk(b"data: " + json.dumps(obj).encode()
+                        + b"\n\n")
+
+    def send_ping(self) -> None:
+        # SSE comment line: ignored by clients, but the write probes
+        # whether the peer is still there (a vanished client surfaces
+        # as a send error)
+        self.send_chunk(b": ping\n\n")
+
+
+class _QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that treats a vanished peer as routine.
+
+    The stock ``handle_error`` dumps a full traceback to stderr for
+    EVERY connection-level failure — but a client that disconnects
+    mid-response (health scraper timing out, streaming consumer
+    closing early, a killed process's half-open socket) is normal
+    operation for a long-lived service, not an error worth a dump.
+    Handler-code bugs still print."""
+
+    def handle_error(self, request, client_address):  # noqa: N802
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                            ConnectionAbortedError, socket.timeout)):
+            return
+        super().handle_error(request, client_address)
+
 
 class HttpService:
     """Threaded HTTP server lifecycle: build, start, address, stop.
@@ -125,7 +158,7 @@ class HttpService:
     def __init__(self, handler_cls, host: str = "127.0.0.1", port: int = 0,
                  **handler_attrs: Any):
         handler = type(handler_cls.__name__, (handler_cls,), handler_attrs)
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = _QuietThreadingHTTPServer((host, port), handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
@@ -135,12 +168,32 @@ class HttpService:
 
     def start(self):
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"http-{self.port}")
         self._thread.start()
         return self
 
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+    def hard_stop(self) -> None:
+        """Chaos helper (ISSUE 9): die the way a SIGKILL'd process
+        looks from the network — close the LISTENING socket first so
+        new connections are refused immediately, then stop the serve
+        loop without any graceful notice to in-flight handlers (their
+        next socket write hits a dead/raw fd and raises, exactly like
+        writing into a killed process's half of a connection). Used by
+        the router chaos soak to simulate replica death in-process;
+        production shutdown is :meth:`stop` (or the gateway's
+        drain-then-close)."""
+        try:
+            self._httpd.socket.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # already closed / never connected
+        self._httpd.server_close()
+        self._httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=5.0)

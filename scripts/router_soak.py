@@ -1,0 +1,501 @@
+"""Multi-replica chaos soak for the serving router (ISSUE 9).
+
+Seeded churn of streaming clients against N gateway replicas behind a
+:class:`~deeplearning4j_tpu.serving.ServingRouter`, with the two
+failure modes a horizontal fleet must survive injected mid-run:
+
+- a HARD replica kill — ``SIGKILL``, no drain, no goodbye — while at
+  least ``min_inflight_at_kill`` streams are in flight on the victim
+  (the acceptance chaos gate); and
+- one GRACEFUL drain (``/v1/drain`` through the router), whose
+  unfinished requests must be handed off to survivors.
+
+Pass criteria:
+
+- **zero lost requests**: every submitted request reaches a terminal
+  result, and the router's journal shows nothing open and nothing
+  lost;
+- **bit-identical greedy completion**: every COMPLETED greedy stream's
+  concat(pre-kill deltas, post-replay deltas) equals the same request
+  on a fault-free single-engine reference, bit for bit (the replay
+  dedup can neither skip nor repeat a token);
+- **no double delivery**: each client's streamed concat equals its
+  terminal ``tokens`` exactly;
+- **the PR 3/5 sampling contract**: a sampling stream whose replica
+  died after streaming terminates ``fault`` — never a silently
+  redrawn continuation;
+- **zero leaked threads/sockets**: after the router and clients are
+  gone the process is back to its baseline thread count and (full
+  mode) its baseline fd count.
+
+Two modes:
+
+- ``--fast`` (tier-1, tests/test_router_soak.py): 2 IN-PROCESS
+  replicas, the kill simulated with ``ServingGateway.hard_kill`` —
+  from the router's network stance the same event as process death
+  (connection refused, streams end without terminal) at a fraction of
+  the wall cost (~5 s).
+- full (default; ``slow`` in the registered tests): 3 SUBPROCESS
+  replicas — real processes, real sockets, a real ``SIGKILL`` — plus
+  the graceful drain. Each child is this same script in ``--replica``
+  mode, building the identical net from the shared seed.
+
+Run standalone: ``python scripts/router_soak.py [--fast]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+VOCAB = 12
+NET_SEED = 11  # non-constant greedy streams: replay checking bites
+ENGINE = dict(n_slots=3, decode_chunk=2, prefix_cache_rows=4, seed=0)
+
+
+def _build_net(vocab: int = VOCAB, seed: int = NET_SEED,
+               stream_max_t: int = 96):
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(transformer_lm(
+        n_in=vocab, width=32, n_layers=2, n_heads=4,
+        n_classes=vocab, seed=seed)).init()
+    for c in net.conf.confs:
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = stream_max_t
+    return net
+
+
+def _throttle(engine, delay_s: float) -> None:
+    """Slow each engine round so chaos events land MID-stream: a toy
+    CPU engine otherwise finishes whole requests faster than the
+    controller can aim."""
+    orig = engine.step
+
+    def slow(sink=None):
+        time.sleep(delay_s)
+        return orig(sink)
+
+    engine.step = slow
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _workload(rng, n_clients: int):
+    """Seeded prompts: two shared-prefix cohorts (affinity traffic
+    that must land warm and survive its warm replica dying) plus
+    random singles; ~1 in 6 samples instead of greedy."""
+    cohorts = [rng.integers(0, VOCAB, 8).tolist(),
+               rng.integers(0, VOCAB, 8).tolist()]
+    cases = []
+    for i in range(n_clients):
+        if i % 3 < 2:
+            prompt = (cohorts[i % 2]
+                      + rng.integers(0, VOCAB,
+                                     int(rng.integers(1, 4))).tolist())
+        else:
+            prompt = rng.integers(
+                0, VOCAB, int(rng.integers(2, 10))).tolist()
+        n_tokens = int(rng.integers(16, 40))
+        temperature = 0.7 if i % 6 == 5 else 0.0
+        cases.append((prompt, n_tokens, temperature))
+    return cases
+
+
+# ---------------------------------------------------------------------------
+# --replica child mode: one gateway process, killed from outside
+# ---------------------------------------------------------------------------
+
+def run_replica(args) -> int:
+    from deeplearning4j_tpu.serving import DecodeEngine, ServingGateway
+
+    engine = DecodeEngine(_build_net(), **ENGINE)
+    if args.throttle > 0:
+        _throttle(engine, args.throttle)
+    gw = ServingGateway(engine, port=args.port,
+                        replica_id=args.replica_id,
+                        keepalive_s=0.1).start()
+    print(f"READY {gw.address}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(0.2)
+    finally:
+        with contextlib.suppress(Exception):
+            gw.close()
+    return 0
+
+
+class _ProcReplica:
+    """A subprocess replica and the handle to kill it with."""
+
+    def __init__(self, idx: int, throttle: float):
+        self.replica_id = f"rep-{idx}"
+        self.port = _free_port()
+        self.address = f"127.0.0.1:{self.port}"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--replica",
+             "--port", str(self.port), "--replica-id",
+             self.replica_id, "--throttle", str(throttle)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+
+    def wait_ready(self, timeout_s: float = 120.0) -> None:
+        # readline() blocks with no deadline of its own, so a wedged
+        # child (stuck in XLA init, never printing READY and never
+        # exiting) would hang the soak forever — read on a reaper
+        # thread and enforce the deadline with join()
+        result: Dict[str, str] = {}
+
+        def read():
+            while True:
+                line = self.proc.stdout.readline().decode()
+                if not line or line.startswith("READY"):
+                    result["line"] = line
+                    return
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(timeout=timeout_s)
+        if result.get("line", "").startswith("READY"):
+            return
+        raise RuntimeError(
+            f"replica {self.replica_id} never became ready within "
+            f"{timeout_s}s (last output {result.get('line')!r})")
+
+    def sigkill(self) -> None:
+        self.proc.kill()  # SIGKILL: no drain, no cleanup, no goodbye
+        self.proc.wait(timeout=30)
+
+    def shutdown(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+        self.proc.stdout.close()
+
+
+class _LocalReplica:
+    """In-process replica (fast mode): a gateway whose ``hard_kill``
+    is the SIGKILL stand-in."""
+
+    def __init__(self, idx: int, net, throttle: float):
+        from deeplearning4j_tpu.serving import (
+            DecodeEngine,
+            ServingGateway,
+        )
+
+        engine = DecodeEngine(net, **ENGINE)
+        if throttle > 0:
+            _throttle(engine, throttle)
+        self.replica_id = f"rep-{idx}"
+        self.gw = ServingGateway(engine, replica_id=self.replica_id,
+                                 keepalive_s=0.1).start()
+        self.address = (f"{self.gw._service.host}:"
+                        f"{self.gw._service.port}")
+
+    def sigkill(self) -> None:
+        self.gw.hard_kill()
+
+    def shutdown(self) -> None:
+        with contextlib.suppress(Exception):
+            self.gw.close()
+
+
+# ---------------------------------------------------------------------------
+# the soak proper
+# ---------------------------------------------------------------------------
+
+def run_soak(n_clients: int = 24, n_replicas: int = 3, seed: int = 0,
+             in_process: bool = False, throttle: float = 0.04,
+             min_inflight_at_kill: int = 4,
+             verbose: bool = False) -> Dict[str, Any]:
+    """One seeded soak; returns a summary dict, raises AssertionError
+    on any gate violation."""
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        Request,
+        RouterClient,
+        ServingRouter,
+    )
+
+    rng = np.random.default_rng(seed)
+    cases = _workload(rng, n_clients)
+
+    # fault-free single-engine reference: what every completed greedy
+    # stream must match bit for bit
+    net = _build_net()
+    ref_eng = DecodeEngine(net, **ENGINE)
+    greedy_idx = [i for i, (_, _, t) in enumerate(cases) if t == 0]
+    ref_ids = {i: ref_eng.submit(Request(list(cases[i][0]),
+                                         cases[i][1]))
+               for i in greedy_idx}
+    ref_res = ref_eng.run()
+    ref_tokens = {i: ref_res[rid].tokens
+                  for i, rid in ref_ids.items()}
+
+    baseline_threads = threading.active_count()
+    baseline_fds = (len(os.listdir("/proc/self/fd"))
+                    if os.path.isdir("/proc/self/fd") else None)
+
+    if in_process:
+        replicas: List[Any] = [_LocalReplica(i, net, throttle)
+                               for i in range(n_replicas)]
+    else:
+        replicas = [_ProcReplica(i, throttle)
+                    for i in range(n_replicas)]
+        for r in replicas:
+            r.wait_ready()
+
+    router = ServingRouter(
+        [r.address for r in replicas], affinity_block_tokens=4,
+        health_interval_s=0.1, probe_interval_s=0.5,
+        failure_threshold=2).start()
+    client = RouterClient(router.address, timeout_s=240.0)
+    t0 = time.perf_counter()
+
+    outcomes: Dict[int, Dict[str, Any]] = {}
+    rid_of: Dict[int, int] = {}
+
+    def one_client(i: int) -> None:
+        prompt, n_tokens, temperature = cases[i]
+        out: Dict[str, Any] = {"tokens": [],
+                               "temperature": temperature}
+        outcomes[i] = out
+        try:
+            kwargs = ({"temperature": temperature}
+                      if temperature else {})
+            s = client.stream(prompt, n_tokens, **kwargs)
+            rid_of[i] = s.id
+            for delta in s:
+                out["tokens"].extend(delta)
+            out["result"] = (s.result or {}).get("finish_reason")
+            out["final"] = s.result
+        except Exception as e:  # no client thread may die silently
+            out["result"] = f"crash:{type(e).__name__}:{e}"
+
+    threads = [threading.Thread(target=one_client, args=(i,),
+                                name=f"router-soak-{i}")
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+
+    # -- chaos controller: SIGKILL with >= min_inflight streams -------
+    chaos: Dict[str, Any] = {"killed": None, "inflight_at_kill": 0,
+                             "drained": None}
+
+    def open_by_replica() -> Dict[str, int]:
+        with router._lock:
+            counts: Dict[str, int] = {}
+            for e in router._journal.values():
+                if not e.done.is_set() and e.replica_address:
+                    counts[e.replica_address] = counts.get(
+                        e.replica_address, 0) + 1
+        return counts
+
+    kill_deadline = time.monotonic() + 120
+    victim = None
+    while time.monotonic() < kill_deadline:
+        counts = open_by_replica()
+        ready = [(n, a) for a, n in counts.items()
+                 if n >= min_inflight_at_kill]
+        if ready:
+            addr = max(ready)[1]
+            victim = next(r for r in replicas if r.address == addr)
+            chaos["inflight_at_kill"] = max(ready)[0]
+            break
+        if all(not t.is_alive() for t in threads):
+            break  # workload finished before chaos could land
+        time.sleep(0.005)
+    assert victim is not None, (
+        f"never reached {min_inflight_at_kill} concurrent streams "
+        f"on one replica (peak {open_by_replica()}) — grow the "
+        "workload or the throttle")
+    victim.sigkill()
+    chaos["killed"] = victim.replica_id
+
+    # -- graceful drain of a second replica (full mode: 3 survivors
+    # of the kill leave 2; drain takes it to 1) ----------------------
+    if n_replicas >= 3:
+        time.sleep(0.3)
+        candidates = [r for r in replicas if r is not victim]
+        counts = open_by_replica()
+        target = max(candidates,
+                     key=lambda r: counts.get(r.address, 0))
+        chaos["drained"] = target.replica_id
+        summary = client.drain_replica(target.replica_id,
+                                       timeout_s=0.2)
+        chaos["drain_summary"] = {
+            "carried": summary["drain"].get("carried"),
+            "handed_off": summary["open_requests_handed_off"]}
+
+    for t in threads:
+        t.join(timeout=240)
+    assert not any(t.is_alive() for t in threads), "client hang"
+    wall_s = time.perf_counter() - t0
+
+    # -- gates ---------------------------------------------------------
+    crashes = [o for o in outcomes.values()
+               if str(o["result"]).startswith("crash")]
+    assert not crashes, f"client crashes: {crashes[:3]}"
+
+    # zero lost requests: every client has a terminal, the journal
+    # has nothing open and nothing lost
+    assert len(rid_of) == n_clients
+    audit = router.journal_audit()
+    assert audit["open"] == [], f"journal still open: {audit['open']}"
+    assert audit["lost"] == [], f"journal lost: {audit['lost']}"
+    assert audit["replayed"], "chaos soak saw zero replays"
+
+    completed = parity_ok = faulted = replayed_ok = 0
+    for i, out in outcomes.items():
+        res = out["result"]
+        final = out.get("final") or {}
+        # no double delivery: the streamed concat IS the terminal
+        if final.get("tokens") is not None:
+            assert out["tokens"] == final["tokens"], (
+                f"client {i}: streamed {len(out['tokens'])} tokens "
+                f"!= terminal {len(final['tokens'])}")
+        if res in ("length", "eos"):
+            completed += 1
+            if final.get("replays"):
+                replayed_ok += 1
+            if out["temperature"] == 0:
+                assert out["tokens"] == ref_tokens[i], (
+                    f"client {i} diverged from the fault-free "
+                    f"reference after "
+                    f"{final.get('replays')} replays")
+                parity_ok += 1
+        elif res == "fault":
+            faulted += 1
+            # the PR 3/5 contract: only sampling streams (or replay
+            # budget blowouts, absent here) may fault
+            assert out["temperature"] > 0, (
+                f"greedy client {i} faulted: {final}")
+        else:
+            raise AssertionError(
+                f"client {i} unexpected terminal {res!r}")
+    assert completed >= n_clients // 2, (
+        f"only {completed}/{n_clients} completed")
+    assert replayed_ok >= 1, (
+        "no COMPLETED stream ever survived a replay — the chaos "
+        "never actually exercised failover")
+
+    router.close()
+    for r in replicas:
+        r.shutdown()
+
+    # zero leaked threads
+    deadline = time.monotonic() + 30
+    while (threading.active_count() > baseline_threads
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    leaked = threading.active_count() - baseline_threads
+    assert leaked <= 0, (
+        f"{leaked} leaked threads: "
+        f"{[t.name for t in threading.enumerate()]}")
+
+    # zero leaked sockets (fd count back to baseline; small slack for
+    # interpreter-internal churn, with a settle loop for TIME_WAIT)
+    leaked_fds = 0
+    if baseline_fds is not None:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            leaked_fds = (len(os.listdir("/proc/self/fd"))
+                          - baseline_fds)
+            if leaked_fds <= 2:
+                break
+            time.sleep(0.2)
+        assert leaked_fds <= 2, f"{leaked_fds} leaked fds"
+
+    summary = {
+        "n_clients": n_clients,
+        "n_replicas": n_replicas,
+        "mode": "in-process" if in_process else "subprocess",
+        "seed": seed,
+        "wall_s": round(wall_s, 2),
+        "completed": completed,
+        "greedy_parity_ok": parity_ok,
+        "faulted_sampling": faulted,
+        "replayed_requests": len(audit["replayed"]),
+        "completed_after_replay": replayed_ok,
+        "killed": chaos["killed"],
+        "inflight_at_kill": chaos["inflight_at_kill"],
+        "drained": chaos["drained"],
+        "router_stats": dict(router.stats),
+        "leaked_threads": max(leaked, 0),
+        "leaked_fds": max(leaked_fds, 0),
+    }
+    if verbose:
+        for k, v in summary.items():
+            print(f"  {k}: {v}")
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1-sized in-process variant")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--clients", type=int, default=None)
+    # --replica child mode (internal)
+    ap.add_argument("--replica", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--port", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--replica-id", default="rep",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--throttle", type=float, default=0.04,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.replica:
+        return run_replica(args)
+    if args.fast:
+        summary = run_soak(
+            n_clients=args.clients or 14, n_replicas=2,
+            seed=args.seed, in_process=True, verbose=True)
+    else:
+        summary = run_soak(
+            n_clients=args.clients or 24, n_replicas=3,
+            seed=args.seed, in_process=False, verbose=True)
+    print(f"router soak PASSED: {summary['completed']} completed "
+          f"(greedy parity {summary['greedy_parity_ok']}), "
+          f"{summary['replayed_requests']} replayed "
+          f"({summary['completed_after_replay']} finished "
+          f"after replay), {summary['faulted_sampling']} sampling "
+          f"faults, killed {summary['killed']} with "
+          f"{summary['inflight_at_kill']} in flight, "
+          f"drained {summary['drained']}, "
+          f"in {summary['wall_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,6 +29,7 @@ EXAMPLES = [
     ("pipeline_4d_training.py", []),
     ("sequence_parallel_transformer.py", []),
     ("serving_gateway.py", []),
+    ("serving_router.py", []),
     ("streaming_decode.py", []),
     ("word2vec_similarity.py", []),
 ]

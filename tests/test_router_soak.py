@@ -1,0 +1,39 @@
+"""Registered router chaos soak (ISSUE 9 acceptance).
+
+Fast variant (tier-1, ~6 s): 2 in-process replicas, hard replica kill
+via ``ServingGateway.hard_kill`` (the network-identical SIGKILL
+stand-in) while ≥4 streams are in flight on the victim; gates zero
+lost requests, bit-identical greedy completion vs the fault-free
+single-engine reference, journal clean, zero leaked threads/sockets.
+
+Full variant (``slow``): 3 SUBPROCESS replicas, a real ``SIGKILL``,
+plus one graceful ``/v1/drain`` hand-off mid-run — the acceptance
+chaos gate end to end across real process boundaries.
+"""
+
+import pytest
+
+from scripts.router_soak import run_soak
+
+
+def test_router_soak_fast():
+    summary = run_soak(n_clients=14, n_replicas=2, seed=0,
+                       in_process=True, min_inflight_at_kill=4)
+    assert summary["completed"] >= 7
+    assert summary["greedy_parity_ok"] >= 1
+    assert summary["inflight_at_kill"] >= 4
+    assert summary["replayed_requests"] >= 1
+    assert summary["completed_after_replay"] >= 1
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0
+
+
+@pytest.mark.slow
+def test_router_soak_full_subprocess():
+    summary = run_soak(n_clients=24, n_replicas=3, seed=0,
+                       in_process=False, min_inflight_at_kill=4)
+    assert summary["inflight_at_kill"] >= 4
+    assert summary["drained"] is not None
+    assert summary["replayed_requests"] >= 1
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0

@@ -1,0 +1,679 @@
+"""Multi-replica serving router (ISSUE 9 tentpole).
+
+The contract under test: the router is a TRANSPARENT failure-domain —
+a one-replica router is bit-identical to direct gateway access; a
+replica dying mid-stream is invisible to greedy clients (the journal
+replays onto a survivor and the high-water dedup resumes the stream
+bit-identically past what was already delivered); sampling requests
+that streamed terminate ``fault`` per the PR 3/5 contract; 429
+backpressure routes to a sibling instead of making the client wait;
+and shared-prefix traffic rendezvous-hashes onto the replica holding
+its warm cache."""
+
+import contextlib
+import socket
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    GatewayClient,
+    GatewayError,
+    Request,
+    RouterClient,
+    ServingGateway,
+    ServingRouter,
+)
+from deeplearning4j_tpu.serving.router import parse_prometheus
+
+V = 12
+#: seed 11 produces non-constant greedy streams (e.g. 5..2..8 phase
+#: changes) for these prompts — replay-overlap checking is only
+#: load-bearing when the tokens actually vary
+NET_SEED = 11
+
+
+def _net(seed=NET_SEED, stream_max_t=96):
+    net = MultiLayerNetwork(transformer_lm(
+        n_in=V, width=32, n_layers=2, n_heads=4, n_classes=V,
+        seed=seed)).init()
+    for c in net.conf.confs:
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = stream_max_t
+    return net
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _net()
+
+
+def _throttle(engine: DecodeEngine, delay_s: float) -> None:
+    """Slow every engine round by ``delay_s`` so kills/drains land
+    deterministically MID-stream (a bare toy engine finishes whole
+    requests faster than a client can react)."""
+    orig = engine.step
+
+    def slow(sink=None):
+        time.sleep(delay_s)
+        return orig(sink)
+
+    engine.step = slow
+
+
+def _wait_for(cond, timeout=20.0, interval=0.01, msg="condition"):
+    t0 = time.monotonic()
+    while not cond():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(interval)
+
+
+def _reference(net, prompts, lens, **engine_kwargs):
+    eng = DecodeEngine(net, **engine_kwargs)
+    ids = [eng.submit(Request(list(p), n))
+           for p, n in zip(prompts, lens)]
+    res = eng.run()
+    return [res[rid].tokens for rid in ids]
+
+
+@contextlib.contextmanager
+def _cluster(net, n_replicas, throttle_s=0.0, router_kwargs=None,
+             **engine_kwargs):
+    """N gateway replicas over the same net + a router in front.
+    Yields ``(router, client, gateways)``."""
+    engine_kwargs.setdefault("n_slots", 2)
+    engine_kwargs.setdefault("decode_chunk", 2)
+    engine_kwargs.setdefault("seed", 0)
+    engines = [DecodeEngine(net, **engine_kwargs)
+               for _ in range(n_replicas)]
+    if throttle_s:
+        for e in engines:
+            _throttle(e, throttle_s)
+    gateways = [ServingGateway(e, keepalive_s=0.1,
+                               replica_id=f"rep-{i}").start()
+                for i, e in enumerate(engines)]
+    kw = dict(health_interval_s=0.1, probe_interval_s=0.4,
+              affinity_block_tokens=4, failure_threshold=2)
+    kw.update(router_kwargs or {})
+    router = ServingRouter([g.address for g in gateways],
+                           **kw).start()
+    client = RouterClient(router.address, timeout_s=120.0)
+    try:
+        yield router, client, gateways
+    finally:
+        router.close()
+        for g in gateways:
+            with contextlib.suppress(Exception):
+                g.close()
+
+
+def _owner_of(router, gateways, rid):
+    """The gateway currently serving the journal entry."""
+    addr = router._journal[rid].replica_address
+    return next(g for g in gateways
+                if addr == f"{g._service.host}:{g._service.port}")
+
+
+PROMPT = [1, 4, 7, 2]
+
+
+class TestSingleReplicaParity:
+    """Acceptance gate: router on/off parity — one replica behind the
+    router is bit-identical to direct gateway access (ids, finish
+    reasons, status mapping), with compile counts unchanged."""
+
+    def test_blocking_and_streaming_bit_identical(self, net):
+        prompts = [PROMPT, [9, 3, 3, 5], [5, 2, 8, 1, 6, 0, 4]]
+        lens = [6, 9, 5]
+        ref = _reference(net, prompts, lens, n_slots=2,
+                         decode_chunk=2, seed=0)
+
+        # direct gateway: the id sequence + counts to match
+        direct_eng = DecodeEngine(net, n_slots=2, decode_chunk=2,
+                                  seed=0)
+        with ServingGateway(direct_eng) as gw:
+            direct = GatewayClient(gw.address)
+            direct_out = [direct.generate(p, n)
+                          for p, n in zip(prompts, lens)]
+        direct_counts = direct_eng.compile_counts()
+
+        with _cluster(net, 1) as (router, client, gateways):
+            routed_eng = gateways[0].engine
+            for i, (p, n) in enumerate(zip(prompts, lens)):
+                out = client.generate(p, n)
+                assert out["id"] == direct_out[i]["id"] == i
+                assert out["tokens"] == direct_out[i]["tokens"] \
+                    == ref[i]
+                assert out["finish_reason"] \
+                    == direct_out[i]["finish_reason"] == "length"
+                assert out["status"] == direct_out[i]["status"] == 200
+                assert out["replays"] == 0
+            # streaming: deltas concat to the same ids, terminal
+            # carries the same mapped status
+            s = client.stream(prompts[0], lens[0])
+            toks = []
+            for d in s:
+                toks.extend(d)
+            assert toks == ref[0]
+            assert s.result["finish_reason"] == "length"
+            assert s.result["status"] == 200
+            # the router added NO engine work: compile counts match
+            # the direct gateway's exactly
+            assert routed_eng.compile_counts() == direct_counts
+
+    def test_status_mapping_deadline_and_cancel(self, net):
+        with _cluster(net, 1, throttle_s=0.05) as (router, client, _):
+            client.generate([2, 2], 2)  # compile before racing clocks
+            # deadline → 504 with partial tokens, through the router
+            with pytest.raises(GatewayError) as err:
+                client.generate(PROMPT, 40, deadline_s=0.25)
+            assert err.value.status == 504
+            assert err.value.payload["finish_reason"] == "deadline"
+            assert len(err.value.payload["tokens"]) >= 1
+            # poll replays the stored result at 200, like the gateway
+            polled = client.poll(err.value.payload["id"])
+            assert polled["finish_reason"] == "deadline"
+            # cancel mid-stream → terminal 499, partial tokens kept
+            s = client.stream(PROMPT, 24)
+            first = next(iter(s))
+            client.cancel(s.id)
+            toks = list(first)
+            for d in s:
+                toks.extend(d)
+            assert s.result["finish_reason"] == "cancelled"
+            assert s.result["status"] == 499
+            assert s.result["tokens"] == toks
+
+    def test_bad_requests_rejected_400(self, net):
+        with _cluster(net, 1) as (_, client, _):
+            for bad in (dict(prompt=[], max_new_tokens=4),
+                        dict(prompt=PROMPT, max_new_tokens=0),
+                        dict(prompt=PROMPT, max_new_tokens=4,
+                             temperature=-1.0)):
+                with pytest.raises(GatewayError) as err:
+                    client.generate(bad.pop("prompt"),
+                                    bad.pop("max_new_tokens"), **bad)
+                assert err.value.status == 400
+            with pytest.raises(GatewayError) as err:
+                client.poll(10_000)
+            assert err.value.status == 404
+
+
+class TestFailover:
+    """The robustness core: replica death mid-stream is invisible to
+    greedy clients; sampling keeps the PR 3/5 fault contract."""
+
+    def test_greedy_stream_survives_replica_kill(self, net):
+        n_gen = 30
+        ref = _reference(net, [PROMPT], [n_gen], n_slots=2,
+                         decode_chunk=2, seed=0)[0]
+        with _cluster(net, 2, throttle_s=0.04) as (router, client,
+                                                   gateways):
+            # warm both replicas so the kill scenario is not racing
+            # XLA compiles (first token would arrive seconds late)
+            for g in gateways:
+                GatewayClient(g.address).generate([2, 2], 2)
+            s = client.stream(PROMPT, n_gen)
+            toks, killed = [], False
+            for d in s:
+                toks.extend(d)
+                if not killed:
+                    _owner_of(router, gateways, s.id).hard_kill()
+                    killed = True
+            assert killed
+            # concat(pre-kill deltas, post-replay deltas) is
+            # bit-identical to the fault-free reference
+            assert toks == ref
+            assert s.result["tokens"] == ref
+            assert s.result["finish_reason"] == "length"
+            assert s.result["replays"] >= 1
+            # journal: nothing lost, nothing double-delivered
+            audit = router.journal_audit()
+            assert audit["lost"] == [] and audit["open"] == []
+            assert s.id in audit["replayed"]
+            # the dead replica trips the breaker; the survivor lives
+            _wait_for(lambda: sorted(
+                r["state"] in ("dead", "half-open")
+                for r in router.replica_status()) == [False, True],
+                msg="breaker to open on the killed replica")
+
+    def test_sampling_stream_faults_after_kill(self, net):
+        """A redrawn RNG cannot splice onto a streamed prefix: a
+        sampling request whose replica died after streaming ends
+        ``fault`` (status 500) with the streamed partial tokens —
+        never a silently wrong continuation."""
+        with _cluster(net, 2, throttle_s=0.05) as (router, client,
+                                                   gateways):
+            for g in gateways:
+                GatewayClient(g.address).generate([2, 2], 2)
+            s = client.stream(PROMPT, 30, temperature=0.7)
+            toks, killed = [], False
+            for d in s:
+                toks.extend(d)
+                if not killed:
+                    _owner_of(router, gateways, s.id).hard_kill()
+                    killed = True
+            assert s.result["finish_reason"] == "fault"
+            assert s.result["status"] == 500
+            assert s.result["tokens"] == toks
+            assert router.stats["request_faults"] == 1
+
+    def test_blocking_request_survives_kill(self, net):
+        """Blocking clients ride the same journaled relay: the
+        response arrives from the survivor, bit-identical."""
+        n_gen = 24
+        ref = _reference(net, [PROMPT], [n_gen], n_slots=2,
+                         decode_chunk=2, seed=0)[0]
+        with _cluster(net, 2, throttle_s=0.04) as (router, client,
+                                                   gateways):
+            for g in gateways:
+                GatewayClient(g.address).generate([2, 2], 2)
+            done = {}
+
+            def call():
+                done["out"] = client.generate(PROMPT, n_gen)
+
+            t = threading.Thread(target=call)
+            t.start()
+            _wait_for(lambda: 0 in router._journal
+                      and router._journal[0].replica_address
+                      and len(router._journal[0].tokens) >= 1,
+                      msg="blocking request to start streaming")
+            _owner_of(router, gateways, 0).hard_kill()
+            t.join(timeout=60)
+            assert not t.is_alive()
+            assert done["out"]["tokens"] == ref
+            assert done["out"]["replays"] >= 1
+
+
+class TestDrainHandoff:
+    """Graceful scale-down: /v1/drain through the router hands the
+    replica's unfinished requests to survivors via the same replay
+    path, and the replica is decommissioned."""
+
+    def test_drain_replica_mid_stream(self, net):
+        n_gen = 30
+        ref = _reference(net, [PROMPT], [n_gen], n_slots=2,
+                         decode_chunk=2, seed=0)[0]
+        with _cluster(net, 2, throttle_s=0.04) as (router, client,
+                                                   gateways):
+            for g in gateways:
+                GatewayClient(g.address).generate([2, 2], 2)
+            s = client.stream(PROMPT, n_gen)
+            first = next(iter(s))
+            owner = _owner_of(router, gateways, s.id)
+            summary = client.drain_replica(owner.replica_id,
+                                           timeout_s=0.2)
+            assert summary["drain"]["carried"] >= 1
+            assert s.id in summary["open_requests_handed_off"]
+            toks = list(first)
+            for d in s:
+                toks.extend(d)
+            assert toks == ref
+            assert s.result["replays"] >= 1
+            # decommissioned: never routed again, never resurrected
+            status = {r["replica_id"]: r["state"]
+                      for r in router.replica_status()}
+            assert status[owner.replica_id] == "dead"
+            out = client.generate([9, 3, 3, 5], 6)
+            assert out["finish_reason"] == "length"
+            time.sleep(3 * router.probe_interval_s)
+            status = {r["replica_id"]: r["state"]
+                      for r in router.replica_status()}
+            assert status[owner.replica_id] == "dead"
+
+
+class TestHealthLifecycle:
+    """Replica state machine: live → draining (healthz payload, the
+    ISSUE 9 satellite), live → degraded → dead (breaker), dead →
+    half-open → live (probe resurrection)."""
+
+    def test_gateway_healthz_reports_draining_state(self, net):
+        eng = DecodeEngine(net, n_slots=2, decode_chunk=2, seed=0)
+        with ServingGateway(eng, replica_id="solo") as gw:
+            client = GatewayClient(gw.address)
+            h = client.healthz()
+            assert h["state"] == "live" and h["ok"]
+            assert h["replica_id"] == "solo"
+            assert h["queued"] == 0 and h["active_slots"] == 0
+            assert "prefix_tokens_reused" in h
+            client.drain(timeout_s=1.0)
+            h = client.healthz()
+            assert h["state"] == "draining" and h["draining"]
+            assert h["ok"]  # draining is not dead
+
+    def test_breaker_opens_and_half_open_probe_recovers(self, net):
+        with _cluster(net, 2) as (router, client, gateways):
+            _wait_for(lambda: all(r["state"] == "live"
+                                  for r in router.replica_status()),
+                      msg="both replicas live")
+            victim = gateways[0]
+            host, port = victim._service.host, victim._service.port
+            victim.hard_kill()
+            _wait_for(lambda: {r["state"] for r in
+                               router.replica_status()}
+                      >= {"dead"},
+                      msg="breaker to open")
+            # requests keep flowing on the survivor meanwhile
+            assert client.generate(PROMPT, 4)["finish_reason"] \
+                == "length"
+            # resurrect on the SAME address: the half-open probe
+            # must bring it back to live
+            eng = DecodeEngine(net, n_slots=2, decode_chunk=2,
+                               seed=0)
+            revived = ServingGateway(eng, host=host, port=port,
+                                     replica_id="rep-0").start()
+            try:
+                _wait_for(lambda: all(r["state"] == "live"
+                                      for r in
+                                      router.replica_status()),
+                          timeout=30,
+                          msg="half-open probe to resurrect")
+            finally:
+                revived.close()
+
+
+class TestBackpressure:
+    """429 + Retry-After is backpressure, not failure."""
+
+    def test_retry_after_honored_single_gateway(self, net):
+        """ISSUE 9 satellite: a 429'd client that waits the hinted
+        seconds is admitted on the next attempt."""
+        eng = DecodeEngine(net, n_slots=1, decode_chunk=2, seed=0,
+                           max_queue=1)
+        _throttle(eng, 0.03)
+        with ServingGateway(eng, keepalive_s=0.1) as gw:
+            client = GatewayClient(gw.address)
+            s = client.stream(PROMPT, 8)      # occupies the slot
+            next(iter(s))                     # admitted for sure
+            queued = threading.Thread(
+                target=lambda: client.generate([9, 3, 3], 4))
+            queued.start()                    # fills max_queue=1
+            _wait_for(lambda: eng.scheduler.pending >= 1,
+                      msg="queue to fill")
+            with pytest.raises(GatewayError) as err:
+                client.generate([5, 2, 8], 4)
+            assert err.value.status == 429
+            hint = err.value.retry_after_s
+            assert hint is not None and hint >= 1
+            time.sleep(hint)
+            out = client.generate([5, 2, 8], 4)  # same workload
+            assert out["finish_reason"] == "length"
+            for _ in s:
+                pass
+            queued.join(timeout=30)
+
+    def test_router_reroutes_429_to_sibling(self, net):
+        """The router-level half of the satellite: backpressure on
+        the affinity-chosen replica routes to a sibling NOW instead
+        of making the client wait out the hint."""
+        ref = _reference(net, [[7] * 8], [4], n_slots=1,
+                         decode_chunk=2, seed=0, max_queue=1)[0]
+        with _cluster(net, 2, throttle_s=0.03, n_slots=1,
+                      max_queue=1) as (router, client, gateways):
+            _wait_for(lambda: {r["replica_id"] for r in
+                               router.replica_status()}
+                      == {"rep-0", "rep-1"},
+                      msg="router to learn replica ids")
+            # an affinity-eligible prompt (>= 1 block of 4) whose
+            # rendezvous owner we can saturate
+            prompt = [7] * 8
+            key = router._affinity_key(prompt)
+            owner = max(router._replicas,
+                        key=lambda r: router._rendezvous_score(
+                            key, r.replica_id))
+            owner_gw = next(g for g in gateways
+                            if g.replica_id == owner.replica_id)
+            # saturate the owner DIRECTLY: slot busy + queue full
+            direct = GatewayClient(owner_gw.address)
+            busy = direct.stream([2, 2], 40)
+            next(iter(busy))
+
+            def fill():
+                with contextlib.suppress(GatewayError):
+                    direct.generate([3, 3], 30)
+
+            filler = threading.Thread(target=fill)
+            filler.start()
+            _wait_for(lambda: owner_gw.engine.scheduler.pending >= 1,
+                      msg="owner queue to fill")
+            t0 = time.monotonic()
+            out = client.generate(prompt, 4)
+            elapsed = time.monotonic() - t0
+            assert out["tokens"] == ref
+            assert router.stats["rerouted_429"] >= 1
+            # rerouting beats waiting: well under the >= 1 s hint
+            # plus the sibling's own service time
+            assert elapsed < 10.0
+            busy.close()
+            filler.join(timeout=30)
+
+
+class TestAffinity:
+    """Prefix-affinity routing: shared-prefix traffic lands where its
+    cache is warm; replica death degrades to cache-cold, not errors."""
+
+    def test_shared_prefix_lands_warm(self, net):
+        shared = [3, 1, 4, 1, 5, 9, 2, 6]  # two affinity blocks of 4
+        tails = [[i % V] for i in range(8)]
+        with _cluster(net, 2, prefix_cache_rows=4) as (
+                router, client, gateways):
+            # let the first health scrape swap the address-derived
+            # replica ids for the stable configured ones BEFORE any
+            # affinity hash is computed — the hash keys on
+            # replica_id, and an id change mid-cohort remaps the key
+            _wait_for(lambda: {r["replica_id"] for r in
+                               router.replica_status()}
+                      == {"rep-0", "rep-1"},
+                      msg="router to learn replica ids")
+            outs = [client.generate(shared + t, 4) for t in tails]
+            assert all(o["finish_reason"] == "length" for o in outs)
+            # acceptance gate: >= 0.7 of warm-eligible requests on
+            # the replica holding the prefix, via its own
+            # prefix_tokens_reused counter — rendezvous makes it ALL
+            # of them here
+            reused = [g.engine.stats["prefill_tokens_skipped"]
+                      for g in gateways]
+            routed = [g.engine.stats["requests_finished"]
+                      for g in gateways]
+            warm_replica = max(range(2), key=lambda i: routed[i])
+            assert routed[warm_replica] == len(tails)
+            assert routed[1 - warm_replica] == 0
+            assert reused[warm_replica] >= len(shared) * 0.7 * (
+                len(tails) - 1)  # first admission is the cold fill
+            assert reused[1 - warm_replica] == 0
+            hit_share = (sum(1 for o in outs
+                             if o["prefix_tokens_reused"] > 0)
+                         / (len(outs) - 1))
+            assert hit_share >= 0.7
+            assert router.stats["affinity_routed"] >= len(tails)
+            # healthz surfaces the per-replica counter the gate reads
+            _wait_for(lambda: max(
+                r["prefix_tokens_reused"]
+                for r in router.replica_status())
+                == reused[warm_replica],
+                msg="health scrape to pick up reuse counters")
+
+    def test_killing_warm_replica_degrades_to_cold(self, net):
+        shared = [3, 1, 4, 1, 5, 9, 2, 6]
+        ref = _reference(net, [shared + [0]], [4], n_slots=2,
+                         decode_chunk=2, seed=0,
+                         prefix_cache_rows=4)[0]
+        with _cluster(net, 2, prefix_cache_rows=4) as (
+                router, client, gateways):
+            client.generate(shared + [1], 4)
+            warm = max(gateways, key=lambda g:
+                       g.engine.stats["requests_finished"])
+            cold = next(g for g in gateways if g is not warm)
+            warm.hard_kill()
+            _wait_for(lambda: any(r["state"] in ("dead", "half-open")
+                                  for r in router.replica_status()),
+                      msg="breaker on warm replica")
+            # same cohort: served cache-COLD on the survivor — right
+            # ids, no errors, just no reuse
+            out = client.generate(shared + [0], 4)
+            assert out["tokens"] == ref
+            assert out["finish_reason"] == "length"
+            assert cold.engine.stats["requests_finished"] >= 1
+
+    def test_bounded_load_overflow_spills_past_saturated_owner(
+            self, net):
+        """Pure rendezvous would pile every same-key stream onto one
+        replica (a 6/2 split on distinct keys measured 0.61× direct
+        on the bench): once the owner's slots are claimed, further
+        same-key picks walk DOWN the ranking to the sibling instead
+        of queueing a whole generation behind busy slots."""
+        with _cluster(net, 2, throttle_s=0.04,
+                      n_slots=2) as (router, client, gateways):
+            _wait_for(lambda: {r["replica_id"] for r in
+                               router.replica_status()}
+                      == {"rep-0", "rep-1"},
+                      msg="router to learn replica ids")
+            for g in gateways:  # compile before the concurrent burst
+                GatewayClient(g.address).generate([2, 2], 2)
+            prompt = [7, 7, 7, 7]  # one shared affinity key
+            outs = [None] * 4
+
+            def one(i):
+                outs[i] = client.generate(prompt, 12)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert all(o and o["finish_reason"] == "length"
+                       for o in outs)
+            # 4 same-key streams over 2 slots: the owner took its
+            # slate, the overflow landed on the sibling
+            assert router.stats["affinity_overflow"] >= 1
+            assert all(g.engine.stats["requests_finished"] >= 1
+                       for g in gateways)
+            # and the claims were all released
+            assert all(r["open_requests"] == 0
+                       for r in router.replica_status())
+
+    def test_rendezvous_remaps_only_dead_keyspace(self):
+        """The hashing property the design leans on: removing one
+        replica reassigns ONLY the keys it owned — survivors keep
+        their whole warm keyspace."""
+        ids = ["rep-a", "rep-b", "rep-c"]
+        keys = [b"key-%d" % i for i in range(64)]
+
+        def owner(key, pool):
+            return max(pool, key=lambda r:
+                       ServingRouter._rendezvous_score(key, r))
+
+        before = {k: owner(k, ids) for k in keys}
+        after = {k: owner(k, ["rep-a", "rep-c"]) for k in keys}
+        for k in keys:
+            if before[k] != "rep-b":
+                assert after[k] == before[k]
+        # and the dead replica's keys spread over the survivors
+        moved = {after[k] for k in keys if before[k] == "rep-b"}
+        assert moved <= {"rep-a", "rep-c"} and moved
+
+
+class TestClientKnobs:
+    """ISSUE 9 satellite: timeouts + bounded jittered retry on the
+    bare client — a dead replica fails fast instead of hanging on
+    the socket default."""
+
+    def test_connect_refused_fails_fast_and_retries_bounded(self):
+        # a port nothing listens on: grab one and close it
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        client = GatewayClient(f"127.0.0.1:{port}", retries=2,
+                               backoff_s=0.05, backoff_cap_s=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            client.healthz()
+        elapsed = time.monotonic() - t0
+        # 2 retries happened (>= ~half the nominal backoff, jitter
+        # floor) and the call still failed in bounded time
+        assert 0.05 * 0.5 <= elapsed < 10.0
+
+    def test_read_timeout_bounds_a_frozen_server(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        try:
+            client = GatewayClient(f"127.0.0.1:{port}",
+                                   connect_timeout_s=1.0,
+                                   read_timeout_s=0.3)
+            t0 = time.monotonic()
+            with pytest.raises(OSError):
+                client.healthz()  # accepts, never answers
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            srv.close()
+
+    def test_retry_recovers_when_server_appears(self, net):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        host, port = probe.getsockname()
+        probe.close()  # port known, nothing listening yet
+        client = GatewayClient(f"{host}:{port}", retries=8,
+                               backoff_s=0.2, backoff_cap_s=0.4)
+        revived = {}
+
+        def come_back():
+            time.sleep(0.5)
+            eng = DecodeEngine(net, n_slots=1, decode_chunk=2,
+                               seed=0)
+            revived["gw"] = ServingGateway(eng, host=host,
+                                           port=port).start()
+
+        t = threading.Thread(target=come_back)
+        t.start()
+        try:
+            h = client.healthz()
+            assert h["state"] == "live"
+        finally:
+            t.join()
+            revived["gw"].close()
+
+
+class TestRouterSurface:
+    def test_metrics_and_health_exports(self, net):
+        with _cluster(net, 2) as (router, client, _):
+            client.generate(PROMPT, 4)
+            gauges = parse_prometheus(client.metrics())
+            assert gauges["router_requests"] >= 1
+            assert gauges["router_replicas_live"] == 2
+            assert gauges["router_journal_open"] == 0
+            h = client.healthz()
+            assert h["ok"] and h["state"] == "live"
+            assert len(h["replicas"]) == 2
+            assert h["journal_open"] == 0
+            for r in h["replicas"]:
+                assert r["state"] in ("live", "degraded")
+
+    def test_cli_route_subcommand(self, net):
+        from deeplearning4j_tpu.cli.driver import (
+            build_parser,
+            router_from_args,
+        )
+
+        eng = DecodeEngine(net, n_slots=2, decode_chunk=2, seed=0)
+        ref = _reference(net, [PROMPT], [5], n_slots=2,
+                         decode_chunk=2, seed=0)[0]
+        with ServingGateway(eng) as gw:
+            args = build_parser().parse_args(
+                ["route", "--replicas", gw.address, "--port", "0",
+                 "--affinity-block-tokens", "4"])
+            router = router_from_args(args).start()
+            try:
+                out = RouterClient(router.address).generate(PROMPT, 5)
+                assert out["tokens"] == ref
+            finally:
+                router.close()
