@@ -1773,6 +1773,181 @@ def bench_router_overhead():
     }
 
 
+def bench_fleet_trace_overhead():
+    """Fleet-observability row (ISSUE 10 acceptance): trace-context
+    propagation + the router's fleet tracing (route/queue_wait spans,
+    per-replica trace-cache scraping, clock-offset estimation) must be
+    cheap enough to leave ON. 8 concurrent SSE streams over TWO
+    gateway replicas (the bench_router_overhead topology), through a
+    fleet-TRACED router vs a ``fleet_trace=False`` twin over the SAME
+    replicas, interleaved trials.
+
+    Gates:
+    - overhead: traced-path aggregate tokens/sec >= 0.97x the
+      untraced path (the context is one header + one span-args
+      string per hop; the scrape rides the existing health loop);
+    - parity: ids bit-identical traced vs untraced vs the in-process
+      single-engine reference — a trace id must never touch the
+      computation;
+    - zero retrace: compile counts identical before/after on both
+      replica engines (span args are host metadata, not jit inputs);
+    - the instruments actually recorded: every traced result carries
+      its fleet trace id, the stitched ``/v1/trace`` shows both
+      replica lanes skew-corrected, and the replicas' flight records
+      carry the router-minted context."""
+    import threading
+
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        Request,
+        RouterClient,
+        ServingGateway,
+        ServingRouter,
+    )
+
+    V, width, n_layers, window = 64, 1024, 8, 2048
+    n_streams, n_gen, prompt_len = 8, 64, 128
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_streams)]
+    ref_eng = DecodeEngine(net, n_slots=n_streams, decode_chunk=32)
+    ref_ids = [ref_eng.submit(Request(prompt=list(p),
+                                      max_new_tokens=n_gen))
+               for p in prompts]
+    ref_res = ref_eng.run()
+    ref_tokens = [ref_res[i].tokens for i in ref_ids]
+
+    engines = [DecodeEngine(net, n_slots=4, decode_chunk=32,
+                            prefix_cache_rows=8)
+               for _ in range(2)]
+    gateways = [ServingGateway(e, keepalive_s=1.0,
+                               admission_grace_s=0.25,
+                               replica_id=f"fleet-rep-{i}").start()
+                for i, e in enumerate(engines)]
+    addresses = [g.address for g in gateways]
+    traced_router = ServingRouter(addresses, health_interval_s=0.25,
+                                  affinity_block_tokens=16,
+                                  fleet_trace=True).start()
+    dark_router = ServingRouter(addresses, health_interval_s=0.25,
+                                affinity_block_tokens=16,
+                                fleet_trace=False).start()
+    traced_client = RouterClient(traced_router.address,
+                                 timeout_s=600.0)
+    dark_client = RouterClient(dark_router.address, timeout_s=600.0)
+
+    def stream_round(client):
+        outs = [None] * n_streams
+        finals = [None] * n_streams
+        errors = [None] * n_streams
+
+        def one(i):
+            try:
+                s = client.stream(prompts[i], n_gen)
+                toks = []
+                for delta in s:
+                    toks.extend(delta)
+                outs[i] = toks
+                finals[i] = s.result
+            except Exception as e:
+                errors[i] = e
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_streams)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        failed = {i: repr(e) for i, e in enumerate(errors) if e}
+        if failed:
+            raise RuntimeError(f"stream clients failed: {failed}")
+        return sum(len(o) for o in outs) / dt, outs, finals
+
+    try:
+        _, outs, finals = stream_round(traced_client)  # warm + check
+        id_match = float(np.mean([outs[i] == ref_tokens[i]
+                                  for i in range(n_streams)]))
+        if id_match < 1.0:
+            _fail_gate(f"traced stream ids diverged from the "
+                       f"in-process reference (match {id_match:.2f})")
+        if not all(f and f.get("trace") for f in finals):
+            _fail_gate("traced results missing fleet trace ids")
+        _, dark_outs, dark_finals = stream_round(dark_client)
+        if dark_outs != outs:
+            _fail_gate("untraced stream ids differ from traced — "
+                       "the trace context leaked into computation")
+        if any(f and f.get("trace") for f in dark_finals):
+            _fail_gate("fleet_trace=False results carry trace ids")
+        counts0 = [e.compile_counts() for e in engines]
+        traced_rates, dark_rates = [], []
+        for _ in range(3):  # interleaved: drift hits both alike
+            r, _, _ = stream_round(dark_client)
+            dark_rates.append(r)
+            r, _, _ = stream_round(traced_client)
+            traced_rates.append(r)
+        counts1 = [e.compile_counts() for e in engines]
+        if counts1 != counts0:
+            _fail_gate(f"replica engines retraced under traced "
+                       f"traffic: {counts0} -> {counts1}")
+        # the stitch is real: both replica lanes, skew-corrected
+        doc = traced_client.trace_events()
+        stitch = next(e for e in doc["traceEvents"]
+                      if e.get("name") == "fleet.stitch")
+        lanes = stitch["args"]["replicas"]
+        if (len(lanes) != 2
+                or not all(r["skew_corrected"] for r in lanes)):
+            _fail_gate(f"stitched trace lanes wrong: {lanes}")
+        # a replica flight record carries the router-minted context
+        probe = traced_client.trace(finals[0]["id"])
+        if not str(probe.get("trace", "")).startswith(
+                str(finals[0]["trace"])):
+            _fail_gate(f"replica flight record lost the fleet trace "
+                       f"context: {probe.get('trace')!r}")
+    finally:
+        traced_router.close()
+        dark_router.close()
+        for g in gateways:
+            g.close()
+    traced_rate = float(np.median(traced_rates))
+    dark_rate = float(np.median(dark_rates))
+    ratio = traced_rate / dark_rate
+    if ratio < 0.97:
+        _fail_gate(
+            f"fleet tracing costs too much: {traced_rate:.0f} tok/s "
+            f"traced < 0.97x {dark_rate:.0f} untraced "
+            f"(ratio {ratio:.3f})")
+    return {
+        "metric": "fleet_observability_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": ("traced-router / untraced-router aggregate "
+                 "streaming tokens/sec (width-1024 flagship, "
+                 "2048-token KV window, 2 replicas x 4 slots, "
+                 f"{n_streams} concurrent SSE streams x {n_gen} "
+                 "tokens, localhost; fleet tracing = trace-context "
+                 "propagation + router spans + trace-cache scrape + "
+                 "clock-offset estimation)"),
+        "vs_baseline": None,  # reference has no fleet tier at all
+        "spread": [round(min(traced_rates) / max(dark_rates), 4),
+                   round(max(traced_rates) / min(dark_rates), 4)],
+        "trials": len(traced_rates),
+        "traced_tokens_per_sec": round(traced_rate, 1),
+        "untraced_tokens_per_sec": round(dark_rate, 1),
+        "router_http_id_match": round(id_match, 4),
+        "compile_counts": counts1,
+    }
+
+
 def bench_observability_overhead():
     """Observability row (ISSUE 7 acceptance): the request-scoped
     flight recorder must be cheap enough to leave ON. Same width-1024
@@ -2275,6 +2450,7 @@ def main() -> None:
                bench_prefix_cache, bench_decode_paged,
                bench_decode_spec,
                bench_gateway_streaming, bench_router_overhead,
+               bench_fleet_trace_overhead,
                bench_observability_overhead,
                bench_train_observability_overhead,
                bench_w2v, bench_dbn, bench_allreduce):

@@ -100,6 +100,35 @@ class Histogram:
             cum += c
         return self.bounds[-1]
 
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other``'s observations into this histogram,
+        bucket-wise (ISSUE 10 — the fleet-metrics federation
+        primitive: N replicas' ``serving_ttft_s`` families merge into
+        one fleet-wide distribution whose quantiles are exact at
+        bucket resolution, because histograms with IDENTICAL bounds
+        are closed under addition). Raises ``ValueError`` when the
+        bound lists differ — adding counts across mismatched buckets
+        would silently misplace mass, the one failure mode a
+        federation layer must reject rather than absorb. Returns
+        ``self``."""
+        if not isinstance(other, Histogram):
+            raise TypeError(
+                f"cannot merge {type(other).__name__} into Histogram")
+        if self.bounds != other.bounds:
+            raise ValueError(
+                "histogram bound mismatch: cannot merge "
+                f"{len(other.bounds)} bounds "
+                f"[{other.bounds[0]:g}..{other.bounds[-1]:g}] into "
+                f"{len(self.bounds)} bounds "
+                f"[{self.bounds[0]:g}..{self.bounds[-1]:g}]")
+        counts, total_sum, total = other.snapshot()
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += total_sum
+            self._count += total
+        return self
+
     def prometheus_lines(self, name: str,
                          help_text: Optional[str] = None) -> List[str]:
         """Prometheus text-format exposition: cumulative
@@ -120,6 +149,93 @@ class Histogram:
         lines.append(f"{name}_sum {repr(float(total_sum))}")
         lines.append(f"{name}_count {total}")
         return lines
+
+
+def _sanitize_metric_name(name: str) -> str:
+    """Prometheus metric-name charset ([a-zA-Z0-9_:], no leading
+    digit) — shared by :meth:`Tracer.prometheus_text` and the fleet
+    federation (:meth:`Tracer.merge_prometheus`), which must agree on
+    sanitization or federated families would silently fork."""
+    safe = "".join(c if (c.isalnum() or c in "_:") else "_"
+                   for c in name)
+    if safe and safe[0].isdigit():
+        safe = "_" + safe
+    return safe
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+#: parsed shape of one replica's exposition text (module-level so the
+#: fleet tools and tests share it): ``types``/``help`` keyed by family
+#: name, ``histograms`` as ``{name: {"les": [str], "cums": [int],
+#: "sum": float, "count": int}}``, ``scalars`` as ``{name: float}``.
+def parse_exposition(text: str) -> Dict[str, Any]:
+    """Parse Prometheus text-format exposition (the subset
+    :meth:`Tracer.prometheus_text` emits: unlabeled scalar samples,
+    ``# TYPE``/``# HELP`` comments, and histogram families with
+    ``le``-labeled buckets) into a merge-friendly structure."""
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    scalars: Dict[str, float] = {}
+
+    def hist_of(family: str) -> Dict[str, Any]:
+        return hists.setdefault(
+            family, {"les": [], "cums": [], "sum": 0.0, "count": 0})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) >= 4:
+                helps[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        name = name.strip()
+        if not name:
+            continue
+        if '{le="' in name and name.endswith('"}'):
+            family = name[:name.index("{")]
+            if family.endswith("_bucket"):
+                family = family[:-len("_bucket")]
+                le = name[name.index('le="') + 4:-2]
+                try:
+                    h = hist_of(family)
+                    h["les"].append(le)
+                    h["cums"].append(int(float(value)))
+                except ValueError:
+                    pass
+                continue
+        if "{" in name:
+            continue  # labeled non-bucket samples: not emitted by us
+        try:
+            fval = float(value)
+        except ValueError:
+            continue
+        for suffix, key in (("_sum", "sum"), ("_count", "count")):
+            family = name[:-len(suffix)] if name.endswith(suffix) \
+                else None
+            if family and (family in hists
+                           or types.get(family) == "histogram"):
+                hist_of(family)[key] = (fval if key == "sum"
+                                        else int(fval))
+                break
+        else:
+            scalars[name] = fval
+    return {"types": types, "help": helps, "histograms": hists,
+            "scalars": scalars}
 
 
 class Tracer:
@@ -148,6 +264,12 @@ class Tracer:
         self._hists: Dict[str, Histogram] = {}
         self._help: Dict[str, str] = {}
         self.max_events = max_events
+        #: events evicted by the cap (or ``clear``) so far: the
+        #: absolute sequence number of ``_events[i]`` is
+        #: ``_dropped + i`` — a monotone cursor remote scrapers
+        #: (the router's incremental trace cache, ISSUE 10) resume
+        #: from without re-downloading the whole window
+        self._dropped = 0
         self._t0 = time.perf_counter()
 
     def _push(self, event: Dict[str, Any]) -> None:
@@ -156,7 +278,9 @@ class Tracer:
         self._events.append(event)
         if (self.max_events is not None
                 and len(self._events) > self.max_events):
-            del self._events[:len(self._events) // 2]
+            half = len(self._events) // 2
+            del self._events[:half]
+            self._dropped += half
 
     def _us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
@@ -280,6 +404,23 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    def events_since(self, seq: int
+                     ) -> Tuple[List[Dict[str, Any]], int]:
+        """Incremental read (ISSUE 10): the events at absolute
+        sequence >= ``seq`` plus the NEXT cursor to resume from, so a
+        periodic scraper (the router's per-replica trace cache) pays
+        only for what is new instead of re-serializing the whole
+        window each tick. A cursor from before the cap dropped events
+        resumes at the oldest retained event; a cursor from a
+        different tracer lifetime (``seq`` beyond the end — the
+        server restarted or ``clear``ed) restarts from 0."""
+        with self._lock:
+            end = self._dropped + len(self._events)
+            if seq > end:
+                seq = 0  # foreign/stale cursor: full window
+            lo = max(int(seq) - self._dropped, 0)
+            return list(self._events[lo:]), end
+
     def spans(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
         return [e for e in self.events()
                 if e["ph"] == "X" and (name is None or e["name"] == name)]
@@ -320,13 +461,7 @@ class Tracer:
             hists = dict(self._hists)
             helps = dict(self._help)
 
-        def sanitize(name: str) -> str:
-            safe = "".join(
-                c if (c.isalnum() or c in "_:") else "_"
-                for c in name)
-            if safe and safe[0].isdigit():
-                safe = "_" + safe
-            return safe
+        sanitize = _sanitize_metric_name
 
         hist_safe: Dict[str, Tuple[str, Histogram]] = {}
         for name in sorted(hists):
@@ -358,12 +493,132 @@ class Tracer:
             lines.extend(hist.prometheus_lines(safe, helps.get(raw)))
         return "\n".join(lines) + ("\n" if lines else "")
 
+    @staticmethod
+    def merge_prometheus(sources: Dict[str, str]) -> str:
+        """Federate N replicas' exposition texts (``{replica_id:
+        prometheus_text}``) into ONE fleet exposition (ISSUE 10
+        tentpole — the router's ``GET /v1/fleet/metrics`` body):
+
+        - **histogram** families merge bucket-wise into an unlabeled
+          fleet family (quantiles over the merged family answer
+          "fleet p99", exactly what one replica's family answers for
+          one replica), PLUS per-replica ``{replica="<id>"}``-labeled
+          bucket/sum/count samples so one scrape carries both views.
+          Families whose ``le`` bound lists differ across replicas
+          raise ``ValueError`` — bucket-wise addition across
+          mismatched bounds would silently misplace mass
+          (:meth:`Histogram.merge` enforces the same contract
+          in-process).
+        - **counter** families sum to one unlabeled fleet total
+          (counters are rates-in-waiting; sums are meaningful).
+        - **gauge** (and untyped) families emit ONLY per-replica
+          ``{replica="<id>"}``-labeled samples: a summed queue depth
+          across replicas is occasionally meaningful, a summed round
+          time never is — and before this existed, same-named gauges
+          from different replicas collided after name sanitization
+          into last-writer-wins (ISSUE 10 satellite fix).
+
+        ``# HELP`` survives (first replica's text wins); names are
+        sanitized with the same rule :meth:`prometheus_text` uses, so
+        a federated family can never fork from its per-replica
+        original."""
+        parsed = {rid: parse_exposition(text)
+                  for rid, text in sources.items()}
+        # family name -> kind/help, first-seen order preserved
+        kinds: Dict[str, str] = {}
+        helps: Dict[str, str] = {}
+        order: List[str] = []
+
+        def note(name: str, kind: str, p: Dict[str, Any]) -> None:
+            safe = _sanitize_metric_name(name)
+            if safe not in kinds:
+                kinds[safe] = kind
+                order.append(safe)
+            if safe not in helps and name in p["help"]:
+                helps[safe] = p["help"][name]
+
+        # histogram families first (they own their names, same as
+        # prometheus_text), then scalars
+        hist_parts: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        scalar_parts: Dict[str, Dict[str, float]] = {}
+        for rid, p in parsed.items():
+            for name, h in p["histograms"].items():
+                note(name, "histogram", p)
+                hist_parts.setdefault(
+                    _sanitize_metric_name(name), {})[rid] = h
+            for name, value in p["scalars"].items():
+                safe = _sanitize_metric_name(name)
+                if safe in hist_parts:
+                    continue
+                kind = p["types"].get(name, "gauge")
+                note(name, kind, p)
+                scalar_parts.setdefault(safe, {})[rid] = value
+        lines: List[str] = []
+        for safe in order:
+            kind = kinds[safe]
+            if safe in helps:
+                lines.append(f"# HELP {safe} {helps[safe]}")
+            lines.append(f"# TYPE {safe} {kind}")
+            if kind == "histogram":
+                parts = hist_parts[safe]
+                les = None
+                for rid, h in parts.items():
+                    if les is None:
+                        les = list(h["les"])
+                    elif list(h["les"]) != les:
+                        raise ValueError(
+                            f"histogram {safe!r}: replica {rid!r} "
+                            f"bounds {h['les'][:3]}..x{len(h['les'])} "
+                            f"mismatch the fleet's "
+                            f"{les[:3]}..x{len(les)} — refusing a "
+                            "bucket-wise merge across mismatched "
+                            "bounds")
+                fleet_cums = [0] * len(les or ())
+                fleet_sum, fleet_count = 0.0, 0
+                for h in parts.values():
+                    for i, c in enumerate(h["cums"]):
+                        fleet_cums[i] += c
+                    fleet_sum += h["sum"]
+                    fleet_count += h["count"]
+                for le, cum in zip(les or (), fleet_cums):
+                    lines.append(
+                        f'{safe}_bucket{{le="{le}"}} {cum}')
+                lines.append(f"{safe}_sum {repr(float(fleet_sum))}")
+                lines.append(f"{safe}_count {fleet_count}")
+                for rid, h in parts.items():
+                    lab = _escape_label(rid)
+                    for le, cum in zip(h["les"], h["cums"]):
+                        lines.append(
+                            f'{safe}_bucket{{replica="{lab}",'
+                            f'le="{le}"}} {cum}')
+                    lines.append(
+                        f'{safe}_sum{{replica="{lab}"}} '
+                        f'{repr(float(h["sum"]))}')
+                    lines.append(
+                        f'{safe}_count{{replica="{lab}"}} '
+                        f'{h["count"]}')
+            elif kind == "counter":
+                total = sum(scalar_parts[safe].values())
+                text = ("%d" % total if float(total).is_integer()
+                        else repr(float(total)))
+                lines.append(f"{safe} {text}")
+            else:
+                for rid, value in scalar_parts[safe].items():
+                    text = ("%d" % value
+                            if float(value).is_integer()
+                            else repr(float(value)))
+                    lines.append(
+                        f'{safe}{{replica="{_escape_label(rid)}"}} '
+                        f"{text}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
     def save(self, path: str) -> None:
         with open(path, "w") as f:
             json.dump({"traceEvents": self.events()}, f)
 
     def clear(self) -> None:
         with self._lock:
+            self._dropped += len(self._events)  # cursors stay monotone
             self._events.clear()
             self._cum.clear()
             self._last.clear()

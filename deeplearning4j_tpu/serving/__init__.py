@@ -13,7 +13,11 @@ by decode slots and the prefix trie, with zero-copy prefix splices and
 copy-on-write divergence (ISSUE 6 tentpole, ``paged_kv=True``) — and
 the multi-replica router tier: a failure-tolerant prefix-affinity
 front door over N gateway replicas with journaled in-flight replay
-onto survivors (ISSUE 9 tentpole)."""
+onto survivors (ISSUE 9 tentpole) — and fleet-wide distributed
+tracing + federated metrics: router-minted ``X-DL4J-Trace`` contexts
+stamped through to every engine span, a stitched skew-corrected
+multi-lane ``/v1/trace``, and bucket-wise-merged
+``/v1/fleet/metrics`` (ISSUE 10 tentpole)."""
 
 from deeplearning4j_tpu.serving.block_pool import BlockPool, BlockTable
 

@@ -37,6 +37,8 @@ import socket
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from deeplearning4j_tpu.util.httpjson import TRACE_HEADER
+
 #: connection-level failures worth a retry for idempotent calls: the
 #: peer was unreachable or vanished BEFORE a full response arrived.
 #: (socket.timeout subclasses OSError; HTTPException covers a peer
@@ -237,13 +239,16 @@ class GatewayClient:
 
     def _call(self, method: str, path: str,
               body: Optional[Dict[str, Any]] = None,
-              ok=(200,)) -> Dict[str, Any]:
+              ok=(200,),
+              headers: Optional[Dict[str, str]] = None
+              ) -> Dict[str, Any]:
         conn = self._connect()
         try:
             payload = (None if body is None
                        else json.dumps(body).encode())
-            headers = ({"Content-Type": "application/json"}
-                       if payload is not None else {})
+            if headers is None:
+                headers = ({"Content-Type": "application/json"}
+                           if payload is not None else {})
             conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()
             raw = resp.read()
@@ -258,6 +263,23 @@ class GatewayClient:
             conn.close()
 
     # -- endpoints -----------------------------------------------------
+    @staticmethod
+    def _generate_body(prompt: List[int], max_new_tokens: int,
+                       kwargs: Dict[str, Any]
+                       ) -> Tuple[Dict[str, Any], Dict[str, str]]:
+        """(body, extra headers) for one generate call. A ``trace=``
+        kwarg (the fleet trace context, ISSUE 10) rides BOTH carriers:
+        the ``X-DL4J-Trace`` header (the Dapper-style wire position a
+        sidecar proxy can read without parsing bodies) and the JSON
+        ``trace`` field (which survives body-level relays)."""
+        body = dict(prompt=list(prompt),
+                    max_new_tokens=int(max_new_tokens), **kwargs)
+        headers = {"Content-Type": "application/json"}
+        if body.get("trace") is not None:
+            body["trace"] = str(body["trace"])
+            headers[TRACE_HEADER] = body["trace"]
+        return body, headers
+
     def generate(self, prompt: List[int], max_new_tokens: int,
                  **kwargs: Any) -> Dict[str, Any]:
         """Blocking generation. Returns the terminal result dict on
@@ -267,21 +289,24 @@ class GatewayClient:
         ``err.payload["tokens"]``. NEVER retried on connection
         failure: resubmitting a generate is a replay decision the
         caller must make (see serving/router.py for the journaled
-        version)."""
-        body = dict(prompt=list(prompt),
-                    max_new_tokens=int(max_new_tokens), **kwargs)
-        return self._call("POST", "/v1/generate", body)
+        version). ``trace=`` attaches a fleet trace context
+        (ISSUE 10)."""
+        body, headers = self._generate_body(prompt, max_new_tokens,
+                                            kwargs)
+        return self._call("POST", "/v1/generate", body,
+                          headers=headers)
 
     def stream(self, prompt: List[int], max_new_tokens: int,
                **kwargs: Any) -> GatewayStream:
         """Start a streaming generation; returns the live
-        :class:`GatewayStream` (its ``id`` is already populated)."""
-        body = dict(prompt=list(prompt),
-                    max_new_tokens=int(max_new_tokens), **kwargs)
+        :class:`GatewayStream` (its ``id`` is already populated).
+        ``trace=`` attaches a fleet trace context (ISSUE 10)."""
+        body, headers = self._generate_body(prompt, max_new_tokens,
+                                            kwargs)
         conn = self._connect()
         conn.request("POST", "/v1/generate?stream=1",
                      body=json.dumps(body).encode(),
-                     headers={"Content-Type": "application/json"})
+                     headers=headers)
         resp = conn.getresponse()
         if resp.status != 200:
             raw = resp.read()
@@ -312,22 +337,32 @@ class GatewayClient:
         return self._with_retry(lambda: self._call(
             "GET", f"/v1/requests/{request_id}/trace", ok=(200, 202)))
 
-    def trace_events(self) -> Dict[str, Any]:
+    def trace_events(self,
+                     since_seq: Optional[int] = None
+                     ) -> Dict[str, Any]:
         """``GET /v1/trace`` — the server tracer's current event
         window as a Chrome trace-event document
         (``{"traceEvents": [...]}``), ready to save and load into
-        Perfetto/chrome://tracing."""
-        return self._call("GET", "/v1/trace")
+        Perfetto/chrome://tracing. ``since_seq`` requests the
+        INCREMENTAL delta (ISSUE 10): only events at absolute tracer
+        sequence >= it, plus a ``nextSeq`` cursor to resume from —
+        what the router's periodic trace-cache scrape rides."""
+        path = ("/v1/trace" if since_seq is None
+                else f"/v1/trace?since_seq={int(since_seq)}")
+        return self._call("GET", path)
 
     def healthz(self) -> Dict[str, Any]:
         return self._with_retry(
             lambda: self._call("GET", "/v1/healthz"))
 
-    def metrics(self) -> str:
+    def _get_text(self, path: str) -> str:
+        """Idempotent text GET (retried per the client's policy) —
+        the metrics-scrape shape, shared by the gateway's
+        ``/v1/metrics`` and the router's ``/v1/fleet/metrics``."""
         def once() -> str:
             conn = self._connect()
             try:
-                conn.request("GET", "/v1/metrics")
+                conn.request("GET", path)
                 resp = conn.getresponse()
                 body = resp.read().decode()
                 if resp.status != 200:
@@ -337,6 +372,9 @@ class GatewayClient:
                 conn.close()
 
         return self._with_retry(once)
+
+    def metrics(self) -> str:
+        return self._get_text("/v1/metrics")
 
     def drain(self, timeout_s: Optional[float] = None
               ) -> Dict[str, Any]:

@@ -339,7 +339,15 @@ def _request_dict(req: Request) -> Dict[str, Any]:
         "id": req.id,
         "deadline_s": req.deadline_s,
         "queue_timeout_s": req.queue_timeout_s,
+        "trace": req.trace,
     }
+
+
+def _targs(req: Request) -> Dict[str, Any]:
+    """Span-args fragment carrying the request's fleet trace context
+    (ISSUE 10) — empty for untraced requests, so local-only traffic
+    adds zero bytes per span."""
+    return {"trace": req.trace} if req.trace else {}
 
 
 def _request_from(d: Dict[str, Any]) -> Request:
@@ -1048,6 +1056,19 @@ class DecodeEngine:
             return contextlib.nullcontext()
         return self.tracer.span(name, **args)
 
+    def _traces_of(self, slots) -> Dict[str, Any]:
+        """Span-args fragment mapping request id -> fleet trace
+        context for a batched span covering several slots (ISSUE 10
+        — decode_chunk / spec_verify carry ``rids`` lists; this is
+        the parallel trace map). Empty when no covered request is
+        traced."""
+        traces = {
+            str(self._slots[s].request.id): self._slots[s].request.trace
+            for s in slots
+            if self._slots[s] is not None
+            and self._slots[s].request.trace}
+        return {"traces": traces} if traces else {}
+
     # -- request-scoped observability (ISSUE 7) ------------------------
     def describe_metrics(self) -> None:
         """Register the engine's histogram tracks + HELP text with the
@@ -1152,6 +1173,7 @@ class DecodeEngine:
                 self._flight[request.id] = {
                     "id": request.id, "finish_reason": reason,
                     "timing": timing, "attempts": clock.attempts,
+                    **_targs(request),
                 }
                 while len(self._flight) > self.flight_recorder:
                     self._flight.popitem(last=False)
@@ -1160,14 +1182,14 @@ class DecodeEngine:
                 # these instants back out of a saved Chrome trace
                 self.tracer.instant("serving.request_done",
                                     rid=request.id, reason=reason,
-                                    timing=timing)
+                                    timing=timing, **_targs(request))
         self._terminal[request.id] = GenerationResult(
             id=request.id, tokens=list(tokens), finish_reason=reason,
             prompt_len=len(request.prompt),
             prefix_tokens_reused=prefix_reused, ttft_s=ttft,
             retries=self._retries.pop(request.id, 0),
             spec_drafted=spec_drafted, spec_accepted=spec_accepted,
-            timing=timing)
+            timing=timing, trace=request.trace)
         self.stats["requests_finished"] += 1
         self._submit_t.pop(request.id, None)
         self._started.discard(request.id)
@@ -1451,7 +1473,8 @@ class DecodeEngine:
                     self.stats["prefill_tokens_skipped"] += matched
                     with self._span("serving.prefix_splice",
                                     rid=request.id, row=hit.row,
-                                    matched=matched, blocks=spliced):
+                                    matched=matched, blocks=spliced,
+                                    **_targs(request)):
                         pass
                     if clock is not None:
                         clock.event(self._clock(), "admit_splice",
@@ -1464,7 +1487,8 @@ class DecodeEngine:
                 t_fetch = self._clock()
                 with self._span("serving.prefix_fetch",
                                 rid=request.id, row=hit.row,
-                                matched=matched, drop=hit.drop):
+                                matched=matched, drop=hit.drop,
+                                **_targs(request)):
                     rnn = self.prefix_cache.fetch(hit)
                 if clock is not None:
                     now = self._clock()
@@ -1532,7 +1556,8 @@ class DecodeEngine:
             t0 = self._clock()
             with self._span("serving.prefill_chunk", rid=req.id,
                             width=width, tokens=len(seg),
-                            done=pending.done, paged=True):
+                            done=pending.done, paged=True,
+                            **_targs(req)):
                 tok, rnn = self._chunk_jit(
                     self.net.params, self.net.state, x, mask, rnn_in,
                     temp, top_k, self._next_key())
@@ -1552,7 +1577,8 @@ class DecodeEngine:
             # first cold segment: no carried state yet — the bucketed
             # cold-prefill executable establishes it
             with self._span("serving.prefill", rid=req.id,
-                            bucket=width, tokens=len(seg)):
+                            bucket=width, tokens=len(seg),
+                            **_targs(req)):
                 tok, rnn = self._prefill_jit(
                     self.net.params, self.net.state, x, mask, temp,
                     top_k, self._next_key())
@@ -1563,7 +1589,7 @@ class DecodeEngine:
         else:
             with self._span("serving.prefill_chunk", rid=req.id,
                             width=width, tokens=len(seg),
-                            done=pending.done):
+                            done=pending.done, **_targs(req)):
                 tok, rnn = self._chunk_jit(
                     self.net.params, self.net.state, x, mask,
                     pending.rnn, temp, top_k, self._next_key())
@@ -1614,7 +1640,8 @@ class DecodeEngine:
                     return
                 table_row, _ = tab.arrays(self._ring_slots)
                 with self._span("serving.admit", rid=request.id,
-                                slot=slot, paged=True):
+                                slot=slot, paged=True,
+                                **_targs(request)):
                     self._pool = self._scatter_jit(
                         self._pool, pending.rnn,
                         jnp.asarray(table_row),
@@ -1642,7 +1669,7 @@ class DecodeEngine:
                                         a.dtype), pending.rnn)
                 self._toks = jnp.zeros((self.n_slots,), jnp.int32)
             with self._span("serving.admit", rid=request.id,
-                            slot=slot):
+                            slot=slot, **_targs(request)):
                 self._pool, self._toks = self._admit_jit(
                     self._pool, self._toks, pending.rnn, pending.tok,
                     jnp.asarray(slot, jnp.int32))
@@ -2051,7 +2078,9 @@ class DecodeEngine:
         with self._span("serving.spec_verify", width=width,
                         drafted=int(lens.sum()),
                         rids=[self._slots[s].request.id
-                              for s, d in drafts.items() if d]):
+                              for s, d in drafts.items() if d],
+                        **self._traces_of(
+                            s for s, d in drafts.items() if d)):
             pool_op, self._toks, emitted, acc = self._verify_jit(
                 self.net.params, self.net.state, pool_op,
                 self._toks, jnp.asarray(draft), jnp.asarray(lens),
@@ -2268,7 +2297,8 @@ class DecodeEngine:
             with self._span("serving.decode_chunk",
                             active=len(active),
                             rids=[self._slots[s].request.id
-                                  for s in active]):
+                                  for s in active],
+                            **self._traces_of(active)):
                 pool_op, self._toks, seq = self._decode_jit(
                     self.net.params, self.net.state, pool_op,
                     self._toks, jnp.asarray(self._temps),
@@ -2468,7 +2498,8 @@ class DecodeEngine:
                     "snapshot's working set")
             table_row, _ = tab.arrays(self._ring_slots)
             with self._span("serving.admit", rid=request.id,
-                            slot=slot, paged=True):
+                            slot=slot, paged=True,
+                            **_targs(request)):
                 self._pool = self._scatter_jit(
                     self._pool, rnn, jnp.asarray(table_row),
                     jnp.asarray(tab.length, jnp.int32))
@@ -2482,7 +2513,7 @@ class DecodeEngine:
                                         a.dtype), rnn)
                 self._toks = jnp.zeros((self.n_slots,), jnp.int32)
             with self._span("serving.admit", rid=request.id,
-                            slot=slot):
+                            slot=slot, **_targs(request)):
                 self._pool, self._toks = self._admit_jit(
                     self._pool, self._toks, rnn, tok,
                     jnp.asarray(slot, jnp.int32))

@@ -106,7 +106,7 @@ STATUS_OF_REASON = {
 
 
 def _result_dict(res: GenerationResult) -> Dict[str, Any]:
-    return {
+    out = {
         "id": res.id,
         "tokens": [int(t) for t in res.tokens],
         "finish_reason": res.finish_reason,
@@ -119,6 +119,9 @@ def _result_dict(res: GenerationResult) -> Dict[str, Any]:
         "timing": res.timing,
         "status": STATUS_OF_REASON.get(res.finish_reason, 200),
     }
+    if res.trace is not None:  # fleet trace context echo (ISSUE 10)
+        out["trace"] = res.trace
+    return out
 
 
 class _Live:
@@ -156,7 +159,7 @@ class _GatewayHandler(JsonHandler):
                            close=True)
 
     def do_GET(self):
-        path = self.path.partition("?")[0]
+        path, _, query = self.path.partition("?")
         if path == "/v1/healthz":
             self.send_json(self.gateway._health(), 200, close=True)
         elif path == "/v1/metrics":
@@ -164,7 +167,7 @@ class _GatewayHandler(JsonHandler):
                             "text/plain; version=0.0.4", 200,
                             close=True)
         elif path == "/v1/trace":
-            self.gateway._handle_trace_export(self)
+            self.gateway._handle_trace_export(self, query)
         elif (path.startswith("/v1/requests/")
                 and path.endswith("/trace")):
             self.gateway._handle_request_trace(self, path)
@@ -496,10 +499,16 @@ class ServingGateway:
             self._live.pop(rid, None)
 
     # -- request plumbing ----------------------------------------------
-    def _submit(self, body: Dict[str, Any]):
+    def _submit(self, body: Dict[str, Any],
+                trace: Optional[str] = None):
         """Parse + admit one generate body under the lock. Returns
         ``(rid, live, None)`` or ``(None, None, (code, payload,
-        headers))`` for an immediate rejection."""
+        headers))`` for an immediate rejection. ``trace`` is the
+        ``X-DL4J-Trace`` header value (ISSUE 10); the JSON ``trace``
+        field wins when both carriers are present (it is what a
+        body-level relay forwards)."""
+        if body.get("trace") is not None:
+            trace = str(body["trace"])[:256]
         try:
             req = Request(
                 prompt=[int(t) for t in body.get("prompt", [])],
@@ -513,7 +522,8 @@ class ServingGateway:
                             else float(body["deadline_s"])),
                 queue_timeout_s=(
                     None if body.get("queue_timeout_s") is None
-                    else float(body["queue_timeout_s"])))
+                    else float(body["queue_timeout_s"])),
+                trace=trace)
         except (TypeError, ValueError) as e:
             return None, None, (400, {"error": str(e)}, ())
         with self._engine_access():
@@ -572,7 +582,8 @@ class ServingGateway:
             handler.send_json({"error": f"bad JSON body: {e}"}, 400,
                               close=True)
             return
-        rid, live, reject = self._submit(body)
+        rid, live, reject = self._submit(body,
+                                         trace=handler.trace_context())
         if reject is not None:
             code, payload, headers = reject
             handler.send_json(payload, code, close=True,
@@ -724,28 +735,33 @@ class ServingGateway:
                           "evicted from the flight recorder, or "
                           "record_timing off)"}, 404, close=True)
 
-    def _handle_trace_export(self, handler) -> None:
+    def _handle_trace_export(self, handler, query: str = "") -> None:
         """``GET /v1/trace``: the tracer's current event window as
         Chrome trace-event JSON (Perfetto/chrome://tracing loadable),
         streamed with the chunked helpers so a large window never
         materializes as one giant bytes object. The tracer snapshot
         is taken under ITS lock (``Tracer.events`` copies); no
-        gateway lock is held while writing the socket."""
+        gateway lock is held while writing the socket.
+
+        ``?since_seq=<n>`` (ISSUE 10) returns only events at absolute
+        tracer sequence >= n, plus a ``nextSeq`` cursor — the
+        incremental protocol the router's per-replica trace cache
+        scrapes with, so a periodic scrape pays for the DELTA instead
+        of re-serializing a 64k-event window every tick."""
         tracer = self.engine.tracer
-        events = tracer.events() if tracer is not None else []
-        try:
-            handler.start_stream("application/json")
-            handler.send_chunk(b'{"traceEvents":[')
-            for lo in range(0, len(events), 512):
-                piece = ",".join(json.dumps(e)
-                                 for e in events[lo:lo + 512])
-                if lo:
-                    piece = "," + piece
-                handler.send_chunk(piece.encode())
-            handler.send_chunk(b"]}")
-            handler.end_stream()
-        except (BrokenPipeError, ConnectionResetError, OSError):
-            pass  # client vanished mid-export; nothing to release
+        since: Optional[int] = None
+        for part in query.split("&"):
+            if part.startswith("since_seq="):
+                with contextlib.suppress(ValueError):
+                    since = int(part[len("since_seq="):])
+        next_seq = None
+        if tracer is None:
+            events = []
+        elif since is not None and hasattr(tracer, "events_since"):
+            events, next_seq = tracer.events_since(since)
+        else:
+            events = tracer.events()
+        handler.send_trace_events(events, next_seq=next_seq)
 
     @staticmethod
     def _rid_of(handler, path: str) -> Optional[int]:
@@ -773,10 +789,18 @@ class ServingGateway:
         # with the live load figures its least-loaded fallback weighs
         state = ("stopped" if self._stopped
                  else "draining" if self._draining else "live")
+        tracer = self.engine.tracer
         return {
             "ok": not self._stopped,
             "state": state,
             "replica_id": self.replica_id,
+            # this replica's tracer clock, in trace-event µs: a
+            # router samples it inside a timed scrape to estimate
+            # the per-replica clock offset (NTP-style midpoint) that
+            # skew-corrects stitched fleet traces (ISSUE 10). Reads
+            # one perf_counter — as lock-free as the rest.
+            "now_us": (tracer.now_us()
+                       if hasattr(tracer, "now_us") else None),
             "draining": self._draining,
             "round": eng._round,
             "queued": eng.scheduler.pending,

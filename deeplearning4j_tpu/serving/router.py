@@ -61,6 +61,34 @@ path: ``drain_replica`` routes ``/v1/drain`` through the replica,
 whose unfinished streams end without a terminal event, and the relay
 loops re-admit those requests on survivors.
 
+**Fleet-wide observability (ISSUE 10 tentpole).** The router is the
+only place that sees a request's WHOLE life across the fleet, so it
+is where the fleet's observability lives:
+
+- every journaled request carries a router-minted trace id
+  (``r<rid>``) with per-attempt span ids (``a<n>``), propagated to
+  the replica as the ``X-DL4J-Trace`` header + JSON ``trace`` field —
+  the engine stamps its spans, flight-recorder record, and terminal
+  with it;
+- ``GET /v1/trace`` answers the STITCHED fleet trace: each replica's
+  Chrome-trace window on its own process lane (live fetch when
+  reachable, the health loop's incrementally-scraped cache for dead
+  replicas — how a SIGKILLed victim's spans survive), skew-corrected
+  onto the router clock by per-replica NTP-style offset estimates
+  (healthz ``now_us`` sampled inside a timed scrape, error <= RTT/2),
+  interleaved with the router's own ``router.route`` /
+  ``router.queue_wait`` / ``router.replay`` spans and
+  ``router.breaker`` instants — a failover reads as one request's
+  monotone timeline spanning two replicas;
+- ``GET /v1/fleet/metrics`` federates the replicas
+  (:meth:`profiler.tracer.Tracer.merge_prometheus`): histograms
+  merged bucket-wise + labeled per replica, counters summed, gauges
+  labeled, plus the router's ``router_replay_gap_s`` histogram
+  (stream break -> first post-replay token);
+- ``GET /v1/requests/<id>/trace`` proxies the owner's flight record
+  via the journal, or serves journal breadcrumbs with a
+  ``replayed_to`` pointer when the owner died.
+
 The router speaks the gateway's own protocol (``/v1/generate``,
 ``/v1/requests/<id>``, ``/v1/healthz``, ``/v1/metrics``, SSE framing),
 so :class:`~deeplearning4j_tpu.serving.GatewayClient` drives a router
@@ -152,6 +180,33 @@ class _Replica:
         self.prefix_tokens_reused = 0
         self.requests_routed = 0
         self.open_entries = 0  # journal entries currently assigned
+        # -- fleet tracing state (ISSUE 10) ----------------------------
+        #: estimated ``replica_tracer_now - router_tracer_now`` in µs,
+        #: NTP-style: the replica reports its tracer clock inside a
+        #: timed healthz scrape and the midpoint of the scrape window
+        #: is the sample point, so the estimate's error is bounded by
+        #: half the scrape RTT. The stitcher maps a replica event onto
+        #: the router timeline as ``ts - clock_offset_us``.
+        self.clock_offset_us: Optional[float] = None
+        self.clock_rtt_us = float("inf")
+        self.clock_age = 0      # scrapes since the estimate updated
+        #: the offset that matches ``trace_cache``'s EPOCH: cached
+        #: events and the offset that corrects them must come from
+        #: the same process lifetime, so the pair is snapshotted
+        #: together at scrape time — the live estimate above may be
+        #: reset (death, restart detection) while the cache still
+        #: holds the dead epoch's events
+        self.cache_offset_us: Optional[float] = None
+        #: scraped Chrome-trace window (the replica flight recorder's
+        #: fleet-side shadow): when a replica is SIGKILLed its own
+        #: tracer dies with it — this cache is the only place the
+        #: victim's spans survive, and what puts the dead lane in a
+        #: stitched failover trace. Filled INCREMENTALLY
+        #: (``?since_seq=`` + the resume cursor below), so the
+        #: periodic scrape pays for the delta, not the window.
+        self.trace_cache: List[Dict[str, Any]] = []
+        self.trace_cache_t = 0.0
+        self.trace_seq = 0
 
     def status(self) -> Dict[str, Any]:
         return {
@@ -180,7 +235,8 @@ class _JournalEntry:
     __slots__ = ("rid", "prompt", "params", "temperature", "tokens",
                  "replays", "cancelled", "done", "result",
                  "replica_address", "replica_rid", "affinity",
-                 "history", "submit_t")
+                 "history", "submit_t", "trace", "done_t",
+                 "replay_t0_us", "replay_hwm", "replay_from")
 
     def __init__(self, rid: int, prompt: List[int],
                  params: Dict[str, Any], submit_t: float):
@@ -200,6 +256,17 @@ class _JournalEntry:
         #: journal's audit trail the chaos soak asserts over
         self.history: List[Tuple[float, str]] = []
         self.submit_t = submit_t
+        #: fleet trace id (ISSUE 10): the router-minted identity every
+        #: hop stamps its spans with; per-attempt span ids extend it
+        self.trace: Optional[str] = None
+        self.done_t: Optional[float] = None
+        # open replay window: set when a stream broke and the request
+        # is being replayed; closed (-> router.replay span + the
+        # router_replay_gap_s observation) by the first POST-replay
+        # fresh token, or by the terminal/divergence
+        self.replay_t0_us: Optional[float] = None
+        self.replay_hwm = 0
+        self.replay_from: Optional[str] = None
 
     def note(self, t: float, event: str) -> None:
         self.history.append((round(t, 4), event))
@@ -250,6 +317,13 @@ class _RouterHandler(JsonHandler):
             self.send_bytes(self.router._metrics_text().encode(),
                             "text/plain; version=0.0.4", 200,
                             close=True)
+        elif path == "/v1/fleet/metrics":
+            self.router._handle_fleet_metrics(self)
+        elif path == "/v1/trace":
+            self.router._handle_fleet_trace(self)
+        elif (path.startswith("/v1/requests/")
+                and path.endswith("/trace")):
+            self.router._handle_request_trace(self, path)
         elif path.startswith("/v1/requests/"):
             self.router._handle_poll(self, path)
         else:
@@ -284,6 +358,22 @@ class RouterClient(GatewayClient):
             body["timeout_s"] = timeout_s
         return self._call("POST", "/v1/replicas/drain", body)
 
+    def fleet_metrics(self) -> str:
+        """``GET /v1/fleet/metrics`` — the federated Prometheus
+        exposition (ISSUE 10): replica histogram families merged
+        bucket-wise into fleet-wide distributions (plus per-replica
+        ``{replica=...}``-labeled samples), counters summed, gauges
+        labeled per replica, and the router's own tracks
+        (``router_*`` including the ``router_replay_gap_s``
+        histogram) appended."""
+        return self._get_text("/v1/fleet/metrics")
+
+    # ``trace_events()`` (inherited) against a ROUTER returns the
+    # STITCHED fleet trace: every replica's window on its own process
+    # lane, skew-corrected, with the router's route/replay/breaker
+    # spans interleaved (ISSUE 10 tentpole).
+    fleet_trace = GatewayClient.trace_events
+
 
 class ServingRouter:
     """Failure-tolerant prefix-aware router over N gateway replicas.
@@ -308,6 +398,10 @@ class ServingRouter:
     - ``probe_interval_s`` — half-open probe period for dead replicas.
     - ``max_replays`` — replay budget per request across replica
       deaths; past it the request terminates ``fault``.
+    - ``fleet_trace`` — fleet observability master switch (default
+      ON; priced >= 0.97x by ``bench_fleet_trace_overhead``):
+      trace-context propagation, router spans, the incremental
+      per-replica trace cache, and clock-offset estimation.
     - ``replica_connect_timeout_s`` / ``replica_timeout_s`` — the
       router→replica connect and read bounds (a dead replica must
       fail fast, a healthy stream may idle up to the replica's
@@ -329,6 +423,7 @@ class ServingRouter:
                  replica_connect_timeout_s: float = 2.0,
                  replica_timeout_s: float = 120.0,
                  journal_cap: int = 4096,
+                 fleet_trace: bool = True,
                  tracer=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -355,11 +450,34 @@ class ServingRouter:
             replica_connect_timeout_s)
         self.replica_timeout_s = float(replica_timeout_s)
         self.journal_cap = int(journal_cap)
+        #: fleet observability master switch (ISSUE 10; default ON —
+        #: priced by bench_fleet_trace_overhead): trace-context
+        #: propagation to replicas, router route/replay spans, the
+        #: per-replica trace cache, and clock-offset estimation. Off,
+        #: the router is the span-silent ISSUE 9 router (the
+        #: /v1/trace and /v1/fleet/metrics endpoints still answer,
+        #: with router-only lanes / unstamped requests).
+        self.fleet_trace = bool(fleet_trace)
         if tracer is None:
             from deeplearning4j_tpu.profiler.tracer import Tracer
 
             tracer = Tracer(max_events=65536)
         self.tracer = tracer
+        from deeplearning4j_tpu.profiler.tracer import Histogram
+
+        #: replay-added latency: stream break -> first POST-replay
+        #: token the client had not already seen (the failover cost a
+        #: fleet operator actually pays — latency_report's --fleet
+        #: ``replay_gap`` row)
+        self._replay_gap = Histogram()
+        if hasattr(self.tracer, "register_histogram"):
+            self.tracer.register_histogram("router_replay_gap_s",
+                                           self._replay_gap)
+        if hasattr(self.tracer, "describe"):
+            self.tracer.describe(
+                "router_replay_gap_s",
+                "stream-break to first post-replay fresh-token gap "
+                "(replay-added latency per failover)")
         self._lock = threading.RLock()
         self._rids = itertools.count()
         self._journal: Dict[int, _JournalEntry] = {}
@@ -413,6 +531,24 @@ class ServingRouter:
     def _now(self) -> float:
         return time.monotonic() - self._t0
 
+    def _now_us(self) -> float:
+        """The router's trace-event clock (µs) — the timeline every
+        replica's events are skew-corrected onto."""
+        f = getattr(self.tracer, "now_us", None)
+        return float(f()) if f else (time.monotonic() - self._t0) * 1e6
+
+    def _breaker_instant(self, replica: _Replica, frm: str,
+                         to: str) -> None:
+        """State-transition instant for the stitched trace (ISSUE 10):
+        a failover timeline without the breaker's live→dead /
+        dead→half-open→live instants cannot answer WHEN routing
+        noticed. Caller holds the lock; the tracer has its own."""
+        if frm != to and hasattr(self.tracer, "instant"):
+            self.tracer.instant("router.breaker",
+                                replica=replica.replica_id,
+                                frm=frm, to=to,
+                                failures=replica.failures)
+
     def _replica_client(self, replica: _Replica,
                         read_timeout_s: Optional[float] = None,
                         retries: int = 0) -> GatewayClient:
@@ -455,17 +591,21 @@ class ServingRouter:
             if now < replica.next_probe_t:
                 return
             with self._lock:
+                self._breaker_instant(replica, replica.state,
+                                      "half-open")
                 replica.state = "half-open"
         # scrape timeouts well under the health interval budget: a
         # hung replica must not stall the whole loop for long
         probe = self._replica_client(
             replica, read_timeout_s=max(
                 4 * self.health_interval_s, 1.0))
+        t0_us = self._now_us()
         try:
             payload = probe.healthz()
         except (GatewayError, *RETRYABLE_ERRORS):
             self._note_failure(replica)
             return
+        self._note_clock(replica, payload, t0_us, self._now_us())
         self._note_alive(replica, payload)
         if scrape_metrics and replica.state == "live":
             try:
@@ -482,6 +622,87 @@ class ServingRouter:
                 if "serving_prefill_tokens_skipped" in gauges:
                     replica.prefix_tokens_reused = int(
                         gauges["serving_prefill_tokens_skipped"])
+            if self.fleet_trace:
+                self._scrape_trace(replica, probe)
+
+    def _note_clock(self, replica: _Replica,
+                    payload: Dict[str, Any], t0_us: float,
+                    t1_us: float) -> None:
+        """Fold one timed healthz scrape into the replica's clock-
+        offset estimate. NTP midpoint: the replica read its tracer
+        clock somewhere inside [t0, t1] on the router timeline, so
+        ``offset = replica_now - (t0+t1)/2`` with error <= RTT/2. A
+        lower-RTT sample always replaces a higher-RTT one (tighter
+        bound); an AGED estimate (8 scrapes) is replaced regardless,
+        so a one-off fast scrape cannot pin a stale offset while the
+        clocks drift."""
+        now_us = payload.get("now_us")
+        if now_us is None:
+            return
+        rtt_us = t1_us - t0_us
+        candidate = float(now_us) - (t0_us + t1_us) / 2.0
+        with self._lock:
+            replica.clock_age += 1
+            # a candidate a full second away from the stored estimate
+            # is not drift (µs between scrapes) — it is a NEW PROCESS
+            # epoch on the same address (restart/resurrection):
+            # accept immediately, or the stitcher would correct the
+            # new epoch's events with the dead process's offset for
+            # up to 8 scrapes
+            epoch_jump = (replica.clock_offset_us is not None
+                          and abs(candidate - replica.clock_offset_us)
+                          > 1e6)
+            if (rtt_us <= replica.clock_rtt_us or epoch_jump
+                    or replica.clock_age >= 8):
+                replica.clock_offset_us = candidate
+                replica.clock_rtt_us = rtt_us
+                replica.clock_age = 0
+
+    #: trace-cache bound per replica (events): past it the oldest
+    #: half drops, mirroring the tracer's own cap policy
+    TRACE_CACHE_CAP = 65536
+
+    def _scrape_trace(self, replica: _Replica,
+                      probe: GatewayClient) -> None:
+        """Refresh the replica's cached Chrome-trace window (the
+        dead-lane source for stitched failover traces — a SIGKILLed
+        replica's spans survive only here). INCREMENTAL: resumes from
+        the last ``nextSeq`` cursor, so a busy replica costs one
+        delta per scrape instead of a full 64k-event serialization
+        (the difference between a free health tick and the 7% tax the
+        fleet-overhead bench first measured). Failures are silent:
+        the healthz that just succeeded owns liveness accounting, and
+        a torn trace fetch must not shadow it."""
+        try:
+            doc = probe.trace_events(since_seq=replica.trace_seq)
+        except Exception:
+            return
+        events = doc.get("traceEvents", [])
+        next_seq = doc.get("nextSeq")
+        with self._lock:
+            if next_seq is None:
+                replica.trace_cache = events  # legacy full window
+            elif next_seq < replica.trace_seq:
+                # the replica's tracer lifetime changed (restart on
+                # the same address): its window IS the new truth,
+                # and the old process's clock estimate must not
+                # correct the new process's epoch
+                replica.trace_cache = events
+                replica.trace_seq = int(next_seq)
+                replica.clock_offset_us = None
+                replica.clock_rtt_us = float("inf")
+                replica.clock_age = 0
+            else:
+                replica.trace_cache.extend(events)
+                replica.trace_seq = int(next_seq)
+            if len(replica.trace_cache) > self.TRACE_CACHE_CAP:
+                del replica.trace_cache[
+                    :len(replica.trace_cache) // 2]
+            # the cache's correcting offset is whatever the clock
+            # estimate says NOW — this scrape just talked to the same
+            # process the events came from, so they share an epoch
+            replica.cache_offset_us = replica.clock_offset_us
+            replica.trace_cache_t = time.monotonic()
 
     def _note_alive(self, replica: _Replica,
                     payload: Dict[str, Any]) -> None:
@@ -489,8 +710,9 @@ class ServingRouter:
             replica.failures = 0
             if replica.decommissioned:
                 return
-            replica.state = ("draining"
-                             if payload.get("draining") else "live")
+            to = "draining" if payload.get("draining") else "live"
+            self._breaker_instant(replica, replica.state, to)
+            replica.state = to
             rid = payload.get("replica_id")
             if rid:
                 replica.replica_id = str(rid)
@@ -512,13 +734,26 @@ class ServingRouter:
             was = replica.state
             if (replica.failures >= self.failure_threshold
                     or was in ("dead", "half-open")):
+                self._breaker_instant(replica, was, "dead")
                 replica.state = "dead"
                 replica.next_probe_t = (time.monotonic()
                                         + self.probe_interval_s)
+                # the clock-offset estimate described a process now
+                # presumed gone: a resurrected replica on the same
+                # port has a FRESH perf_counter epoch, and correcting
+                # its events with the dead process's offset would
+                # scatter them across the stitched timeline. Drop the
+                # estimate so the first post-resurrection scrape
+                # always measures anew (a merely-slow replica just
+                # re-measures — harmless).
+                replica.clock_offset_us = None
+                replica.clock_rtt_us = float("inf")
+                replica.clock_age = 0
                 if was not in ("dead", "half-open"):
                     self.stats["replica_faults"] += 1
                     self.tracer.incr("router_replica_dead")
             elif was == "live":
+                self._breaker_instant(replica, was, "degraded")
                 replica.state = "degraded"
 
     # -- routing -------------------------------------------------------
@@ -539,11 +774,15 @@ class ServingRouter:
                             digest_size=8).digest(), "big")
 
     def _pick(self, prompt: Sequence[int],
-              exclude: Set[str]) -> Tuple[_Replica, bool]:
+              exclude: Set[str]) -> Tuple[_Replica, Dict[str, Any]]:
         """Choose the replica for one (re)submission and claim one
         unit of its in-flight budget (``open_entries`` — the caller
         MUST release it when the attempt ends). Returns ``(replica,
-        by_affinity)``. Raises :class:`_AllBackedOff` when every
+        route_info)`` where ``route_info`` carries the
+        ``router.route`` span's args: ``affinity`` (bool), the
+        affinity ``key`` digest, and the chosen replica's rendezvous
+        ``rank`` (0 = first choice; >0 = bounded-load overflow walked
+        down the ranking). Raises :class:`_AllBackedOff` when every
         candidate is parked behind a 429 hint, :class:`_NoReplica`
         when nothing can serve at all.
 
@@ -592,8 +831,13 @@ class ServingRouter:
                     (r for r in ranked
                      if r.open_entries < max(r.n_slots, 1)),
                     ranked[0])  # all saturated: stay sticky
-                by_affinity = True
-                if chosen is ranked[0]:
+                info = {
+                    "affinity": True,
+                    "key": hashlib.blake2b(
+                        key, digest_size=4).hexdigest(),
+                    "rank": ranked.index(chosen),
+                }
+                if info["rank"] == 0:
                     self.stats["affinity_routed"] += 1
                 else:
                     self.stats["affinity_overflow"] += 1
@@ -609,11 +853,11 @@ class ServingRouter:
                                    p[0].queue_depth
                                    + p[0].active_slots,
                                    p[1] % len(ready)))[0]
-                by_affinity = False
+                info = {"affinity": False, "key": None, "rank": None}
                 self.stats["load_routed"] += 1
             chosen.requests_routed += 1
             chosen.open_entries += 1
-            return chosen, by_affinity
+            return chosen, info
 
     # -- journal -------------------------------------------------------
     def _journal_entry(self, prompt: List[int],
@@ -621,6 +865,12 @@ class ServingRouter:
         with self._lock:
             rid = next(self._rids)
             entry = _JournalEntry(rid, prompt, params, self._now())
+            if self.fleet_trace:
+                # the fleet-level identity (ISSUE 10): every hop —
+                # router spans, gateway, engine flight recorder —
+                # stamps this id, so one grep of a stitched trace
+                # yields the request's whole cross-process story
+                entry.trace = f"r{rid}"
             entry.note(self._now(), "submitted")
             self._journal[rid] = entry
             # bounded journal: evict oldest DONE entries past the cap
@@ -670,27 +920,70 @@ class ServingRouter:
         out["id"] = entry.rid
         out["tokens"] = list(entry.tokens)
         out["replays"] = entry.replays
+        if entry.trace:
+            out["trace"] = entry.trace
         return out
 
     def _fault_terminal(self, entry: _JournalEntry,
                         reason: str = "fault",
                         status: int = 500) -> Dict[str, Any]:
-        return {"id": entry.rid, "tokens": list(entry.tokens),
-                "finish_reason": reason, "status": status,
-                "prompt_len": len(entry.prompt),
-                "replays": entry.replays}
+        out = {"id": entry.rid, "tokens": list(entry.tokens),
+               "finish_reason": reason, "status": status,
+               "prompt_len": len(entry.prompt),
+               "replays": entry.replays}
+        if entry.trace:
+            out["trace"] = entry.trace
+        return out
 
     def _finish(self, entry: _JournalEntry,
                 result: Dict[str, Any]) -> Dict[str, Any]:
+        self._close_replay_window(entry, outcome="terminal")
         with self._lock:
             entry.result = result
-            entry.note(self._now(),
+            entry.done_t = self._now()
+            entry.note(entry.done_t,
                        f"terminal:{result.get('finish_reason')}")
             entry.done.set()
             if result.get("finish_reason") == "fault":
                 self.stats["request_faults"] += 1
                 self.tracer.incr("router_request_faults")
         return result
+
+    def _open_replay_window(self, entry: _JournalEntry,
+                            from_replica: str) -> None:
+        """The stream broke and a replay begins: anchor the
+        ``router.replay`` span (and the ``router_replay_gap_s``
+        observation) at the BREAK, not at the resubmit — the client's
+        dead air starts now."""
+        if entry.replay_t0_us is None:
+            entry.replay_t0_us = self._now_us()
+            entry.replay_hwm = len(entry.tokens)
+            entry.replay_from = from_replica
+
+    def _close_replay_window(self, entry: _JournalEntry,
+                             outcome: str,
+                             overlap_ok: bool = True) -> None:
+        """First fresh token after a replay (or the terminal, for a
+        replay that only had its tail left / diverged / faulted):
+        emit the bridging ``router.replay`` span — break to first
+        post-replay delivery, the exact failover gap the client
+        experienced — and feed the replay-gap histogram."""
+        t0 = entry.replay_t0_us
+        if t0 is None:
+            return
+        entry.replay_t0_us = None
+        now = self._now_us()
+        gap_s = max(now - t0, 0.0) / 1e6
+        self._replay_gap.observe(gap_s)
+        if hasattr(self.tracer, "complete"):
+            self.tracer.complete(
+                "router.replay", t0, max(now - t0, 0.0),
+                rid=entry.rid, trace=entry.trace,
+                high_water=entry.replay_hwm,
+                overlap_ok=overlap_ok, outcome=outcome,
+                from_replica=entry.replay_from,
+                to_replica=(entry.replica_address or ""),
+                replay=entry.replays)
 
     def _relay_tokens(self, entry: _JournalEntry, tokens: List[int],
                       seen: int) -> Tuple[int, List[int]]:
@@ -728,8 +1021,9 @@ class ServingRouter:
             time.sleep(min(left, self.keepalive_s))
 
     def _attempt(self, entry: _JournalEntry, replica: _Replica,
-                 client: GatewayClient, by_affinity: bool, emit,
-                 forward_ping
+                 client: GatewayClient, route_info: Dict[str, Any],
+                 emit, forward_ping, attempt_no: int = 0,
+                 wait_t0_us: Optional[float] = None
                  ) -> Tuple[Optional[Dict[str, Any]], bool]:
         """One streaming attempt against one replica. Returns
         ``(terminal, diverged)``; ``terminal is None`` means the
@@ -739,8 +1033,16 @@ class ServingRouter:
         never started streaming (submit rejected/unreachable — try a
         sibling, no replay charged) and :class:`_ClientGone` when the
         router's own client vanished mid-relay."""
+        by_affinity = bool(route_info.get("affinity"))
+        params = entry.params
+        if self.fleet_trace and entry.trace:
+            # trace id + PER-ATTEMPT span id: a failover's two
+            # attempts are two spans of one trace, so the replica
+            # each served knows which chapter it was
+            params = dict(params,
+                          trace=f"{entry.trace}/a{attempt_no}")
         try:
-            stream = client.stream(entry.prompt, **entry.params)
+            stream = client.stream(entry.prompt, **params)
         except GatewayError as e:
             if e.status == 429:
                 # backpressure, not failure: park the replica for the
@@ -773,6 +1075,18 @@ class ServingRouter:
                        f"routed:{replica.replica_id}"
                        f"{':affinity' if by_affinity else ''}"
                        f":rid={stream.id}")
+        if (self.fleet_trace and wait_t0_us is not None
+                and hasattr(self.tracer, "complete")):
+            # pick + backoff + submit handshake: everything between
+            # "this attempt became runnable" and "the replica accepted
+            # the stream" — the router-side analogue of the engine's
+            # queue_wait phase
+            now_us = self._now_us()
+            self.tracer.complete(
+                "router.queue_wait", wait_t0_us,
+                max(now_us - wait_t0_us, 0.0), rid=entry.rid,
+                trace=entry.trace, attempt=attempt_no,
+                replica=replica.replica_id)
         terminal: Optional[Dict[str, Any]] = None
         diverged = False
         seen = 0
@@ -792,6 +1106,12 @@ class ServingRouter:
                         entry, toks, seen)
                     if fresh:
                         emit(fresh)
+                        # the first fresh token after a failover ends
+                        # the client-visible replay gap: the dedup
+                        # walk verified the regenerated prefix, new
+                        # content is flowing again
+                        self._close_replay_window(
+                            entry, outcome="fresh_token")
                     continue
                 if event.get("done"):
                     # the terminal may carry committed tokens the
@@ -826,6 +1146,9 @@ class ServingRouter:
         Returns the client-facing terminal dict (also journaled)."""
         exclude: Set[str] = set()
         attempts = 0
+        # router-side queue-wait anchor: submit (or the previous
+        # attempt's break) -> the replica accepting the stream
+        wait_t0_us = self._now_us() if self.fleet_trace else None
         while True:
             if entry.cancelled:
                 return self._finish(
@@ -838,9 +1161,10 @@ class ServingRouter:
                 # replays, which count mid-stream deaths)
                 return self._finish(entry,
                                     self._fault_terminal(entry))
+            t_route_us = self._now_us() if self.fleet_trace else None
             try:
-                replica, by_affinity = self._pick(entry.prompt,
-                                                  exclude)
+                replica, route_info = self._pick(entry.prompt,
+                                                 exclude)
             except _AllBackedOff as e:
                 if not entry.tokens:
                     wait = max(1, int(e.wait_s + 0.999))
@@ -874,15 +1198,31 @@ class ServingRouter:
                     "status": (500 if entry.tokens else 503),
                     "prompt_len": len(entry.prompt),
                     "replays": entry.replays})
-            entry.affinity = entry.affinity or by_affinity
+            entry.affinity = (entry.affinity
+                              or bool(route_info.get("affinity")))
+            if (self.fleet_trace and t_route_us is not None
+                    and hasattr(self.tracer, "complete")):
+                # the routing decision itself, with the evidence:
+                # affinity key digest + the chosen replica's
+                # rendezvous rank (>0 = bounded-load overflow)
+                now_us = self._now_us()
+                self.tracer.complete(
+                    "router.route", t_route_us,
+                    max(now_us - t_route_us, 0.0), rid=entry.rid,
+                    trace=entry.trace, attempt=attempts,
+                    replica=replica.replica_id,
+                    affinity=route_info.get("affinity"),
+                    affinity_key=route_info.get("key"),
+                    rendezvous_rank=route_info.get("rank"))
             client = self._replica_client(replica)
             try:
                 # _pick claimed one unit of the replica's in-flight
                 # budget; the outer finally releases it however this
                 # attempt ends (bounded-load affinity reads it live)
                 terminal, diverged = self._attempt(
-                    entry, replica, client, by_affinity, emit,
-                    forward_ping)
+                    entry, replica, client, route_info, emit,
+                    forward_ping, attempt_no=attempts,
+                    wait_t0_us=wait_t0_us)
             except _RouteAround as ra:
                 exclude.add(replica.address)
                 if ra.deterministic is not None:
@@ -896,6 +1236,11 @@ class ServingRouter:
                                     self._result_of(entry, terminal))
             if diverged:
                 entry.note(self._now(), "replay_diverged")
+                # the overlap check FAILED: the bridging replay span
+                # records it (a silent splice is the one thing the
+                # dedup walk exists to prevent)
+                self._close_replay_window(entry, outcome="diverged",
+                                          overlap_ok=False)
                 return self._finish(entry,
                                     self._fault_terminal(entry))
             # ---- the stream ended WITHOUT a terminal ---------------
@@ -925,6 +1270,12 @@ class ServingRouter:
             if entry.replays > self.max_replays:
                 return self._finish(entry,
                                     self._fault_terminal(entry))
+            if self.fleet_trace:
+                # anchor the bridging router.replay span (and the
+                # replay-gap histogram) at the break; the next
+                # attempt's queue_wait restarts here too
+                self._open_replay_window(entry, replica.replica_id)
+                wait_t0_us = self._now_us()
             # keep the client connection warm across the failover
             # gap (route + resubmit + survivor prefill before its
             # first event)
@@ -1102,6 +1453,271 @@ class ServingRouter:
                       if not e.done.is_set()))
             return self.tracer.prometheus_text()
 
+    # -- fleet observability (ISSUE 10 tentpole) ------------------------
+    def fleet_metrics_text(self) -> str:
+        """``GET /v1/fleet/metrics`` body: every reachable replica's
+        ``/v1/metrics`` exposition federated through
+        :meth:`profiler.tracer.Tracer.merge_prometheus` — histogram
+        families merged bucket-wise into fleet-wide distributions
+        (plus ``{replica=...}``-labeled per-replica samples), counters
+        summed, gauges labeled per replica — with the router's own
+        tracks (``router_*`` + the ``router_replay_gap_s`` histogram)
+        appended. Replicas that cannot contribute — dead or
+        decommissioned (no live scrape exists), or in-state but
+        failing the fetch — are skipped and NAMED in a comment line,
+        so a fleet-aggregate discontinuity is explained by the scrape
+        itself: it must degrade, not 500, while a replica is
+        mid-death. Replica fetches run in PARALLEL, so one frozen
+        replica costs the scrape one timeout, not one per replica."""
+        from deeplearning4j_tpu.profiler.tracer import Tracer
+
+        with self._lock:
+            targets = [(r.replica_id, r.address)
+                       for r in self._replicas
+                       if not r.decommissioned
+                       and r.state in ("live", "degraded",
+                                       "draining")]
+            skipped = [r.replica_id for r in self._replicas
+                       if r.decommissioned
+                       or r.state not in ("live", "degraded",
+                                          "draining")]
+        results: Dict[str, str] = {}
+
+        def fetch(rid: str, addr: str) -> None:
+            with contextlib.suppress(GatewayError,
+                                     *RETRYABLE_ERRORS):
+                results[rid] = GatewayClient(
+                    addr,
+                    connect_timeout_s=self.replica_connect_timeout_s,
+                    read_timeout_s=5.0).metrics()
+
+        threads = [threading.Thread(target=fetch, args=t,
+                                    daemon=True,
+                                    name=f"fleet-metrics-{t[0]}")
+                   for t in targets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        sources = {rid: results[rid] for rid, _ in targets
+                   if rid in results}
+        skipped += [rid for rid, _ in targets if rid not in results]
+        parts = []
+        if skipped:
+            parts.append("# fleet: replicas skipped (dead, "
+                         "decommissioned, or scrape failed): "
+                         + ", ".join(sorted(skipped)))
+        parts.append(Tracer.merge_prometheus(sources))
+        parts.append(self._metrics_text())
+        return "\n".join(p.rstrip("\n") for p in parts if p) + "\n"
+
+    def _handle_fleet_metrics(self, handler) -> None:
+        handler.send_bytes(self.fleet_metrics_text().encode(),
+                           "text/plain; version=0.0.4", 200,
+                           close=True)
+
+    def fleet_trace_events(self) -> List[Dict[str, Any]]:
+        """The STITCHED fleet trace (ISSUE 10 tentpole): one
+        Perfetto-loadable event list where
+
+        - lane (Chrome ``pid``) 0 is the ROUTER — its
+          ``router.route`` / ``router.queue_wait`` / ``router.replay``
+          spans and ``router.breaker`` instants;
+        - lane ``i+1`` is replica ``i`` — its live ``/v1/trace``
+          window when reachable, else the health loop's last cached
+          window (how a SIGKILLed replica's spans survive onto the
+          stitched timeline);
+        - every replica event's ``ts`` is skew-corrected onto the
+          router's clock by that replica's scrape-RTT offset estimate
+          (``ts - clock_offset_us``), so a failover reads MONOTONE:
+          the dead lane's spans end, the bridging ``router.replay``
+          span runs, the survivor lane's spans begin;
+        - ``process_name`` metadata labels every lane, and a final
+          ``fleet.stitch`` instant records per-replica offset / RTT /
+          source (live vs cache) — the trace describes its own
+          stitching."""
+        with self._lock:
+            snap = [(i, r, r.state, r.decommissioned,
+                     list(r.trace_cache), r.clock_offset_us,
+                     r.clock_rtt_us, r.cache_offset_us)
+                    for i, r in enumerate(self._replicas)]
+        events: List[Dict[str, Any]] = [
+            {"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "router"}},
+            {"name": "process_sort_index", "ph": "M", "pid": 0,
+             "args": {"sort_index": 0}},
+        ]
+        if hasattr(self.tracer, "events"):
+            for e in self.tracer.events():
+                e2 = dict(e)
+                e2["pid"] = 0
+                events.append(e2)
+        # live fetches (window + any missing clock measurement) run
+        # in PARALLEL: a frozen replica costs the stitch one timeout,
+        # not one per replica — this endpoint exists for incidents,
+        # which is exactly when a replica is likely to be sick
+        fetched: Dict[int, Tuple[List[Dict[str, Any]],
+                                 Optional[float], float]] = {}
+
+        def fetch(i: int, replica: _Replica,
+                  offset: Optional[float], rtt: float) -> None:
+            probe = self._replica_client(replica, read_timeout_s=5.0)
+            evts = None
+            with contextlib.suppress(GatewayError,
+                                     *RETRYABLE_ERRORS):
+                evts = probe.trace_events().get("traceEvents", [])
+            if evts is not None and offset is None:
+                # replica never completed a clock-bearing scrape
+                # (e.g. stitch requested before the first health
+                # tick): measure once, inline
+                with contextlib.suppress(GatewayError,
+                                         *RETRYABLE_ERRORS):
+                    t0 = self._now_us()
+                    payload = probe.healthz()
+                    t1 = self._now_us()
+                    if payload.get("now_us") is not None:
+                        offset = (float(payload["now_us"])
+                                  - (t0 + t1) / 2.0)
+                        rtt = t1 - t0
+            if evts is not None:
+                fetched[i] = (evts, offset, rtt)
+
+        threads = [
+            threading.Thread(
+                target=fetch, args=(i, replica, offset, rtt),
+                daemon=True, name=f"fleet-trace-{replica.replica_id}")
+            for i, replica, state, dec, _, offset, rtt, _c in snap
+            if not dec and state in ("live", "degraded", "draining")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=12.0)
+
+        stitch: List[Dict[str, Any]] = []
+        for (i, replica, state, dec, cache, offset, rtt,
+                cache_offset) in snap:
+            lane = i + 1
+            if i in fetched:
+                evts, offset, rtt = fetched[i]
+                source = "live"
+            else:
+                # cached events belong to the epoch the cache was
+                # scraped from: correct them with the offset
+                # snapshotted ALONGSIDE the cache, not the live
+                # estimate (which a death/restart may have reset)
+                evts, source = cache, "cache"
+                offset = cache_offset
+            dead = dec or state in ("dead", "half-open")
+            label = (f"replica {replica.replica_id}"
+                     + (" (dead)" if dead else ""))
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": lane, "args": {"name": label}})
+            events.append({"name": "process_sort_index", "ph": "M",
+                           "pid": lane,
+                           "args": {"sort_index": lane}})
+            for e in evts:
+                e2 = dict(e)
+                e2["pid"] = lane
+                if offset is not None and "ts" in e2:
+                    e2["ts"] = e2["ts"] - offset
+                events.append(e2)
+            stitch.append({
+                "replica_id": replica.replica_id,
+                "lane": lane, "state": state,
+                "decommissioned": dec, "source": source,
+                "events": len(evts),
+                "clock_offset_us": offset,
+                "clock_rtt_us": (None if rtt == float("inf")
+                                 else rtt),
+                "skew_corrected": offset is not None,
+            })
+        events.append({"name": "fleet.stitch", "ph": "i",
+                       "ts": self._now_us(), "pid": 0, "tid": 0,
+                       "s": "g", "args": {"replicas": stitch}})
+        return events
+
+    def _handle_fleet_trace(self, handler) -> None:
+        """``GET /v1/trace``: the stitched fleet trace, chunk-streamed
+        512 events at a time (``JsonHandler.send_trace_events`` — the
+        same framing as the gateway's trace export: one downloads a
+        replica, the other the fleet)."""
+        handler.send_trace_events(self.fleet_trace_events())
+
+    def _handle_request_trace(self, handler, path: str) -> None:
+        """``GET /v1/requests/<id>/trace`` (ISSUE 10 satellite):
+        resolve the request's owning replica through the journal and
+        PROXY its flight-recorder trace — the router id maps to the
+        replica-side id the journal recorded. When the owner is dead
+        or has evicted the record, answer with the journal's own
+        breadcrumbs (routing/replay history + the streamed high-water
+        mark) and a ``replayed_to`` pointer instead of a blind 404:
+        the router watched every attempt, so it always has SOMETHING
+        true to say about a request it journaled."""
+        tail = path[len("/v1/requests/"):-len("/trace")]
+        try:
+            rid = int(tail)
+        except ValueError:
+            handler.send_json({"error": f"bad request id {tail!r}"},
+                              400, close=True)
+            return
+        with self._lock:
+            entry = self._journal.get(rid)
+            if entry is None:
+                addr = rrid = replica = None
+            else:
+                addr, rrid = entry.replica_address, entry.replica_rid
+                replica = next(
+                    (r for r in self._replicas if r.address == addr),
+                    None)
+                reachable = (replica is not None
+                             and not replica.decommissioned
+                             and replica.state in ("live", "degraded",
+                                                   "draining"))
+                router_info = {
+                    "trace": entry.trace,
+                    "replays": entry.replays,
+                    "tokens_high_water": len(entry.tokens),
+                    "finish_reason": (entry.result or {}).get(
+                        "finish_reason"),
+                    "e2e_s": (round(entry.done_t - entry.submit_t, 6)
+                              if entry.done_t is not None else None),
+                    "history": [list(h) for h in entry.history],
+                }
+        if entry is None:
+            handler.send_json({"error": f"unknown request {rid}"},
+                              404, close=True)
+            return
+        replayed_to = (replica.replica_id
+                       if entry.replays and replica is not None
+                       else None)
+        if reachable and rrid is not None:
+            try:
+                out = GatewayClient(
+                    addr,
+                    connect_timeout_s=self.replica_connect_timeout_s,
+                    read_timeout_s=5.0).trace(rrid)
+                status = 202 if out.get("running") else 200
+                out = dict(out)
+                out["id"] = rid
+                out["replica_id"] = replica.replica_id
+                out["replica_rid"] = rrid
+                if replayed_to:
+                    out["replayed_to"] = replayed_to
+                out["router"] = router_info
+                handler.send_json(out, status, close=True)
+                return
+            except (GatewayError, *RETRYABLE_ERRORS):
+                pass  # owner died / evicted: journal breadcrumbs
+        handler.send_json({
+            "id": rid, "source": "journal",
+            "replayed_to": replayed_to,
+            "owner": (replica.replica_id if replica is not None
+                      else None),
+            "owner_reachable": bool(rrid is not None and replica
+                                    is not None and reachable),
+            "router": router_info,
+        }, 200, close=True)
+
     def drain_replica(self, replica_id: str,
                       timeout_s: Optional[float] = None
                       ) -> Dict[str, Any]:
@@ -1119,6 +1735,7 @@ class ServingRouter:
             if not matches:
                 raise KeyError(f"unknown replica {replica_id!r}")
             replica = matches[0]
+            self._breaker_instant(replica, replica.state, "draining")
             replica.state = "draining"
             handed_off = [e.rid for e in self._journal.values()
                           if not e.done.is_set()
@@ -1131,6 +1748,7 @@ class ServingRouter:
             self._note_failure(replica)
             summary = {"drained": False, "error": repr(e)}
         with self._lock:
+            self._breaker_instant(replica, replica.state, "dead")
             replica.state = "dead"
             replica.decommissioned = True
             self.stats["drained_replicas"] += 1

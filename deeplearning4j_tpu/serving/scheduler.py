@@ -43,6 +43,14 @@ class Request:
     id: Optional[int] = None
     deadline_s: Optional[float] = None
     queue_timeout_s: Optional[float] = None
+    #: fleet-level trace context (ISSUE 10): an opaque
+    #: ``<trace_id>/<span_id>`` string minted by an upstream tier
+    #: (the router's journaled request id + per-attempt span id) and
+    #: carried through the engine so every span, flight-recorder
+    #: record, and ``serving.request_done`` instant this request
+    #: produces is stitchable into one cross-process trace. Pure
+    #: host metadata — never touches device work, RNG, or ids.
+    trace: Optional[str] = None
 
     def __post_init__(self):
         if len(self.prompt) == 0:
@@ -108,6 +116,11 @@ class GenerationResult:
     #: attribution guarantees the phase sums never exceed ``e2e_s``.
     #: None when timing was off or the engine predates the request.
     timing: Optional[Dict[str, Any]] = None
+    #: the fleet trace context the request carried in (ISSUE 10) —
+    #: echoed on the terminal so an upstream tier can correlate the
+    #: result with the stitched cross-process trace. None for
+    #: requests submitted without one.
+    trace: Optional[str] = None
 
 
 class Scheduler:

@@ -27,6 +27,14 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
+#: Dapper-style trace-context carrier (ISSUE 10): the router mints
+#: ``<trace_id>/<span_id>`` per request attempt and every hop
+#: (router → gateway → engine) forwards it, so one fleet-level id
+#: stitches a request's spans across processes. One definition here —
+#: the client sends it, every JSON service reads it — so the wire
+#: name can never drift between the two sides.
+TRACE_HEADER = "X-DL4J-Trace"
+
 
 class JsonHandler(BaseHTTPRequestHandler):
     """Request handler base: JSON body parsing + JSON/bytes replies +
@@ -43,6 +51,16 @@ class JsonHandler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt: str, *args: Any) -> None:  # silence
         pass
+
+    def trace_context(self) -> Optional[str]:
+        """The request's :data:`TRACE_HEADER` value (None when the
+        caller sent no trace context). Bounded: a hostile header
+        cannot grow server-side bookkeeping past 256 chars."""
+        value = self.headers.get(TRACE_HEADER)
+        if value is None:
+            return None
+        value = value.strip()
+        return value[:256] or None
 
     def read_json(self) -> Dict[str, Any]:
         n = int(self.headers.get("Content-Length", 0))
@@ -113,6 +131,32 @@ class JsonHandler(BaseHTTPRequestHandler):
         if self._stream_chunked:
             self.wfile.write(b"0\r\n\r\n")
         self.wfile.flush()
+
+    def send_trace_events(self, events, next_seq=None) -> None:
+        """Stream a Chrome trace-event document in 512-event chunks
+        (one wire format for every trace export: the gateway's
+        ``/v1/trace`` and the router's stitched fleet variant must
+        never drift). A large window never materializes as one giant
+        bytes object; ``next_seq`` prefixes the incremental-scrape
+        cursor (ISSUE 10). A vanished client is swallowed — there is
+        nothing to release on a read-only export."""
+        try:
+            self.start_stream("application/json")
+            if next_seq is not None:
+                self.send_chunk(b'{"nextSeq":%d,"traceEvents":['
+                                % int(next_seq))
+            else:
+                self.send_chunk(b'{"traceEvents":[')
+            for lo in range(0, len(events), 512):
+                piece = ",".join(json.dumps(e)
+                                 for e in events[lo:lo + 512])
+                if lo:
+                    piece = "," + piece
+                self.send_chunk(piece.encode())
+            self.send_chunk(b"]}")
+            self.end_stream()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
 
     # -- SSE framing (one definition for every streaming service:
     # the gateway and the router must never drift on the wire format)

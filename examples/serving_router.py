@@ -17,6 +17,12 @@ and fronts them with the
 3. **Replica state machine** — the router's `/v1/healthz` shows the
    breaker opening on the dead replica (live → dead) while the
    survivor keeps serving.
+4. **Fleet-wide tracing (ISSUE 10)** — the failover request's
+   STITCHED cross-replica timeline from the router's `GET /v1/trace`
+   (the dead replica's spans from the router's trace cache, the
+   survivor's live, both skew-corrected onto the router clock, with
+   the bridging `router.replay` span), and fleet p50/p99 TTFT from
+   `GET /v1/fleet/metrics` (replica histograms merged bucket-wise).
 
 Run: python examples/serving_router.py
 """
@@ -74,7 +80,7 @@ def main():
         orig = engine.step
 
         def throttled(sink=None):
-            time.sleep(0.02)
+            time.sleep(0.06)
             return orig(sink)
 
         engine.step = throttled
@@ -85,7 +91,8 @@ def main():
     router = ServingRouter(
         [g.address for g in replicas], affinity_block_tokens=4,
         health_interval_s=0.1, probe_interval_s=0.5,
-        failure_threshold=2).start()
+        metrics_every=1,  # scrape the trace cache every tick, so the
+        failure_threshold=2).start()  # kill can't outrun the cache
     client = RouterClient(router.address)
     print(f"router on {router.address} over "
           f"{[g.replica_id for g in replicas]}")
@@ -122,6 +129,9 @@ def main():
                               str(g._service.port)))
             print(f"stream {s.id} on {killed.replica_id}: "
                   f"got {got} — KILLING {killed.replica_id}")
+            # one trace-cache scrape captures the victim's spans so
+            # the dead lane of the stitched trace is populated
+            time.sleep(0.12)
             killed.hard_kill()
         else:
             print(f"  += {delta}")
@@ -144,6 +154,51 @@ def main():
     audit = router.journal_audit()
     print(f"journal  : {audit['entries']} entries, "
           f"lost={audit['lost']}, replayed={audit['replayed']}")
+
+    # 4. fleet tracing: the failover request as ONE timeline spanning
+    # both replicas' lanes (stitched /v1/trace), then fleet-wide
+    # latency quantiles from the federated /v1/fleet/metrics
+    tid = s.result["trace"]
+    doc = client.trace_events()   # against a router: the STITCH
+    lane_names = {e["pid"]: e["args"]["name"]
+                  for e in doc["traceEvents"]
+                  if e.get("name") == "process_name"}
+
+    def of_trace(e):
+        a = e.get("args") or {}
+        vals = [a.get("trace")] + list((a.get("traces")
+                                        or {}).values())
+        return any(v == tid or str(v).startswith(tid + "/")
+                   for v in vals if v)
+
+    timeline = sorted(
+        (e for e in doc["traceEvents"]
+         if of_trace(e) and e.get("ph") == "X"),
+        key=lambda e: e["ts"])
+    t0_us = timeline[0]["ts"]
+    print(f"timeline : request {s.id} (trace {tid}) across "
+          f"{len({e['pid'] for e in timeline})} processes:")
+    for e in timeline:
+        print(f"           +{(e['ts'] - t0_us) / 1e3:8.1f}ms "
+              f"{e.get('dur', 0) / 1e3:7.1f}ms  "
+              f"{lane_names.get(e['pid'], e['pid']):<22} {e['name']}")
+    replay = next(e for e in timeline
+                  if e["name"] == "router.replay")
+    print(f"           the router.replay span bridges the lanes: "
+          f"{replay['args']['from_replica']} -> survivor, "
+          f"high-water {replay['args']['high_water']} tokens, "
+          f"overlap_ok={replay['args']['overlap_ok']}")
+
+    from scripts.latency_report import fleet_report
+
+    fleet = {r["phase"]: r
+             for r in fleet_report(client.fleet_metrics())["fleet"]}
+    ttft = fleet["ttft"]
+    print(f"fleet    : p50 TTFT {ttft['p50_ms']:.0f}ms, "
+          f"p99 TTFT {ttft['p99_ms']:.0f}ms over "
+          f"{ttft['count']} requests on both replicas; "
+          f"replay gap p50 "
+          f"{fleet['replay_gap']['p50_ms']:.0f}ms")
 
     router.close()
     for g in replicas:

@@ -28,11 +28,23 @@ Two sources, same table:
   carry the phase breakdown; a fused K-step window contributes K
   per-step samples (window value / steps, K times).
 
+**Fleet mode** (``--fleet``, ISSUE 10): point it at a
+:class:`~deeplearning4j_tpu.serving.ServingRouter` base URL (or a
+saved ``/v1/fleet/metrics`` text file) and it reads the FEDERATED
+exposition — fleet-wide histogram families (replica families merged
+bucket-wise by the router) AND the per-replica
+``{replica="<id>"}``-labeled copies — reporting p50/p90/p99
+TTFT/ITL/e2e both fleet-wide and per replica, plus the
+``replay_gap`` row (``router_replay_gap_s``: stream-break to first
+post-replay token — the latency a failover actually added).
+
 Usage::
 
     python scripts/latency_report.py trace.json
     python scripts/latency_report.py http://127.0.0.1:8000
     python scripts/latency_report.py http://127.0.0.1:9000/train/metrics
+    python scripts/latency_report.py --fleet http://127.0.0.1:8800
+    python scripts/latency_report.py --fleet --json fleet_metrics.txt
 """
 
 from __future__ import annotations
@@ -72,6 +84,15 @@ _BUCKET_RE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{le="([^"]+)"\}\s+(\d+)\s*$')
 _SCALAR_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)_(sum|count)\s+(\S+)\s*$")
+#: the federated exposition's per-replica samples (ISSUE 10): same
+#: families, ``replica`` label first, ``le`` last — exactly as
+#: ``Tracer.merge_prometheus`` emits them.
+_FLEET_BUCKET_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{replica="([^"]*)",'
+    r'le="([^"]+)"\}\s+(\d+)\s*$')
+_FLEET_SCALAR_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)_(sum|count)\{replica="([^"]*)"\}'
+    r"\s+(\S+)\s*$")
 
 
 def parse_prometheus_histograms(
@@ -132,12 +153,46 @@ def _exact_quantile(values: List[float], q: float) -> float:
     return ordered[idx]
 
 
-def report_from_metrics_text(text: str) -> List[Dict[str, object]]:
-    """Table rows from a metrics scrape (live mode): serving and/or
-    training histogram families, whichever the text carries."""
-    hists = parse_prometheus_histograms(text)
+def parse_fleet_histograms(
+        text: str) -> Dict[str, Dict[str, Dict[str, object]]]:
+    """The per-replica half of a federated scrape:
+    ``{replica_id: {family: {"buckets": [(le, cum)], "sum", "count"}}}``
+    from the ``{replica="<id>", le="..."}``-labeled samples
+    ``Tracer.merge_prometheus`` emits next to each merged fleet
+    family."""
+    out: Dict[str, Dict[str, Dict[str, object]]] = {}
+
+    def entry(rid: str, name: str) -> Dict[str, object]:
+        return out.setdefault(rid, {}).setdefault(
+            name, {"buckets": [], "sum": 0.0, "count": 0})
+
+    for line in text.splitlines():
+        m = _FLEET_BUCKET_RE.match(line)
+        if m:
+            name, rid, le, cum = m.groups()
+            bound = math.inf if le == "+Inf" else float(le)
+            entry(rid, name)["buckets"].append((bound, int(cum)))
+            continue
+        m = _FLEET_SCALAR_RE.match(line)
+        if m:
+            name, kind, rid, value = m.groups()
+            if name in out.get(rid, {}):
+                entry(rid, name)[kind] = (
+                    float(value) if kind == "sum" else
+                    int(float(value)))
+    return {rid: {n: h for n, h in fams.items() if h["buckets"]}
+            for rid, fams in out.items()}
+
+
+#: fleet-scope rows: the serving families plus the router's
+#: replay-added-latency histogram (ISSUE 10)
+FLEET_ROWS = LIVE_ROWS + (("router_replay_gap_s", "replay_gap"),)
+
+
+def _rows_of(hists: Dict[str, Dict[str, object]],
+             row_spec) -> List[Dict[str, object]]:
     rows = []
-    for track, label in LIVE_ROWS + TRAIN_LIVE_ROWS:
+    for track, label in row_spec:
         h = hists.get(track)
         if h is None:
             continue
@@ -149,6 +204,27 @@ def report_from_metrics_text(text: str) -> List[Dict[str, object]]:
                for q in QUANTILES},
         })
     return rows
+
+
+def fleet_report(text: str) -> Dict[str, object]:
+    """``--fleet`` rows from one federated exposition: the merged
+    (unlabeled) families become the ``"fleet"`` table, the
+    ``{replica=...}``-labeled copies one table per replica."""
+    fleet_rows = _rows_of(parse_prometheus_histograms(text),
+                          FLEET_ROWS)
+    replicas = {
+        rid: _rows_of(fams, LIVE_ROWS)
+        for rid, fams in sorted(parse_fleet_histograms(text).items())}
+    return {"fleet": fleet_rows,
+            "replicas": {rid: rows for rid, rows in replicas.items()
+                         if rows}}
+
+
+def report_from_metrics_text(text: str) -> List[Dict[str, object]]:
+    """Table rows from a metrics scrape (live mode): serving and/or
+    training histogram families, whichever the text carries."""
+    return _rows_of(parse_prometheus_histograms(text),
+                    LIVE_ROWS + TRAIN_LIVE_ROWS)
 
 
 def report_from_events(events) -> List[Dict[str, object]]:
@@ -253,14 +329,49 @@ def run_report(source: str) -> List[Dict[str, object]]:
     return report_from_events(events)
 
 
+def run_fleet_report(source: str) -> Dict[str, object]:
+    """``--fleet`` rows for one source: a router base URL (scraped at
+    ``/v1/fleet/metrics``), a full federated-metrics URL, or a saved
+    federated exposition text file."""
+    if source.startswith(("http://", "https://")):
+        base = source.rstrip("/")
+        if not base.endswith("/metrics"):
+            base = base + "/v1/fleet/metrics"
+        return fleet_report(_scrape(base))
+    with open(source) as f:
+        return fleet_report(f.read())
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("source",
                     help="saved Chrome trace path, or gateway base "
-                         "URL (http://host:port)")
+                         "URL (http://host:port); with --fleet, a "
+                         "router base URL or saved federated-metrics "
+                         "text")
     ap.add_argument("--json", action="store_true",
                     help="emit the rows as JSON instead of a table")
+    ap.add_argument("--fleet", action="store_true",
+                    help="federated mode (ISSUE 10): read a router's "
+                         "/v1/fleet/metrics and report fleet-wide "
+                         "AND per-replica quantiles, plus the "
+                         "replay-gap row")
     args = ap.parse_args(argv)
+    if args.fleet:
+        report = run_fleet_report(args.source)
+        if not report["fleet"] and not report["replicas"]:
+            print(f"no fleet latency data found in {args.source}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(render(report["fleet"],
+                         f"{args.source} (fleet-wide)"))
+            for rid, rows in report["replicas"].items():
+                print()
+                print(render(rows, f"replica {rid}"))
+        return 0
     rows = run_report(args.source)
     if not rows:
         print("no serving or training latency data found in "

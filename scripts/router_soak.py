@@ -26,7 +26,16 @@ Pass criteria:
   redrawn continuation;
 - **zero leaked threads/sockets**: after the router and clients are
   gone the process is back to its baseline thread count and (full
-  mode) its baseline fd count.
+  mode) its baseline fd count;
+- **fleet observability under churn** (ISSUE 10): ``/v1/trace`` and
+  ``/v1/fleet/metrics`` answer with zero 5xx throughout the
+  kill/drain churn; every terminal request's
+  ``/v1/requests/<id>/trace`` parses with engine phase sums <= e2e
+  across the stitch; the STITCHED fleet trace shows a replayed
+  request's spans on BOTH the dead and the survivor replica's lanes,
+  monotone after skew correction, with the bridging ``router.replay``
+  span; and ``latency_report``'s ``--fleet`` rows carry fleet
+  TTFT/ITL plus a populated ``router_replay_gap_s``.
 
 Two modes:
 
@@ -47,6 +56,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import http.client
 import os
 import signal
 import socket
@@ -277,9 +287,46 @@ def run_soak(n_clients: int = 24, n_replicas: int = 3, seed: int = 0,
     router = ServingRouter(
         [r.address for r in replicas], affinity_block_tokens=4,
         health_interval_s=0.1, probe_interval_s=0.5,
+        # metrics (and trace-cache) scrape every tick: the victim's
+        # pre-kill spans must be in the router's cache when the
+        # SIGKILL lands, or the dead lane of the stitched trace
+        # would be empty (ISSUE 10 acceptance)
+        metrics_every=1,
         failure_threshold=2).start()
     client = RouterClient(router.address, timeout_s=240.0)
     t0 = time.perf_counter()
+
+    # -- fleet-endpoint churn scraper (ISSUE 10 satellite): /v1/trace
+    # and /v1/fleet/metrics must answer without a single 5xx while
+    # replicas are being killed and drained under live traffic -------
+    scrape_stop = threading.Event()
+    endpoint_5xx: List[str] = []
+    endpoint_hits = {"/v1/trace": 0, "/v1/fleet/metrics": 0}
+
+    def scrape_endpoints() -> None:
+        host, port = router._service.host, router._service.port
+        while not scrape_stop.is_set():
+            for path in ("/v1/trace", "/v1/fleet/metrics"):
+                try:
+                    conn = http.client.HTTPConnection(host, port,
+                                                      timeout=30)
+                    conn.request("GET", path)
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status >= 500:
+                        endpoint_5xx.append(
+                            f"{path} -> {resp.status}")
+                    endpoint_hits[path] += 1
+                    conn.close()
+                except OSError:
+                    # the scrape itself raced a socket teardown; the
+                    # gate is about SERVER-side 5xx, not client luck
+                    pass
+            scrape_stop.wait(0.1)
+
+    scraper = threading.Thread(target=scrape_endpoints,
+                               name="router-soak-scraper")
+    scraper.start()
 
     outcomes: Dict[int, Dict[str, Any]] = {}
     rid_of: Dict[int, int] = {}
@@ -408,6 +455,120 @@ def run_soak(n_clients: int = 24, n_replicas: int = 3, seed: int = 0,
         "no COMPLETED stream ever survived a replay — the chaos "
         "never actually exercised failover")
 
+    # -- fleet observability gates (ISSUE 10) --------------------------
+    scrape_stop.set()
+    scraper.join(timeout=60)
+    assert not endpoint_5xx, (
+        f"fleet endpoints 5xx under churn: {endpoint_5xx[:5]}")
+    assert min(endpoint_hits.values()) >= 1, endpoint_hits
+
+    # every terminal request's fleet trace parses, with the engine's
+    # phase sums <= e2e ACROSS THE STITCH (the proxied flight record's
+    # own e2e, and that attempt's e2e inside the router's journal e2e)
+    traces_proxied = traces_journal = 0
+    for i in outcomes:
+        resp = client.trace(rid_of[i])
+        assert resp.get("id") == rid_of[i], resp
+        router_info = resp.get("router") or {}
+        timing = resp.get("timing")
+        if timing is not None:
+            traces_proxied += 1
+            phase_sum = sum(timing.get(k, 0.0) or 0.0
+                            for k in ("queue_wait_s", "admission_s",
+                                      "decode_s", "verify_s",
+                                      "stall_s"))
+            assert phase_sum <= timing["e2e_s"] + 0.05, (
+                f"request {rid_of[i]}: phase sum {phase_sum:.3f} > "
+                f"e2e {timing['e2e_s']:.3f}")
+            if router_info.get("e2e_s") is not None:
+                assert (timing["e2e_s"]
+                        <= router_info["e2e_s"] + 0.25), (
+                    f"request {rid_of[i]}: replica-attempt e2e "
+                    f"{timing['e2e_s']:.3f} exceeds the router's "
+                    f"journal e2e {router_info['e2e_s']:.3f}")
+        else:
+            traces_journal += 1
+            assert router_info.get("history"), resp
+            if (outcomes[i].get("final") or {}).get("replays"):
+                assert resp.get("replayed_to"), (
+                    f"replayed request {rid_of[i]} breadcrumbs lack "
+                    f"a replayed_to pointer: {resp}")
+
+    # the STITCHED trace: a replayed-and-completed request's spans
+    # must appear on two replica lanes — the dead owner's (from the
+    # router's cache) and the survivor's — monotone after skew
+    # correction, with the router.replay span bridging the gap
+    doc = client.trace_events()
+    events = doc["traceEvents"]
+    stitch = next(e for e in events
+                  if e.get("name") == "fleet.stitch")["args"]
+    assert all(r["skew_corrected"] for r in stitch["replicas"]), (
+        f"uncorrected lanes in the stitch: {stitch}")
+
+    def spans_of(tid):
+        lanes: Dict[int, List[Dict[str, Any]]] = {}
+        for e in events:
+            a = e.get("args") or {}
+            vals = [a.get("trace")] + list((a.get("traces")
+                                            or {}).values())
+            if not any(v == tid or str(v).startswith(tid + "/")
+                       for v in vals if v):
+                continue
+            if str(e.get("name", "")).startswith("serving."):
+                lanes.setdefault(e["pid"], []).append(e)
+        return lanes
+
+    bridged = None
+    for i, out in outcomes.items():
+        final = out.get("final") or {}
+        if (out["result"] in ("length", "eos")
+                and final.get("replays") and final.get("trace")):
+            lanes = spans_of(final["trace"])
+            if len(lanes) >= 2:
+                bridged = (i, final["trace"], lanes)
+                break
+    assert bridged is not None, (
+        "no replayed request's spans landed on two replica lanes — "
+        "the dead lane's cache missed the victim's spans")
+    _, victim_tid, lanes = bridged
+    replay_spans = [e for e in events
+                    if e.get("name") == "router.replay"
+                    and (e.get("args") or {}).get("trace")
+                    == victim_tid]
+    assert replay_spans, f"no router.replay span for {victim_tid}"
+    # order the two lanes by their span midpoints: the earlier lane
+    # is the dead owner's chapter, the later the survivor's
+    eps_us = 50e3
+    by_end = sorted(lanes, key=lambda p: max(
+        e["ts"] + e.get("dur", 0) for e in lanes[p]))
+    first_end = max(e["ts"] + e.get("dur", 0)
+                    for e in lanes[by_end[0]])
+    second_start = min(e["ts"] for e in lanes[by_end[1]])
+    assert second_start > first_end - eps_us, (
+        f"stitched lanes overlap beyond skew tolerance: first lane "
+        f"ends {first_end:.0f}us, second starts {second_start:.0f}us")
+    bridge = replay_spans[0]
+    assert bridge["ts"] >= first_end - eps_us, (
+        "router.replay starts before the dead lane ended")
+    assert bridge["ts"] <= second_start + eps_us, (
+        "router.replay starts after the survivor lane began")
+    assert bridge["ts"] + bridge["dur"] >= second_start - eps_us, (
+        "router.replay ends before the survivor lane began — it "
+        "does not bridge the gap")
+    assert bridge["args"].get("overlap_ok") is True
+
+    # latency_report --fleet over the SAME run: fleet TTFT/ITL rows
+    # plus a populated replay-gap histogram
+    from scripts.latency_report import fleet_report
+
+    fleet = fleet_report(client.fleet_metrics())
+    fleet_phases = {r["phase"]: r for r in fleet["fleet"]}
+    assert all(k in fleet_phases for k in ("ttft", "itl", "e2e")), (
+        f"fleet report missing latency rows: {fleet_phases.keys()}")
+    assert "replay_gap" in fleet_phases, fleet_phases.keys()
+    assert fleet_phases["replay_gap"]["count"] >= 1
+    assert fleet["replicas"], "no per-replica tables in --fleet mode"
+
     router.close()
     for r in replicas:
         r.shutdown()
@@ -452,6 +613,15 @@ def run_soak(n_clients: int = 24, n_replicas: int = 3, seed: int = 0,
         "router_stats": dict(router.stats),
         "leaked_threads": max(leaked, 0),
         "leaked_fds": max(leaked_fds, 0),
+        "endpoint_scrapes": dict(endpoint_hits),
+        "endpoint_5xx": len(endpoint_5xx),
+        "request_traces_proxied": traces_proxied,
+        "request_traces_from_journal": traces_journal,
+        "stitched_failover_trace": victim_tid,
+        "fleet_replay_gap_count":
+            fleet_phases["replay_gap"]["count"],
+        "fleet_p99_ttft_ms":
+            round(fleet_phases["ttft"]["p99_ms"], 3),
     }
     if verbose:
         for k, v in summary.items():

@@ -1,0 +1,517 @@
+"""Fleet-wide distributed tracing + federated metrics (ISSUE 10).
+
+Three layers under test:
+
+- the federation PRIMITIVES: ``Histogram.merge`` (bucket-wise
+  addition closed under identical bounds, ``ValueError`` on
+  mismatch) and ``Tracer.merge_prometheus`` (histograms merged +
+  per-replica labeled, counters summed, gauges ``replica``-labeled so
+  same-named families can no longer collide after sanitization);
+- trace-context PROPAGATION: a ``Request.trace`` stamped at submit
+  surfaces on every engine span, the flight-recorder record, the
+  ``serving.request_done`` instant, and the terminal result — through
+  the engine directly, and over HTTP via the gateway's
+  ``X-DL4J-Trace`` header / JSON ``trace`` field;
+- the ROUTER's stitching layer: minted trace ids on routed requests,
+  ``GET /v1/trace`` emitting one multi-lane skew-corrected Perfetto
+  document, ``GET /v1/fleet/metrics`` federating replicas, and the
+  ``GET /v1/requests/<id>/trace`` proxy (journal breadcrumbs +
+  ``replayed_to`` when the owner is gone).
+"""
+
+import contextlib
+import json
+import math
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.profiler.tracer import (
+    Histogram,
+    Tracer,
+    parse_exposition,
+)
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    GatewayClient,
+    Request,
+    RouterClient,
+    ServingGateway,
+    ServingRouter,
+)
+
+VOCAB = 10
+
+
+@pytest.fixture(scope="module")
+def tiny_net():
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    return MultiLayerNetwork(transformer_lm(
+        n_in=VOCAB, width=16, n_layers=1, n_heads=2,
+        n_classes=VOCAB, seed=7)).init()
+
+
+# ---------------------------------------------------------------------------
+# Histogram.merge (ISSUE 10 satellite: the federation primitive)
+# ---------------------------------------------------------------------------
+
+class TestHistogramMerge:
+    def test_bucketwise_addition_exact(self):
+        a, b = Histogram(), Histogram()
+        for v in (2e-4, 3e-3, 0.04, 0.5, 7.0):
+            a.observe(v)
+        for v in (2e-4, 0.04, 11.0, 250.0):  # 250 -> +Inf bucket
+            b.observe(v, n=2)
+        ca = a.snapshot()[0]
+        cb = b.snapshot()[0]
+        a.merge(b)
+        counts, total_sum, total = a.snapshot()
+        assert counts == [x + y for x, y in zip(ca, cb)]
+        assert total == 5 + 8
+        assert total_sum == pytest.approx(
+            (2e-4 + 3e-3 + 0.04 + 0.5 + 7.0)
+            + 2 * (2e-4 + 0.04 + 11.0 + 250.0))
+
+    def test_inf_and_count_invariants_preserved(self):
+        a, b = Histogram(), Histogram()
+        a.observe(1e9)   # above the top bound -> +Inf
+        b.observe(1e9, n=3)
+        b.observe(0.01)
+        a.merge(b)
+        counts, _, total = a.snapshot()
+        assert counts[-1] == 4          # +Inf bucket adds
+        assert total == 5
+        # exposition keeps cumulative monotone and +Inf == count
+        lines = a.prometheus_lines("m")
+        cums = [int(line.rsplit(" ", 1)[1]) for line in lines
+                if "_bucket" in line]
+        assert cums == sorted(cums)
+        assert cums[-1] == total
+
+    def test_mismatched_bounds_value_error(self):
+        a = Histogram()
+        b = Histogram(bounds=[0.1, 1.0, 10.0])
+        with pytest.raises(ValueError, match="bound mismatch"):
+            a.merge(b)
+        # and the failed merge changed NOTHING
+        assert a.count == 0
+        with pytest.raises(TypeError):
+            a.merge("not a histogram")
+
+    def test_merged_quantile_within_one_bucket_width(self):
+        # pooled exact distribution vs quantile of the merged pair:
+        # the estimate must stay within the winning bucket's width
+        rng = np.random.default_rng(0)
+        xs = list(10.0 ** rng.uniform(-3.5, 1.5, 400))
+        ys = list(10.0 ** rng.uniform(-2.5, 0.5, 300))
+        a, b = Histogram(), Histogram()
+        for v in xs:
+            a.observe(v)
+        for v in ys:
+            b.observe(v)
+        a.merge(b)
+        pooled = sorted(xs + ys)
+        for q in (0.1, 0.5, 0.9, 0.99):
+            est = a.quantile(q)
+            exact = pooled[min(len(pooled) - 1,
+                               int(q * len(pooled)))]
+            i = 0
+            while (i < len(a.bounds) and a.bounds[i] < est
+                   and not math.isclose(a.bounds[i], est)):
+                i += 1
+            lo = a.bounds[i - 1] if i > 0 else 0.0
+            hi = a.bounds[min(i, len(a.bounds) - 1)]
+            width = hi - lo
+            assert abs(est - exact) <= width + 1e-12, (
+                f"q={q}: estimate {est} vs exact {exact} "
+                f"(bucket width {width})")
+
+
+# ---------------------------------------------------------------------------
+# Tracer.merge_prometheus (federation semantics)
+# ---------------------------------------------------------------------------
+
+class TestMergePrometheus:
+    def _tracer(self, ttfts, shed, depth):
+        t = Tracer()
+        for v in ttfts:
+            t.observe("serving_ttft_s", v)
+        t.describe("serving_ttft_s", "ttft help")
+        t.incr("serving_shed", shed)
+        t.gauge("serving_gateway_queue_depth", depth)
+        return t
+
+    def test_histograms_merge_counters_sum_gauges_label(self):
+        t0 = self._tracer([0.01, 0.02], shed=1, depth=3)
+        t1 = self._tracer([0.04], shed=2, depth=5)
+        out = Tracer.merge_prometheus(
+            {"rep-0": t0.prometheus_text(),
+             "rep-1": t1.prometheus_text()})
+        parsed = parse_exposition(out)
+        # fleet histogram = bucket-wise sum of both replicas
+        assert parsed["histograms"]["serving_ttft_s"]["count"] == 3
+        assert parsed["histograms"]["serving_ttft_s"]["sum"] == \
+            pytest.approx(0.07)
+        # counters summed into ONE unlabeled sample
+        assert parsed["scalars"]["serving_shed"] == 3
+        assert parsed["types"]["serving_shed"] == "counter"
+        # gauges labeled per replica — NOT last-writer-wins
+        assert ('serving_gateway_queue_depth{replica="rep-0"} 3'
+                in out)
+        assert ('serving_gateway_queue_depth{replica="rep-1"} 5'
+                in out)
+        assert "\nserving_gateway_queue_depth 5" not in out
+        # per-replica labeled histogram copies ride along
+        assert 'serving_ttft_s_count{replica="rep-0"} 2' in out
+        assert 'serving_ttft_s_count{replica="rep-1"} 1' in out
+        # HELP survives federation
+        assert "# HELP serving_ttft_s ttft help" in out
+
+    def test_sanitize_collision_resolved_by_labels(self):
+        # the ISSUE 10 satellite fix: two replicas exporting gauges
+        # whose names sanitize identically used to collapse to one
+        # last-writer-wins sample; with replica labels both survive
+        t0, t1 = Tracer(), Tracer()
+        t0.gauge("queue depth", 1.0)   # sanitizes to queue_depth
+        t1.gauge("queue-depth", 2.0)   # sanitizes to queue_depth
+        out = Tracer.merge_prometheus(
+            {"a": t0.prometheus_text(), "b": t1.prometheus_text()})
+        assert 'queue_depth{replica="a"} 1' in out
+        assert 'queue_depth{replica="b"} 2' in out
+
+    def test_bound_mismatch_rejected(self):
+        t0, t1 = Tracer(), Tracer()
+        t0.observe("h", 0.5)
+        t1.observe("h", 0.5, bounds=[0.1, 1.0])
+        with pytest.raises(ValueError, match="mismatch"):
+            Tracer.merge_prometheus(
+                {"a": t0.prometheus_text(),
+                 "b": t1.prometheus_text()})
+
+    def test_quantiles_survive_the_round_trip(self):
+        # scrape -> federate -> report parses the merged family to
+        # the same quantiles the pooled histogram answers in-process
+        from scripts.latency_report import (
+            histogram_quantile,
+            parse_prometheus_histograms,
+        )
+
+        rng = np.random.default_rng(1)
+        pooled = Histogram()
+        tracers = {}
+        for rid in ("rep-0", "rep-1", "rep-2"):
+            t = Tracer()
+            for v in 10.0 ** rng.uniform(-3, 1, 200):
+                t.observe("serving_e2e_s", v)
+                pooled.observe(v)
+            tracers[rid] = t.prometheus_text()
+        merged = Tracer.merge_prometheus(tracers)
+        fams = parse_prometheus_histograms(merged)
+        for q in (0.5, 0.99):
+            # the exposition renders bounds at 6 significant digits,
+            # so the round-trip agrees to that precision
+            assert histogram_quantile(
+                fams["serving_e2e_s"]["buckets"], q) == \
+                pytest.approx(pooled.quantile(q), rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# trace-context propagation: engine, then gateway over HTTP
+# ---------------------------------------------------------------------------
+
+class TestTracePropagation:
+    def test_engine_stamps_spans_recorder_and_result(self, tiny_net):
+        tracer = Tracer()
+        eng = DecodeEngine(tiny_net, n_slots=2, decode_chunk=2,
+                           tracer=tracer)
+        rid = eng.submit(Request([1, 2, 3], 5, trace="r9/a0"))
+        plain = eng.submit(Request([4, 5], 4))  # untraced neighbour
+        res = eng.run()
+        assert res[rid].trace == "r9/a0"
+        assert res[plain].trace is None
+        rec = eng.request_trace(rid)
+        assert rec["trace"] == "r9/a0"
+        assert "trace" not in (eng.request_trace(plain) or {})
+        names = set()
+        for e in tracer.events():
+            args = e.get("args") or {}
+            if (args.get("trace") == "r9/a0"
+                    or "r9/a0" in (args.get("traces")
+                                   or {}).values()):
+                names.add(e["name"])
+        assert "serving.prefill" in names or "serving.admit" in names
+        assert "serving.decode_chunk" in names
+        assert "serving.request_done" in names
+        # the batched decode span maps rid -> trace for traced slots
+        chunk = next(e for e in tracer.events()
+                     if e["name"] == "serving.decode_chunk")
+        assert chunk["args"]["traces"] == {str(rid): "r9/a0"}
+
+    def test_trace_rides_snapshot_restore(self, tiny_net):
+        eng = DecodeEngine(tiny_net, n_slots=2, decode_chunk=2)
+        rid = eng.submit(Request([1, 2, 3], 6, trace="r4/a1"))
+        eng.step()  # admit + first rounds
+        snap = eng.snapshot()
+        restored = DecodeEngine.restore(tiny_net, snap)
+        res = restored.run()
+        assert res[rid].trace == "r4/a1"
+
+    def test_gateway_header_and_body_carriers(self, tiny_net):
+        eng = DecodeEngine(tiny_net, n_slots=2, decode_chunk=2)
+        with ServingGateway(eng, replica_id="rep-t") as gw:
+            client = GatewayClient(gw.address)
+            # JSON-field carrier (what GatewayClient trace= sends)
+            out = client.generate([1, 2, 3], 4, trace="rA/a0")
+            assert out["trace"] == "rA/a0"
+            tr = client.trace(out["id"])
+            assert tr["trace"] == "rA/a0"
+            # header-only carrier (a sidecar proxy that cannot touch
+            # the body): X-DL4J-Trace alone must land too
+            req = urllib.request.Request(
+                gw.address + "/v1/generate",
+                data=json.dumps({"prompt": [2, 3],
+                                 "max_new_tokens": 3}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-DL4J-Trace": "rB/a0"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                out2 = json.loads(resp.read())
+            assert out2["trace"] == "rB/a0"
+            # healthz exposes the tracer clock for skew estimation
+            assert client.healthz()["now_us"] >= 0
+
+    def test_untraced_requests_unchanged(self, tiny_net):
+        # trace stamping must not perturb ids or compile counts
+        base = DecodeEngine(tiny_net, n_slots=2, decode_chunk=2)
+        rid0 = base.submit(Request([1, 2, 3], 6))
+        want = base.run()[rid0].tokens
+        traced = DecodeEngine(tiny_net, n_slots=2, decode_chunk=2)
+        rid1 = traced.submit(Request([1, 2, 3], 6, trace="rX/a0"))
+        got = traced.run()[rid1]
+        assert got.tokens == want
+        assert base.compile_counts() == traced.compile_counts()
+
+
+# ---------------------------------------------------------------------------
+# the router's stitching layer
+# ---------------------------------------------------------------------------
+
+def _fleet(net, n=2, throttle=0.0):
+    gws = []
+    for i in range(n):
+        eng = DecodeEngine(net, n_slots=2, decode_chunk=2)
+        if throttle:
+            orig = eng.step
+
+            def slow(sink=None, _orig=orig):
+                time.sleep(throttle)
+                return _orig(sink)
+
+            eng.step = slow
+        gws.append(ServingGateway(eng, replica_id=f"rep-{i}",
+                                  keepalive_s=0.1).start())
+    router = ServingRouter(
+        [g.address for g in gws], health_interval_s=0.1,
+        metrics_every=1, failure_threshold=2,
+        probe_interval_s=0.5).start()
+    return gws, router
+
+
+class TestRouterStitching:
+    def test_stitched_trace_and_fleet_metrics(self, tiny_net):
+        gws, router = _fleet(tiny_net)
+        try:
+            client = RouterClient(router.address)
+            time.sleep(0.35)  # a clock-bearing scrape per replica
+            outs = [client.generate([1 + i, 2, 3], 4)
+                    for i in range(3)]
+            assert all(o["trace"] for o in outs)
+            assert len({o["trace"] for o in outs}) == 3
+            doc = client.trace_events()
+            events = doc["traceEvents"]
+            names = {e["args"]["name"] for e in events
+                     if e.get("name") == "process_name"}
+            assert names == {"router", "replica rep-0",
+                             "replica rep-1"}
+            stitch = next(e for e in events
+                          if e.get("name") == "fleet.stitch")
+            info = stitch["args"]["replicas"]
+            assert [r["lane"] for r in info] == [1, 2]
+            assert all(r["skew_corrected"] for r in info)
+            assert all(r["source"] == "live" for r in info)
+            # the router's own spans live on lane 0
+            route = [e for e in events
+                     if e.get("name") == "router.route"]
+            assert route and all(e["pid"] == 0 for e in route)
+            assert any(e["args"].get("affinity") is not None
+                       for e in route)
+            waits = [e for e in events
+                     if e.get("name") == "router.queue_wait"]
+            assert waits and all(e["pid"] == 0 for e in waits)
+            # fleet metrics: merged + labeled + router families
+            text = client.fleet_metrics()
+            assert 'serving_e2e_s_bucket{replica="rep-0"' in text
+            assert 'serving_e2e_s_bucket{replica="rep-1"' in text
+            assert "router_replay_gap_s_bucket" in text
+            assert 'router_requests' in text
+        finally:
+            router.close()
+            for g in gws:
+                g.close()
+
+    def test_request_trace_proxy_live_and_breadcrumbs(self, tiny_net):
+        gws, router = _fleet(tiny_net, throttle=0.04)
+        try:
+            client = RouterClient(router.address, timeout_s=120.0)
+            time.sleep(0.3)
+            out = client.generate([1, 2, 3], 4)
+            # live owner: proxied flight record, re-keyed to the
+            # router id, with the journal's view attached
+            tr = client.trace(out["id"])
+            assert tr["id"] == out["id"]
+            assert tr["trace"].startswith(out["trace"] + "/")
+            assert tr["timing"]["e2e_s"] > 0
+            assert tr["router"]["trace"] == out["trace"]
+            assert tr["router"]["history"]
+            assert tr["replica_id"] in ("rep-0", "rep-1")
+            # unknown id -> 404 (the ONLY blind 404 left)
+            from deeplearning4j_tpu.serving import GatewayError
+
+            with pytest.raises(GatewayError) as ei:
+                client.trace(10 ** 6)
+            assert ei.value.status == 404
+
+            # kill the owner mid-stream: the replayed request's proxy
+            # resolves to the SURVIVOR, with replayed_to set
+            s = client.stream([3, 2, 1], 16)
+            got = []
+            killed = None
+            for delta in s:
+                got.extend(delta)
+                if killed is None:
+                    owner = router._journal[s.id].replica_address
+                    killed = next(
+                        g for g in gws
+                        if owner.endswith(str(g._service.port)))
+                    time.sleep(0.12)  # a scrape catches the spans
+                    killed.hard_kill()
+            assert s.result["replays"] >= 1
+            tr2 = client.trace(s.id)
+            assert tr2["id"] == s.id
+            assert tr2.get("replayed_to") in ("rep-0", "rep-1")
+            if "timing" in tr2:   # proxied from the survivor
+                assert tr2["router"]["replays"] >= 1
+            # the stitched trace now carries a dead lane from cache
+            doc = client.trace_events()
+            stitch = next(e for e in doc["traceEvents"]
+                          if e.get("name") == "fleet.stitch")
+            sources = {r["replica_id"]: r["source"]
+                       for r in stitch["args"]["replicas"]}
+            assert sources[killed.replica_id] == "cache"
+            replays = [e for e in doc["traceEvents"]
+                       if e.get("name") == "router.replay"]
+            assert replays
+            assert replays[0]["args"]["overlap_ok"] is True
+            assert replays[0]["args"]["high_water"] >= 1
+        finally:
+            router.close()
+            for g in gws:
+                with contextlib.suppress(Exception):
+                    g.close()  # the killed one raises; that's fine
+
+    def test_clock_epoch_jump_replaces_estimate_immediately(self):
+        # a replica resurrected on the same port has a NEW
+        # perf_counter epoch; its offset candidate jumps by >> 1s and
+        # must replace the dead process's estimate at once — not
+        # after the 8-scrape age-out (review-round fix)
+        router = ServingRouter(["127.0.0.1:9"])
+        try:
+            rep = router._replicas[0]
+            router._note_clock(rep, {"now_us": 1e9}, 0.0, 100.0)
+            assert rep.clock_offset_us == pytest.approx(1e9 - 50)
+            # higher RTT, µs drift: the tighter old sample wins
+            router._note_clock(rep, {"now_us": 1e9 + 1000},
+                               500.0, 1500.0)
+            assert rep.clock_offset_us == pytest.approx(1e9 - 50)
+            # higher RTT but a >1s jump (restart): accepted NOW
+            router._note_clock(rep, {"now_us": 5e4}, 0.0, 1000.0)
+            assert rep.clock_offset_us == pytest.approx(5e4 - 500)
+            # and a breaker-open drops the estimate outright (the
+            # cache keeps its own epoch-matched copy)
+            rep.cache_offset_us = rep.clock_offset_us
+            for _ in range(router.failure_threshold):
+                router._note_failure(rep)
+            assert rep.state == "dead"
+            assert rep.clock_offset_us is None
+            assert rep.cache_offset_us == pytest.approx(5e4 - 500)
+        finally:
+            router._service._httpd.server_close()
+
+    def test_fleet_trace_off_switch(self, tiny_net):
+        # fleet_trace=False: no minted ids, no router spans, yet the
+        # endpoints still answer (router-only lane / plain metrics)
+        eng = DecodeEngine(tiny_net, n_slots=2, decode_chunk=2)
+        gw = ServingGateway(eng, replica_id="rep-0").start()
+        router = ServingRouter([gw.address], health_interval_s=0.1,
+                               fleet_trace=False).start()
+        try:
+            client = RouterClient(router.address)
+            out = client.generate([1, 2, 3], 4)
+            assert "trace" not in out
+            doc = client.trace_events()
+            assert not any(e.get("name") == "router.route"
+                           for e in doc["traceEvents"])
+            assert "router_requests" in client.fleet_metrics()
+        finally:
+            router.close()
+            gw.close()
+
+
+# ---------------------------------------------------------------------------
+# latency_report --fleet
+# ---------------------------------------------------------------------------
+
+class TestFleetReport:
+    def test_rows_from_federated_text(self):
+        from scripts.latency_report import fleet_report
+
+        t0, t1, router_t = Tracer(), Tracer(), Tracer()
+        for v in (0.01, 0.03):
+            t0.observe("serving_ttft_s", v)
+            t0.observe("serving_itl_s", v / 10)
+            t0.observe("serving_e2e_s", v * 4)
+        t1.observe("serving_ttft_s", 0.08)
+        t1.observe("serving_itl_s", 0.008)
+        t1.observe("serving_e2e_s", 0.3)
+        router_t.observe("router_replay_gap_s", 0.25)
+        text = Tracer.merge_prometheus(
+            {"rep-0": t0.prometheus_text(),
+             "rep-1": t1.prometheus_text()})
+        text += router_t.prometheus_text()
+        report = fleet_report(text)
+        fleet = {r["phase"]: r for r in report["fleet"]}
+        assert fleet["ttft"]["count"] == 3
+        assert fleet["itl"]["count"] == 3
+        assert fleet["replay_gap"]["count"] == 1
+        assert fleet["replay_gap"]["p50_ms"] > 100
+        assert set(report["replicas"]) == {"rep-0", "rep-1"}
+        assert {r["phase"] for r in report["replicas"]["rep-0"]} == \
+            {"ttft", "itl", "e2e"}
+        assert report["replicas"]["rep-0"][0]["count"] == 2
+
+    def test_cli_fleet_json(self, tmp_path, capsys):
+        from scripts.latency_report import main
+
+        t0 = Tracer()
+        t0.observe("serving_ttft_s", 0.02, n=4)
+        text = Tracer.merge_prometheus(
+            {"rep-0": t0.prometheus_text()})
+        path = tmp_path / "fleet.txt"
+        path.write_text(text)
+        assert main(["--fleet", "--json", str(path)]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["fleet"][0]["phase"] == "ttft"
+        assert doc["replicas"]["rep-0"][0]["count"] == 4

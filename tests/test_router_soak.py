@@ -9,6 +9,14 @@ single-engine reference, journal clean, zero leaked threads/sockets.
 Full variant (``slow``): 3 SUBPROCESS replicas, a real ``SIGKILL``,
 plus one graceful ``/v1/drain`` hand-off mid-run — the acceptance
 chaos gate end to end across real process boundaries.
+
+Both variants additionally gate the ISSUE 10 fleet-observability
+surface (inside ``run_soak``): zero 5xx from ``/v1/trace`` +
+``/v1/fleet/metrics`` under churn, every terminal request's proxied
+trace parsing with phase sums <= e2e, a stitched failover trace whose
+victim request spans BOTH the dead and the survivor lane with the
+bridging ``router.replay`` span, and ``--fleet`` latency rows with a
+populated ``router_replay_gap_s``.
 """
 
 import pytest
@@ -26,6 +34,14 @@ def test_router_soak_fast():
     assert summary["completed_after_replay"] >= 1
     assert summary["leaked_threads"] == 0
     assert summary["leaked_fds"] == 0
+    # ISSUE 10: fleet endpoints survived the churn, the failover is
+    # one stitched cross-replica trace, and the replay gap is priced
+    assert summary["endpoint_5xx"] == 0
+    assert min(summary["endpoint_scrapes"].values()) >= 1
+    assert summary["request_traces_proxied"] >= 1
+    assert summary["stitched_failover_trace"]
+    assert summary["fleet_replay_gap_count"] >= 1
+    assert summary["fleet_p99_ttft_ms"] > 0
 
 
 @pytest.mark.slow
