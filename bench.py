@@ -1948,6 +1948,182 @@ def bench_fleet_trace_overhead():
     }
 
 
+def bench_fleet_controller_overhead():
+    """Fleet-controller row (ISSUE 11 acceptance): the control loop
+    must be a free rider on the serving path. 8 concurrent SSE
+    streams over TWO gateway replicas (the bench_router_overhead
+    topology), through a router whose :class:`FleetController` loop
+    is LIVE — scraping replica status and the federated TTFT window
+    every ``eval_interval_s``, evaluating SLOs, never triggering a
+    scale event (min == max == fleet size; thresholds unreachable) —
+    vs a controller-free router over the SAME replicas, interleaved
+    trials.
+
+    Gates:
+    - overhead: controller-path aggregate tokens/sec >= 0.97x the
+      controller-off path (the loop is a sidecar thread reading
+      host-side state; its federated scrape rides a separate
+      connection);
+    - parity: ids bit-identical both paths vs the in-process
+      single-engine reference;
+    - zero retrace: compile counts identical before/after on both
+      replica engines;
+    - the loop actually ran (evaluations counted, zero errors) and
+      actually held (zero scale events)."""
+    import threading
+
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        FleetController,
+        Request,
+        RouterClient,
+        ServingGateway,
+        ServingRouter,
+    )
+
+    V, width, n_layers, window = 64, 1024, 8, 2048
+    n_streams, n_gen, prompt_len = 8, 64, 128
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_streams)]
+    ref_eng = DecodeEngine(net, n_slots=n_streams, decode_chunk=32)
+    ref_ids = [ref_eng.submit(Request(prompt=list(p),
+                                      max_new_tokens=n_gen))
+               for p in prompts]
+    ref_res = ref_eng.run()
+    ref_tokens = [ref_res[i].tokens for i in ref_ids]
+
+    engines = [DecodeEngine(net, n_slots=4, decode_chunk=32,
+                            prefix_cache_rows=8)
+               for _ in range(2)]
+    gateways = [ServingGateway(e, keepalive_s=1.0,
+                               admission_grace_s=0.25,
+                               replica_id=f"ctl-rep-{i}").start()
+                for i, e in enumerate(engines)]
+    addresses = [g.address for g in gateways]
+    ctl_router = ServingRouter(addresses, health_interval_s=0.25,
+                               affinity_block_tokens=16).start()
+    plain_router = ServingRouter(addresses, health_interval_s=0.25,
+                                 affinity_block_tokens=16).start()
+    # a LIVE loop that must never act: fleet already at min == max,
+    # thresholds unreachable — pure observation cost
+    controller = FleetController(
+        ctl_router, replica_factory=None,
+        min_replicas=2, max_replicas=2,
+        eval_interval_s=0.25, ttft_p99_slo_s=1000.0,
+        pressure_high=1e9, pressure_low=0.0).start()
+    ctl_client = RouterClient(ctl_router.address, timeout_s=600.0)
+    plain_client = RouterClient(plain_router.address,
+                                timeout_s=600.0)
+
+    def stream_round(client):
+        outs = [None] * n_streams
+        errors = [None] * n_streams
+
+        def one(i):
+            try:
+                s = client.stream(prompts[i], n_gen)
+                toks = []
+                for delta in s:
+                    toks.extend(delta)
+                outs[i] = toks
+            except Exception as e:
+                errors[i] = e
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_streams)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        failed = {i: repr(e) for i, e in enumerate(errors) if e}
+        if failed:
+            raise RuntimeError(f"stream clients failed: {failed}")
+        return sum(len(o) for o in outs) / dt, outs
+
+    try:
+        _, outs = stream_round(ctl_client)  # warm + parity check
+        id_match = float(np.mean([outs[i] == ref_tokens[i]
+                                  for i in range(n_streams)]))
+        if id_match < 1.0:
+            _fail_gate(f"controller-path stream ids diverged from "
+                       f"the in-process reference (match "
+                       f"{id_match:.2f})")
+        _, plain_outs = stream_round(plain_client)
+        if plain_outs != outs:
+            _fail_gate("controller-off stream ids differ — the "
+                       "control loop leaked into computation")
+        counts0 = [e.compile_counts() for e in engines]
+        ctl_rates, plain_rates = [], []
+        for _ in range(3):  # interleaved: drift hits both alike
+            r, _ = stream_round(plain_client)
+            plain_rates.append(r)
+            r, _ = stream_round(ctl_client)
+            ctl_rates.append(r)
+        counts1 = [e.compile_counts() for e in engines]
+        if counts1 != counts0:
+            _fail_gate(f"replica engines retraced under controller "
+                       f"traffic: {counts0} -> {counts1}")
+        if controller.stats["evals"] < 3:
+            _fail_gate(f"control loop barely ran "
+                       f"({controller.stats['evals']} evals) — the "
+                       "row would price nothing")
+        if controller.stats["errors"]:
+            _fail_gate(f"control loop errored "
+                       f"{controller.stats['errors']}x during the "
+                       "bench")
+        if controller.events:
+            _fail_gate(f"controller scaled during the overhead row "
+                       f"(events {controller.events}) — the "
+                       "comparison is no longer same-fleet")
+    finally:
+        controller.close()
+        ctl_router.close()
+        plain_router.close()
+        for g in gateways:
+            g.close()
+    ctl_rate = float(np.median(ctl_rates))
+    plain_rate = float(np.median(plain_rates))
+    ratio = ctl_rate / plain_rate
+    if ratio < 0.97:
+        _fail_gate(
+            f"fleet controller costs too much: {ctl_rate:.0f} tok/s "
+            f"with the loop live < 0.97x {plain_rate:.0f} without "
+            f"(ratio {ratio:.3f})")
+    return {
+        "metric": "fleet_controller_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": ("controller-on / controller-off router aggregate "
+                 "streaming tokens/sec (width-1024 flagship, "
+                 "2048-token KV window, 2 replicas x 4 slots, "
+                 f"{n_streams} concurrent SSE streams x {n_gen} "
+                 "tokens, localhost; loop live at 4 Hz scraping "
+                 "replica status + the federated TTFT window, no "
+                 "scale events triggered)"),
+        "vs_baseline": None,  # reference has no fleet tier at all
+        "spread": [round(min(ctl_rates) / max(plain_rates), 4),
+                   round(max(ctl_rates) / min(plain_rates), 4)],
+        "trials": len(ctl_rates),
+        "controller_tokens_per_sec": round(ctl_rate, 1),
+        "plain_tokens_per_sec": round(plain_rate, 1),
+        "controller_evals": controller.stats["evals"],
+        "router_http_id_match": round(id_match, 4),
+        "compile_counts": counts1,
+    }
+
+
 def bench_observability_overhead():
     """Observability row (ISSUE 7 acceptance): the request-scoped
     flight recorder must be cheap enough to leave ON. Same width-1024
@@ -2451,6 +2627,7 @@ def main() -> None:
                bench_decode_spec,
                bench_gateway_streaming, bench_router_overhead,
                bench_fleet_trace_overhead,
+               bench_fleet_controller_overhead,
                bench_observability_overhead,
                bench_train_observability_overhead,
                bench_w2v, bench_dbn, bench_allreduce):

@@ -398,7 +398,8 @@ def gateway_from_args(args):
     return ServingGateway.boot(
         engine, snapshot_path=args.snapshot,
         net_factory=lambda: restore_model(args.model),
-        host=args.host, port=args.port)
+        host=args.host, port=args.port,
+        replica_id=getattr(args, "replica_id", None))
 
 
 def router_from_args(args):
@@ -436,12 +437,131 @@ def _cmd_route(args) -> int:
     return 0
 
 
+def _serve_child_argv(args, port: int, replica_id: str):
+    """Child argv for one fleet replica: this same CLI's ``serve``
+    subcommand on an ephemeral port with a stable replica id."""
+    argv = [sys.executable, "-m", "deeplearning4j_tpu.cli.driver",
+            "serve", "--model", args.model,
+            "--host", "127.0.0.1", "--port", str(port),
+            "--replica-id", replica_id,
+            "--slots", str(args.slots),
+            "--decode-chunk", str(args.decode_chunk),
+            "--prefix-cache-rows", str(args.prefix_cache_rows),
+            "--prefill-chunk", str(args.prefill_chunk),
+            "--admission-policy", args.admission_policy]
+    if args.paged_kv:
+        argv += ["--paged-kv", "--block-tokens",
+                 str(args.block_tokens)]
+        if args.kv_blocks is not None:
+            argv += ["--kv-blocks", str(args.kv_blocks)]
+    return argv
+
+
+def fleet_from_args(args):
+    """Build the elastic fleet the ``fleet`` subcommand runs — N
+    subprocess ``serve`` replicas, the failure-tolerant router over
+    them, and the SLO-driven :class:`FleetController` that breathes
+    the fleet (spawns replicas on pressure/TTFT-SLO violations,
+    drains idle ones, `controller.rolling_upgrade()` for
+    zero-downtime model upgrades). Factored out so tests can drive
+    the exact CLI wiring without the serve-forever loop. Returns
+    ``(replicas, router, controller)`` — none of them started."""
+    from deeplearning4j_tpu.serving import (
+        FleetController,
+        ServingRouter,
+    )
+    from deeplearning4j_tpu.serving.replica_proc import (
+        ReplicaProcess,
+        free_port,
+    )
+
+    def spawn(replica_id: str):
+        port = free_port()
+        return ReplicaProcess(
+            _serve_child_argv(args, port, replica_id),
+            replica_id=replica_id, port=port,
+            ready_pattern="serving on")
+
+    def factory(replica_id: str):
+        proc = spawn(replica_id)
+        try:
+            proc.wait_ready(timeout_s=300.0)
+        except BaseException:
+            proc.shutdown()  # a wedged boot must not leak the child
+            raise
+        return proc
+
+    # spawn all seeds first so their XLA inits overlap, then wait;
+    # ANY failure before the caller owns the fleet (a wedged boot, a
+    # bad router port, rejected controller bounds) must reap every
+    # child already spawned — orphaned serve subprocesses outlive
+    # the CLI
+    seeds = [spawn(f"fleet-{i}") for i in range(args.replicas)]
+    try:
+        for r in seeds:
+            r.wait_ready(timeout_s=300.0)
+        router = ServingRouter(
+            [r.address for r in seeds], host=args.host,
+            port=args.port,
+            affinity_block_tokens=args.affinity_block_tokens)
+        controller = FleetController(
+            router, replica_factory=factory,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            eval_interval_s=args.eval_interval,
+            ttft_p99_slo_s=args.ttft_slo,
+            pressure_high=args.pressure_high,
+            pressure_low=args.pressure_low,
+            cooldown_s=args.cooldown, id_prefix="fleet-auto")
+    except BaseException:
+        from deeplearning4j_tpu.serving.replica_proc import (
+            shutdown_all,
+        )
+
+        shutdown_all(seeds)
+        raise
+    for r in seeds:
+        controller.adopt(r)
+    return seeds, router, controller
+
+
+def _cmd_fleet(args) -> int:
+    import time as _time
+
+    print(f"booting {args.replicas} replica(s)...", flush=True)
+    seeds, router, controller = fleet_from_args(args)
+    try:
+        router.start()
+        controller.start()
+        print(f"fleet routing on {router.address} over "
+              f"{len(seeds)} replicas, controller live "
+              f"(min {controller.min_replicas} / max "
+              f"{controller.max_replicas}, TTFT-p99 SLO "
+              f"{controller.ttft_p99_slo_s}); scale timeline at "
+              f"GET /v1/trace as fleet.scale spans", flush=True)
+        try:
+            while True:
+                _time.sleep(0.5)
+        except KeyboardInterrupt:
+            print("stopping fleet (drain + reap)...")
+    finally:
+        controller.close()
+        router.close()
+        # the seeds were adopted, so shutdown_fleet reaps everything
+        controller.shutdown_fleet()
+    return 0
+
+
 def _cmd_serve(args) -> int:
     import time as _time
 
     gw = gateway_from_args(args).start()
+    # flush: a fleet parent reads this line through a pipe as the
+    # boot handshake (ReplicaProcess ready_pattern) — block-buffered
+    # stdout would hold it until the buffer filled
     print(f"serving on {gw.address} "
-          f"(POST /v1/generate, GET /v1/healthz, GET /v1/metrics)")
+          f"(POST /v1/generate, GET /v1/healthz, GET /v1/metrics)",
+          flush=True)
     try:
         while True:
             _time.sleep(0.5)
@@ -561,7 +681,52 @@ def build_parser() -> argparse.ArgumentParser:
                         "restored on boot when present")
     s.add_argument("--drain-timeout", type=float, default=30.0,
                    help="seconds to settle in-flight work on shutdown")
+    s.add_argument("--replica-id", default=None,
+                   help="stable replica identity for a router tier "
+                        "(affinity keys hash against it; defaults "
+                        "to host:port)")
     s.set_defaults(fn=_cmd_serve)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="run an ELASTIC fleet: N serve replicas + router + "
+             "SLO-driven autoscaling controller (ISSUE 11)")
+    fl.add_argument("--model", required=True,
+                    help="LM-shaped model zip every replica serves")
+    fl.add_argument("--host", default="127.0.0.1")
+    fl.add_argument("--port", type=int, default=8420,
+                    help="the router's port (replicas take "
+                         "ephemeral ports)")
+    fl.add_argument("--replicas", type=int, default=2,
+                    help="initial fleet size")
+    fl.add_argument("--min-replicas", type=int, default=1)
+    fl.add_argument("--max-replicas", type=int, default=4)
+    fl.add_argument("--ttft-slo", type=float, default=None,
+                    help="TTFT p99 SLO in seconds (windowed over "
+                         "the federated scrape); unset = "
+                         "pressure-only control")
+    fl.add_argument("--pressure-high", type=float, default=2.0,
+                    help="in-flight-per-slot above this = breach")
+    fl.add_argument("--pressure-low", type=float, default=0.25,
+                    help="in-flight-per-slot below this = idle "
+                         "(the hysteresis band between the two "
+                         "holds)")
+    fl.add_argument("--eval-interval", type=float, default=0.5,
+                    help="control-loop period in seconds")
+    fl.add_argument("--cooldown", type=float, default=5.0,
+                    help="seconds after any scale event before the "
+                         "next may fire")
+    fl.add_argument("--affinity-block-tokens", type=int, default=16)
+    fl.add_argument("--slots", type=int, default=8)
+    fl.add_argument("--decode-chunk", type=int, default=8)
+    fl.add_argument("--prefix-cache-rows", type=int, default=8)
+    fl.add_argument("--prefill-chunk", type=int, default=0)
+    fl.add_argument("--admission-policy", default="ttft",
+                    choices=("ttft", "decode"))
+    fl.add_argument("--paged-kv", action="store_true")
+    fl.add_argument("--block-tokens", type=int, default=16)
+    fl.add_argument("--kv-blocks", type=int, default=None)
+    fl.set_defaults(fn=_cmd_fleet)
 
     rt = sub.add_parser(
         "route",

@@ -313,12 +313,22 @@ class Tracer:
                     "args": args,
                 })
 
-    def instant(self, name: str, **args: Any) -> None:
+    def instant(self, name: str, scope: str = "t",
+                **args: Any) -> None:
+        """Zero-duration marker. ``scope`` is the Chrome trace-event
+        instant scope: ``"t"`` (thread — the default; renders as a
+        tick on the emitting thread's row), ``"p"`` (process — a line
+        across the whole lane) or ``"g"`` (global). Lane-wide events
+        — breaker transitions, fleet scale decisions (ISSUE 11) —
+        pass ``"p"`` so they read against EVERY row of the lane they
+        affect, not just the control thread that noticed."""
+        if scope not in ("t", "p", "g"):
+            raise ValueError(f"instant scope {scope!r} not in t/p/g")
         with self._lock:
             self._push({
                 "name": name, "ph": "i", "ts": self._us(),
                 "pid": os.getpid(),
-                "tid": threading.get_ident() % 2 ** 31, "s": "t",
+                "tid": threading.get_ident() % 2 ** 31, "s": scope,
                 "args": args,
             })
 

@@ -17,9 +17,17 @@ onto survivors (ISSUE 9 tentpole) — and fleet-wide distributed
 tracing + federated metrics: router-minted ``X-DL4J-Trace`` contexts
 stamped through to every engine span, a stitched skew-corrected
 multi-lane ``/v1/trace``, and bucket-wise-merged
-``/v1/fleet/metrics`` (ISSUE 10 tentpole)."""
+``/v1/fleet/metrics`` (ISSUE 10 tentpole) — and the elastic fleet
+controller: SLO-driven autoscaling over subprocess/in-process replica
+factories and zero-downtime rolling upgrades, every scale decision a
+``fleet.scale`` span on the stitched trace (ISSUE 11 tentpole)."""
 
 from deeplearning4j_tpu.serving.block_pool import BlockPool, BlockTable
+from deeplearning4j_tpu.serving.controller import FleetController
+from deeplearning4j_tpu.serving.replica_proc import (
+    LocalReplica,
+    ReplicaProcess,
+)
 
 from deeplearning4j_tpu.serving.client import (
     GatewayClient,
@@ -67,12 +75,15 @@ __all__ = [
     "FINISH_REASONS",
     "FaultEvent",
     "FaultPlan",
+    "FleetController",
     "GatewayClient",
     "GatewayError",
     "GatewayStream",
     "GenerationResult",
+    "LocalReplica",
     "ManualClock",
     "NgramDraftTable",
+    "ReplicaProcess",
     "PagedPrefixCache",
     "PrefixHit",
     "REPLICA_STATES",

@@ -380,3 +380,14 @@ class GatewayClient:
               ) -> Dict[str, Any]:
         body = {} if timeout_s is None else {"timeout_s": timeout_s}
         return self._call("POST", "/v1/drain", body)
+
+    def warmup(self, prompts: List[List[int]],
+               max_new_tokens: int = 1) -> Dict[str, Any]:
+        """``POST /v1/warmup`` (ISSUE 11): the boot-with-warmup
+        handshake — prime a booting replica's prefix cache with the
+        fleet's live affinity keys before the router shifts any
+        rendezvous keyspace onto it. Returns ``{"warmed", "requested",
+        "prefix_tokens_reused"}``."""
+        return self._call("POST", "/v1/warmup", {
+            "prompts": [[int(t) for t in p] for p in prompts],
+            "max_new_tokens": int(max_new_tokens)})

@@ -154,6 +154,8 @@ class _GatewayHandler(JsonHandler):
             self.gateway._handle_generate(self, stream)
         elif path == "/v1/drain":
             self.gateway._handle_drain(self)
+        elif path == "/v1/warmup":
+            self.gateway._handle_warmup(self)
         else:
             self.send_json({"error": f"no such endpoint {path}"}, 404,
                            close=True)
@@ -282,6 +284,13 @@ class ServingGateway:
         self._draining = False
         self._paused = False
         self._stopped = False
+        # idempotent drain (ISSUE 11 satellite): the first drain owns
+        # the work; later/concurrent drains wait and return ITS
+        # summary (same carried_ids) instead of double-draining
+        self._drain_lock = threading.Lock()
+        self._drain_started = False
+        self._drain_done = threading.Event()
+        self._drain_summary: Optional[Dict[str, Any]] = None
         self._round_s = 0.01  # EMA of step wall time (Retry-After)
         self._step_sink: Dict[int, GenerationResult] = {}
         self.stats = {"connections": 0, "streams": 0,
@@ -837,6 +846,116 @@ class ServingGateway:
             gauge(f"serving_gateway_{key}", value)
         return tracer.prometheus_text()
 
+    # -- boot-with-warmup handshake (ISSUE 11) --------------------------
+    #: warmup request cap per call: the handshake primes a cache, it
+    #: is not a bulk-generation backdoor
+    WARMUP_CAP = 64
+    #: warmup generation-length clamp: one token is enough to drive
+    #: the admission path (and the cache insert); a handful is the
+    #: most a boot handshake could justify
+    WARMUP_MAX_NEW_TOKENS = 8
+
+    def warmup(self, prompts: List[List[int]],
+               max_new_tokens: int = 1,
+               timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Boot-with-warmup handshake: run each prompt through a
+        short greedy generation so admission inserts its prefix into
+        the engine's prefix cache BEFORE the router shifts any
+        rendezvous keyspace here. A rolling upgrade's replacement
+        replica calls this with the fleet's live affinity keys
+        (``ServingRouter.live_affinity_prompts``), so the first real
+        request for a moved key lands warm instead of paying a cold
+        prefill. One generated token per prompt: enough to drive the
+        full admission path (and the cache insert); cheap enough that
+        a warmup cannot meaningfully delay the replica joining."""
+        prompts = list(prompts)
+        requested = len(prompts)
+        prompts = prompts[:self.WARMUP_CAP]
+        # the cap on generation length is what actually keeps warmup
+        # from being a bulk-generation backdoor around /v1/generate's
+        # admission accounting — the prompt-count cap alone would not
+        max_new_tokens = min(max(int(max_new_tokens), 1),
+                             self.WARMUP_MAX_NEW_TOKENS)
+        # validate EVERY prompt before submitting ANY: a malformed
+        # prompt mid-batch must reject the whole call, not leak the
+        # already-submitted half into the engine with no consumer
+        reqs = []
+        for p in prompts:
+            toks = [int(t) for t in p]
+            bad = [t for t in toks
+                   if not 0 <= t < self.engine.vocab]
+            if bad:
+                raise ValueError(
+                    f"warmup prompt ids {bad[:4]} outside vocab "
+                    f"[0, {self.engine.vocab})")
+            req = Request(prompt=toks,
+                          max_new_tokens=int(max_new_tokens))
+            self.engine.scheduler.validate(req)
+            reqs.append(req)
+        lives: List = []
+        with self._engine_access():
+            if self._draining or self._stopped:
+                raise RuntimeError("gateway draining/stopped")
+            reused_before = self.engine.stats[
+                "prefill_tokens_skipped"]
+            for req in reqs:
+                if self.engine.scheduler.full:
+                    # warmup primes a cache on a BOOTING replica; it
+                    # must never shed real traffic off a full queue —
+                    # whatever fits is warm enough
+                    break
+                rid = self.engine.submit(req)
+                live = _Live()
+                self._live[rid] = live
+                lives.append((rid, live))
+            if lives:
+                self._wake.notify_all()
+        deadline = time.monotonic() + timeout_s
+        warmed = 0
+        for rid, live in lives:
+            live.done.wait(timeout=max(deadline - time.monotonic(),
+                                       0.0))
+            if live.result is not None:
+                warmed += 1
+            self._forget(rid)
+        if self.engine.tracer is not None:
+            self.engine.tracer.incr("serving_gateway_warmups",
+                                    warmed)
+        return {"warmed": warmed, "requested": requested,
+                "submitted": len(lives),
+                "prefix_tokens_reused":
+                    self.engine.stats["prefill_tokens_skipped"]
+                    - reused_before}
+
+    def _handle_warmup(self, handler) -> None:
+        """``POST /v1/warmup`` body ``{"prompts": [[tok, ...], ...],
+        "max_new_tokens"?: n}`` — the HTTP face of :meth:`warmup`
+        (503 while draining, 400 on a malformed body)."""
+        try:
+            body = handler.read_json()
+            prompts = body["prompts"]
+            if not isinstance(prompts, list) or not all(
+                    isinstance(p, list) for p in prompts):
+                raise ValueError("prompts must be a list of token "
+                                 "lists")
+            max_new = int(body.get("max_new_tokens", 1))
+        except (ValueError, TypeError, KeyError, AttributeError,
+                UnicodeDecodeError) as e:
+            handler.send_json({"error": f"bad warmup body: {e}"},
+                              400, close=True)
+            return
+        try:
+            out = self.warmup(prompts, max_new_tokens=max_new)
+        except RuntimeError as e:
+            handler.send_json({"error": str(e)}, 503, close=True)
+            return
+        except (ValueError, TypeError) as e:
+            # rejected prompt, or a token that int() cannot coerce
+            # (e.g. a nested list): still a malformed body → 400
+            handler.send_json({"error": str(e)}, 400, close=True)
+            return
+        handler.send_json(out, 200, close=True)
+
     # -- drain / snapshot ----------------------------------------------
     def drain(self, timeout_s: Optional[float] = None
               ) -> Dict[str, Any]:
@@ -847,7 +966,51 @@ class ServingGateway:
         configured). Whatever had not finished inside the budget is in
         the snapshot — :meth:`boot` on the next process finishes those
         very ids. Returns a summary: requests finished here, requests
-        carried in the snapshot, the snapshot path."""
+        carried in the snapshot, the snapshot path.
+
+        IDEMPOTENT (ISSUE 11 satellite): a second drain — concurrent
+        (a fleet controller racing an operator) or later — returns
+        the FIRST drain's summary, ``carried_ids`` included, instead
+        of re-running the settle loop against a paused engine."""
+        with self._drain_lock:
+            first = not self._drain_started
+            self._drain_started = True
+            # capture the latch under the SAME lock: the failure path
+            # swaps in a fresh Event, and a waiter that saw
+            # drain_started must wait on the event that failure path
+            # will set, not the replacement
+            done = self._drain_done
+        if not first:
+            done.wait(timeout=600.0)
+            if self._drain_summary is not None:
+                return dict(self._drain_summary)
+            with self._drain_lock:
+                owner_failed = not self._drain_started
+            if owner_failed:
+                # the owning drain raised and released the latch: a
+                # success-shaped in_progress dict would make the
+                # caller (a controller about to reap the process)
+                # believe the drain happened — retry as the new owner
+                return self.drain(timeout_s)
+            return {"drained": False, "carried": None,
+                    "carried_ids": None, "snapshot": None,
+                    "in_progress": True}
+        try:
+            return self._drain_owner(timeout_s)
+        except BaseException:
+            # a failed drain must stay retryable: release the latch
+            # (waiters wake with no summary) and hand the NEXT drain
+            # a fresh one, instead of wedging every later drain
+            # behind a summary that will never land
+            with self._drain_lock:
+                self._drain_started = False
+                done, self._drain_done = (self._drain_done,
+                                          threading.Event())
+            done.set()
+            raise
+
+    def _drain_owner(self, timeout_s: Optional[float]
+                     ) -> Dict[str, Any]:
         with self._engine_access():
             self._draining = True
         t0 = time.monotonic()
@@ -892,10 +1055,14 @@ class ServingGateway:
                     live.done.set()
         if self.engine.tracer is not None:
             self.engine.tracer.incr("serving_gateway_drained")
-        return {"drained": carried == 0, "carried": carried,
-                "carried_ids": carried_ids,
-                "snapshot": snap_path,
-                "finished": self.engine.stats["requests_finished"]}
+        summary = {
+            "drained": carried == 0, "carried": carried,
+            "carried_ids": carried_ids,
+            "snapshot": snap_path,
+            "finished": self.engine.stats["requests_finished"]}
+        self._drain_summary = summary
+        self._drain_done.set()
+        return dict(summary)
 
     def _handle_drain(self, handler) -> None:
         try:

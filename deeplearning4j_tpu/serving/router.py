@@ -180,6 +180,13 @@ class _Replica:
         self.prefix_tokens_reused = 0
         self.requests_routed = 0
         self.open_entries = 0  # journal entries currently assigned
+        # -- idempotent drain (ISSUE 11 satellite): the fleet
+        # controller and a human operator WILL race on scale-down —
+        # the first drain owns the work, every later/concurrent drain
+        # waits on the event and returns the first drain's summary
+        self.drain_started = False
+        self.drain_done = threading.Event()
+        self.drain_summary: Optional[Dict[str, Any]] = None
         # -- fleet tracing state (ISSUE 10) ----------------------------
         #: estimated ``replica_tracer_now - router_tracer_now`` in µs,
         #: NTP-style: the replica reports its tracer clock inside a
@@ -220,6 +227,7 @@ class _Replica:
             "prefix_tokens_reused": self.prefix_tokens_reused,
             "requests_routed": self.requests_routed,
             "open_requests": self.open_entries,
+            "decommissioned": self.decommissioned,
         }
 
 
@@ -544,10 +552,16 @@ class ServingRouter:
         dead→half-open→live instants cannot answer WHEN routing
         noticed. Caller holds the lock; the tracer has its own."""
         if frm != to and hasattr(self.tracer, "instant"):
-            self.tracer.instant("router.breaker",
-                                replica=replica.replica_id,
-                                frm=frm, to=to,
-                                failures=replica.failures)
+            try:
+                self.tracer.instant("router.breaker", scope="p",
+                                    replica=replica.replica_id,
+                                    frm=frm, to=to,
+                                    failures=replica.failures)
+            except TypeError:  # duck-typed tracer without scope
+                self.tracer.instant("router.breaker",
+                                    replica=replica.replica_id,
+                                    frm=frm, to=to,
+                                    failures=replica.failures)
 
     def _replica_client(self, replica: _Replica,
                         read_timeout_s: Optional[float] = None,
@@ -673,13 +687,33 @@ class ServingRouter:
         fleet-overhead bench first measured). Failures are silent:
         the healthz that just succeeded owns liveness accounting, and
         a torn trace fetch must not shadow it."""
+        since = replica.trace_seq
         try:
-            doc = probe.trace_events(since_seq=replica.trace_seq)
+            doc = probe.trace_events(since_seq=since)
         except Exception:
             return
+        self._merge_trace_delta(replica, doc, since_seq=since)
+
+    def _merge_trace_delta(self, replica: _Replica,
+                           doc: Dict[str, Any],
+                           cache_offset_us: Optional[float] = None,
+                           since_seq: Optional[int] = None
+                           ) -> None:
+        """Fold one ``/v1/trace?since_seq=`` delta into the replica's
+        cache. ``cache_offset_us`` overrides the epoch-matched offset
+        snapshotted alongside the cache — the last-gasp scrape passes
+        the PRE-death estimate, because ``_note_failure`` has already
+        reset the live one by the time the fetch lands. ``since_seq``
+        is the cursor the fetch resumed from: a delta whose base no
+        longer matches the cursor lost a race to a concurrent merge
+        (periodic scrape vs last-gasp both fetching the same window)
+        and is dropped rather than folded twice."""
         events = doc.get("traceEvents", [])
         next_seq = doc.get("nextSeq")
         with self._lock:
+            if (since_seq is not None
+                    and since_seq != replica.trace_seq):
+                return
             if next_seq is None:
                 replica.trace_cache = events  # legacy full window
             elif next_seq < replica.trace_seq:
@@ -701,8 +735,36 @@ class ServingRouter:
             # the cache's correcting offset is whatever the clock
             # estimate says NOW — this scrape just talked to the same
             # process the events came from, so they share an epoch
-            replica.cache_offset_us = replica.clock_offset_us
+            replica.cache_offset_us = (
+                cache_offset_us if cache_offset_us is not None
+                else replica.clock_offset_us)
             replica.trace_cache_t = time.monotonic()
+
+    def _last_gasp_scrape(self, replica: _Replica,
+                          epoch_offset_us: Optional[float]) -> None:
+        """ISSUE 11 satellite — one immediate bounded
+        ``/v1/trace?since_seq=`` delta fetch the moment the breaker
+        opens, BEFORE giving the replica up: the periodic trace cache
+        refreshes on the METRICS tick, so a replica that died within
+        one metrics interval of a request's only spans would leave a
+        thin dead lane in the stitched trace (the PR 10 known gap).
+        A truly SIGKILLed process refuses the connection in
+        milliseconds and we give up; a replica the breaker declared
+        dead for softer reasons — wedged healthz, data-plane stream
+        breaks, drain-then-die — often still answers its trace
+        endpoint, and its final spans land in the cache with the
+        pre-death epoch's clock offset."""
+        self.tracer.incr("router_last_gasp_scrapes")
+        probe = self._replica_client(replica, read_timeout_s=2.0)
+        since = replica.trace_seq
+        try:
+            doc = probe.trace_events(since_seq=since)
+        except Exception:
+            return  # actually dead: the cache keeps what it had
+        self._merge_trace_delta(replica, doc,
+                                cache_offset_us=epoch_offset_us,
+                                since_seq=since)
+        self.tracer.incr("router_last_gasp_hits")
 
     def _note_alive(self, replica: _Replica,
                     payload: Dict[str, Any]) -> None:
@@ -727,6 +789,8 @@ class ServingRouter:
         """One failed health scrape OR data-plane break: the breaker
         counts both, so a dying replica is detected by whichever
         surface hits it first."""
+        became_dead = False
+        epoch_offset_us: Optional[float] = None
         with self._lock:
             if replica.decommissioned:
                 return
@@ -734,6 +798,8 @@ class ServingRouter:
             was = replica.state
             if (replica.failures >= self.failure_threshold
                     or was in ("dead", "half-open")):
+                became_dead = was not in ("dead", "half-open")
+                epoch_offset_us = replica.clock_offset_us
                 self._breaker_instant(replica, was, "dead")
                 replica.state = "dead"
                 replica.next_probe_t = (time.monotonic()
@@ -755,6 +821,15 @@ class ServingRouter:
             elif was == "live":
                 self._breaker_instant(replica, was, "degraded")
                 replica.state = "degraded"
+        if became_dead and self.fleet_trace and not self._stopped:
+            # last-gasp trace scrape (ISSUE 11 satellite): off the
+            # caller's thread — _note_failure fires from the health
+            # loop AND data-plane relays, neither of which may stall
+            # on a bounded fetch against a dying peer
+            threading.Thread(
+                target=self._last_gasp_scrape,
+                args=(replica, epoch_offset_us), daemon=True,
+                name=f"last-gasp-{replica.replica_id}").start()
 
     # -- routing -------------------------------------------------------
     def _affinity_key(self, prompt: Sequence[int]) -> Optional[bytes]:
@@ -1718,6 +1793,106 @@ class ServingRouter:
             "router": router_info,
         }, 200, close=True)
 
+    # -- elastic fleet surface (ISSUE 11 tentpole) -----------------------
+    def add_replica(self, address: str,
+                    replica_id: Optional[str] = None) -> str:
+        """Runtime scale-up: register one more gateway replica and
+        atomically swap it into the rendezvous set — the append
+        happens under the router lock, the same lock every ``_pick``
+        ranks candidates under, so a pick sees either the old set or
+        the new set, never a torn one. By the rendezvous property the
+        new replica claims ONLY the affinity keys that rank it first;
+        every other key keeps its owner, and streams already in
+        flight stay pinned to the replica they were picked onto (no
+        mid-stream migration — routing is decided per attempt, not
+        per token).
+
+        ``replica_id`` should be the replica's configured stable id:
+        affinity keys hash against it, and passing it here (instead
+        of waiting for the first health scrape to learn it) means the
+        keyspace the new replica will own is its FINAL keyspace from
+        the first pick. The newcomer joins DEGRADED — routable, but
+        ``live`` is earned by its first successful health scrape, so
+        a caller that waits for ``replica_status`` to show ``live``
+        (the fleet controller does, after its warmup handshake) is
+        waiting on a real health round-trip, not the optimistic
+        default a dead-on-arrival replica would also show."""
+        replica = _Replica(address)
+        replica.state = "degraded"
+        if replica_id is not None:
+            replica.replica_id = str(replica_id)
+        with self._lock:
+            for r in self._replicas:
+                if r.decommissioned:
+                    continue
+                if r.address == replica.address:
+                    raise ValueError(
+                        f"replica {replica.address} already "
+                        "registered")
+                if r.replica_id == replica.replica_id:
+                    raise ValueError(
+                        f"replica id {replica.replica_id!r} already "
+                        "registered (affinity keys hash against ids "
+                        "— duplicates would fork one keyspace)")
+            self._replicas.append(replica)
+            self._breaker_instant(replica, "new", "degraded")
+        self.tracer.incr("router_replicas_added")
+        return replica.replica_id
+
+    def remove_replica(self, replica_id: str) -> Dict[str, Any]:
+        """Forget a replica that is already out of rotation
+        (decommissioned or dead): the health loop stops probing it,
+        it stops occupying a stitched-trace lane, and its address
+        becomes reusable. Removing a live/draining replica is
+        refused — drain it first (``drain_replica``), so its
+        in-flight work hands off through the replay path instead of
+        vanishing with the registration."""
+        with self._lock:
+            matches = [r for r in self._replicas
+                       if replica_id in (r.replica_id, r.address)]
+            if not matches:
+                raise KeyError(f"unknown replica {replica_id!r}")
+            # when a reused address/id matches both a stale
+            # decommissioned entry and a live replica, removal means
+            # the out-of-rotation one
+            removable = [r for r in matches
+                         if r.decommissioned or r.state == "dead"]
+            replica = (removable or matches)[0]
+            if not (replica.decommissioned
+                    or replica.state == "dead"):
+                raise ValueError(
+                    f"replica {replica.replica_id} is "
+                    f"{replica.state}; drain it before removing")
+            self._replicas.remove(replica)
+            status = replica.status()
+        self.tracer.incr("router_replicas_removed")
+        return status
+
+    def live_affinity_prompts(self, cap: int = 8
+                              ) -> List[List[int]]:
+        """The fleet's WARM working set, from the journal: the
+        block-aligned prompt prefixes of the most recently submitted
+        affinity-eligible requests, deduped by affinity key, newest
+        first. The fleet controller feeds these to a booting
+        replica's ``/v1/warmup`` so a rolling upgrade's replacement
+        joins the rendezvous set with its prefix cache already
+        holding the keys it is about to own."""
+        out: List[List[int]] = []
+        seen: Set[bytes] = set()
+        with self._lock:
+            entries = list(self._journal.values())
+        for entry in reversed(entries):
+            key = self._affinity_key(entry.prompt)
+            if key is None or key in seen:
+                continue
+            seen.add(key)
+            b = self.affinity_block_tokens
+            n = (len(entry.prompt) // b) * b
+            out.append([int(t) for t in entry.prompt[:n]])
+            if len(out) >= cap:
+                break
+        return out
+
     def drain_replica(self, replica_id: str,
                       timeout_s: Optional[float] = None
                       ) -> Dict[str, Any]:
@@ -1728,35 +1903,88 @@ class ServingRouter:
         fail over to survivors through the normal replay path, so
         from every client's point of view the requests simply
         continue. Returns the replica's drain summary plus the
-        journal entries that were still open on it at drain time."""
+        journal entries that were still open on it at drain time.
+
+        IDEMPOTENT (ISSUE 11 satellite): the fleet controller and an
+        operator will race on this. The first drain owns the work;
+        any later or concurrent drain of the same replica waits for
+        it and returns the FIRST drain's summary (same
+        ``carried_ids``) instead of double-draining or erroring."""
         with self._lock:
             matches = [r for r in self._replicas
                        if replica_id in (r.replica_id, r.address)]
             if not matches:
                 raise KeyError(f"unknown replica {replica_id!r}")
-            replica = matches[0]
-            self._breaker_instant(replica, replica.state, "draining")
-            replica.state = "draining"
-            handed_off = [e.rid for e in self._journal.values()
-                          if not e.done.is_set()
-                          and e.replica_address == replica.address]
+            # a reused address/id may leave a RETAINED decommissioned
+            # registration alongside the live one (add_replica allows
+            # the reuse); the drain the caller means is the active
+            # replica's, never the stale entry's already-done summary
+            active = [r for r in matches if not r.decommissioned]
+            replica = (active or matches)[0]
+            # capture the latch under the SAME lock that reads
+            # drain_started: the failure path swaps in a fresh Event,
+            # and a waiter that saw drain_started must wait on the
+            # one that path will set
+            done = replica.drain_done
+            if replica.drain_started:
+                already = True
+            else:
+                already = False
+                replica.drain_started = True
+                self._breaker_instant(replica, replica.state,
+                                      "draining")
+                replica.state = "draining"
+                handed_off = [e.rid for e in self._journal.values()
+                              if not e.done.is_set()
+                              and e.replica_address
+                              == replica.address]
+        if already:
+            done.wait(timeout=600.0)
+            with self._lock:
+                if replica.drain_summary is not None:
+                    return dict(replica.drain_summary)
+                owner_failed = not replica.drain_started
+            if owner_failed:
+                # the owning drain raised and released the latch —
+                # retry as the new owner rather than hand the caller
+                # a success-shaped dict for a drain that never ran
+                return self.drain_replica(replica_id, timeout_s)
+            return {"replica_id": replica.replica_id,
+                    "address": replica.address, "drained": False,
+                    "in_progress": True}
         try:
-            summary = self._replica_client(replica).drain(timeout_s)
-        except (GatewayError, *RETRYABLE_ERRORS) as e:
-            # failed drain = unplanned death: the breaker path takes
-            # over and the same replay machinery rescues the work
-            self._note_failure(replica)
-            summary = {"drained": False, "error": repr(e)}
+            try:
+                summary = self._replica_client(replica).drain(
+                    timeout_s)
+            except (GatewayError, *RETRYABLE_ERRORS) as e:
+                # failed drain = unplanned death: the breaker path
+                # takes over and the same replay machinery rescues
+                # the work
+                self._note_failure(replica)
+                summary = {"drained": False, "error": repr(e)}
+        except BaseException:
+            # anything unexpected must release the latch retryably —
+            # a permanently-armed drain_started with no summary would
+            # wedge every later drain of this replica
+            with self._lock:
+                replica.drain_started = False
+                done, replica.drain_done = (replica.drain_done,
+                                            threading.Event())
+            done.set()
+            raise
         with self._lock:
             self._breaker_instant(replica, replica.state, "dead")
             replica.state = "dead"
             replica.decommissioned = True
             self.stats["drained_replicas"] += 1
             self.tracer.incr("router_drained_replicas")
-        return {"replica_id": replica.replica_id,
-                "address": replica.address,
-                "open_requests_handed_off": handed_off,
-                "drain": summary}
+            out = {"replica_id": replica.replica_id,
+                   "address": replica.address,
+                   "open_requests_handed_off": handed_off,
+                   "drain": summary}
+            replica.drain_summary = out
+            replica.drain_done.set()
+        return dict(out)
 
     def _handle_drain_replica(self, handler) -> None:
         try:

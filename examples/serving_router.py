@@ -23,6 +23,13 @@ and fronts them with the
    survivor's live, both skew-corrected onto the router clock, with
    the bridging `router.replay` span), and fleet p50/p99 TTFT from
    `GET /v1/fleet/metrics` (replica histograms merged bucket-wise).
+5. **Elastic scale-up under load (ISSUE 11)** — a burst of concurrent
+   streams overloads the lone survivor; the
+   :class:`~deeplearning4j_tpu.serving.FleetController` sees the
+   pressure breach, spawns a fresh replica through its factory, warms
+   it from the live affinity keys, and swaps it into the rendezvous
+   set — the burst finishes bit-identically and the decision is a
+   `fleet.scale` span on the same stitched trace.
 
 Run: python examples/serving_router.py
 """
@@ -200,7 +207,78 @@ def main():
           f"replay gap p50 "
           f"{fleet['replay_gap']['p50_ms']:.0f}ms")
 
+    # 5. elastic scale-up under load (ISSUE 11): overload the lone
+    # survivor with a burst; the controller breathes the fleet
+    import threading
+
+    from deeplearning4j_tpu.serving import (
+        FleetController,
+        LocalReplica,
+    )
+
+    def spawn_replica(replica_id):
+        engine = DecodeEngine(net, n_slots=4, decode_chunk=2,
+                              prefix_cache_rows=4)
+        orig = engine.step
+
+        def throttled(sink=None):
+            time.sleep(0.06)
+            return orig(sink)
+
+        engine.step = throttled
+        return LocalReplica(engine, replica_id=replica_id)
+
+    controller = FleetController(
+        router, replica_factory=spawn_replica,
+        min_replicas=1, max_replicas=2, eval_interval_s=0.15,
+        pressure_high=1.5, pressure_low=0.3, breach_evals=2,
+        cooldown_s=1.0, id_prefix="elastic").start()
+    n_burst, burst_gen = 8, 16
+    burst_outs = [None] * n_burst
+
+    def one(i):
+        s2 = client.stream(PATTERN[:3], burst_gen)
+        toks = []
+        for delta in s2:
+            toks.extend(delta)
+        burst_outs[i] = toks
+
+    burst = [threading.Thread(target=one, args=(i,))
+             for i in range(n_burst)]
+    for t in burst:
+        t.start()
+    # wait for the scale-up WHILE the burst holds the pressure on —
+    # once the streams finish, pressure is gone and the breach
+    # streak can never start
+    deadline = time.monotonic() + 15
+    while (not any(e["action"] == "up" for e in controller.events)
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    for t in burst:
+        t.join()
+    ups = [e for e in controller.events if e["action"] == "up"]
+    assert ups, ("controller never scaled up within 15s: last "
+                 f"signals {controller.last_signals}")
+    up = ups[0]
+    expected_burst = [PATTERN[(3 + i) % len(PATTERN)]
+                      for i in range(burst_gen)]
+    print(f"elastic  : {n_burst} concurrent streams on 1 replica -> "
+          f"controller scaled UP ({up['reason']}): spawned "
+          f"{up['replica']} (warmed {up['warmed']} affinity "
+          f"prefixes) in {up['dur_s']}s")
+    states = {r["replica_id"]: r["state"]
+              for r in client.healthz()["replicas"]}
+    print(f"           fleet now: {states}")
+    print(f"           burst bit-identical through the scale-up: "
+          f"{all(o == expected_burst for o in burst_outs)}")
+    scale_spans = [e for e in router.tracer.events()
+                   if e.get("name") == "fleet.scale"]
+    print(f"           {len(scale_spans)} fleet.scale span(s) on the "
+          f"stitched trace (lane 0)")
+
+    controller.close()
     router.close()
+    controller.shutdown_fleet()
     for g in replicas:
         try:
             g.close()

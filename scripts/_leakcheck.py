@@ -1,0 +1,84 @@
+"""Shared leaked-resource gates for the soak scripts (ISSUE 11
+satellite: hoisted from the copy-pasted settle loops in
+``scripts/gateway_soak.py`` / ``scripts/router_soak.py``).
+
+Every soak ends the same way: tear the stack down, then prove the
+process is back to its pre-soak baseline — thread count (handler
+threads are socket-timeout bounded, steppers/health loops join on
+close) and fd count (sockets; a small slack with a settle loop
+absorbs TIME_WAIT and interpreter-internal churn). One definition
+here so a new soak cannot fork the policy by copy-paste.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: fd slack every soak allows: TIME_WAIT sockets and interpreter
+#: internals churn a couple of fds even in a leak-free run
+FD_SLACK = 2
+
+
+def leak_baseline() -> Dict[str, Optional[int]]:
+    """Snapshot thread/fd counts BEFORE the stack under test exists
+    (call it before building gateways/routers/subprocesses)."""
+    fds = (len(os.listdir("/proc/self/fd"))
+           if os.path.isdir("/proc/self/fd") else None)
+    return {"threads": threading.active_count(), "fds": fds}
+
+
+def settle_threads(baseline_threads: int,
+                   timeout_s: float = 30.0) -> int:
+    """Wait for the thread count to settle back to baseline (handler
+    threads drain on their socket timeouts); returns the residual
+    leak count (<= 0 means clean)."""
+    deadline = time.monotonic() + timeout_s
+    while (threading.active_count() > baseline_threads
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    return threading.active_count() - baseline_threads
+
+
+def settle_fds(baseline_fds: int, slack: int = FD_SLACK,
+               timeout_s: float = 20.0) -> int:
+    """Wait for the fd count to settle within ``slack`` of baseline
+    (TIME_WAIT needs a beat); returns the residual leak count."""
+    leaked = 0
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        leaked = len(os.listdir("/proc/self/fd")) - baseline_fds
+        if leaked <= slack:
+            break
+        time.sleep(0.2)
+    return leaked
+
+
+def assert_no_leaks(baseline: Dict[str, Optional[int]],
+                    fd_slack: int = FD_SLACK,
+                    subprocesses: Optional[List[Any]] = None
+                    ) -> Dict[str, int]:
+    """The shared gate: threads back to baseline, fds within slack,
+    and (when ``subprocesses`` — Popen-bearing handles — are given)
+    every child process actually exited. Raises AssertionError on
+    any violation; returns the residual counts for the summary."""
+    leaked = settle_threads(baseline["threads"])
+    assert leaked <= 0, (
+        f"{leaked} leaked threads: "
+        f"{[t.name for t in threading.enumerate()]}")
+    leaked_fds = 0
+    if baseline["fds"] is not None:
+        leaked_fds = settle_fds(baseline["fds"], slack=fd_slack)
+        assert leaked_fds <= fd_slack, f"{leaked_fds} leaked fds"
+    leaked_procs = []
+    for h in subprocesses or []:
+        proc = getattr(h, "proc", None)
+        if proc is not None and proc.poll() is None:
+            leaked_procs.append(getattr(h, "replica_id", repr(h)))
+    assert not leaked_procs, (
+        f"leaked subprocess replicas: {leaked_procs}")
+    return {"leaked_threads": max(leaked, 0),
+            "leaked_fds": max(leaked_fds, 0),
+            "leaked_subprocesses": len(leaked_procs)}

@@ -1,0 +1,309 @@
+"""Diurnal-load fleet soak (ISSUE 11 acceptance gate): the fleet
+BREATHES.
+
+Traffic against a controller-managed fleet ramps 10× up and back
+down, the way real serving load does across a day. The
+:class:`~deeplearning4j_tpu.serving.FleetController` must track it:
+
+- the ramp-up violates the SLOs (in-flight pressure and windowed
+  TTFT p99) → the controller scales the fleet UP (≥1 scale-up
+  event), warming each new replica from live affinity keys;
+- the SLO breach RECOVERS within the cooldown budget once capacity
+  lands (the ``recovered_after_s`` stamp on the scale-up event);
+- the ramp-down leaves the fleet idle → the controller drains
+  surplus replicas back down (≥1 scale-down event) through the
+  replay-backed idempotent drain — in-flight streams on the drained
+  replica finish bit-identically on survivors;
+- the whole scaling timeline is visible as ``fleet.scale`` spans on
+  the stitched ``/v1/trace`` (router lane), next to the traffic that
+  caused it;
+- zero lost requests, zero double delivery, bit-identical greedy
+  completion vs the fault-free single-engine reference, zero leaked
+  threads/fds/subprocesses — scale events inherit the suite's
+  correctness discipline.
+
+Two modes: ``--fast`` (tier-1, tests/test_fleet_controller.py) runs
+in-process replicas; full (``slow``) spawns real subprocess replicas
+— the controller pays real process boot on every scale-up.
+
+Run standalone: ``python scripts/fleet_soak.py [--fast]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from scripts.router_soak import (  # noqa: E402
+    ENGINE,
+    VOCAB,
+    _build_net,
+    build_soak_engine,
+    spawn_soak_replica,
+)
+
+
+def run_soak(seed: int = 0, in_process: bool = True,
+             throttle: float = 0.03, high_clients: int = 10,
+             low_dwell_s: float = 0.5, high_dwell_s: float = 1.2,
+             recovery_budget_s: Optional[float] = None,
+             verbose: bool = False) -> Dict[str, Any]:
+    """One seeded diurnal soak; returns a summary dict, raises
+    AssertionError on any gate violation."""
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        FleetController,
+        LocalReplica,
+        Request,
+        RouterClient,
+        ServingRouter,
+    )
+    from deeplearning4j_tpu.serving.replica_proc import ReplicaProcess
+    from scripts._leakcheck import assert_no_leaks, leak_baseline
+
+    rng = np.random.default_rng(seed)
+    # fixed greedy prompt pool: one shared-prefix cohort (the warm
+    # keys new replicas are primed with) + singles
+    cohort = rng.integers(0, VOCAB, 8).tolist()
+    pool: List = []
+    for k in range(6):
+        if k % 2 == 0:
+            p = (cohort + rng.integers(
+                0, VOCAB, int(rng.integers(1, 4))).tolist())
+        else:
+            p = rng.integers(0, VOCAB,
+                             int(rng.integers(4, 10))).tolist()
+        pool.append((p, int(rng.integers(10, 16))))
+
+    net = _build_net()
+    ref_eng = DecodeEngine(net, **ENGINE)
+    ref_ids = {k: ref_eng.submit(Request(list(p), n))
+               for k, (p, n) in enumerate(pool)}
+    ref_res = ref_eng.run()
+    ref_tokens = {k: ref_res[rid].tokens
+                  for k, rid in ref_ids.items()}
+
+    baseline = leak_baseline()
+
+    def factory(replica_id: str):
+        if in_process:
+            return LocalReplica(build_soak_engine(net, throttle),
+                                replica_id=replica_id)
+        return spawn_soak_replica(replica_id, throttle)
+
+    seed_rep = factory("seed-0")
+    router = ServingRouter(
+        [seed_rep.address], affinity_block_tokens=4,
+        health_interval_s=0.1, probe_interval_s=0.5,
+        metrics_every=1, failure_threshold=2).start()
+    controller = FleetController(
+        router, replica_factory=factory,
+        min_replicas=1, max_replicas=3,
+        eval_interval_s=0.15, ttft_p99_slo_s=0.6,
+        pressure_high=1.5, pressure_low=0.4,
+        breach_evals=2, idle_evals=6, cooldown_s=1.0,
+        drain_timeout_s=0.3,
+        await_live_timeout_s=240.0, id_prefix="auto")
+    controller.adopt(seed_rep)
+    controller.start()
+    client = RouterClient(router.address, timeout_s=240.0)
+    if recovery_budget_s is None:
+        # the fleet must absorb a breach within the cooldown window
+        # plus a few evaluation ticks of measurement lag
+        recovery_budget_s = (controller.cooldown_s
+                             + 6 * controller.eval_interval_s)
+    t0 = time.perf_counter()
+
+    # -- the diurnal load generator: N workers, only the first
+    # ``conc`` of them active at any moment ---------------------------
+    phase = {"conc": 1}
+    stop = threading.Event()
+    outcomes: List[Dict[str, Any]] = []
+    out_lock = threading.Lock()
+    timeline: List = []
+
+    def worker(w: int) -> None:
+        it = 0
+        while not stop.is_set():
+            if w >= phase["conc"]:
+                time.sleep(0.02)
+                continue
+            k = (w + it) % len(pool)
+            it += 1
+            p, n = pool[k]
+            rec: Dict[str, Any] = {"pool": k, "tokens": []}
+            try:
+                s = client.stream(list(p), n)
+                for delta in s:
+                    rec["tokens"].extend(delta)
+                rec["final"] = s.result
+                rec["result"] = (s.result or {}).get(
+                    "finish_reason")
+            except Exception as e:  # no worker may die silently
+                rec["result"] = f"crash:{type(e).__name__}:{e}"
+            with out_lock:
+                outcomes.append(rec)
+
+    workers = [threading.Thread(target=worker, args=(w,),
+                                name=f"fleet-soak-{w}")
+               for w in range(high_clients)]
+    for t in workers:
+        t.start()
+
+    def set_conc(conc: int) -> None:
+        phase["conc"] = conc
+        timeline.append((round(time.perf_counter() - t0, 2), conc))
+
+    def ups():
+        return [e for e in controller.events
+                if e["action"] == "up"]
+
+    def downs():
+        return [e for e in controller.events
+                if e["action"] == "down"]
+
+    # trough → 10× peak (hold until the controller scaled up) →
+    # trough (hold until it scaled back down)
+    set_conc(1)
+    time.sleep(low_dwell_s)
+    set_conc(high_clients)
+    time.sleep(high_dwell_s)
+    deadline = time.monotonic() + (60 if in_process else 300)
+    while not ups() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert ups(), (
+        f"controller never scaled up under {high_clients}x load: "
+        f"last signals {controller.last_signals}")
+    # keep the peak until the breach recovers (the recovery stamp is
+    # part of the acceptance), then ramp down
+    deadline = time.monotonic() + (60 if in_process else 300)
+    while (ups()[-1].get("recovered_after_s") is None
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    set_conc(1)
+    deadline = time.monotonic() + 90
+    while not downs() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    stop.set()
+    for t in workers:
+        t.join(timeout=240)
+    assert not any(t.is_alive() for t in workers), "worker hang"
+    wall_s = time.perf_counter() - t0
+
+    # -- gates ---------------------------------------------------------
+    assert downs(), (
+        f"controller never scaled back down: events "
+        f"{controller.events}, last {controller.last_signals}")
+    # SLO recovery within the cooldown budget: the breach that drove
+    # the LAST scale-up cleared once its capacity landed
+    last_up = ups()[-1]
+    assert last_up.get("recovered_after_s") is not None, (
+        f"scale-up breach never recovered: {controller.events}")
+    assert last_up["recovered_after_s"] <= recovery_budget_s, (
+        f"breach took {last_up['recovered_after_s']}s to recover "
+        f"> budget {recovery_budget_s}s")
+
+    crashes = [o for o in outcomes
+               if str(o["result"]).startswith("crash")]
+    assert not crashes, f"worker crashes: {crashes[:3]}"
+
+    audit = router.journal_audit()
+    assert audit["open"] == [], f"journal still open: {audit['open']}"
+    assert audit["lost"] == [], f"journal lost: {audit['lost']}"
+
+    completed = parity_ok = 0
+    for rec in outcomes:
+        final = rec.get("final") or {}
+        if final.get("tokens") is not None:
+            assert rec["tokens"] == final["tokens"], (
+                f"pool {rec['pool']}: streamed != terminal "
+                "(double delivery?)")
+        if rec["result"] in ("length", "eos"):
+            completed += 1
+            assert rec["tokens"] == ref_tokens[rec["pool"]], (
+                f"pool {rec['pool']} diverged from the fault-free "
+                f"reference (replays {final.get('replays')})")
+            parity_ok += 1
+        elif rec["result"] not in ("shed",):
+            raise AssertionError(
+                f"unexpected terminal {rec['result']!r}")
+    assert completed >= high_clients, (
+        f"only {completed} completed streams across the ramp")
+
+    # the scaling timeline rides the stitched trace: fleet.scale
+    # spans on the router lane, both directions
+    doc = client.trace_events()
+    scale_spans = [e for e in doc["traceEvents"]
+                   if e.get("name") == "fleet.scale"
+                   and e.get("pid") == 0]
+    actions = [(e.get("args") or {}).get("action")
+               for e in scale_spans]
+    assert "up" in actions and "down" in actions, (
+        f"fleet.scale spans missing a direction: {actions}")
+    assert len(scale_spans) >= len(controller.events), (
+        f"{len(scale_spans)} fleet.scale spans < "
+        f"{len(controller.events)} controller events")
+
+    controller.close()
+    router.close()
+    procs = [h for h in controller._handles.values()
+             if isinstance(h, ReplicaProcess)]
+    controller.shutdown_fleet()
+    leaks = assert_no_leaks(baseline, subprocesses=procs)
+
+    summary = {
+        "seed": seed,
+        "mode": "in-process" if in_process else "subprocess",
+        "wall_s": round(wall_s, 2),
+        "streams_total": len(outcomes),
+        "completed": completed,
+        "greedy_parity_ok": parity_ok,
+        "scale_ups": len(ups()),
+        "scale_downs": len(downs()),
+        "recovered_after_s": last_up["recovered_after_s"],
+        "recovery_budget_s": round(recovery_budget_s, 2),
+        "peak_live": max(e["n_live"] for e in controller.events),
+        "events": [
+            {k: e.get(k) for k in ("t_s", "action", "replica",
+                                   "n_live", "reason")}
+            for e in controller.events],
+        "load_timeline": timeline,
+        "controller_evals": controller.stats["evals"],
+        "controller_errors": controller.stats["errors"],
+        **leaks,
+    }
+    if verbose:
+        for k, v in summary.items():
+            print(f"  {k}: {v}")
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1-sized in-process variant")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    summary = run_soak(seed=args.seed, in_process=args.fast,
+                       verbose=True)
+    print(f"fleet soak PASSED: {summary['scale_ups']} up / "
+          f"{summary['scale_downs']} down (peak "
+          f"{summary['peak_live']} replicas), breach recovered in "
+          f"{summary['recovered_after_s']}s "
+          f"(budget {summary['recovery_budget_s']}s), "
+          f"{summary['completed']} streams completed bit-identical, "
+          f"in {summary['wall_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -125,7 +125,9 @@ def run_soak(n_clients: int = 48, seed: int = 0, vocab: int = 12,
     ref = ref_eng.run()
     ref_tokens = [ref[rid].tokens for rid in ref_ids]
 
-    baseline_threads = threading.active_count()
+    from scripts._leakcheck import assert_no_leaks, leak_baseline
+
+    baseline = leak_baseline()
     plan = FaultPlan.random(seed, rounds=40 * n_clients,
                             rate=fault_rate)
     gw = ServingGateway(build(plan), keepalive_s=0.1,
@@ -266,16 +268,10 @@ def run_soak(n_clients: int = 48, seed: int = 0, vocab: int = 12,
     assert counts["chunk_prefill"] == 1, counts
 
     gw.close()
-    # zero leaked threads: handler threads are timeout-bounded, the
-    # stepper and server threads join in close()
-    deadline = time.monotonic() + 30
-    while (threading.active_count() > baseline_threads
-           and time.monotonic() < deadline):
-        time.sleep(0.05)
-    leaked = threading.active_count() - baseline_threads
-    assert leaked <= 0, (
-        f"{leaked} leaked threads: "
-        f"{[t.name for t in threading.enumerate()]}")
+    # zero leaked threads (shared settle-loop gate —
+    # scripts/_leakcheck.py): handler threads are timeout-bounded,
+    # the stepper and server threads join in close()
+    leaks = assert_no_leaks(baseline)
 
     summary = {
         "n_clients": n_clients,
@@ -292,7 +288,7 @@ def run_soak(n_clients: int = 48, seed: int = 0, vocab: int = 12,
         "engine_cancelled": eng.stats["cancelled"],
         "traced": traced,
         "trace_events": len(trace_doc["traceEvents"]),
-        "leaked_threads": max(leaked, 0),
+        "leaked_threads": leaks["leaked_threads"],
         "compile_counts": counts,
     }
     if verbose:

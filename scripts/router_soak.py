@@ -59,12 +59,10 @@ import contextlib
 import http.client
 import os
 import signal
-import socket
-import subprocess
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -101,14 +99,6 @@ def _throttle(engine, delay_s: float) -> None:
         return orig(sink)
 
     engine.step = slow
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _workload(rng, n_clients: int):
@@ -157,86 +147,83 @@ def run_replica(args) -> int:
     return 0
 
 
-class _ProcReplica:
-    """A subprocess replica and the handle to kill it with."""
-
-    def __init__(self, idx: int, throttle: float):
-        self.replica_id = f"rep-{idx}"
-        self.port = _free_port()
-        self.address = f"127.0.0.1:{self.port}"
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        env.pop("XLA_FLAGS", None)
-        self.proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--replica",
-             "--port", str(self.port), "--replica-id",
-             self.replica_id, "--throttle", str(throttle)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            env=env, cwd=os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))))
-
-    def wait_ready(self, timeout_s: float = 120.0) -> None:
-        # readline() blocks with no deadline of its own, so a wedged
-        # child (stuck in XLA init, never printing READY and never
-        # exiting) would hang the soak forever — read on a reaper
-        # thread and enforce the deadline with join()
-        result: Dict[str, str] = {}
-
-        def read():
-            while True:
-                line = self.proc.stdout.readline().decode()
-                if not line or line.startswith("READY"):
-                    result["line"] = line
-                    return
-
-        t = threading.Thread(target=read, daemon=True)
-        t.start()
-        t.join(timeout=timeout_s)
-        if result.get("line", "").startswith("READY"):
-            return
-        raise RuntimeError(
-            f"replica {self.replica_id} never became ready within "
-            f"{timeout_s}s (last output {result.get('line')!r})")
-
-    def sigkill(self) -> None:
-        self.proc.kill()  # SIGKILL: no drain, no cleanup, no goodbye
-        self.proc.wait(timeout=30)
-
-    def shutdown(self) -> None:
-        if self.proc.poll() is None:
-            self.proc.terminate()
-            try:
-                self.proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.proc.wait(timeout=10)
-        self.proc.stdout.close()
+def soak_replica_argv(port: int, replica_id: str,
+                      throttle: float) -> List[str]:
+    """Child argv for one subprocess soak replica: this same script
+    in ``--replica`` mode, building the identical net from the shared
+    seed. The upgrade soak reuses it to boot "new-binary" replicas
+    with fresh stable ids."""
+    return [sys.executable, os.path.abspath(__file__), "--replica",
+            "--port", str(port), "--replica-id", str(replica_id),
+            "--throttle", str(throttle)]
 
 
-class _LocalReplica:
+def spawn_soak_replica(replica_id: str, throttle: float = 0.04,
+                       wait: bool = True):
+    """One subprocess soak replica — the replica factory shape the
+    fleet controller scales with (serving/replica_proc.py).
+    ``wait=False`` returns it UNREADY so a caller booting a whole
+    fleet can overlap the children's XLA init
+    (spawn-all-then-wait-all)."""
+    from deeplearning4j_tpu.serving.replica_proc import (
+        ReplicaProcess,
+        free_port,
+    )
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    port = free_port()
+    proc = ReplicaProcess(
+        soak_replica_argv(port, replica_id, throttle),
+        replica_id=replica_id, port=port, env=env,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    if wait:
+        proc.wait_ready()
+    return proc
+
+
+def _ProcReplica(idx: int, throttle: float):
+    """A subprocess replica and the handle to kill it with (now the
+    hoisted :class:`serving.replica_proc.ReplicaProcess` — ISSUE 11
+    satellite). NOT yet ready: the soak overlaps the children's XLA
+    init by spawning all, then waiting all."""
+    from deeplearning4j_tpu.serving.replica_proc import (
+        ReplicaProcess,
+        free_port,
+    )
+
+    replica_id = f"rep-{idx}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    port = free_port()
+    return ReplicaProcess(
+        soak_replica_argv(port, replica_id, throttle),
+        replica_id=replica_id, port=port, env=env,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+
+
+def build_soak_engine(net=None, throttle: float = 0.0):
+    """One soak-configured engine over the shared-seed net (in-process
+    replicas; the upgrade/diurnal soaks reuse it as their engine
+    factory)."""
+    from deeplearning4j_tpu.serving import DecodeEngine
+
+    engine = DecodeEngine(net if net is not None else _build_net(),
+                          **ENGINE)
+    if throttle > 0:
+        _throttle(engine, throttle)
+    return engine
+
+
+def _LocalReplica(idx: int, net, throttle: float):
     """In-process replica (fast mode): a gateway whose ``hard_kill``
-    is the SIGKILL stand-in."""
+    is the SIGKILL stand-in (hoisted LocalReplica)."""
+    from deeplearning4j_tpu.serving.replica_proc import LocalReplica
 
-    def __init__(self, idx: int, net, throttle: float):
-        from deeplearning4j_tpu.serving import (
-            DecodeEngine,
-            ServingGateway,
-        )
-
-        engine = DecodeEngine(net, **ENGINE)
-        if throttle > 0:
-            _throttle(engine, throttle)
-        self.replica_id = f"rep-{idx}"
-        self.gw = ServingGateway(engine, replica_id=self.replica_id,
-                                 keepalive_s=0.1).start()
-        self.address = (f"{self.gw._service.host}:"
-                        f"{self.gw._service.port}")
-
-    def sigkill(self) -> None:
-        self.gw.hard_kill()
-
-    def shutdown(self) -> None:
-        with contextlib.suppress(Exception):
-            self.gw.close()
+    return LocalReplica(build_soak_engine(net, throttle),
+                        replica_id=f"rep-{idx}")
 
 
 # ---------------------------------------------------------------------------
@@ -271,9 +258,9 @@ def run_soak(n_clients: int = 24, n_replicas: int = 3, seed: int = 0,
     ref_tokens = {i: ref_res[rid].tokens
                   for i, rid in ref_ids.items()}
 
-    baseline_threads = threading.active_count()
-    baseline_fds = (len(os.listdir("/proc/self/fd"))
-                    if os.path.isdir("/proc/self/fd") else None)
+    from scripts._leakcheck import assert_no_leaks, leak_baseline
+
+    baseline = leak_baseline()
 
     if in_process:
         replicas: List[Any] = [_LocalReplica(i, net, throttle)
@@ -573,28 +560,10 @@ def run_soak(n_clients: int = 24, n_replicas: int = 3, seed: int = 0,
     for r in replicas:
         r.shutdown()
 
-    # zero leaked threads
-    deadline = time.monotonic() + 30
-    while (threading.active_count() > baseline_threads
-           and time.monotonic() < deadline):
-        time.sleep(0.05)
-    leaked = threading.active_count() - baseline_threads
-    assert leaked <= 0, (
-        f"{leaked} leaked threads: "
-        f"{[t.name for t in threading.enumerate()]}")
-
-    # zero leaked sockets (fd count back to baseline; small slack for
-    # interpreter-internal churn, with a settle loop for TIME_WAIT)
-    leaked_fds = 0
-    if baseline_fds is not None:
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline:
-            leaked_fds = (len(os.listdir("/proc/self/fd"))
-                          - baseline_fds)
-            if leaked_fds <= 2:
-                break
-            time.sleep(0.2)
-        assert leaked_fds <= 2, f"{leaked_fds} leaked fds"
+    # zero leaked threads / sockets / subprocesses (shared settle-loop
+    # gate — scripts/_leakcheck.py, ISSUE 11 satellite)
+    leaks = assert_no_leaks(
+        baseline, subprocesses=[] if in_process else replicas)
 
     summary = {
         "n_clients": n_clients,
@@ -611,8 +580,8 @@ def run_soak(n_clients: int = 24, n_replicas: int = 3, seed: int = 0,
         "inflight_at_kill": chaos["inflight_at_kill"],
         "drained": chaos["drained"],
         "router_stats": dict(router.stats),
-        "leaked_threads": max(leaked, 0),
-        "leaked_fds": max(leaked_fds, 0),
+        "leaked_threads": leaks["leaked_threads"],
+        "leaked_fds": leaks["leaked_fds"],
         "endpoint_scrapes": dict(endpoint_hits),
         "endpoint_5xx": len(endpoint_5xx),
         "request_traces_proxied": traces_proxied,

@@ -1,0 +1,343 @@
+"""Upgrade-under-churn chaos soak (ISSUE 11 acceptance gate).
+
+A full ZERO-DOWNTIME rolling upgrade — every replica replaced by a
+factory-fresh one under a new stable id, prefix caches warmed from
+live affinity keys, rendezvous keyspace shifted one replica at a
+time, old replicas drained through the journal replay path — while
+streaming clients churn continuously, with one replica SIGKILLed
+mid-upgrade (the upgrade must absorb an UNPLANNED death inside a
+PLANNED migration).
+
+Pass criteria:
+
+- **zero lost requests**: every stream reaches a terminal; the
+  router journal shows nothing open and nothing lost;
+- **zero double delivery**: each client's streamed concat equals its
+  terminal ``tokens`` exactly;
+- **bit-identical greedy completion**: every COMPLETED greedy stream
+  — including those that lived through a drain handoff or the
+  SIGKILL — matches the fault-free single-engine reference bit for
+  bit;
+- **the PR 3/5 sampling contract**: sampling streams broken after
+  streaming terminate ``fault``, never a silently redrawn tail;
+- **the upgrade completed**: every v1 replica decommissioned, the
+  live set is entirely v2, one ``fleet.scale`` upgrade span per
+  replaced replica on the stitched trace, and at least one
+  replacement was warmed from live affinity keys;
+- **zero leaked threads/fds/subprocesses** (scripts/_leakcheck.py).
+
+Two modes, like the router soak: ``--fast`` (tier-1,
+tests/test_upgrade_soak.py) runs in-process replicas with
+``hard_kill`` as the SIGKILL stand-in; full (``slow``) runs real
+subprocess replicas and a real ``SIGKILL``.
+
+Run standalone: ``python scripts/upgrade_soak.py [--fast]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from scripts.router_soak import (  # noqa: E402
+    ENGINE,
+    _build_net,
+    _workload,
+    build_soak_engine,
+    spawn_soak_replica,
+)
+
+
+def run_soak(n_clients: int = 14, n_replicas: int = 2, seed: int = 0,
+             in_process: bool = True, throttle: float = 0.04,
+             min_inflight_at_upgrade: int = 8,
+             verbose: bool = False) -> Dict[str, Any]:
+    """One seeded upgrade-under-churn soak; returns a summary dict,
+    raises AssertionError on any gate violation."""
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        FleetController,
+        LocalReplica,
+        Request,
+        RouterClient,
+        ServingRouter,
+    )
+    from deeplearning4j_tpu.serving.replica_proc import ReplicaProcess
+    from scripts._leakcheck import assert_no_leaks, leak_baseline
+
+    rng = np.random.default_rng(seed)
+    cases = _workload(rng, n_clients)
+
+    # fault-free single-engine reference: what every completed greedy
+    # stream must match bit for bit, upgrade or no upgrade
+    net = _build_net()
+    ref_eng = DecodeEngine(net, **ENGINE)
+    greedy_idx = [i for i, (_, _, t) in enumerate(cases) if t == 0]
+    ref_ids = {i: ref_eng.submit(Request(list(cases[i][0]),
+                                         cases[i][1]))
+               for i in greedy_idx}
+    ref_res = ref_eng.run()
+    ref_tokens = {i: ref_res[rid].tokens
+                  for i, rid in ref_ids.items()}
+
+    baseline = leak_baseline()
+
+    def factory(replica_id: str):
+        if in_process:
+            return LocalReplica(build_soak_engine(net, throttle),
+                                replica_id=replica_id)
+        return spawn_soak_replica(replica_id, throttle)
+
+    # v1 fleet (overlapped boot in subprocess mode)
+    if in_process:
+        v1: List[Any] = [factory(f"v1-{i}")
+                         for i in range(n_replicas)]
+    else:
+        v1 = [spawn_soak_replica(f"v1-{i}", throttle, wait=False)
+              for i in range(n_replicas)]
+        for r in v1:
+            r.wait_ready()
+
+    router = ServingRouter(
+        [r.address for r in v1], affinity_block_tokens=4,
+        health_interval_s=0.1, probe_interval_s=0.5,
+        metrics_every=1, failure_threshold=2).start()
+    controller = FleetController(
+        router, replica_factory=factory, min_replicas=1,
+        max_replicas=n_replicas + 1, warm_on_scale=True,
+        drain_timeout_s=0.3, await_live_timeout_s=180.0,
+        id_prefix="v2")
+    for r in v1:
+        controller.adopt(r)
+    client = RouterClient(router.address, timeout_s=240.0)
+    t0 = time.perf_counter()
+
+    # -- churn: every client loops streams until the upgrade is done
+    # (so streams are in flight through EVERY upgrade step) ----------
+    upgrade_done = threading.Event()
+    outcomes: List[Dict[str, Any]] = []
+    out_lock = threading.Lock()
+
+    def one_client(i: int) -> None:
+        prompt, n_tokens, temperature = cases[i]
+        runs = 0
+        while runs < 24 and not (upgrade_done.is_set()
+                                 and runs >= 1):
+            runs += 1
+            out: Dict[str, Any] = {"case": i, "tokens": [],
+                                   "temperature": temperature}
+            try:
+                kwargs = ({"temperature": temperature}
+                          if temperature else {})
+                s = client.stream(prompt, n_tokens, **kwargs)
+                for delta in s:
+                    out["tokens"].extend(delta)
+                out["result"] = (s.result or {}).get(
+                    "finish_reason")
+                out["final"] = s.result
+            except Exception as e:  # no client may die silently
+                out["result"] = f"crash:{type(e).__name__}:{e}"
+            with out_lock:
+                outcomes.append(out)
+
+    threads = [threading.Thread(target=one_client, args=(i,),
+                                name=f"upgrade-soak-{i}")
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+
+    # ≥ min_inflight streams actually in flight before the upgrade
+    def open_count() -> int:
+        with router._lock:
+            return sum(1 for e in router._journal.values()
+                       if not e.done.is_set())
+
+    deadline = time.monotonic() + 120
+    while (open_count() < min_inflight_at_upgrade
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    inflight_at_upgrade = open_count()
+    assert inflight_at_upgrade >= min_inflight_at_upgrade, (
+        f"only {inflight_at_upgrade} streams in flight — grow the "
+        "workload or the throttle")
+
+    # -- the rolling upgrade, with a SIGKILL injected mid-flight -----
+    upgrade_out: Dict[str, Any] = {}
+
+    def run_upgrade() -> None:
+        try:
+            upgrade_out.update(controller.rolling_upgrade())
+        except Exception as e:
+            upgrade_out["error"] = repr(e)
+        finally:
+            upgrade_done.set()
+
+    upgrader = threading.Thread(target=run_upgrade,
+                                name="upgrade-soak-upgrader")
+    upgrader.start()
+
+    # chaos: once the FIRST replacement landed, SIGKILL the LAST v1
+    # replica — an unplanned death inside the planned migration; the
+    # upgrade must find it dead at its step and still replace it
+    deadline = time.monotonic() + 240
+    while not controller.events and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert controller.events, "upgrade never completed a step"
+    victim = v1[-1]
+    victim.sigkill()
+    killed_id = victim.replica_id
+
+    upgrader.join(timeout=300)
+    assert not upgrader.is_alive(), "rolling upgrade hung"
+    assert "error" not in upgrade_out, upgrade_out
+    for t in threads:
+        t.join(timeout=240)
+    assert not any(t.is_alive() for t in threads), "client hang"
+    wall_s = time.perf_counter() - t0
+
+    # -- gates ---------------------------------------------------------
+    assert upgrade_out["upgraded"] == n_replicas, upgrade_out
+    status = {s["replica_id"]: s for s in router.replica_status()}
+    live = [rid for rid, s in status.items()
+            if s["state"] in ("live", "degraded")]
+    assert live and all(r.startswith("v2") for r in live), (
+        f"post-upgrade live set is not all-v2: {live}")
+    assert len(live) == n_replicas, live
+    for r in v1:
+        assert status[r.replica_id]["decommissioned"], (
+            f"v1 replica {r.replica_id} not decommissioned: "
+            f"{status[r.replica_id]}")
+
+    crashes = [o for o in outcomes
+               if str(o["result"]).startswith("crash")]
+    assert not crashes, f"client crashes: {crashes[:3]}"
+
+    audit = router.journal_audit()
+    assert audit["open"] == [], f"journal still open: {audit['open']}"
+    assert audit["lost"] == [], f"journal lost: {audit['lost']}"
+    assert audit["replayed"], (
+        "an upgrade with streams in flight must hand work off "
+        "through the replay path — zero replays means the churn "
+        "never overlapped a drain")
+
+    completed = parity_ok = faulted = replayed_ok = 0
+    for out in outcomes:
+        res = out["result"]
+        final = out.get("final") or {}
+        if final.get("tokens") is not None:
+            assert out["tokens"] == final["tokens"], (
+                f"case {out['case']}: streamed "
+                f"{len(out['tokens'])} != terminal "
+                f"{len(final['tokens'])} (double delivery?)")
+        if res in ("length", "eos"):
+            completed += 1
+            if final.get("replays"):
+                replayed_ok += 1
+            if out["temperature"] == 0:
+                assert out["tokens"] == ref_tokens[out["case"]], (
+                    f"case {out['case']} diverged from the "
+                    f"fault-free reference after "
+                    f"{final.get('replays')} replays")
+                parity_ok += 1
+        elif res == "fault":
+            faulted += 1
+            assert out["temperature"] > 0, (
+                f"greedy case {out['case']} faulted: {final}")
+        elif res == "shed":
+            pass  # a kill+drain window can briefly empty the fleet
+        else:
+            raise AssertionError(
+                f"case {out['case']} unexpected terminal {res!r}")
+    assert completed >= n_clients, (
+        f"only {completed} completed streams across the upgrade")
+    assert replayed_ok >= 1, (
+        "no completed stream survived a drain/kill replay")
+
+    # the scaling timeline is on the stitched trace: one fleet.scale
+    # upgrade span per replaced replica, on the router lane (pid 0)
+    doc = client.trace_events()
+    scale_spans = [e for e in doc["traceEvents"]
+                   if e.get("name") == "fleet.scale"
+                   and e.get("pid") == 0]
+    upgrade_spans = [e for e in scale_spans
+                     if (e.get("args") or {}).get("action")
+                     == "upgrade"]
+    assert len(upgrade_spans) == n_replicas, (
+        f"{len(upgrade_spans)} fleet.scale upgrade spans for "
+        f"{n_replicas} replaced replicas")
+    warmed = [s for s in upgrade_out["steps"]
+              if (s.get("warmed") or 0) >= 1]
+    assert warmed, (
+        "no replacement was warmed from live affinity keys — the "
+        "boot-with-warmup handshake never engaged")
+
+    router.close()
+    controller.close()
+    procs = [h for h in list(controller._handles.values()) + v1
+             if isinstance(h, ReplicaProcess)]
+    controller.shutdown_fleet()
+    for r in v1:
+        r.shutdown()
+    leaks = assert_no_leaks(baseline, subprocesses=procs)
+
+    summary = {
+        "n_clients": n_clients,
+        "n_replicas": n_replicas,
+        "mode": "in-process" if in_process else "subprocess",
+        "seed": seed,
+        "wall_s": round(wall_s, 2),
+        "streams_total": len(outcomes),
+        "completed": completed,
+        "greedy_parity_ok": parity_ok,
+        "faulted_sampling": faulted,
+        "completed_after_replay": replayed_ok,
+        "replayed_requests": len(audit["replayed"]),
+        "inflight_at_upgrade": inflight_at_upgrade,
+        "killed_mid_upgrade": killed_id,
+        "upgraded": upgrade_out["upgraded"],
+        "warmed_steps": len(warmed),
+        "live_after": sorted(live),
+        **leaks,
+    }
+    if verbose:
+        for k, v in summary.items():
+            print(f"  {k}: {v}")
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1-sized in-process variant")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--clients", type=int, default=None)
+    args = ap.parse_args()
+    if args.fast:
+        summary = run_soak(n_clients=args.clients or 14,
+                           n_replicas=2, seed=args.seed,
+                           in_process=True, verbose=True)
+    else:
+        summary = run_soak(n_clients=args.clients or 20,
+                           n_replicas=3, seed=args.seed,
+                           in_process=False, verbose=True)
+    print(f"upgrade soak PASSED: {summary['upgraded']} replicas "
+          f"replaced under {summary['streams_total']} streams "
+          f"({summary['completed']} completed, greedy parity "
+          f"{summary['greedy_parity_ok']}, "
+          f"{summary['completed_after_replay']} finished after "
+          f"replay), SIGKILLed {summary['killed_mid_upgrade']} "
+          f"mid-upgrade, in {summary['wall_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

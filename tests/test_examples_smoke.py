@@ -44,8 +44,19 @@ def test_all_examples_listed():
         "(with a DL4J_EXAMPLES_TINY mode if it is heavy)")
 
 
-@pytest.mark.parametrize("name,args", EXAMPLES,
-                         ids=[n for n, _ in EXAMPLES])
+#: even in tiny-shape mode these are the heaviest smokes (the
+#: flagship runs the full train/eval/decode pipeline, ~30 s); they
+#: ride the slow tier with the subprocess soaks so tier-1 stays
+#: inside its wall-time budget
+SLOW_EXAMPLES = {"flagship_transformer.py"}
+
+
+@pytest.mark.parametrize(
+    "name,args",
+    [pytest.param(n, a, marks=([pytest.mark.slow]
+                               if n in SLOW_EXAMPLES else []))
+     for n, a in EXAMPLES],
+    ids=[n for n, _ in EXAMPLES])
 def test_example_runs(name, args):
     if name == "pipeline_4d_training.py" and not NATIVE_SHARD_MAP:
         # dp x pp x sp x tp lowers through partial-manual shard_map,

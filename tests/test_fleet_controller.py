@@ -1,0 +1,353 @@
+"""Elastic fleet controller (ISSUE 11 tentpole).
+
+The contract under test: the control loop turns SLO signals into
+scale events with FLAP DAMPING (hysteresis band + consecutive-eval
+streaks + post-event cooldown — a bursty load must not flap the
+fleet), scale events inherit the suite's zero-lost-request and
+bit-parity discipline (the drain path is the PR 9 replay path), and
+the fast diurnal soak proves the closed loop end to end: traffic
+ramps 10×, the controller scales up, the SLO breach recovers within
+the cooldown budget, traffic ramps down, the controller scales back
+down, and the whole timeline is ``fleet.scale`` spans on the
+stitched trace."""
+
+import pytest
+
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.profiler.tracer import Histogram, Tracer
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    FleetController,
+    LocalReplica,
+    RouterClient,
+    ServingRouter,
+)
+
+V = 12
+
+
+def _net(seed=11, stream_max_t=96):
+    net = MultiLayerNetwork(transformer_lm(
+        n_in=V, width=32, n_layers=2, n_heads=4, n_classes=V,
+        seed=seed)).init()
+    for c in net.conf.confs:
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = stream_max_t
+    return net
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _net()
+
+
+class _StubRouter:
+    """Just enough router for the pure decision-layer tests."""
+
+    def __init__(self, metrics_texts=None):
+        self.tracer = Tracer()
+        self.health_interval_s = 0.1
+        self._texts = list(metrics_texts or [])
+
+    def replica_status(self):
+        return []
+
+    def fleet_metrics_text(self):
+        if not self._texts:
+            return ""
+        return self._texts.pop(0)
+
+
+def _controller(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("pressure_high", 2.0)
+    kw.setdefault("pressure_low", 0.25)
+    kw.setdefault("breach_evals", 2)
+    kw.setdefault("idle_evals", 3)
+    kw.setdefault("cooldown_s", 5.0)
+    router = kw.pop("router", None) or _StubRouter()
+    return FleetController(router, **kw)
+
+
+def _sig(n_live=1, pressure=0.5, ttft=None):
+    return {"n_live": n_live, "n_registered": n_live,
+            "slots": 3 * n_live, "inflight": 0, "queued": 0,
+            "pressure": pressure, "ttft_p99_s": ttft,
+            "ttft_window_n": 0}
+
+
+class TestDecision:
+    """The flap dampers, driven with synthetic signals (``decide`` is
+    deliberately separable from the fleet so this is possible)."""
+
+    def test_breach_needs_consecutive_evals(self):
+        c = _controller()
+        assert c.decide(_sig(pressure=5.0), now=0.0) is None
+        assert c.decide(_sig(pressure=5.0), now=0.1) == "up"
+
+    def test_one_spiky_tick_resets_the_streak(self):
+        c = _controller()
+        assert c.decide(_sig(pressure=5.0), now=0.0) is None
+        assert c.decide(_sig(pressure=1.0), now=0.1) is None
+        # the streak restarted: a second spike is eval #1 again
+        assert c.decide(_sig(pressure=5.0), now=0.2) is None
+        assert c.decide(_sig(pressure=5.0), now=0.3) == "up"
+
+    def test_ttft_slo_breach_counts(self):
+        c = _controller(ttft_p99_slo_s=0.5)
+        s = _sig(pressure=0.5, ttft=1.2)  # pressure fine, SLO blown
+        assert c.decide(s, now=0.0) is None
+        assert c.decide(s, now=0.1) == "up"
+        assert "ttft_p99" in c._reason
+
+    def test_hysteresis_band_holds(self):
+        # between pressure_low and pressure_high: NOTHING moves,
+        # however long it persists
+        c = _controller()
+        for i in range(20):
+            assert c.decide(_sig(n_live=2, pressure=1.0),
+                            now=0.1 * i) is None
+
+    def test_idle_needs_longer_streak_and_respects_min(self):
+        c = _controller(idle_evals=3)
+        lo = _sig(n_live=2, pressure=0.1)
+        assert c.decide(lo, now=0.0) is None
+        assert c.decide(lo, now=0.1) is None
+        assert c.decide(lo, now=0.2) == "down"
+        # at min_replicas the same signal holds instead
+        c2 = _controller(idle_evals=3)
+        lo1 = _sig(n_live=1, pressure=0.1)
+        for i in range(6):
+            assert c2.decide(lo1, now=0.1 * i) is None
+
+    def test_cooldown_blocks_back_to_back_events(self):
+        c = _controller(cooldown_s=5.0)
+        hi = _sig(pressure=5.0)
+        c.decide(hi, now=0.0)
+        assert c.decide(hi, now=0.1) == "up"
+        c._cooldown_until = 0.1 + c.cooldown_s  # what scale_up sets
+        assert c.decide(hi, now=1.0) is None  # still breaching: held
+        assert c.decide(hi, now=5.2) == "up"  # cooldown expired
+
+    def test_max_replicas_bounds_up(self):
+        c = _controller(max_replicas=2)
+        hi = _sig(n_live=2, pressure=9.0)
+        for i in range(5):
+            assert c.decide(hi, now=0.1 * i) is None
+
+    def test_alternating_burst_never_flaps(self):
+        # the bursty workload the dampers exist for: breach, idle,
+        # breach, idle ... — streaks never build, nothing scales
+        c = _controller(breach_evals=2, idle_evals=3)
+        for i in range(30):
+            p = 5.0 if i % 2 == 0 else 0.05
+            assert c.decide(_sig(n_live=2, pressure=p),
+                            now=0.1 * i) is None
+
+    def test_recovery_stamp_lands_on_breach_clear(self):
+        c = _controller()
+        ev = {"action": "up"}
+        c._pending_recovery = (ev, 10.0)
+        c.decide(_sig(pressure=5.0), now=11.0)  # still breaching
+        assert "recovered_after_s" not in ev
+        c.decide(_sig(pressure=0.5), now=12.5)
+        assert ev["recovered_after_s"] == pytest.approx(2.5)
+
+
+class TestWindowQuantile:
+    """The TTFT control signal is the p99 of the LAST window —
+    cumulative-scrape differencing, not uptime quantiles."""
+
+    def _texts_from(self, observations):
+        h = Histogram()
+        texts = []
+        for batch in observations:
+            for value, n in batch:
+                h.observe(value, n)
+            texts.append("\n".join(
+                h.prometheus_lines("serving_ttft_s")) + "\n")
+        return texts
+
+    def test_window_p99_tracks_the_delta_not_the_uptime(self):
+        texts = self._texts_from([
+            [(0.001, 1000)],      # uptime so far: all fast
+            [(10.0, 100)],        # THIS window: all slow
+            [(0.001, 100)],       # next window: fast again
+        ])
+        c = _controller(router=_StubRouter(texts),
+                        ttft_p99_slo_s=0.5)
+        p99, n = c._window_ttft_p99()
+        assert p99 is None and n == 0  # first scrape: no window yet
+        p99, n = c._window_ttft_p99()
+        assert n == 100
+        assert p99 == pytest.approx(10.0)  # uptime p99 would be tiny
+        p99, n = c._window_ttft_p99()
+        assert n == 100
+        assert p99 is not None and p99 <= 0.01
+
+    def test_empty_window_and_count_regression_degrade(self):
+        h = Histogram()
+        h.observe(0.1, 5)
+        full = "\n".join(h.prometheus_lines("serving_ttft_s"))
+        h2 = Histogram()
+        h2.observe(0.1, 2)  # fewer than before: a replica died
+        less = "\n".join(h2.prometheus_lines("serving_ttft_s"))
+        c = _controller(router=_StubRouter([full, full, less]),
+                        ttft_p99_slo_s=0.5)
+        assert c._window_ttft_p99() == (None, 0)   # first scrape
+        assert c._window_ttft_p99() == (None, 0)   # empty window
+        assert c._window_ttft_p99() == (None, 0)   # regression
+
+    def test_slo_off_skips_the_scrape(self):
+        router = _StubRouter(["should-not-be-read"])
+        c = _controller(router=router, ttft_p99_slo_s=None)
+        assert c._window_ttft_p99() == (None, 0)
+        assert router._texts  # untouched
+
+
+class TestScaleActions:
+    """Manual scale_up/scale_down against a real in-process fleet:
+    the atomic rendezvous swap, the warmup handshake, and the
+    replay-backed drain."""
+
+    def test_scale_up_then_down_round_trip(self, net):
+        def factory(rid):
+            return LocalReplica(
+                DecodeEngine(net, n_slots=2, decode_chunk=2,
+                             prefix_cache_rows=4, seed=0),
+                replica_id=rid)
+
+        seed_rep = factory("seed-0")
+        router = ServingRouter([seed_rep.address],
+                               affinity_block_tokens=4,
+                               health_interval_s=0.05).start()
+        c = FleetController(router, factory, min_replicas=1,
+                            max_replicas=3, cooldown_s=0.0)
+        c.adopt(seed_rep)
+        try:
+            client = RouterClient(router.address)
+            # journal some affinity traffic so scale-up has keys to
+            # warm the newcomer with
+            prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+            first = client.generate(prompt, 4)
+            new_id = c.scale_up(reason="test")
+            assert new_id in [s["replica_id"]
+                              for s in router.replica_status()
+                              if s["state"] == "live"]
+            up = c.events[-1]
+            assert up["action"] == "up" and up["n_live"] == 2
+            assert up["warmed"] >= 1  # the handshake engaged
+            # routing still works over the grown fleet, and the
+            # pre-add request's owner never changed
+            again = client.generate(prompt, 4)
+            assert again["tokens"] == first["tokens"]
+            drained = c.scale_down(reason="test")
+            assert drained is not None
+            down = c.events[-1]
+            assert down["action"] == "down"
+            live = [s for s in router.replica_status()
+                    if s["state"] in ("live", "degraded")]
+            assert len(live) == 1
+            # still serving, bit-identically
+            assert client.generate(prompt, 4)["tokens"] \
+                == first["tokens"]
+            # fleet.scale spans recorded for both directions
+            actions = [(e.get("args") or {}).get("action")
+                       for e in router.tracer.events()
+                       if e.get("name") == "fleet.scale"]
+            assert "up" in actions and "down" in actions
+        finally:
+            c.close()
+            router.close()
+            c.shutdown_fleet()
+            seed_rep.shutdown()
+
+    def test_scale_down_refuses_below_min(self, net):
+        seed_rep = LocalReplica(
+            DecodeEngine(net, n_slots=2, decode_chunk=2, seed=0),
+            replica_id="only")
+        router = ServingRouter([seed_rep.address],
+                               health_interval_s=0.05).start()
+        c = FleetController(router, None, min_replicas=1)
+        try:
+            assert c.scale_down(reason="test") is None
+            assert not c.events
+        finally:
+            c.close()
+            router.close()
+            seed_rep.shutdown()
+
+    def test_spawn_without_factory_is_an_error(self, net):
+        seed_rep = LocalReplica(
+            DecodeEngine(net, n_slots=2, decode_chunk=2, seed=0),
+            replica_id="only")
+        router = ServingRouter([seed_rep.address],
+                               health_interval_s=0.05).start()
+        c = FleetController(router, None)
+        try:
+            with pytest.raises(RuntimeError):
+                c.scale_up(reason="test")
+        finally:
+            c.close()
+            router.close()
+            seed_rep.shutdown()
+
+
+class TestControllerValidation:
+    def test_bad_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            _controller(min_replicas=0)
+        with pytest.raises(ValueError):
+            _controller(max_replicas=1, min_replicas=2)
+        with pytest.raises(ValueError):
+            _controller(pressure_high=0.2, pressure_low=0.5)
+
+    def test_cli_fleet_subcommand_parses(self):
+        from deeplearning4j_tpu.cli.driver import build_parser
+
+        args = build_parser().parse_args(
+            ["fleet", "--model", "m.zip", "--replicas", "2",
+             "--max-replicas", "5", "--ttft-slo", "0.8",
+             "--cooldown", "2.5"])
+        assert args.command == "fleet"
+        assert args.replicas == 2 and args.max_replicas == 5
+        assert args.ttft_slo == pytest.approx(0.8)
+        assert args.cooldown == pytest.approx(2.5)
+        assert args.min_replicas == 1  # default
+
+
+def test_fleet_soak_fast_diurnal():
+    """The closed loop end to end (fast tier-1 variant of
+    scripts/fleet_soak.py): 10× ramp → scale-up → SLO recovery
+    within the cooldown budget → ramp-down → scale-down, zero lost,
+    bit-identical, fleet.scale spans on the stitched trace, zero
+    leaks."""
+    from scripts.fleet_soak import run_soak
+
+    summary = run_soak(seed=0, in_process=True)
+    assert summary["scale_ups"] >= 1
+    assert summary["scale_downs"] >= 1
+    assert summary["peak_live"] >= 2
+    assert summary["recovered_after_s"] \
+        <= summary["recovery_budget_s"]
+    assert summary["completed"] >= 10
+    assert summary["greedy_parity_ok"] == summary["completed"]
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0
+    assert summary["controller_errors"] == 0
+
+
+@pytest.mark.slow
+def test_fleet_soak_full_subprocess():
+    """Full diurnal soak: real subprocess replicas — every scale-up
+    pays a real process boot, every scale-down reaps one."""
+    from scripts.fleet_soak import run_soak
+
+    summary = run_soak(seed=0, in_process=False)
+    assert summary["scale_ups"] >= 1
+    assert summary["scale_downs"] >= 1
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0
+    assert summary["leaked_subprocesses"] == 0

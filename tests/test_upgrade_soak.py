@@ -1,0 +1,44 @@
+"""Registered upgrade-under-churn chaos soak (ISSUE 11 acceptance).
+
+Fast variant (tier-1, ~8 s): 2 in-process replicas, a full rolling
+upgrade (v1 → v2, fresh stable ids, warmup handshake, gradual
+rendezvous shift, drain-through-replay) with ≥8 streams in flight and
+one ``hard_kill`` (the network-identical SIGKILL stand-in) injected
+mid-upgrade; gates zero lost requests, zero double delivery,
+bit-identical greedy completion vs the fault-free single-engine
+reference, an all-v2 live set, one ``fleet.scale`` upgrade span per
+replaced replica, and zero leaked threads/fds.
+
+Full variant (``slow``): 3 SUBPROCESS replicas and a real ``SIGKILL``
+— the acceptance gate end to end across real process boundaries,
+including zero leaked subprocesses."""
+
+import pytest
+
+from scripts.upgrade_soak import run_soak
+
+
+def test_upgrade_soak_fast():
+    summary = run_soak(n_clients=14, n_replicas=2, seed=0,
+                       in_process=True, min_inflight_at_upgrade=8)
+    assert summary["upgraded"] == 2
+    assert summary["inflight_at_upgrade"] >= 8
+    assert summary["killed_mid_upgrade"]
+    assert summary["completed"] >= 14
+    assert summary["completed_after_replay"] >= 1
+    assert summary["warmed_steps"] >= 1
+    assert all(r.startswith("v2") for r in summary["live_after"])
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0
+
+
+@pytest.mark.slow
+def test_upgrade_soak_full_subprocess():
+    summary = run_soak(n_clients=20, n_replicas=3, seed=0,
+                       in_process=False, min_inflight_at_upgrade=8)
+    assert summary["upgraded"] == 3
+    assert summary["inflight_at_upgrade"] >= 8
+    assert summary["completed_after_replay"] >= 1
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0
+    assert summary["leaked_subprocesses"] == 0
