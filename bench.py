@@ -2469,6 +2469,38 @@ def bench_dbn():
     }
 
 
+def bench_decode_tp():
+    """Tensor-parallel sharded decode row (ISSUE 12 acceptance):
+    flagship-family decode at TP in {1, 2, 4} on the 8-virtual-device
+    mesh, in a subprocess (the TPU process cannot re-init its backend
+    as CPU). scripts/tp_decode_bench.py runs the widths interleaved
+    and gates greedy ids bit-identical to single-chip (match 1.0),
+    zero retrace + one decode executable per width, per-shard KV
+    bytes == total/TP, and TP=4 throughput >= 0.9x TP=1 on CPU
+    (communication-bound on the virtual mesh; real chips split the
+    matmuls so per-token latency drops with width — annotated
+    per-width)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "scripts", "tp_decode_bench.py")],
+        capture_output=True, text=True, timeout=900, env=env)
+    if proc.returncode != 0:
+        _fail_gate(f"tp decode bench gates failed: "
+                   f"{proc.stderr[-400:]}")
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    _fail_gate(f"tp decode bench produced no row: "
+               f"{proc.stderr[-400:]}")
+    return None
+
+
 def bench_allreduce():
     """BASELINE row 5: dp step-time decomposition on the 8-virtual-
     device mesh, in a subprocess (the TPU process cannot re-init its
@@ -2624,7 +2656,7 @@ def main() -> None:
                bench_transformer_32k_context, bench_flagship,
                bench_hostfed_cnn, bench_decode, bench_decode_batched,
                bench_prefix_cache, bench_decode_paged,
-               bench_decode_spec,
+               bench_decode_spec, bench_decode_tp,
                bench_gateway_streaming, bench_router_overhead,
                bench_fleet_trace_overhead,
                bench_fleet_controller_overhead,

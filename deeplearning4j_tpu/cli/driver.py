@@ -372,6 +372,11 @@ def _cmd_worker(args) -> int:
     return 0
 
 
+#: --use-flash-paged CLI spelling -> DecodeEngine toggle value
+FLASH_PAGED_MODES = {"auto": None, "on": True, "off": False,
+                     "interpret": "interpret"}
+
+
 def gateway_from_args(args):
     """Build (or restore) the serving gateway the ``serve`` subcommand
     runs — factored out so tests can drive the exact CLI path without
@@ -393,11 +398,21 @@ def gateway_from_args(args):
             spec_draft_len=args.spec_draft_len,
             paged_kv=args.paged_kv,
             block_tokens=args.block_tokens,
-            kv_blocks=args.kv_blocks)
+            kv_blocks=args.kv_blocks,
+            tp=getattr(args, "tp", 1),
+            use_flash_paged=FLASH_PAGED_MODES[
+                getattr(args, "use_flash_paged", "auto")])
 
     return ServingGateway.boot(
         engine, snapshot_path=args.snapshot,
         net_factory=lambda: restore_model(args.model),
+        # the HOST wins layout knobs on restore: the snapshot wire
+        # format is tp-invariant, so a drain taken at one width
+        # restores at whatever this host can shard
+        restore_kwargs={
+            "tp": getattr(args, "tp", 1),
+            "use_flash_paged": FLASH_PAGED_MODES[
+                getattr(args, "use_flash_paged", "auto")]},
         host=args.host, port=args.port,
         replica_id=getattr(args, "replica_id", None))
 
@@ -454,6 +469,10 @@ def _serve_child_argv(args, port: int, replica_id: str):
                  str(args.block_tokens)]
         if args.kv_blocks is not None:
             argv += ["--kv-blocks", str(args.kv_blocks)]
+    if getattr(args, "tp", 1) != 1:
+        argv += ["--tp", str(args.tp)]
+    if getattr(args, "use_flash_paged", "auto") != "auto":
+        argv += ["--use-flash-paged", args.use_flash_paged]
     return argv
 
 
@@ -676,6 +695,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--kv-blocks", type=int, default=None,
                    help="block-pool size (default: the dense "
                         "layout's byte budget)")
+    s.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel shards: decode/verify/chunk "
+                        "run as shard_map programs over attention "
+                        "heads, per-shard KV bytes = total/TP "
+                        "(1 = single-chip)")
+    s.add_argument("--use-flash-paged", default="auto",
+                   choices=("auto", "on", "off", "interpret"),
+                   help="pallas paged-attention decode kernel: auto "
+                        "= kernel on TPU / XLA gather elsewhere, on "
+                        "= force kernel (TPU), off = gather always, "
+                        "interpret = kernel via the pallas "
+                        "interpreter (CPU parity testing)")
     s.add_argument("--snapshot", default=None,
                    help="drain-snapshot path: written on shutdown, "
                         "restored on boot when present")
@@ -726,6 +757,11 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--paged-kv", action="store_true")
     fl.add_argument("--block-tokens", type=int, default=16)
     fl.add_argument("--kv-blocks", type=int, default=None)
+    fl.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel shards per replica (every "
+                         "replica serves at the same width)")
+    fl.add_argument("--use-flash-paged", default="auto",
+                    choices=("auto", "on", "off", "interpret"))
     fl.set_defaults(fn=_cmd_fleet)
 
     rt = sub.add_parser(

@@ -13,8 +13,10 @@ flash-style attention that XLA maps onto the MXU.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
-from typing import Optional
+import threading
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +25,56 @@ from deeplearning4j_tpu.nn.conf.layers import BaseRecurrentLayer
 from deeplearning4j_tpu.nn.conf.serde import register_bean
 from deeplearning4j_tpu.nn.layers.base import LayerImplBase
 from deeplearning4j_tpu.nn.weights import init_weights
+
+# -- tensor-parallel head sharding (serving TP, ISSUE 12) --------------
+#
+# Trace-time marker stack: when the enclosing program is the body of a
+# fully-manual ``shard_map`` over a TP mesh axis with attention weights
+# head-sharded (Wq/Wk/Wv column-sliced so each shard owns n_heads/TP
+# whole heads, Wo row-sliced), the attention layers must (a) reshape
+# onto the LOCAL head count and (b) all-reduce the partial output
+# projection — the Megatron self-attention block. The serving decode
+# engine (serving/tp.py) enters this context inside its shard_map
+# bodies; training TP needs none of it (the trainers shard via GSPMD
+# param specs, parallel/data_parallel.py:tp_param_specs, and XLA
+# derives the same collective). Thread-local: engines in one process
+# may trace concurrently (the in-process replica pattern), and a tp>1
+# scope must not leak into a sibling engine's plain-jit trace.
+_TP_SCOPES = threading.local()
+
+
+def _tp_stack() -> List[Tuple[str, int]]:
+    stack = getattr(_TP_SCOPES, "stack", None)
+    if stack is None:
+        stack = _TP_SCOPES.stack = []
+    return stack
+
+
+@contextlib.contextmanager
+def tp_head_shards(axis_name: str, size: int):
+    """Declare that attention params (and KV caches) within this trace
+    are head-sharded ``size``-ways over mesh axis ``axis_name``."""
+    stack = _tp_stack()
+    stack.append((str(axis_name), int(size)))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def _tp_scope() -> Optional[Tuple[str, int]]:
+    stack = _tp_stack()
+    return stack[-1] if stack else None
+
+
+def _tp_local_heads(n_heads: int, tp: Tuple[str, int]) -> int:
+    axis, size = tp
+    if n_heads % size:
+        raise ValueError(
+            f"tensor parallelism over {axis!r} needs tp ({size}) to "
+            f"divide n_heads ({n_heads}): head sharding slices whole "
+            "heads")
+    return n_heads // size
 
 
 @register_bean("MultiHeadSelfAttention")
@@ -49,6 +101,13 @@ class MultiHeadSelfAttention(BaseRecurrentLayer):
     # engages at T >= 2048 when T % 512 == 0 (healthy kernel blocks),
     # and at T >= 8192 unconditionally (dense OOMs long before 32k)
     use_flash: Optional[bool] = None
+    # pallas PAGED-attention decode kernel (serving paged_kv engines;
+    # ISSUE 12): True forces it (TPU), False forces the XLA
+    # gather-by-block-table program, "interpret" runs the kernel in
+    # pallas interpret mode (the CPU parity-testing hook), None = auto
+    # — kernel on TPU, XLA gather everywhere else (see
+    # _should_use_flash_paged)
+    use_flash_paged: Optional[object] = None
     # KV-cache length for rnn_time_step streaming (reference
     # rnnTimeStep contract, BaseRecurrentLayer stateMap): a FIXED-size
     # right-aligned sliding cache so the decode step compiles once
@@ -82,11 +141,14 @@ class AttentionImpl(LayerImplBase):
         if d % h:
             raise ValueError(f"n_out {d} not divisible by n_heads {h}")
         dh = d // h
+        tp = _tp_scope()
+        if tp is not None:
+            h = _tp_local_heads(h, tp)
         x = cls.maybe_dropout(conf, x, train, rng)
         xt = jnp.transpose(x, (0, 2, 1))  # [N, T, C]
 
         def split_heads(m):
-            y = xt @ m  # [N, T, D]
+            y = xt @ m  # [N, T, D] (local D/TP under tp head sharding)
             return jnp.transpose(
                 y.reshape(y.shape[0], y.shape[1], h, dh), (0, 2, 1, 3)
             )  # [N, H, T, dh]
@@ -97,9 +159,24 @@ class AttentionImpl(LayerImplBase):
         o, state = cls._attend_core(lc, q, k, v, state, train, mask)
 
         o = jnp.transpose(o, (0, 2, 1, 3)).reshape(
-            o.shape[0], o.shape[2], d
-        )  # [N, T, D]
-        out = o @ params["Wo"] + params["b"]
+            o.shape[0], o.shape[2], h * dh
+        )  # [N, T, D] (local heads under tp)
+        if tp is not None:
+            # row-parallel output projection: each shard's o covers
+            # its own heads, the matmul yields a partial [N, T, D]
+            # sum — ONE all-reduce completes it (bias added once,
+            # after). Partials accumulate AND all-reduce in f32,
+            # rounding to the compute dtype once: bf16 partials
+            # rounded per shard then summed double-round, and the
+            # extra noise flips argmaxes vs the single-chip engine
+            # (the bench id-match gate caught it at tp=2/bf16)
+            out = jax.lax.dot_general(
+                o, params["Wo"], (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out = jax.lax.psum(out, tp[0]).astype(o.dtype)
+        else:
+            out = o @ params["Wo"]
+        out = out + params["b"]
         out = cls.activation_of(conf)(out)
         out = jnp.transpose(out, (0, 2, 1))  # [N, D, T]
         if mask is not None:
@@ -298,10 +375,31 @@ class AttentionImpl(LayerImplBase):
         # whole ring — the decode step reads ~window keys like dense)
         ntab = min(s_ring, (tm + t - 2) // bt + 2)
         lo = jnp.maximum(floor, jnp.maximum(filled - tm + 1, 0))
-        g = lo[:, None] // bt + jnp.arange(ntab)[None, :]  # [B, ntab]
+        lo_blk = lo // bt
+        g = lo_blk[:, None] + jnp.arange(ntab)[None, :]    # [B, ntab]
         tb = jnp.take_along_axis(table, g % s_ring, axis=1)
         bb = jnp.take_along_axis(base, g % s_ring, axis=1)
         bval = (tb >= 0) & (bb == g * bt)          # ring slot holds g
+        toggle = getattr(lc, "use_flash_paged", None)
+        if _should_use_flash_paged(toggle, bt, dh):
+            # fused pallas kernel (ISSUE 12): each (row, head) walks
+            # its block list INSIDE the kernel — no [B, ntab*bt, ...]
+            # gather ever materializes in HBM. Same validity rule,
+            # same value-level NaN masking, online softmax; parity vs
+            # the gather program is argmax-level (different float
+            # reduction shape — the PR 6 paged-parity convention).
+            o = _paged_flash_attention(
+                q, pkf.reshape(nb, bt, h, dh),
+                pvf.reshape(nb, bt, h, dh),
+                jnp.where(bval, tb, 0).astype(jnp.int32),
+                bval.astype(jnp.int32), lo_blk.astype(jnp.int32),
+                floor.astype(jnp.int32), filled.astype(jnp.int32),
+                lengths.astype(jnp.int32), tm=tm,
+                interpret=(toggle == "interpret"))
+            return o, {"pk": pkf.reshape(nb, bt, h, dh),
+                       "pv": pvf.reshape(nb, bt, h, dh),
+                       "table": table, "base": base, "floor": floor,
+                       "filled": filled + lengths}
         off = jnp.arange(bt)
         gidx = (jnp.where(bval, tb, 0)[:, :, None] * bt
                 + off[None, None, :]).reshape(b, ntab * bt)
@@ -320,7 +418,11 @@ class AttentionImpl(LayerImplBase):
         # validity rule — block mapped AND position inside
         # [floor, filled + written) — or a recycled dirty block
         # silently corrupts its next owner through masked lanes
-        # (caught by the chaos gate and the paranoid-off regression)
+        # (caught by the chaos gate and the paranoid-off regression).
+        # The pallas kernel above enforces the SAME rule on its DMA'd
+        # V blocks (`vlive` in _paged_flash_attention) — the two paths
+        # share the contract, and the kernel parity tests poison a
+        # freed block to prove it holds there too
         vlive = (kval
                  & (kpos < (filled + lengths)[:, None])
                  & (kpos >= floor[:, None]))
@@ -466,6 +568,7 @@ class TransformerBlock(BaseRecurrentLayer):
     ring_block_size: Optional[int] = None
     sp_mode: str = "ring"
     use_flash: Optional[bool] = None
+    use_flash_paged: Optional[object] = None
     stream_max_t: int = 512
 
 
@@ -513,6 +616,9 @@ class TransformerBlockImpl(LayerImplBase):
         if d % h:
             raise ValueError(f"n_out {d} not divisible by n_heads {h}")
         dh = d // h
+        tp = _tp_scope()
+        if tp is not None:
+            h = _tp_local_heads(h, tp)
         x = cls.maybe_dropout(conf, x, train, rng)
         xt = jnp.transpose(x, (0, 2, 1))  # [N, T, C]
         if "Wi" in params:
@@ -521,7 +627,7 @@ class TransformerBlockImpl(LayerImplBase):
         hn = _layer_norm(xt, params["ln1_g"], params["ln1_b"])
 
         def split_heads(m):
-            y = hn @ m  # [N, T, D]
+            y = hn @ m  # [N, T, D] (local D/TP under tp head sharding)
             return jnp.transpose(
                 y.reshape(y.shape[0], y.shape[1], h, dh), (0, 2, 1, 3)
             )  # [N, H, T, dh]
@@ -532,8 +638,23 @@ class TransformerBlockImpl(LayerImplBase):
         o, state = AttentionImpl._attend_core(
             lc, q, k, v, state, train, mask)
         o = jnp.transpose(o, (0, 2, 1, 3)).reshape(
-            o.shape[0], o.shape[2], d)  # [N, T, D]
-        xt = xt + (o @ params["Wo"] + params["bo"])
+            o.shape[0], o.shape[2], h * dh)  # [N, T, D] (local heads)
+        if tp is not None:
+            # row-parallel Wo: one all-reduce per block completes the
+            # partial sum; LN params, biases, and the (replicated) FFN
+            # see the full-width activation — the Megatron block with
+            # only the attention heads sharded (the KV cache is the
+            # memory that matters in serving; serving/tp.py). f32
+            # accumulate + f32 psum + one rounding, as in
+            # AttentionImpl.apply — per-shard bf16 rounding before the
+            # sum flips argmaxes vs single-chip
+            attn = jax.lax.dot_general(
+                o, params["Wo"], (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            attn = jax.lax.psum(attn, tp[0]).astype(o.dtype)
+        else:
+            attn = o @ params["Wo"]
+        xt = xt + (attn + params["bo"])
 
         h2 = _layer_norm(xt, params["ln2_g"], params["ln2_b"])
         ffn = activation(lc.ffn_activation)(
@@ -569,6 +690,13 @@ def guard_streamable(named_layer_beans) -> None:
 
 
 def _should_use_flash(use_flash, q, mask) -> bool:
+    """Training/prefill flash dispatch. The PAGED decode analogue is
+    :func:`_should_use_flash_paged` below — same toggle philosophy
+    (None = auto, False = XLA always, True = force the kernel), but
+    auto mode gates on BACKEND + tile health rather than sequence
+    length: a decode chunk is a handful of queries over ~window keys,
+    so the kernel's win is skipping the [B, ntab*bt, H, dh] gather
+    materialization (HBM bandwidth), not O(T²) score memory."""
     if use_flash is False:
         return False
     t, dh = q.shape[2], q.shape[3]
@@ -630,6 +758,174 @@ def _flash_attention(q, k, v, causal):
     return flash_attention(
         q, k, v, causal=causal, sm_scale=q.shape[-1] ** -0.5,
         block_sizes=bs)
+
+
+def _should_use_flash_paged(toggle, block_tokens: int,
+                            head_dim: int) -> bool:
+    """Dispatch rule for the pallas paged-attention decode kernel
+    (:func:`_paged_flash_attention`) vs the XLA gather-by-block-table
+    program in :meth:`AttentionImpl._paged_attend`:
+
+    - ``None`` (auto): the kernel on the TPU backend when the block
+      shape tiles healthily — ``block_tokens`` a multiple of 8
+      (sublane) and ``head_dim`` a multiple of 128 (lane); toy/test
+      geometries below the native tile stay on the XLA gather, which
+      fuses fine at those sizes. Off-TPU always falls back to the
+      gather program (the kernel's DMA scheduling is TPU-specific;
+      interpret mode exists for parity testing, not serving).
+    - ``True``: force the kernel — raises off-TPU or on unhealthy
+      tiles instead of silently degrading.
+    - ``False``: the XLA gather program always.
+    - ``"interpret"``: the kernel through the pallas interpreter on
+      any backend — the CPU bit-parity testing hook (tier-1 gates the
+      kernel's semantics against the gather program with it).
+
+    Both paths enforce the SAME value-level masking rule: gathered /
+    DMA'd V lanes outside ``[floor, filled + written)`` are zeroed at
+    the VALUE level, not just score-masked, because a recycled dirty
+    block's NaN survives a zero softmax weight (0 x NaN = NaN — the
+    PR 6 poisoned-neighbour fix; the kernel parity tests poison a
+    freed block to prove the kernel preserves it)."""
+    if toggle is False or (toggle is None
+                           and jax.default_backend() != "tpu"):
+        return False
+    if toggle == "interpret":
+        return True
+    tiles_ok = (block_tokens % 8 == 0 and head_dim % 128 == 0)
+    if toggle is None:
+        return tiles_ok
+    if jax.default_backend() != "tpu" or not tiles_ok:
+        raise ValueError(
+            "use_flash_paged=True requires the TPU backend, "
+            "block_tokens % 8 == 0 and head dim % 128 == 0 "
+            f"(got block_tokens={block_tokens}, head_dim={head_dim} "
+            f"on {jax.default_backend()!r}); use 'interpret' for "
+            "off-TPU parity testing or None for auto fallback")
+    return True
+
+
+def _paged_flash_attention(q, pk, pv, bid, bval, lo_blk, floor,
+                           filled, lengths, *, tm: int,
+                           interpret: bool = False):
+    """Fused pallas paged-attention kernel (ISSUE 12; pallas_guide.md,
+    boom_attention_tricks.md §8-12 — the in-repo flash kernel's decode
+    successor). One grid step = one (row, head, logical-block) visit:
+
+    - the BLOCK TABLE rides as scalar-prefetch operands, and the K/V
+      BlockSpec ``index_map`` reads it to map grid step ``(b, h, j)``
+      to pool block ``bid[b, j]`` — pallas's pipeline then DMAs each
+      (non-contiguous) block HBM→VMEM ahead of compute, exactly the
+      double-buffered page walk of the reference paged kernel, with
+      NO ``[B, ntab*bt, H, dh]`` gather ever materialized.
+    - online softmax over the block walk (running max / sum / output
+      accumulator in VMEM scratch, rescaled per block) under the SAME
+      validity rule as the XLA gather program: block mapped, causal,
+      last-``tm`` window, per-row floor.
+    - value-level masking: V lanes outside ``[floor, filled + len)``
+      are zeroed BEFORE the weighted sum — a zero softmax weight does
+      not kill a NaN (0 x NaN = NaN), so a recycled dirty block would
+      otherwise poison its next owner through masked lanes (the PR 6
+      fix, preserved here; `_should_use_flash_paged` documents the
+      shared contract). Fully-masked blocks contribute exactly zero
+      mass (``p`` is zeroed where invalid, so ``l`` never counts
+      them — rows with NO valid key anywhere, idle slots, emit 0 like
+      the gather path's uniform-softmax-over-zeroed-values).
+
+    Shapes: q [B, H, t, dh]; pk/pv [nb, bt, H, dh] (post-scatter);
+    bid/bval [B, ntab] int32 (pool block per logical block, validity);
+    lo_blk/floor/filled/lengths [B] int32. Returns o [B, H, t, dh].
+    Parity vs the gather program is argmax-level (one float reduction
+    runs blockwise, the other over the flat gather — the PR 6
+    paged-parity convention), gated per tier-1 workload in
+    tests/test_serving_tp.py via interpret mode."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b_sz, h_sz, t, dh = q.shape
+    nb, bt = pk.shape[0], pk.shape[1]
+    ntab = bid.shape[1]
+    scale = dh ** -0.5
+
+    def kernel(bid_ref, bval_ref, lo_ref, floor_ref, filled_ref,
+               len_ref, q_ref, pk_ref, pv_ref, o_ref, m_ref, l_ref,
+               acc_ref):
+        b = pl.program_id(0)
+        j = pl.program_id(2)
+        nj = pl.num_programs(2)
+
+        @pl.when(j == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, -1e30)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        qb = q_ref[0, 0].astype(jnp.float32)          # [t, dh]
+        kb = pk_ref[0, :, 0, :].astype(jnp.float32)   # [bt, dh]
+        vb = pv_ref[0, :, 0, :].astype(jnp.float32)
+        kpos = ((lo_ref[b] + j) * bt
+                + jax.lax.broadcasted_iota(jnp.int32, (t, bt), 1))
+        qpos = (filled_ref[b]
+                + jax.lax.broadcasted_iota(jnp.int32, (t, bt), 0))
+        live = bval_ref[b, j] > 0
+        ok = (live & (kpos <= qpos) & (kpos > qpos - tm)
+              & (kpos >= floor_ref[b]))
+        # value-level masking (see docstring): one [1, bt] row — the
+        # written-span rule is q-position-independent
+        vlive = (live
+                 & (kpos[:1] < filled_ref[b] + len_ref[b])
+                 & (kpos[:1] >= floor_ref[b]))
+        vb = jnp.where(vlive.reshape(bt, 1), vb, 0.0)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = jnp.where(ok, s, -1e30)
+        m_prev = jnp.max(m_ref[...], axis=1)          # [t]
+        l_prev = jnp.max(l_ref[...], axis=1)
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.where(ok, jnp.exp(s - m_next[:, None]), 0.0)
+        l_next = alpha * l_prev + jnp.sum(p, axis=1)
+        acc_ref[...] = (alpha[:, None] * acc_ref[...]
+                        + jax.lax.dot_general(
+                            p, vb, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[...] = jnp.broadcast_to(m_next[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_next[:, None], l_ref.shape)
+
+        @pl.when(j == nj - 1)
+        def _finalize():
+            l = jnp.max(l_ref[...], axis=1)
+            o_ref[0, 0] = (
+                acc_ref[...] / jnp.where(l == 0, 1.0, l)[:, None]
+            ).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(b_sz, h_sz, ntab),
+        in_specs=[
+            pl.BlockSpec((1, 1, t, dh),
+                         lambda b, h, j, *refs: (b, h, 0, 0)),
+            # the page walk: scalar-prefetched table drives the DMA
+            pl.BlockSpec((1, bt, 1, dh),
+                         lambda b, h, j, bid, *refs:
+                         (bid[b, j], 0, h, 0)),
+            pl.BlockSpec((1, bt, 1, dh),
+                         lambda b, h, j, bid, *refs:
+                         (bid[b, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, t, dh),
+                               lambda b, h, j, *refs: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((t, 128), jnp.float32),   # running max
+            pltpu.VMEM((t, 128), jnp.float32),   # running sum
+            pltpu.VMEM((t, dh), jnp.float32),    # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b_sz, h_sz, t, dh), q.dtype),
+        interpret=interpret,
+    )(bid, bval, lo_blk, floor, filled, lengths, q, pk, pv)
 
 
 def _dense_attention(q, k, v, causal, mask):

@@ -219,7 +219,24 @@ def parse_exposition(text: str) -> Dict[str, Any]:
                     pass
                 continue
         if "{" in name:
-            continue  # labeled non-bucket samples: not emitted by us
+            # labeled non-bucket samples: keep gauge-style labeled
+            # samples (the ISSUE 12 per-shard gauges) keyed by their
+            # FULL labeled name; drop a federated histogram's
+            # per-replica `_sum{replica=..}`/`_count{..}`/`_bucket{..}`
+            # satellites (the unlabeled fleet family already carries
+            # the merged values)
+            base = name[:name.index("{")]
+            fam = next((base[:-len(s)] for s in ("_bucket", "_sum",
+                                                 "_count")
+                        if base.endswith(s)), None)
+            if fam is not None and (fam in hists
+                                    or types.get(fam) == "histogram"):
+                continue
+            try:
+                scalars[name] = float(value)
+            except ValueError:
+                pass
+            continue
         try:
             fval = float(value)
         except ValueError:
@@ -479,25 +496,39 @@ class Tracer:
                 hist_safe[sanitize(name)] = (name, hists[name])
         # collapse tracks whose names sanitize to the same metric name
         # (sorted order ⇒ the lexically-last raw name wins): Prometheus
-        # rejects an entire scrape over one duplicate sample
-        merged: Dict[str, Tuple[str, float, Optional[str]]] = {}
+        # rejects an entire scrape over one duplicate sample. A track
+        # named ``family{label="v"}`` (the ISSUE 12 per-shard gauges:
+        # ``serving_blocks_free{shard="0"}``) emits as a LABELED sample
+        # of the ``family`` metric — the same labeling scheme the fleet
+        # federation uses for ``{replica=...}`` — so one family carries
+        # several samples and HELP/TYPE render once.
+        merged: Dict[str, Dict[Optional[str],
+                               Tuple[str, float, Optional[str]]]] = {}
         for name in sorted(latest):
             if prefix is not None and not name.startswith(prefix):
                 continue
-            safe = sanitize(name)
+            base, labels = name, None
+            if "{" in name and name.endswith("}"):
+                base = name[:name.index("{")]
+                labels = name[name.index("{"):]
+            safe = sanitize(base)
             if safe in hist_safe:  # the histogram family owns the name
                 continue
             kind = "counter" if name in cumulative else "gauge"
-            merged[safe] = (kind, latest[name], helps.get(name))
+            merged.setdefault(safe, {})[labels] = (
+                kind, latest[name], helps.get(name, helps.get(base)))
         lines: List[str] = []
         for safe in sorted(merged):
-            kind, value, help_text = merged[safe]
-            text = ("%d" % value if float(value).is_integer()
-                    else repr(float(value)))
+            samples = merged[safe]
+            kind, _, help_text = next(iter(samples.values()))
             if help_text:
                 lines.append(f"# HELP {safe} {help_text}")
             lines.append(f"# TYPE {safe} {kind}")
-            lines.append(f"{safe} {text}")
+            for labels in sorted(samples, key=lambda v: v or ""):
+                _, value, _ = samples[labels]
+                text = ("%d" % value if float(value).is_integer()
+                        else repr(float(value)))
+                lines.append(f"{safe}{labels or ''} {text}")
         for safe in sorted(hist_safe):
             raw, hist = hist_safe[safe]
             lines.extend(hist.prometheus_lines(safe, helps.get(raw)))
@@ -557,12 +588,19 @@ class Tracer:
                 hist_parts.setdefault(
                     _sanitize_metric_name(name), {})[rid] = h
             for name, value in p["scalars"].items():
-                safe = _sanitize_metric_name(name)
+                # a labeled sample (`family{shard="0"}`) rides under
+                # its base family's TYPE/HELP; the label string stays
+                # verbatim on the federated sample
+                base, labels = name, ""
+                if "{" in name and name.endswith("}"):
+                    base = name[:name.index("{")]
+                    labels = name[name.index("{") + 1:-1]
+                safe = _sanitize_metric_name(base)
                 if safe in hist_parts:
                     continue
-                kind = p["types"].get(name, "gauge")
-                note(name, kind, p)
-                scalar_parts.setdefault(safe, {})[rid] = value
+                kind = p["types"].get(base, "gauge")
+                note(base, kind, p)
+                scalar_parts.setdefault(safe, {})[(rid, labels)] = value
         lines: List[str] = []
         for safe in order:
             kind = kinds[safe]
@@ -608,18 +646,30 @@ class Tracer:
                         f'{safe}_count{{replica="{lab}"}} '
                         f'{h["count"]}')
             elif kind == "counter":
-                total = sum(scalar_parts[safe].values())
-                text = ("%d" % total if float(total).is_integer()
-                        else repr(float(total)))
-                lines.append(f"{safe} {text}")
+                # sum per label set: an unlabeled counter sums to one
+                # fleet total; labeled counters sum within each label
+                # combination
+                by_labels: Dict[str, float] = {}
+                for (rid, labels), value in (
+                        scalar_parts[safe].items()):
+                    by_labels[labels] = by_labels.get(labels, 0.0) \
+                        + value
+                for labels in sorted(by_labels):
+                    total = by_labels[labels]
+                    text = ("%d" % total if float(total).is_integer()
+                            else repr(float(total)))
+                    suffix = f"{{{labels}}}" if labels else ""
+                    lines.append(f"{safe}{suffix} {text}")
             else:
-                for rid, value in scalar_parts[safe].items():
+                for (rid, labels), value in (
+                        scalar_parts[safe].items()):
                     text = ("%d" % value
                             if float(value).is_integer()
                             else repr(float(value)))
-                    lines.append(
-                        f'{safe}{{replica="{_escape_label(rid)}"}} '
-                        f"{text}")
+                    lab = f'replica="{_escape_label(rid)}"'
+                    if labels:
+                        lab += f",{labels}"
+                    lines.append(f"{safe}{{{lab}}} {text}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def save(self, path: str) -> None:

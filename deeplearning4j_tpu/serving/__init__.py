@@ -20,7 +20,12 @@ multi-lane ``/v1/trace``, and bucket-wise-merged
 ``/v1/fleet/metrics`` (ISSUE 10 tentpole) — and the elastic fleet
 controller: SLO-driven autoscaling over subprocess/in-process replica
 factories and zero-downtime rolling upgrades, every scale decision a
-``fleet.scale`` span on the stitched trace (ISSUE 11 tentpole)."""
+``fleet.scale`` span on the stitched trace (ISSUE 11 tentpole) — and
+the tensor-parallel sharded decode engine: ``DecodeEngine(tp=N)``
+turns the decode/verify/chunk executables into ``shard_map`` programs
+over attention heads with per-shard head-sliced KV (bytes = total/TP)
+behind the SAME layout-invariant host BlockTable, paired with a fused
+pallas paged-attention decode kernel (ISSUE 12 tentpole)."""
 
 from deeplearning4j_tpu.serving.block_pool import BlockPool, BlockTable
 from deeplearning4j_tpu.serving.controller import FleetController
@@ -66,6 +71,7 @@ from deeplearning4j_tpu.serving.scheduler import (
     Scheduler,
 )
 from deeplearning4j_tpu.serving.spec import NgramDraftTable
+from deeplearning4j_tpu.serving.tp import TPContext
 
 __all__ = [
     "BlockPool",
@@ -92,6 +98,7 @@ __all__ = [
     "RouterClient",
     "STATUS_OF_REASON",
     "Scheduler",
+    "TPContext",
     "ServingGateway",
     "ServingRouter",
     "greedy_acceptance",

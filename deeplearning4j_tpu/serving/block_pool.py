@@ -128,7 +128,8 @@ class BlockPool:
     sharer still reads it), and the two single-compile jitted helpers
     (``copy_block`` for CoW, ``zero_block`` for scrubbing)."""
 
-    def __init__(self, n_blocks: int, block_tokens: int):
+    def __init__(self, n_blocks: int, block_tokens: int,
+                 jit_wrap=None):
         if n_blocks < 1:
             raise ValueError(f"kv_blocks {n_blocks} < 1")
         if block_tokens < 1 or (block_tokens & (block_tokens - 1)):
@@ -136,6 +137,11 @@ class BlockPool:
                 f"block_tokens {block_tokens} must be a power of two")
         self.n_blocks = int(n_blocks)
         self.block_tokens = int(block_tokens)
+        # the engine's compilation entry point (ISSUE 12): a
+        # tensor-parallel engine hands its shard_map wrapper in so the
+        # pool's movers run per-shard on head-sliced blocks; None = the
+        # single-chip plain jax.jit (the pool is engine-agnostic)
+        self._jit_wrap = jit_wrap if jit_wrap is not None else jax.jit
         self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
         self._ref = np.zeros(self.n_blocks, np.int64)
         self.poisoned: set = set()
@@ -164,8 +170,8 @@ class BlockPool:
 
         # the pool is donated through every mover: one block changes,
         # the other n_blocks-1 alias in place instead of copying
-        self._copy_jit = jax.jit(copy_block, donate_argnums=(0,))
-        self._zero_jit = jax.jit(zero_block, donate_argnums=(0,))
+        self._copy_jit = self._jit_wrap(copy_block, donate_argnums=(0,))
+        self._zero_jit = self._jit_wrap(zero_block, donate_argnums=(0,))
 
     def compile_counts(self) -> Dict[str, int]:
         def n(f):

@@ -156,6 +156,11 @@ from deeplearning4j_tpu.serving.scheduler import (
     Scheduler,
 )
 from deeplearning4j_tpu.serving.spec import NgramDraftTable
+from deeplearning4j_tpu.serving.tp import TPContext
+
+#: restore() kwarg sentinel — ``None`` is a meaningful toggle value
+#: (auto mode) for ``use_flash_paged``
+_UNSET = object()
 
 
 @dataclasses.dataclass
@@ -325,6 +330,24 @@ SERVING_TRACK_HELP = {
     "serving_quarantined": "slots quarantined by the paranoid sweep",
     "serving_retries": "fault-retry re-admissions",
     "serving_retry_failures": "requests that exhausted the retry cap",
+    "serving_tp_dispatch_s": "sharded (tensor-parallel) device "
+                             "dispatch wall-time distribution "
+                             "(decode/verify dispatches; tp > 1 "
+                             "engines only)",
+    "serving_tp_shards": "tensor-parallel shard count (1 = "
+                         "single-chip engine)",
+    "serving_tp_kv_bytes": "per-shard device KV bytes "
+                           "({shard=...}-labeled; total/TP under "
+                           "head sharding)",
+    "serving_blocks_free": "free KV pool blocks (per-shard "
+                           "{shard=...} copies under tp > 1 — block "
+                           "ids are shard-invariant, so every shard "
+                           "reports the same count over its own "
+                           "head-sliced bytes)",
+    "serving_blocks_used": "used KV pool blocks (per-shard copies "
+                           "under tp > 1, as serving_blocks_free)",
+    "serving_frag_tokens": "allocated-but-masked pool tokens "
+                           "(per-shard copies under tp > 1)",
 }
 
 
@@ -544,7 +567,9 @@ class DecodeEngine:
                  block_tokens: int = 16,
                  kv_blocks: Optional[int] = None,
                  record_timing: bool = True,
-                 flight_recorder: int = 256):
+                 flight_recorder: int = 256,
+                 tp: int = 1,
+                 use_flash_paged=None):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
@@ -591,6 +616,40 @@ class DecodeEngine:
             raise ValueError(
                 "DecodeEngine requires at least one attention layer")
         self.window = min(windows)
+        # -- tensor-parallel head sharding (ISSUE 12; default tp=1 =
+        # the bit-identical single-chip engine) -----------------------
+        if tp < 1:
+            raise ValueError(f"tp {tp} < 1")
+        self.tp = int(tp)
+        self.tp_ctx: Optional[TPContext] = None
+        attn_items = [(name, bean) for name, bean in beans
+                      if isinstance(bean, ATTENTION_BEANS)]
+        if self.tp > 1:
+            for name, bean in attn_items:
+                if bean.n_heads % self.tp:
+                    raise ValueError(
+                        f"tp {self.tp} does not divide layer {name}'s "
+                        f"n_heads ({bean.n_heads}): head sharding "
+                        "slices whole heads")
+            self.tp_ctx = TPContext(self.tp,
+                                    [name for name, _ in attn_items])
+        #: pallas paged-attention kernel toggle (ISSUE 12 satellite):
+        #: None = auto (TPU only; the XLA gather path is the off-TPU
+        #: fallback), True = force (TPU), False = gather always,
+        #: "interpret" = run the kernel in pallas interpret mode (the
+        #: CPU parity-testing hook). Stamped onto the net's attention
+        #: beans — the engine owns its net in serving deployments.
+        self.use_flash_paged = use_flash_paged
+        if use_flash_paged is not None:
+            for _, bean in attn_items:
+                bean.use_flash_paged = use_flash_paged
+        #: sharded (tp > 1) or plain (tp == 1) views of the net's
+        #: params/state: every dispatch reads THESE, so the weights are
+        #: resident per-shard once, not re-sharded per call
+        self._params = (self.tp_ctx.place(net.params)
+                        if self.tp_ctx else net.params)
+        self._state = (self.tp_ctx.place(net.state)
+                       if self.tp_ctx and net.state else net.state)
         self.spec_draft_len = int(spec_draft_len)
         self.draft_source = draft_source
         if self.spec_draft_len >= self.window:
@@ -662,7 +721,8 @@ class DecodeEngine:
                     f"kv_blocks {self.kv_blocks} cannot hold one "
                     f"slot's window + one round of writes "
                     f"({slot_worst} blocks of {bt} tokens)")
-            self.block_pool = BlockPool(self.kv_blocks, bt)
+            self.block_pool = BlockPool(self.kv_blocks, bt,
+                                        jit_wrap=self._jit)
         if prefix_cache_rows and self.paged_kv:
             # paged trie: entries lease pool BLOCKS (zero-copy); the
             # row count caps entries, the block pool caps bytes
@@ -727,7 +787,8 @@ class DecodeEngine:
                 name: Histogram()
                 for name in ("serving_ttft_s", "serving_itl_s",
                              "serving_queue_wait_s", "serving_round_s",
-                             "serving_e2e_s")}
+                             "serving_e2e_s",
+                             "serving_tp_dispatch_s")}
         self.describe_metrics()
 
         self._key = jax.random.key(seed)
@@ -768,6 +829,31 @@ class DecodeEngine:
         self._build_jits()
 
     # -- jitted computations (fixed executables; see module docstring) -
+    def _jit(self, fn, donate_argnums=()):
+        """The engine's one compilation entry point: plain ``jax.jit``
+        at ``tp == 1`` (the bit-identical single-chip engine), or the
+        TP context's ``shard_map`` wrapper at ``tp > 1`` — the SAME
+        step functions become fully-manual sharded programs over the
+        ``tp`` mesh axis with per-leaf specs derived from key paths
+        (serving/tp.py). Every jitted computation the engine (or its
+        block pool / dense prefix trie) owns is built through here, so
+        the compile-count discipline reads through unchanged."""
+        if self.tp_ctx is not None:
+            return self.tp_ctx.wrap(fn, donate_argnums=donate_argnums)
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
+    def _place(self, tree):
+        """Commit a fresh device pytree onto the TP mesh under its
+        derived sharding (no-op at ``tp == 1``). Persistent state the
+        engine creates EAGERLY (the slot pool, the paged block pool,
+        the current-token vector) must be placed at creation: an
+        uncommitted array entering a sharded executable would compile
+        a second specialization the round its committed successor
+        returns (the retrace the spike caught)."""
+        if self.tp_ctx is not None:
+            return self.tp_ctx.place(tree)
+        return tree
+
     def _build_jits(self):
         forward, chunk = self._forward, self.decode_chunk
 
@@ -813,7 +899,7 @@ class DecodeEngine:
             (pool, tok), seq = jax.lax.scan(body, (pool, toks), keys)
             return pool, tok, jnp.swapaxes(seq, 0, 1)  # [B, chunk]
 
-        self._prefill_jit = jax.jit(prefill)
+        self._prefill_jit = self._jit(prefill)
         if self.paged_kv:
             # donate the carried cache: the block pool rides EVERY
             # paged dispatch as an operand, and without input-output
@@ -822,13 +908,13 @@ class DecodeEngine:
             # regression on the CPU proxy; the dense path keeps its
             # original no-donation behavior — callers there may hold
             # the old buffers)
-            self._chunk_jit = jax.jit(chunk_prefill,
-                                      donate_argnums=(4,))
-            self._decode_jit = jax.jit(decode, donate_argnums=(2,))
+            self._chunk_jit = self._jit(chunk_prefill,
+                                        donate_argnums=(4,))
+            self._decode_jit = self._jit(decode, donate_argnums=(2,))
         else:
-            self._chunk_jit = jax.jit(chunk_prefill)
-            self._decode_jit = jax.jit(decode)
-        self._admit_jit = jax.jit(admit)
+            self._chunk_jit = self._jit(chunk_prefill)
+            self._decode_jit = self._jit(decode)
+        self._admit_jit = self._jit(admit)
         self._verify_jit = None
         if self.spec_draft_len:
             vocab, dtype = self.vocab, self.net._dtype
@@ -875,8 +961,9 @@ class DecodeEngine:
                               bonus[:, None], 0))
                 return new_pool, bonus, emitted, acc
 
-            self._verify_jit = (jax.jit(verify, donate_argnums=(2,))
-                                if self.paged_kv else jax.jit(verify))
+            self._verify_jit = (
+                self._jit(verify, donate_argnums=(2,))
+                if self.paged_kv else self._jit(verify))
         self._scatter_jit = None
         self._tok_jit = None
         if self.paged_kv:
@@ -917,9 +1004,9 @@ class DecodeEngine:
                 return jax.lax.dynamic_update_slice(
                     toks, tok1.astype(toks.dtype), (slot,))
 
-            self._scatter_jit = jax.jit(scatter_row,
-                                        donate_argnums=(0,))
-            self._tok_jit = jax.jit(put_tok)
+            self._scatter_jit = self._jit(scatter_row,
+                                          donate_argnums=(0,))
+            self._tok_jit = self._jit(put_tok)
         self._health_jit = None
         if self.paranoid and self.paged_kv:
             vocab = self.vocab
@@ -940,7 +1027,7 @@ class DecodeEngine:
                 blocks_ok = functools.reduce(jnp.logical_and, oks)
                 return blocks_ok, (toks >= 0) & (toks < vocab)
 
-            self._health_jit = jax.jit(paged_health)
+            self._health_jit = self._jit(paged_health)
         elif self.paranoid:
             vocab = self.vocab
 
@@ -957,7 +1044,7 @@ class DecodeEngine:
                 ok = functools.reduce(jnp.logical_and, oks)
                 return ok & (toks >= 0) & (toks < vocab)
 
-            self._health_jit = jax.jit(health)
+            self._health_jit = self._jit(health)
 
     def compile_counts(self) -> Dict[str, int]:
         """Executable counts per jitted computation (the no-retrace
@@ -1382,12 +1469,20 @@ class DecodeEngine:
             filled[i] = tab.length
         # per-layer COPIES of the (tiny) table operands: the paged
         # dispatches donate their cache operand, and XLA rejects the
-        # same buffer donated through two pytree leaves
+        # same buffer donated through two pytree leaves. Under tp the
+        # copies COMMIT replicated (TPContext.replicate) so a plain
+        # round's operands and a spec round's chained verify-output
+        # pool share one decode lowering
+        def op(host_array):
+            if self.tp_ctx is not None:
+                return self.tp_ctx.replicate(host_array)
+            return jnp.asarray(host_array)
+
         return {name: dict(st,
-                           table=jnp.asarray(table),
-                           base=jnp.asarray(base),
-                           floor=jnp.asarray(floor),
-                           filled=jnp.asarray(filled))
+                           table=op(table),
+                           base=op(base),
+                           floor=op(floor),
+                           filled=op(filled))
                 for name, st in self._pool.items()}
 
     def _strip_pool(self, rnn):
@@ -1559,7 +1654,7 @@ class DecodeEngine:
                             done=pending.done, paged=True,
                             **_targs(req)):
                 tok, rnn = self._chunk_jit(
-                    self.net.params, self.net.state, x, mask, rnn_in,
+                    self._params, self._state, x, mask, rnn_in,
                     temp, top_k, self._next_key())
             if clock is not None:
                 now = self._clock()
@@ -1580,7 +1675,7 @@ class DecodeEngine:
                             bucket=width, tokens=len(seg),
                             **_targs(req)):
                 tok, rnn = self._prefill_jit(
-                    self.net.params, self.net.state, x, mask, temp,
+                    self._params, self._state, x, mask, temp,
                     top_k, self._next_key())
             if clock is not None:
                 now = self._clock()
@@ -1591,7 +1686,7 @@ class DecodeEngine:
                             width=width, tokens=len(seg),
                             done=pending.done, **_targs(req)):
                 tok, rnn = self._chunk_jit(
-                    self.net.params, self.net.state, x, mask,
+                    self._params, self._state, x, mask,
                     pending.rnn, temp, top_k, self._next_key())
             if clock is not None:
                 now = self._clock()
@@ -1617,8 +1712,9 @@ class DecodeEngine:
             return {"pk": jnp.zeros(shape, k.dtype),
                     "pv": jnp.zeros(shape, st["v"].dtype)}
 
-        self._pool = {name: make(st) for name, st in rnn1.items()}
-        self._toks = jnp.zeros((self.n_slots,), jnp.int32)
+        self._pool = self._place(
+            {name: make(st) for name, st in rnn1.items()})
+        self._toks = self._place(jnp.zeros((self.n_slots,), jnp.int32))
 
     def _complete_admission(self, pending: _Pending):
         """Suffix fully prefilled: scatter the state + first token into
@@ -1664,10 +1760,11 @@ class DecodeEngine:
             self._reserved.discard(slot)
         else:
             if self._pool is None:
-                self._pool = jax.tree_util.tree_map(
+                self._pool = self._place(jax.tree_util.tree_map(
                     lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
-                                        a.dtype), pending.rnn)
-                self._toks = jnp.zeros((self.n_slots,), jnp.int32)
+                                        a.dtype), pending.rnn))
+                self._toks = self._place(
+                    jnp.zeros((self.n_slots,), jnp.int32))
             with self._span("serving.admit", rid=request.id,
                             slot=slot, **_targs(request)):
                 self._pool, self._toks = self._admit_jit(
@@ -2082,7 +2179,7 @@ class DecodeEngine:
                         **self._traces_of(
                             s for s, d in drafts.items() if d)):
             pool_op, self._toks, emitted, acc = self._verify_jit(
-                self.net.params, self.net.state, pool_op,
+                self._params, self._state, pool_op,
                 self._toks, jnp.asarray(draft), jnp.asarray(lens),
                 jnp.asarray(self._temps), jnp.asarray(self._top_ks),
                 self._next_key())
@@ -2300,13 +2397,21 @@ class DecodeEngine:
                                   for s in active],
                             **self._traces_of(active)):
                 pool_op, self._toks, seq = self._decode_jit(
-                    self.net.params, self.net.state, pool_op,
+                    self._params, self._state, pool_op,
                     self._toks, jnp.asarray(self._temps),
                     jnp.asarray(self._top_ks), self._next_key())
                 seq = np.asarray(seq)  # [B, chunk]; forces the whole
                 #                        round (verify included) done
             dec_dt = (self._clock() - td0 if self.record_timing
                       else 0.0)
+            if self.tp > 1 and self.record_timing:
+                # sharded-dispatch wall (ISSUE 12): the decode (and
+                # chained verify) round-trips through the shard_map
+                # executables — per-dispatch, not per-token, so the
+                # histogram reads as "what does one TP round cost"
+                self._observe("serving_tp_dispatch_s", dec_dt)
+                if ver_dt:
+                    self._observe("serving_tp_dispatch_s", ver_dt)
             self._pool = self._strip_pool(pool_op)
             if verify_out is not None:
                 v_rows, v_n = self._land_verify(drafts, *verify_out)
@@ -2422,6 +2527,46 @@ class DecodeEngine:
             for key in ("hits", "misses", "evictions"):
                 self.tracer.counter(f"serving_prefix_{key}",
                                     self.prefix_cache.stats[key])
+        self._emit_tp_gauges()
+
+    def _emit_tp_gauges(self) -> None:
+        """Per-shard observability (ISSUE 12 satellite): under tp > 1
+        the pool/frag gauges gain ``{shard=...}``-labeled per-shard
+        copies (block IDS are shard-invariant — the host BlockTable is
+        the same on every shard — so the per-shard count equals the
+        fleet count while the BYTES behind each count are the shard's
+        head slice), plus ``serving_tp_kv_bytes{shard=...}`` measured
+        from the actual addressable shards. Labeled names ride the
+        PR 10 ``merge_prometheus`` labeling scheme, so a fleet scrape
+        shows ``{replica=...,shard=...}``."""
+        if self.tracer is None:
+            return
+        self.tracer.gauge("serving_tp_shards", self.tp)
+        if self.tp_ctx is None:
+            return
+        per_shard = self.kv_shard_bytes()
+        for shard, nbytes in per_shard.items():
+            self.tracer.gauge(
+                f'serving_tp_kv_bytes{{shard="{shard}"}}', nbytes)
+            if self.paged_kv:
+                for key in ("blocks_free", "blocks_used",
+                            "frag_tokens"):
+                    self.tracer.gauge(
+                        f'serving_{key}{{shard="{shard}"}}',
+                        self.stats[key])
+
+    def kv_shard_bytes(self) -> Dict[int, int]:
+        """Per-shard addressable KV-cache bytes (slot pool only): the
+        ``total/TP`` acceptance arithmetic and the per-shard gauges
+        read this. At ``tp == 1`` shard 0 holds everything."""
+        if self._pool is None:
+            return {i: 0 for i in range(self.tp)}
+        if self.tp_ctx is not None:
+            return self.tp_ctx.shard_bytes(self._pool)
+        total = sum(
+            int(np.prod(leaf.shape) * leaf.dtype.itemsize)
+            for leaf in jax.tree_util.tree_leaves(self._pool))
+        return {0: total}
 
     @property
     def mean_occupancy(self) -> float:
@@ -2508,10 +2653,11 @@ class DecodeEngine:
             self._kv_tabs[slot] = tab
         else:
             if self._pool is None:
-                self._pool = jax.tree_util.tree_map(
+                self._pool = self._place(jax.tree_util.tree_map(
                     lambda a: jnp.zeros((self.n_slots,) + a.shape[1:],
-                                        a.dtype), rnn)
-                self._toks = jnp.zeros((self.n_slots,), jnp.int32)
+                                        a.dtype), rnn))
+                self._toks = self._place(
+                    jnp.zeros((self.n_slots,), jnp.int32))
             with self._span("serving.admit", rid=request.id,
                             slot=slot, **_targs(request)):
                 self._pool, self._toks = self._admit_jit(
@@ -2595,6 +2741,12 @@ class DecodeEngine:
                 "kv_blocks": self.kv_blocks,
                 "record_timing": self.record_timing,
                 "flight_recorder": self.flight_recorder,
+                # provenance, not payload: the snapshot wire format is
+                # LAYOUT-INVARIANT (host tables + token ids, no device
+                # arrays), so a snapshot taken at one tp width
+                # restores at any other — restore(tp=...) overrides
+                "tp": self.tp,
+                "use_flash_paged": self.use_flash_paged,
             },
             # paged bookkeeping rides the snapshot for inspection and
             # exact-capacity restores (restore REBUILDS device blocks
@@ -2644,7 +2796,8 @@ class DecodeEngine:
     @classmethod
     def restore(cls, net, snapshot: Dict[str, Any], tracer=None,
                 fault_plan: Optional[FaultPlan] = None, clock=None,
-                seed: int = 0) -> "DecodeEngine":
+                seed: int = 0, tp: Optional[int] = None,
+                use_flash_paged=_UNSET) -> "DecodeEngine":
         """Rebuild an engine from ``snapshot()`` output in a fresh
         process: same config, prefix cache re-primed (deterministic
         prefill reproduces each stored row), every in-flight slot's KV
@@ -2653,8 +2806,21 @@ class DecodeEngine:
         crash-free engine would have (greedy: bit-identical). In-flight
         chunked admissions restart from the queue front (their partial
         prefill is recomputed); deadlines keep their already-elapsed
-        time."""
+        time.
+
+        ``tp`` overrides the snapshot's tensor-parallel width (ISSUE
+        12): the wire format is layout-invariant — host block tables,
+        token ids, NO device arrays — so a snapshot taken at TP=2
+        restores at TP=1 (or 4) bit-identically; device KV is rebuilt
+        by re-prefill under the restoring engine's own sharding.
+        ``use_flash_paged`` likewise overrides the kernel toggle (a
+        TPU-taken snapshot restores on a CPU host with the gather
+        fallback)."""
         cfg = snapshot["config"]
+        if tp is None:
+            tp = int(cfg.get("tp", 1))
+        if use_flash_paged is _UNSET:
+            use_flash_paged = cfg.get("use_flash_paged")
         eng = cls(
             net, n_slots=cfg["n_slots"],
             decode_chunk=cfg["decode_chunk"],
@@ -2675,7 +2841,8 @@ class DecodeEngine:
             block_tokens=cfg.get("block_tokens", 16),
             kv_blocks=cfg.get("kv_blocks") or None,
             record_timing=cfg.get("record_timing", True),
-            flight_recorder=cfg.get("flight_recorder", 256))
+            flight_recorder=cfg.get("flight_recorder", 256),
+            tp=tp, use_flash_paged=use_flash_paged)
         spec_state = snapshot.get("spec")
         if spec_state and eng.spec is not None:
             # resume K-adaptation where the crash left it (final ids

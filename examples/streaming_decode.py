@@ -28,6 +28,12 @@ three ways, fastest first:
    counters printed at the end show how often the free drafts were
    right; this trained pattern-following LM accepts nearly all of
    them).
+6. **Tensor-parallel sharding** (``tp=2``) — the same paged engine
+   sharded over attention heads: decode/verify/chunk run as
+   ``shard_map`` programs, each shard holds HALF the KV bytes behind
+   the SAME host block tables, and greedy ids stay identical to the
+   single-chip engine (the per-shard block/byte counters printed at
+   the end show the total/TP split).
 
 Run: python examples/streaming_decode.py
 """
@@ -36,6 +42,11 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# step 6 (tensor-parallel) wants >= 2 devices; on a CPU host that
+# means virtual XLA devices, declared BEFORE jax initializes
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=2")
 
 if os.environ.get("DL4J_EXAMPLES_PLATFORM", "native") == "cpu":
     import jax
@@ -218,6 +229,37 @@ def main():
           f"when idle, fragmentation "
           f"{paged.stats['frag_tokens']} tokens")
     print("paged compile counts:", paged.compile_counts())
+
+    # Tensor-parallel sharded decode (ISSUE 12): the paged engine
+    # again, sharded 2-ways over attention heads. The host block
+    # tables, refcounts, and trie are LAYOUT-INVARIANT — only the
+    # device bytes split — so the same warm-admission workload runs
+    # unchanged and every greedy id matches the single-chip run above.
+    import jax as _jax
+
+    if len(_jax.devices()) < 2:
+        print("tp: skipped (needs >= 2 devices)")
+        return
+    tp_eng = DecodeEngine(net, n_slots=4, decode_chunk=4,
+                          prefix_cache_rows=4, prefill_chunk=8,
+                          paged_kv=True, block_tokens=8, tp=2)
+    tp_reqs = {
+        tp_eng.submit(Request(prompt=system_prompt + tail,
+                              max_new_tokens=8)): tail
+        for tail in tails
+    }
+    tp_results = tp_eng.run()
+    ok = all(tp_results[rid].tokens == paged_results[prid].tokens
+             for rid, prid in zip(sorted(tp_results),
+                                  sorted(paged_results)))
+    print("tp=2 engine == single-chip paged engine:", ok)
+    shard_bytes = tp_eng.kv_shard_bytes()
+    for shard in sorted(shard_bytes):
+        print(f"  shard {shard}: {tp_eng.stats['blocks_used']} pool "
+              f"blocks held ({tp_eng.stats['blocks_free']} free), "
+              f"{shard_bytes[shard]} KV bytes "
+              "(= total/2 — head-sliced)")
+    print("tp compile counts:", tp_eng.compile_counts())
 
 
 if __name__ == "__main__":

@@ -69,14 +69,24 @@ def _workload(rng, n_requests: int, vocab: int, window: int):
 
 def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
              n_slots: int = 4, window: int = 32, block_tokens: int = 4,
-             kv_blocks: int = 18,
+             kv_blocks: int = 18, tp: int = 1,
+             use_flash_paged=None,
              verbose: bool = False) -> Dict[str, Any]:
     """One seeded soak; returns a summary dict and raises
-    AssertionError on any gate violation."""
+    AssertionError on any gate violation. ``tp > 1`` (ISSUE 12) runs
+    the paged engine SHARDED over attention heads — same pressure
+    ladder, same dense-reference parity gate, plus per-shard gates:
+    the head-sliced pool shards hold identical byte counts
+    (total/TP), and zero blocks leak per shard (block ids are
+    shard-invariant, so the host leak audit IS the per-shard audit —
+    asserted against the device shards to prove it)."""
+    from scripts._leakcheck import assert_no_leaks, leak_baseline
+
     from deeplearning4j_tpu.serving import DecodeEngine, Request
 
     rng = np.random.default_rng(seed)
     cases = _workload(rng, n_requests, vocab, window)
+    baseline = leak_baseline()
 
     def build(paged: bool):
         return DecodeEngine(
@@ -84,7 +94,9 @@ def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
             decode_chunk=4, prefix_cache_rows=8, prefill_chunk=4,
             admission_policy="decode", max_queue=4 * n_requests,
             paged_kv=paged, block_tokens=block_tokens,
-            kv_blocks=kv_blocks if paged else None)
+            kv_blocks=kv_blocks if paged else None,
+            tp=tp if paged else 1,
+            use_flash_paged=use_flash_paged if paged else None)
 
     # dense reference: the ids every paged finish must match
     ref_eng = build(False)
@@ -124,6 +136,14 @@ def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
         f"leak: {pool.used_blocks} blocks used while the trie holds "
         f"{len(trie_blocks)} — a slot or pending admission leaked "
         "references")
+    # per-shard audit (ISSUE 12): every shard's head slice of the pool
+    # holds total/TP bytes — a shard that leaked device blocks (or was
+    # never sharded) breaks the symmetry
+    shard_bytes = eng.kv_shard_bytes()
+    assert len(shard_bytes) == tp, shard_bytes
+    assert len(set(shard_bytes.values())) == 1, (
+        f"asymmetric shards: {shard_bytes}")
+
     eng.prefix_cache.clear()
     assert pool.used_blocks == 0, "blocks survived a trie clear"
     assert pool.free_blocks == eng.kv_blocks
@@ -136,9 +156,15 @@ def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
     assert counts["paged_tok"] == 1, counts
     assert counts["chunk_prefill"] <= 2, counts
 
+    # the engine is in-process (no sockets), but the sharded runtime
+    # must not strand helper threads either — the shared soak policy
+    assert_no_leaks(baseline)
+
     summary = {
         "n_requests": n_requests,
         "seed": seed,
+        "tp": tp,
+        "shard_bytes": shard_bytes,
         "wall_s": round(wall_s, 2),
         "kv_blocks": eng.kv_blocks,
         "used_blocks_peak": used_peak,
@@ -165,12 +191,31 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--kv-blocks", type=int, default=18)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel shards (ISSUE 12): the "
+                         "paged engine runs sharded over attention "
+                         "heads; parity/leak gates gain per-shard "
+                         "checks")
+    ap.add_argument("--use-flash-paged", default="auto",
+                    choices=("auto", "on", "off", "interpret"))
     args = ap.parse_args(argv)
+    if args.tp > 1:
+        # a CPU host needs virtual devices for the TP mesh — set
+        # BEFORE anything touches jax (the serving import does)
+        os.environ.setdefault(
+            "XLA_FLAGS",
+            f"--xla_force_host_platform_device_count="
+            f"{max(8, args.tp)}")
+    # imported after the XLA_FLAGS setdefault — the driver module
+    # pulls in jax, which freezes the device count on first touch
+    from deeplearning4j_tpu.cli.driver import FLASH_PAGED_MODES
+    toggle = FLASH_PAGED_MODES[args.use_flash_paged]
     n = args.requests or (24 if args.fast else 160)
     print(f"paged soak: {n} requests, seed {args.seed}, "
-          f"{args.kv_blocks} blocks")
+          f"{args.kv_blocks} blocks, tp {args.tp}")
     summary = run_soak(n_requests=n, seed=args.seed,
-                       kv_blocks=args.kv_blocks, verbose=True)
+                       kv_blocks=args.kv_blocks, tp=args.tp,
+                       use_flash_paged=toggle, verbose=True)
     print(f"PASS in {summary['wall_s']}s")
     return 0
 

@@ -1,0 +1,381 @@
+"""Tensor-parallel sharded decode engine + fused pallas
+paged-attention kernel (ISSUE 12 tentpole).
+
+The contract under test: ``DecodeEngine(tp=N)`` turns the
+decode/verify/chunk executables into fully-manual ``shard_map``
+programs over a TP mesh axis — attention params column/row-sliced over
+heads, every KV leaf sharded on its head axis (per-shard bytes =
+total/TP) — while the HOST side (block ids, refcounts, CoW, the radix
+trie, the snapshot wire format) stays layout-invariant. Greedy ids are
+BIT-IDENTICAL to the single-chip engine at every TP width, across
+admission modes x paged on/off x spec on/off, at the single-chip
+compile budget; a snapshot taken at TP=2 restores at TP=1. The pallas
+paged-attention kernel (interpret mode on CPU) is argmax-bit-parity
+with the XLA gather program and preserves the PR 6 value-level NaN
+masking.
+
+Engines are BUILT ONCE per config in a module-scoped rig (each build
+compiles a shard_map program set — the expensive part) and shared by
+the parity/sharding/retrace/byte tests; tier-1 wall time is budgeted.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.layers.attention import (
+    AttentionImpl,
+    MultiHeadSelfAttention,
+    _should_use_flash_paged,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.profiler.tracer import Tracer
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    GatewayClient,
+    Request,
+    ServingGateway,
+    TPContext,
+)
+
+V = 12
+
+
+def _net(seed=7, stream_max_t=64):
+    net = MultiLayerNetwork(transformer_lm(
+        n_in=V, width=32, n_layers=2, n_heads=4, n_classes=V,
+        seed=seed)).init()
+    for c in net.conf.confs:
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = stream_max_t
+    return net
+
+
+# shared-prefix workload: splice + CoW + cold admissions under TP
+SHARED = [1, 4, 7, 2, 5, 9, 3, 3]
+CASES = [(SHARED + [1, 6], 8), (SHARED + [2, 0], 5),
+         ([9, 3, 3], 11), ([2, 2], 9)]
+
+
+def _submit_run(eng):
+    ids = [eng.submit(Request(list(p), n)) for p, n in CASES]
+    res = eng.run()
+    return {r: res[r].tokens for r in ids}
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """Build-once engine cache keyed by config; every engine has run
+    the shared workload once (warm — compile counts are frozen)."""
+    cache = {}
+
+    def get(tp=1, paged=True, spec=0, prefill_chunk=0, policy="ttft",
+            use_flash_paged=None):
+        key = (tp, paged, spec, prefill_chunk, policy,
+               use_flash_paged)
+        if key not in cache:
+            eng = DecodeEngine(
+                _net(), n_slots=2, decode_chunk=2, seed=0,
+                prefix_cache_rows=4, paged_kv=paged, block_tokens=8,
+                spec_draft_len=spec, prefill_chunk=prefill_chunk,
+                admission_policy=policy, tp=tp,
+                use_flash_paged=use_flash_paged)
+            cache[key] = (eng, _submit_run(eng))
+        return cache[key]
+
+    return get
+
+
+class TestTpParityMatrix:
+    """Acceptance gate: greedy bit-parity vs the single-chip engine
+    across TP width x paged x spec x admission mode."""
+
+    @pytest.mark.parametrize("paged,spec,prefill_chunk,policy", [
+        (False, 0, 0, "ttft"),      # dense, blocking admission
+        (True, 0, 0, "ttft"),       # paged
+        (True, 3, 4, "decode"),     # paged + spec + chunked
+    ])
+    def test_tp2_bit_parity(self, rig, paged, spec, prefill_chunk,
+                            policy):
+        _, ref = rig(1, paged, spec, prefill_chunk, policy)
+        eng, got = rig(2, paged, spec, prefill_chunk, policy)
+        assert got == ref
+        assert eng.tp == 2 and eng.tp_ctx is not None
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("spec,prefill_chunk,policy", [
+        (0, 0, "decode"), (3, 4, "ttft")])
+    @pytest.mark.parametrize("paged", [False, True])
+    def test_tp2_bit_parity_full_matrix(self, rig, paged, spec,
+                                        prefill_chunk, policy):
+        """The remaining admission-mode x layout combinations (slow
+        tier: tier-1 keeps the three structurally distinct corners
+        above within the wall-time budget)."""
+        _, ref = rig(1, paged, spec, prefill_chunk, policy)
+        _, got = rig(2, paged, spec, prefill_chunk, policy)
+        assert got == ref
+
+    def test_tp4_bit_parity_paged_spec(self, rig):
+        _, ref = rig(1, True, 3, 4, "decode")
+        _, got = rig(4, True, 3, 4, "decode")
+        assert got == ref
+
+    def test_tp_width_validation(self):
+        with pytest.raises(ValueError, match="does not divide"):
+            DecodeEngine(_net(), tp=3)  # 4 heads % 3
+        with pytest.raises(ValueError, match="tp 0"):
+            DecodeEngine(_net(), tp=0)
+        # width past the visible devices fails in TPContext (the
+        # engine's heads check fires first at non-dividing widths)
+        with pytest.raises(ValueError, match="exceeds"):
+            TPContext(16, ["0"])
+
+
+class TestTpCompileDiscipline:
+    """The sharded engine holds the SINGLE-CHIP compile budget: one
+    decode, one scatter, one paged tok — per TP width — and a warmed
+    engine never retraces."""
+
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_no_retrace_and_budget(self, assert_no_retrace, rig, tp):
+        eng, first = rig(tp, True, 3 if tp == 4 else 0,
+                         4 if tp == 4 else 0,
+                         "decode" if tp == 4 else "ttft")
+        # a second pass admits through the now-warm prefix trie — the
+        # paged engine's SECOND legitimate chunk_prefill variant (the
+        # PR 6 budget: cold accumulation + paged warm continuation)
+        _submit_run(eng)
+        counts = eng.compile_counts()
+        # the PR 6 paged budget, unchanged by sharding
+        assert counts["decode"] == 1, counts
+        assert counts["paged_scatter"] == 1, counts
+        assert counts["paged_tok"] == 1, counts
+        assert counts["chunk_prefill"] <= 2, counts
+        with assert_no_retrace(eng):
+            again = _submit_run(eng)
+        assert list(again.values()) == list(first.values())
+
+
+class TestTpSharding:
+    """Device-side acceptance: per-shard KV bytes == total/TP, every
+    cache leaf actually sharded on its head axis."""
+
+    def test_per_shard_kv_bytes_total_over_tp(self, rig):
+        eng1, _ = rig(1)
+        total = sum(eng1.kv_shard_bytes().values())
+        for tp in (2, 4):
+            eng, _ = rig(tp, True, 3 if tp == 4 else 0,
+                         4 if tp == 4 else 0,
+                         "decode" if tp == 4 else "ttft")
+            per = eng.kv_shard_bytes()
+            assert len(per) == tp
+            assert all(b == total // tp for b in per.values()), (
+                total, per)
+
+    def test_pool_leaves_sharded_on_head_axis(self, rig):
+        eng, _ = rig(2)
+        for st in eng._pool.values():
+            for leaf in (st["pk"], st["pv"]):
+                spec = leaf.sharding.spec
+                assert "tp" in spec, spec      # head axis (index 2)
+                assert spec.index("tp") == 2
+        dense, _ = rig(2, paged=False)
+        for st in dense._pool.values():
+            assert st["k"].sharding.spec.index("tp") == 1  # [B,H,W,dh]
+
+    def test_params_head_sliced(self, rig):
+        eng, _ = rig(2)
+        for layer in eng._params.values():
+            if "Wq" not in layer:
+                continue
+            assert layer["Wq"].sharding.spec.index("tp") == 1
+            assert layer["Wo"].sharding.spec.index("tp") == 0
+
+    def test_spec_normalization_no_trailing_none(self):
+        """P(None, None, 'tp', None) and P(None, None, 'tp') hash as
+        different jit keys — the context must emit the normalized
+        form or the first decode after a scatter retraces (the spike
+        this caught)."""
+        ctx = TPContext(2, ["0"])
+        leaf = jnp.zeros((4, 8, 4, 8))
+        spec = ctx._leaf_spec(
+            (jax.tree_util.DictKey("0"), jax.tree_util.DictKey("pk")),
+            leaf)
+        assert tuple(spec) == (None, None, "tp")
+
+    def test_tp_context_validation(self):
+        with pytest.raises(ValueError, match="exceeds"):
+            TPContext(99, ["0"])
+        with pytest.raises(ValueError, match="tp 0"):
+            TPContext(0, ["0"])
+
+
+class TestSnapshotLayoutInvariance:
+    """Satellite: the snapshot wire format never sees the head axis —
+    a snapshot taken at TP=2 restores at TP=1 (and vice versa),
+    finishing bit-identically."""
+
+    def _crash_restore(self, snap_tp, restore_tp, rig):
+        eng = DecodeEngine(_net(), n_slots=2, decode_chunk=2, seed=0,
+                           prefix_cache_rows=4, paged_kv=True,
+                           block_tokens=8, tp=snap_tp)
+        for p, n in CASES:
+            eng.submit(Request(list(p), n))
+        res = {}
+        eng.step(res)
+        eng.step(res)
+        snap = json.loads(json.dumps(eng.snapshot()))
+        assert snap["config"]["tp"] == snap_tp
+        restored = DecodeEngine.restore(_net(), snap, tp=restore_tp)
+        assert restored.tp == restore_tp
+        out = dict(res)
+        out.update(restored.run())
+        got = {r: t.tokens for r, t in out.items()}
+        assert got == rig(1)[1]
+
+    def test_tp2_snapshot_restores_at_tp1(self, rig):
+        self._crash_restore(2, 1, rig)
+
+    @pytest.mark.slow
+    def test_tp1_snapshot_restores_at_tp2(self, rig):
+        self._crash_restore(1, 2, rig)
+
+    def test_restore_defaults_to_snapshot_width(self):
+        eng = DecodeEngine(_net(), n_slots=2, tp=2)
+        snap = eng.snapshot()
+        assert DecodeEngine.restore(_net(), snap).tp == 2
+
+
+class TestPagedFlashKernel:
+    """The pallas paged-attention kernel (interpret mode = the CPU
+    parity hook) vs the XLA gather program."""
+
+    def test_kernel_bit_parity_sharded(self, rig):
+        _, ref = rig(1)
+        _, got = rig(2, use_flash_paged="interpret")
+        assert got == ref
+
+    def test_kernel_bit_parity_spec_chunked(self, rig):
+        _, ref = rig(1, True, 3, 4, "decode")
+        _, got = rig(1, True, 3, 4, "decode",
+                     use_flash_paged="interpret")
+        assert got == ref
+
+    def test_auto_mode_fallback_off_tpu(self):
+        """None = auto selects the XLA gather off-TPU; True raises
+        rather than silently degrading; False is always the gather."""
+        assert not _should_use_flash_paged(None, 16, 128)
+        assert not _should_use_flash_paged(False, 16, 128)
+        assert _should_use_flash_paged("interpret", 2, 8)
+        with pytest.raises(ValueError, match="TPU backend"):
+            _should_use_flash_paged(True, 16, 128)
+
+    def test_kernel_value_level_nan_masking(self):
+        """The PR 6 poisoned-neighbour fix holds INSIDE the kernel: a
+        NaN in an unmapped/out-of-span pool block must not reach the
+        output (0 x NaN = NaN would survive score-only masking)."""
+        lc = MultiHeadSelfAttention(n_in=8, n_out=8, n_heads=2,
+                                    stream_max_t=16)
+        b, h, t, dh, nb, bt, s_ring = 1, 2, 2, 4, 6, 4, 8
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (b, h, t, dh))
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, h, t, dh))
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, h, t, dh))
+        pool_k = jax.random.normal(jax.random.PRNGKey(3),
+                                   (nb, bt, h, dh))
+        pool_v = jax.random.normal(jax.random.PRNGKey(4),
+                                   (nb, bt, h, dh))
+        # block 5 is FREE and dirty with NaN (eviction never scrubs)
+        pool_v = pool_v.at[5].set(jnp.nan)
+        pool_k = pool_k.at[5].set(jnp.nan)
+        table = np.full((b, s_ring), -1, np.int32)
+        base = np.full((b, s_ring), -1, np.int32)
+        # logical blocks 0..2 mapped; row has 9 tokens, writes 2 more
+        for g, bid in ((0, 1), (1, 2), (2, 3)):
+            table[0, g % s_ring] = bid
+            base[0, g % s_ring] = g * bt
+        cache = {"pk": pool_k, "pv": pool_v,
+                 "table": jnp.asarray(table),
+                 "base": jnp.asarray(base),
+                 "floor": jnp.zeros((b,), jnp.int32),
+                 "filled": jnp.full((b,), 9, jnp.int32)}
+        outs = {}
+        for toggle in (False, "interpret"):
+            lc.use_flash_paged = toggle
+            o, _ = AttentionImpl._paged_attend(lc, q, k, v,
+                                               dict(cache))
+            outs[toggle] = np.asarray(o)
+        assert np.isfinite(outs["interpret"]).all(), (
+            "NaN leaked through the kernel's masked lanes")
+        np.testing.assert_allclose(outs["interpret"], outs[False],
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestTpObservability:
+    """Satellite: per-shard gauges ({shard=...} labels riding the
+    PR 10 labeling scheme) + the serving_tp_dispatch_s histogram,
+    asserted over HTTP through the gateway."""
+
+    def test_per_shard_gauges_over_http(self):
+        eng = DecodeEngine(_net(), n_slots=2, decode_chunk=2, seed=0,
+                           prefix_cache_rows=4, paged_kv=True,
+                           block_tokens=8, tp=2)
+        gw = ServingGateway(eng)
+        gw.start()
+        try:
+            client = GatewayClient(gw.address, timeout_s=60.0)
+            client.generate(list(CASES[0][0]), 6)
+            text = client.metrics()
+        finally:
+            gw.close()
+        for shard in (0, 1):
+            for fam in ("serving_blocks_free", "serving_blocks_used",
+                        "serving_frag_tokens",
+                        "serving_tp_kv_bytes"):
+                assert f'{fam}{{shard="{shard}"}} ' in text, (
+                    f"missing {fam} shard {shard}:\n{text}")
+        assert "\nserving_tp_shards 2" in text
+        assert "serving_tp_dispatch_s_bucket" in text
+        assert "serving_tp_dispatch_s_count" in text
+        # the histogram actually observed sharded dispatches
+        count = [ln for ln in text.splitlines()
+                 if ln.startswith("serving_tp_dispatch_s_count")]
+        assert count and float(count[0].split()[-1]) >= 1
+        # per-shard KV bytes agree with the engine's own accounting
+        per = eng.kv_shard_bytes()
+        for shard, nbytes in per.items():
+            assert f'serving_tp_kv_bytes{{shard="{shard}"}} ' \
+                f"{nbytes}" in text
+
+    def test_single_chip_emits_no_shard_labels(self):
+        tracer = Tracer()
+        eng = DecodeEngine(_net(), n_slots=2, decode_chunk=2, seed=0,
+                           tracer=tracer)
+        eng.submit(Request([1, 4, 7, 2], 4))
+        eng.run()
+        text = tracer.prometheus_text()
+        assert "{shard=" not in text
+        assert "\nserving_tp_shards 1" in text
+        for ln in text.splitlines():
+            if ln.startswith("serving_tp_dispatch_s_count"):
+                assert ln.split()[-1] == "0"
+
+    def test_shard_labels_federate_with_replica_labels(self):
+        """{shard=...} gauges ride merge_prometheus: the federated
+        scrape carries {replica=...,shard=...} samples."""
+        texts = {}
+        for rid in ("r0", "r1"):
+            tr = Tracer()
+            tr.gauge('serving_blocks_free{shard="0"}', 7)
+            tr.gauge('serving_blocks_free{shard="1"}', 7)
+            texts[rid] = tr.prometheus_text()
+        assert 'serving_blocks_free{shard="0"} 7' in texts["r0"]
+        fleet = Tracer.merge_prometheus(texts)
+        for rid in ("r0", "r1"):
+            for shard in (0, 1):
+                assert (f'serving_blocks_free{{replica="{rid}",'
+                        f'shard="{shard}"}} 7') in fleet, fleet
