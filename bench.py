@@ -2124,6 +2124,115 @@ def bench_fleet_controller_overhead():
     }
 
 
+def bench_tenant_qos_overhead():
+    """Multi-tenant QoS row (ISSUE 13 acceptance): tenancy must be
+    FREE when unused. Single-tenant traffic (every request on the
+    implicit ``default`` tenant) through the weighted-fair scheduler
+    vs the SAME workload on the seed FIFO scheduler — same net, same
+    width-1024 flagship / 2048-window / 8-slot config, interleaved
+    median-of-3.
+
+    Gates:
+    - overhead: weighted-fair aggregate tokens/sec >= 0.97x the seed
+      scheduler's (the per-round begin_round/pop_admissible hooks and
+      the per-tenant histograms are host-side bookkeeping — they may
+      not tax the decode hot path);
+    - parity: ids bit-identical across the two engines (one
+      backlogged tenant's fair order IS arrival order);
+    - zero retrace on both engines, and the QoS layer must not have
+      acted (zero preemptions, zero sheds): tenancy-on with one
+      tenant is OBSERVATION only."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        Request,
+        TenantRegistry,
+    )
+
+    V, width, n_layers, window = 64, 1024, 8, 2048
+    n_slots, n_gen, prompt_len = 8, 128, 128
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_slots)]
+
+    seed_eng = DecodeEngine(net, n_slots=n_slots, decode_chunk=32)
+    fair_eng = DecodeEngine(net, n_slots=n_slots, decode_chunk=32,
+                            tenants=TenantRegistry())
+
+    def one_round(engine):
+        ids = [engine.submit(Request(prompt=list(p),
+                                     max_new_tokens=n_gen))
+               for p in prompts]
+        t0 = time.perf_counter()
+        results = engine.run()
+        dt = time.perf_counter() - t0
+        toks = sum(len(results[i].tokens) for i in ids)
+        return toks / dt, [results[i].tokens for i in ids]
+
+    _, seed_tokens = one_round(seed_eng)   # warm + reference ids
+    _, fair_tokens = one_round(fair_eng)
+    id_match = float(np.mean([fair_tokens[i] == seed_tokens[i]
+                              for i in range(n_slots)]))
+    if id_match < 1.0:
+        _fail_gate(f"weighted-fair ids diverged from the seed "
+                   f"scheduler (match {id_match:.2f})")
+
+    counts0 = {"seed": seed_eng.compile_counts(),
+               "fair": fair_eng.compile_counts()}
+    seed_rates, fair_rates = [], []
+    for _ in range(3):  # interleaved: drift hits both alike
+        r, _ = one_round(seed_eng)
+        seed_rates.append(r)
+        r, _ = one_round(fair_eng)
+        fair_rates.append(r)
+    counts1 = {"seed": seed_eng.compile_counts(),
+               "fair": fair_eng.compile_counts()}
+    if counts1 != counts0:
+        _fail_gate(f"tenancy bench retraced: {counts0} -> {counts1}")
+    if (fair_eng.stats["qos_preempted"] or fair_eng.stats["shed"]
+            or fair_eng.stats["preempted"]):
+        _fail_gate(
+            "the QoS layer ACTED on single-tenant traffic "
+            f"(qos_preempted {fair_eng.stats['qos_preempted']}, "
+            f"shed {fair_eng.stats['shed']}) — tenancy-on with one "
+            "tenant must be observation only")
+
+    seed_rate = float(np.median(seed_rates))
+    fair_rate = float(np.median(fair_rates))
+    ratio = fair_rate / seed_rate
+    if ratio < 0.97:
+        _fail_gate(
+            f"weighted-fair scheduler {fair_rate:.0f} tok/s < 0.97x "
+            f"seed scheduler {seed_rate:.0f} (ratio {ratio:.3f}) — "
+            "tenancy is supposed to be free when unused")
+    return {
+        "metric": "tenant_qos_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": ("aggregate tokens/sec, weighted-fair scheduler "
+                 "(default tenant only) / seed FIFO scheduler "
+                 f"(width-1024 flagship, 2048-token window, "
+                 f"{n_slots} slots x {n_gen} tokens, interleaved "
+                 "median-of-3)"),
+        "vs_baseline": None,  # reference has no tenancy tier
+        "spread": [round(min(fair_rates) / max(seed_rates), 4),
+                   round(max(fair_rates) / min(seed_rates), 4)],
+        "trials": len(fair_rates),
+        "fair_tokens_per_sec": round(fair_rate, 1),
+        "seed_tokens_per_sec": round(seed_rate, 1),
+        "tenant_id_match": round(id_match, 4),
+        "compile_counts": counts1["fair"],
+    }
+
+
 def bench_observability_overhead():
     """Observability row (ISSUE 7 acceptance): the request-scoped
     flight recorder must be cheap enough to leave ON. Same width-1024
@@ -2660,6 +2769,7 @@ def main() -> None:
                bench_gateway_streaming, bench_router_overhead,
                bench_fleet_trace_overhead,
                bench_fleet_controller_overhead,
+               bench_tenant_qos_overhead,
                bench_observability_overhead,
                bench_train_observability_overhead,
                bench_w2v, bench_dbn, bench_allreduce):

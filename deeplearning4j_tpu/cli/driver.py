@@ -377,6 +377,19 @@ FLASH_PAGED_MODES = {"auto": None, "on": True, "off": False,
                      "interpret": "interpret"}
 
 
+def tenants_from_args(args):
+    """Build the :class:`TenantRegistry` from repeated ``--tenant``
+    specs (``name[:key=value]...`` — see ``TenantSpec.parse``), or
+    None when no spec was given (tenancy stays off: the seed FIFO
+    scheduler, zero per-tenant bookkeeping)."""
+    specs = getattr(args, "tenant", None) or []
+    if not specs:
+        return None
+    from deeplearning4j_tpu.serving import TenantRegistry, TenantSpec
+
+    return TenantRegistry(tuple(TenantSpec.parse(s) for s in specs))
+
+
 def gateway_from_args(args):
     """Build (or restore) the serving gateway the ``serve`` subcommand
     runs — factored out so tests can drive the exact CLI path without
@@ -385,6 +398,8 @@ def gateway_from_args(args):
     ids) instead of starting fresh."""
     from deeplearning4j_tpu.serving import DecodeEngine, ServingGateway
     from deeplearning4j_tpu.util.model_serializer import restore_model
+
+    tenants = tenants_from_args(args)
 
     def engine():
         return DecodeEngine(
@@ -401,18 +416,22 @@ def gateway_from_args(args):
             kv_blocks=args.kv_blocks,
             tp=getattr(args, "tp", 1),
             use_flash_paged=FLASH_PAGED_MODES[
-                getattr(args, "use_flash_paged", "auto")])
+                getattr(args, "use_flash_paged", "auto")],
+            tenants=tenants)
 
     return ServingGateway.boot(
         engine, snapshot_path=args.snapshot,
         net_factory=lambda: restore_model(args.model),
         # the HOST wins layout knobs on restore: the snapshot wire
         # format is tp-invariant, so a drain taken at one width
-        # restores at whatever this host can shard
+        # restores at whatever this host can shard. The tenant
+        # registry likewise: this host's --tenant specs override the
+        # snapshot's (None = keep the snapshot's registry).
         restore_kwargs={
             "tp": getattr(args, "tp", 1),
             "use_flash_paged": FLASH_PAGED_MODES[
-                getattr(args, "use_flash_paged", "auto")]},
+                getattr(args, "use_flash_paged", "auto")],
+            "tenants": tenants},
         host=args.host, port=args.port,
         replica_id=getattr(args, "replica_id", None))
 
@@ -431,7 +450,8 @@ def router_from_args(args):
         health_interval_s=args.health_interval,
         failure_threshold=args.failure_threshold,
         probe_interval_s=args.probe_interval,
-        max_replays=args.max_replays)
+        max_replays=args.max_replays,
+        tenants=tenants_from_args(args))
 
 
 def _cmd_route(args) -> int:
@@ -473,6 +493,10 @@ def _serve_child_argv(args, port: int, replica_id: str):
         argv += ["--tp", str(args.tp)]
     if getattr(args, "use_flash_paged", "auto") != "auto":
         argv += ["--use-flash-paged", args.use_flash_paged]
+    for spec in getattr(args, "tenant", None) or []:
+        # every replica enforces the same tenant table the router
+        # rate-limits by — quotas and priorities are fleet-wide
+        argv += ["--tenant", spec]
     return argv
 
 
@@ -522,7 +546,8 @@ def fleet_from_args(args):
         router = ServingRouter(
             [r.address for r in seeds], host=args.host,
             port=args.port,
-            affinity_block_tokens=args.affinity_block_tokens)
+            affinity_block_tokens=args.affinity_block_tokens,
+            tenants=tenants_from_args(args))
         controller = FleetController(
             router, replica_factory=factory,
             min_replicas=args.min_replicas,
@@ -569,6 +594,60 @@ def _cmd_fleet(args) -> int:
         # the seeds were adopted, so shutdown_fleet reaps everything
         controller.shutdown_fleet()
     return 0
+
+
+def _cmd_client(args) -> int:
+    """One generation against a running gateway or router
+    (``dl4j-tpu client``): the smallest way to exercise a serving
+    deployment — including its tenancy surface (``--tenant`` /
+    ``--priority`` ride the request; a 429 prints that tenant's own
+    Retry-After instead of dying with a traceback)."""
+    from deeplearning4j_tpu.serving import GatewayClient, GatewayError
+
+    try:
+        prompt = [int(t) for t in args.prompt.split(",") if t.strip()]
+    except ValueError:
+        raise SystemExit(
+            f"--prompt {args.prompt!r}: expected comma-separated "
+            "token ids, e.g. '1,4,7,2'")
+    if not prompt:
+        raise SystemExit("--prompt must carry at least one token id")
+    kwargs = {}
+    if args.tenant is not None:
+        kwargs["tenant"] = args.tenant
+    if args.priority is not None:
+        kwargs["priority"] = args.priority
+    if args.temperature:
+        kwargs["temperature"] = args.temperature
+    client = GatewayClient(args.address, timeout_s=args.timeout)
+    try:
+        if args.stream:
+            stream = client.stream(prompt, args.max_new_tokens,
+                                   **kwargs)
+            tokens = []
+            for delta in stream:
+                tokens.extend(delta)
+                print(f"delta: {delta}", flush=True)
+            result = stream.result or {}
+        else:
+            result = client.generate(prompt, args.max_new_tokens,
+                                     **kwargs)
+            tokens = result.get("tokens", [])
+    except GatewayError as e:
+        if e.status == 429:
+            tenant = e.payload.get("tenant")
+            print(f"429 throttled"
+                  + (f" (tenant {tenant})" if tenant else "")
+                  + f": retry after {e.retry_after_s}s "
+                  f"({e.payload.get('error')})")
+            return 2
+        raise SystemExit(f"request failed: {e}")
+    print(f"tokens: {tokens}")
+    print(f"finish_reason: {result.get('finish_reason')}"
+          + (f" tenant: {result['tenant']}"
+             if result.get("tenant") else ""))
+    return 0 if result.get("finish_reason") in ("length", "eos") \
+        else 1
 
 
 def _cmd_serve(args) -> int:
@@ -716,6 +795,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stable replica identity for a router tier "
                         "(affinity keys hash against it; defaults "
                         "to host:port)")
+    s.add_argument("--tenant", action="append", default=None,
+                   metavar="SPEC",
+                   help="tenant service class, repeatable "
+                        "(ISSUE 13): name[:key=value]... with keys "
+                        "priority/weight/slots/queue/rps/burst, "
+                        "e.g. premium:priority=2:weight=4:slots=4; "
+                        "any --tenant enables the weighted-fair "
+                        "scheduler (none = the seed FIFO engine)")
     s.set_defaults(fn=_cmd_serve)
 
     fl = sub.add_parser(
@@ -762,6 +849,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "replica serves at the same width)")
     fl.add_argument("--use-flash-paged", default="auto",
                     choices=("auto", "on", "off", "interpret"))
+    fl.add_argument("--tenant", action="append", default=None,
+                    metavar="SPEC",
+                    help="tenant service class, repeatable "
+                         "(ISSUE 13): name[:key=value]... — armed on "
+                         "EVERY replica's scheduler AND the router's "
+                         "rate limiter (rps/burst keys)")
     fl.set_defaults(fn=_cmd_fleet)
 
     rt = sub.add_parser(
@@ -788,7 +881,36 @@ def build_parser() -> argparse.ArgumentParser:
     rt.add_argument("--max-replays", type=int, default=3,
                     help="replay budget per request across replica "
                          "deaths")
+    rt.add_argument("--tenant", action="append", default=None,
+                    metavar="SPEC",
+                    help="tenant service class, repeatable "
+                         "(ISSUE 13): arms the router's per-tenant "
+                         "token-bucket rate limits (rps/burst keys)")
     rt.set_defaults(fn=_cmd_route)
+
+    cl = sub.add_parser(
+        "client",
+        help="send one generation to a running serve/route/fleet "
+             "deployment (ISSUE 13: --tenant/--priority ride the "
+             "request)")
+    cl.add_argument("--address", required=True,
+                    help="gateway or router address host:port")
+    cl.add_argument("--prompt", required=True,
+                    help="comma-separated token ids, e.g. '1,4,7,2'")
+    cl.add_argument("--max-new-tokens", type=int, default=16)
+    cl.add_argument("--tenant", default=None,
+                    help="tenant to bill the request against "
+                         "(quotas, rate limits, priority class; "
+                         "default = the unlabeled 'default' class)")
+    cl.add_argument("--priority", type=int, default=None,
+                    help="per-request priority override — clamped "
+                         "to the tenant's class (you can lower your "
+                         "own batch traffic, never self-boost)")
+    cl.add_argument("--temperature", type=float, default=0.0)
+    cl.add_argument("--stream", action="store_true",
+                    help="SSE streaming instead of one blocking call")
+    cl.add_argument("--timeout", type=float, default=120.0)
+    cl.set_defaults(fn=_cmd_client)
     return p
 
 

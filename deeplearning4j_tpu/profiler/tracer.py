@@ -130,24 +130,34 @@ class Histogram:
         return self
 
     def prometheus_lines(self, name: str,
-                         help_text: Optional[str] = None) -> List[str]:
+                         help_text: Optional[str] = None,
+                         labels: Optional[str] = None,
+                         header: bool = True) -> List[str]:
         """Prometheus text-format exposition: cumulative
         ``_bucket{le=...}`` samples (monotone by construction), the
         ``+Inf`` bucket equal to ``_count``, plus ``_sum`` and
-        ``_count``."""
+        ``_count``. ``labels`` (ISSUE 13 — the per-tenant histogram
+        copies) is a brace-less label fragment (``tenant="a"``)
+        prepended to every bucket's ``le`` and wrapped around
+        ``_sum``/``_count``; ``header=False`` suppresses the
+        ``# HELP``/``# TYPE`` comments so several label sets of one
+        family can share a single header."""
         counts, total_sum, total = self.snapshot()
         lines = []
-        if help_text:
-            lines.append(f"# HELP {name} {help_text}")
-        lines.append(f"# TYPE {name} histogram")
+        if header:
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} histogram")
+        pre = f"{labels}," if labels else ""
+        suffix = f"{{{labels}}}" if labels else ""
         cum = 0
         for bound, c in zip(self.bounds, counts):
             cum += c
-            lines.append(
-                f'{name}_bucket{{le="{format(bound, ".6g")}"}} {cum}')
-        lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{name}_sum {repr(float(total_sum))}")
-        lines.append(f"{name}_count {total}")
+            lines.append(f'{name}_bucket{{{pre}le='
+                         f'"{format(bound, ".6g")}"}} {cum}')
+        lines.append(f'{name}_bucket{{{pre}le="+Inf"}} {total}')
+        lines.append(f"{name}_sum{suffix} {repr(float(total_sum))}")
+        lines.append(f"{name}_count{suffix} {total}")
         return lines
 
 
@@ -168,6 +178,62 @@ def _escape_label(value: str) -> str:
             .replace("\n", "\\n"))
 
 
+def _split_labeled_name(name: str
+                        ) -> Tuple[str, Optional[str]]:
+    """``'fam{a="x",b="y"}'`` → ``('fam', 'a="x",b="y"')``; a plain
+    name → ``(name, None)`` — the track-naming convention labeled
+    samples ride (ISSUE 12 gauges, ISSUE 13 per-tenant
+    histograms)."""
+    if "{" in name and name.endswith("}"):
+        return (name[:name.index("{")],
+                name[name.index("{") + 1:-1])
+    return name, None
+
+
+def _parse_label_pairs(labels: str) -> List[Tuple[str, str]]:
+    """``'a="x",le="0.1"'`` → ``[("a", "x"), ("le", "0.1")]``.
+    Values keep their escape sequences verbatim (re-serializing a
+    pair reproduces the input), so escaped quotes/commas inside a
+    label value cannot tear the parse."""
+    pairs: List[Tuple[str, str]] = []
+    i, n = 0, len(labels)
+    while i < n:
+        eq = labels.find("=", i)
+        if eq < 0:
+            break
+        key = labels[i:eq].strip().strip(",").strip()
+        j = labels.find('"', eq)
+        if j < 0:
+            break
+        j += 1
+        buf: List[str] = []
+        while j < n:
+            c = labels[j]
+            if c == "\\" and j + 1 < n:
+                buf.append(labels[j:j + 2])
+                j += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            j += 1
+        pairs.append((key, "".join(buf)))
+        i = j + 1
+        while i < n and labels[i] in ", ":
+            i += 1
+    return pairs
+
+
+def _canonical_labels(pairs: List[Tuple[str, str]]
+                      ) -> Optional[str]:
+    """Sorted, re-serialized label fragment (``le`` excluded by the
+    callers) — the stable key labeled histogram series merge
+    under."""
+    if not pairs:
+        return None
+    return ",".join(f'{k}="{v}"' for k, v in sorted(pairs))
+
+
 #: parsed shape of one replica's exposition text (module-level so the
 #: fleet tools and tests share it): ``types``/``help`` keyed by family
 #: name, ``histograms`` as ``{name: {"les": [str], "cums": [int],
@@ -176,15 +242,31 @@ def parse_exposition(text: str) -> Dict[str, Any]:
     """Parse Prometheus text-format exposition (the subset
     :meth:`Tracer.prometheus_text` emits: unlabeled scalar samples,
     ``# TYPE``/``# HELP`` comments, and histogram families with
-    ``le``-labeled buckets) into a merge-friendly structure."""
+    ``le``-labeled buckets) into a merge-friendly structure.
+
+    Histogram families whose buckets carry labels BESIDE ``le``
+    (ISSUE 13 — the per-tenant ``family{tenant="..."}`` copies) land
+    under the family's ``"labeled"`` sub-dict, keyed by the
+    canonical (sorted) label fragment, each with its own
+    ``les``/``cums``/``sum``/``count``. Federation satellites whose
+    label set includes ``replica`` (the marker
+    :meth:`Tracer.merge_prometheus` stamps on per-replica copies)
+    are still dropped — the unlabeled fleet family and the fleet's
+    per-label-set merges already carry those values."""
     types: Dict[str, str] = {}
     helps: Dict[str, str] = {}
     hists: Dict[str, Dict[str, Any]] = {}
     scalars: Dict[str, float] = {}
 
-    def hist_of(family: str) -> Dict[str, Any]:
-        return hists.setdefault(
-            family, {"les": [], "cums": [], "sum": 0.0, "count": 0})
+    def hist_of(family: str,
+                labels: Optional[str] = None) -> Dict[str, Any]:
+        fam = hists.setdefault(
+            family, {"les": [], "cums": [], "sum": 0.0, "count": 0,
+                     "labeled": {}})
+        if labels is None:
+            return fam
+        return fam["labeled"].setdefault(
+            labels, {"les": [], "cums": [], "sum": 0.0, "count": 0})
 
     for line in text.splitlines():
         line = line.strip()
@@ -206,32 +288,47 @@ def parse_exposition(text: str) -> Dict[str, Any]:
         name = name.strip()
         if not name:
             continue
-        if '{le="' in name and name.endswith('"}'):
-            family = name[:name.index("{")]
-            if family.endswith("_bucket"):
-                family = family[:-len("_bucket")]
-                le = name[name.index('le="') + 4:-2]
+        if "{" in name and name.endswith("}"):
+            base, labelstr = _split_labeled_name(name)
+            pairs = _parse_label_pairs(labelstr or "")
+            le = next((v for k, v in pairs if k == "le"), None)
+            rest = [(k, v) for k, v in pairs if k != "le"]
+            replica_tagged = any(k == "replica" for k, _ in rest)
+            restkey = _canonical_labels(rest)
+            fam = next((base[:-len(s)] for s in ("_bucket", "_sum",
+                                                 "_count")
+                        if base.endswith(s)), None)
+            is_hist = fam is not None and (
+                fam in hists or types.get(fam) == "histogram")
+            if (base.endswith("_bucket") and le is not None
+                    and not replica_tagged):
+                family = base[:-len("_bucket")]
                 try:
-                    h = hist_of(family)
+                    h = hist_of(family, restkey)
                     h["les"].append(le)
                     h["cums"].append(int(float(value)))
                 except ValueError:
                     pass
                 continue
-        if "{" in name:
-            # labeled non-bucket samples: keep gauge-style labeled
-            # samples (the ISSUE 12 per-shard gauges) keyed by their
-            # FULL labeled name; drop a federated histogram's
-            # per-replica `_sum{replica=..}`/`_count{..}`/`_bucket{..}`
-            # satellites (the unlabeled fleet family already carries
-            # the merged values)
-            base = name[:name.index("{")]
-            fam = next((base[:-len(s)] for s in ("_bucket", "_sum",
-                                                 "_count")
-                        if base.endswith(s)), None)
-            if fam is not None and (fam in hists
-                                    or types.get(fam) == "histogram"):
+            if is_hist:
+                # histogram satellites: per-label-set `_sum`/`_count`
+                # (ISSUE 13 tenant copies) fold into their labeled
+                # series; `replica=`-tagged federation copies drop —
+                # the unlabeled fleet family (and the fleet's
+                # per-label-set merges) already carry those values
+                if restkey is not None and not replica_tagged \
+                        and not base.endswith("_bucket"):
+                    key = "sum" if base.endswith("_sum") else "count"
+                    try:
+                        h = hist_of(fam, restkey)
+                        h[key] = (float(value) if key == "sum"
+                                  else int(float(value)))
+                    except ValueError:
+                        pass
                 continue
+            # labeled non-bucket samples: keep gauge-style labeled
+            # samples (the ISSUE 12 per-shard gauges, ISSUE 13
+            # per-tenant counters) keyed by their FULL labeled name
             try:
                 scalars[name] = float(value)
             except ValueError:
@@ -490,10 +587,18 @@ class Tracer:
 
         sanitize = _sanitize_metric_name
 
-        hist_safe: Dict[str, Tuple[str, Histogram]] = {}
+        # histogram tracks group into FAMILIES keyed by sanitized
+        # base name: a track named ``family{tenant="a"}`` (ISSUE 13 —
+        # the per-tenant latency copies) is a LABELED series of the
+        # ``family`` metric, sharing one TYPE/HELP header with the
+        # unlabeled series and any sibling label sets
+        hist_fams: Dict[str, Dict[Optional[str],
+                                  Tuple[str, Histogram]]] = {}
         for name in sorted(hists):
             if prefix is None or name.startswith(prefix):
-                hist_safe[sanitize(name)] = (name, hists[name])
+                base, labels = _split_labeled_name(name)
+                hist_fams.setdefault(sanitize(base), {})[labels] = (
+                    name, hists[name])
         # collapse tracks whose names sanitize to the same metric name
         # (sorted order ⇒ the lexically-last raw name wins): Prometheus
         # rejects an entire scrape over one duplicate sample. A track
@@ -512,7 +617,7 @@ class Tracer:
                 base = name[:name.index("{")]
                 labels = name[name.index("{"):]
             safe = sanitize(base)
-            if safe in hist_safe:  # the histogram family owns the name
+            if safe in hist_fams:  # the histogram family owns the name
                 continue
             kind = "counter" if name in cumulative else "gauge"
             merged.setdefault(safe, {})[labels] = (
@@ -529,9 +634,21 @@ class Tracer:
                 text = ("%d" % value if float(value).is_integer()
                         else repr(float(value)))
                 lines.append(f"{safe}{labels or ''} {text}")
-        for safe in sorted(hist_safe):
-            raw, hist = hist_safe[safe]
-            lines.extend(hist.prometheus_lines(safe, helps.get(raw)))
+        for safe in sorted(hist_fams):
+            series = hist_fams[safe]
+            raw0 = next(iter(series.values()))[0]
+            base0 = _split_labeled_name(raw0)[0]
+            help_text = helps.get(base0, helps.get(raw0))
+            first = True
+            # unlabeled series first, then label sets in sorted order
+            for labels in sorted(series,
+                                 key=lambda v: (v is not None,
+                                                v or "")):
+                _, hist = series[labels]
+                lines.extend(hist.prometheus_lines(
+                    safe, help_text if first else None,
+                    labels=labels, header=first))
+                first = False
         return "\n".join(lines) + ("\n" if lines else "")
 
     @staticmethod
@@ -609,42 +726,75 @@ class Tracer:
             lines.append(f"# TYPE {safe} {kind}")
             if kind == "histogram":
                 parts = hist_parts[safe]
+                # every series — the unlabeled one plus each labeled
+                # set (ISSUE 13 per-tenant copies) — must share ONE
+                # bound list before any bucket-wise addition
                 les = None
                 for rid, h in parts.items():
-                    if les is None:
-                        les = list(h["les"])
-                    elif list(h["les"]) != les:
-                        raise ValueError(
-                            f"histogram {safe!r}: replica {rid!r} "
-                            f"bounds {h['les'][:3]}..x{len(h['les'])} "
-                            f"mismatch the fleet's "
-                            f"{les[:3]}..x{len(les)} — refusing a "
-                            "bucket-wise merge across mismatched "
-                            "bounds")
-                fleet_cums = [0] * len(les or ())
-                fleet_sum, fleet_count = 0.0, 0
-                for h in parts.values():
-                    for i, c in enumerate(h["cums"]):
-                        fleet_cums[i] += c
-                    fleet_sum += h["sum"]
-                    fleet_count += h["count"]
-                for le, cum in zip(les or (), fleet_cums):
-                    lines.append(
-                        f'{safe}_bucket{{le="{le}"}} {cum}')
-                lines.append(f"{safe}_sum {repr(float(fleet_sum))}")
-                lines.append(f"{safe}_count {fleet_count}")
-                for rid, h in parts.items():
-                    lab = _escape_label(rid)
-                    for le, cum in zip(h["les"], h["cums"]):
+                    for series in ([h]
+                                   + list(h.get("labeled",
+                                                {}).values())):
+                        if not series["les"]:
+                            continue
+                        if les is None:
+                            les = list(series["les"])
+                        elif list(series["les"]) != les:
+                            raise ValueError(
+                                f"histogram {safe!r}: replica "
+                                f"{rid!r} bounds "
+                                f"{series['les'][:3]}.."
+                                f"x{len(series['les'])} mismatch "
+                                f"the fleet's {les[:3]}..x{len(les)}"
+                                " — refusing a bucket-wise merge "
+                                "across mismatched bounds")
+
+                def emit_series(cums, total_sum, total, labels):
+                    pre = f"{labels}," if labels else ""
+                    suffix = f"{{{labels}}}" if labels else ""
+                    for le, cum in zip(les or (), cums):
                         lines.append(
-                            f'{safe}_bucket{{replica="{lab}",'
-                            f'le="{le}"}} {cum}')
+                            f'{safe}_bucket{{{pre}le="{le}"}} {cum}')
                     lines.append(
-                        f'{safe}_sum{{replica="{lab}"}} '
-                        f'{repr(float(h["sum"]))}')
-                    lines.append(
-                        f'{safe}_count{{replica="{lab}"}} '
-                        f'{h["count"]}')
+                        f"{safe}_sum{suffix} "
+                        f"{repr(float(total_sum))}")
+                    lines.append(f"{safe}_count{suffix} {total}")
+
+                def folded(series_list):
+                    cums = [0] * len(les or ())
+                    s, n = 0.0, 0
+                    for series in series_list:
+                        for i, c in enumerate(series["cums"]):
+                            cums[i] += c
+                        s += series["sum"]
+                        n += series["count"]
+                    return cums, s, n
+
+                # fleet-wide: the unlabeled merge, then one merged
+                # series PER label set (so "premium's fleet p99" is
+                # one histogram_quantile away, same as the fleet's)
+                if any(h["les"] for h in parts.values()):
+                    emit_series(*folded([h for h in parts.values()
+                                         if h["les"]]), labels=None)
+                labelsets = sorted({
+                    ls for h in parts.values()
+                    for ls in h.get("labeled", {})})
+                for ls in labelsets:
+                    emit_series(*folded(
+                        [h["labeled"][ls] for h in parts.values()
+                         if ls in h.get("labeled", {})]), labels=ls)
+                # per-replica copies: ``{replica=...}`` for the
+                # unlabeled series, ``{replica=...,<labels>}`` for
+                # each labeled set
+                for rid, h in parts.items():
+                    lab = f'replica="{_escape_label(rid)}"'
+                    if h["les"]:
+                        emit_series(h["cums"], h["sum"], h["count"],
+                                    labels=lab)
+                    for ls in sorted(h.get("labeled", {})):
+                        series = h["labeled"][ls]
+                        emit_series(series["cums"], series["sum"],
+                                    series["count"],
+                                    labels=f"{lab},{ls}")
             elif kind == "counter":
                 # sum per label set: an unlabeled counter sums to one
                 # fleet total; labeled counters sum within each label

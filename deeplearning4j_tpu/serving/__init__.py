@@ -71,6 +71,14 @@ from deeplearning4j_tpu.serving.scheduler import (
     Scheduler,
 )
 from deeplearning4j_tpu.serving.spec import NgramDraftTable
+from deeplearning4j_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    SYSTEM_TENANT,
+    TenantRegistry,
+    TenantSpec,
+    TokenBucket,
+    WeightedFairScheduler,
+)
 from deeplearning4j_tpu.serving.tp import TPContext
 
 __all__ = [
@@ -98,6 +106,12 @@ __all__ = [
     "RouterClient",
     "STATUS_OF_REASON",
     "Scheduler",
+    "DEFAULT_TENANT",
+    "SYSTEM_TENANT",
+    "TenantRegistry",
+    "TenantSpec",
+    "TokenBucket",
+    "WeightedFairScheduler",
     "TPContext",
     "ServingGateway",
     "ServingRouter",

@@ -271,7 +271,12 @@ class GatewayClient:
         kwarg (the fleet trace context, ISSUE 10) rides BOTH carriers:
         the ``X-DL4J-Trace`` header (the Dapper-style wire position a
         sidecar proxy can read without parsing bodies) and the JSON
-        ``trace`` field (which survives body-level relays)."""
+        ``trace`` field (which survives body-level relays).
+        ``tenant=`` / ``priority=`` (ISSUE 13) ride the body to a
+        tenancy-enabled gateway/router: the tenant's quotas, rate
+        limits, and priority class then govern the request — a 429
+        carries that tenant's OWN ``Retry-After`` and names the
+        tenant in the payload."""
         body = dict(prompt=list(prompt),
                     max_new_tokens=int(max_new_tokens), **kwargs)
         headers = {"Content-Type": "application/json"}

@@ -124,6 +124,7 @@ class FleetController:
                  pressure_low: float = 0.25,
                  breach_evals: int = 2, idle_evals: int = 6,
                  cooldown_s: float = 3.0,
+                 slo_tenant: Optional[str] = None,
                  warm_on_scale: bool = True,
                  warm_prompts_cap: int = 8,
                  drain_timeout_s: float = 2.0,
@@ -152,6 +153,14 @@ class FleetController:
         self.breach_evals = max(int(breach_evals), 1)
         self.idle_evals = max(int(idle_evals), 1)
         self.cooldown_s = float(cooldown_s)
+        #: tenancy-aware SLO accounting (ISSUE 13): when set, the
+        #: windowed TTFT p99 is read from the fleet's
+        #: ``serving_ttft_s{tenant="<slo_tenant>"}`` labeled family
+        #: instead of the all-traffic one — a rate-throttled
+        #: flooder's self-inflicted queueing (its OWN requests
+        #: waiting out quota) can no longer page the autoscaler;
+        #: the fleet scales for the tenant the SLO was promised to
+        self.slo_tenant = slo_tenant
         self.warm_on_scale = bool(warm_on_scale)
         self.warm_prompts_cap = int(warm_prompts_cap)
         self.drain_timeout_s = drain_timeout_s
@@ -272,6 +281,12 @@ class FleetController:
             return None, 0
         h = parse_exposition(text)["histograms"].get(
             "serving_ttft_s")
+        if h and self.slo_tenant:
+            # the SLO belongs to ONE tenant: difference that
+            # tenant's labeled fleet family (merged per label set by
+            # merge_prometheus), not the all-traffic one
+            h = h.get("labeled", {}).get(
+                f'tenant="{self.slo_tenant}"')
         if not h or not h["les"]:
             return None, 0
         les, cums = list(h["les"]), list(h["cums"])

@@ -156,6 +156,10 @@ from deeplearning4j_tpu.serving.scheduler import (
     Scheduler,
 )
 from deeplearning4j_tpu.serving.spec import NgramDraftTable
+from deeplearning4j_tpu.serving.tenancy import (
+    TenantRegistry,
+    WeightedFairScheduler,
+)
 from deeplearning4j_tpu.serving.tp import TPContext
 
 #: restore() kwarg sentinel — ``None`` is a meaningful toggle value
@@ -348,6 +352,11 @@ SERVING_TRACK_HELP = {
                            "under tp > 1, as serving_blocks_free)",
     "serving_frag_tokens": "allocated-but-masked pool tokens "
                            "(per-shard copies under tp > 1)",
+    "serving_qos_preempted": "slots recompute-preempted by the "
+                             "weighted-fair scheduler (over-quota "
+                             "tenant evicted for a waiting "
+                             "same-or-higher-priority arrival; "
+                             "tenancy-enabled engines only)",
 }
 
 
@@ -363,6 +372,11 @@ def _request_dict(req: Request) -> Dict[str, Any]:
         "deadline_s": req.deadline_s,
         "queue_timeout_s": req.queue_timeout_s,
         "trace": req.trace,
+        # tenancy identity (ISSUE 13): restore must bill the same
+        # tenant the drained process did, or the snapshot would
+        # launder a flooder's work onto the default quota
+        "tenant": req.tenant,
+        "priority": req.priority,
     }
 
 
@@ -487,10 +501,24 @@ class DecodeEngine:
       deadline/stall tests deterministic); defaults to
       ``time.perf_counter``.
 
+    ``tenants=TenantRegistry(...)`` (ISSUE 13; default None = the
+    seed FIFO scheduler, zero per-tenant bookkeeping) swaps in the
+    weighted-fair :class:`~deeplearning4j_tpu.serving.tenancy.
+    WeightedFairScheduler`: admission ordered priority-then-
+    most-underserved with per-tenant token accounting (prompt +
+    decode tokens, deficit carry-over), per-tenant slot/queue
+    quotas, and recompute-preemption of over-quota or lower-class
+    slots when a higher-priority arrival would otherwise wait
+    (``_qos_round``; greedy victims requeue and regenerate
+    bit-identical ids). Per-request latency histograms and the
+    shed/preempted counters gain ``{tenant=...}`` labeled twins, and
+    ``GenerationResult.tenant`` echoes the billed tenant.
+
     ``snapshot()``/``DecodeEngine.restore()`` round-trip the full
     host-side state through a plain dict and rebuild device KV state
     by re-prefilling recorded tokens — crash recovery that finishes
-    the same ids.
+    the same ids. The tenant registry rides the snapshot, so a
+    drained engine restores its quotas.
 
     An optional ``profiler.tracer.Tracer`` receives prefill/admit/
     decode/prefix-fetch spans plus per-round counters (admitted,
@@ -569,7 +597,8 @@ class DecodeEngine:
                  record_timing: bool = True,
                  flight_recorder: int = 256,
                  tp: int = 1,
-                 use_flash_paged=None):
+                 use_flash_paged=None,
+                 tenants: Optional[TenantRegistry] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
@@ -660,15 +689,30 @@ class DecodeEngine:
                 "can only be rewound while nothing slid out of the "
                 "window")
         self.prefill_chunk = int(prefill_chunk)
-        self.scheduler = Scheduler(self.window,
-                                   min_bucket=min_prompt_bucket,
-                                   prefill_chunk=self.prefill_chunk,
-                                   prefill_budget=prefill_budget,
-                                   policy=admission_policy,
-                                   max_queue=max_queue,
-                                   pressure_high=pressure_high,
-                                   pressure_low=pressure_low,
-                                   spec_draft_len=self.spec_draft_len)
+        # -- multi-tenant QoS (ISSUE 13; default off = the seed FIFO
+        # scheduler, zero per-tenant bookkeeping — tenancy must be
+        # free when unused, gated by bench_tenant_qos_overhead) ------
+        self.tenants = tenants
+        sched_kwargs = dict(min_bucket=min_prompt_bucket,
+                            prefill_chunk=self.prefill_chunk,
+                            prefill_budget=prefill_budget,
+                            policy=admission_policy,
+                            max_queue=max_queue,
+                            pressure_high=pressure_high,
+                            pressure_low=pressure_low,
+                            spec_draft_len=self.spec_draft_len)
+        if tenants is not None:
+            self.scheduler = WeightedFairScheduler(
+                self.window, tenants=tenants, **sched_kwargs)
+        else:
+            self.scheduler = Scheduler(self.window, **sched_kwargs)
+        #: per-tenant latency histograms (``family{tenant="..."}``
+        #: tracks, lazily created per tenant seen) and cumulative
+        #: per-tenant stats mirrored as labeled tracer samples —
+        #: riding the PR 12 labeled-sample scheme so a fleet scrape
+        #: shows ``{replica=...,tenant=...}``
+        self._tenant_hists: Dict[str, Any] = {}
+        self.tenant_stats: Dict[str, Dict[str, int]] = {}
         # -- paged KV block pool (ISSUE 6; default off = the
         # bit-identical dense engine) ---------------------------------
         self.paged_kv = bool(paged_kv)
@@ -822,7 +866,7 @@ class DecodeEngine:
             "blocks_free": self.kv_blocks, "blocks_used": 0,
             "cow_copies": 0, "prefix_blocks_spliced": 0,
             "frag_tokens": 0, "preempted": 0,
-            "paged_admit_deferred": 0,
+            "paged_admit_deferred": 0, "qos_preempted": 0,
         }
         for key in self.FAILURE_KEYS:
             self.stats[key] = 0
@@ -1088,13 +1132,23 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ids {bad[:4]} outside vocab [0, {self.vocab})")
         self.scheduler.validate(request)
+        if (self.tenants is not None
+                and self.scheduler.tenant_full(request.tenant)):
+            # per-tenant queue bound (ISSUE 13): the tenant's OWN
+            # backlog is full — always reject-new, whatever the
+            # global shed policy: shedding ANOTHER tenant's oldest
+            # to admit a flooder would invert the QoS contract
+            rid = self.scheduler.assign_id(request)
+            self._mint_clock(rid)
+            self._shed(request)
+            return rid
         if self.scheduler.full:
             if self.shed_policy == "reject-new":
                 rid = self.scheduler.assign_id(request)
                 self._mint_clock(rid)
                 self._shed(request)
                 return rid
-            self._shed(self.scheduler.pop())
+            self._shed(self.scheduler.shed_victim())
         rid = self.scheduler.submit(request)
         self._submit_t[rid] = self._clock()
         self._mint_clock(rid, self._submit_t[rid])
@@ -1166,6 +1220,8 @@ class DecodeEngine:
         if hasattr(self.tracer, "register_histogram"):
             for name, hist in self.histograms.items():
                 self.tracer.register_histogram(name, hist)
+            for name, hist in self._tenant_hists.items():
+                self.tracer.register_histogram(name, hist)
         if hasattr(self.tracer, "describe"):
             for name, help_text in SERVING_TRACK_HELP.items():
                 self.tracer.describe(name, help_text)
@@ -1184,6 +1240,38 @@ class DecodeEngine:
         if hist is not None and value is not None:
             hist.observe(value, n)
 
+    def _observe_tenant(self, family: str, tenant: str, value,
+                        n: int = 1) -> None:
+        """Per-tenant labeled twin of :meth:`_observe` (ISSUE 13):
+        records into the ``family{tenant="..."}`` histogram track,
+        created and tracer-registered on the tenant's first sample.
+        No-op (zero cost) on engines without a TenantRegistry."""
+        if (self.tenants is None or not self.record_timing
+                or value is None):
+            return
+        name = f'{family}{{tenant="{tenant}"}}'
+        hist = self._tenant_hists.get(name)
+        if hist is None:
+            from deeplearning4j_tpu.profiler.tracer import Histogram
+
+            hist = self._tenant_hists[name] = Histogram()
+            if (self.tracer is not None
+                    and hasattr(self.tracer, "register_histogram")):
+                self.tracer.register_histogram(name, hist)
+        hist.observe(value, n)
+
+    def _tenant_count(self, tenant: str, key: str,
+                      n: int = 1) -> None:
+        """Bump a per-tenant cumulative stat (mirrored as
+        ``serving_<key>{tenant=...}`` labeled samples by
+        ``_emit_counters``). No-op without tenancy."""
+        if self.tenants is None:
+            return
+        stats = self.tenant_stats.setdefault(
+            tenant, {"tokens_generated": 0, "admitted": 0,
+                     "shed": 0, "preempted": 0})
+        stats[key] = stats.get(key, 0) + n
+
     def request_trace(self, rid: int) -> Optional[Dict[str, Any]]:
         """Flight-recorder record for one TERMINAL request: the timing
         breakdown plus the ordered per-attempt phase timeline. None
@@ -1195,10 +1283,17 @@ class DecodeEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _failure_event(self, kind: str) -> None:
+    def _failure_event(self, kind: str,
+                       tenant: Optional[str] = None) -> None:
         self.stats[kind] += 1
         if self.tracer is not None:
             self.tracer.incr(f"serving_{kind}")
+            if tenant is not None and self.tenants is not None:
+                # labeled twin (ISSUE 13): same family, same counter
+                # type — merge_prometheus sums it per label set, so
+                # the fleet scrape answers "who got shed"
+                self.tracer.incr(
+                    f'serving_{kind}{{tenant="{tenant}"}}')
 
     def _note_progress(self, state: _Slot) -> None:
         """Surface a slot's newly committed tokens as a delta (see
@@ -1256,11 +1351,19 @@ class DecodeEngine:
             clock.event(now, "terminal", reason=reason)
             timing = clock.summary(now, len(tokens))
             self._observe("serving_e2e_s", timing["e2e_s"])
+            self._observe_tenant("serving_e2e_s", request.tenant,
+                                 timing["e2e_s"])
+            # tenancy-enabled engines stamp the tenant onto the
+            # flight record and the request_done instant so the
+            # saved-trace half of latency_report --tenant can group
+            # by it; tenant-blind engines stay byte-identical
+            tenancy = ({"tenant": request.tenant}
+                       if self.tenants is not None else {})
             if self.flight_recorder:
                 self._flight[request.id] = {
                     "id": request.id, "finish_reason": reason,
                     "timing": timing, "attempts": clock.attempts,
-                    **_targs(request),
+                    **tenancy, **_targs(request),
                 }
                 while len(self._flight) > self.flight_recorder:
                     self._flight.popitem(last=False)
@@ -1269,14 +1372,17 @@ class DecodeEngine:
                 # these instants back out of a saved Chrome trace
                 self.tracer.instant("serving.request_done",
                                     rid=request.id, reason=reason,
-                                    timing=timing, **_targs(request))
+                                    timing=timing, **tenancy,
+                                    **_targs(request))
         self._terminal[request.id] = GenerationResult(
             id=request.id, tokens=list(tokens), finish_reason=reason,
             prompt_len=len(request.prompt),
             prefix_tokens_reused=prefix_reused, ttft_s=ttft,
             retries=self._retries.pop(request.id, 0),
             spec_drafted=spec_drafted, spec_accepted=spec_accepted,
-            timing=timing, trace=request.trace)
+            timing=timing, trace=request.trace,
+            tenant=(request.tenant if self.tenants is not None
+                    else None))
         self.stats["requests_finished"] += 1
         self._submit_t.pop(request.id, None)
         self._started.discard(request.id)
@@ -1285,7 +1391,8 @@ class DecodeEngine:
 
     def _shed(self, request: Request) -> None:
         self._record_terminal(request, [], "shed")
-        self._failure_event("shed")
+        self._failure_event("shed", tenant=request.tenant)
+        self._tenant_count(request.tenant, "shed")
 
     def _abort_pending(self, pending: _Pending) -> None:
         """Drop an in-flight admission (cancel/deadline): release the
@@ -1377,14 +1484,26 @@ class DecodeEngine:
         self.stats["preempted"] += 1
         if self.tracer is not None:
             self.tracer.incr("serving_preempted")
+            if self.tenants is not None:
+                self.tracer.incr(
+                    f'serving_preempted{{tenant='
+                    f'"{state.request.tenant}"}}')
+        self._tenant_count(state.request.tenant, "preempted")
         self._slots[slot] = None
         self._temps[slot] = 0.0
         self._top_ks[slot] = self.vocab
         if self.spec is not None:
             self.spec.drop(slot)
-        tab = self._kv_tabs[slot]
-        self._kv_tabs[slot] = None
-        self._free_table(tab)
+        if self.paged_kv:
+            tab = self._kv_tabs[slot]
+            self._kv_tabs[slot] = None
+            self._free_table(tab)
+        elif self._pool is not None:
+            # dense-layout preemption (ISSUE 13 extends the PR 6
+            # paged path to both layouts): zero the slot's rows so
+            # the freed slot's stale K/V can never be observed —
+            # the same per-slot reset eviction uses
+            self._pool = clear_state_rows(self._pool, [slot])
         if ((self.on_delta is not None or self.emit_deltas)
                 and state.request.temperature > 0
                 and self._delta_sent.get(state.request.id, 0) > 0):
@@ -1538,6 +1657,9 @@ class DecodeEngine:
             now = self._clock()
             self._observe("serving_queue_wait_s",
                           now - clock.enqueue_t)
+            self._observe_tenant("serving_queue_wait_s",
+                                 request.tenant,
+                                 now - clock.enqueue_t)
             clock.add(now, "queue_wait", now - clock.enqueue_t,
                       slot=slot)
         rnn, matched, hit, tab = None, 0, None, None
@@ -1796,10 +1918,14 @@ class DecodeEngine:
                         prefix_reused=pending.matched)
             clock.last_commit_t = now  # ITL starts after this token
             self._observe("serving_ttft_s", ttft)
+            self._observe_tenant("serving_ttft_s", request.tenant,
+                                 ttft)
         state = _Slot(request, [first], prefix_reused=pending.matched,
                       ttft_s=ttft, hit_row=hit_row)
         self.stats["tokens_generated"] += 1
         self.stats["admitted"] += 1
+        self._tenant_count(request.tenant, "admitted")
+        self._tenant_count(request.tenant, "tokens_generated")
         if self._finished(state):
             # PR 3 blind spot (ISSUE 4 satellite): a request finishing
             # AT admission never reaches the post-decode health sweep,
@@ -2213,6 +2339,44 @@ class DecodeEngine:
                                 self.scheduler.draft_len)
         return emitted, acc + 1
 
+    # -- multi-tenant QoS round hook (ISSUE 13) ------------------------
+    def _qos_round(self) -> None:
+        """Once per scheduling round, before admission: feed the
+        weighted-fair scheduler the per-tenant slot occupancy
+        (deficit refill + quota accounting), then recompute-preempt
+        the over-quota slots it names — through the PR 6 preemption
+        path, so a high-priority arrival admits THIS round instead
+        of waiting out a flooder's decode rounds. Greedy victims
+        requeue and regenerate bit-identical ids; a sampling victim
+        that already streamed terminates ``fault`` (the preemption
+        contract, unchanged)."""
+        running: Dict[str, int] = {}
+        view: List[Tuple[int, str, int]] = []
+        for slot, state in enumerate(self._slots):
+            if state is None:
+                continue
+            tenant = state.request.tenant
+            running[tenant] = running.get(tenant, 0) + 1
+            view.append((slot, tenant,
+                         self.tenants.effective_priority(
+                             state.request)))
+        for pending in self._pending:
+            tenant = pending.request.tenant
+            running[tenant] = running.get(tenant, 0) + 1
+        self.scheduler.begin_round(running)
+        if not self.scheduler.pending or not view:
+            return
+        free = sum(1 for slot in range(self.n_slots)
+                   if self._slots[slot] is None
+                   and slot not in self._reserved)
+        for slot in self.scheduler.plan_preemptions(view, free):
+            if self._slots[slot] is not None:
+                self.stats["qos_preempted"] = (
+                    self.stats.get("qos_preempted", 0) + 1)
+                if self.tracer is not None:
+                    self.tracer.incr("serving_qos_preempted")
+                self._preempt_slot(slot)
+
     # -- the serving loop ----------------------------------------------
     def has_work(self) -> bool:
         """True while anything is queued, admitting, decoding, or
@@ -2255,6 +2419,8 @@ class DecodeEngine:
         self._drain_requeue()
         self._inject_faults()
         self._sweep_deadlines()
+        if self.tenants is not None:
+            self._qos_round()
         for slot in range(self.n_slots):
             if (self._slots[slot] is None
                     and slot not in self._reserved
@@ -2271,7 +2437,14 @@ class DecodeEngine:
                     self._failure_event("faults_detected")
                     self._requeue_victim(victim)
                     continue
-                self._start_admission(self.scheduler.pop(), slot)
+                # the scheduler chooses WHOM to admit (FIFO without
+                # tenancy; priority-then-deficit with it); None =
+                # every queued tenant is over its slot quota, so the
+                # round admits nobody rather than admitting unfairly
+                nxt = self.scheduler.pop_admissible()
+                if nxt is None:
+                    break
+                self._start_admission(nxt, slot)
         if self._pending:
             if self.adaptive_prefill:
                 budget = self.scheduler.adapt_budget()
@@ -2434,6 +2607,7 @@ class DecodeEngine:
             if self.paranoid:
                 active = self._quarantine(active)
             emitted = 0
+            round_usage: Dict[str, int] = {}
             for slot in active:
                 state = self._slots[slot]
                 appended = []
@@ -2443,6 +2617,12 @@ class DecodeEngine:
                     emitted += 1
                     if self._finished(state):
                         break
+                if self.tenants is not None and appended:
+                    tenant = state.request.tenant
+                    round_usage[tenant] = (
+                        round_usage.get(tenant, 0) + len(appended))
+                    self._tenant_count(tenant, "tokens_generated",
+                                       len(appended))
                 # deltas flow AFTER the paranoid sweep filtered
                 # ``active`` (a quarantined slot's round never streams)
                 # and cover the admission's first token too — the
@@ -2457,10 +2637,14 @@ class DecodeEngine:
                             clock.add(now_c, "verify", ver_dt)
                         clock.add(now_c, "decode", dec_dt)
                         if clock.last_commit_t is not None:
-                            self._observe(
+                            gap = ((now_c - clock.last_commit_t)
+                                   / len(appended))
+                            self._observe("serving_itl_s", gap,
+                                          n=len(appended))
+                            self._observe_tenant(
                                 "serving_itl_s",
-                                (now_c - clock.last_commit_t)
-                                / len(appended), n=len(appended))
+                                state.request.tenant, gap,
+                                n=len(appended))
                         clock.last_commit_t = now_c
                         clock.rounds += 1
                         clock.event(now_c, "commit", n=len(appended))
@@ -2473,6 +2657,10 @@ class DecodeEngine:
             self.stats["tokens_generated"] += emitted
             self.stats["decode_time_s"] += dt
             self.stats["chunks"] += 1
+            if self.tenants is not None and round_usage:
+                # committed decode tokens charge each tenant's
+                # deficit: the fair share is tokens, not admissions
+                self.scheduler.note_usage(round_usage)
             if self.record_timing:
                 self._observe("serving_round_s", self._clock() - rt0)
             occ = len(active) / self.n_slots
@@ -2528,6 +2716,24 @@ class DecodeEngine:
                 self.tracer.counter(f"serving_prefix_{key}",
                                     self.prefix_cache.stats[key])
         self._emit_tp_gauges()
+        self._emit_tenant_gauges()
+
+    def _emit_tenant_gauges(self) -> None:
+        """Per-tenant labeled copies of the per-round serving
+        counters (ISSUE 13): ``serving_tokens_generated{tenant=...}``
+        / ``serving_admitted{...}`` ride the same family names as
+        their unlabeled twins, via ``Tracer.gauge`` (last-value
+        table only — no event-log growth per round). The sparse
+        failure counters (shed/preempted) get labeled ``incr`` twins
+        at event time instead."""
+        if self.tenants is None or self.tracer is None:
+            return
+        gauge = getattr(self.tracer, "gauge", self.tracer.counter)
+        for tenant, stats in self.tenant_stats.items():
+            for key, value in stats.items():
+                if key in ("shed", "preempted"):
+                    continue  # incr'd (counter-typed) at event time
+                gauge(f'serving_{key}{{tenant="{tenant}"}}', value)
 
     def _emit_tp_gauges(self) -> None:
         """Per-shard observability (ISSUE 12 satellite): under tp > 1
@@ -2768,6 +2974,11 @@ class DecodeEngine:
                     for b in range(self.kv_blocks)
                     if self.block_pool.refcount(b) > 0},
             } if self.paged_kv else None),
+            # tenant registry (ISSUE 13): quotas/priorities survive a
+            # drain/restore without the booting host re-plumbing them
+            # (restore(tenants=) still overrides)
+            "tenants": (self.tenants.to_dict()
+                        if self.tenants is not None else None),
             # draft TABLES are derived state (rebuilt from recorded
             # ids); only the adaptation point needs the wire format
             "spec": ({"draft_len": self.scheduler.draft_len,
@@ -2797,7 +3008,9 @@ class DecodeEngine:
     def restore(cls, net, snapshot: Dict[str, Any], tracer=None,
                 fault_plan: Optional[FaultPlan] = None, clock=None,
                 seed: int = 0, tp: Optional[int] = None,
-                use_flash_paged=_UNSET) -> "DecodeEngine":
+                use_flash_paged=_UNSET,
+                tenants: Optional[TenantRegistry] = None
+                ) -> "DecodeEngine":
         """Rebuild an engine from ``snapshot()`` output in a fresh
         process: same config, prefix cache re-primed (deterministic
         prefill reproduces each stored row), every in-flight slot's KV
@@ -2821,6 +3034,11 @@ class DecodeEngine:
             tp = int(cfg.get("tp", 1))
         if use_flash_paged is _UNSET:
             use_flash_paged = cfg.get("use_flash_paged")
+        if tenants is None and snapshot.get("tenants"):
+            # the drained engine's quotas/priorities ride the wire
+            # format — the restoring host keeps them unless it
+            # explicitly passes a registry of its own
+            tenants = TenantRegistry.from_dict(snapshot["tenants"])
         eng = cls(
             net, n_slots=cfg["n_slots"],
             decode_chunk=cfg["decode_chunk"],
@@ -2842,7 +3060,8 @@ class DecodeEngine:
             kv_blocks=cfg.get("kv_blocks") or None,
             record_timing=cfg.get("record_timing", True),
             flight_recorder=cfg.get("flight_recorder", 256),
-            tp=tp, use_flash_paged=use_flash_paged)
+            tp=tp, use_flash_paged=use_flash_paged,
+            tenants=tenants)
         spec_state = snapshot.get("spec")
         if spec_state and eng.spec is not None:
             # resume K-adaptation where the crash left it (final ids

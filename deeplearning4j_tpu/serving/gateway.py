@@ -121,6 +121,8 @@ def _result_dict(res: GenerationResult) -> Dict[str, Any]:
     }
     if res.trace is not None:  # fleet trace context echo (ISSUE 10)
         out["trace"] = res.trace
+    if res.tenant is not None:  # tenancy echo (ISSUE 13): the router
+        out["tenant"] = res.tenant  # parks per-tenant keyspace by it
     return out
 
 
@@ -532,27 +534,54 @@ class ServingGateway:
                 queue_timeout_s=(
                     None if body.get("queue_timeout_s") is None
                     else float(body["queue_timeout_s"])),
-                trace=trace)
+                trace=trace,
+                tenant=str(body.get("tenant") or "default"),
+                priority=(None if body.get("priority") is None
+                          else int(body["priority"])))
         except (TypeError, ValueError) as e:
             return None, None, (400, {"error": str(e)}, ())
+        if req.tenant == "system":
+            # the reserved infrastructure tenant is quota-, rate-,
+            # and priority-exempt BY DESIGN (warmup handshakes) — an
+            # external caller claiming it would bypass the whole QoS
+            # layer with one JSON field. Only in-process callers
+            # (warmup(), ISSUE 11 boot) may bill it.
+            return None, None, (
+                400, {"error": "tenant 'system' is reserved for "
+                               "infrastructure traffic"}, ())
         with self._engine_access():
             if self._draining or self._stopped:
                 self._bump("rejected_503")
                 return None, None, (503, {"error": "draining"}, ())
             sched = self.engine.scheduler
-            if sched.full and self.engine.shed_policy == "reject-new":
+            tenancy = self.engine.tenants is not None
+            tenant_full = tenancy and sched.tenant_full(req.tenant)
+            if tenant_full or (sched.full
+                               and self.engine.shed_policy
+                               == "reject-new"):
                 # answer the shed synchronously, BEFORE the engine
                 # would mint a terminal for it: the client gets 429 +
-                # Retry-After and the engine never hears about it
-                retry = sched.retry_after_s(self.engine.n_slots,
-                                            self._round_s)
+                # Retry-After — per-TENANT when tenancy is on (the
+                # tenant's own queue share prices the hint, and the
+                # payload names the tenant so a router parks only
+                # that tenant's keyspace, ISSUE 13)
+                retry = sched.tenant_retry_after_s(
+                    req.tenant, self.engine.n_slots, self._round_s)
                 self._bump("rejected_429")
+                payload = {"error": ("tenant queue full"
+                                     if tenant_full
+                                     else "queue full"),
+                           "retry_after_s": retry}
+                if tenancy:
+                    payload["tenant"] = req.tenant
                 if self.engine.tracer is not None:
                     self.engine.tracer.incr("serving_gateway_429")
+                    if tenancy:
+                        self.engine.tracer.incr(
+                            f'serving_gateway_429{{tenant='
+                            f'"{req.tenant}"}}')
                 return None, None, (
-                    429, {"error": "queue full",
-                          "retry_after_s": retry},
-                    (("Retry-After", retry),))
+                    429, payload, (("Retry-After", retry),))
             try:
                 rid = self.engine.submit(req)
             except ValueError as e:
@@ -629,10 +658,13 @@ class ServingGateway:
             headers = ()
             if res.finish_reason == "shed":
                 # shed-oldest victims and queue timeouts learn when to
-                # come back, same as the synchronous reject-new 429
+                # come back, same as the synchronous reject-new 429 —
+                # priced per tenant when the result names one
                 with self._engine_access():
                     headers = (("Retry-After",
-                                self.engine.scheduler.retry_after_s(
+                                self.engine.scheduler
+                                .tenant_retry_after_s(
+                                    res.tenant or "default",
                                     self.engine.n_slots,
                                     self._round_s)),)
             handler.send_json(_result_dict(res),
@@ -888,8 +920,14 @@ class ServingGateway:
                 raise ValueError(
                     f"warmup prompt ids {bad[:4]} outside vocab "
                     f"[0, {self.engine.vocab})")
+            # warmup is INFRASTRUCTURE traffic (ISSUE 13): it bills
+            # the reserved system tenant — top priority, quota- and
+            # rate-exempt — never a user quota, so a boot handshake
+            # can neither starve behind a flooder's backlog nor eat
+            # a user's slot entitlement
             req = Request(prompt=toks,
-                          max_new_tokens=int(max_new_tokens))
+                          max_new_tokens=int(max_new_tokens),
+                          tenant="system")
             self.engine.scheduler.validate(req)
             reqs.append(req)
         lives: List = []

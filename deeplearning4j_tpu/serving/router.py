@@ -101,6 +101,7 @@ from __future__ import annotations
 import contextlib
 import hashlib
 import itertools
+import math
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
@@ -171,6 +172,13 @@ class _Replica:
         self.state = "live"  # optimistic until the breaker disagrees
         self.failures = 0
         self.backoff_until = 0.0  # 429 Retry-After parking
+        #: per-TENANT 429 parking (ISSUE 13): a replica's
+        #: tenant-scoped 429 (its payload names the tenant) parks
+        #: only that tenant's keyspace on this replica — other
+        #: tenants keep routing here. ``backoff_until`` above stays
+        #: the replica-wide park for tenant-blind (global queue
+        #: full) backpressure.
+        self.tenant_backoff: Dict[str, float] = {}
         self.next_probe_t = 0.0   # half-open probe schedule (dead)
         self.decommissioned = False  # drained away: never resurrected
         # scraped load + affinity figures
@@ -244,7 +252,8 @@ class _JournalEntry:
                  "replays", "cancelled", "done", "result",
                  "replica_address", "replica_rid", "affinity",
                  "history", "submit_t", "trace", "done_t",
-                 "replay_t0_us", "replay_hwm", "replay_from")
+                 "replay_t0_us", "replay_hwm", "replay_from",
+                 "tenant")
 
     def __init__(self, rid: int, prompt: List[int],
                  params: Dict[str, Any], submit_t: float):
@@ -252,6 +261,10 @@ class _JournalEntry:
         self.prompt = prompt
         self.params = params
         self.temperature = float(params.get("temperature") or 0.0)
+        #: tenancy identity (ISSUE 13) — rides ``params`` to the
+        #: replica (so failover replay re-bills the same tenant) and
+        #: keys the router's per-tenant parking/accounting
+        self.tenant = str(params.get("tenant") or "default")
         self.tokens: List[int] = []
         self.replays = 0
         self.cancelled = False
@@ -432,7 +445,8 @@ class ServingRouter:
                  replica_timeout_s: float = 120.0,
                  journal_cap: int = 4096,
                  fleet_trace: bool = True,
-                 tracer=None):
+                 tracer=None,
+                 tenants=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         if affinity_block_tokens < 1:
@@ -458,6 +472,16 @@ class ServingRouter:
             replica_connect_timeout_s)
         self.replica_timeout_s = float(replica_timeout_s)
         self.journal_cap = int(journal_cap)
+        #: multi-tenant QoS front door (ISSUE 13; default None = the
+        #: tenant-blind router): a
+        #: :class:`~deeplearning4j_tpu.serving.tenancy.TenantRegistry`
+        #: whose ``rate_rps``/``burst`` specs arm per-tenant token
+        #: buckets — a flooder sheds AT THE DOOR with its own
+        #: Retry-After (time to the next bucket token) before any
+        #: replica sees it, and the ``system`` tenant is never
+        #: throttled (warmup must always land)
+        self.tenants = tenants
+        self._buckets: Dict[str, Any] = {}
         #: fleet observability master switch (ISSUE 10; default ON —
         #: priced by bench_fleet_trace_overhead): trace-context
         #: propagation to replicas, router route/replay spans, the
@@ -497,6 +521,7 @@ class ServingRouter:
             "load_routed": 0, "replays": 0, "rerouted_429": 0,
             "replica_faults": 0, "request_faults": 0,
             "disconnect_cancels": 0, "drained_replicas": 0,
+            "tenant_throttled": 0, "tenant_backoffs": 0,
         }
         self._stopped = False
         self._service = HttpService(_RouterHandler, host, port,
@@ -848,8 +873,9 @@ class ServingRouter:
             hashlib.blake2b(key + b"|" + replica_id.encode(),
                             digest_size=8).digest(), "big")
 
-    def _pick(self, prompt: Sequence[int],
-              exclude: Set[str]) -> Tuple[_Replica, Dict[str, Any]]:
+    def _pick(self, prompt: Sequence[int], exclude: Set[str],
+              tenant: str = "default"
+              ) -> Tuple[_Replica, Dict[str, Any]]:
         """Choose the replica for one (re)submission and claim one
         unit of its in-flight budget (``open_entries`` — the caller
         MUST release it when the attempt ends). Returns ``(replica,
@@ -881,19 +907,27 @@ class ServingRouter:
                 return (r.state == state and not r.decommissioned
                         and r.address not in exclude)
 
+            def parked_until(r):
+                # a replica is parked for THIS pick when either its
+                # replica-wide backoff or this TENANT's backoff
+                # (ISSUE 13: a tenant-scoped 429 parks only that
+                # tenant's keyspace) is still running
+                return max(r.backoff_until,
+                           r.tenant_backoff.get(tenant, 0.0))
+
             live = [r for r in self._replicas if usable(r, "live")]
-            ready = [r for r in live if now >= r.backoff_until]
+            ready = [r for r in live if now >= parked_until(r)]
             if not ready:
                 # degraded replicas are a LAST resort: recent
                 # failures, but the breaker hasn't opened
                 degraded = [r for r in self._replicas
                             if usable(r, "degraded")
-                            and now >= r.backoff_until]
+                            and now >= parked_until(r)]
                 if degraded:
                     ready = degraded
                 elif live:
                     raise _AllBackedOff(
-                        min(r.backoff_until for r in live) - now)
+                        min(parked_until(r) for r in live) - now)
                 else:
                     raise _NoReplica()
             key = self._affinity_key(prompt)
@@ -1120,11 +1154,34 @@ class ServingRouter:
             stream = client.stream(entry.prompt, **params)
         except GatewayError as e:
             if e.status == 429:
-                # backpressure, not failure: park the replica for the
-                # hinted window and try a sibling NOW
+                # backpressure, not failure — and the SCOPE of the
+                # park follows the reply (ISSUE 13): a reply naming
+                # a tenant ("tenant queue full" from a
+                # tenancy-enabled replica) parks only that TENANT's
+                # keyspace on this replica, so an at-SLO victim keeps
+                # routing here while the flooder waits out its own
+                # hint; a tenant-blind 429 (global queue full) parks
+                # the whole replica as before
+                hinted = (e.payload or {}).get("tenant")
                 with self._lock:
-                    replica.backoff_until = (time.monotonic()
-                                             + (e.retry_after_s or 1))
+                    until = (time.monotonic()
+                             + (e.retry_after_s or 1))
+                    if hinted:
+                        replica.tenant_backoff[str(hinted)] = until
+                        # bounded map: drop expired parks once it
+                        # grows past a handful of tenants
+                        if len(replica.tenant_backoff) > 64:
+                            now_m = time.monotonic()
+                            replica.tenant_backoff = {
+                                t: u for t, u
+                                in replica.tenant_backoff.items()
+                                if u > now_m}
+                        self.stats["tenant_backoffs"] += 1
+                        self.tracer.incr(
+                            f'router_tenant_backoff{{tenant='
+                            f'"{hinted}"}}')
+                    else:
+                        replica.backoff_until = until
                     self.stats["rerouted_429"] += 1
                     self.tracer.incr("router_rerouted_429")
                 raise _RouteAround() from e
@@ -1239,16 +1296,23 @@ class ServingRouter:
             t_route_us = self._now_us() if self.fleet_trace else None
             try:
                 replica, route_info = self._pick(entry.prompt,
-                                                 exclude)
+                                                 exclude,
+                                                 tenant=entry.tenant)
             except _AllBackedOff as e:
                 if not entry.tokens:
                     wait = max(1, int(e.wait_s + 0.999))
-                    return self._finish(entry, {
+                    shed = {
                         "id": entry.rid, "tokens": [],
                         "finish_reason": "shed", "status": 429,
                         "prompt_len": len(entry.prompt),
                         "retry_after_s": wait,
-                        "replays": entry.replays})
+                        "replays": entry.replays}
+                    if self.tenants is not None:
+                        # the wait was computed over THIS tenant's
+                        # parks (ISSUE 13) — name it, so the caller
+                        # knows whose hint this is
+                        shed["tenant"] = entry.tenant
+                    return self._finish(entry, shed)
                 # mid-replay with streamed tokens: waiting is better
                 # than faulting — the backoff hints are short. The
                 # wait is pinged at keepalive_s cadence: the CLIENT
@@ -1364,10 +1428,64 @@ class ServingRouter:
         params: Dict[str, Any] = {
             "max_new_tokens": int(body.get("max_new_tokens", 16))}
         for knob in ("temperature", "top_k", "eos_id", "deadline_s",
-                     "queue_timeout_s"):
+                     "queue_timeout_s", "tenant", "priority"):
             if body.get(knob) is not None:
                 params[knob] = body[knob]
+        if params.get("tenant") is not None:
+            # validate HERE, inside the caller's 400-mapping
+            # try/except: a malformed name must answer 400 like the
+            # gateway surface does, not explode the rate-limit path
+            # (spec_of builds a TenantSpec) with a connection reset —
+            # and the reserved system tenant is never accepted from
+            # the wire (it is quota/rate/priority-exempt: one JSON
+            # field would otherwise bypass the whole QoS layer)
+            from deeplearning4j_tpu.serving.tenancy import (
+                validate_tenant,
+            )
+
+            params["tenant"] = validate_tenant(params["tenant"])
+            if params["tenant"] == "system":
+                raise ValueError(
+                    "tenant 'system' is reserved for infrastructure "
+                    "traffic")
         return prompt, params
+
+    def _tenant_throttle(self, tenant: str) -> float:
+        """Per-tenant token-bucket check (ISSUE 13): 0.0 = admitted,
+        else seconds until the tenant's next token accrues — the
+        seed of its OWN Retry-After. The reserved ``system`` tenant
+        (warmup/boot handshakes) and tenants without a configured
+        rate are never throttled."""
+        from deeplearning4j_tpu.serving.tenancy import (
+            SYSTEM_TENANT,
+            TokenBucket,
+        )
+
+        if self.tenants is None or tenant == SYSTEM_TENANT:
+            return 0.0
+        spec = self.tenants.spec_of(tenant)
+        if spec.rate_rps is None:
+            return 0.0
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = TokenBucket(
+                    spec.rate_rps, spec.burst)
+            return bucket.try_take()
+
+    def _tenant_queue_share_s(self, tenant: str) -> float:
+        """The tenant's open-request share priced in replica waves —
+        folded into its Retry-After so a flooder with a deep
+        in-flight backlog hears a longer hint than the bucket alone
+        would say."""
+        with self._lock:
+            open_t = sum(1 for e in self._journal.values()
+                         if not e.done.is_set()
+                         and e.tenant == tenant)
+            slots = sum(max(r.n_slots, 1) for r in self._replicas
+                        if r.state == "live"
+                        and not r.decommissioned) or 1
+        return open_t / slots
 
     def _handle_generate(self, handler: _RouterHandler,
                          stream: bool) -> None:
@@ -1382,6 +1500,27 @@ class ServingRouter:
         except (ValueError, TypeError, UnicodeDecodeError) as e:
             handler.send_json({"error": f"bad JSON body: {e}"}, 400,
                               close=True)
+            return
+        tenant = str(params.get("tenant") or "default")
+        wait = self._tenant_throttle(tenant)
+        if wait > 0:
+            # the front-door shed (ISSUE 13): over its rate quota,
+            # the tenant is 429'd BEFORE journaling or any replica
+            # traffic, with a Retry-After priced from ITS bucket
+            # refill plus ITS queue share — never the global hint
+            retry = max(1, math.ceil(
+                wait + self._tenant_queue_share_s(tenant)))
+            with self._lock:
+                self.stats["tenant_throttled"] += 1
+            self.tracer.incr("router_tenant_429")
+            self.tracer.incr(
+                f'router_tenant_429{{tenant="{tenant}"}}')
+            handler.send_json(
+                {"error": "tenant rate limit", "tenant": tenant,
+                 "retry_after_s": retry, "finish_reason": "shed",
+                 "status": 429},
+                429, close=True,
+                headers=(("Retry-After", retry),))
             return
         entry = self._journal_entry(prompt, params)
         if stream:
@@ -1526,6 +1665,18 @@ class ServingRouter:
             gauge("router_journal_open",
                   sum(1 for e in self._journal.values()
                       if not e.done.is_set()))
+            if self.tenants is not None:
+                # per-tenant open-request share (ISSUE 13): what the
+                # per-tenant Retry-After prices, exported so an
+                # operator can see WHOSE requests fill the fleet
+                open_by: Dict[str, int] = {}
+                for e in self._journal.values():
+                    if not e.done.is_set():
+                        open_by[e.tenant] = (
+                            open_by.get(e.tenant, 0) + 1)
+                for tenant, n in open_by.items():
+                    gauge(f'router_journal_open{{tenant='
+                          f'"{tenant}"}}', n)
             return self.tracer.prometheus_text()
 
     # -- fleet observability (ISSUE 10 tentpole) ------------------------

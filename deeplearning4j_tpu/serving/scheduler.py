@@ -51,10 +51,30 @@ class Request:
     #: produces is stitchable into one cross-process trace. Pure
     #: host metadata — never touches device work, RNG, or ids.
     trace: Optional[str] = None
+    #: multi-tenant QoS identity (ISSUE 13): which tenant's quotas,
+    #: priority class, and fair share this request bills against.
+    #: ``"default"`` = the unlabeled-caller class — engines without a
+    #: TenantRegistry ignore the field entirely, so existing callers
+    #: are unchanged. Rides the snapshot wire format and the router
+    #: journal, so failover replay and drain/restore preserve it.
+    tenant: str = "default"
+    #: optional per-request priority override (ISSUE 13): CLAMPED to
+    #: the tenant's class — a request can de-prioritize itself (batch
+    #: traffic under an interactive tenant) but never self-boost.
+    #: None = the tenant spec's priority.
+    priority: Optional[int] = None
 
     def __post_init__(self):
         if len(self.prompt) == 0:
             raise ValueError("empty prompt")
+        # tenant names ride Prometheus labels and accounting keys
+        # verbatim — validate here so EVERY submit surface (engine,
+        # gateway, router) rejects a malformed one identically
+        from deeplearning4j_tpu.serving.tenancy import validate_tenant
+
+        self.tenant = validate_tenant(self.tenant)
+        if self.priority is not None:
+            self.priority = int(self.priority)
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens {self.max_new_tokens} < 1")
@@ -121,6 +141,12 @@ class GenerationResult:
     #: result with the stitched cross-process trace. None for
     #: requests submitted without one.
     trace: Optional[str] = None
+    #: the tenant the request billed against (ISSUE 13) — echoed on
+    #: the terminal ONLY by tenancy-enabled engines (None otherwise,
+    #: so non-tenant deployments' wire format is unchanged); the
+    #: gateway's per-tenant Retry-After and the router's per-tenant
+    #: parking read it back.
+    tenant: Optional[str] = None
 
 
 class Scheduler:
@@ -243,6 +269,32 @@ class Scheduler:
 
     def pop(self) -> Request:
         return self._queue.popleft()
+
+    # -- tenancy hooks (ISSUE 13): the base scheduler is tenant-blind;
+    # -- these defaults keep the engine/gateway call sites unconditional
+    # -- while WeightedFairScheduler (serving/tenancy.py) overrides them
+    def pop_admissible(self) -> Optional[Request]:
+        """Next request the admission loop may start, or None when
+        every queued request is quota-blocked. FIFO base: the front
+        of the queue, always (no quotas exist to block it)."""
+        return self.pop() if self._queue else None
+
+    def shed_victim(self) -> Request:
+        """Overflow victim under the shed-oldest policy. FIFO base:
+        the oldest queued request (the pre-tenancy behavior);
+        weighted-fair picks the flooder's oldest instead."""
+        return self.pop()
+
+    def tenant_full(self, tenant: str) -> bool:
+        """Per-tenant queue-bound check — never full without tenancy
+        (only the global ``max_queue`` sheds)."""
+        return False
+
+    def tenant_retry_after_s(self, tenant: str, n_slots: int,
+                             round_time_s: float) -> int:
+        """Per-tenant Retry-After hint — the global hint without
+        tenancy, so the gateway's 429 path is tenancy-agnostic."""
+        return self.retry_after_s(n_slots, round_time_s)
 
     def remove(self, request_id: int) -> Optional[Request]:
         """Pull a specific queued request out of line (cancellation,

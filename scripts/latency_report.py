@@ -188,6 +188,110 @@ def parse_fleet_histograms(
 #: replay-added-latency histogram (ISSUE 10)
 FLEET_ROWS = LIVE_ROWS + (("router_replay_gap_s", "replay_gap"),)
 
+#: per-tenant rows (ISSUE 13): the per-request families that carry
+#: ``{tenant=...}`` labeled copies on tenancy-enabled engines
+#: (round time is per-round, not per-request — no tenant copy)
+TENANT_ROWS = (
+    ("serving_ttft_s", "ttft"),
+    ("serving_itl_s", "itl"),
+    ("serving_queue_wait_s", "queue_wait"),
+    ("serving_e2e_s", "e2e"),
+)
+
+#: ``{tenant="...",le="..."}``-labeled samples: a tenancy-enabled
+#: replica's own exposition AND the fleet-level per-tenant merge
+#: ``Tracer.merge_prometheus`` emits (the ``{replica=...,tenant=...}``
+#: per-replica copies deliberately do NOT match — one tenant table,
+#: not one per replica pair)
+_TENANT_BUCKET_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{tenant="([^"]*)",'
+    r'le="([^"]+)"\}\s+(\d+)\s*$')
+_TENANT_SCALAR_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)_(sum|count)\{tenant="([^"]*)"\}'
+    r"\s+(\S+)\s*$")
+
+
+def parse_tenant_histograms(
+        text: str) -> Dict[str, Dict[str, Dict[str, object]]]:
+    """The per-tenant half of a scrape: ``{tenant: {family:
+    {"buckets": [(le, cum)], "sum", "count"}}}`` from the
+    ``{tenant="...", le="..."}``-labeled samples (ISSUE 13)."""
+    out: Dict[str, Dict[str, Dict[str, object]]] = {}
+
+    def entry(tid: str, name: str) -> Dict[str, object]:
+        return out.setdefault(tid, {}).setdefault(
+            name, {"buckets": [], "sum": 0.0, "count": 0})
+
+    for line in text.splitlines():
+        m = _TENANT_BUCKET_RE.match(line)
+        if m:
+            name, tid, le, cum = m.groups()
+            bound = math.inf if le == "+Inf" else float(le)
+            entry(tid, name)["buckets"].append((bound, int(cum)))
+            continue
+        m = _TENANT_SCALAR_RE.match(line)
+        if m:
+            name, kind, tid, value = m.groups()
+            if name in out.get(tid, {}):
+                entry(tid, name)[kind] = (
+                    float(value) if kind == "sum" else
+                    int(float(value)))
+    return {tid: {n: h for n, h in fams.items() if h["buckets"]}
+            for tid, fams in out.items()}
+
+
+def tenant_report(text: str) -> Dict[str, object]:
+    """``--tenant`` rows from one metrics scrape (a replica's
+    ``/v1/metrics`` or a router's federated ``/v1/fleet/metrics``):
+    one p50/p90/p99 table per tenant."""
+    return {"tenants": {
+        tid: rows for tid, rows in sorted(
+            (tid, _rows_of(fams, TENANT_ROWS))
+            for tid, fams in parse_tenant_histograms(text).items())
+        if rows}}
+
+
+def tenant_report_from_events(events) -> Dict[str, object]:
+    """``--tenant`` rows from a saved Chrome trace: exact quantiles
+    over the ``serving.request_done`` instants, grouped by the
+    ``tenant`` arg tenancy-enabled engines stamp (ISSUE 13)."""
+    series: Dict[str, Dict[str, List[float]]] = {}
+    for event in events:
+        if (event.get("ph") != "i"
+                or event.get("name") != "serving.request_done"):
+            continue
+        args = event.get("args") or {}
+        tid = args.get("tenant")
+        if tid is None:
+            continue
+        timing = args.get("timing") or {}
+        rows = series.setdefault(
+            tid, {"ttft": [], "itl": [], "queue_wait": [],
+                  "e2e": []})
+        if timing.get("ttft_s") is not None:
+            rows["ttft"].append(timing["ttft_s"])
+        rows["queue_wait"].append(timing.get("queue_wait_s", 0.0))
+        if timing.get("e2e_s") is not None:
+            rows["e2e"].append(timing["e2e_s"])
+        tokens = timing.get("tokens") or 0
+        if (tokens > 1 and timing.get("ttft_s") is not None
+                and timing.get("e2e_s") is not None):
+            rows["itl"].append(
+                (timing["e2e_s"] - timing["ttft_s"]) / (tokens - 1))
+    out: Dict[str, List[Dict[str, object]]] = {}
+    for tid in sorted(series):
+        rows = [{
+            "phase": label,
+            "count": len(series[tid][label]),
+            **{f"p{int(q * 100)}_ms":
+               1e3 * _exact_quantile(series[tid][label], q)
+               for q in QUANTILES},
+        } for label in ("ttft", "itl", "queue_wait", "e2e")
+            if series[tid][label]]
+        if rows:
+            out[tid] = rows
+    return {"tenants": out}
+
 
 def _rows_of(hists: Dict[str, Dict[str, object]],
              row_spec) -> List[Dict[str, object]]:
@@ -329,6 +433,36 @@ def run_report(source: str) -> List[Dict[str, object]]:
     return report_from_events(events)
 
 
+def run_tenant_report(source: str) -> Dict[str, object]:
+    """``--tenant`` rows for one source: a router/replica base URL
+    (the federated ``/v1/fleet/metrics`` is probed first, then the
+    gateway's ``/v1/metrics``), a full metrics URL, a saved metrics
+    text, or a saved Chrome trace (grouped ``serving.request_done``
+    instants)."""
+    if source.startswith(("http://", "https://")):
+        base = source.rstrip("/")
+        if base.endswith("/metrics"):
+            return tenant_report(_scrape(base))
+        errors = []
+        for path in ("/v1/fleet/metrics", "/v1/metrics"):
+            try:
+                return tenant_report(_scrape(base + path))
+            except Exception as e:  # probe: either may 404
+                errors.append(f"{path}: {e}")
+        raise RuntimeError(
+            f"no metrics endpoint answered at {base} "
+            f"({'; '.join(errors)})")
+    with open(source) as f:
+        raw = f.read()
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return tenant_report(raw)  # saved metrics text
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) \
+        else doc
+    return tenant_report_from_events(events)
+
+
 def run_fleet_report(source: str) -> Dict[str, object]:
     """``--fleet`` rows for one source: a router base URL (scraped at
     ``/v1/fleet/metrics``), a full federated-metrics URL, or a saved
@@ -356,7 +490,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "/v1/fleet/metrics and report fleet-wide "
                          "AND per-replica quantiles, plus the "
                          "replay-gap row")
+    ap.add_argument("--tenant", action="store_true",
+                    help="per-tenant mode (ISSUE 13): one "
+                         "TTFT/ITL/queue-wait/e2e table per tenant "
+                         "from the {tenant=...}-labeled families "
+                         "(live scrape, saved federated text, or a "
+                         "saved trace's request_done instants); "
+                         "--json emits {\"tenants\": {tid: rows}}")
     args = ap.parse_args(argv)
+    if args.tenant:
+        report = run_tenant_report(args.source)
+        if not report["tenants"]:
+            print(f"no per-tenant latency data found in "
+                  f"{args.source}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(report))
+        else:
+            first = True
+            for tid, rows in report["tenants"].items():
+                if not first:
+                    print()
+                first = False
+                print(render(rows,
+                             f"{args.source} (tenant {tid})"))
+        return 0
     if args.fleet:
         report = run_fleet_report(args.source)
         if not report["fleet"] and not report["replicas"]:

@@ -1,0 +1,538 @@
+"""Antagonist (noisy-neighbour) soak for the multi-tenant QoS layer
+(ISSUE 13 acceptance gate).
+
+One tenant FLOODS at ~20x its rate quota while two victim tenants
+(``premium``, ``standard``) run their workload at SLO, against a
+tenancy-enabled fleet (weighted-fair engines behind a rate-limiting
+:class:`~deeplearning4j_tpu.serving.ServingRouter`). The soak first
+measures each victim's no-antagonist baseline, then repeats the SAME
+victim workload under the flood and gates:
+
+- **victims hold p99**: each victim tenant's client-measured TTFT and
+  e2e p99 stay within ``p99_ratio`` (default 1.2x) of its baseline
+  (plus a small absolute slack for shared-CPU jitter — the full soak
+  runs the strict ratio);
+- **the flooder throttles**: it receives per-tenant 429s whose
+  payload names ``flood`` and carries its OWN ``Retry-After``
+  (bucket refill + its queue share — not the global hint), while the
+  victims receive ZERO 429s;
+- **ids stay bit-identical**: every COMPLETED greedy stream —
+  victims and the flood requests that were admitted — matches the
+  same prompt on a fault-free single-engine reference, bit for bit
+  (QoS preemption is recompute-preemption: invisible to results);
+- **zero lost / zero double delivery**: the router journal shows
+  nothing open and nothing lost, and each client's streamed concat
+  equals its terminal tokens;
+- **per-tenant observability end-to-end**: ``{tenant=...}`` labeled
+  histograms on a replica's ``/v1/metrics``, both
+  ``{replica=...,tenant=...}`` labels through the router's
+  ``/v1/fleet/metrics`` federation, and populated
+  ``latency_report --tenant`` rows from the federated text;
+- **zero leaked threads/fds/subprocesses** (scripts/_leakcheck.py).
+
+Two modes:
+
+- ``--fast`` (tier-1, tests/test_tenant_soak.py): 2 IN-PROCESS
+  replicas (hoisted LocalReplica), a few seconds;
+- full (default; ``slow`` in the registered tests): SUBPROCESS
+  replicas — each a child of this same script in ``--replica`` mode
+  building the identical net AND the identical tenant table — and
+  the strict 1.2x ratio.
+
+Run standalone: ``python scripts/tenant_soak.py [--fast]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from scripts._leakcheck import assert_no_leaks, leak_baseline  # noqa: E402
+
+VOCAB = 24
+NET_SEED = 11
+ENGINE = dict(n_slots=3, decode_chunk=2, prefix_cache_rows=4, seed=0)
+
+#: the soak's tenant table — shared verbatim by the in-process
+#: replicas, the subprocess children, and the router's rate limiter.
+#: flood: one slot, a short queue, and a 3 rps / burst-3 bucket the
+#: antagonist will exceed 20x over; premium outranks standard
+#: outranks flood.
+TENANTS = (
+    ("premium", dict(priority=2, weight=4.0)),
+    ("standard", dict(priority=1, weight=2.0)),
+    ("flood", dict(priority=0, weight=1.0, max_slots=1,
+                   max_queued=4, rate_rps=3.0, burst=3.0)),
+)
+
+#: seconds of artificial per-round stall on every replica engine: a
+#: toy CPU engine otherwise drains requests faster than a flood can
+#: contend with them
+THROTTLE_S = 0.012
+
+
+def build_registry():
+    from deeplearning4j_tpu.serving import TenantRegistry, TenantSpec
+
+    return TenantRegistry(tuple(
+        TenantSpec(name, **kw) for name, kw in TENANTS))
+
+
+def _build_net(vocab: int = VOCAB, seed: int = NET_SEED,
+               stream_max_t: int = 96):
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(transformer_lm(
+        n_in=vocab, width=32, n_layers=2, n_heads=4,
+        n_classes=vocab, seed=seed)).init()
+    for c in net.conf.confs:
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = stream_max_t
+    return net
+
+
+def _throttle(engine, delay_s: float) -> None:
+    orig = engine.step
+
+    def slow(sink=None):
+        time.sleep(delay_s)
+        return orig(sink)
+
+    engine.step = slow
+
+
+def build_soak_engine(net=None, throttle: float = THROTTLE_S):
+    from deeplearning4j_tpu.serving import DecodeEngine
+
+    engine = DecodeEngine(net if net is not None else _build_net(),
+                          tenants=build_registry(), **ENGINE)
+    if throttle > 0:
+        _throttle(engine, throttle)
+    return engine
+
+
+# ---------------------------------------------------------------------------
+# --replica child mode (full/subprocess soak)
+# ---------------------------------------------------------------------------
+
+def run_replica(args) -> int:
+    from deeplearning4j_tpu.serving import ServingGateway
+
+    gw = ServingGateway(build_soak_engine(throttle=args.throttle),
+                        port=args.port, replica_id=args.replica_id,
+                        keepalive_s=0.1).start()
+    print(f"READY {gw.address}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(0.2)
+    finally:
+        with contextlib.suppress(Exception):
+            gw.close()
+    return 0
+
+
+def _ProcReplica(idx: int, throttle: float):
+    from deeplearning4j_tpu.serving.replica_proc import (
+        ReplicaProcess,
+        free_port,
+    )
+
+    replica_id = f"ten-{idx}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    port = free_port()
+    argv = [sys.executable, os.path.abspath(__file__), "--replica",
+            "--port", str(port), "--replica-id", replica_id,
+            "--throttle", str(throttle)]
+    return ReplicaProcess(argv, replica_id=replica_id, port=port,
+                          env=env,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+
+
+def _LocalReplica(idx: int, net, throttle: float):
+    from deeplearning4j_tpu.serving.replica_proc import LocalReplica
+
+    return LocalReplica(build_soak_engine(net, throttle),
+                        replica_id=f"ten-{idx}")
+
+
+# ---------------------------------------------------------------------------
+# workload
+# ---------------------------------------------------------------------------
+
+def _victim_workload(rng, per_tenant: int
+                     ) -> List[Tuple[str, List[int], int]]:
+    """Seeded (tenant, prompt, n_tokens) cases for the two victim
+    tenants — identical across the baseline and antagonist phases,
+    so the p99 comparison is apples to apples."""
+    cases = []
+    for i in range(per_tenant):
+        for tenant in ("premium", "standard"):  # interleaved: the
+            # staggered arrival order must not bias one tenant early
+            prompt = rng.integers(
+                0, VOCAB, int(rng.integers(3, 9))).tolist()
+            cases.append((tenant, prompt, int(rng.integers(8, 16))))
+    return cases
+
+
+def _flood_prompts(rng, n: int) -> List[Tuple[List[int], int]]:
+    return [(rng.integers(0, VOCAB,
+                          int(rng.integers(3, 8))).tolist(),
+             int(rng.integers(12, 24)))
+            for _ in range(n)]
+
+
+class _StreamOutcome:
+    __slots__ = ("tenant", "prompt", "n_tokens", "tokens",
+                 "terminal", "ttft_s", "e2e_s", "status_429",
+                 "retry_after_s", "payload", "error")
+
+    def __init__(self, tenant, prompt, n_tokens):
+        self.tenant = tenant
+        self.prompt = prompt
+        self.n_tokens = n_tokens
+        self.tokens: List[int] = []
+        self.terminal: Optional[Dict[str, Any]] = None
+        self.ttft_s: Optional[float] = None
+        self.e2e_s: Optional[float] = None
+        self.status_429 = False
+        self.retry_after_s: Optional[int] = None
+        self.payload: Optional[Dict[str, Any]] = None
+        self.error: Optional[str] = None
+
+
+def _run_stream(client, out: _StreamOutcome) -> _StreamOutcome:
+    from deeplearning4j_tpu.serving import GatewayError
+
+    t0 = time.monotonic()
+    try:
+        stream = client.stream(out.prompt, out.n_tokens,
+                               tenant=out.tenant)
+        for delta in stream:
+            if out.ttft_s is None:
+                out.ttft_s = time.monotonic() - t0
+            out.tokens.extend(delta)
+        out.e2e_s = time.monotonic() - t0
+        out.terminal = stream.result
+    except GatewayError as e:
+        if e.status == 429:
+            out.status_429 = True
+            out.retry_after_s = e.retry_after_s
+            out.payload = e.payload
+        else:
+            out.error = repr(e)
+    except Exception as e:  # noqa: BLE001 — the summary names it
+        out.error = repr(e)
+    return out
+
+
+def _p99(values: List[float]) -> float:
+    if not values:
+        return float("nan")
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1,
+              max(0, round(0.99 * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def _victim_phase(router_addr: str, cases, timeout_s: float = 120.0,
+                  stagger_s: float = 0.08) -> List[_StreamOutcome]:
+    """Run the victim workload: one thread per case, arrivals
+    STAGGERED ``stagger_s`` apart — "victims running at SLO" means a
+    steady paced stream, not a thundering herd whose baseline p99 is
+    dominated by self-queueing noise (which would drown the
+    flood-induced regression this soak exists to measure)."""
+    from deeplearning4j_tpu.serving import GatewayClient
+
+    outs = [_StreamOutcome(t, p, n) for t, p, n in cases]
+    threads = [threading.Thread(
+        target=_run_stream,
+        args=(GatewayClient(router_addr, timeout_s=timeout_s), o),
+        name=f"victim-{i}") for i, o in enumerate(outs)]
+    for t in threads:
+        t.start()
+        time.sleep(stagger_s)
+    for t in threads:
+        t.join(timeout=timeout_s)
+    return outs
+
+
+def run_soak(per_tenant: int = 6, n_replicas: int = 2, seed: int = 0,
+             in_process: bool = False, throttle: float = THROTTLE_S,
+             flood_seconds: float = 3.0, flood_multiple: float = 20.0,
+             p99_ratio: float = 1.2, p99_slack_s: float = 0.0,
+             verbose: bool = False) -> Dict[str, Any]:
+    """One seeded antagonist soak; returns a summary dict, raises
+    AssertionError on any gate violation. ``p99_slack_s`` is the
+    absolute jitter allowance the FAST tier-1 variant adds on top of
+    the ratio (a shared CI core makes sub-second p99s noisy); the
+    full soak runs with the strict ratio alone."""
+    import numpy as np
+
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        GatewayClient,
+        Request,
+        RouterClient,
+        ServingRouter,
+    )
+    from deeplearning4j_tpu.serving.replica_proc import shutdown_all
+    from scripts.latency_report import tenant_report
+
+    rng = np.random.default_rng(seed)
+    cases = _victim_workload(rng, per_tenant)
+    flood_rate = dict(TENANTS)["flood"]["rate_rps"]
+    floods = _flood_prompts(
+        rng, max(int(flood_seconds * flood_rate * flood_multiple),
+                 8))
+
+    # fault-free single-engine reference for every prompt the soak
+    # may complete (greedy ids must match it bit for bit)
+    ref_engine = DecodeEngine(_build_net(), **ENGINE)
+    ref_ids = {}
+    for prompt, n in ({(tuple(p), n) for _, p, n in cases}
+                      | {(tuple(p), n) for p, n in floods}):
+        ref_ids[(prompt, n)] = ref_engine.submit(
+            Request(list(prompt), n))
+    ref_res = ref_engine.run()
+    reference = {key: ref_res[rid].tokens
+                 for key, rid in ref_ids.items()}
+
+    baseline = leak_baseline()
+    if in_process:
+        net = _build_net()
+        replicas: List[Any] = [_LocalReplica(i, net, throttle)
+                               for i in range(n_replicas)]
+    else:
+        replicas = [_ProcReplica(i, throttle)
+                    for i in range(n_replicas)]
+        for r in replicas:
+            r.wait_ready(timeout_s=300.0)
+    router = ServingRouter([r.address for r in replicas],
+                           tenants=build_registry(),
+                           health_interval_s=0.1,
+                           keepalive_s=0.1).start()
+    summary: Dict[str, Any] = {
+        "mode": "in-process" if in_process else "subprocess",
+        "replicas": n_replicas, "victim_cases": len(cases),
+        "flood_attempts": 0,
+    }
+    try:
+        # wait for the first health scrape so replica ids are known
+        time.sleep(0.4)
+
+        # warm pass (discarded): the first requests pay every
+        # replica's XLA compiles — a baseline that included them
+        # would dwarf any flood-induced regression and make the p99
+        # budget meaningless
+        _victim_phase(router.address, cases)
+
+        # ---- phase A: no-antagonist baseline -----------------------
+        base_outs = _victim_phase(router.address, cases)
+        base_by_tenant: Dict[str, Dict[str, List[float]]] = {}
+        for o in base_outs:
+            assert o.error is None and not o.status_429, (
+                f"baseline victim failed: {o.tenant} {o.error} "
+                f"429={o.status_429}")
+            rows = base_by_tenant.setdefault(
+                o.tenant, {"ttft": [], "e2e": []})
+            rows["ttft"].append(o.ttft_s)
+            rows["e2e"].append(o.e2e_s)
+
+        # ---- phase B: same workload under a 20x flood --------------
+        # a PACER fires one attempt thread per tick at the full
+        # 20x-quota rate — attempts must not serialize behind the
+        # few admitted streams, or the "flood" would self-pace down
+        # to its quota and never test the limiter
+        flood_outs: List[_StreamOutcome] = []
+        workers: List[threading.Thread] = []
+        stop_flood = threading.Event()
+
+        def flood_pacer():
+            interval = 1.0 / (flood_rate * flood_multiple)
+            i = 0
+            while not stop_flood.is_set():
+                prompt, n = floods[i % len(floods)]
+                i += 1
+                out = _StreamOutcome("flood", prompt, n)
+                flood_outs.append(out)
+                w = threading.Thread(
+                    target=_run_stream,
+                    args=(GatewayClient(router.address,
+                                        timeout_s=120.0), out),
+                    name=f"flood-{i}")
+                workers.append(w)
+                w.start()
+                time.sleep(interval)
+
+        pacer = threading.Thread(target=flood_pacer, name="pacer")
+        pacer.start()
+        time.sleep(0.3)  # let the flood drain its burst bucket first
+        storm_outs = _victim_phase(router.address, cases)
+        stop_flood.set()
+        pacer.join(timeout=30.0)
+        for w in workers:
+            w.join(timeout=120.0)
+        summary["flood_attempts"] = len(flood_outs)
+
+        # ---- gates -------------------------------------------------
+        # victims: zero 429s, every stream completed, p99 held
+        tenants_seen = set()
+        for o in storm_outs:
+            assert o.error is None, (
+                f"victim stream failed under flood: {o.tenant} "
+                f"{o.error}")
+            assert not o.status_429, (
+                f"victim {o.tenant} was throttled — per-tenant "
+                "limits leaked across tenants")
+            tenants_seen.add(o.tenant)
+        p99s: Dict[str, Dict[str, float]] = {}
+        for tenant in ("premium", "standard"):
+            base_rows = base_by_tenant[tenant]
+            storm_ttft = [o.ttft_s for o in storm_outs
+                          if o.tenant == tenant]
+            storm_e2e = [o.e2e_s for o in storm_outs
+                         if o.tenant == tenant]
+            p99s[tenant] = {
+                "base_ttft_p99_s": _p99(base_rows["ttft"]),
+                "storm_ttft_p99_s": _p99(storm_ttft),
+                "base_e2e_p99_s": _p99(base_rows["e2e"]),
+                "storm_e2e_p99_s": _p99(storm_e2e),
+            }
+            for metric in ("ttft", "e2e"):
+                base_p = p99s[tenant][f"base_{metric}_p99_s"]
+                storm_p = p99s[tenant][f"storm_{metric}_p99_s"]
+                budget = max(p99_ratio * base_p,
+                             base_p + p99_slack_s)
+                assert storm_p <= budget, (
+                    f"victim {tenant} {metric} p99 {storm_p:.3f}s "
+                    f"exceeds budget {budget:.3f}s "
+                    f"(baseline {base_p:.3f}s x {p99_ratio}"
+                    f" + slack {p99_slack_s})")
+        summary["p99"] = p99s
+
+        # flooder: throttled with ITS OWN per-tenant hint
+        shed = [o for o in flood_outs if o.status_429]
+        assert shed, ("the flood was never throttled — the rate "
+                      "limiter did not engage at 20x quota")
+        for o in shed:
+            assert (o.payload or {}).get("tenant") == "flood", (
+                f"flood 429 payload does not name the tenant: "
+                f"{o.payload}")
+            assert o.retry_after_s and o.retry_after_s >= 1, (
+                f"flood 429 carried no Retry-After: "
+                f"{o.retry_after_s}")
+        summary["flood_429s"] = len(shed)
+        completed_floods = [o for o in flood_outs
+                            if o.terminal is not None
+                            and o.terminal.get("finish_reason")
+                            in ("length", "eos")]
+        summary["flood_completed"] = len(completed_floods)
+
+        # bit-parity: every COMPLETED greedy stream matches the
+        # fault-free reference; streamed concat == terminal tokens
+        checked = 0
+        for o in list(storm_outs) + list(base_outs) \
+                + completed_floods:
+            if o.terminal is None:
+                continue
+            assert o.tokens == o.terminal.get("tokens"), (
+                f"double/lost delivery for {o.tenant}: streamed "
+                f"{len(o.tokens)} != terminal "
+                f"{len(o.terminal.get('tokens', []))}")
+            key = (tuple(o.prompt), o.n_tokens)
+            if o.terminal.get("finish_reason") in ("length", "eos"):
+                assert o.tokens == reference[key], (
+                    f"{o.tenant} ids diverged from the fault-free "
+                    f"reference for prompt {o.prompt}")
+                checked += 1
+        assert checked >= len(cases) * 2, checked
+        summary["bit_checked"] = checked
+
+        # journal audit: nothing open, nothing lost
+        audit = router.journal_audit()
+        assert not audit["open"], f"open entries: {audit['open']}"
+        assert not audit["lost"], f"lost entries: {audit['lost']}"
+        summary["journal_entries"] = audit["entries"]
+
+        # per-tenant observability end to end
+        replica_text = GatewayClient(
+            replicas[0].address, timeout_s=30.0).metrics()
+        assert 'serving_ttft_s_bucket{tenant="premium",le=' \
+            in replica_text, "replica /v1/metrics lacks tenant labels"
+        fleet_text = RouterClient(router.address,
+                                  timeout_s=30.0).fleet_metrics()
+        assert 'serving_ttft_s_bucket{tenant="premium",le=' \
+            in fleet_text, "federation lost the tenant-level merge"
+        import re as _re
+
+        assert _re.search(
+            r'serving_ttft_s_bucket\{replica="[^"]+",'
+            r'tenant="premium",le=', fleet_text), (
+            "federation lacks {replica=...,tenant=...} copies")
+        assert 'router_tenant_429{tenant="flood"}' in fleet_text, (
+            "router per-tenant 429 counter missing from federation")
+        report = tenant_report(fleet_text)["tenants"]
+        for tenant in ("premium", "standard", "flood"):
+            assert tenant in report and any(
+                r["phase"] == "ttft" for r in report[tenant]), (
+                f"latency_report --tenant lost tenant {tenant}: "
+                f"{sorted(report)}")
+        summary["report_tenants"] = sorted(report)
+    finally:
+        router.close()
+        shutdown_all(replicas)
+
+    leaks = assert_no_leaks(
+        baseline, subprocesses=[] if in_process else replicas)
+    summary.update(leaks)
+    if verbose:
+        print(summary)
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="in-process tier-1 variant")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replica", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--port", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--replica-id", default="ten-0",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--throttle", type=float, default=THROTTLE_S,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.replica:
+        return run_replica(args)
+    if args.fast:
+        summary = run_soak(per_tenant=5, n_replicas=2,
+                           seed=args.seed, in_process=True,
+                           p99_slack_s=0.35, verbose=True)
+    else:
+        summary = run_soak(per_tenant=6, n_replicas=2,
+                           seed=args.seed, in_process=False,
+                           flood_seconds=4.0, verbose=True)
+    print(f"tenant soak PASSED ({summary['mode']}): "
+          f"{summary['flood_429s']} flood 429s, "
+          f"{summary['bit_checked']} bit-checked streams")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

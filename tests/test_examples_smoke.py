@@ -47,11 +47,15 @@ def test_all_examples_listed():
 #: even in tiny-shape mode these are the heaviest smokes (the
 #: flagship runs the full train/eval/decode pipeline, ~30 s;
 #: streaming_decode grew to SEVEN decode variants incl. a
-#: tensor-parallel shard_map compile, ~13 s); they ride the slow tier
+#: tensor-parallel shard_map compile, ~13 s; serving_router grew to
+#: SIX acts — affinity, failover, breaker, stitch, elastic scale-up,
+#: and the ISSUE 13 tenant flood — ~17 s); they ride the slow tier
 #: with the subprocess soaks so tier-1 stays inside its wall-time
-#: budget — tier-1 covers the same engine paths through
-#: tests/test_serving_tp.py and tests/test_serving_paged.py
-SLOW_EXAMPLES = {"flagship_transformer.py", "streaming_decode.py"}
+#: budget — tier-1 covers the same engine/router/tenancy paths
+#: through tests/test_serving_tp.py, tests/test_serving_paged.py,
+#: tests/test_serving_router.py, and tests/test_tenancy.py
+SLOW_EXAMPLES = {"flagship_transformer.py", "streaming_decode.py",
+                 "serving_router.py"}
 
 
 @pytest.mark.parametrize(

@@ -1,0 +1,925 @@
+"""Multi-tenant QoS (ISSUE 13 tentpole): tenant registry, weighted-
+fair scheduling with deficit carry-over, quota preemption through the
+recompute-preemption path, per-tenant 429 backpressure, labeled
+per-tenant observability end to end, and the plumbing that carries
+``Request.tenant``/``priority`` across every process boundary
+(snapshot→restore, router failover replay, the warmup handshake)."""
+
+import contextlib
+import json
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.profiler.tracer import (
+    Histogram,
+    Tracer,
+    parse_exposition,
+)
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    GatewayClient,
+    GatewayError,
+    Request,
+    RouterClient,
+    Scheduler,
+    ServingGateway,
+    ServingRouter,
+    TenantRegistry,
+    TenantSpec,
+    TokenBucket,
+    WeightedFairScheduler,
+)
+
+V = 12
+
+
+def _net(seed=7, stream_max_t=64):
+    net = MultiLayerNetwork(transformer_lm(
+        n_in=V, width=32, n_layers=2, n_heads=4, n_classes=V,
+        seed=seed)).init()
+    for c in net.conf.confs:
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = stream_max_t
+    return net
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _net()
+
+
+def _registry(**flood_kw):
+    flood = dict(priority=0, weight=1.0, max_slots=1)
+    flood.update(flood_kw)
+    return TenantRegistry((
+        TenantSpec("premium", priority=2, weight=4.0),
+        TenantSpec("standard", priority=1, weight=2.0),
+        TenantSpec("flood", **flood)))
+
+
+def _throttle(engine, delay_s):
+    orig = engine.step
+
+    def slow(sink=None):
+        time.sleep(delay_s)
+        return orig(sink)
+
+    engine.step = slow
+
+
+def _wait_for(cond, timeout=20.0, interval=0.01, msg="condition"):
+    t0 = time.monotonic()
+    while not cond():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(interval)
+
+
+PROMPTS = [[1, 4, 7, 2], [9, 3, 3], [5, 2, 8, 1, 6, 0, 4],
+           [2, 2], [11, 0, 6]]
+LENS = [6, 11, 4, 9, 13]
+
+
+# ---------------------------------------------------------------------------
+# registry / spec / bucket units
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_default_and_system_always_present(self):
+        reg = TenantRegistry()
+        assert reg.spec_of("default").max_slots is None
+        sys_spec = reg.spec_of("system")
+        assert sys_spec.priority > 10**5
+        assert sys_spec.max_slots is None
+
+    def test_unknown_tenant_gets_default_class_under_own_name(self):
+        reg = _registry()
+        spec = reg.spec_of("nobody")
+        assert spec.tenant == "nobody"
+        assert spec.priority == reg.spec_of("default").priority
+        assert spec.max_slots is None
+
+    def test_priority_clamped_never_boosted(self):
+        reg = _registry()
+        assert reg.effective_priority(
+            Request([1], 1, tenant="flood", priority=9)) == 0
+        assert reg.effective_priority(
+            Request([1], 1, tenant="premium", priority=1)) == 1
+        assert reg.effective_priority(
+            Request([1], 1, tenant="premium")) == 2
+
+    def test_tenant_name_validation(self):
+        with pytest.raises(ValueError, match="tenant"):
+            Request([1], 1, tenant='evil"} bad')
+        with pytest.raises(ValueError, match="tenant"):
+            TenantSpec("x" * 80)
+        with pytest.raises(ValueError, match="tenant"):
+            TenantSpec("")
+
+    def test_spec_parse_cli_spelling(self):
+        s = TenantSpec.parse(
+            "premium:priority=2:weight=4:slots=3:queue=16:rps=50")
+        assert (s.tenant, s.priority, s.weight, s.max_slots,
+                s.max_queued, s.rate_rps) == ("premium", 2, 4.0, 3,
+                                              16, 50.0)
+        with pytest.raises(ValueError, match="tenant spec"):
+            TenantSpec.parse("a:bogus=1")
+
+    def test_registry_round_trips_the_wire_format(self):
+        reg = _registry(rate_rps=5.0, burst=9.0)
+        reg2 = TenantRegistry.from_dict(
+            json.loads(json.dumps(reg.to_dict())))
+        assert reg2.spec_of("flood").rate_rps == 5.0
+        assert reg2.spec_of("flood").burst == 9.0
+        assert reg2.spec_of("premium").weight == 4.0
+
+    def test_system_quota_registration_refused(self):
+        with pytest.raises(ValueError, match="system"):
+            TenantRegistry((TenantSpec("system", max_slots=1),))
+
+
+class TestTokenBucket:
+    def test_burst_then_rate_with_fake_clock(self):
+        now = [0.0]
+        b = TokenBucket(2.0, burst=3.0, clock=lambda: now[0])
+        assert [b.try_take() for _ in range(3)] == [0.0, 0.0, 0.0]
+        wait = b.try_take()
+        assert wait == pytest.approx(0.5)  # 1 token at 2/s
+        now[0] += 0.5
+        assert b.try_take() == 0.0
+        now[0] += 10.0  # refill clamps at burst
+        assert [b.try_take() for _ in range(3)] == [0.0, 0.0, 0.0]
+        assert b.try_take() > 0
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair scheduler units (pure host)
+# ---------------------------------------------------------------------------
+
+def _sched(reg=None, **kw):
+    return WeightedFairScheduler(64, tenants=reg or _registry(),
+                                 **kw)
+
+
+class TestWeightedFairScheduler:
+    def test_single_tenant_is_fifo(self):
+        s = _sched()
+        reqs = [Request([i + 1], 4) for i in range(4)]
+        for r in reqs:
+            s.submit(r)
+        s.begin_round({})
+        assert [s.pop_admissible() for _ in range(4)] == reqs
+
+    def test_priority_orders_admission(self):
+        s = _sched()
+        lo = Request([1, 2], 4, tenant="flood")
+        hi = Request([3, 4], 4, tenant="premium")
+        mid = Request([5, 6], 4, tenant="standard")
+        for r in (lo, mid, hi):
+            s.submit(r)
+        s.begin_round({})
+        assert s.pop_admissible() is hi
+        assert s.pop_admissible() is mid
+        assert s.pop_admissible() is lo
+
+    def test_service_splits_equal_priority_by_weight(self):
+        # two equal-priority tenants, weights 3:1 — over many rounds
+        # the admitted prompt tokens converge to the weight ratio
+        # (the carry-over accounting: the underserved tenant's low
+        # pass IS its banked deficit)
+        reg = TenantRegistry((TenantSpec("a", weight=3.0),
+                              TenantSpec("b", weight=1.0)))
+        s = WeightedFairScheduler(64, tenants=reg)
+        for _ in range(60):
+            s.submit(Request([1] * 8, 4, tenant="a"))
+            s.submit(Request([2] * 8, 4, tenant="b"))
+        admitted = {"a": 0, "b": 0}
+        for _ in range(40):  # one admission per "round"
+            s.begin_round({})
+            req = s.pop_admissible()
+            if req is None:
+                break
+            admitted[req.tenant] += len(req.prompt)
+        ratio = admitted["a"] / max(admitted["b"], 1)
+        assert 2.0 <= ratio <= 4.5, (admitted, ratio)
+
+    def test_emptied_backlog_cannot_hoard_entitlement(self):
+        # b idles while a is served heavily; when b returns it joins
+        # at the current virtual time — it gets the NEXT admission
+        # (it is not behind), but not an unbounded catch-up run
+        reg = TenantRegistry((TenantSpec("a"), TenantSpec("b")))
+        s = WeightedFairScheduler(64, tenants=reg)
+        for _ in range(10):
+            s.submit(Request([1] * 8, 4, tenant="a"))
+        for _ in range(6):
+            s.begin_round({})
+            assert s.pop_admissible().tenant == "a"
+        for _ in range(6):
+            s.submit(Request([2] * 8, 4, tenant="b"))
+        order = []
+        for _ in range(8):
+            s.begin_round({})
+            order.append(s.pop_admissible().tenant)
+        # b starts AT the virtual time: strict alternation from here
+        assert order.count("b") in (4, 5)
+        assert "a" in order[:2] or "b" in order[:2]
+
+    def test_slot_quota_gates_admission(self):
+        s = _sched()
+        for _ in range(3):
+            s.submit(Request([1, 2], 4, tenant="flood"))
+        s.begin_round({})
+        assert s.pop_admissible() is not None  # 0 running < 1 quota
+        assert s.pop_admissible() is None      # round-admitted == 1
+        s.begin_round({"flood": 1})            # still decoding
+        assert s.pop_admissible() is None
+        s.begin_round({})                      # slot freed
+        assert s.pop_admissible() is not None
+
+    def test_pending_stays_truthy_when_quota_blocked(self):
+        s = _sched()
+        s.submit(Request([1, 2], 4, tenant="flood"))
+        s.begin_round({"flood": 1})
+        assert s.pending == 1
+        assert s.pop_admissible() is None
+        assert s.pending == 1  # nothing silently dropped
+
+    def test_tenant_queue_bound(self):
+        s = _sched(reg=_registry(max_queued=2))
+        s.submit(Request([1], 4, tenant="flood"))
+        assert not s.tenant_full("flood")
+        s.submit(Request([2], 4, tenant="flood"))
+        assert s.tenant_full("flood")
+        assert not s.tenant_full("premium")
+
+    def test_shed_victim_is_the_flooders_oldest(self):
+        s = _sched()
+        keeper = Request([1, 2], 4, tenant="premium")
+        first_flood = Request([3, 4], 4, tenant="flood")
+        s.submit(keeper)   # oldest overall — FIFO would shed it
+        s.submit(first_flood)
+        s.submit(Request([5, 6], 4, tenant="flood"))
+        victim = s.shed_victim()
+        assert victim is first_flood  # lowest class, oldest of it
+
+    def test_remove_and_queued_requests_stay_consistent(self):
+        s = _sched()
+        a = Request([1, 2], 4, tenant="premium")
+        b = Request([3, 4], 4, tenant="flood")
+        s.submit(a)
+        s.submit(b)
+        assert s.queued_requests() == [a, b]  # arrival order
+        assert s.remove(a.id) is a
+        assert s.queued_requests() == [b]
+        s.begin_round({})
+        assert s.pop_admissible() is b
+        assert s.pending == 0
+
+    def test_mid_queue_take_tombstones_not_scans(self):
+        # a victim's head sits BEHIND a deep flooder backlog:
+        # admission takes it from the middle of the arrival deque —
+        # every base view (pending/full/pressure/queued_requests/
+        # remove) must see through the tombstone, and a tombstoned
+        # id must never be cancellable a second time
+        s = _sched(max_queue=100)
+        floods = [Request([1, 2], 4, tenant="flood")
+                  for _ in range(8)]
+        for r in floods:
+            s.submit(r)
+        prem = Request([5, 6, 7], 4, tenant="premium")
+        s.submit(prem)
+        s.begin_round({})
+        took = s.pop_admissible()
+        assert took is prem  # priority beats arrival
+        assert s.pending == 8
+        assert s.queued_requests() == floods
+        assert s.pressure() == sum(len(r.prompt) for r in floods)
+        assert s.remove(prem.id) is None  # already taken
+        assert s.retry_after_s(4, 0.5) >= 1
+        # compaction: draining the flooders pops the tombstone too
+        s.begin_round({})
+        while s.pop_admissible() is not None:
+            s.begin_round({})
+        assert s.pending == 0
+        assert not s._queue and not s._taken_ids
+
+    def test_tenant_retry_after_prices_own_queue_share(self):
+        s = _sched()
+        for _ in range(24):
+            s.submit(Request([1, 2], 4, tenant="flood"))
+        s.submit(Request([3, 4], 4, tenant="premium"))
+        flood_hint = s.tenant_retry_after_s("flood", 4, 0.5)
+        victim_hint = s.tenant_retry_after_s("premium", 4, 0.5)
+        assert flood_hint > victim_hint
+        assert victim_hint >= 1
+
+    def test_plan_preemptions_priority_tier(self):
+        s = _sched()
+        s.submit(Request([1, 2], 4, tenant="premium"))
+        s.begin_round({"flood": 2})
+        # flood holds both slots (quota 1 → slot 1 is over-quota);
+        # the premium waiter takes the youngest flood slot
+        victims = s.plan_preemptions(
+            [(0, "flood", 0), (1, "flood", 0)], free_slots=0)
+        assert victims == [1]
+
+    def test_plan_preemptions_respects_free_slots(self):
+        s = _sched()
+        s.submit(Request([1, 2], 4, tenant="premium"))
+        s.begin_round({"flood": 1})
+        assert s.plan_preemptions([(0, "flood", 0)],
+                                  free_slots=1) == []
+
+    def test_no_preemption_between_equal_in_quota_classes(self):
+        reg = TenantRegistry((TenantSpec("a"), TenantSpec("b")))
+        s = WeightedFairScheduler(64, tenants=reg)
+        s.submit(Request([1, 2], 4, tenant="a"))
+        s.begin_round({"b": 2})
+        assert s.plan_preemptions(
+            [(0, "b", 0), (1, "b", 0)], free_slots=0) == []
+
+    def test_over_quota_preemptible_by_equal_priority(self):
+        # over-quota slots (restore under a tightened registry) are
+        # reclaimable even by an equal-priority waiter
+        reg = TenantRegistry((TenantSpec("a", max_slots=1),
+                              TenantSpec("b")))
+        s = WeightedFairScheduler(64, tenants=reg)
+        s.submit(Request([1, 2], 4, tenant="b"))
+        s.begin_round({"a": 2})
+        assert s.plan_preemptions(
+            [(0, "a", 0), (1, "a", 0)], free_slots=0) == [1]
+
+
+# ---------------------------------------------------------------------------
+# labeled HISTOGRAM tracks (ISSUE 13 satellite — mirrors the
+# labeled-gauge suite of tests/test_serving_tp.py)
+# ---------------------------------------------------------------------------
+
+class TestLabeledHistograms:
+    def test_labeled_tracks_share_one_family_header(self):
+        t = Tracer()
+        t.observe("serving_ttft_s", 0.01)
+        t.describe("serving_ttft_s", "ttft help")
+        h = Histogram()
+        h.observe(0.04)
+        t.register_histogram('serving_ttft_s{tenant="a"}', h)
+        text = t.prometheus_text()
+        assert text.count("# TYPE serving_ttft_s histogram") == 1
+        assert text.count("# HELP serving_ttft_s") == 1
+        assert 'serving_ttft_s_bucket{tenant="a",le="0.0562341"} 1' \
+            in text
+        assert 'serving_ttft_s_sum{tenant="a"} 0.04' in text
+        assert 'serving_ttft_s_count{tenant="a"} 1' in text
+        # the unlabeled series is intact next to it
+        assert "serving_ttft_s_count 1" in text.replace(
+            'serving_ttft_s_count{tenant="a"} 1', "")
+
+    def test_parse_exposition_keeps_labeled_series(self):
+        t = Tracer()
+        t.observe("f", 0.01, n=2)
+        h = Histogram()
+        h.observe(0.04, n=3)
+        t.register_histogram('f{tenant="x"}', h)
+        p = parse_exposition(t.prometheus_text())
+        assert p["histograms"]["f"]["count"] == 2
+        lab = p["histograms"]["f"]["labeled"]['tenant="x"']
+        assert lab["count"] == 3
+        assert lab["sum"] == pytest.approx(0.12)
+        assert lab["les"] == p["histograms"]["f"]["les"]
+
+    def test_merge_prometheus_merges_per_label_set(self):
+        def tracer_with(unlabeled, labeled):
+            t = Tracer()
+            for v in unlabeled:
+                t.observe("serving_ttft_s", v)
+            h = Histogram()
+            for v in labeled:
+                h.observe(v)
+            t.register_histogram('serving_ttft_s{tenant="p"}', h)
+            return t.prometheus_text()
+
+        out = Tracer.merge_prometheus(
+            {"r0": tracer_with([0.01, 0.02], [0.04]),
+             "r1": tracer_with([0.08], [0.16, 0.32])})
+        p = parse_exposition(out)
+        assert p["histograms"]["serving_ttft_s"]["count"] == 3
+        # fleet-level per-tenant merge: one series per label set
+        lab = p["histograms"]["serving_ttft_s"]["labeled"]
+        assert lab['tenant="p"']["count"] == 3
+        # per-replica copies carry BOTH labels
+        assert ('serving_ttft_s_count{replica="r0",tenant="p"} 1'
+                in out)
+        assert ('serving_ttft_s_count{replica="r1",tenant="p"} 2'
+                in out)
+
+    def test_merge_rejects_mismatched_labeled_bounds(self):
+        t0, t1 = Tracer(), Tracer()
+        h0 = Histogram()
+        h0.observe(0.5)
+        t0.register_histogram('f{tenant="x"}', h0)
+        h1 = Histogram(bounds=[0.1, 1.0])
+        h1.observe(0.5)
+        t1.register_histogram('f{tenant="x"}', h1)
+        with pytest.raises(ValueError, match="mismatch"):
+            Tracer.merge_prometheus({"a": t0.prometheus_text(),
+                                     "b": t1.prometheus_text()})
+
+    def test_replica_tagged_satellites_still_dropped(self):
+        # re-parsing a FEDERATED text must not double-count the
+        # per-replica copies as fresh labeled series
+        t = Tracer()
+        t.observe("f", 0.01)
+        merged = Tracer.merge_prometheus(
+            {"r0": t.prometheus_text()})
+        p = parse_exposition(merged)
+        assert p["histograms"]["f"]["count"] == 1
+        assert p["histograms"]["f"]["labeled"] == {}
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+class TestEngineTenancy:
+    def test_default_tenant_bit_parity_with_seed_scheduler(self):
+        ref = DecodeEngine(_net(), n_slots=2, decode_chunk=3, seed=0)
+        rids = [ref.submit(Request(list(p), n))
+                for p, n in zip(PROMPTS, LENS)]
+        rres = ref.run()
+        eng = DecodeEngine(_net(), n_slots=2, decode_chunk=3, seed=0,
+                           tenants=TenantRegistry())
+        ids = [eng.submit(Request(list(p), n))
+               for p, n in zip(PROMPTS, LENS)]
+        res = eng.run()
+        for a, b in zip(rids, ids):
+            assert rres[a].tokens == res[b].tokens
+        assert res[ids[0]].tenant == "default"
+        assert rres[rids[0]].tenant is None  # tenant-blind engines
+        assert eng.compile_counts() == ref.compile_counts()
+
+    def test_priority_arrival_preempts_lower_class(self):
+        reg = _registry(max_slots=2)
+        eng = DecodeEngine(_net(), n_slots=2, decode_chunk=2, seed=0,
+                           tenants=reg)
+        f1 = eng.submit(Request([1, 2, 3], 30, tenant="flood"))
+        f2 = eng.submit(Request([2, 3, 4], 30, tenant="flood"))
+        eng.step()
+        eng.step()
+        assert all(s is not None for s in eng._slots)
+        p = eng.submit(Request([4, 5, 6], 4, tenant="premium"))
+        eng.step()
+        assert eng.stats["qos_preempted"] == 1
+        res = eng.run()
+        assert res[p].finish_reason == "length"
+        # the preempted flood request regenerates bit-identically
+        solo = DecodeEngine(_net(), n_slots=2, decode_chunk=2,
+                            seed=0)
+        s1 = solo.submit(Request([1, 2, 3], 30))
+        s2 = solo.submit(Request([2, 3, 4], 30))
+        sres = solo.run()
+        assert res[f1].tokens == sres[s1].tokens
+        assert res[f2].tokens == sres[s2].tokens
+
+    def test_slot_quota_holds_while_others_run(self):
+        reg = _registry()  # flood max_slots=1
+        eng = DecodeEngine(_net(), n_slots=3, decode_chunk=2, seed=0,
+                           tenants=reg)
+        occupancy = []
+        orig = eng.step
+
+        def spy(sink=None):
+            out = orig(sink)
+            occupancy.append(sum(
+                1 for s in eng._slots
+                if s is not None
+                and s.request.tenant == "flood"))
+            return out
+
+        eng.step = spy
+        for _ in range(4):
+            eng.submit(Request([1, 2, 3], 8, tenant="flood"))
+        eng.submit(Request([4, 5], 8, tenant="premium"))
+        res = eng.run()
+        assert max(occupancy) <= 1  # quota never exceeded
+        assert all(r.finish_reason == "length"
+                   for r in res.values())
+
+    def test_snapshot_restore_preserves_tenancy(self):
+        reg = _registry()
+        eng = DecodeEngine(_net(), n_slots=2, decode_chunk=2, seed=0,
+                           tenants=reg)
+        a = eng.submit(Request([1, 2, 3], 12, tenant="premium",
+                               priority=1))
+        b = eng.submit(Request([2, 3, 4], 12, tenant="flood"))
+        eng.step()
+        snap = json.loads(json.dumps(eng.snapshot()))
+        restored = DecodeEngine.restore(_net(), snap)
+        assert isinstance(restored.scheduler, WeightedFairScheduler)
+        assert restored.scheduler.tenants.spec_of(
+            "flood").max_slots == 1
+        res = restored.run()
+        assert res[a].tenant == "premium"
+        assert res[b].tenant == "flood"
+        ref = DecodeEngine(_net(), n_slots=2, decode_chunk=2, seed=0)
+        ra = ref.submit(Request([1, 2, 3], 12))
+        rb = ref.submit(Request([2, 3, 4], 12))
+        rr = ref.run()
+        assert res[a].tokens == rr[ra].tokens
+        assert res[b].tokens == rr[rb].tokens
+
+    def test_tenant_queue_bound_sheds_only_that_tenant(self):
+        reg = _registry(max_queued=1)
+        eng = DecodeEngine(_net(), n_slots=1, decode_chunk=2, seed=0,
+                           tenants=reg)
+        keep = eng.submit(Request([1, 2], 6, tenant="flood"))
+        eng.step()  # flood admitted, queue empty again
+        q1 = eng.submit(Request([2, 3], 6, tenant="flood"))
+        shed = eng.submit(Request([3, 4], 6, tenant="flood"))
+        ok = eng.submit(Request([4, 5], 6, tenant="premium"))
+        res = eng.run()
+        assert res[shed].finish_reason == "shed"
+        assert res[keep].finish_reason == "length"
+        assert res[q1].finish_reason == "length"
+        assert res[ok].finish_reason == "length"
+        assert eng.tenant_stats["flood"]["shed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# gateway: per-tenant 429 + labeled metrics + warmup billing
+# ---------------------------------------------------------------------------
+
+class TestGatewayTenancy:
+    def test_per_tenant_429_and_labeled_metrics(self, net):
+        reg = _registry(max_queued=1)
+        eng = DecodeEngine(net, n_slots=1, decode_chunk=2, seed=0,
+                           tenants=reg)
+        _throttle(eng, 0.02)
+        with ServingGateway(eng, keepalive_s=0.1) as gw:
+            client = GatewayClient(gw.address, timeout_s=60.0)
+            streams = [client.stream([9, 3, 3, i], 20,
+                                     tenant="flood")
+                       for i in range(2)]
+            _wait_for(lambda: eng.scheduler.tenant_full("flood"),
+                      msg="flood queue to fill")
+            with pytest.raises(GatewayError) as exc:
+                client.generate([9, 3, 1], 4, tenant="flood")
+            assert exc.value.status == 429
+            assert exc.value.payload["tenant"] == "flood"
+            assert exc.value.retry_after_s >= 1
+            # another tenant is NOT full: admitted fine
+            out = client.generate([1, 4, 7], 4, tenant="premium")
+            assert out["finish_reason"] == "length"
+            assert out["tenant"] == "premium"
+            for s in streams:
+                for _ in s:
+                    pass
+            text = client.metrics()
+            assert ('serving_ttft_s_bucket{tenant="premium",le='
+                    in text)
+            assert 'serving_admitted{tenant="flood"}' in text
+            assert ('serving_gateway_429{tenant="flood"} 1'
+                    in text)
+
+    def test_system_tenant_rejected_from_the_wire(self, net):
+        # claiming the quota/rate/priority-exempt system tenant via
+        # one JSON field would bypass the whole QoS layer: 400 at
+        # BOTH HTTP surfaces, while warmup's in-process use stays
+        reg = _registry()
+        eng = DecodeEngine(net, n_slots=2, decode_chunk=2, seed=0,
+                           tenants=reg)
+        with ServingGateway(eng, keepalive_s=0.1) as gw:
+            client = GatewayClient(gw.address, timeout_s=30.0)
+            with pytest.raises(GatewayError) as exc:
+                client.generate([1, 4, 7], 2, tenant="system")
+            assert exc.value.status == 400
+            assert "reserved" in exc.value.payload["error"]
+            with ServingRouter([gw.address], tenants=reg,
+                               health_interval_s=0.1) as router:
+                rc = RouterClient(router.address, timeout_s=30.0)
+                with pytest.raises(GatewayError) as exc:
+                    rc.generate([1, 4, 7], 2, tenant="system")
+                assert exc.value.status == 400
+                # malformed names answer 400 too, never a reset
+                with pytest.raises(GatewayError) as exc:
+                    rc.generate([1, 4, 7], 2, tenant="bad name{x}")
+                assert exc.value.status == 400
+
+    def test_warmup_bills_the_system_tenant(self, net):
+        reg = _registry()
+        eng = DecodeEngine(net, n_slots=2, decode_chunk=2, seed=0,
+                           prefix_cache_rows=4, tenants=reg)
+        with ServingGateway(eng, keepalive_s=0.1) as gw:
+            out = GatewayClient(gw.address).warmup(
+                [[1, 4, 7, 2], [9, 3, 3, 1]])
+            assert out["warmed"] == 2
+            assert eng.tenant_stats["system"]["admitted"] == 2
+            # no user tenant was billed
+            assert "default" not in eng.tenant_stats
+            assert "premium" not in eng.tenant_stats
+
+
+# ---------------------------------------------------------------------------
+# router: rate limits, per-tenant parking, failover plumbing
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _cluster(net, n_replicas, reg, throttle_s=0.0,
+             router_kwargs=None, **engine_kwargs):
+    engine_kwargs.setdefault("n_slots", 2)
+    engine_kwargs.setdefault("decode_chunk", 2)
+    engine_kwargs.setdefault("seed", 0)
+    engines = [DecodeEngine(net, tenants=reg, **engine_kwargs)
+               for _ in range(n_replicas)]
+    if throttle_s:
+        for e in engines:
+            _throttle(e, throttle_s)
+    gateways = [ServingGateway(e, keepalive_s=0.1,
+                               replica_id=f"rep-{i}").start()
+                for i, e in enumerate(engines)]
+    kw = dict(health_interval_s=0.1, probe_interval_s=0.4,
+              affinity_block_tokens=4, failure_threshold=2,
+              tenants=reg)
+    kw.update(router_kwargs or {})
+    router = ServingRouter([g.address for g in gateways],
+                           **kw).start()
+    client = RouterClient(router.address, timeout_s=120.0)
+    try:
+        yield router, client, gateways
+    finally:
+        router.close()
+        for g in gateways:
+            with contextlib.suppress(Exception):
+                g.close()
+
+
+class TestRouterTenancy:
+    def test_rate_limit_429_with_own_retry_after(self, net):
+        # rate slow enough that the burst cannot refill behind the
+        # first requests' wall time (XLA compiles included)
+        reg = _registry(rate_rps=0.05, burst=2.0)
+        with _cluster(net, 1, reg) as (router, client, _):
+            for _ in range(2):
+                client.generate([1, 4, 7], 2, tenant="flood")
+            with pytest.raises(GatewayError) as exc:
+                client.generate([1, 4, 7], 2, tenant="flood")
+            assert exc.value.status == 429
+            assert exc.value.payload["tenant"] == "flood"
+            assert exc.value.retry_after_s >= 1
+            # victims are untouched by the flooder's bucket
+            out = client.generate([1, 4, 7], 2, tenant="premium")
+            assert out["finish_reason"] == "length"
+            text = client.fleet_metrics()
+            assert 'router_tenant_429{tenant="flood"} 1' in text
+
+    def test_tenant_scoped_429_parks_keyspace_not_replica(self, net):
+        # one replica, flood queue-bound: a flood 429 from the
+        # replica parks only flood's keyspace — premium keeps
+        # routing to the SAME replica immediately
+        reg = _registry(max_queued=1)
+        with _cluster(net, 1, reg,
+                      throttle_s=0.02) as (router, client, gws):
+            streams = [client.stream([9, 3, 3, i], 24,
+                                     tenant="flood")
+                       for i in range(2)]
+            _wait_for(
+                lambda: gws[0].engine.scheduler.tenant_full("flood"),
+                msg="flood queue to fill")
+            with pytest.raises(GatewayError) as exc:
+                client.generate([9, 3, 1], 2, tenant="flood")
+            assert exc.value.status == 429
+            replica = router._replicas[0]
+            assert replica.tenant_backoff.get("flood", 0) > 0
+            assert replica.backoff_until == 0.0  # replica NOT parked
+            t0 = time.monotonic()
+            out = client.generate([1, 4, 7], 2, tenant="premium")
+            assert out["finish_reason"] == "length"
+            assert time.monotonic() - t0 < 5.0
+            for s in streams:
+                for _ in s:
+                    pass
+
+    def test_failover_replay_preserves_tenant(self, net):
+        n_gen = 24
+        ref_eng = DecodeEngine(net, n_slots=2, decode_chunk=2,
+                               seed=0)
+        ref_id = ref_eng.submit(Request([1, 4, 7, 2], n_gen))
+        ref = ref_eng.run()[ref_id].tokens
+        reg = _registry()
+        with _cluster(net, 2, reg,
+                      throttle_s=0.04) as (router, client, gws):
+            for g in gws:
+                GatewayClient(g.address).generate([2, 2], 2)
+            s = client.stream([1, 4, 7, 2], n_gen, tenant="premium")
+            toks, killed = [], False
+            for d in s:
+                toks.extend(d)
+                if not killed:
+                    addr = router._journal[s.id].replica_address
+                    owner = next(
+                        g for g in gws
+                        if addr == f"{g._service.host}:"
+                                   f"{g._service.port}")
+                    owner.hard_kill()
+                    killed = True
+            assert killed
+            assert toks == ref
+            assert s.result["finish_reason"] == "length"
+            assert s.result["replays"] >= 1
+            assert s.result["tenant"] == "premium"
+            # the survivor billed the SAME tenant on replay
+            survivor = next(g for g in gws if not g._stopped)
+            assert survivor.engine.tenant_stats[
+                "premium"]["admitted"] >= 1
+            audit = router.journal_audit()
+            assert audit["lost"] == [] and audit["open"] == []
+
+    def test_fleet_metrics_carry_both_labels(self, net):
+        reg = _registry()
+        with _cluster(net, 2, reg) as (router, client, _):
+            client.generate([1, 4, 7, 2], 4, tenant="premium")
+            time.sleep(0.3)  # a health tick learns replica ids
+            text = client.fleet_metrics()
+            assert ('serving_ttft_s_bucket{tenant="premium",le='
+                    in text)
+            import re
+            assert re.search(
+                r'serving_ttft_s_bucket\{replica="rep-\d",'
+                r'tenant="premium",le=', text)
+
+
+# ---------------------------------------------------------------------------
+# controller: tenant-scoped SLO accounting
+# ---------------------------------------------------------------------------
+
+class _StubRouter:
+    def __init__(self, metrics_texts):
+        self.tracer = Tracer()
+        self.health_interval_s = 0.1
+        self._texts = list(metrics_texts)
+
+    def replica_status(self):
+        return []
+
+    def fleet_metrics_text(self):
+        return self._texts.pop(0) if self._texts else ""
+
+
+class TestControllerSloTenant:
+    def _text(self, all_values, premium_values):
+        t = Tracer()
+        for v, n in all_values:
+            t.observe("serving_ttft_s", v, n)
+        h = Histogram()
+        for v, n in premium_values:
+            h.observe(v, n)
+        t.register_histogram('serving_ttft_s{tenant="premium"}', h)
+        return t.prometheus_text()
+
+    def test_slo_judged_on_the_promised_tenant(self):
+        from deeplearning4j_tpu.serving import FleetController
+
+        # window 2: the FLOODER's latency explodes while premium
+        # stays fast — a tenant-scoped controller must NOT breach
+        texts = [
+            self._text([(0.01, 10)], [(0.01, 5)]),
+            self._text([(0.01, 10), (10.0, 200)],
+                       [(0.01, 5), (0.02, 5)]),
+        ]
+        c = FleetController(_StubRouter(list(texts)),
+                            ttft_p99_slo_s=0.5,
+                            slo_tenant="premium")
+        assert c._window_ttft_p99() == (None, 0)  # first scrape
+        p99, n = c._window_ttft_p99()
+        assert n == 5
+        assert p99 is not None and p99 <= 0.1
+        # the tenant-blind twin DOES breach on the same scrapes
+        c2 = FleetController(_StubRouter(list(texts)),
+                             ttft_p99_slo_s=0.5)
+        c2._window_ttft_p99()
+        p99_all, n_all = c2._window_ttft_p99()
+        assert n_all == 200  # the flood's window observations
+        assert p99_all is not None and p99_all > 0.5
+
+
+# ---------------------------------------------------------------------------
+# latency_report --tenant + CLI parse
+# ---------------------------------------------------------------------------
+
+class TestTenantLatencyReport:
+    def _federated_text(self):
+        def replica():
+            t = Tracer()
+            t.observe("serving_ttft_s", 0.01)
+            for tid, v in (("premium", 0.02), ("flood", 0.4)):
+                h = Histogram()
+                h.observe(v)
+                h2 = Histogram()
+                h2.observe(2 * v)
+                t.register_histogram(
+                    f'serving_ttft_s{{tenant="{tid}"}}', h)
+                t.register_histogram(
+                    f'serving_e2e_s{{tenant="{tid}"}}', h2)
+            return t.prometheus_text()
+
+        return Tracer.merge_prometheus({"r0": replica(),
+                                        "r1": replica()})
+
+    def test_rows_from_federated_text(self):
+        from scripts.latency_report import tenant_report
+
+        report = tenant_report(self._federated_text())["tenants"]
+        assert sorted(report) == ["flood", "premium"]
+        ttft = next(r for r in report["premium"]
+                    if r["phase"] == "ttft")
+        assert ttft["count"] == 2  # both replicas merged
+        flood = next(r for r in report["flood"]
+                     if r["phase"] == "ttft")
+        assert flood["p99_ms"] > ttft["p99_ms"]
+
+    def test_rows_from_saved_trace(self, tmp_path):
+        from scripts.latency_report import run_tenant_report
+
+        events = [
+            {"ph": "i", "name": "serving.request_done",
+             "args": {"tenant": "premium",
+                      "timing": {"ttft_s": 0.02, "e2e_s": 0.1,
+                                 "queue_wait_s": 0.001,
+                                 "tokens": 6}}},
+            {"ph": "i", "name": "serving.request_done",
+             "args": {"tenant": "flood",
+                      "timing": {"ttft_s": 0.5, "e2e_s": 1.0,
+                                 "queue_wait_s": 0.3,
+                                 "tokens": 4}}},
+            {"ph": "i", "name": "serving.request_done",
+             "args": {"timing": {"ttft_s": 0.1}}},  # tenant-blind
+        ]
+        path = tmp_path / "trace.json"
+        path.write_text(json.dumps({"traceEvents": events}))
+        report = run_tenant_report(str(path))["tenants"]
+        assert sorted(report) == ["flood", "premium"]
+        assert any(r["phase"] == "itl" for r in report["premium"])
+
+    def test_cli_json_shape(self, tmp_path, capsys):
+        from scripts.latency_report import main
+
+        path = tmp_path / "fleet.txt"
+        path.write_text(self._federated_text())
+        assert main([str(path), "--tenant", "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert sorted(out["tenants"]) == ["flood", "premium"]
+
+
+class TestCliTenancy:
+    def test_tenant_and_priority_flags_parse(self):
+        from deeplearning4j_tpu.cli.driver import (
+            build_parser,
+            tenants_from_args,
+        )
+
+        p = build_parser()
+        a = p.parse_args([
+            "serve", "--model", "m.zip",
+            "--tenant", "premium:priority=2:weight=4:slots=4:rps=50",
+            "--tenant", "batch:queue=8"])
+        reg = tenants_from_args(a)
+        assert reg.spec_of("premium").max_slots == 4
+        assert reg.spec_of("batch").max_queued == 8
+        assert tenants_from_args(
+            p.parse_args(["serve", "--model", "m.zip"])) is None
+        c = p.parse_args(["client", "--address", "h:1", "--prompt",
+                          "1,2,3", "--tenant", "premium",
+                          "--priority", "1", "--stream"])
+        assert (c.tenant, c.priority, c.stream) == ("premium", 1,
+                                                    True)
+        f = p.parse_args(["fleet", "--model", "m.zip", "--tenant",
+                          "x:rps=5"])
+        assert f.tenant == ["x:rps=5"]
+        r = p.parse_args(["route", "--replicas", "h:1", "--tenant",
+                          "x:rps=5:burst=9"])
+        assert tenants_from_args(r).spec_of("x").burst == 9.0
+
+    def test_bad_tenant_spec_raises(self):
+        with pytest.raises(ValueError):
+            TenantSpec.parse("name:priority")
+
+
+class TestClientSubcommand:
+    def test_client_generate_against_gateway(self, net, capsys):
+        from deeplearning4j_tpu.cli.driver import main as cli_main
+
+        reg = _registry()
+        eng = DecodeEngine(net, n_slots=2, decode_chunk=2, seed=0,
+                           tenants=reg)
+        with ServingGateway(eng, keepalive_s=0.1) as gw:
+            rc = cli_main(["client", "--address", gw.address,
+                           "--prompt", "1,4,7,2",
+                           "--max-new-tokens", "4",
+                           "--tenant", "premium"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "finish_reason: length" in out
+            assert "tenant: premium" in out
