@@ -2124,6 +2124,179 @@ def bench_fleet_controller_overhead():
     }
 
 
+def bench_kv_transfer():
+    """KV transfer plane rows (ISSUE 14 tentpole).
+
+    Row 1 — ``kv_transfer_warm_admission_speedup``: cross-replica
+    warm admission beats local recompute on a LONG (512-token)
+    prompt. A donor engine warms three distinct 512-token prompts and
+    exports each as a framed binary payload; a cold receiver pays the
+    full-prefill recompute (the control), a second receiver imports
+    the payload first and admits warm. Gates: median warm admission
+    (import wall + TTFT) < median recompute TTFT, ids BIT-IDENTICAL
+    to the donor's (zero retrace asserted on the warm receiver across
+    trials, >= 511 prompt tokens spliced per warm admission).
+
+    Row 2 — ``kv_async_itl_storm_ratio``: decode ITL under an
+    admission storm stays <= ~1.1x idle-admission ITL on the
+    ``async_rounds=True`` engine (the in-engine half of ROADMAP item
+    2: double-buffered dispatch hides the inter-round host gap the
+    storm inflates). Measured as the VICTIM stream's mean ITL
+    ((e2e - ttft)/(tokens-1) — exact, per request; the
+    ``serving_itl_s`` histogram pools every stream's per-round gaps,
+    including the storm's own short requests, and its log buckets
+    quantize p50s at 1.78x steps, so the per-victim mean is the
+    resolvable form of the same measurement), median of 3
+    interleaved idle/storm pairs; the synchronous twin's ratio is
+    annotated as the counterfactual."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import DecodeEngine, Request
+
+    V, width, n_layers, window, bt = 64, 512, 4, 1024, 16
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    prompt_len, n_gen, n_trials = 512, 16, 3
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_trials)]
+    eng_kw = dict(n_slots=2, decode_chunk=8, paged_kv=True,
+                  block_tokens=bt, prefix_cache_rows=4,
+                  prefill_chunk=64, seed=0)
+
+    # --- row 1: warm-import admission vs full-prefill recompute -----
+    donor = DecodeEngine(net, **eng_kw)
+    refs, payloads = [], []
+    for p in prompts:
+        rid = donor.submit(Request(p, n_gen))
+        refs.append(donor.run()[rid].tokens)
+        payloads.append(donor.export_kv(p))
+    if any(pay is None for pay in payloads):
+        _fail_gate("kv donor failed to export a warmed prompt")
+        return []
+    cold = DecodeEngine(net, **eng_kw)
+    warm = DecodeEngine(net, **eng_kw)
+    cold_ttfts, warm_costs = [], []
+    warm_counts = None
+    for i, p in enumerate(prompts):
+        rid = cold.submit(Request(p, n_gen))
+        res = cold.run()[rid]
+        if res.tokens != refs[i]:
+            _fail_gate(f"kv recompute control diverged on prompt {i}")
+        cold_ttfts.append(res.ttft_s)
+        t0 = time.perf_counter()
+        out = warm.import_kv(payloads[i])
+        t_import = time.perf_counter() - t0
+        if not out.get("imported"):
+            _fail_gate(f"kv import declined on prompt {i}: {out}")
+            continue
+        rid = warm.submit(Request(p, n_gen))
+        res = warm.run()[rid]
+        if res.tokens != refs[i]:
+            _fail_gate(f"kv warm-import admission diverged on "
+                       f"prompt {i} — the transfer corrupted ids")
+        if res.prefix_tokens_reused < prompt_len - 1:
+            _fail_gate(
+                f"warm admission reused only "
+                f"{res.prefix_tokens_reused}/{prompt_len - 1} prompt "
+                "tokens — the import did not actually serve it")
+        warm_costs.append(t_import + res.ttft_s)
+        counts = warm.compile_counts()
+        if warm_counts is None:
+            warm_counts = counts  # trial-1 executables
+        elif counts != warm_counts:
+            _fail_gate(f"warm receiver retraced between trials: "
+                       f"{warm_counts} -> {counts}")
+    cold_med = sorted(cold_ttfts)[len(cold_ttfts) // 2]
+    warm_med = sorted(warm_costs)[len(warm_costs) // 2]
+    if warm_med >= cold_med:
+        _fail_gate(
+            f"warm-import admission {warm_med:.3f}s did not beat "
+            f"full-prefill recompute {cold_med:.3f}s on a "
+            f"{prompt_len}-token prompt")
+    row_warm = {
+        "metric": "kv_transfer_warm_admission_speedup",
+        "value": round(cold_med / max(warm_med, 1e-9), 2),
+        "unit": (f"recompute-TTFT over (import + warm-TTFT), median "
+                 f"of {n_trials} distinct {prompt_len}-token "
+                 f"prompts; width-{width} {n_layers}-block "
+                 f"transformer, {window}-window, {bt}-token blocks, "
+                 "bf16"),
+        "vs_baseline": None,  # reference rnnTimeStep has no KV plane
+        "trials": n_trials,
+        "recompute_ttft_ms": round(1e3 * cold_med, 1),
+        "warm_admission_ms": round(1e3 * warm_med, 1),
+        "payload_mb": round(len(payloads[0]) / 2**20, 2),
+        "prefix_tokens_reused": prompt_len - 1,
+        "id_match": 1.0,
+        "compile_counts": warm_counts,
+    }
+
+    # --- row 2: decode ITL under an admission storm (async rounds) --
+    def victim_itl(eng, storm_rng, storm):
+        rid = eng.submit(Request(
+            storm_rng.integers(0, V, 24).tolist(), 256))
+        res = {}
+        fed = 0
+        while eng.has_work():
+            if storm and fed < 24 and eng.scheduler.pending < 2:
+                eng.submit(Request(
+                    storm_rng.integers(0, V, 8).tolist(), 2))
+                fed += 1
+            eng.step(res)
+        r = res[rid]
+        return ((r.timing["e2e_s"] - r.timing["ttft_s"])
+                / (len(r.tokens) - 1))
+
+    storm_kw = dict(n_slots=8, decode_chunk=32, paged_kv=True,
+                    block_tokens=bt, prefill_chunk=8,
+                    admission_policy="decode", seed=0)
+    meds = {}
+    for mode in (True, False):
+        storm_rng = np.random.default_rng(1)
+        eng = DecodeEngine(net, async_rounds=mode, **storm_kw)
+        eng.submit(Request(storm_rng.integers(0, V, 8).tolist(), 34))
+        eng.run()  # compile warm-up, excluded
+        idles, storms = [], []
+        for _ in range(3):
+            idles.append(victim_itl(eng, storm_rng, storm=False))
+            storms.append(victim_itl(eng, storm_rng, storm=True))
+        meds[mode] = (sorted(idles)[1], sorted(storms)[1])
+    idle_med, storm_med = meds[True]
+    # 3 ms absolute slack on top of the 1.1x ratio: CPU-proxy ITLs
+    # sit at ~30 ms where host-scheduler noise alone swings several
+    # percent between identical runs (same spirit as the tenant
+    # soak's fast-mode slack); on a real chip ITLs are ms-scale and
+    # the ratio term dominates
+    if storm_med > 1.1 * idle_med + 3e-3:
+        _fail_gate(
+            f"async-rounds decode ITL under the admission storm is "
+            f"{storm_med * 1e3:.2f}ms vs idle "
+            f"{idle_med * 1e3:.2f}ms (> 1.1x + 3ms slack): "
+            "double-buffered dispatch is not hiding the admission "
+            "gap")
+    row_itl = {
+        "metric": "kv_async_itl_storm_ratio",
+        "value": round(storm_med / idle_med, 3),
+        "unit": ("victim-stream mean ITL under a continuous "
+                 "chunked-admission storm over idle-admission ITL "
+                 "(async_rounds=True, decode-priority, median of 3 "
+                 "interleaved pairs; gate <= 1.1x + 3ms CPU slack)"),
+        "vs_baseline": None,
+        "trials": 3,
+        "idle_itl_ms": round(idle_med * 1e3, 2),
+        "storm_itl_ms": round(storm_med * 1e3, 2),
+        "sync_engine_ratio": round(meds[False][1] / meds[False][0],
+                                   3),
+    }
+    return [row_warm, row_itl]
+
+
 def bench_tenant_qos_overhead():
     """Multi-tenant QoS row (ISSUE 13 acceptance): tenancy must be
     FREE when unused. Single-tenant traffic (every request on the
@@ -2770,6 +2943,7 @@ def main() -> None:
                bench_fleet_trace_overhead,
                bench_fleet_controller_overhead,
                bench_tenant_qos_overhead,
+               bench_kv_transfer,
                bench_observability_overhead,
                bench_train_observability_overhead,
                bench_w2v, bench_dbn, bench_allreduce):

@@ -417,7 +417,8 @@ def gateway_from_args(args):
             tp=getattr(args, "tp", 1),
             use_flash_paged=FLASH_PAGED_MODES[
                 getattr(args, "use_flash_paged", "auto")],
-            tenants=tenants)
+            tenants=tenants,
+            async_rounds=getattr(args, "async_rounds", False))
 
     return ServingGateway.boot(
         engine, snapshot_path=args.snapshot,
@@ -433,7 +434,8 @@ def gateway_from_args(args):
                 getattr(args, "use_flash_paged", "auto")],
             "tenants": tenants},
         host=args.host, port=args.port,
-        replica_id=getattr(args, "replica_id", None))
+        replica_id=getattr(args, "replica_id", None),
+        role=getattr(args, "role", "any"))
 
 
 def router_from_args(args):
@@ -493,6 +495,8 @@ def _serve_child_argv(args, port: int, replica_id: str):
         argv += ["--tp", str(args.tp)]
     if getattr(args, "use_flash_paged", "auto") != "auto":
         argv += ["--use-flash-paged", args.use_flash_paged]
+    if getattr(args, "async_rounds", False):
+        argv += ["--async-rounds"]
     for spec in getattr(args, "tenant", None) or []:
         # every replica enforces the same tenant table the router
         # rate-limits by — quotas and priorities are fleet-wide
@@ -786,6 +790,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "= force kernel (TPU), off = gather always, "
                         "interpret = kernel via the pallas "
                         "interpreter (CPU parity testing)")
+    s.add_argument("--role", default="any",
+                   choices=("any", "prefill", "decode"),
+                   help="disaggregation role (ISSUE 14): prefill = "
+                        "admission-heavy tier + warm-KV donor, "
+                        "decode = long-decode tier that pulls KV on "
+                        "miss, any = role-blind")
+    s.add_argument("--async-rounds", action="store_true",
+                   help="double-buffer decode rounds (ISSUE 14): "
+                        "round N's token fetch defers to the next "
+                        "step so the inter-round host gap overlaps "
+                        "device compute (ids stay bit-identical)")
     s.add_argument("--snapshot", default=None,
                    help="drain-snapshot path: written on shutdown, "
                         "restored on boot when present")
@@ -844,6 +859,9 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--paged-kv", action="store_true")
     fl.add_argument("--block-tokens", type=int, default=16)
     fl.add_argument("--kv-blocks", type=int, default=None)
+    fl.add_argument("--async-rounds", action="store_true",
+                    help="double-buffered decode rounds on every "
+                         "replica (ISSUE 14)")
     fl.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel shards per replica (every "
                          "replica serves at the same width)")

@@ -463,6 +463,16 @@ class Tracer:
         with self._lock:
             self._last[name] = float(value)
 
+    def drop_gauge(self, name: str) -> bool:
+        """Retire a last-value track: the name stops appearing in
+        :meth:`prometheus_text` until something writes it again
+        (ISSUE 14 satellite — a tenant whose open-request count
+        dropped to zero must not freeze its per-tenant gauges at the
+        last sample forever). Returns True when the track existed.
+        Event history is untouched — only the scrape table forgets."""
+        with self._lock:
+            return self._last.pop(name, None) is not None
+
     def rate(self, name: str, count: float, seconds: float) -> None:
         """Counter expressed as events/sec over a measured window —
         the serving engine's tokens/sec stream
@@ -515,6 +525,15 @@ class Tracer:
         with self._lock:
             self._hists[name] = hist
         return hist
+
+    def drop_histogram(self, name: str) -> bool:
+        """Retire a registered histogram track (the labeled-twin
+        counterpart of :meth:`drop_gauge` — ISSUE 14 satellite: a
+        retired tenant's ``family{tenant=...}`` histogram families
+        must stop scraping, not freeze forever). Returns True when
+        the track existed."""
+        with self._lock:
+            return self._hists.pop(name, None) is not None
 
     def histogram(self, name: str) -> Optional[Histogram]:
         with self._lock:

@@ -25,7 +25,12 @@ the tensor-parallel sharded decode engine: ``DecodeEngine(tp=N)``
 turns the decode/verify/chunk executables into ``shard_map`` programs
 over attention heads with per-shard head-sliced KV (bytes = total/TP)
 behind the SAME layout-invariant host BlockTable, paired with a fused
-pallas paged-attention decode kernel (ISSUE 12 tentpole)."""
+pallas paged-attention decode kernel (ISSUE 12 tentpole) — and the
+KV transfer plane: disaggregated prefill/decode roles with
+cross-replica shipping of warmed KV blocks (framed binary
+export/import, width-invariant across TP donors) and async
+double-buffered decode rounds (ISSUE 14 tentpole,
+``async_rounds=True`` / router ``kv_transfer=True``)."""
 
 from deeplearning4j_tpu.serving.block_pool import BlockPool, BlockTable
 from deeplearning4j_tpu.serving.controller import FleetController
@@ -47,8 +52,14 @@ from deeplearning4j_tpu.serving.faults import (
     ManualClock,
 )
 from deeplearning4j_tpu.serving.gateway import (
+    ROLES,
     STATUS_OF_REASON,
     ServingGateway,
+)
+from deeplearning4j_tpu.serving.kv_transfer import (
+    KVTransferError,
+    pack_prefix,
+    unpack_prefix,
 )
 from deeplearning4j_tpu.serving.router import (
     REPLICA_STATES,
@@ -94,6 +105,7 @@ __all__ = [
     "GatewayError",
     "GatewayStream",
     "GenerationResult",
+    "KVTransferError",
     "LocalReplica",
     "ManualClock",
     "NgramDraftTable",
@@ -101,6 +113,7 @@ __all__ = [
     "PagedPrefixCache",
     "PrefixHit",
     "REPLICA_STATES",
+    "ROLES",
     "RadixPrefixCache",
     "Request",
     "RouterClient",
@@ -116,5 +129,7 @@ __all__ = [
     "ServingGateway",
     "ServingRouter",
     "greedy_acceptance",
+    "pack_prefix",
     "sample_tokens",
+    "unpack_prefix",
 ]

@@ -381,6 +381,62 @@ class GatewayClient:
     def metrics(self) -> str:
         return self._get_text("/v1/metrics")
 
+    # -- KV transfer plane (ISSUE 14) ----------------------------------
+    #: query-string token cap: http.server rejects request lines over
+    #: 64 KiB with 414, so a very long prompt ships only its leading
+    #: tokens — SAFE, because any cached prefix of a truncated prompt
+    #: is a cached prefix of the full prompt (the radix-trie prefix
+    #: property), and real exports are window-bounded far below this
+    KV_EXPORT_QUERY_TOKENS = 8000
+
+    def kv_export(self, tokens: List[int]) -> Optional[bytes]:
+        """``GET /v1/kv/export?tokens=...`` — the replica's longest
+        cached prefix of ``tokens`` as a framed binary payload
+        (serving/kv_transfer.py wire format), or ``None`` on 404
+        (nothing cached / not a paged engine — the soft miss the
+        router's recompute fallback absorbs). Other non-200s raise.
+        Prompts past :data:`KV_EXPORT_QUERY_TOKENS` query on their
+        leading tokens only (see the cap's note)."""
+        path = ("/v1/kv/export?tokens="
+                + ",".join(str(int(t)) for t
+                           in tokens[:self.KV_EXPORT_QUERY_TOKENS]))
+        conn = self._connect()
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status == 404:
+                return None
+            if resp.status != 200:
+                try:
+                    data = json.loads(raw) if raw else {}
+                except ValueError:
+                    data = {"body": raw[:256].decode("latin-1")}
+                raise GatewayError(resp.status, data)
+            return raw
+        finally:
+            conn.close()
+
+    def kv_import(self, payload: bytes) -> Dict[str, Any]:
+        """``POST /v1/kv/import`` (raw binary body) — splice a peer's
+        exported prefix into this replica's pool + trie. Returns the
+        import summary (``imported`` False = soft decline); raises
+        :class:`GatewayError` on 400/413/503."""
+        conn = self._connect()
+        try:
+            conn.request(
+                "POST", "/v1/kv/import", body=payload,
+                headers={"Content-Type": "application/octet-stream",
+                         "Content-Length": str(len(payload))})
+            resp = conn.getresponse()
+            raw = resp.read()
+            data = json.loads(raw) if raw else {}
+            if resp.status != 200:
+                raise GatewayError(resp.status, data)
+            return data
+        finally:
+            conn.close()
+
     def drain(self, timeout_s: Optional[float] = None
               ) -> Dict[str, Any]:
         body = {} if timeout_s is None else {"timeout_s": timeout_s}

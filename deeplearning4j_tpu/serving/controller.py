@@ -429,20 +429,42 @@ class FleetController:
     def _warm(self, handle) -> Optional[int]:
         """The boot-with-warmup handshake: live affinity keys from
         the router journal into the new replica's prefix cache,
-        BEFORE any keyspace shifts onto it."""
+        BEFORE any keyspace shifts onto it.
+
+        ISSUE 14: warmup now ships KV instead of regenerating it —
+        the router's ``warm_transfer`` pulls each key's warm peer
+        export and imports it into the newcomer (blocks move, no
+        prefill runs). Prompts the transfer plane cannot cover (no
+        capable donor, dense newcomer, transfer fault) fall back to
+        the PR 11 greedy-generation ``/v1/warmup`` handshake, so the
+        newcomer is never LESS warm than before."""
         prompts = self.router.live_affinity_prompts(
             cap=self.warm_prompts_cap)
         if not prompts:
             return 0
+        warmed = 0
+        cold = prompts
+        transfer = getattr(self.router, "warm_transfer", None)
+        if transfer is not None:
+            try:
+                out = transfer(handle.address, prompts,
+                               receiver_id=handle.replica_id)
+                warmed += int(out.get("imported", 0))
+                cold = out.get("cold", prompts)
+            except Exception:
+                self.tracer.incr("fleet_warmup_errors")
+                cold = prompts
+        if not cold:
+            return warmed
         try:
             out = GatewayClient(
-                handle.address, timeout_s=60.0).warmup(prompts)
-            return int(out.get("warmed", 0))
+                handle.address, timeout_s=60.0).warmup(cold)
+            return warmed + int(out.get("warmed", 0))
         except Exception:
             # a cold cache is a performance bug, not a correctness
             # one: join anyway
             self.tracer.incr("fleet_warmup_errors")
-            return None
+            return warmed if warmed else None
 
     def _await_live(self, replica_id: str) -> None:
         """Block until the router's health loop marks the new replica

@@ -214,6 +214,27 @@ class _Pending:
         return len(self.seq) - self.matched - self.done
 
 
+@dataclasses.dataclass
+class _InflightRound:
+    """One dispatched-but-unlanded decode round (``async_rounds=True``,
+    ISSUE 14): the device arrays whose fetch was deferred to the next
+    ``step()``, plus everything the landing needs to commit them. The
+    ``rids`` map guards against slots whose request was cancelled or
+    deadline-evicted between dispatch and landing — their rows are
+    discarded, neighbours are untouched (the same per-row independence
+    idle slots ride on)."""
+
+    active: List[int]
+    rids: Dict[int, int]              # slot -> request id at dispatch
+    drafts: Optional[Dict[int, List[int]]]
+    verify_out: Optional[Tuple]       # (lens, emitted, acc) or None
+    seq: Any                          # device [B, chunk], unfetched
+    t0: float                         # perf_counter at dispatch start
+    td0: float                        # phase clock at decode dispatch
+    dispatch_end: float               # phase clock after dispatch
+    ver_dt: float                     # verify dispatch wall
+
+
 class _PhaseClock:
     """Host-side per-request phase clock (ISSUE 7 tentpole): every
     request accumulates a monotone, DISJOINT-interval phase breakdown
@@ -357,6 +378,22 @@ SERVING_TRACK_HELP = {
                              "tenant evicted for a waiting "
                              "same-or-higher-priority arrival; "
                              "tenancy-enabled engines only)",
+    "serving_kv_import_s": "cross-replica KV import wall time "
+                           "(device scatter + trie seed per shipped "
+                           "prefix; ISSUE 14)",
+    "serving_admission_warm_s": "admission device-work wall for "
+                                "requests that reused a cached "
+                                "prefix (splice/fetch + suffix "
+                                "prefill) — the warm half of the "
+                                "warm-vs-recompute comparison",
+    "serving_admission_cold_s": "admission device-work wall for "
+                                "requests prefilled from scratch — "
+                                "the recompute half of the "
+                                "warm-vs-recompute comparison",
+    "serving_kv_exports": "warmed prefixes exported to peers "
+                          "(ISSUE 14 KV transfer plane)",
+    "serving_kv_imports": "warmed prefixes imported from peers "
+                          "(ISSUE 14 KV transfer plane)",
 }
 
 
@@ -514,11 +551,22 @@ class DecodeEngine:
     shed/preempted counters gain ``{tenant=...}`` labeled twins, and
     ``GenerationResult.tenant`` echoes the billed tenant.
 
+    ``async_rounds=True`` (ISSUE 14; default off = the synchronous
+    engine) double-buffers ``step()``: a dispatched decode round's
+    token fetch defers to the START of the next ``step()`` — landed
+    before any scheduling decision, so ids (greedy AND sampling) are
+    bit-identical and the executable set is unchanged, while the
+    inter-round host gap (lock yields, submit handling) overlaps
+    device compute instead of inflating decode ITL under admission
+    storms (``bench_kv_transfer`` row 2). ``export_kv``/``import_kv``
+    ship warmed prefixes across replicas (serving/kv_transfer.py).
+
     ``snapshot()``/``DecodeEngine.restore()`` round-trip the full
     host-side state through a plain dict and rebuild device KV state
     by re-prefilling recorded tokens — crash recovery that finishes
     the same ids. The tenant registry rides the snapshot, so a
-    drained engine restores its quotas.
+    drained engine restores its quotas. An async engine lands its
+    in-flight round before snapshotting.
 
     An optional ``profiler.tracer.Tracer`` receives prefill/admit/
     decode/prefix-fetch spans plus per-round counters (admitted,
@@ -564,6 +612,12 @@ class DecodeEngine:
     #: model; the knob exists so a draft-model source can slot in later
     DRAFT_SOURCES = ("ngram",)
 
+    #: idle rounds before a retired tenant's LABELED HISTOGRAM tracks
+    #: drop from the scrape (ISSUE 14 satellite): long enough that
+    #: any real scrape cadence sees the tenant's final distributions,
+    #: short enough that a churning population stays bounded
+    TENANT_HIST_RETIRE_ROUNDS = 4096
+
     #: stats keys that count failure events (each mirrors into a
     #: cumulative tracer track named ``serving_<key>``)
     FAILURE_KEYS = ("deadline_expired", "queue_timeouts", "cancelled",
@@ -598,7 +652,8 @@ class DecodeEngine:
                  flight_recorder: int = 256,
                  tp: int = 1,
                  use_flash_paged=None,
-                 tenants: Optional[TenantRegistry] = None):
+                 tenants: Optional[TenantRegistry] = None,
+                 async_rounds: bool = False):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
@@ -832,8 +887,23 @@ class DecodeEngine:
                 for name in ("serving_ttft_s", "serving_itl_s",
                              "serving_queue_wait_s", "serving_round_s",
                              "serving_e2e_s",
-                             "serving_tp_dispatch_s")}
+                             "serving_tp_dispatch_s",
+                             "serving_kv_import_s",
+                             "serving_admission_warm_s",
+                             "serving_admission_cold_s")}
         self.describe_metrics()
+        # -- async double-buffered rounds (ISSUE 14; default off =
+        # the bit-identical synchronous engine): round N's token
+        # fetch defers to the START of the next step(), so the
+        # inter-round host gap (gateway lock yields, submit handling,
+        # scheduler work) overlaps device compute instead of adding
+        # to decode ITL. Every scheduling decision still sees exactly
+        # the state the synchronous engine would — landing happens
+        # before admission/eviction each round — so greedy AND
+        # sampling ids are bit-identical (tested) and the executable
+        # set is unchanged.
+        self.async_rounds = bool(async_rounds)
+        self._inflight: Optional[_InflightRound] = None
 
         self._key = jax.random.key(seed)
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
@@ -867,6 +937,11 @@ class DecodeEngine:
             "cow_copies": 0, "prefix_blocks_spliced": 0,
             "frag_tokens": 0, "preempted": 0,
             "paged_admit_deferred": 0, "qos_preempted": 0,
+            # KV transfer plane (ISSUE 14): cross-replica prefix
+            # shipping counters (nonzero only when export/import run)
+            "kv_exports": 0, "kv_exported_tokens": 0,
+            "kv_imports": 0, "kv_imported_tokens": 0,
+            "kv_imported_blocks": 0, "kv_import_declined": 0,
         }
         for key in self.FAILURE_KEYS:
             self.stats[key] = 0
@@ -1048,9 +1123,49 @@ class DecodeEngine:
                 return jax.lax.dynamic_update_slice(
                     toks, tok1.astype(toks.dtype), (slot,))
 
+            def kv_import(pool, new, ids):
+                # KV transfer import (ISSUE 14): scatter shipped
+                # block stacks [n, bt, H, dh] into the pool at the
+                # freshly-allocated ids; pad lanes carry an
+                # out-of-range id and drop. One executable per pow2
+                # block-count bucket (serving/kv_transfer.py pads),
+                # the engine's standing compile discipline. Under tp
+                # the shipped leaves shard on their head axis exactly
+                # like the pool (same pk/pv key paths).
+                out = {}
+                for name, st in pool.items():
+                    npk = new[name]["pk"].astype(st["pk"].dtype)
+                    npv = new[name]["pv"].astype(st["pv"].dtype)
+                    out[name] = {
+                        "pk": st["pk"].at[ids].set(npk, mode="drop"),
+                        "pv": st["pv"].at[ids].set(npv, mode="drop"),
+                    }
+                return out
+
+            def kv_gather(pool, ids):
+                # KV transfer export (ISSUE 14): pull the selected
+                # blocks [W, bt, H, dh] out of the pool so only the
+                # exported slice crosses to host (a whole-pool host
+                # copy would scale with pool size, not export size,
+                # under the engine lock). Pad ids are out of range
+                # and fill zero; one executable per pow2 bucket,
+                # like the import twin.
+                out = {}
+                for name, st in pool.items():
+                    out[name] = {
+                        "pk": jnp.take(st["pk"], ids, axis=0,
+                                       mode="fill", fill_value=0),
+                        "pv": jnp.take(st["pv"], ids, axis=0,
+                                       mode="fill", fill_value=0),
+                    }
+                return out
+
             self._scatter_jit = self._jit(scatter_row,
                                           donate_argnums=(0,))
             self._tok_jit = self._jit(put_tok)
+            self._kv_import_jit = self._jit(kv_import,
+                                            donate_argnums=(0,))
+            self._kv_gather_jit = self._jit(kv_gather)
         self._health_jit = None
         if self.paranoid and self.paged_kv:
             vocab = self.vocab
@@ -1113,6 +1228,8 @@ class DecodeEngine:
         if self.paged_kv:
             counts["paged_scatter"] = n(self._scatter_jit)
             counts["paged_tok"] = n(self._tok_jit)
+            counts["kv_import"] = n(self._kv_import_jit)
+            counts["kv_gather"] = n(self._kv_gather_jit)
             counts.update(self.block_pool.compile_counts())
         if self.prefix_cache is not None:
             counts.update(self.prefix_cache.compile_counts())
@@ -1638,6 +1755,36 @@ class DecodeEngine:
             tabs.extend(self.prefix_cache._payloads.values())
         self.stats["frag_tokens"] = pool.fragmentation_tokens(tabs)
 
+    # -- cross-replica KV transfer (ISSUE 14) --------------------------
+    def export_kv(self, prompt,
+                  cap_bytes: Optional[int] = None) -> Optional[bytes]:
+        """Serialize the longest cached prefix of ``prompt`` as a
+        framed binary payload any peer replica can import
+        (serving/kv_transfer.py). None when nothing reusable is
+        cached or the engine is not paged; ``cap_bytes`` raises
+        :class:`~deeplearning4j_tpu.serving.kv_transfer
+        .KVTransferTooLarge` from size arithmetic BEFORE any device
+        gather. Layout-invariant: a TP=N engine exports full logical
+        blocks (host reassembly), so the receiver's width need not
+        match."""
+        from deeplearning4j_tpu.serving.kv_transfer import export_prefix
+
+        return export_prefix(self, prompt, cap_bytes=cap_bytes)
+
+    def import_kv(self, payload: bytes):
+        """Splice a peer's exported prefix into this engine's pool
+        and radix trie; the next admission of that prompt splices it
+        exactly like a locally-computed entry (greedy bit-parity
+        gated in tests/test_kv_transfer.py). Declines softly
+        (``imported: False``) under pool/trie pressure; raises
+        :class:`~deeplearning4j_tpu.serving.kv_transfer
+        .KVTransferError` on a malformed frame or geometry mismatch —
+        either way the caller's recompute path still covers
+        correctness."""
+        from deeplearning4j_tpu.serving.kv_transfer import import_prefix
+
+        return import_prefix(self, payload)
+
     def _one_hot_prompt(self, prompt, bucket):
         x = np.zeros((1, self.vocab, bucket), np.float32)
         x[0, list(prompt), np.arange(len(prompt))] = 1.0
@@ -1920,6 +2067,15 @@ class DecodeEngine:
             self._observe("serving_ttft_s", ttft)
             self._observe_tenant("serving_ttft_s", request.tenant,
                                  ttft)
+            # warm-vs-recompute admission comparison (ISSUE 14): the
+            # attempt's accumulated admission device work, split by
+            # whether a cached prefix (local OR imported) was reused
+            phases = clock.attempts[-1]["phases"]
+            adm = (phases.get("admit_cold", 0.0)
+                   + phases.get("admit_chunk", 0.0)
+                   + phases.get("admit_fetch", 0.0))
+            self._observe("serving_admission_warm_s" if pending.matched
+                          else "serving_admission_cold_s", adm)
         state = _Slot(request, [first], prefix_reused=pending.matched,
                       ttft_s=ttft, hit_row=hit_row)
         self.stats["tokens_generated"] += 1
@@ -2379,16 +2535,148 @@ class DecodeEngine:
 
     # -- the serving loop ----------------------------------------------
     def has_work(self) -> bool:
-        """True while anything is queued, admitting, decoding, or
-        waiting out a retry backoff."""
+        """True while anything is queued, admitting, decoding,
+        waiting out a retry backoff, or dispatched-but-unlanded
+        (async rounds)."""
         return bool(self.scheduler.pending or self._pending
-                    or self._requeue
+                    or self._requeue or self._inflight is not None
                     or any(s is not None for s in self._slots))
 
     def _drain_terminal(self, results: Dict[int, GenerationResult]):
         if self._terminal:
             results.update(self._terminal)
             self._terminal.clear()
+
+    def _land_round(self, inf: _InflightRound) -> None:
+        """Commit one dispatched decode round: fetch the tokens (the
+        sync point), mirror paged table advances, run the paranoid
+        sweep, append/stream committed tokens, finish/evict, and do
+        the round's accounting. Synchronous engines call this inline
+        right after dispatch (behavior identical to the pre-ISSUE-14
+        engine); ``async_rounds`` engines call it at the START of the
+        next ``step()``, before any scheduling decision, which is what
+        keeps ids bit-identical while the fetch overlaps the
+        inter-step host gap.
+
+        Slots whose request was cancelled or deadline-evicted between
+        dispatch and landing (async mode only — handler threads share
+        the engine lock between steps) are skipped via the ``rids``
+        guard: their rows are discarded, and the blocks their
+        in-flight writes touched were either still table-mapped
+        (harmless overwrite of live positions' successors, masked by
+        ``filled``) or freed-but-unreallocated (nothing allocates
+        between dispatch and landing)."""
+        t_sync0 = self._clock() if self.record_timing else 0.0
+        seq = np.asarray(inf.seq)
+        v_n = None
+        v_rows = None
+        if inf.verify_out is not None:
+            live_drafts = {
+                s: d for s, d in inf.drafts.items()
+                if (self._slots[s] is not None
+                    and self._slots[s].request.id == inf.rids.get(s))}
+            v_rows, v_n = self._land_verify(live_drafts,
+                                            *inf.verify_out)
+        ver_dt = inf.ver_dt
+        # decode attribution: dispatch wall + sync wall — in sync
+        # mode the fetch already happened inside the dispatch window
+        # so the second term is ~0 and this equals the pre-ISSUE-14
+        # measurement; in async mode the inter-step gap is EXCLUDED
+        # (it belongs to no phase — the device was working, the host
+        # was elsewhere), keeping phase sums <= e2e.
+        dec_dt = ((inf.dispatch_end - inf.td0)
+                  + (self._clock() - t_sync0)
+                  if self.record_timing else 0.0)
+        if self.tp > 1 and self.record_timing:
+            # sharded-dispatch wall (ISSUE 12): the decode (and
+            # chained verify) round-trips through the shard_map
+            # executables — per-dispatch, not per-token, so the
+            # histogram reads as "what does one TP round cost"
+            self._observe("serving_tp_dispatch_s", dec_dt)
+            if ver_dt:
+                self._observe("serving_tp_dispatch_s", ver_dt)
+        active = [s for s in inf.active
+                  if self._slots[s] is not None
+                  and self._slots[s].request.id == inf.rids.get(s)]
+        if v_rows is not None:
+            rows = [list(v_rows[s][:int(v_n[s])]) + list(seq[s])
+                    for s in range(self.n_slots)]
+        else:
+            rows = seq
+        dt = time.perf_counter() - inf.t0
+        if self.paged_kv:
+            # mirror the device-side filled advance (decode chunk
+            # + verify's accepted+bonus) into the host tables, and
+            # release blocks that slid out of every window — the
+            # "pop blocks" half of the paged rewind contract
+            for slot in active:
+                tab = self._kv_tabs[slot]
+                tab.length += self.decode_chunk + (
+                    int(v_n[slot]) if v_n is not None else 0)
+                self._free_expired_blocks(tab)
+        if self.paranoid:
+            active = self._quarantine(active)
+        emitted = 0
+        round_usage: Dict[str, int] = {}
+        for slot in active:
+            state = self._slots[slot]
+            appended = []
+            for tok in rows[slot]:
+                state.tokens.append(int(tok))
+                appended.append(int(tok))
+                emitted += 1
+                if self._finished(state):
+                    break
+            if self.tenants is not None and appended:
+                tenant = state.request.tenant
+                round_usage[tenant] = (
+                    round_usage.get(tenant, 0) + len(appended))
+                self._tenant_count(tenant, "tokens_generated",
+                                   len(appended))
+            # deltas flow AFTER the paranoid sweep filtered
+            # ``active`` (a quarantined slot's round never streams)
+            # and cover the admission's first token too — the
+            # diff-based high-water mark picks it up here, where
+            # this round's health verdict is already in
+            self._note_progress(state)
+            if self.record_timing and appended:
+                clock = self._clocks.get(state.request.id)
+                if clock is not None:
+                    now_c = self._clock()
+                    if ver_dt:
+                        clock.add(now_c, "verify", ver_dt)
+                    clock.add(now_c, "decode", dec_dt)
+                    if clock.last_commit_t is not None:
+                        gap = ((now_c - clock.last_commit_t)
+                               / len(appended))
+                        self._observe("serving_itl_s", gap,
+                                      n=len(appended))
+                        self._observe_tenant(
+                            "serving_itl_s",
+                            state.request.tenant, gap,
+                            n=len(appended))
+                    clock.last_commit_t = now_c
+                    clock.rounds += 1
+                    clock.event(now_c, "commit", n=len(appended))
+            if self._finished(state):
+                self._finish(state, slot)
+            elif self.spec is not None:
+                # committed ids extend the slot's n-gram context;
+                # finished slots dropped theirs in _evict_slot
+                self.spec.extend(slot, appended)
+        self.stats["tokens_generated"] += emitted
+        self.stats["decode_time_s"] += dt
+        self.stats["chunks"] += 1
+        if self.tenants is not None and round_usage:
+            # committed decode tokens charge each tenant's
+            # deficit: the fair share is tokens, not admissions
+            self.scheduler.note_usage(round_usage)
+        occ = len(active) / self.n_slots
+        self.stats["occupancy_sum"] += occ
+        if self.tracer is not None:
+            self.tracer.counter("slot_occupancy", occ)
+            self.tracer.rate("serving_tokens_per_sec", emitted, dt)
+            self._emit_counters()
 
     def step(self, results: Optional[Dict[int, GenerationResult]] = None
              ) -> Dict[int, GenerationResult]:
@@ -2401,6 +2689,18 @@ class DecodeEngine:
         accumulate into (and are returned via) ``results``."""
         if results is None:
             results = {}
+        if self._inflight is not None:
+            # async double-buffered rounds (ISSUE 14): land the round
+            # the PREVIOUS step dispatched before any of this round's
+            # scheduling. Everything below — admission, eviction, QoS,
+            # draft planning — then sees exactly the state the
+            # synchronous engine would at the same point, so ids are
+            # bit-identical; only the host's observation of the round
+            # moved, letting the inter-step gap (gateway lock yields,
+            # submit handling) overlap device compute instead of
+            # inflating decode ITL under admission storms.
+            inf, self._inflight = self._inflight, None
+            self._land_round(inf)
         # phase-clock round anchors (ISSUE 7): the pre-decode gap —
         # sweeps, fault handling, OTHER requests' admission chunks —
         # is the "stall" phase of every slot that was already running
@@ -2573,102 +2873,35 @@ class DecodeEngine:
                     self._params, self._state, pool_op,
                     self._toks, jnp.asarray(self._temps),
                     jnp.asarray(self._top_ks), self._next_key())
-                seq = np.asarray(seq)  # [B, chunk]; forces the whole
-                #                        round (verify included) done
-            dec_dt = (self._clock() - td0 if self.record_timing
-                      else 0.0)
-            if self.tp > 1 and self.record_timing:
-                # sharded-dispatch wall (ISSUE 12): the decode (and
-                # chained verify) round-trips through the shard_map
-                # executables — per-dispatch, not per-token, so the
-                # histogram reads as "what does one TP round cost"
-                self._observe("serving_tp_dispatch_s", dec_dt)
-                if ver_dt:
-                    self._observe("serving_tp_dispatch_s", ver_dt)
+                if not self.async_rounds:
+                    seq = np.asarray(seq)  # [B, chunk]; forces the
+                    #               whole round (verify included) done
             self._pool = self._strip_pool(pool_op)
-            if verify_out is not None:
-                v_rows, v_n = self._land_verify(drafts, *verify_out)
-                rows = [list(v_rows[s][:int(v_n[s])]) + list(seq[s])
-                        for s in range(self.n_slots)]
+            inf = _InflightRound(
+                active=list(active),
+                rids={s: self._slots[s].request.id for s in active},
+                drafts=drafts, verify_out=verify_out, seq=seq,
+                t0=t0, td0=td0,
+                dispatch_end=(self._clock() if self.record_timing
+                              else 0.0),
+                ver_dt=ver_dt)
+            if self.async_rounds:
+                # round N's fetch waits for the NEXT step: stash the
+                # dispatched round and return. The round-time
+                # histogram observes the DISPATCH wall here (the
+                # landing belongs to the next round's timeline — the
+                # phase clock's disjoint-interval invariant holds
+                # because decode attribution at landing covers only
+                # dispatch + sync walls, never the inter-step gap).
+                self._inflight = inf
+                if self.record_timing:
+                    self._observe("serving_round_s",
+                                  inf.dispatch_end - rt0)
             else:
-                rows = seq
-            dt = time.perf_counter() - t0
-            if self.paged_kv:
-                # mirror the device-side filled advance (decode chunk
-                # + verify's accepted+bonus) into the host tables, and
-                # release blocks that slid out of every window — the
-                # "pop blocks" half of the paged rewind contract
-                for slot in active:
-                    tab = self._kv_tabs[slot]
-                    tab.length += self.decode_chunk + (
-                        int(v_n[slot]) if verify_out is not None
-                        else 0)
-                    self._free_expired_blocks(tab)
-            if self.paranoid:
-                active = self._quarantine(active)
-            emitted = 0
-            round_usage: Dict[str, int] = {}
-            for slot in active:
-                state = self._slots[slot]
-                appended = []
-                for tok in rows[slot]:
-                    state.tokens.append(int(tok))
-                    appended.append(int(tok))
-                    emitted += 1
-                    if self._finished(state):
-                        break
-                if self.tenants is not None and appended:
-                    tenant = state.request.tenant
-                    round_usage[tenant] = (
-                        round_usage.get(tenant, 0) + len(appended))
-                    self._tenant_count(tenant, "tokens_generated",
-                                       len(appended))
-                # deltas flow AFTER the paranoid sweep filtered
-                # ``active`` (a quarantined slot's round never streams)
-                # and cover the admission's first token too — the
-                # diff-based high-water mark picks it up here, where
-                # this round's health verdict is already in
-                self._note_progress(state)
-                if self.record_timing and appended:
-                    clock = self._clocks.get(state.request.id)
-                    if clock is not None:
-                        now_c = self._clock()
-                        if ver_dt:
-                            clock.add(now_c, "verify", ver_dt)
-                        clock.add(now_c, "decode", dec_dt)
-                        if clock.last_commit_t is not None:
-                            gap = ((now_c - clock.last_commit_t)
-                                   / len(appended))
-                            self._observe("serving_itl_s", gap,
-                                          n=len(appended))
-                            self._observe_tenant(
-                                "serving_itl_s",
-                                state.request.tenant, gap,
-                                n=len(appended))
-                        clock.last_commit_t = now_c
-                        clock.rounds += 1
-                        clock.event(now_c, "commit", n=len(appended))
-                if self._finished(state):
-                    self._finish(state, slot)
-                elif self.spec is not None:
-                    # committed ids extend the slot's n-gram context;
-                    # finished slots dropped theirs in _evict_slot
-                    self.spec.extend(slot, appended)
-            self.stats["tokens_generated"] += emitted
-            self.stats["decode_time_s"] += dt
-            self.stats["chunks"] += 1
-            if self.tenants is not None and round_usage:
-                # committed decode tokens charge each tenant's
-                # deficit: the fair share is tokens, not admissions
-                self.scheduler.note_usage(round_usage)
-            if self.record_timing:
-                self._observe("serving_round_s", self._clock() - rt0)
-            occ = len(active) / self.n_slots
-            self.stats["occupancy_sum"] += occ
-            if self.tracer is not None:
-                self.tracer.counter("slot_occupancy", occ)
-                self.tracer.rate("serving_tokens_per_sec", emitted, dt)
-                self._emit_counters()
+                self._land_round(inf)
+                if self.record_timing:
+                    self._observe("serving_round_s",
+                                  self._clock() - rt0)
         if self.paged_kv:
             self._paged_stats_refresh()
         self._round += 1
@@ -2718,6 +2951,18 @@ class DecodeEngine:
         self._emit_tp_gauges()
         self._emit_tenant_gauges()
 
+    def _open_tenants(self) -> set:
+        """Tenants with at least one OPEN request anywhere in the
+        engine (queued, retrying, admitting, or in a slot) — the
+        liveness test the per-tenant gauge retirement keys on."""
+        open_t = {s.request.tenant for s in self._slots
+                  if s is not None}
+        open_t.update(p.request.tenant for p in self._pending)
+        open_t.update(req.tenant for _, req in self._requeue)
+        open_t.update(req.tenant
+                      for req in self.scheduler.queued_requests())
+        return open_t
+
     def _emit_tenant_gauges(self) -> None:
         """Per-tenant labeled copies of the per-round serving
         counters (ISSUE 13): ``serving_tokens_generated{tenant=...}``
@@ -2725,15 +2970,64 @@ class DecodeEngine:
         their unlabeled twins, via ``Tracer.gauge`` (last-value
         table only — no event-log growth per round). The sparse
         failure counters (shed/preempted) get labeled ``incr`` twins
-        at event time instead."""
+        at event time instead.
+
+        RETIREMENT (ISSUE 14 satellite, the PR 13 known fact fixed):
+        a tenant whose open-request count drops to zero gets one
+        final emission round — so a scrape between its last commit
+        and its retirement still sees the closing totals — and is
+        then retired: its ``tenant_stats`` entry and gauge tracks
+        are dropped, instead of freezing at the last sample forever
+        on a server whose tenant population churns."""
         if self.tenants is None or self.tracer is None:
             return
         gauge = getattr(self.tracer, "gauge", self.tracer.counter)
-        for tenant, stats in self.tenant_stats.items():
+        drop = getattr(self.tracer, "drop_gauge", None)
+        open_now = self._open_tenants()
+        was_open = getattr(self, "_tenant_open_last", set())
+        for tenant in list(self.tenant_stats):
+            stats = self.tenant_stats[tenant]
+            if tenant not in open_now and tenant not in was_open:
+                # idle for a full emission round: the closing totals
+                # already went out last round — retire the tracks
+                del self.tenant_stats[tenant]
+                if drop is not None:
+                    for key in stats:
+                        if key in ("shed", "preempted"):
+                            continue
+                        drop(f'serving_{key}{{tenant="{tenant}"}}')
+                continue
             for key, value in stats.items():
                 if key in ("shed", "preempted"):
                     continue  # incr'd (counter-typed) at event time
                 gauge(f'serving_{key}{{tenant="{tenant}"}}', value)
+        self._tenant_open_last = open_now
+        # the labeled HISTOGRAM twins retire too — a churning tenant
+        # population must not grow the scrape without bound — but on
+        # a much LONGER idle horizon than the gauges: latency
+        # distributions are what an operator scrapes minutes later,
+        # so they outlive the tenant by TENANT_HIST_RETIRE_ROUNDS
+        # rounds instead of evaporating two rounds after its last
+        # request (which would beat any real scrape cadence)
+        drop_hist = getattr(self.tracer, "drop_histogram", None)
+        idle = getattr(self, "_tenant_hist_idle", None)
+        if idle is None:
+            idle = self._tenant_hist_idle = {}
+        hist_tenants = {name.rsplit('{tenant="', 1)[-1][:-2]
+                        for name in self._tenant_hists}
+        for tenant in hist_tenants:
+            if tenant in open_now:
+                idle.pop(tenant, None)
+                continue
+            idle[tenant] = idle.get(tenant, 0) + 1
+            if idle[tenant] > self.TENANT_HIST_RETIRE_ROUNDS:
+                idle.pop(tenant)
+                suffix = f'{{tenant="{tenant}"}}'
+                for name in [n for n in self._tenant_hists
+                             if n.endswith(suffix)]:
+                    del self._tenant_hists[name]
+                    if drop_hist is not None:
+                        drop_hist(name)
 
     def _emit_tp_gauges(self) -> None:
         """Per-shard observability (ISSUE 12 satellite): under tp > 1
@@ -2893,6 +3187,14 @@ class DecodeEngine:
         deliberately NOT captured: ``restore`` rebuilds KV state by
         re-prefilling recorded tokens, which is smaller, portable, and
         exactly reproducible."""
+        if self._inflight is not None:
+            # an async engine snapshots LANDED state: commit the
+            # dispatched round first so the wire format carries every
+            # token the device already produced (dropping it would
+            # still restore correctly — greedy recompute — but why
+            # recompute a round that is already done)
+            inf, self._inflight = self._inflight, None
+            self._land_round(inf)
         now = self._clock()
 
         def entry(req: Request) -> Dict[str, Any]:
@@ -2953,6 +3255,7 @@ class DecodeEngine:
                 # restores at any other — restore(tp=...) overrides
                 "tp": self.tp,
                 "use_flash_paged": self.use_flash_paged,
+                "async_rounds": self.async_rounds,
             },
             # paged bookkeeping rides the snapshot for inspection and
             # exact-capacity restores (restore REBUILDS device blocks
@@ -3061,7 +3364,8 @@ class DecodeEngine:
             record_timing=cfg.get("record_timing", True),
             flight_recorder=cfg.get("flight_recorder", 256),
             tp=tp, use_flash_paged=use_flash_paged,
-            tenants=tenants)
+            tenants=tenants,
+            async_rounds=cfg.get("async_rounds", False))
         spec_state = snapshot.get("spec")
         if spec_state and eng.spec is not None:
             # resume K-adaptation where the crash left it (final ids

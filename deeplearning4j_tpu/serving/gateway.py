@@ -94,6 +94,10 @@ from deeplearning4j_tpu.serving.scheduler import (
 )
 from deeplearning4j_tpu.util.httpjson import HttpService, JsonHandler
 
+#: disaggregation roles a replica can declare (ISSUE 14): advisory
+#: placement labels the router folds into its pick + transfer policy
+ROLES = ("any", "prefill", "decode")
+
 #: engine terminal state → HTTP status for the one-shot JSON endpoint
 #: (streaming responses carry the status in the final SSE event)
 STATUS_OF_REASON = {
@@ -158,6 +162,8 @@ class _GatewayHandler(JsonHandler):
             self.gateway._handle_drain(self)
         elif path == "/v1/warmup":
             self.gateway._handle_warmup(self)
+        elif path == "/v1/kv/import":
+            self.gateway._handle_kv_import(self)
         else:
             self.send_json({"error": f"no such endpoint {path}"}, 404,
                            close=True)
@@ -166,6 +172,8 @@ class _GatewayHandler(JsonHandler):
         path, _, query = self.path.partition("?")
         if path == "/v1/healthz":
             self.send_json(self.gateway._health(), 200, close=True)
+        elif path == "/v1/kv/export":
+            self.gateway._handle_kv_export(self, query)
         elif path == "/v1/metrics":
             self.send_bytes(self.gateway._metrics_text().encode(),
                             "text/plain; version=0.0.4", 200,
@@ -233,7 +241,9 @@ class ServingGateway:
                  handler_timeout_s: float = 30.0,
                  admission_grace_s: float = 0.0,
                  results_cap: int = 4096,
-                 replica_id: Optional[str] = None):
+                 replica_id: Optional[str] = None,
+                 role: str = "any",
+                 kv_transfer_cap_bytes: Optional[int] = None):
         if engine.on_delta is not None:
             raise ValueError(
                 "engine already has an on_delta consumer; the gateway "
@@ -309,6 +319,25 @@ class ServingGateway:
         self.replica_id = (replica_id if replica_id is not None
                            else f"{self._service.host}:"
                                 f"{self._service.port}")
+        #: disaggregation role (ISSUE 14): advisory placement label
+        #: the router reads from healthz. ``prefill`` = prefers
+        #: admission-heavy traffic and serves as a warm-KV donor;
+        #: ``decode`` = prefers long-decode streams and pulls KV on
+        #: miss; ``any`` (default) = the role-blind PR 9 behavior.
+        if role not in ROLES:
+            raise ValueError(
+                f"role {role!r}: expected one of {ROLES}")
+        self.role = role
+        #: bounded-binary cap for the KV transfer endpoints: an
+        #: oversized import answers 413 before buffering, an export
+        #: larger than this answers 413 instead of shipping
+        if kv_transfer_cap_bytes is None:
+            from deeplearning4j_tpu.serving.kv_transfer import (
+                DEFAULT_CAP_BYTES,
+            )
+
+            kv_transfer_cap_bytes = DEFAULT_CAP_BYTES
+        self.kv_transfer_cap_bytes = int(kv_transfer_cap_bytes)
         # claim the engine's delta hook only AFTER the bind succeeded:
         # a port-in-use OSError above must not leave the engine
         # permanently marked as owned by a gateway that never existed
@@ -853,6 +882,13 @@ class ServingGateway:
             # replica to prove warm traffic landed warm
             "prefix_tokens_reused":
                 eng.stats["prefill_tokens_skipped"],
+            # disaggregation surface (ISSUE 14): the role this
+            # replica declared, and whether its engine can speak the
+            # KV transfer plane (paged + trie — the router reads
+            # this instead of paying a 404 round-trip per miss)
+            "role": self.role,
+            "kv_transfer": bool(eng.paged_kv
+                                and eng.prefix_cache is not None),
         }
 
     def _metrics_text(self) -> str:
@@ -877,6 +913,85 @@ class ServingGateway:
         for key, value in self.stats.items():
             gauge(f"serving_gateway_{key}", value)
         return tracer.prometheus_text()
+
+    # -- KV transfer plane (ISSUE 14) -----------------------------------
+    def _handle_kv_export(self, handler, query: str) -> None:
+        """``GET /v1/kv/export?tokens=1,2,3``: the longest cached
+        prefix of the given prompt as a framed binary payload
+        (serving/kv_transfer.py wire format). 404 when nothing
+        reusable is cached (or the engine is not paged — the caller
+        recomputes), 413 when the payload would exceed the transfer
+        cap, 400 on a malformed query."""
+        tokens: Optional[List[int]] = None
+        for part in query.split("&"):
+            if part.startswith("tokens="):
+                try:
+                    tokens = [int(t)
+                              for t in part[len("tokens="):].split(",")
+                              if t != ""]
+                except ValueError:
+                    tokens = None
+        if not tokens:
+            handler.send_json(
+                {"error": "tokens=<comma-separated ids> required"},
+                400, close=True)
+            return
+        from deeplearning4j_tpu.serving.kv_transfer import (
+            KVTransferTooLarge,
+        )
+
+        with self._engine_access():
+            # a DRAINING replica still exports: the drain-handback
+            # receiver pulling the victim's warm prefix is exactly
+            # the scale-down case the transfer plane exists for —
+            # export is read-only, so it cannot delay the drain
+            if self._stopped:
+                handler.send_json({"error": "stopped"}, 503,
+                                  close=True)
+                return
+            try:
+                # the cap is enforced from block arithmetic BEFORE
+                # any device gather — an over-cap prompt costs
+                # integer math under the lock, not a discarded
+                # device-to-host copy
+                payload = self.engine.export_kv(
+                    tokens, cap_bytes=self.kv_transfer_cap_bytes)
+            except KVTransferTooLarge as e:
+                handler.send_json({"error": str(e)}, 413, close=True)
+                return
+        if payload is None:
+            handler.send_json(
+                {"error": "no cached prefix to export (cold, or "
+                          "not a paged engine)"}, 404, close=True)
+            return
+        handler.send_binary(payload)
+
+    def _handle_kv_import(self, handler) -> None:
+        """``POST /v1/kv/import`` (binary body, content-length capped
+        — util/httpjson ``read_binary``): splice a peer's exported
+        prefix into this engine's pool + trie. 200 with the import
+        summary (``imported`` False = soft decline, stay cold), 400
+        on a malformed frame or geometry mismatch, 413 oversized,
+        503 draining."""
+        payload = handler.read_binary(self.kv_transfer_cap_bytes)
+        if payload is None:
+            return  # read_binary already answered 411/413/400
+        from deeplearning4j_tpu.serving.kv_transfer import (
+            KVTransferError,
+        )
+
+        with self._engine_access():
+            if self._draining or self._stopped:
+                handler.send_json({"error": "draining"}, 503,
+                                  close=True)
+                return
+            try:
+                out = self.engine.import_kv(payload)
+            except KVTransferError as e:
+                handler.send_json({"error": str(e)}, 400, close=True)
+                return
+            self._wake.notify_all()
+        handler.send_json(out, 200, close=True)
 
     # -- boot-with-warmup handshake (ISSUE 11) --------------------------
     #: warmup request cap per call: the handshake primes a cache, it

@@ -171,6 +171,15 @@ class _Replica:
         self.replica_id = self.address
         self.state = "live"  # optimistic until the breaker disagrees
         self.failures = 0
+        #: disaggregation role (ISSUE 14), scraped from healthz:
+        #: ``prefill`` replicas prefer admission-heavy traffic and
+        #: serve as warm-KV donors, ``decode`` replicas prefer
+        #: long-decode streams, ``any`` is the role-blind default
+        self.role = "any"
+        #: whether the replica can speak the KV transfer plane
+        #: (paged engine + prefix trie) — scraped from healthz so a
+        #: dense fleet never pays a 404 round-trip per affinity miss
+        self.kv_capable = False
         self.backoff_until = 0.0  # 429 Retry-After parking
         #: per-TENANT 429 parking (ISSUE 13): a replica's
         #: tenant-scoped 429 (its payload names the tenant) parks
@@ -236,6 +245,8 @@ class _Replica:
             "requests_routed": self.requests_routed,
             "open_requests": self.open_entries,
             "decommissioned": self.decommissioned,
+            "role": self.role,
+            "kv_capable": self.kv_capable,
         }
 
 
@@ -423,6 +434,11 @@ class ServingRouter:
       ON; priced >= 0.97x by ``bench_fleet_trace_overhead``):
       trace-context propagation, router spans, the incremental
       per-replica trace cache, and clock-offset estimation.
+    - ``kv_transfer`` — KV transfer plane master switch (ISSUE 14;
+      default ON, capability-gated per replica via healthz so a
+      dense fleet pays nothing): warm-import on affinity-miss /
+      failover picks whose receiver is cold for the key, with
+      fallback to full recompute on any fault.
     - ``replica_connect_timeout_s`` / ``replica_timeout_s`` — the
       router→replica connect and read bounds (a dead replica must
       fail fast, a healthy stream may idle up to the replica's
@@ -446,7 +462,8 @@ class ServingRouter:
                  journal_cap: int = 4096,
                  fleet_trace: bool = True,
                  tracer=None,
-                 tenants=None):
+                 tenants=None,
+                 kv_transfer: bool = True):
         if not replicas:
             raise ValueError("router needs at least one replica")
         if affinity_block_tokens < 1:
@@ -510,6 +527,34 @@ class ServingRouter:
                 "router_replay_gap_s",
                 "stream-break to first post-replay fresh-token gap "
                 "(replay-added latency per failover)")
+        #: KV transfer plane master switch (ISSUE 14; default ON —
+        #: capability-gated per replica via healthz ``kv_transfer``,
+        #: so a dense fleet pays literally nothing): on an affinity
+        #: miss / failover replay whose receiver is cold for the key,
+        #: the router pulls the warm peer's exported prefix and
+        #: imports it into the receiver BEFORE the attempt; any fault
+        #: falls back to full recompute (correctness never depends on
+        #: the transfer).
+        self.kv_transfer = bool(kv_transfer)
+        #: bounded warm-key map: affinity key -> {replica_id: stamp}
+        #: — which replicas are believed warm for a key (admissions
+        #: routed there, or a completed import). A belief, not a
+        #: contract: a wrong entry costs one recompute, nothing else.
+        self._warm: "Dict[bytes, Dict[str, float]]" = {}
+        self._warm_cap = 1024
+        #: end-to-end transfer wall (export fetch + import push) —
+        #: the ``serving_kv_transfer_s`` row in latency_report
+        #: --fleet (the router appends its own tracks to the
+        #: federation)
+        self._kv_transfer_hist = Histogram()
+        if hasattr(self.tracer, "register_histogram"):
+            self.tracer.register_histogram("serving_kv_transfer_s",
+                                           self._kv_transfer_hist)
+        if hasattr(self.tracer, "describe"):
+            self.tracer.describe(
+                "serving_kv_transfer_s",
+                "cross-replica KV transfer wall (donor export fetch "
+                "+ receiver import push, per shipped prefix)")
         self._lock = threading.RLock()
         self._rids = itertools.count()
         self._journal: Dict[int, _JournalEntry] = {}
@@ -522,6 +567,8 @@ class ServingRouter:
             "replica_faults": 0, "request_faults": 0,
             "disconnect_cancels": 0, "drained_replicas": 0,
             "tenant_throttled": 0, "tenant_backoffs": 0,
+            "kv_transfers": 0, "kv_transfer_failures": 0,
+            "kv_transfer_declined": 0, "kv_transferred_tokens": 0,
         }
         self._stopped = False
         self._service = HttpService(_RouterHandler, host, port,
@@ -809,6 +856,8 @@ class ServingRouter:
             replica.n_slots = int(payload.get("n_slots", 1)) or 1
             replica.prefix_tokens_reused = int(
                 payload.get("prefix_tokens_reused", 0))
+            replica.role = str(payload.get("role") or "any")
+            replica.kv_capable = bool(payload.get("kv_transfer"))
 
     def _note_failure(self, replica: _Replica) -> None:
         """One failed health scrape OR data-plane break: the breaker
@@ -843,6 +892,10 @@ class ServingRouter:
                 if was not in ("dead", "half-open"):
                     self.stats["replica_faults"] += 1
                     self.tracer.incr("router_replica_dead")
+                # a dead replica's warm-key beliefs die with it: a
+                # resurrected process boots cold, and keeping them
+                # would skip the one transfer that could re-warm it
+                self._forget_warm(replica.replica_id)
             elif was == "live":
                 self._breaker_instant(replica, was, "degraded")
                 replica.state = "degraded"
@@ -932,8 +985,18 @@ class ServingRouter:
                     raise _NoReplica()
             key = self._affinity_key(prompt)
             if key is not None:
+                # role-aware ranking (ISSUE 14): ``prefill``-role
+                # replicas are the warm-KV donor tier — they stay out
+                # of the rendezvous ranking for stream OWNERSHIP while
+                # any decode-capable replica is ready (their caches
+                # warm through the transfer plane's export pulls and
+                # direct short-prompt traffic), so long decode streams
+                # land on the decode tier. A fleet of ``any`` roles is
+                # bit-identical to the role-blind PR 9 ranking.
+                pool = ([r for r in ready if r.role != "prefill"]
+                        or ready)
                 ranked = sorted(
-                    ready, reverse=True,
+                    pool, reverse=True,
                     key=lambda r: self._rendezvous_score(
                         key, r.replica_id))
                 chosen = next(
@@ -951,22 +1014,255 @@ class ServingRouter:
                 else:
                     self.stats["affinity_overflow"] += 1
             else:
+                # short prompts (no reusable prefix): least-loaded,
+                # preferring the admission-heavy (non-``decode``)
+                # tier when one exists — the inverse of the affinity
+                # preference above
+                pool = ([r for r in ready if r.role != "decode"]
+                        or ready)
                 self._rr += 1
-                order = (self._rr + i for i in range(len(ready)))
+                order = (self._rr + i for i in range(len(pool)))
                 # live in-flight count first (exact, claimed under
                 # this very lock), scraped load as the tiebreak,
                 # rotation last
                 chosen = min(
-                    zip(ready, order),
+                    zip(pool, order),
                     key=lambda p: (p[0].open_entries,
                                    p[0].queue_depth
                                    + p[0].active_slots,
-                                   p[1] % len(ready)))[0]
+                                   p[1] % len(pool)))[0]
                 info = {"affinity": False, "key": None, "rank": None}
                 self.stats["load_routed"] += 1
             chosen.requests_routed += 1
             chosen.open_entries += 1
             return chosen, info
+
+    # -- KV transfer plane (ISSUE 14) ----------------------------------
+    def _note_warm(self, key: bytes, replica_id: str) -> None:
+        """Record the belief that ``replica_id`` is (about to be)
+        warm for ``key`` — set when an affinity request routes there
+        (its admission inserts the prefix) and when an import lands.
+        A belief, not a contract: a stale entry (replica restarted,
+        trie evicted the key) costs one recompute, never
+        correctness. Caller holds the lock."""
+        warm = self._warm.get(key)
+        if warm is None:
+            warm = self._warm[key] = {}
+            while len(self._warm) > self._warm_cap:
+                self._warm.pop(next(iter(self._warm)))
+        warm[replica_id] = time.monotonic()
+
+    def _forget_warm(self, replica_id: str) -> None:
+        """Drop every warm belief about a replica the breaker just
+        declared dead: a resurrected process boots cold, and a stale
+        belief would skip the one transfer that could re-warm it.
+        Caller holds the lock."""
+        for warm in self._warm.values():
+            warm.pop(replica_id, None)
+
+    #: per-hop read bound for transfer traffic: the plane only buys
+    #: admission latency, so a slow donor must cost LESS than the
+    #: recompute it would have saved — a wedged peer times out in
+    #: seconds, not the data-plane's stream budget
+    KV_TRANSFER_TIMEOUT_S = 3.0
+
+    def _fetch_kv_payload(self, donor: _Replica,
+                          prompt: List[int]) -> Optional[bytes]:
+        """Pull the donor's exported prefix (None = nothing cached).
+        Factored out as the soak's fault-injection seam: truncating
+        the returned payload models a torn transfer."""
+        return self._replica_client(
+            donor,
+            read_timeout_s=self.KV_TRANSFER_TIMEOUT_S).kv_export(
+                prompt)
+
+    def _push_kv_payload(self, receiver_address: str,
+                         payload: bytes) -> Dict[str, Any]:
+        """Push one payload into the receiver (by address — upgrade
+        warmup targets replicas not yet registered). The soak's
+        second fault seam."""
+        return GatewayClient(
+            receiver_address,
+            connect_timeout_s=self.replica_connect_timeout_s,
+            read_timeout_s=self.KV_TRANSFER_TIMEOUT_S).kv_import(
+                payload)
+
+    def _maybe_kv_transfer(self, entry: _JournalEntry,
+                           receiver: _Replica,
+                           forward_ping=lambda: None,
+                           rank: Optional[int] = None) -> None:
+        """The warm-import hook (ISSUE 14 tentpole): called after
+        ``_pick`` and before the attempt, when the chosen replica is
+        believed COLD for the prompt's affinity key — an affinity
+        miss (bounded-load overflow), a failover replay landing on a
+        survivor, or plain cache churn. Pulls the warm peer's export
+        and imports it into the receiver so the admission that
+        follows splices instead of recomputing. EVERY failure mode —
+        no donor, transfer fault, decline — falls through silently:
+        the attempt's full-prompt recompute already covers
+        correctness (the PR 9 discipline), the transfer only buys
+        admission latency."""
+        key = self._affinity_key(entry.prompt)
+        if key is None:
+            return
+        with self._lock:
+            warm = self._warm.get(key, {})
+            wanted = (receiver.kv_capable
+                      and receiver.replica_id not in warm)
+            donors: List[_Replica] = []
+            if wanted:
+                # live/draining donors only: a DEGRADED peer (recent
+                # failures, breaker not yet open) is exactly the one
+                # whose export would eat the transfer timeout for
+                # nothing — recompute is cheaper than probing it
+                cands = [r for r in self._replicas
+                         if r.kv_capable and not r.decommissioned
+                         and r.address != receiver.address
+                         and r.state in ("live", "draining")]
+                # believed-warm peers first (newest belief first);
+                # then the key's rendezvous-top capable replica (its
+                # designated owner — warm whenever the key has seen
+                # traffic, even if the belief map forgot)
+                donors = sorted(
+                    (r for r in cands if r.replica_id in warm),
+                    key=lambda r: -warm[r.replica_id])
+                # the rendezvous-top fallback (the key's designated
+                # owner, warm whenever the key has seen traffic even
+                # if the belief map forgot) only makes sense when the
+                # RECEIVER is not that owner: on a rank-0 pick with
+                # no warm beliefs, nobody else can be warm — probing
+                # the second-ranked replica would pay a guaranteed
+                # 404 round-trip per first-touch key
+                if rank is None or rank > 0:
+                    ranked = sorted(
+                        cands, reverse=True,
+                        key=lambda r: self._rendezvous_score(
+                            key, r.replica_id))
+                    for r in ranked[:1]:
+                        if r not in donors:
+                            donors.append(r)
+            # the attempt that follows warms the receiver either way
+            # (import, or the admission's own insert)
+            self._note_warm(key, receiver.replica_id)
+            if wanted and not donors:
+                self.stats["kv_transfer_declined"] += 1
+        if not wanted or not donors:
+            return
+        t0_us = self._now_us()
+        landed = None
+        for donor in donors[:2]:
+            try:
+                # keepalive before each bounded hop: the client sees
+                # at most one KV_TRANSFER_TIMEOUT_S of silence, never
+                # the whole donor walk
+                forward_ping()
+                payload = self._fetch_kv_payload(donor, entry.prompt)
+                if payload is None:
+                    continue  # donor turned out cold: next candidate
+                forward_ping()
+                out = self._push_kv_payload(receiver.address, payload)
+            except Exception:
+                # torn payload, timeout, 400 from a geometry
+                # mismatch, receiver died — all the same outcome:
+                # count it, recompute covers it
+                with self._lock:
+                    self.stats["kv_transfer_failures"] += 1
+                self.tracer.incr("router_kv_transfer_failures")
+                continue
+            if out.get("imported"):
+                landed = (donor, out, len(payload))
+                break
+            # soft decline (already warm / pool pressure): done —
+            # "already warm" needs no second donor
+            if out.get("reason") == "already_warm":
+                landed = (donor, out, len(payload))
+                break
+        dur_us = max(self._now_us() - t0_us, 0.0)
+        if landed is None:
+            return
+        donor, out, nbytes = landed
+        self._kv_transfer_hist.observe(dur_us / 1e6)
+        with self._lock:
+            if out.get("imported"):
+                self.stats["kv_transfers"] += 1
+                self.stats["kv_transferred_tokens"] += int(
+                    out.get("tokens") or 0)
+            entry.note(self._now(),
+                       f"kv_import:{donor.replica_id}"
+                       f":{out.get('reason')}")
+        if out.get("imported"):
+            self.tracer.incr("router_kv_transfers")
+        if hasattr(self.tracer, "complete"):
+            self.tracer.complete(
+                "router.kv_transfer", t0_us, dur_us,
+                rid=entry.rid, trace=entry.trace,
+                donor=donor.replica_id,
+                receiver=receiver.replica_id,
+                imported=bool(out.get("imported")),
+                reason=out.get("reason"),
+                tokens=out.get("tokens"), blocks=out.get("blocks"),
+                bytes=nbytes)
+
+    def warm_transfer(self, receiver_address: str,
+                      prompts: Sequence[Sequence[int]],
+                      receiver_id: Optional[str] = None
+                      ) -> Dict[str, Any]:
+        """Upgrade-warmup transfer (ISSUE 14): ship the fleet's warm
+        prefixes for ``prompts`` into a BOOTING replica (addressed
+        directly — it is not registered yet) instead of regenerating
+        them (the PR 11 ``/v1/warmup`` handshake). Returns
+        ``{"imported", "attempted", "failed", "cold"}`` where
+        ``cold`` lists the prompts that could not be shipped — the
+        controller falls back to greedy warmup generation for
+        exactly those. ``receiver_id`` (the stable replica id the
+        receiver will register under — the controller knows it)
+        records each shipped key in the warm-belief map, so the
+        receiver's first affinity request does not pay a redundant
+        export+import just to hear ``already_warm``."""
+        imported = attempted = failed = 0
+        cold: List[List[int]] = []
+        for prompt in prompts:
+            prompt = [int(t) for t in prompt]
+            key = self._affinity_key(prompt)
+            with self._lock:
+                warm = self._warm.get(key, {}) if key else {}
+                cands = [r for r in self._replicas
+                         if r.kv_capable and not r.decommissioned
+                         and r.address != receiver_address.split(
+                             "://", 1)[-1]
+                         and r.state in ("live", "degraded",
+                                         "draining")]
+                donors = sorted(
+                    (r for r in cands if r.replica_id in warm),
+                    key=lambda r: -warm[r.replica_id])
+                donors += [r for r in cands if r not in donors]
+            ok = False
+            for donor in donors[:3]:
+                attempted += 1
+                try:
+                    payload = self._fetch_kv_payload(donor, prompt)
+                    if payload is None:
+                        continue
+                    out = self._push_kv_payload(receiver_address,
+                                                payload)
+                except Exception:
+                    failed += 1
+                    continue
+                if out.get("imported") or out.get(
+                        "reason") == "already_warm":
+                    ok = True
+                    imported += int(bool(out.get("imported")))
+                    if receiver_id is not None and key is not None:
+                        with self._lock:
+                            self._note_warm(key, str(receiver_id))
+                    break
+            if not ok:
+                cold.append(prompt)
+        with self._lock:
+            self.stats["kv_transfers"] += imported
+            self.stats["kv_transfer_failures"] += failed
+        return {"imported": imported, "attempted": attempted,
+                "failed": failed, "cold": cold}
 
     # -- journal -------------------------------------------------------
     def _journal_entry(self, prompt: List[int],
@@ -1353,6 +1649,16 @@ class ServingRouter:
                     affinity=route_info.get("affinity"),
                     affinity_key=route_info.get("key"),
                     rendezvous_rank=route_info.get("rank"))
+            if self.kv_transfer and route_info.get("affinity"):
+                # warm import BEFORE the attempt (ISSUE 14): an
+                # affinity miss / failover replay whose receiver is
+                # cold pulls the warm peer's KV so the admission
+                # splices instead of recomputing; every transfer
+                # fault falls through to the recompute the attempt
+                # does anyway
+                self._maybe_kv_transfer(
+                    entry, replica, forward_ping=forward_ping,
+                    rank=route_info.get("rank"))
             client = self._replica_client(replica)
             try:
                 # _pick claimed one unit of the replica's in-flight

@@ -68,6 +68,52 @@ class JsonHandler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(n))
 
+    # -- bounded binary request/response (ISSUE 14 satellite) ----------
+    def read_binary(self, max_bytes: int) -> Optional[bytes]:
+        """Read a raw (non-JSON) request body with a HARD size cap —
+        the KV-transfer import endpoint rides this. The cap is checked
+        against ``Content-Length`` BEFORE any byte is read, so an
+        oversized payload answers **413** without ever buffering (no
+        base64 round-trip, no OOM from a hostile length); a missing
+        length answers **411** (chunked uploads are not accepted — the
+        cap must be checkable up front). Returns the body, or ``None``
+        when a rejection was already sent (the caller just returns).
+        A body shorter than its declared length (peer died mid-send)
+        answers **400**."""
+        length = self.headers.get("Content-Length")
+        if length is None:
+            self.send_json({"error": "Content-Length required for "
+                                     "binary uploads"}, 411,
+                           close=True)
+            return None
+        try:
+            n = int(length)
+        except ValueError:
+            self.send_json({"error": f"bad Content-Length "
+                                     f"{length!r}"}, 400, close=True)
+            return None
+        if n < 0 or n > max_bytes:
+            self.send_json(
+                {"error": f"payload {n} bytes exceeds the "
+                          f"{max_bytes}-byte cap", "max_bytes":
+                 int(max_bytes)}, 413, close=True)
+            return None
+        body = self.rfile.read(n)
+        if len(body) != n:
+            self.send_json(
+                {"error": f"truncated body: {len(body)} of {n} "
+                          "declared bytes arrived"}, 400, close=True)
+            return None
+        return body
+
+    def send_binary(self, body: bytes, code: int = 200) -> None:
+        """Raw-bytes response (``application/octet-stream``) — the
+        export half of the bounded binary path. One-shot by design
+        (``Connection: close``): a transfer payload is fetched once,
+        never pipelined."""
+        self.send_bytes(body, "application/octet-stream", code,
+                        close=True)
+
     def send_json(self, obj: Dict[str, Any], code: int = 200,
                   close: bool = False,
                   headers: Tuple[Tuple[str, str], ...] = ()) -> None:

@@ -353,6 +353,56 @@ def main():
     qos_router.close()
     qos_gw.close()
 
+    # 7. KV transfer plane (ISSUE 14): an affinity-miss warm import —
+    # a prefix warmed on one PAGED replica ships as serialized KV
+    # blocks into a cold peer, whose next admission splices it
+    # (prefill skipped) and produces bit-identical ids. The router
+    # fires this hook automatically whenever a bounded-load overflow
+    # or failover pick lands on a replica that is cold for the key;
+    # here the public warm_transfer (the rolling-upgrade warmup path)
+    # demonstrates it deterministically.
+    from deeplearning4j_tpu.serving import GatewayClient
+
+    def paged_replica(i):
+        engine = DecodeEngine(net, n_slots=4, decode_chunk=2,
+                              paged_kv=True, block_tokens=4,
+                              prefix_cache_rows=4)
+        return ServingGateway(engine, replica_id=f"kv-{i}",
+                              keepalive_s=0.1).start()
+
+    kv_replicas = [paged_replica(0), paged_replica(1)]
+    kv_router = ServingRouter(
+        [g.address for g in kv_replicas], affinity_block_tokens=4,
+        health_interval_s=0.1).start()
+    kv_client = RouterClient(kv_router.address)
+    while not all(r["kv_capable"] and r["state"] == "live"
+                  for r in kv_router.replica_status()):
+        time.sleep(0.05)
+    warm_prompt = PATTERN[:4] + [PATTERN[4]]
+    first = kv_client.generate(warm_prompt, n_gen)
+    owner = next(e.replica_address
+                 for e in kv_router._journal.values())
+    cold_gw = next(g for g in kv_replicas
+                   if g._service.address.split("://")[-1] != owner)
+    shipped = kv_router.warm_transfer(cold_gw.address,
+                                      [warm_prompt[:4]])
+    cold_direct = GatewayClient(cold_gw.address).generate(
+        warm_prompt, n_gen)
+    blocks = cold_gw.engine.stats["kv_imported_blocks"]
+    print(f"kv plane : affinity-miss warm import -> "
+          f"{shipped['imported']} prefix shipped "
+          f"({blocks} block(s), "
+          f"{cold_gw.engine.stats['kv_imported_tokens']} tokens) "
+          f"from the warm owner")
+    print(f"           cold replica admission: "
+          f"{cold_direct['prefix_tokens_reused']} prompt tokens "
+          f"spliced from the IMPORTED blocks (prefill skipped), "
+          f"ids identical across replicas: "
+          f"{cold_direct['tokens'] == first['tokens']}")
+    kv_router.close()
+    for g in kv_replicas:
+        g.close()
+
 
 if __name__ == "__main__":
     main()

@@ -185,8 +185,16 @@ def parse_fleet_histograms(
 
 
 #: fleet-scope rows: the serving families plus the router's
-#: replay-added-latency histogram (ISSUE 10)
-FLEET_ROWS = LIVE_ROWS + (("router_replay_gap_s", "replay_gap"),)
+#: replay-added-latency histogram (ISSUE 10) and the KV transfer
+#: plane's rows (ISSUE 14): cross-replica transfer wall, plus the
+#: warm-vs-recompute admission split the transfer exists to win
+FLEET_ROWS = LIVE_ROWS + (
+    ("router_replay_gap_s", "replay_gap"),
+    ("serving_kv_transfer_s", "kv_transfer"),
+    ("serving_kv_import_s", "kv_import"),
+    ("serving_admission_warm_s", "admission_warm"),
+    ("serving_admission_cold_s", "admission_cold"),
+)
 
 #: per-tenant rows (ISSUE 13): the per-request families that carry
 #: ``{tenant=...}`` labeled copies on tenancy-enabled engines
@@ -310,18 +318,45 @@ def _rows_of(hists: Dict[str, Dict[str, object]],
     return rows
 
 
+def _admission_comparison(
+        hists: Dict[str, Dict[str, object]]
+        ) -> Optional[Dict[str, object]]:
+    """Warm-import vs recompute admission comparison (ISSUE 14): the
+    device-work wall of admissions that reused a cached/imported
+    prefix vs those that prefilled from scratch, as p50s plus the
+    recompute-over-warm ratio — the number the KV transfer plane
+    exists to raise."""
+    warm = hists.get("serving_admission_warm_s")
+    cold = hists.get("serving_admission_cold_s")
+    if not warm or not cold or not warm["count"] or not cold["count"]:
+        return None
+    warm_p50 = histogram_quantile(warm["buckets"], 0.5)
+    cold_p50 = histogram_quantile(cold["buckets"], 0.5)
+    return {
+        "warm_count": warm["count"],
+        "cold_count": cold["count"],
+        "warm_admission_p50_ms": 1e3 * warm_p50,
+        "recompute_admission_p50_ms": 1e3 * cold_p50,
+        "recompute_over_warm_p50": (cold_p50 / warm_p50
+                                    if warm_p50 > 0 else math.inf),
+    }
+
+
 def fleet_report(text: str) -> Dict[str, object]:
     """``--fleet`` rows from one federated exposition: the merged
     (unlabeled) families become the ``"fleet"`` table, the
-    ``{replica=...}``-labeled copies one table per replica."""
-    fleet_rows = _rows_of(parse_prometheus_histograms(text),
-                          FLEET_ROWS)
+    ``{replica=...}``-labeled copies one table per replica, plus the
+    ISSUE 14 warm-vs-recompute admission comparison when both halves
+    carry samples."""
+    hists = parse_prometheus_histograms(text)
+    fleet_rows = _rows_of(hists, FLEET_ROWS)
     replicas = {
         rid: _rows_of(fams, LIVE_ROWS)
         for rid, fams in sorted(parse_fleet_histograms(text).items())}
     return {"fleet": fleet_rows,
             "replicas": {rid: rows for rid, rows in replicas.items()
-                         if rows}}
+                         if rows},
+            "admission_comparison": _admission_comparison(hists)}
 
 
 def report_from_metrics_text(text: str) -> List[Dict[str, object]]:
@@ -526,6 +561,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(render(report["fleet"],
                          f"{args.source} (fleet-wide)"))
+            comp = report.get("admission_comparison")
+            if comp:
+                print()
+                print(f"admission: warm p50 "
+                      f"{comp['warm_admission_p50_ms']:.1f}ms "
+                      f"({comp['warm_count']}) vs recompute p50 "
+                      f"{comp['recompute_admission_p50_ms']:.1f}ms "
+                      f"({comp['cold_count']}) — recompute/warm "
+                      f"{comp['recompute_over_warm_p50']:.2f}x")
             for rid, rows in report["replicas"].items():
                 print()
                 print(render(rows, f"replica {rid}"))

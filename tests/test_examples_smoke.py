@@ -53,9 +53,18 @@ def test_all_examples_listed():
 #: with the subprocess soaks so tier-1 stays inside its wall-time
 #: budget — tier-1 covers the same engine/router/tenancy paths
 #: through tests/test_serving_tp.py, tests/test_serving_paged.py,
-#: tests/test_serving_router.py, and tests/test_tenancy.py
+#: tests/test_serving_router.py, and tests/test_tenancy.py.
+#: ISSUE 14 added the KV-transfer act to serving_router (already
+#: slow) plus tests/test_kv_transfer.py (+~1 min of tier-1): the
+#: next-heaviest smokes (~6-8 s each) join the slow tier to
+#: compensate — their paths stay tier-1-covered by
+#: tests/test_sequence_parallel.py, tests/test_pipeline_expert.py,
+#: and tests/test_serving_gateway.py
 SLOW_EXAMPLES = {"flagship_transformer.py", "streaming_decode.py",
-                 "serving_router.py"}
+                 "serving_router.py",
+                 "sequence_parallel_transformer.py",
+                 "moe_expert_parallel.py",
+                 "serving_gateway.py"}
 
 
 @pytest.mark.parametrize(

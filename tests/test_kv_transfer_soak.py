@@ -1,0 +1,43 @@
+"""Registered KV-transfer-plane chaos soak (ISSUE 14 acceptance).
+
+Fast variant (tier-1): 2 in-process PAGED async-round replicas behind
+a transfer-enabled router; every second transfer payload arrives
+truncated and the busiest replica is hard-killed with streams in
+flight. Gates zero lost streams, bit-identical greedy ids vs a
+fault-free single-engine reference (warm imports and torn transfers
+included), >= 1 successful transfer AND >= 1 fault that fell back to
+recompute, a populated ``kv_transfer`` row in the ``--fleet`` report,
+and zero leaked threads/fds.
+
+Full variant (``slow``): 3 SUBPROCESS replicas and a real SIGKILL.
+"""
+
+import pytest
+
+from scripts.kv_transfer_soak import run_soak
+
+
+def test_kv_transfer_soak_fast():
+    summary = run_soak(n_clients=14, n_replicas=2, seed=0,
+                       in_process=True, min_inflight_at_kill=3)
+    assert summary["completed"] >= 7
+    assert summary["greedy_parity_ok"] == summary["completed"]
+    assert summary["inflight_at_kill"] >= 3
+    assert summary["kv_transfers"] >= 1
+    assert summary["kv_transfer_failures"] >= 1
+    assert summary["payloads_torn"] >= 1
+    assert summary["fleet_kv_transfer_count"] >= 1
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0
+
+
+@pytest.mark.slow
+def test_kv_transfer_soak_full_subprocess():
+    summary = run_soak(n_clients=20, n_replicas=3, seed=0,
+                       in_process=False, min_inflight_at_kill=3)
+    assert summary["completed"] >= 10
+    assert summary["greedy_parity_ok"] == summary["completed"]
+    assert summary["kv_transfers"] >= 1
+    assert summary["kv_transfer_failures"] >= 1
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0
