@@ -2124,6 +2124,180 @@ def bench_fleet_controller_overhead():
     }
 
 
+def bench_router_wal_overhead():
+    """Durable-router row (ISSUE 15 acceptance): the write-ahead
+    journal must be a free rider on the serving path. 8 concurrent
+    SSE streams over TWO gateway replicas (the standard flagship
+    router topology), through a router journaling every
+    open/route/progress/done transition to an on-disk WAL with the
+    default BATCHED fsync, vs an identically-configured WAL-off
+    router over the SAME replicas, interleaved trials.
+
+    Gates:
+    - overhead: WAL-on aggregate tokens/sec >= 0.97x WAL-off (the
+      journal is framed appends + coalesced fsync on the relay
+      threads' path);
+    - parity: ids bit-identical both paths vs the in-process
+      single-engine reference;
+    - zero retrace: compile counts identical before/after on both
+      replica engines;
+    - the WAL actually recorded the traffic (every stream's open +
+      done framed on disk, recoverable by a fresh fold)."""
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine,
+        Request,
+        RouterClient,
+        ServingGateway,
+        ServingRouter,
+        read_records,
+        recover_state,
+    )
+
+    V, width, n_layers, window = 64, 1024, 8, 2048
+    n_streams, n_gen, prompt_len = 8, 64, 128
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_streams)]
+    ref_eng = DecodeEngine(net, n_slots=n_streams, decode_chunk=32)
+    ref_ids = [ref_eng.submit(Request(prompt=list(p),
+                                      max_new_tokens=n_gen))
+               for p in prompts]
+    ref_res = ref_eng.run()
+    ref_tokens = [ref_res[i].tokens for i in ref_ids]
+
+    engines = [DecodeEngine(net, n_slots=4, decode_chunk=32,
+                            prefix_cache_rows=8)
+               for _ in range(2)]
+    gateways = [ServingGateway(e, keepalive_s=1.0,
+                               admission_grace_s=0.25,
+                               replica_id=f"wal-rep-{i}").start()
+                for i, e in enumerate(engines)]
+    addresses = [g.address for g in gateways]
+    tmp = tempfile.mkdtemp(prefix="bench-router-wal-")
+    wal_path = os.path.join(tmp, "router.wal")
+    wal_router = ServingRouter(addresses, health_interval_s=0.25,
+                               affinity_block_tokens=16,
+                               journal_path=wal_path,
+                               fsync="batched").start()
+    plain_router = ServingRouter(addresses, health_interval_s=0.25,
+                                 affinity_block_tokens=16).start()
+    wal_client = RouterClient(wal_router.address, timeout_s=600.0)
+    plain_client = RouterClient(plain_router.address,
+                                timeout_s=600.0)
+
+    def stream_round(client):
+        outs = [None] * n_streams
+        errors = [None] * n_streams
+
+        def one(i):
+            try:
+                s = client.stream(prompts[i], n_gen)
+                toks = []
+                for delta in s:
+                    toks.extend(delta)
+                outs[i] = toks
+            except Exception as e:
+                errors[i] = e
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_streams)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        failed = {i: repr(e) for i, e in enumerate(errors) if e}
+        if failed:
+            raise RuntimeError(f"stream clients failed: {failed}")
+        return sum(len(o) for o in outs) / dt, outs
+
+    try:
+        _, outs = stream_round(wal_client)  # warm + parity check
+        id_match = float(np.mean([outs[i] == ref_tokens[i]
+                                  for i in range(n_streams)]))
+        if id_match < 1.0:
+            _fail_gate(f"WAL-path stream ids diverged from the "
+                       f"in-process reference (match "
+                       f"{id_match:.2f})")
+        _, plain_outs = stream_round(plain_client)
+        if plain_outs != outs:
+            _fail_gate("WAL-off stream ids differ — the journal "
+                       "leaked into computation")
+        counts0 = [e.compile_counts() for e in engines]
+        wal_rates, plain_rates = [], []
+        for _ in range(3):  # interleaved: drift hits both alike
+            r, _ = stream_round(plain_client)
+            plain_rates.append(r)
+            r, _ = stream_round(wal_client)
+            wal_rates.append(r)
+        counts1 = [e.compile_counts() for e in engines]
+        if counts1 != counts0:
+            _fail_gate(f"replica engines retraced under WAL "
+                       f"traffic: {counts0} -> {counts1}")
+        # the journal recorded every stream and folds back clean
+        records, torn = read_records(wal_path)
+        if torn:
+            _fail_gate(f"WAL has a torn tail ({torn} bytes) on a "
+                       "healthy run")
+        state = recover_state(records)
+        done_n = sum(1 for e in state["entries"].values()
+                     if e["done"])
+        expected = 4 * n_streams  # warm round + 3 timed rounds
+        if done_n < expected:
+            _fail_gate(f"WAL recovered only {done_n} terminal "
+                       f"entries of {expected} journaled streams")
+        wal_bytes = os.path.getsize(wal_path)
+    finally:
+        import shutil
+
+        wal_router.close()
+        plain_router.close()
+        for g in gateways:
+            g.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    wal_rate = float(np.median(wal_rates))
+    plain_rate = float(np.median(plain_rates))
+    ratio = wal_rate / plain_rate
+    if ratio < 0.97:
+        _fail_gate(
+            f"WAL costs too much: {wal_rate:.0f} tok/s journaled "
+            f"< 0.97x {plain_rate:.0f} without (ratio {ratio:.3f})")
+    return {
+        "metric": "router_wal_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": ("WAL-on (batched fsync) / WAL-off router aggregate "
+                 "streaming tokens/sec (width-1024 flagship, "
+                 "2048-token KV window, 2 replicas x 4 slots, "
+                 f"{n_streams} concurrent SSE streams x {n_gen} "
+                 "tokens, localhost; every open/route/progress/done "
+                 "transition framed + CRC'd to disk)"),
+        "vs_baseline": None,  # reference has no router tier at all
+        "spread": [round(min(wal_rates) / max(plain_rates), 4),
+                   round(max(wal_rates) / min(plain_rates), 4)],
+        "trials": len(wal_rates),
+        "wal_tokens_per_sec": round(wal_rate, 1),
+        "plain_tokens_per_sec": round(plain_rate, 1),
+        "wal_bytes": wal_bytes,
+        "wal_recovered_terminals": done_n,
+        "router_http_id_match": round(id_match, 4),
+        "compile_counts": counts1,
+    }
+
+
 def bench_kv_transfer():
     """KV transfer plane rows (ISSUE 14 tentpole).
 
@@ -2942,6 +3116,7 @@ def main() -> None:
                bench_gateway_streaming, bench_router_overhead,
                bench_fleet_trace_overhead,
                bench_fleet_controller_overhead,
+               bench_router_wal_overhead,
                bench_tenant_qos_overhead,
                bench_kv_transfer,
                bench_observability_overhead,

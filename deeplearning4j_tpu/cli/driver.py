@@ -453,17 +453,25 @@ def router_from_args(args):
         failure_threshold=args.failure_threshold,
         probe_interval_s=args.probe_interval,
         max_replays=args.max_replays,
-        tenants=tenants_from_args(args))
+        tenants=tenants_from_args(args),
+        journal_path=getattr(args, "journal_path", None),
+        fsync=getattr(args, "fsync", "batched"))
 
 
 def _cmd_route(args) -> int:
     import time as _time
 
     router = router_from_args(args).start()
+    wal = ""
+    if getattr(args, "journal_path", None):
+        wal = (f", WAL {args.journal_path} "
+               f"(fsync={args.fsync}, recovered "
+               f"{router.stats['recovered_entries']} entries, "
+               f"{router.stats['recovered_open']} open)")
     print(f"routing on {router.address} over "
           f"{len(router._replicas)} replicas "
           f"(POST /v1/generate, GET /v1/healthz, GET /v1/metrics, "
-          f"POST /v1/replicas/drain)")
+          f"POST /v1/replicas/drain){wal}", flush=True)
     try:
         while True:
             _time.sleep(0.5)
@@ -904,6 +912,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="tenant service class, repeatable "
                          "(ISSUE 13): arms the router's per-tenant "
                          "token-bucket rate limits (rps/burst keys)")
+    rt.add_argument("--journal-path", default=None,
+                    help="crash-safe write-ahead journal (ISSUE 15): "
+                         "a router restarted against the same file "
+                         "replays open streams on live replicas, "
+                         "restores tenant buckets + warm-KV "
+                         "beliefs, and serves client resumes "
+                         "(Last-Event-ID) from the recovered "
+                         "breadcrumbs")
+    rt.add_argument("--fsync", default="batched",
+                    choices=("per_record", "batched", "off"),
+                    help="WAL durability policy: per_record "
+                         "(power-loss safe, per-record latency), "
+                         "batched (default: SIGKILL-safe, fsync "
+                         "coalesced), off (flush-only)")
     rt.set_defaults(fn=_cmd_route)
 
     cl = sub.add_parser(

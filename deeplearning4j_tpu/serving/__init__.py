@@ -30,7 +30,14 @@ KV transfer plane: disaggregated prefill/decode roles with
 cross-replica shipping of warmed KV blocks (framed binary
 export/import, width-invariant across TP donors) and async
 double-buffered decode rounds (ISSUE 14 tentpole,
-``async_rounds=True`` / router ``kv_transfer=True``)."""
+``async_rounds=True`` / router ``kv_transfer=True``) — and the
+durable router: a crash-safe write-ahead journal
+(``serving/journal.py``, ``ServingRouter(journal_path=)``) that
+makes the router itself as expendable as the replicas it fronts —
+restart recovery replays open streams bit-identically, token-bucket
+levels and warm beliefs survive the crash, and clients resume
+dropped streams by SSE ``Last-Event-ID`` with zero duplicated and
+zero lost tokens (ISSUE 15 tentpole)."""
 
 from deeplearning4j_tpu.serving.block_pool import BlockPool, BlockTable
 from deeplearning4j_tpu.serving.controller import FleetController
@@ -55,6 +62,13 @@ from deeplearning4j_tpu.serving.gateway import (
     ROLES,
     STATUS_OF_REASON,
     ServingGateway,
+)
+from deeplearning4j_tpu.serving.journal import (
+    FSYNC_POLICIES,
+    JournalError,
+    WriteAheadJournal,
+    read_records,
+    recover_state,
 )
 from deeplearning4j_tpu.serving.kv_transfer import (
     KVTransferError,
@@ -100,11 +114,13 @@ __all__ = [
     "FINISH_REASONS",
     "FaultEvent",
     "FaultPlan",
+    "FSYNC_POLICIES",
     "FleetController",
     "GatewayClient",
     "GatewayError",
     "GatewayStream",
     "GenerationResult",
+    "JournalError",
     "KVTransferError",
     "LocalReplica",
     "ManualClock",
@@ -128,8 +144,11 @@ __all__ = [
     "TPContext",
     "ServingGateway",
     "ServingRouter",
+    "WriteAheadJournal",
     "greedy_acceptance",
     "pack_prefix",
+    "read_records",
+    "recover_state",
     "sample_tokens",
     "unpack_prefix",
 ]

@@ -82,6 +82,15 @@ class GatewayStream:
         self._resp = resp
         self.id: Optional[int] = None
         self.result: Optional[Dict[str, Any]] = None
+        #: the server's last SSE ``id:`` field (ISSUE 15): the
+        #: serving streams use the cumulative delivered-token count,
+        #: so after a connection drop this is exactly the
+        #: ``Last-Event-ID`` to resume from. Committed only when the
+        #: event's DATA arrives (the SSE dispatch rule) — an ``id:``
+        #: line whose event was torn off by the disconnect must not
+        #: advance the cursor past tokens never received.
+        self.last_event_id: Optional[int] = None
+        self._pending_event_id: Optional[int] = None
         self._read_head()
 
     def _read_head(self) -> None:
@@ -105,10 +114,24 @@ class GatewayStream:
             line = line.rstrip(b"\r\n")
             if not line:  # blank line = event boundary
                 if data_lines:
+                    if self._pending_event_id is not None:
+                        # SSE dispatch rule: the id commits WITH its
+                        # event, never before its data landed
+                        self.last_event_id = self._pending_event_id
+                        self._pending_event_id = None
                     return "event", json.loads(b"".join(data_lines))
                 continue  # boundary after a comment ping
             if line.startswith(b":"):
                 return "ping", None  # keep-alive comment
+            if line.startswith(b"id:"):
+                # SSE event id (ISSUE 15): token-position cursor for
+                # Last-Event-ID resumption; staged until the event's
+                # data line(s) complete the frame
+                try:
+                    self._pending_event_id = int(line[3:].strip())
+                except ValueError:
+                    pass
+                continue
             if line.startswith(b"data:"):
                 data_lines.append(line[5:].strip())
 
@@ -285,7 +308,10 @@ class GatewayClient:
             headers[TRACE_HEADER] = body["trace"]
         return body, headers
 
-    def generate(self, prompt: List[int], max_new_tokens: int,
+    def generate(self, prompt: Optional[List[int]] = None,
+                 max_new_tokens: int = 16,
+                 resume: Optional[int] = None,
+                 last_event_id: int = 0,
                  **kwargs: Any) -> Dict[str, Any]:
         """Blocking generation. Returns the terminal result dict on
         any 2xx; raises :class:`GatewayError` carrying the mapped
@@ -295,7 +321,28 @@ class GatewayClient:
         failure: resubmitting a generate is a replay decision the
         caller must make (see serving/router.py for the journaled
         version). ``trace=`` attaches a fleet trace context
-        (ISSUE 10)."""
+        (ISSUE 10).
+
+        ``resume=<request_id>`` (ISSUE 15) re-attaches to an
+        EXISTING request instead of submitting a new one — follow
+        its journaled stream from ``last_event_id`` (a token
+        position) to the terminal and return the terminal dict,
+        whose ``tokens`` is always the complete list. The blocking
+        way back after a dropped connection or a router restart;
+        ``resumable=True`` on the original call keeps a router-side
+        stream alive across client disconnects."""
+        if resume is not None:
+            s = self.resume(resume, last_event_id=last_event_id)
+            try:
+                for _ in s:
+                    pass
+            finally:
+                s.close()
+            if s.result is None:
+                raise GatewayError(
+                    0, {"error": "resumed stream ended without a "
+                                 f"terminal (request {resume})"})
+            return s.result
         body, headers = self._generate_body(prompt, max_new_tokens,
                                             kwargs)
         return self._call("POST", "/v1/generate", body,
@@ -321,6 +368,33 @@ class GatewayClient:
             raise GatewayError(
                 resp.status, data,
                 retry_after_s=(int(retry) if retry else None))
+        return GatewayStream(conn, resp)
+
+    def resume(self, request_id: int,
+               last_event_id: int = 0) -> GatewayStream:
+        """``GET /v1/requests/<id>/stream`` with ``Last-Event-ID``
+        (ISSUE 15): reconnect to a journaled stream and resume at an
+        exact token position — everything past ``last_event_id``
+        replays first (journal breadcrumbs), then the stream follows
+        live progress (failover replay, router-restart recovery) to
+        the terminal. Event ids keep counting delivered tokens, so a
+        resume can itself be resumed. Raises :class:`GatewayError`
+        on 404 (unknown/evicted id) and 202 (the server has no
+        followable stream state — poll for the terminal instead)."""
+        conn = self._connect()
+        try:
+            conn.request(
+                "GET", f"/v1/requests/{int(request_id)}/stream",
+                headers={"Last-Event-ID": str(int(last_event_id))})
+            resp = conn.getresponse()
+        except BaseException:
+            conn.close()
+            raise
+        if resp.status != 200:
+            raw = resp.read()
+            conn.close()
+            data = json.loads(raw) if raw else {}
+            raise GatewayError(resp.status, data)
         return GatewayStream(conn, resp)
 
     def cancel(self, request_id: int) -> Dict[str, Any]:

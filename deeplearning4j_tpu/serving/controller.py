@@ -218,6 +218,29 @@ class FleetController:
         its process, not just drain its traffic."""
         self._handles[handle.replica_id] = handle
 
+    def attach(self, router) -> None:
+        """Re-attach the controller to a RESTARTED router (ISSUE 15):
+        the control plane must survive the same faults the fleet
+        does, and a router recovered from its write-ahead journal is
+        a new object on the same fleet. The swap happens under the
+        scale lock (no scale action sees a torn router reference),
+        the tracer follows the new router (scale spans land on the
+        lane the new stitched trace serves), windowed-TTFT deltas
+        reset (the new router's counters restart from its own
+        scrape epoch — a stale delta would fake a breach or mask
+        one), and breach/idle streaks restart: the controller
+        re-learns the fleet's state from live scrapes rather than
+        acting on pre-crash momentum. Replica handles stay adopted —
+        the processes never died."""
+        with self._scale_lock:
+            self.router = router
+            self.tracer = router.tracer
+            self._prev_ttft = None
+            self._pending_recovery = None
+            self._breach_streak = 0
+            self._idle_streak = 0
+        self.tracer.incr("fleet_controller_reattached")
+
     def shutdown_fleet(self) -> None:
         """Reap every handle the controller owns (soak/test
         teardown)."""

@@ -135,13 +135,18 @@ class _Live:
     the stepping thread (producer: deltas, terminal) and the handler
     thread serving its connection (consumer)."""
 
-    __slots__ = ("events", "result", "done")
+    __slots__ = ("events", "result", "done", "tokens")
 
     def __init__(self):
         #: delta token lists and, last, the GenerationResult terminal
         self.events: Queue = Queue()
         self.result: Optional[GenerationResult] = None
         self.done = threading.Event()
+        #: cumulative generated tokens (ISSUE 15): the stream-resume
+        #: endpoint follows this list by exact token position, so a
+        #: reconnecting client's ``Last-Event-ID`` resumes gap- and
+        #: duplicate-free while the request is still running
+        self.tokens: List[int] = []
 
 
 class _GatewayHandler(JsonHandler):
@@ -183,6 +188,9 @@ class _GatewayHandler(JsonHandler):
         elif (path.startswith("/v1/requests/")
                 and path.endswith("/trace")):
             self.gateway._handle_request_trace(self, path)
+        elif (path.startswith("/v1/requests/")
+                and path.endswith("/stream")):
+            self.gateway._handle_stream_resume(self, path, query)
         elif path.startswith("/v1/requests/"):
             self.gateway._handle_poll(self, path)
         else:
@@ -307,7 +315,7 @@ class ServingGateway:
         self._step_sink: Dict[int, GenerationResult] = {}
         self.stats = {"connections": 0, "streams": 0,
                       "disconnect_cancels": 0, "rejected_429": 0,
-                      "rejected_503": 0}
+                      "rejected_503": 0, "resumed_streams": 0}
         self._service = HttpService(_GatewayHandler, host, port,
                                     gateway=self,
                                     timeout=float(handler_timeout_s))
@@ -519,6 +527,7 @@ class ServingGateway:
         # Queue.put hands off to the handler thread without blocking
         live = self._live.get(rid)
         if live is not None:
+            live.tokens.extend(int(t) for t in tokens)
             live.events.put(list(tokens))
 
     def _deliver_terminal(self, rid: int,
@@ -711,9 +720,10 @@ class ServingGateway:
         the full result + mapped status. Any write failure means the
         client vanished: the request is cancelled and its slot freed."""
         self._bump("streams")
+        sent = 0  # delivered-token count = the SSE event id
         try:
             handler.start_stream("text/event-stream")
-            handler.send_event({"id": rid})
+            handler.send_event({"id": rid}, event_id=0)
             while True:
                 try:
                     item = live.events.get(timeout=self.keepalive_s)
@@ -730,9 +740,12 @@ class ServingGateway:
                 if isinstance(item, GenerationResult):
                     out = _result_dict(item)
                     out["done"] = True
-                    handler.send_event(out)
+                    handler.send_event(out,
+                                       event_id=len(item.tokens))
                     break
-                handler.send_event({"id": rid, "tokens": item})
+                sent += len(item)
+                handler.send_event({"id": rid, "tokens": item},
+                                   event_id=sent)
             handler.end_stream()
         except (BrokenPipeError, ConnectionResetError, OSError):
             # the peer is gone: release its compute immediately
@@ -743,6 +756,70 @@ class ServingGateway:
             self.cancel(rid)
         finally:
             self._forget(rid)
+
+    def _handle_stream_resume(self, handler, path: str,
+                              query: str = "") -> None:
+        """``GET /v1/requests/<rid>/stream`` (ISSUE 15): resume a
+        stream by exact token position — ``Last-Event-ID: N`` (or
+        ``?from=N``) replays everything past token N. A terminal
+        request replays from its stored result; a running one whose
+        connection-era ``_Live`` still exists is FOLLOWED live (the
+        cumulative token list is position-exact); a running request
+        with no ``_Live`` (drain-restored: its pre-restore deltas
+        never reached this process) answers 202 — poll for the
+        terminal, which always carries the full token list. The
+        resume consumer never cancels the request when it vanishes;
+        cancel-on-disconnect stays the PRIMARY stream's contract
+        (the router's relay depends on it)."""
+        parsed = handler.read_resume_cursor(path, query)
+        if parsed is None:
+            return
+        rid, cursor = parsed
+        with self._engine_access():
+            res = self._results.get(rid)
+            live = self._live.get(rid)
+            running = (live is not None
+                       or rid in self.engine.scheduler._issued)
+        if res is None and live is None and not running:
+            handler.send_json({"error": f"unknown request {rid}"},
+                              404, close=True)
+            return
+        if res is None and live is None:
+            handler.send_json(
+                {"id": rid, "running": True,
+                 "resume": "no live stream state in this process; "
+                           "poll /v1/requests/<id> for the terminal"},
+                202, close=True)
+            return
+        self._bump("resumed_streams")
+        if self.engine.tracer is not None:
+            self.engine.tracer.incr("serving_gateway_resumes")
+
+        def poll(at):
+            r = (live.result
+                 if live is not None and live.result is not None
+                 else res)
+            if r is not None:
+                total = len(r.tokens)
+                tail = ([int(t) for t in r.tokens[at:]]
+                        if total > at else [])
+                return tail, total, True, _result_dict(r)
+            # live is non-None here: the res-and-live-both-None case
+            # answered 404/202 above
+            tokens = live.tokens
+            total = len(tokens)
+            tail = ([int(t) for t in tokens[at:]]
+                    if total > at else [])
+            return (tail, total,
+                    live.done.is_set() or self._stopped, None)
+
+        wait = (live.done.wait if live is not None
+                else (lambda t: None))
+        try:
+            handler.follow_stream(rid, cursor, poll, wait,
+                                  self.keepalive_s)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # a vanished resume consumer cancels nothing
 
     def _handle_cancel(self, handler, path: str) -> None:
         rid = self._rid_of(handler, path)

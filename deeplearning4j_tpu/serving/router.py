@@ -89,6 +89,23 @@ is where the fleet's observability lives:
   via the journal, or serves journal breadcrumbs with a
   ``replayed_to`` pointer when the owner died.
 
+**Durability (ISSUE 15 tentpole).** The journal above is also a
+crash ledger: with ``journal_path=`` every open/route/progress/done
+transition (plus tenant bucket levels, warm-KV beliefs, and stable
+replica ids) is appended to a length+CRC framed write-ahead journal
+(serving/journal.py) BEFORE the router acts on it. A SIGKILLed
+router restarted against the same file replays its open entries
+through the very replay path above — full-prompt resubmit on
+whichever replicas answer healthz, the recovered high-water mark
+dedupping the regenerated prefix — restores bucket levels (a flooder
+stays throttled through the crash) and warm beliefs, and emits a
+``router.recover`` span on the stitched trace. Streams carry
+monotone SSE event ids (= delivered-token count), so a dropped
+client resumes via ``GET /v1/requests/<id>/stream`` +
+``Last-Event-ID`` with zero duplicated and zero lost tokens;
+``resumable: true`` on the generate body turns client disconnects
+into detaches instead of cancels.
+
 The router speaks the gateway's own protocol (``/v1/generate``,
 ``/v1/requests/<id>``, ``/v1/healthz``, ``/v1/metrics``, SSE framing),
 so :class:`~deeplearning4j_tpu.serving.GatewayClient` drives a router
@@ -110,6 +127,10 @@ from deeplearning4j_tpu.serving.client import (
     RETRYABLE_ERRORS,
     GatewayClient,
     GatewayError,
+)
+from deeplearning4j_tpu.serving.journal import (
+    WriteAheadJournal,
+    recover_state,
 )
 from deeplearning4j_tpu.util.httpjson import HttpService, JsonHandler
 
@@ -264,7 +285,7 @@ class _JournalEntry:
                  "replica_address", "replica_rid", "affinity",
                  "history", "submit_t", "trace", "done_t",
                  "replay_t0_us", "replay_hwm", "replay_from",
-                 "tenant")
+                 "tenant", "resumable", "recovered")
 
     def __init__(self, rid: int, prompt: List[int],
                  params: Dict[str, Any], submit_t: float):
@@ -299,6 +320,15 @@ class _JournalEntry:
         self.replay_t0_us: Optional[float] = None
         self.replay_hwm = 0
         self.replay_from: Optional[str] = None
+        #: ISSUE 15: a resumable stream's client disconnect DETACHES
+        #: instead of cancelling — the relay keeps running with a
+        #: buffering emit and the client reconnects via
+        #: ``GET /v1/requests/<rid>/stream`` + ``Last-Event-ID``
+        self.resumable = bool(params.get("resumable"))
+        #: rebuilt from the write-ahead journal after a router
+        #: restart (open entries re-enter the replay path; done
+        #: entries serve polls/resumes from their recovered terminal)
+        self.recovered = False
 
     def note(self, t: float, event: str) -> None:
         self.history.append((round(t, 4), event))
@@ -342,7 +372,7 @@ class _RouterHandler(JsonHandler):
                            close=True)
 
     def do_GET(self):
-        path = self.path.partition("?")[0]
+        path, _, query = self.path.partition("?")
         if path == "/v1/healthz":
             self.send_json(self.router._health(), 200, close=True)
         elif path == "/v1/metrics":
@@ -356,6 +386,9 @@ class _RouterHandler(JsonHandler):
         elif (path.startswith("/v1/requests/")
                 and path.endswith("/trace")):
             self.router._handle_request_trace(self, path)
+        elif (path.startswith("/v1/requests/")
+                and path.endswith("/stream")):
+            self.router._handle_stream_resume(self, path, query)
         elif path.startswith("/v1/requests/"):
             self.router._handle_poll(self, path)
         else:
@@ -443,6 +476,22 @@ class ServingRouter:
       router→replica connect and read bounds (a dead replica must
       fail fast, a healthy stream may idle up to the replica's
       keep-alive period between events).
+    - ``journal_path`` — crash-safe write-ahead journal (ISSUE 15
+      tentpole; default None = the memory-only PR 9 journal). Every
+      open/route/progress/done transition, tenant bucket level, and
+      warm-KV belief is appended BEFORE the router acts on it; a
+      router restarted against the same path replays open entries on
+      whichever replicas answer healthz (high-water dedup — zero
+      lost, zero double-delivered tokens), restores bucket levels
+      (a flooder stays throttled through a crash) and warm beliefs,
+      and serves client resumes from the recovered breadcrumbs.
+    - ``fsync`` — the WAL durability policy (``per_record`` /
+      ``batched`` / ``off``; serving/journal.py). ``batched``
+      (default) is SIGKILL-safe and priced >= 0.97x WAL-off by
+      ``bench_router_wal_overhead``.
+    - ``wal_compact_bytes`` — compaction threshold: past it the live
+      state folds into one snapshot record and the file rewrites
+      atomically, so the WAL stays bounded like ``journal_cap``.
 
     ``with ServingRouter([...]) as r: ...`` serves on entry and closes
     on exit; or ``start()``/``close()`` explicitly."""
@@ -463,7 +512,11 @@ class ServingRouter:
                  fleet_trace: bool = True,
                  tracer=None,
                  tenants=None,
-                 kv_transfer: bool = True):
+                 kv_transfer: bool = True,
+                 journal_path: Optional[str] = None,
+                 fsync: str = "batched",
+                 wal_compact_bytes: int = 1 << 20,
+                 wal_retain_done: int = 64):
         if not replicas:
             raise ValueError("router needs at least one replica")
         if affinity_block_tokens < 1:
@@ -557,6 +610,7 @@ class ServingRouter:
                 "+ receiver import push, per shipped prefix)")
         self._lock = threading.RLock()
         self._rids = itertools.count()
+        self._rid_hwm = 0  # next unminted rid (the WAL snapshot's)
         self._journal: Dict[int, _JournalEntry] = {}
         self._rr = 0  # least-loaded tie-break rotation
         self._t0 = time.monotonic()
@@ -569,7 +623,28 @@ class ServingRouter:
             "tenant_throttled": 0, "tenant_backoffs": 0,
             "kv_transfers": 0, "kv_transfer_failures": 0,
             "kv_transfer_declined": 0, "kv_transferred_tokens": 0,
+            "recovered_entries": 0, "recovered_open": 0,
+            "recovered_replayed": 0, "resumed_streams": 0,
+            "detached_streams": 0, "wal_compactions": 0,
+            "wal_errors": 0,
         }
+        #: the crash ledger (ISSUE 15 tentpole): None = memory-only
+        self._wal: Optional[WriteAheadJournal] = None
+        self.wal_retain_done = int(wal_retain_done)
+        self._recovered_buckets: Dict[str, Dict[str, float]] = {}
+        self._recovery_open: List[_JournalEntry] = []
+        self._recover_t0_us: Optional[float] = None
+        self._recover_pending = 0
+        self._compacting = False
+        self._wal_deferred: List[Dict[str, Any]] = []
+        self._wal_flush_lock = threading.Lock()
+        if journal_path is not None:
+            self._wal = WriteAheadJournal(
+                journal_path, fsync=fsync,
+                compact_bytes=wal_compact_bytes)
+            if self._wal.recovered:
+                self._restore_from_wal(
+                    recover_state(self._wal.recovered))
         self._stopped = False
         self._service = HttpService(_RouterHandler, host, port,
                                     router=self,
@@ -586,6 +661,23 @@ class ServingRouter:
     def start(self) -> "ServingRouter":
         self._service.start()
         self._health_thread.start()
+        if self._recovery_open:
+            # re-enter the PR 9 replay path for every entry the WAL
+            # says was open when the previous router died: full-prompt
+            # resubmit on whichever replicas answer healthz, the
+            # recovered high-water mark dedupping the already-streamed
+            # prefix. Off-thread — clients reconnect through the
+            # resume endpoint while replay runs.
+            replays, self._recovery_open = self._recovery_open, []
+            for entry in replays:
+                threading.Thread(
+                    target=self._recover_entry, args=(entry,),
+                    daemon=True,
+                    name=f"router-recover-{entry.rid}").start()
+        elif self._recover_t0_us is not None:
+            # a WAL with nothing open still recovered state (done
+            # breadcrumbs, buckets, beliefs): the span records it
+            self._emit_recover_span()
         return self
 
     def __enter__(self) -> "ServingRouter":
@@ -607,6 +699,12 @@ class ServingRouter:
             for entry in self._journal.values():
                 entry.done.set()
         self._service.stop()
+        if self._wal is not None:
+            # drain deferred records, then flush + fsync — NO
+            # clean-shutdown marker: the recovery path must be the
+            # same one a SIGKILL exercises
+            self._wal_flush()
+            self._wal.close()
 
     def _now(self) -> float:
         return time.monotonic() - self._t0
@@ -666,6 +764,9 @@ class ServingRouter:
                     # failed scrape, not a router outage
                     self._note_failure(replica)
                     self.tracer.incr("router_health_scrape_errors")
+            # deferred warm/cold/rep records from lock-held sites
+            # drain here at worst (most drain at their caller's seam)
+            self._wal_flush()
             time.sleep(self.health_interval_s)
 
     def _check_replica(self, replica: _Replica,
@@ -848,8 +949,16 @@ class ServingRouter:
             self._breaker_instant(replica, replica.state, to)
             replica.state = to
             rid = payload.get("replica_id")
-            if rid:
+            if rid and str(rid) != replica.replica_id:
                 replica.replica_id = str(rid)
+                # the id→address binding rides the WAL (ISSUE 15): a
+                # restarted router re-seats stable ids BEFORE any
+                # scrape, so the rendezvous keyspace holds from the
+                # first post-restart pick and a dead-at-recovery
+                # replica's breaker opens under the SAME id its
+                # restored warm beliefs are keyed by
+                self._wal_defer({"t": "rep", "r": str(rid),
+                                 "addr": replica.address})
             replica.queue_depth = int(payload.get("queued", 0))
             replica.active_slots = int(
                 payload.get("active_slots", 0))
@@ -899,6 +1008,7 @@ class ServingRouter:
             elif was == "live":
                 self._breaker_instant(replica, was, "degraded")
                 replica.state = "degraded"
+        self._wal_flush()  # the cold record from _forget_warm
         if became_dead and self.fleet_trace and not self._stopped:
             # last-gasp trace scrape (ISSUE 11 satellite): off the
             # caller's thread — _note_failure fires from the health
@@ -1051,6 +1161,10 @@ class ServingRouter:
             while len(self._warm) > self._warm_cap:
                 self._warm.pop(next(iter(self._warm)))
         warm[replica_id] = time.monotonic()
+        self._wal_defer({"t": "warm",
+                         "k": key.decode("ascii", "replace"),
+                         "r": replica_id,
+                         "wall": round(time.time(), 3)})
 
     def _forget_warm(self, replica_id: str) -> None:
         """Drop every warm belief about a replica the breaker just
@@ -1059,6 +1173,7 @@ class ServingRouter:
         Caller holds the lock."""
         for warm in self._warm.values():
             warm.pop(replica_id, None)
+        self._wal_defer({"t": "cold", "r": replica_id})
 
     #: per-hop read bound for transfer traffic: the plane only buys
     #: admission latency, so a slow donor must cost LESS than the
@@ -1146,6 +1261,7 @@ class ServingRouter:
             self._note_warm(key, receiver.replica_id)
             if wanted and not donors:
                 self.stats["kv_transfer_declined"] += 1
+        self._wal_flush()  # the warm note deferred under the lock
         if not wanted or not donors:
             return
         t0_us = self._now_us()
@@ -1261,14 +1377,274 @@ class ServingRouter:
         with self._lock:
             self.stats["kv_transfers"] += imported
             self.stats["kv_transfer_failures"] += failed
+        self._wal_flush()  # warm notes deferred under the lock
         return {"imported": imported, "attempted": attempted,
                 "failed": failed, "cold": cold}
+
+    # -- write-ahead journal (ISSUE 15 tentpole) -----------------------
+    def _wal_append(self, record: Dict[str, Any]) -> None:
+        """Append one record to the crash ledger (no-op without a
+        ``journal_path``). A failing disk must not take the data
+        plane down with it: the error is counted and the stream keeps
+        relaying — the operator sees ``router_wal_errors`` climb and
+        knows recovery coverage is degrading."""
+        wal = self._wal
+        if wal is None:
+            return
+        try:
+            wal.append(record)
+        except (OSError, ValueError):
+            with self._lock:
+                self.stats["wal_errors"] += 1
+            self.tracer.incr("router_wal_errors")
+
+    def _wal_defer(self, record: Dict[str, Any]) -> None:
+        """Queue one record from a LOCK-HELD site (warm/cold/rep
+        notes fire inside ``self._lock``): file I/O must not run
+        under the router's global lock, so the record is flushed by
+        the nearest unlocked seam (:meth:`_wal_flush` — the caller's
+        epilogue, or the health tick). These record types are
+        advisory state (beliefs, bindings) folded last-wins, so the
+        flush latency costs recovery fidelity only in the window a
+        crash would anyway."""
+        if self._wal is not None:
+            self._wal_deferred.append(record)
+
+    def _wal_flush(self) -> None:
+        """Append every deferred record (caller must NOT hold the
+        router lock). Flushers fully serialize on their own lock —
+        two concurrent flushers interleaving their swapped batches
+        could otherwise append a warm note AFTER the cold record
+        that superseded it, and recovery's last-wins fold would
+        resurrect a dead replica's belief."""
+        if self._wal is None:
+            return
+        with self._wal_flush_lock:
+            with self._lock:
+                if not self._wal_deferred:
+                    return
+                pending, self._wal_deferred = self._wal_deferred, []
+            for record in pending:
+                self._wal_append(record)
+
+    def _wal_snapshot(self) -> Dict[str, Any]:
+        """The compaction snapshot: every OPEN entry (the crash
+        ledger proper — never dropped), the most recent
+        ``wal_retain_done`` terminals (resume/poll breadcrumbs),
+        refreshed token-bucket levels, and the warm-belief map with
+        stamps converted to wall time."""
+        wall = time.time()
+        mono = time.monotonic()
+        with self._lock:
+            entries = []
+            done_kept = 0
+            for rid in sorted(self._journal, reverse=True):
+                e = self._journal[rid]
+                done = e.done.is_set()
+                if done:
+                    if done_kept >= self.wal_retain_done:
+                        continue
+                    done_kept += 1
+                entries.append({
+                    "rid": e.rid, "prompt": e.prompt,
+                    "params": e.params,
+                    "tokens": list(e.tokens),
+                    "replica": e.replica_address, "done": done,
+                    "finish_reason": (e.result or {}).get(
+                        "finish_reason"),
+                    "status": (e.result or {}).get("status"),
+                    "submit_wall": round(
+                        wall - (self._now() - e.submit_t), 3),
+                })
+            buckets = {}
+            for tenant, b in self._buckets.items():
+                b.try_take(0.0)  # refresh the level to NOW
+                buckets[tenant] = {
+                    "tokens": round(b.tokens, 6),
+                    "capacity": b.capacity, "rate": b.rate,
+                    "wall": wall}
+            warm = {
+                k.decode("ascii", "replace"): {
+                    r: round(wall - (mono - s), 3)
+                    for r, s in v.items()}
+                for k, v in self._warm.items() if v}
+            return {"next_rid": self._rid_hwm, "wall": wall,
+                    "entries": entries, "buckets": buckets,
+                    "warm": warm,
+                    "replicas": {r.address: r.replica_id
+                                 for r in self._replicas
+                                 if r.replica_id != r.address}}
+
+    def _compact_wal(self) -> None:
+        """Fold the live state into one snapshot record and rewrite
+        the file (bounded WAL — the on-disk twin of ``journal_cap``).
+        One compactor at a time; the microsecond window between
+        snapshot and rewrite can drop a concurrent progress append,
+        which is safe by construction: greedy replay regenerates the
+        same tokens and the client's Last-Event-ID dedups delivery."""
+        wal = self._wal
+        if wal is None:
+            return
+        with self._lock:
+            if self._compacting:
+                return
+            self._compacting = True
+        try:
+            # arm the carry-over buffer FIRST: any record appended
+            # while the snapshot is being built rides into the
+            # rewritten file verbatim (idempotent folds absorb the
+            # possible duplication) — the rewrite can lose nothing
+            wal.begin_compaction()
+            wal.compact(self._wal_snapshot())
+            with self._lock:
+                self.stats["wal_compactions"] += 1
+            self.tracer.incr("router_wal_compactions")
+        except (OSError, ValueError):
+            with self._lock:
+                self.stats["wal_errors"] += 1
+            self.tracer.incr("router_wal_errors")
+        finally:
+            with self._lock:
+                self._compacting = False
+
+    def _restore_from_wal(self, state: Dict[str, Any]) -> None:
+        """Rebuild the in-memory journal from a recovered WAL fold
+        (constructor path, before the HTTP service exists). Done
+        entries come back poll/resume-servable; open entries queue
+        for the replay pass :meth:`start` launches; bucket levels and
+        warm beliefs come back as if the crash were a long GC pause."""
+        self._recover_t0_us = self._now_us()
+        now = self._now()
+        wall = time.time()
+        mono = time.monotonic()
+        self._rid_hwm = int(state["next_rid"])
+        self._rids = itertools.count(self._rid_hwm)
+        # re-seat the replicas' stable ids before any health scrape:
+        # the rendezvous keyspace holds from the first pick, and a
+        # replica that died WITH the old router opens its breaker
+        # under the same id its restored warm beliefs are keyed by
+        for replica in self._replicas:
+            rid_known = state["replica_ids"].get(replica.address)
+            if rid_known:
+                replica.replica_id = rid_known
+        for rid, rec in sorted(state["entries"].items()):
+            # the persisted submit WALL time folds back onto the new
+            # process's monotonic timeline, so a recovered entry's
+            # age (journal_audit, history, e2e) spans the crash
+            # instead of resetting to zero
+            submit_t = now
+            if rec.get("submit_wall") is not None:
+                submit_t = now - max(
+                    0.0, wall - float(rec["submit_wall"]))
+            entry = _JournalEntry(rid, rec["prompt"],
+                                  dict(rec["params"]), submit_t)
+            entry.recovered = True
+            entry.tokens = list(rec["tokens"])
+            entry.replica_address = rec.get("replica")
+            if self.fleet_trace:
+                entry.trace = f"r{rid}"
+            entry.note(now, "recovered")
+            if rec["done"]:
+                entry.result = {
+                    "id": rid, "tokens": list(entry.tokens),
+                    "finish_reason": rec.get("finish_reason"),
+                    "status": rec.get("status") or 200,
+                    "prompt_len": len(entry.prompt),
+                    "replays": 0, "recovered": True}
+                if entry.trace:
+                    entry.result["trace"] = entry.trace
+                entry.done_t = now
+                entry.done.set()
+            else:
+                self._recovery_open.append(entry)
+                self.stats["recovered_open"] += 1
+            self._journal[rid] = entry
+        self.stats["recovered_entries"] = len(state["entries"])
+        # warm-belief recovery (ISSUE 15 satellite): wall stamps back
+        # to the monotonic clock `_note_warm` speaks. A replica whose
+        # breaker opens during recovery drops these through the same
+        # `_forget_warm` a live death fires — a resurrected replica
+        # still boots cold.
+        for k, beliefs in state["warm"].items():
+            self._warm[k.encode()] = {
+                r: mono - max(0.0, wall - w)
+                for r, w in beliefs.items()}
+        # token-bucket recovery (ISSUE 15 satellite): levels refill
+        # only for the real wall-clock downtime — a flooded tenant is
+        # still throttled the moment the restarted router answers
+        self._recovered_buckets = dict(state["buckets"])
+        self._arm_recovered_buckets()
+        self._recover_pending = len(self._recovery_open)
+
+    def _arm_recovered_buckets(self) -> None:
+        if self.tenants is None or not self._recovered_buckets:
+            return
+        from deeplearning4j_tpu.serving.tenancy import TokenBucket
+
+        wall = time.time()
+        for tenant, saved in self._recovered_buckets.items():
+            spec = self.tenants.spec_of(tenant)
+            if spec.rate_rps is None:
+                continue
+            bucket = TokenBucket(spec.rate_rps, spec.burst)
+            bucket.restore_level(
+                saved.get("tokens", 0.0),
+                age_s=max(0.0, wall - saved.get("wall", wall)))
+            self._buckets[tenant] = bucket
+
+    def _recover_entry(self, entry: _JournalEntry) -> None:
+        """Replay one recovered OPEN entry to its terminal. No client
+        is attached — the emit is a no-op, because `_relay_tokens`
+        already extends ``entry.tokens`` (what resume followers and
+        the final terminal serve) and journals the progress."""
+        try:
+            if entry.temperature > 0 and entry.tokens:
+                # the PR 3/5 contract across the restart: a redrawn
+                # sampling stream cannot splice onto the streamed
+                # prefix — terminate ``fault`` with the partials
+                entry.note(self._now(), "sampling_fault")
+                self._finish(entry, self._fault_terminal(entry))
+            else:
+                self._run_entry(entry, lambda tokens: None,
+                                lambda: None)
+                with self._lock:
+                    self.stats["recovered_replayed"] += 1
+        except Exception:
+            if not entry.done.is_set():
+                self._finish(entry, self._fault_terminal(entry))
+        finally:
+            with self._lock:
+                self._recover_pending -= 1
+                last = self._recover_pending <= 0
+            if last:
+                self._emit_recover_span()
+
+    def _emit_recover_span(self) -> None:
+        """The ``router.recover`` span (ISSUE 15): one lane-0 span on
+        the stitched trace covering WAL restore through the last
+        recovered entry's terminal — a restart reads on the fleet
+        timeline exactly like a failover reads as ``router.replay``."""
+        t0 = self._recover_t0_us
+        if t0 is None:
+            return
+        self._recover_t0_us = None
+        now = self._now_us()
+        if hasattr(self.tracer, "complete"):
+            self.tracer.complete(
+                "router.recover", t0, max(now - t0, 0.0),
+                entries=self.stats["recovered_entries"],
+                open=self.stats["recovered_open"],
+                replayed=self.stats["recovered_replayed"],
+                buckets=len(self._recovered_buckets),
+                warm_keys=len(self._warm))
+        self.tracer.incr("router_recoveries")
 
     # -- journal -------------------------------------------------------
     def _journal_entry(self, prompt: List[int],
                        params: Dict[str, Any]) -> _JournalEntry:
         with self._lock:
             rid = next(self._rids)
+            self._rid_hwm = rid + 1
             entry = _JournalEntry(rid, prompt, params, self._now())
             if self.fleet_trace:
                 # the fleet-level identity (ISSUE 10): every hop —
@@ -1290,7 +1666,14 @@ class ServingRouter:
                         del self._journal[old_rid]
             self.stats["requests"] += 1
             self.tracer.incr("router_requests")
-            return entry
+        # write-ahead (ISSUE 15): the open record lands BEFORE the
+        # first routing attempt, so a crash a microsecond later still
+        # recovers the request
+        self._wal_append({"t": "open", "rid": rid,
+                          "prompt": entry.prompt,
+                          "params": entry.params,
+                          "wall": round(time.time(), 3)})
+        return entry
 
     def journal_audit(self) -> Dict[str, Any]:
         """The chaos-soak ledger: per-entry delivery accounting. A
@@ -1352,6 +1735,17 @@ class ServingRouter:
             if result.get("finish_reason") == "fault":
                 self.stats["request_faults"] += 1
                 self.tracer.incr("router_request_faults")
+        self._wal_append({"t": "done", "rid": entry.rid,
+                          "reason": result.get("finish_reason"),
+                          "status": result.get("status"),
+                          "n": len(entry.tokens)})
+        if self._wal is not None and self._wal.needs_compaction():
+            # off-thread: the relay that happened to trip the
+            # threshold must not pay the snapshot + rewrite + fsyncs
+            # before its client sees the terminal (_compacting keeps
+            # it single-flight)
+            threading.Thread(target=self._compact_wal, daemon=True,
+                             name="router-wal-compact").start()
         return result
 
     def _open_replay_window(self, entry: _JournalEntry,
@@ -1411,6 +1805,17 @@ class ServingRouter:
             else:
                 entry.tokens.append(t)
                 fresh.append(t)
+        if fresh:
+            # write-ahead: the high-water mark advances on disk
+            # BEFORE the tokens go out to the client, so a crash
+            # between the two can only under-count what was delivered
+            # — replay then re-offers tokens the client dedups by
+            # Last-Event-ID, and never loses ones it journaled.
+            # ``at`` makes the record position-addressed (idempotent
+            # under compaction carry-over duplication).
+            self._wal_append({"t": "prog", "rid": entry.rid,
+                              "at": len(entry.tokens) - len(fresh),
+                              "toks": fresh})
         return seen, fresh
 
     def _ping_sleep(self, total_s: float, forward_ping) -> None:
@@ -1503,6 +1908,12 @@ class ServingRouter:
                        f"routed:{replica.replica_id}"
                        f"{':affinity' if by_affinity else ''}"
                        f":rid={stream.id}")
+        # the ADDRESS, not the id: recovery folds this into
+        # ``entry.replica_address`` (the same field the compaction
+        # snapshot persists) — the id↔address binding has its own
+        # ``rep`` records
+        self._wal_append({"t": "route", "rid": entry.rid,
+                          "replica": replica.address})
         if (self.fleet_trace and wait_t0_us is not None
                 and hasattr(self.tracer, "complete")):
             # pick + backoff + submit handshake: everything between
@@ -1737,6 +2148,13 @@ class ServingRouter:
                      "queue_timeout_s", "tenant", "priority"):
             if body.get(knob) is not None:
                 params[knob] = body[knob]
+        if body.get("resumable"):
+            # ISSUE 15: a resumable stream's client disconnect
+            # detaches instead of cancelling (resume via
+            # GET /v1/requests/<id>/stream + Last-Event-ID). Kept in
+            # params so the WAL open record carries it and a
+            # recovered entry stays resumable; replicas ignore it.
+            params["resumable"] = True
         if params.get("tenant") is not None:
             # validate HERE, inside the caller's 400-mapping
             # try/except: a malformed name must answer 400 like the
@@ -1777,7 +2195,22 @@ class ServingRouter:
             if bucket is None:
                 bucket = self._buckets[tenant] = TokenBucket(
                     spec.rate_rps, spec.burst)
-            return bucket.try_take()
+            wait = bucket.try_take()
+            # ISSUE 15 satellite: the level rides the WAL, so a
+            # restarted router refills only for real downtime — a
+            # flooder's bucket comes back as empty as it died. The
+            # record is DEFERRED from under the lock (build order =
+            # level order, and the serialized flushers preserve it —
+            # two racing appends could otherwise land a stale fuller
+            # level after the newer one) and flushed right below,
+            # outside the lock.
+            self._wal_defer({"t": "bucket", "tenant": tenant,
+                             "tokens": round(bucket.tokens, 6),
+                             "capacity": bucket.capacity,
+                             "rate": bucket.rate,
+                             "wall": round(time.time(), 3)})
+        self._wal_flush()
+        return wait
 
     def _tenant_queue_share_s(self, tenant: str) -> float:
         """The tenant's open-request share priced in replica waves —
@@ -1847,30 +2280,58 @@ class ServingRouter:
     def _stream_response(self, handler, entry: _JournalEntry) -> None:
         with self._lock:
             self.stats["streams"] += 1
+        detached = [False]
         try:
             handler.start_stream("text/event-stream")
-            handler.send_event({"id": entry.rid})
+            handler.send_event({"id": entry.rid,
+                                "resumable": entry.resumable},
+                               event_id=0)
 
             # client-facing writes raise _ClientGone so _run_entry
-            # can tell "my client left" apart from "the replica died"
-            def emit(tokens: List[int]) -> None:
-                try:
-                    handler.send_event({"id": entry.rid,
-                                        "tokens": tokens})
-                except OSError as e:
+            # can tell "my client left" apart from "the replica
+            # died" — EXCEPT on a resumable stream (ISSUE 15), where
+            # a vanished client DETACHES: the relay keeps running
+            # with these emits degraded to no-ops, every token still
+            # lands in the journal, and the client reconnects via
+            # GET /v1/requests/<rid>/stream + Last-Event-ID
+            def gone(e: OSError) -> None:
+                if not entry.resumable:
                     raise _ClientGone() from e
+                if not detached[0]:
+                    detached[0] = True
+                    with self._lock:
+                        self.stats["detached_streams"] += 1
+                    self.tracer.incr("router_detached_streams")
+                    entry.note(self._now(), "client_detached")
+
+            def emit(tokens: List[int]) -> None:
+                if detached[0]:
+                    return
+                try:
+                    # the SSE id is the cumulative delivered-token
+                    # count — entry.tokens already includes this
+                    # delta (extended by _relay_tokens before emit)
+                    handler.send_event({"id": entry.rid,
+                                        "tokens": tokens},
+                                       event_id=len(entry.tokens))
+                except OSError as e:
+                    gone(e)
 
             def ping() -> None:
+                if detached[0]:
+                    return
                 try:
                     handler.send_ping()
                 except OSError as e:
-                    raise _ClientGone() from e
+                    gone(e)
 
             result = self._run_entry(entry, emit, ping)
-            out = dict(result)
-            out["done"] = True
-            handler.send_event(out)
-            handler.end_stream()
+            if not detached[0]:
+                out = dict(result)
+                out["done"] = True
+                handler.send_event(out,
+                                   event_id=len(entry.tokens))
+                handler.end_stream()
         except (_ClientGone, BrokenPipeError, ConnectionResetError,
                 OSError):
             # the ROUTER's client vanished: cancel on the replica and
@@ -1889,6 +2350,53 @@ class ServingRouter:
             if not entry.done.is_set():
                 self._finish(entry, self._fault_terminal(
                     entry, "cancelled", 499))
+
+    def _handle_stream_resume(self, handler, path: str,
+                              query: str) -> None:
+        """``GET /v1/requests/<rid>/stream`` (ISSUE 15 tentpole): a
+        dropped client reconnects and resumes its stream from the
+        journal — ``Last-Event-ID`` (or ``?from=N``) names the last
+        token position it received, and the reply replays everything
+        past it from the entry's high-water mark, then FOLLOWS the
+        live entry (replay after a replica death, recovery after a
+        router restart) until the terminal. Zero duplicated and zero
+        lost tokens: the journal is the single source of truth and
+        the cursor is an exact token position. Works on any journaled
+        entry (a blocking submit's progress is followable too); a
+        vanished resume consumer just ends — it never cancels the
+        underlying request."""
+        parsed = handler.read_resume_cursor(path, query)
+        if parsed is None:
+            return
+        rid, cursor = parsed
+        with self._lock:
+            entry = self._journal.get(rid)
+        if entry is None:
+            handler.send_json({"error": f"unknown request {rid}"},
+                              404, close=True)
+            return
+        with self._lock:
+            self.stats["resumed_streams"] += 1
+        self.tracer.incr("router_resumed_streams")
+        entry.note(self._now(), f"resumed:from={cursor}")
+
+        def poll(at):
+            with self._lock:
+                total = len(entry.tokens)
+                tail = ([int(t) for t in entry.tokens[at:]]
+                        if total > at else [])
+                return (tail, total,
+                        entry.done.is_set() or self._stopped,
+                        entry.result)
+
+        try:
+            handler.follow_stream(rid, cursor, poll,
+                                  entry.done.wait, self.keepalive_s)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the resume consumer vanished: nothing to cancel — the
+            # underlying request belongs to its primary stream (or
+            # to the recovery replay), and another resume may follow
+            pass
 
     def _handle_cancel(self, handler, path: str) -> None:
         tail = path.rsplit("/", 1)[-1]
@@ -1951,12 +2459,23 @@ class ServingRouter:
                          if not e.done.is_set())
         routable = any(s["state"] in ("live", "degraded")
                        for s in statuses)
-        return {"ok": routable and not self._stopped,
-                "state": "stopped" if self._stopped else (
-                    "live" if routable else "dead"),
-                "replicas": statuses,
-                "journal_entries": len(self._journal),
-                "journal_open": open_n}
+        out = {"ok": routable and not self._stopped,
+               "state": "stopped" if self._stopped else (
+                   "live" if routable else "dead"),
+               "replicas": statuses,
+               "journal_entries": len(self._journal),
+               "journal_open": open_n}
+        if self._wal is not None:
+            out["wal"] = {"path": self._wal.path,
+                          "fsync": self._wal.fsync,
+                          "bytes": self._wal.size_bytes,
+                          "compactions":
+                              self.stats["wal_compactions"],
+                          "recovered_entries":
+                              self.stats["recovered_entries"],
+                          "recovered_open":
+                              self.stats["recovered_open"]}
+        return out
 
     def _metrics_text(self) -> str:
         with self._lock:

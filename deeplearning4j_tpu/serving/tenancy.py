@@ -255,6 +255,20 @@ class TokenBucket:
             return 0.0
         return (n - self.tokens) / self.rate
 
+    def restore_level(self, tokens: float,
+                      age_s: float = 0.0) -> None:
+        """Overwrite the level with a persisted one (ISSUE 15: the
+        router's WAL carries bucket levels through a crash).
+        ``tokens`` is the level as of ``age_s`` seconds ago; refill
+        accrues for exactly that downtime, capped at capacity — a
+        restarted router neither refills a flooder's bucket nor
+        forgets real elapsed time."""
+        self.tokens = min(
+            self.capacity,
+            max(0.0, float(tokens))
+            + max(0.0, float(age_s)) * self.rate)
+        self._t = self._clock()
+
 
 class WeightedFairScheduler(Scheduler):
     """Deficit-round-robin admission over per-tenant queues.

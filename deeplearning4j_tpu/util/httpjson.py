@@ -24,8 +24,9 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 #: Dapper-style trace-context carrier (ISSUE 10): the router mints
 #: ``<trace_id>/<span_id>`` per request attempt and every hop
@@ -206,15 +207,117 @@ class JsonHandler(BaseHTTPRequestHandler):
 
     # -- SSE framing (one definition for every streaming service:
     # the gateway and the router must never drift on the wire format)
-    def send_event(self, obj: Dict[str, Any]) -> None:
-        self.send_chunk(b"data: " + json.dumps(obj).encode()
-                        + b"\n\n")
+    def send_event(self, obj: Dict[str, Any],
+                   event_id: Optional[int] = None) -> None:
+        """One SSE data event. ``event_id`` (ISSUE 15) rides as the
+        standard ``id:`` field — the serving streams use the
+        cumulative delivered-token count, so a client that
+        reconnects with ``Last-Event-ID: N`` resumes at exactly
+        token N: monotone, gap-free, duplicate-free by the SSE
+        contract itself."""
+        frame = b""
+        if event_id is not None:
+            frame += b"id: %d\n" % int(event_id)
+        self.send_chunk(frame + b"data: "
+                        + json.dumps(obj).encode() + b"\n\n")
 
     def send_ping(self) -> None:
         # SSE comment line: ignored by clients, but the write probes
         # whether the peer is still there (a vanished client surfaces
         # as a send error)
         self.send_chunk(b": ping\n\n")
+
+    def read_resume_cursor(self, path: str, query: str
+                           ) -> Optional[Tuple[int, int]]:
+        """Parse a ``GET /v1/requests/<rid>/stream`` resume request
+        into ``(rid, cursor)`` — the cursor from ``Last-Event-ID``
+        (the SSE-standard reconnect carrier) or the ``?from=``
+        query fallback, defaulting to 0. ONE definition (ISSUE 15):
+        the request-side twin of :meth:`send_event`'s ``id:``
+        framing, shared by the gateway's and the router's resume
+        endpoints so the two cannot drift. Sends the **400** itself
+        and returns ``None`` on a malformed id/cursor — the caller
+        just returns."""
+        tail = path[len("/v1/requests/"):-len("/stream")]
+        try:
+            rid = int(tail)
+        except ValueError:
+            self.send_json({"error": f"bad request id {tail!r}"},
+                           400, close=True)
+            return None
+        last_id = self.headers.get("Last-Event-ID")
+        if last_id is None:
+            for part in query.split("&"):
+                if part.startswith("from="):
+                    last_id = part[len("from="):]
+        try:
+            cursor = int(last_id) if last_id is not None else 0
+        except ValueError:
+            self.send_json(
+                {"error": f"bad Last-Event-ID {last_id!r}"}, 400,
+                close=True)
+            return None
+        if cursor < 0:
+            self.send_json(
+                {"error": f"negative resume cursor {cursor}"}, 400,
+                close=True)
+            return None
+        return rid, cursor
+
+    def follow_stream(
+            self, rid: int, cursor: int,
+            poll: Callable[[], Tuple[List[int], bool,
+                                     Optional[Dict[str, Any]]]],
+            wait: Callable[[float], Any],
+            keepalive_s: float) -> None:
+        """The response half of a stream resume (ISSUE 15), shared by
+        the gateway's and the router's endpoints so the cursor math,
+        event-id monotonicity, and keepalive cadence cannot drift —
+        the body-side twin of :meth:`read_resume_cursor`.
+
+        ``poll(cursor) -> (tail, total, done, terminal)``: the
+        delivered tokens PAST the cursor (never the whole list — a
+        long stream's follower must not copy O(n) per tick), the
+        total delivered count, whether the request is finished, and
+        the terminal dict to emit (None = end WITHOUT a terminal:
+        the underlying request was dropped or the server stopped).
+        ``wait(timeout_s)`` blocks until progress may have happened
+        (typically the entry's done-Event wait). Emits the head event
+        at ``cursor``, replays/follows everything past it — each
+        event's ``id:`` is the cumulative token count, so a resumed
+        stream is itself resumable — pings at ``keepalive_s`` cadence
+        while idle (waking on a shorter quantum so followed tokens
+        flow per-delta), and finishes with the terminal. The usual
+        OSError family propagates when the consumer vanishes; the
+        caller decides what that means."""
+        self.start_stream("text/event-stream")
+        self.send_event({"id": rid, "resumed": True,
+                         "from": cursor}, event_id=cursor)
+        last_ping = time.monotonic()
+        quantum = min(keepalive_s, 0.05)
+        while True:
+            tail, total, done, terminal = poll(cursor)
+            if tail:
+                # a cursor AHEAD of the tokens (the client saw
+                # tokens a crash window lost) yields an empty tail
+                # and waits below: deterministic replay regrows the
+                # list past it
+                self.send_event({"id": rid, "tokens": tail},
+                                event_id=cursor + len(tail))
+                cursor += len(tail)
+                continue
+            if done:
+                if terminal is not None:
+                    out = dict(terminal)
+                    out["done"] = True
+                    self.send_event(out, event_id=total)
+                self.end_stream()
+                return
+            now = time.monotonic()
+            if now - last_ping >= keepalive_s:
+                self.send_ping()
+                last_ping = now
+            wait(quantum)
 
 
 class _QuietThreadingHTTPServer(ThreadingHTTPServer):

@@ -37,6 +37,13 @@ and fronts them with the
    premium stream completes at SLO, bit-identical — and the
    per-tenant `{tenant=...}` latency histograms read back through
    `latency_report --tenant` rows from the federated scrape.
+7. **Durable router (ISSUE 15)** — a router armed with a write-ahead
+   journal is SIGKILLed mid-stream (step 8): a fresh router recovers
+   from the same WAL, replays the open stream through the PR 9 path,
+   and the client resumes with `Last-Event-ID` — the concatenation
+   of pre-kill and post-recovery deltas is bit-identical to the
+   fault-free ids, and the recovery reads as a `router.recover` span
+   on the stitched trace.
 
 Run: python examples/serving_router.py
 """
@@ -401,6 +408,66 @@ def main():
           f"{cold_direct['tokens'] == first['tokens']}")
     kv_router.close()
     for g in kv_replicas:
+        g.close()
+
+    # 8. Durable router (ISSUE 15): kill the ROUTER mid-stream,
+    # restart it against the same write-ahead journal, resume the
+    # client with Last-Event-ID — zero duplicated, zero lost tokens,
+    # ids identical to the fault-free reference.
+    import tempfile
+
+    wal_path = os.path.join(tempfile.mkdtemp(prefix="router-wal-"),
+                            "router.wal")
+    wal_replicas = [replica(0), replica(1)]
+    wal_addrs = [g.address for g in wal_replicas]
+
+    def wal_router():
+        return ServingRouter(
+            wal_addrs, affinity_block_tokens=4,
+            health_interval_s=0.1, probe_interval_s=0.5,
+            failure_threshold=2, journal_path=wal_path).start()
+
+    r1 = wal_router()
+    c1 = RouterClient(r1.address)
+    n_gen = 24
+    reference = c1.generate(PATTERN[:5], n_gen)["tokens"]
+    stream = c1.stream(PATTERN[:5], n_gen, resumable=True)
+    rid = stream.id
+    got = []
+    for delta in stream:
+        got.extend(delta)
+        if len(got) >= 6:
+            break  # the crash lands mid-stream
+    stream.close()
+    # SIGKILL stand-in for the in-process router: the WAL freezes,
+    # the HTTP service dies abruptly — no drain, no goodbye (the
+    # registered soak does this to a real subprocess with a real
+    # SIGKILL: scripts/router_restart_soak.py)
+    if r1._wal is not None:
+        r1._wal.close()
+    r1._stopped = True
+    r1._service.hard_stop()
+    print(f"durable  : router KILLED with stream {rid} at "
+          f"{len(got)}/{n_gen} tokens (WAL "
+          f"{os.path.getsize(wal_path)} bytes)")
+
+    r2 = wal_router()  # a fresh process would do exactly this
+    c2 = RouterClient(r2.address)
+    cursor = len(got)
+    resumed = c2.resume(rid, last_event_id=cursor)
+    for delta in resumed:
+        got.extend(delta)
+    recover = next(e for e in r2.tracer.events()
+                   if e.get("name") == "router.recover")
+    print(f"           restarted router recovered "
+          f"{r2.stats['recovered_entries']} entries "
+          f"({r2.stats['recovered_open']} open, replayed via the "
+          f"PR 9 path), router.recover span on the stitched trace: "
+          f"{recover['args']}")
+    print(f"           client resumed at Last-Event-ID={cursor} "
+          f"-> ids identical across the kill: {got == reference}")
+    r2.close()
+    for g in wal_replicas:
         g.close()
 
 

@@ -59,12 +59,20 @@ def test_all_examples_listed():
 #: next-heaviest smokes (~6-8 s each) join the slow tier to
 #: compensate — their paths stay tier-1-covered by
 #: tests/test_sequence_parallel.py, tests/test_pipeline_expert.py,
-#: and tests/test_serving_gateway.py
+#: and tests/test_serving_gateway.py.
+#: ISSUE 15 added tests/test_router_journal.py + the fast
+#: router-restart soak (~+45 s of tier-1): the next-heaviest smokes
+#: (mnist_mlp ~5 s, fsdp_zero3_training ~4 s) join the slow tier —
+#: tier-1 covers the same paths through tests/test_mnist_e2e.py and
+#: tests/test_scaleout.py (FSDP composes validated in
+#: MULTICHIP_r05.json)
 SLOW_EXAMPLES = {"flagship_transformer.py", "streaming_decode.py",
                  "serving_router.py",
                  "sequence_parallel_transformer.py",
                  "moe_expert_parallel.py",
-                 "serving_gateway.py"}
+                 "serving_gateway.py",
+                 "mnist_mlp.py",
+                 "fsdp_zero3_training.py"}
 
 
 @pytest.mark.parametrize(

@@ -295,6 +295,41 @@ class TestScaleActions:
             seed_rep.shutdown()
 
 
+class TestReattach:
+    """ISSUE 15 satellite: the controller survives a router restart —
+    ``attach(new_router)`` swaps the reference, follows the new
+    tracer, and resets the windowed-TTFT delta + streak state so the
+    controller re-learns the fleet from live scrapes instead of
+    acting on pre-crash momentum."""
+
+    def test_attach_swaps_router_and_resets_window_state(self):
+        old = _StubRouter()
+        ctl = _controller(router=old, ttft_p99_slo_s=1.0)
+        ctl._breach_streak = 2
+        ctl._idle_streak = 4
+        ctl._prev_ttft = (["0.1"], [5])
+        new = _StubRouter()
+        ctl.attach(new)
+        assert ctl.router is new
+        assert ctl.tracer is new.tracer
+        assert ctl._prev_ttft is None
+        assert ctl._breach_streak == 0
+        assert ctl._idle_streak == 0
+        # signals() and the loop read through the NEW router
+        sig = ctl.signals()
+        assert sig["n_registered"] == 0
+
+    def test_attach_keeps_adopted_handles(self):
+        ctl = _controller()
+
+        class H:
+            replica_id = "rep-x"
+
+        ctl.adopt(H())
+        ctl.attach(_StubRouter())
+        assert "rep-x" in ctl._handles
+
+
 class TestControllerValidation:
     def test_bad_bounds_rejected(self):
         with pytest.raises(ValueError):

@@ -1,0 +1,841 @@
+"""Durable router (ISSUE 15 tentpole): the write-ahead journal, crash
+recovery, client stream resumption, and the satellites that ride them.
+
+Contracts under test:
+
+- the WAL wire format survives torn tails, CRC corruption, and
+  compaction — recovery folds exactly the intact prefix;
+- a router restarted against its WAL replays open streams
+  bit-identically (high-water dedup across the restart) and serves
+  done entries from breadcrumbs;
+- token-bucket levels survive the restart (the PR 13 known-fact
+  regression: a flooder is still throttled immediately after
+  recovery) and warm-KV beliefs survive it too, minus any replica
+  whose breaker opens during recovery (the PR 14 cold-resurrection
+  rule, extended across router restarts);
+- SSE event ids count delivered tokens exactly, and resume by
+  ``Last-Event-ID`` is gap- and duplicate-free, live or from
+  breadcrumbs;
+- the bounded in-memory journal NEVER evicts an open entry, even
+  under done-entry pressure past the cap (ISSUE 15 satellite — only
+  the happy path was tested before).
+"""
+
+import contextlib
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (
+    DecodeEngine,
+    GatewayError,
+    JournalError,
+    Request,
+    RouterClient,
+    ServingGateway,
+    ServingRouter,
+    TenantRegistry,
+    TenantSpec,
+    TokenBucket,
+    WriteAheadJournal,
+    read_records,
+    recover_state,
+)
+
+V = 12
+NET_SEED = 11  # non-constant greedy streams: dedup checking bites
+
+
+def _net(seed=NET_SEED, stream_max_t=96):
+    net = MultiLayerNetwork(transformer_lm(
+        n_in=V, width=32, n_layers=2, n_heads=4, n_classes=V,
+        seed=seed)).init()
+    for c in net.conf.confs:
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = stream_max_t
+    return net
+
+
+def _throttle(engine, delay_s):
+    orig = engine.step
+
+    def slow(sink=None):
+        time.sleep(delay_s)
+        return orig(sink)
+
+    engine.step = slow
+
+
+def _wait_for(cond, timeout=30.0, interval=0.01, msg="condition"):
+    t0 = time.monotonic()
+    while not cond():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(interval)
+
+
+def _reference(net, prompt, n):
+    eng = DecodeEngine(net, n_slots=2, decode_chunk=2, seed=0)
+    rid = eng.submit(Request(list(prompt), n))
+    return eng.run()[rid].tokens
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _net()
+
+
+@pytest.fixture(scope="module")
+def gateways(net):
+    """Two throttled replicas shared by the restart tests (router
+    instances come and go per test; the replica tier persists)."""
+    engines = [DecodeEngine(net, n_slots=3, decode_chunk=2, seed=0)
+               for _ in range(2)]
+    for e in engines:
+        _throttle(e, 0.03)
+    gws = [ServingGateway(e, keepalive_s=0.1,
+                          replica_id=f"wal-rep-{i}").start()
+           for i, e in enumerate(engines)]
+    yield gws
+    for g in gws:
+        with contextlib.suppress(Exception):
+            g.close()
+
+
+def _router(gateways, wal_path, **kw):
+    kw.setdefault("affinity_block_tokens", 4)
+    kw.setdefault("health_interval_s", 0.1)
+    kw.setdefault("probe_interval_s", 0.4)
+    kw.setdefault("failure_threshold", 2)
+    return ServingRouter([g.address for g in gateways],
+                         journal_path=wal_path, **kw).start()
+
+
+def _kill(router):
+    """SIGKILL stand-in for an in-process router: the WAL freezes
+    FIRST (a real SIGKILL stops appends and sockets in the same
+    instant; in-process, the still-running relay threads must not
+    journal past the 'kill'), then the HTTP service dies abruptly —
+    no drain, no finalization, no clean-shutdown marker (there is
+    none)."""
+    if router._wal is not None:
+        router._wal.close()
+    router._stopped = True
+    router._service.hard_stop()
+
+
+# ---------------------------------------------------------------------------
+# WAL wire format + recovery fold (no engines involved)
+# ---------------------------------------------------------------------------
+
+class TestWireFormat:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "j.wal")
+        wal = WriteAheadJournal(path, fsync="off")
+        recs = [{"t": "open", "rid": 0, "prompt": [1, 2],
+                 "params": {"max_new_tokens": 4}, "wall": 1.0},
+                {"t": "prog", "rid": 0, "toks": [5, 6]},
+                {"t": "done", "rid": 0, "reason": "length",
+                 "status": 200, "n": 2}]
+        for r in recs:
+            wal.append(r)
+        wal.close()
+        out, torn = read_records(path)
+        assert out == recs
+        assert torn == 0
+
+    def test_torn_tail_truncated_and_appendable(self, tmp_path):
+        path = str(tmp_path / "j.wal")
+        wal = WriteAheadJournal(path, fsync="per_record")
+        wal.append({"t": "open", "rid": 0, "prompt": [1],
+                    "params": {}})
+        wal.append({"t": "prog", "rid": 0, "toks": [7]})
+        wal.close()
+        # chop mid-record: the torn tail a crash mid-append leaves
+        size = os.path.getsize(path)
+        with open(path, "rb+") as f:
+            f.truncate(size - 3)
+        out, torn = read_records(path)
+        assert [r["t"] for r in out] == ["open"]
+        assert torn > 0
+        # reopening truncates the tear and appends cleanly after it
+        wal2 = WriteAheadJournal(path, fsync="off")
+        assert [r["t"] for r in wal2.recovered] == ["open"]
+        assert wal2.torn_tail_bytes > 0
+        wal2.append({"t": "done", "rid": 0, "reason": "fault",
+                     "status": 500, "n": 0})
+        wal2.close()
+        out2, torn2 = read_records(path)
+        assert [r["t"] for r in out2] == ["open", "done"]
+        assert torn2 == 0
+
+    def test_crc_corruption_stops_the_fold(self, tmp_path):
+        path = str(tmp_path / "j.wal")
+        wal = WriteAheadJournal(path, fsync="off")
+        wal.append({"t": "open", "rid": 0, "prompt": [1],
+                    "params": {}})
+        mark = wal.size_bytes
+        wal.append({"t": "prog", "rid": 0, "toks": [3]})
+        wal.append({"t": "done", "rid": 0, "reason": "length",
+                    "status": 200, "n": 1})
+        wal.close()
+        with open(path, "rb+") as f:  # flip one payload byte
+            f.seek(mark + 10)
+            b = f.read(1)
+            f.seek(mark + 10)
+            f.write(bytes([b[0] ^ 0xFF]))
+        out, torn = read_records(path)
+        assert [r["t"] for r in out] == ["open"]
+        assert torn > 0  # everything from the corrupt frame on
+
+    def test_oversized_frame_is_corruption(self, tmp_path):
+        path = str(tmp_path / "j.wal")
+        wal = WriteAheadJournal(path, fsync="off")
+        wal.append({"t": "open", "rid": 0, "prompt": [],
+                    "params": {}})
+        wal.close()
+        with open(path, "ab") as f:  # a frame claiming 1 GiB
+            f.write(struct.pack("<II", 1 << 30, 0) + b"xx")
+        out, torn = read_records(path)
+        assert len(out) == 1
+        assert torn > 0
+
+    def test_not_a_journal_raises(self, tmp_path):
+        path = str(tmp_path / "j.wal")
+        with open(path, "wb") as f:
+            f.write(b"definitely not a journal")
+        with pytest.raises(JournalError):
+            read_records(path)
+        with pytest.raises(JournalError):
+            WriteAheadJournal(path)
+
+    def test_bad_fsync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            WriteAheadJournal(str(tmp_path / "j.wal"),
+                              fsync="sometimes")
+
+    def test_compaction_atomic_and_bounded(self, tmp_path):
+        path = str(tmp_path / "j.wal")
+        wal = WriteAheadJournal(path, fsync="off",
+                                compact_bytes=256)
+        for i in range(32):
+            wal.append({"t": "open", "rid": i,
+                        "prompt": list(range(8)), "params": {}})
+        assert wal.needs_compaction()
+        wal.compact({"next_rid": 32, "wall": 123.0,
+                     "entries": [{"rid": 31,
+                                  "prompt": list(range(8)),
+                                  "params": {}, "tokens": [1],
+                                  "done": False}],
+                     "buckets": {}, "warm": {}})
+        assert wal.size_bytes < 256
+        wal.append({"t": "prog", "rid": 31, "toks": [2]})
+        wal.close()
+        out, torn = read_records(path)
+        assert torn == 0
+        assert [r["t"] for r in out] == ["snap", "prog"]
+        state = recover_state(out)
+        assert state["next_rid"] == 32
+        assert state["entries"][31]["tokens"] == [1, 2]
+
+
+class TestWireFormatCarryOver:
+    def test_compaction_carries_concurrent_appends(self, tmp_path):
+        """A record appended between begin_compaction() and
+        compact() must survive the rewrite — the zero-lost-streams
+        guarantee cannot have a compaction-shaped hole."""
+        path = str(tmp_path / "j.wal")
+        wal = WriteAheadJournal(path, fsync="off")
+        wal.append({"t": "open", "rid": 0, "prompt": [1],
+                    "params": {}})
+        wal.begin_compaction()
+        # "concurrent" append while the owner builds its snapshot —
+        # rid 1 is NOT in the snapshot below
+        wal.append({"t": "open", "rid": 1, "prompt": [2],
+                    "params": {}})
+        wal.compact({"next_rid": 1, "wall": 1.0,
+                     "entries": [{"rid": 0, "prompt": [1],
+                                  "params": {}, "tokens": [],
+                                  "done": False}],
+                     "buckets": {}, "warm": {}})
+        wal.close()
+        out, torn = read_records(path)
+        assert torn == 0
+        assert [r["t"] for r in out] == ["snap", "open"]
+        state = recover_state(out)
+        assert set(state["entries"]) == {0, 1}
+        assert state["next_rid"] == 2
+
+    def test_oversized_record_rejected_at_append(self, tmp_path):
+        """The reader treats an oversized frame as corruption and
+        stops there — so the WRITER must refuse it, or one giant
+        record would silently poison every record after it."""
+        path = str(tmp_path / "j.wal")
+        wal = WriteAheadJournal(path, fsync="off")
+        wal.append({"t": "open", "rid": 0, "prompt": [1],
+                    "params": {}})
+        with pytest.raises(ValueError):
+            wal.append({"t": "open", "rid": 1,
+                        "prompt": [7] * (6 << 20), "params": {}})
+        wal.append({"t": "done", "rid": 0, "reason": "length",
+                    "status": 200, "n": 0})
+        wal.close()
+        out, torn = read_records(path)
+        assert torn == 0
+        assert [r["t"] for r in out] == ["open", "done"]
+
+    def test_prog_past_a_positional_gap_is_dropped(self):
+        """A prog record whose start position lies beyond the folded
+        tokens (an earlier append was swallowed by a disk hiccup)
+        must be DROPPED — splicing it at the wrong absolute position
+        would serve wrong tokens to a resuming client; replay
+        regenerates the real ones instead."""
+        state = recover_state([
+            {"t": "open", "rid": 0, "prompt": [1], "params": {}},
+            {"t": "prog", "rid": 0, "at": 2, "toks": [8, 9]},
+            {"t": "prog", "rid": 0, "at": 0, "toks": [5]},
+        ])
+        assert state["entries"][0]["tokens"] == [5]
+
+    def test_carry_over_duplicates_fold_idempotently(self):
+        """Carry-over may duplicate a record the snapshot already
+        reflects: a duplicated open must not clobber folded
+        progress, and position-addressed prog records land on the
+        same positions instead of appending twice."""
+        state = recover_state([
+            {"t": "snap", "next_rid": 1, "wall": 1.0,
+             "entries": [{"rid": 0, "prompt": [1], "params": {},
+                          "tokens": [5, 6], "done": False}],
+             "buckets": {}, "warm": {}},
+            # all three already folded into the snapshot above
+            {"t": "open", "rid": 0, "prompt": [1], "params": {}},
+            {"t": "prog", "rid": 0, "at": 0, "toks": [5, 6]},
+            # genuinely new progress after the duplicates
+            {"t": "prog", "rid": 0, "at": 2, "toks": [7]},
+        ])
+        assert state["entries"][0]["tokens"] == [5, 6, 7]
+
+
+class TestRecoveryFold:
+    def test_lifecycle_fold(self):
+        state = recover_state([
+            {"t": "open", "rid": 0, "prompt": [1, 2],
+             "params": {"max_new_tokens": 4}, "wall": 10.0},
+            {"t": "route", "rid": 0, "replica": "rep-1"},
+            {"t": "prog", "rid": 0, "toks": [5]},
+            {"t": "prog", "rid": 0, "toks": [6, 7]},
+            {"t": "open", "rid": 1, "prompt": [3], "params": {}},
+            {"t": "done", "rid": 0, "reason": "length",
+             "status": 200, "n": 3},
+        ])
+        assert state["next_rid"] == 2
+        e0, e1 = state["entries"][0], state["entries"][1]
+        assert e0["tokens"] == [5, 6, 7]
+        assert e0["done"] and e0["finish_reason"] == "length"
+        assert e0["replica"] == "rep-1"
+        assert not e1["done"] and e1["tokens"] == []
+
+    def test_done_count_is_authoritative(self):
+        # a prog append racing the crash may land after the terminal
+        state = recover_state([
+            {"t": "open", "rid": 0, "prompt": [1], "params": {}},
+            {"t": "prog", "rid": 0, "toks": [5, 6, 7]},
+            {"t": "done", "rid": 0, "reason": "length",
+             "status": 200, "n": 2},
+        ])
+        assert state["entries"][0]["tokens"] == [5, 6]
+
+    def test_bucket_newest_wins_and_warm_cold(self):
+        state = recover_state([
+            {"t": "bucket", "tenant": "a", "tokens": 5.0,
+             "capacity": 6.0, "rate": 1.0, "wall": 10.0},
+            {"t": "bucket", "tenant": "a", "tokens": 0.5,
+             "capacity": 6.0, "rate": 1.0, "wall": 20.0},
+            {"t": "warm", "k": "1,2,3,4", "r": "rep-0",
+             "wall": 11.0},
+            {"t": "warm", "k": "1,2,3,4", "r": "rep-1",
+             "wall": 12.0},
+            {"t": "cold", "r": "rep-0"},
+        ])
+        assert state["buckets"]["a"]["tokens"] == 0.5
+        assert state["warm"] == {"1,2,3,4": {"rep-1": 12.0}}
+
+    def test_snap_replaces_prior_state(self):
+        state = recover_state([
+            {"t": "open", "rid": 0, "prompt": [1], "params": {}},
+            {"t": "snap", "next_rid": 7, "wall": 50.0,
+             "entries": [{"rid": 5, "prompt": [9], "params": {},
+                          "tokens": [4], "done": True,
+                          "finish_reason": "length",
+                          "status": 200}],
+             "buckets": {"b": {"tokens": 1.0, "capacity": 2.0,
+                               "rate": 1.0, "wall": 50.0}},
+             "warm": {"9,9,9,9": {"rep-1": 49.0}}},
+            {"t": "open", "rid": 7, "prompt": [2], "params": {}},
+        ])
+        assert set(state["entries"]) == {5, 7}
+        assert state["next_rid"] == 8
+        assert state["buckets"]["b"]["tokens"] == 1.0
+
+    def test_unknown_record_types_skipped(self):
+        state = recover_state([
+            {"t": "from_the_future", "x": 1},
+            {"t": "open", "rid": 0, "prompt": [1], "params": {}},
+        ])
+        assert set(state["entries"]) == {0}
+
+
+def test_stream_event_id_commits_only_with_its_data():
+    """The SSE dispatch rule, client-side: an ``id:`` line whose
+    event was torn off by a disconnect must NOT advance
+    ``last_event_id`` — resuming from it would skip tokens the
+    client never received."""
+    from deeplearning4j_tpu.serving import GatewayStream
+
+    class _Resp:
+        def __init__(self, lines):
+            self._lines = list(lines)
+
+        def readline(self):
+            return self._lines.pop(0) if self._lines else b""
+
+        def close(self):
+            pass
+
+    class _Conn:
+        def close(self):
+            pass
+
+    resp = _Resp([b"id: 0\n", b'data: {"id": 7}\n', b"\n",
+                  b"id: 3\n", b'data: {"id": 7, "tokens": [1, 2, '
+                  b'3]}\n', b"\n",
+                  b"id: 9\n"])  # the event after this id is TORN off
+    s = GatewayStream(_Conn(), resp)
+    assert s.id == 7
+    assert s.last_event_id == 0
+    kinds = list(s.raw_events())
+    assert ("event", {"id": 7, "tokens": [1, 2, 3]}) in kinds
+    # the delivered event committed its id; the torn one did not
+    assert s.last_event_id == 3
+
+
+def test_token_bucket_restore_level():
+    clock = [100.0]
+    b = TokenBucket(2.0, burst=4.0, clock=lambda: clock[0])
+    # an empty bucket restored with zero downtime stays empty
+    b.restore_level(0.0, age_s=0.0)
+    assert b.try_take() > 0
+    # downtime accrues refill at the configured rate...
+    b.restore_level(0.0, age_s=1.0)
+    assert b.tokens == pytest.approx(2.0)
+    # ...capped at capacity, and never goes negative
+    b.restore_level(3.0, age_s=100.0)
+    assert b.tokens == pytest.approx(4.0)
+    b.restore_level(-5.0, age_s=0.0)
+    assert b.tokens == 0.0
+
+
+# ---------------------------------------------------------------------------
+# router restart recovery (the tentpole, in-process)
+# ---------------------------------------------------------------------------
+
+class TestRestartRecovery:
+    def test_open_stream_recovers_bit_identical(self, net, gateways,
+                                                tmp_path):
+        wal = str(tmp_path / "r.wal")
+        prompt, n = [1, 2, 3, 4, 5, 6], 24
+        ref = _reference(net, prompt, n)
+        r1 = _router(gateways, wal)
+        c1 = RouterClient(r1.address, timeout_s=60.0)
+        s = c1.stream(prompt, n, resumable=True)
+        rid = s.id
+        got = []
+        for delta in s:
+            got.extend(delta)
+            if len(got) >= 4:
+                break
+        s.close()
+        _kill(r1)
+
+        r2 = _router(gateways, wal)
+        try:
+            assert r2.stats["recovered_entries"] >= 1
+            assert r2.stats["recovered_open"] >= 1
+            c2 = RouterClient(r2.address, timeout_s=60.0)
+            s2 = c2.resume(rid, last_event_id=len(got))
+            seg = []
+            for delta in s2:
+                seg.extend(delta)
+                # wire-level exactly-once: id == cumulative count
+                assert s2.last_event_id == len(got) + len(seg)
+            assert s2.result is not None
+            assert got + seg == s2.result["tokens"] == ref
+            # the recovery is on the stitched trace
+            _wait_for(lambda: any(
+                e.get("name") == "router.recover"
+                for e in r2.tracer.events()), msg="recover span")
+            span = next(e for e in r2.tracer.events()
+                        if e.get("name") == "router.recover")
+            assert span["args"]["entries"] >= 1
+            assert span["args"]["open"] >= 1
+        finally:
+            r2.close()
+
+    def test_done_entry_serves_resume_from_breadcrumbs(
+            self, net, gateways, tmp_path):
+        wal = str(tmp_path / "r.wal")
+        prompt, n = [2, 3, 4, 5, 6, 7], 12
+        ref = _reference(net, prompt, n)
+        r1 = _router(gateways, wal)
+        c1 = RouterClient(r1.address, timeout_s=60.0)
+        out = c1.generate(prompt, n)
+        assert out["tokens"] == ref
+        rid = out["id"]
+        _kill(r1)
+
+        r2 = _router(gateways, wal)
+        try:
+            c2 = RouterClient(r2.address, timeout_s=60.0)
+            # blocking resume: the terminal from journal breadcrumbs
+            res = c2.generate(resume=rid)
+            assert res["tokens"] == ref
+            assert res.get("recovered") is True
+            # no replica traffic was needed: the entry was done
+            assert r2.stats["recovered_open"] == 0
+        finally:
+            r2.close()
+
+    def test_flooded_bucket_not_refilled_by_restart(
+            self, net, gateways, tmp_path):
+        """The PR 13 known fact, fixed and regression-gated: router
+        token buckets were router-local state a restart refilled — a
+        flooder got a fresh burst out of every crash. Now the level
+        rides the WAL: still throttled immediately after recovery."""
+        wal = str(tmp_path / "r.wal")
+
+        def tenants():
+            # refill slow enough (1 token / 5 s) that the restart
+            # wall itself cannot re-arm the bucket
+            return TenantRegistry((TenantSpec(
+                "flooder", rate_rps=0.2, burst=1.0),))
+
+        r1 = _router(gateways, wal, tenants=tenants())
+        c1 = RouterClient(r1.address, timeout_s=60.0)
+        out = c1.generate([1, 2, 3], 2, tenant="flooder")
+        assert out["finish_reason"] in ("length", "eos")
+        with pytest.raises(GatewayError) as ei:
+            c1.generate([1, 2, 3], 2, tenant="flooder")
+        assert ei.value.status == 429
+        _kill(r1)
+
+        r2 = _router(gateways, wal, tenants=tenants())
+        try:
+            # the bucket came back EMPTY (modulo refill for the
+            # restart wall itself — far below one token at 0.2 rps)
+            assert "flooder" in r2._buckets
+            assert r2._buckets["flooder"].tokens < 1.0
+            c2 = RouterClient(r2.address, timeout_s=60.0)
+            with pytest.raises(GatewayError) as ei2:
+                c2.generate([1, 2, 3], 2, tenant="flooder")
+            assert ei2.value.status == 429
+            assert ei2.value.payload.get("tenant") == "flooder"
+        finally:
+            r2.close()
+
+    def test_warm_beliefs_survive_restart_then_drop_on_breaker(
+            self, net, tmp_path):
+        """The PR 14 unit, extended across restarts: beliefs ride the
+        compaction snapshot / warm records, and a replica whose
+        breaker opens during recovery still boots cold — its restored
+        beliefs drop exactly like a live death's would."""
+        engines = [DecodeEngine(net, n_slots=3, decode_chunk=2,
+                                seed=0) for _ in range(2)]
+        gws = [ServingGateway(e, keepalive_s=0.1,
+                              replica_id=f"warm-rep-{i}").start()
+               for i, e in enumerate(engines)]
+        wal = str(tmp_path / "r.wal")
+        r1 = _router(gws, wal)
+        try:
+            # wait for the first health scrape so beliefs key by the
+            # replicas' STABLE ids, not the bootstrap addresses
+            _wait_for(lambda: all(
+                r.replica_id.startswith("warm-rep")
+                for r in r1._replicas), msg="ids scraped")
+            c1 = RouterClient(r1.address, timeout_s=60.0)
+            # distinct affinity keys until BOTH replicas hold a
+            # belief (rendezvous spreads keys across the fleet)
+            for i in range(32):
+                c1.generate([i + 1, i + 2, i + 3, i + 4, 5], 2)
+                with r1._lock:
+                    beliefs = {r for v in r1._warm.values()
+                               for r in v}
+                if len(beliefs) == 2:
+                    break
+            assert beliefs == {"warm-rep-0", "warm-rep-1"}, beliefs
+            _kill(r1)
+
+            gws[1].hard_kill()  # this replica dies WITH the router
+            r2 = _router(gws, wal)
+            try:
+                with r2._lock:
+                    restored = {r for v in r2._warm.values()
+                                for r in v}
+                assert restored == beliefs
+                # recovery's health loop opens the dead replica's
+                # breaker; its beliefs must drop with it
+                _wait_for(lambda: any(
+                    r.state == "dead" for r in r2._replicas),
+                    msg="breaker open on the dead replica")
+                _wait_for(lambda: not any(
+                    "warm-rep-1" in v for v in r2._warm.values()),
+                    msg="dead replica's beliefs dropped")
+                with r2._lock:
+                    assert any("warm-rep-0" in v
+                               for v in r2._warm.values()), (
+                        "the survivor's beliefs were dropped too")
+            finally:
+                r2.close()
+        finally:
+            for g in gws:
+                with contextlib.suppress(Exception):
+                    g.close()
+
+    def test_wal_compaction_retains_open_entry(self, net, gateways,
+                                               tmp_path):
+        """Compaction must treat open entries as the crash ledger:
+        a stream mid-flight survives any number of compactions AND a
+        restart from the compacted file."""
+        wal = str(tmp_path / "r.wal")
+        # long enough to outlive the done-entry churn below — the
+        # kill must land while the stream is genuinely OPEN
+        prompt, n = [3, 4, 5, 6, 7, 8], 80
+        ref = _reference(net, prompt, n)
+        r1 = _router(gateways, wal, wal_compact_bytes=512)
+        c1 = RouterClient(r1.address, timeout_s=60.0)
+        s = c1.stream(prompt, n, resumable=True)
+        rid = s.id
+        got = []
+        for delta in s:
+            got.extend(delta)
+            if len(got) >= 2:
+                break
+        # done-entry churn forces compactions while the stream is
+        # still open
+        for _ in range(8):
+            c1.generate([9, 9], 1)
+        assert r1.stats["wal_compactions"] >= 1
+        s.close()
+        _kill(r1)
+
+        r2 = _router(gateways, wal, wal_compact_bytes=512)
+        try:
+            assert r2.stats["recovered_open"] >= 1
+            res = RouterClient(r2.address,
+                               timeout_s=60.0).generate(
+                resume=rid, last_event_id=0)
+            assert res["tokens"] == ref
+        finally:
+            r2.close()
+
+    def test_wal_off_is_memory_only(self, net, gateways):
+        r = ServingRouter([g.address for g in gateways],
+                          affinity_block_tokens=4,
+                          health_interval_s=0.1).start()
+        try:
+            c = RouterClient(r.address, timeout_s=60.0)
+            out = c.generate([5, 6, 7], 2)
+            assert out["finish_reason"] in ("length", "eos")
+            assert r._wal is None
+            assert "wal" not in c.healthz()
+        finally:
+            r.close()
+
+
+# ---------------------------------------------------------------------------
+# resumption on a LIVE router (no restart involved)
+# ---------------------------------------------------------------------------
+
+class TestLiveResume:
+    def test_event_ids_count_delivered_tokens(self, net, gateways,
+                                              tmp_path):
+        r = _router(gateways, str(tmp_path / "r.wal"))
+        try:
+            c = RouterClient(r.address, timeout_s=60.0)
+            s = c.stream([1, 2, 3, 4], 8)
+            got = []
+            for delta in s:
+                got.extend(delta)
+                assert s.last_event_id == len(got)
+            assert s.last_event_id == len(s.result["tokens"])
+        finally:
+            r.close()
+
+    def test_detach_and_resume_mid_stream(self, net, gateways,
+                                          tmp_path):
+        """A resumable stream's client drop DETACHES (the relay keeps
+        running, nothing is cancelled); the reconnect resumes at the
+        exact token position."""
+        prompt, n = [4, 5, 6, 7, 8, 9], 20
+        ref = _reference(net, prompt, n)
+        r = _router(gateways, str(tmp_path / "r.wal"))
+        try:
+            c = RouterClient(r.address, timeout_s=60.0)
+            s = c.stream(prompt, n, resumable=True)
+            rid = s.id
+            got = []
+            for delta in s:
+                got.extend(delta)
+                if len(got) >= 3:
+                    break
+            s.close()  # vanish mid-stream
+            _wait_for(lambda: r.stats["detached_streams"] >= 1,
+                      msg="detach noted")
+            assert r.stats["disconnect_cancels"] == 0
+            s2 = c.resume(rid, last_event_id=len(got))
+            seg = []
+            for delta in s2:
+                seg.extend(delta)
+            assert got + seg == s2.result["tokens"] == ref
+            assert r.stats["resumed_streams"] >= 1
+        finally:
+            r.close()
+
+    def test_non_resumable_disconnect_still_cancels(
+            self, net, gateways, tmp_path):
+        """The standing contract is untouched by default: without
+        ``resumable``, a vanished client cancels the request."""
+        r = _router(gateways, str(tmp_path / "r.wal"))
+        try:
+            c = RouterClient(r.address, timeout_s=60.0)
+            s = c.stream([7, 8, 9, 1, 2, 3], 40)
+            rid = s.id
+            next(iter(s))
+            s.close()
+            _wait_for(lambda: r.stats["disconnect_cancels"] >= 1,
+                      msg="disconnect cancel")
+            _wait_for(lambda: r._journal[rid].done.is_set(),
+                      msg="entry closed")
+            assert (r._journal[rid].result or {}).get(
+                "finish_reason") == "cancelled"
+        finally:
+            r.close()
+
+    def test_resume_completed_stream_replays_breadcrumbs(
+            self, net, gateways, tmp_path):
+        r = _router(gateways, str(tmp_path / "r.wal"))
+        try:
+            c = RouterClient(r.address, timeout_s=60.0)
+            out = c.generate([8, 9, 1, 2], 6)
+            s = c.resume(out["id"], last_event_id=2)
+            seg = []
+            for delta in s:
+                seg.extend(delta)
+            assert seg == out["tokens"][2:]
+            assert s.result["tokens"] == out["tokens"]
+        finally:
+            r.close()
+
+    def test_resume_unknown_rid_404(self, net, gateways, tmp_path):
+        r = _router(gateways, str(tmp_path / "r.wal"))
+        try:
+            c = RouterClient(r.address, timeout_s=60.0)
+            with pytest.raises(GatewayError) as ei:
+                c.resume(424242)
+            assert ei.value.status == 404
+            with pytest.raises(GatewayError) as ei2:
+                c.generate(resume=424242)
+            assert ei2.value.status == 404
+        finally:
+            r.close()
+
+
+# ---------------------------------------------------------------------------
+# bounded in-memory journal vs open entries (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+class TestJournalCapVsOpenEntries:
+    def test_cap_eviction_never_takes_an_open_entry(
+            self, net, gateways, tmp_path):
+        """journal_cap eviction racing a live stream: the open entry
+        must survive arbitrary done-entry churn past the cap, keep
+        streaming, resume correctly, and never read as lost — only
+        the happy path (eviction of done entries) was covered
+        before."""
+        # long enough that the stream is still OPEN when the churn
+        # below completes (the premise under test)
+        prompt, n = [6, 5, 4, 3, 2, 1], 88
+        ref = _reference(net, prompt, n)
+        r = _router(gateways, str(tmp_path / "r.wal"),
+                    journal_cap=4)
+        try:
+            c = RouterClient(r.address, timeout_s=60.0)
+            s = c.stream(prompt, n, resumable=True)
+            rid = s.id
+            got = []
+            for delta in s:
+                got.extend(delta)
+                if len(got) >= 2:
+                    break
+            # flood well past the cap with short completed requests,
+            # CONCURRENTLY so the churn lands while the stream is
+            # still mid-flight
+            def short(_):
+                c.generate([9, 8], 1)
+
+            churn = [threading.Thread(target=short, args=(i,))
+                     for i in range(12)]
+            for t in churn:
+                t.start()
+            for t in churn:
+                t.join(timeout=60)
+            # one more sequential submit: eviction fires at submit
+            # time, and by now the 12 churn entries are all done
+            c.generate([9, 8], 1)
+            with r._lock:
+                still_open = not r._journal[rid].done.is_set() \
+                    if rid in r._journal else False
+                assert rid in r._journal, (
+                    "open entry evicted by journal-cap churn")
+                assert still_open, (
+                    "stream finished before the churn — the test "
+                    "premise needs a longer stream")
+                assert len(r._journal) <= 4 + 1  # cap + the open one
+            s.close()
+            # the stream finishes and resumes exactly
+            res = c.generate(resume=rid, last_event_id=len(got))
+            assert res["tokens"] == ref
+            audit = r.journal_audit()
+            assert rid not in audit["lost"]
+            assert audit["open"] == []
+        finally:
+            r.close()
+
+    def test_cap_eviction_with_many_open_entries(self, net, gateways,
+                                                 tmp_path):
+        """More open entries than the cap: the journal grows past the
+        cap rather than evict any of them (open entries are the crash
+        ledger)."""
+        r = _router(gateways, str(tmp_path / "r.wal"),
+                    journal_cap=2)
+        try:
+            c = RouterClient(r.address, timeout_s=60.0)
+            streams = [c.stream([i + 1, i + 2, i + 3, i + 4], 16,
+                                resumable=True)
+                       for i in range(4)]
+            with r._lock:
+                open_rids = [e.rid for e in r._journal.values()
+                             if not e.done.is_set()]
+            assert len(open_rids) >= 3  # grew past journal_cap=2
+            for s in streams:
+                for _ in s:
+                    pass
+                assert s.result is not None
+                assert s.result["finish_reason"] in ("length",
+                                                     "eos")
+        finally:
+            r.close()

@@ -1,0 +1,50 @@
+"""Registered kill-the-router chaos soak (ISSUE 15 acceptance).
+
+Fast variant (tier-1, ~9 s): 2 in-process replicas behind a
+SUBPROCESS router (the child imports only the router module, so a
+boot costs ~1 s) — two real ``SIGKILL`` + restart cycles against one
+write-ahead journal, resumable clients reconnecting with
+``Last-Event-ID`` through each death. Gates: zero lost streams, the
+wire-level exactly-once contract (every SSE event id == the client's
+cumulative token count, asserted inside every client), bit-identical
+greedy completions vs the fault-free single-engine reference, a
+bounded-and-compacted WAL, the ``router.recover`` span on the
+restarted router's stitched trace, and zero leaked
+threads/fds/subprocesses.
+
+Full variant (``slow``): 3 subprocess PAGED replicas, 3 kill/restart
+cycles, kill #2 racing a ``drain_replica`` (the mid-drain SIGKILL) —
+the acceptance gate end to end across real process boundaries.
+"""
+
+import pytest
+
+from scripts.router_restart_soak import run_soak
+
+
+def test_router_restart_soak_fast():
+    summary = run_soak(n_clients_per_wave=8, n_replicas=2,
+                       n_cycles=2, seed=0, in_process=True,
+                       min_inflight_at_kill=8)
+    assert summary["router_kills"] == 2
+    assert summary["completed"] >= 10
+    assert summary["greedy_parity_ok"] >= 5
+    assert summary["completed_across_restart"] >= 1
+    assert summary["final_recovered_entries"] >= 1
+    assert summary["recover_span_entries"] >= 1
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0
+
+
+@pytest.mark.slow
+def test_router_restart_soak_full_subprocess():
+    summary = run_soak(n_clients_per_wave=12, n_replicas=3,
+                       n_cycles=3, seed=0, in_process=False,
+                       throttle=0.04, min_inflight_at_kill=8,
+                       drain_at_cycle=1)
+    assert summary["router_kills"] == 3
+    assert summary["drained"] is not None
+    assert summary["completed_across_restart"] >= 1
+    assert summary["greedy_parity_ok"] >= 10
+    assert summary["leaked_threads"] == 0
+    assert summary["leaked_fds"] == 0

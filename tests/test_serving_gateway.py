@@ -688,3 +688,90 @@ class TestConnectionLifetime:
             resp.read()
             assert resp.getheader("Connection") == "close"
             conn.close()
+
+
+class TestStreamResume:
+    """ISSUE 15: SSE event ids + ``GET /v1/requests/<id>/stream``.
+    The gateway's streams carry monotone token-count event ids, and a
+    dropped consumer can re-attach at an exact token position — from
+    the stored result (terminal replay) or by following the live
+    request. The resume consumer never cancels anything; the primary
+    stream's cancel-on-disconnect contract is untouched."""
+
+    def test_event_ids_count_delivered_tokens(self):
+        with _Gateway(n_slots=2, decode_chunk=3, seed=0) as g:
+            s = g.client.stream(PROMPTS[0], LENS[0])
+            got = []
+            for d in s:
+                got.extend(d)
+                assert s.last_event_id == len(got)
+            assert s.last_event_id == len(s.result["tokens"])
+
+    def test_resume_terminal_replays_from_cursor(self):
+        with _Gateway(n_slots=2, decode_chunk=3, seed=0) as g:
+            out = g.client.generate(PROMPTS[1], LENS[1])
+            s = g.client.resume(out["id"], last_event_id=3)
+            seg = []
+            for d in s:
+                seg.extend(d)
+            assert seg == out["tokens"][3:]
+            assert s.result["tokens"] == out["tokens"]
+            assert s.result["finish_reason"] == out["finish_reason"]
+            assert g.gw.stats["resumed_streams"] == 1
+
+    def test_resume_follows_live_request(self):
+        """A second consumer attaches mid-flight and follows the
+        SAME request to its terminal without disturbing the primary
+        stream."""
+        with _Gateway(n_slots=1, decode_chunk=1, seed=0) as g:
+            orig = g.engine.step
+
+            def slow(sink=None):
+                time.sleep(0.03)
+                return orig(sink)
+
+            g.engine.step = slow
+            s = g.client.stream(PROMPTS[2], 12)
+            rid = s.id
+            primary = []
+            follower = {}
+
+            def follow():
+                fs = g.client.resume(rid, last_event_id=0)
+                toks = []
+                for d in fs:
+                    toks.extend(d)
+                follower["tokens"] = toks
+                follower["result"] = fs.result
+
+            first = next(iter(s))
+            primary.extend(first)
+            t = threading.Thread(target=follow)
+            t.start()
+            for d in s:
+                primary.extend(d)
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert s.result is not None
+            assert primary == s.result["tokens"]
+            assert follower["tokens"] == s.result["tokens"]
+            assert (follower["result"]["finish_reason"]
+                    == s.result["finish_reason"])
+
+    def test_resume_unknown_rid_404(self):
+        with _Gateway(n_slots=1, decode_chunk=2, seed=0) as g:
+            with pytest.raises(GatewayError) as ei:
+                g.client.resume(987654)
+            assert ei.value.status == 404
+
+    def test_resume_bad_cursor_400(self):
+        with _Gateway(n_slots=1, decode_chunk=2, seed=0) as g:
+            out = g.client.generate(PROMPTS[0], 3)
+            import http.client
+
+            conn = http.client.HTTPConnection(
+                g.gw._service.host, g.gw._service.port, timeout=10)
+            conn.request("GET", f"/v1/requests/{out['id']}/stream",
+                         headers={"Last-Event-ID": "not-a-number"})
+            assert conn.getresponse().status == 400
+            conn.close()

@@ -678,6 +678,40 @@ class TestRouterSurface:
             finally:
                 router.close()
 
+    def test_cli_route_journal_knobs(self, net, tmp_path):
+        """ISSUE 15: ``route --journal-path --fsync`` arm the WAL
+        through the exact CLI path, and the fsync choices are
+        enforced at parse time."""
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.cli.driver import (
+            build_parser,
+            router_from_args,
+        )
+
+        wal = str(tmp_path / "cli.wal")
+        eng = DecodeEngine(net, n_slots=2, decode_chunk=2, seed=0)
+        with ServingGateway(eng) as gw:
+            args = build_parser().parse_args(
+                ["route", "--replicas", gw.address, "--port", "0",
+                 "--journal-path", wal, "--fsync", "per_record"])
+            assert args.journal_path == wal
+            assert args.fsync == "per_record"
+            router = router_from_args(args).start()
+            try:
+                RouterClient(router.address).generate(PROMPT, 3)
+                assert router._wal is not None
+                assert router._wal.fsync == "per_record"
+                import os
+
+                assert os.path.getsize(wal) > 0
+            finally:
+                router.close()
+            with _pytest.raises(SystemExit):
+                build_parser().parse_args(
+                    ["route", "--replicas", gw.address,
+                     "--fsync", "sometimes"])
+
 
 class TestElasticFleetSurface:
     """ISSUE 11 satellites: runtime rendezvous ADD (only the
