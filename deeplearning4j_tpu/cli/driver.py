@@ -418,7 +418,8 @@ def gateway_from_args(args):
             use_flash_paged=FLASH_PAGED_MODES[
                 getattr(args, "use_flash_paged", "auto")],
             tenants=tenants,
-            async_rounds=getattr(args, "async_rounds", False))
+            async_rounds=getattr(args, "async_rounds", False),
+            fused_rounds=getattr(args, "fused_rounds", 0))
 
     return ServingGateway.boot(
         engine, snapshot_path=args.snapshot,
@@ -505,6 +506,8 @@ def _serve_child_argv(args, port: int, replica_id: str):
         argv += ["--use-flash-paged", args.use_flash_paged]
     if getattr(args, "async_rounds", False):
         argv += ["--async-rounds"]
+    if getattr(args, "fused_rounds", 0):
+        argv += ["--fused-rounds", str(args.fused_rounds)]
     for spec in getattr(args, "tenant", None) or []:
         # every replica enforces the same tenant table the router
         # rate-limits by — quotas and priorities are fleet-wide
@@ -809,6 +812,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "round N's token fetch defers to the next "
                         "step so the inter-round host gap overlaps "
                         "device compute (ids stay bit-identical)")
+    s.add_argument("--fused-rounds", type=int, default=0,
+                   metavar="K",
+                   help="fuse up to K decision-free decode rounds "
+                        "into one on-device scan (ISSUE 16; 0 = "
+                        "off). Greedy ids stay bit-identical to "
+                        "stepped mode; SSE deltas arrive in chunks "
+                        "of up to K * decode_chunk tokens")
     s.add_argument("--snapshot", default=None,
                    help="drain-snapshot path: written on shutdown, "
                         "restored on boot when present")
@@ -870,6 +880,10 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--async-rounds", action="store_true",
                     help="double-buffered decode rounds on every "
                          "replica (ISSUE 14)")
+    fl.add_argument("--fused-rounds", type=int, default=0,
+                    metavar="K",
+                    help="fused multi-round decode scans on every "
+                         "replica (ISSUE 16; 0 = off)")
     fl.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel shards per replica (every "
                          "replica serves at the same width)")

@@ -87,7 +87,9 @@ from deeplearning4j_tpu.serving.prefix_cache import (
 )
 from deeplearning4j_tpu.serving.sampler import (
     greedy_acceptance,
+    residual_sample,
     sample_tokens,
+    stochastic_acceptance,
 )
 from deeplearning4j_tpu.serving.scheduler import (
     FINISH_REASONS,
@@ -149,6 +151,8 @@ __all__ = [
     "pack_prefix",
     "read_records",
     "recover_state",
+    "residual_sample",
     "sample_tokens",
+    "stochastic_acceptance",
     "unpack_prefix",
 ]

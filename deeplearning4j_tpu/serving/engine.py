@@ -147,8 +147,9 @@ from deeplearning4j_tpu.serving.prefix_cache import (
     RadixPrefixCache,
 )
 from deeplearning4j_tpu.serving.sampler import (
-    greedy_acceptance,
+    residual_sample,
     sample_tokens,
+    stochastic_acceptance,
 )
 from deeplearning4j_tpu.serving.scheduler import (
     GenerationResult,
@@ -228,11 +229,19 @@ class _InflightRound:
     rids: Dict[int, int]              # slot -> request id at dispatch
     drafts: Optional[Dict[int, List[int]]]
     verify_out: Optional[Tuple]       # (lens, emitted, acc) or None
-    seq: Any                          # device [B, chunk], unfetched
+    seq: Any                          # device [B, T], unfetched
     t0: float                         # perf_counter at dispatch start
     td0: float                        # phase clock at decode dispatch
     dispatch_end: float               # phase clock after dispatch
     ver_dt: float                     # verify dispatch wall
+    #: fused multi-round scan (ISSUE 16): rounds fused into this
+    #: dispatch (1 = a plain stepped round), the decode tokens the
+    #: DEVICE wrote per slot (n_rounds * decode_chunk — the paged
+    #: table advance), and the device [B] committed-prefix lengths
+    #: (None on the stepped path: the whole chunk is the prefix)
+    n_rounds: int = 1
+    decode_tokens: int = 0
+    n_valid: Any = None
 
 
 class _PhaseClock:
@@ -394,6 +403,15 @@ SERVING_TRACK_HELP = {
                           "(ISSUE 14 KV transfer plane)",
     "serving_kv_imports": "warmed prefixes imported from peers "
                           "(ISSUE 14 KV transfer plane)",
+    "serving_host_step_s": "inter-dispatch host wall (previous "
+                           "round's token sync to the next decode "
+                           "dispatch) — the per-round host-loop cost "
+                           "fused decode amortizes over K rounds "
+                           "(ISSUE 16)",
+    "serving_fused_rounds": "rounds fused per decode scan dispatch "
+                            "(the pow2 K-bucket actually run; "
+                            "fused_rounds > 0 engines only, "
+                            "ISSUE 16)",
 }
 
 
@@ -653,11 +671,14 @@ class DecodeEngine:
                  tp: int = 1,
                  use_flash_paged=None,
                  tenants: Optional[TenantRegistry] = None,
-                 async_rounds: bool = False):
+                 async_rounds: bool = False,
+                 fused_rounds: int = 0):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk {decode_chunk} < 1")
+        if fused_rounds < 0:
+            raise ValueError(f"fused_rounds {fused_rounds} < 0")
         if shed_policy not in self.SHED_POLICIES:
             raise ValueError(
                 f"shed_policy {shed_policy!r}: expected one of "
@@ -677,6 +698,12 @@ class DecodeEngine:
         self.net = net
         self.n_slots = int(n_slots)
         self.decode_chunk = int(decode_chunk)
+        #: fused multi-round decode (ISSUE 16): 0 = off (the
+        #: bit-identical stepped engine); K > 0 = decision-free rounds
+        #: may dispatch as ONE on-device scan of up to K rounds
+        #: (pow2-bucketed), amortizing the host step loop over
+        #: K * decode_chunk tokens
+        self.fused_rounds = int(fused_rounds)
         self.tracer = tracer
         self._forward, self.vocab, beans = _lm_shape_of(net)
         guard_streamable(iter(beans))
@@ -793,7 +820,12 @@ class DecodeEngine:
             # one round's decode/verify writes — sized so a logical
             # block is never recycled while any in-flight query can
             # still reach it (see AttentionImpl._paged_attend)
-            round_write = (self.decode_chunk + self.spec_draft_len + 1)
+            # a fused scan writes K rounds of decode tokens before the
+            # host sees any of them — the ring must cover the widest
+            # single dispatch, whichever path issues it
+            round_write = max(
+                self.decode_chunk + self.spec_draft_len + 1,
+                self.fused_rounds * self.decode_chunk)
             self._ring_slots = (
                 -(-self._wmax // bt) + -(-self.window // bt)
                 + -(-round_write // bt) + 3)
@@ -890,7 +922,9 @@ class DecodeEngine:
                              "serving_tp_dispatch_s",
                              "serving_kv_import_s",
                              "serving_admission_warm_s",
-                             "serving_admission_cold_s")}
+                             "serving_admission_cold_s",
+                             "serving_host_step_s",
+                             "serving_fused_rounds")}
         self.describe_metrics()
         # -- async double-buffered rounds (ISSUE 14; default off =
         # the bit-identical synchronous engine): round N's token
@@ -904,6 +938,10 @@ class DecodeEngine:
         # set is unchanged.
         self.async_rounds = bool(async_rounds)
         self._inflight: Optional[_InflightRound] = None
+        #: host-loop observability (ISSUE 16): wall stamp of the last
+        #: token sync — the next dispatch's gap to it is the
+        #: serving_host_step_s observation
+        self._last_sync_end: Optional[float] = None
 
         self._key = jax.random.key(seed)
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
@@ -1018,6 +1056,48 @@ class DecodeEngine:
             (pool, tok), seq = jax.lax.scan(body, (pool, toks), keys)
             return pool, tok, jnp.swapaxes(seq, 0, 1)  # [B, chunk]
 
+        def fused_decode(params, state, pool, toks, temps, top_ks,
+                         eos_ids, remaining, keys):
+            # fused multi-round decode (ISSUE 16): K stepped rounds
+            # as ONE scan over K * chunk positions. ``keys`` carries
+            # the K per-round host keys (the exact keys K stepped
+            # dispatches would have consumed, in order), each
+            # vmap-split into its chunk keys — so the flattened key
+            # stream, and with it every sampled id, is bit-identical
+            # to K sequential decode dispatches. eos/stop detection
+            # runs on device: ``eos_ids[B]`` (-1 = none) and
+            # ``remaining[B]`` (max_new_tokens headroom at dispatch)
+            # yield ``n_valid[B]`` — the per-slot committed prefix of
+            # the K * chunk emitted tokens. Finished slots ride the
+            # rest of the scan as dead rows (per-row independence:
+            # neighbours' ids are untouched, the same invariant idle
+            # slots rest on) and their overshoot is dropped at
+            # landing, exactly like a chunk overshooting eos today.
+            k_rounds = keys.shape[0]
+            flat = jax.vmap(
+                lambda kk: jax.random.split(kk, chunk))(keys)
+            flat = flat.reshape(k_rounds * chunk)
+
+            def body(carry, k):
+                rnn, tok = carry
+                x = jax.nn.one_hot(
+                    tok, self.vocab, dtype=self.net._dtype)[:, :, None]
+                out, new_rnn = forward(params, state, x, None, rnn)
+                nxt = sample_tokens(out[:, :, -1], temps, top_ks, k)
+                return (new_rnn, nxt), nxt
+
+            (pool, tok), seq = jax.lax.scan(body, (pool, toks), flat)
+            seq = jnp.swapaxes(seq, 0, 1)       # [B, K * chunk]
+            t = k_rounds * chunk
+            pos = jnp.arange(t)
+            is_eos = seq == eos_ids[:, None]
+            eos_pos = jnp.min(
+                jnp.where(is_eos, pos[None, :], t), axis=1)
+            n_valid = jnp.minimum(
+                jnp.minimum(eos_pos + 1, t),
+                jnp.clip(remaining, 0, t)).astype(jnp.int32)
+            return pool, tok, seq, n_valid
+
         self._prefill_jit = self._jit(prefill)
         if self.paged_kv:
             # donate the carried cache: the block pool rides EVERY
@@ -1033,6 +1113,11 @@ class DecodeEngine:
         else:
             self._chunk_jit = self._jit(chunk_prefill)
             self._decode_jit = self._jit(decode)
+        self._fused_jit = None
+        if self.fused_rounds:
+            self._fused_jit = (
+                self._jit(fused_decode, donate_argnums=(2,))
+                if self.paged_kv else self._jit(fused_decode))
         self._admit_jit = self._jit(admit)
         self._verify_jit = None
         if self.spec_draft_len:
@@ -1058,15 +1143,33 @@ class DecodeEngine:
                 mask = (pos[None, :]
                         <= lens[:, None]).astype(jnp.float32)
                 out, new_pool = forward(params, state, x, mask, pool)
-                targets = jnp.argmax(out, axis=1).astype(jnp.int32)
-                acc = greedy_acceptance(targets[:, :-1], draft, lens)
-                # bonus token AFTER the accepted prefix, sampled with
-                # each slot's config (greedy slots: argmax == target —
-                # the correction token at the first divergence, or the
-                # free extra token on full acceptance)
+                # acceptance (ISSUE 16): greedy rows keep the equality
+                # rule (bit-parity with plain greedy decode); sampling
+                # rows accept each draft token with probability
+                # p_tau(draft) — the Leviathan p/q rejection rule with
+                # the n-gram drafter's point-mass q — so sampling
+                # traffic rides the verify pass with target-model
+                # marginals preserved exactly
+                k_acc, k_bonus = jax.random.split(key)
+                acc = stochastic_acceptance(
+                    jnp.swapaxes(out, 1, 2)[:, :-1], draft, lens,
+                    temps, top_ks, k_acc)
+                # bonus token AFTER the accepted prefix: on a greedy
+                # row argmax == target (the correction token at the
+                # first divergence, or the free extra token on full
+                # acceptance); on a rejected sampling row the draw is
+                # from the RESIDUAL distribution (rejected token
+                # banned, renormalized) — the second half of the
+                # rejection-sampling identity
                 probs = jnp.take_along_axis(
                     out, acc[:, None, None], axis=2)[:, :, 0]
-                bonus = sample_tokens(probs, temps, top_ks, key)
+                w = draft.shape[1]
+                rejected = acc < lens
+                rej_tok = jnp.take_along_axis(
+                    draft, jnp.minimum(acc, w - 1)[:, None],
+                    axis=1)[:, 0]
+                bonus = residual_sample(probs, rej_tok, rejected,
+                                        temps, top_ks, k_bonus)
                 # roll each row's rejected tail back out of the cache;
                 # the committed cache then holds exactly
                 # context + accepted prefix, with the bonus token as
@@ -1221,6 +1324,10 @@ class DecodeEngine:
                   "chunk_prefill": n(self._chunk_jit),
                   "admit": n(self._admit_jit),
                   "decode": n(self._decode_jit)}
+        if self._fused_jit is not None:
+            # one executable per pow2 K-bucket actually dispatched —
+            # at most log2(fused_rounds) + 1
+            counts["fused_decode"] = n(self._fused_jit)
         if self._verify_jit is not None:
             counts["verify"] = n(self._verify_jit)
         if self._health_jit is not None:
@@ -2186,6 +2293,21 @@ class DecodeEngine:
                     state.spec_drafted, state.spec_accepted)
                 self._failure_event("deadline_expired")
                 self._evict_slot(slot)
+        # drop the flag once no live request carries a time budget —
+        # the sweep stays zero-cost afterwards and, since the flag
+        # also gates fused dispatch (``_plan_fused``), one
+        # deadline-carrying request must not disable fusing for the
+        # rest of the engine's life
+        def _timed(req: Request) -> bool:
+            return (req.deadline_s is not None
+                    or req.queue_timeout_s is not None)
+
+        self._has_deadlines = (
+            any(_timed(r) for r in self.scheduler.queued_requests())
+            or any(_timed(r) for _, r in self._requeue)
+            or any(_timed(p.request) for p in self._pending)
+            or any(s is not None and _timed(s.request)
+                   for s in self._slots))
 
     def _inject_faults(self) -> None:
         if self.fault_plan is None:
@@ -2404,25 +2526,24 @@ class DecodeEngine:
     # -- speculative draft & verify (ISSUE 4) --------------------------
     def _plan_drafts(self, active: List[int]) -> Dict[int, List[int]]:
         """Per-slot draft proposals for this round from the n-gram
-        tables. Greedy slots only (the acceptance rule is greedy-match;
-        a sampling slot still rides the verify pass and advances one
-        sampled token). Each draft is capped at the live K
-        (``Scheduler.draft_len`` — acceptance-adapted), the tokens the
-        round's decode chunk won't already deliver (a request the
-        chunk alone finishes gains nothing from drafting — its verify
-        lanes would be pure waste), and the slot's window headroom: a
-        rejected tail can only be rewound while no token slid out of
-        the sliding window, so a slot within K+1 tokens of saturation
-        drafts less (down to zero at the brim — the chunk still
-        advances it exactly like plain decode)."""
+        tables. Sampling slots draft too (ISSUE 16): the stochastic
+        acceptance rule gives a drafted sampling slot exactly the
+        target model's sampling marginals, so temperature traffic
+        rides the same verify pass greedy traffic does. Each draft is
+        capped at the live K (``Scheduler.draft_len`` —
+        acceptance-adapted), the tokens the round's decode chunk won't
+        already deliver (a request the chunk alone finishes gains
+        nothing from drafting — its verify lanes would be pure waste),
+        and the slot's window headroom: a rejected tail can only be
+        rewound while no token slid out of the sliding window, so a
+        slot within K+1 tokens of saturation drafts less (down to
+        zero at the brim — the chunk still advances it exactly like
+        plain decode)."""
         k = self.scheduler.draft_len
         drafts: Dict[int, List[int]] = {}
         for slot in active:
             state = self._slots[slot]
             req = state.request
-            if req.temperature > 0:
-                drafts[slot] = []
-                continue
             filled = min(len(req.prompt) + len(state.tokens) - 1,
                          self.window)
             cap = min(k,
@@ -2533,6 +2654,38 @@ class DecodeEngine:
                     self.tracer.incr("serving_qos_preempted")
                 self._preempt_slot(slot)
 
+    # -- fused multi-round decode (ISSUE 16) ---------------------------
+    def _plan_fused(self, active: List[int], spec_round: bool) -> int:
+        """Rounds to fuse into this dispatch: 0 = step (the plain
+        decode executable), K >= 1 = one K-round scan. A scan is
+        dispatched only when NOTHING needs a per-round host decision:
+        no queued arrivals (``Scheduler.decision_pending`` — also the
+        gate on QoS preemption planning, which only fires for queued
+        arrivals), no admission mid-prefill, no requeued victims
+        waiting out a backoff, no fault plan (injections are
+        round-indexed), no live deadlines (a deadline must be able to
+        expire between ROUNDS, not between windows), and no draft this
+        round (a verify pass needs its per-round host lookup). Cancels
+        need no carve-out: a cancel mid-window lands through the
+        ``rids`` guard exactly like the async-rounds engine, and the
+        NEXT round sees the freed slot. K is the pow2 bucket covering
+        the widest live request's remaining rounds, capped at
+        ``fused_rounds`` — the executable set is bounded at
+        log2(fused_rounds) + 1 and a near-finished batch never pays
+        for rounds it cannot use."""
+        if (not self.fused_rounds or self._fused_jit is None
+                or spec_round or self._pending or self._requeue
+                or self.fault_plan is not None or self._has_deadlines
+                or self.scheduler.decision_pending()):
+            return 0
+        max_rem = max(self._slots[s].request.max_new_tokens
+                      - len(self._slots[s].tokens) for s in active)
+        need = -(-max_rem // self.decode_chunk)
+        k = 1
+        while k < need and k * 2 <= self.fused_rounds:
+            k *= 2
+        return k
+
     # -- the serving loop ----------------------------------------------
     def has_work(self) -> bool:
         """True while anything is queued, admitting, decoding,
@@ -2568,6 +2721,8 @@ class DecodeEngine:
         between dispatch and landing)."""
         t_sync0 = self._clock() if self.record_timing else 0.0
         seq = np.asarray(inf.seq)
+        n_valid = (np.asarray(inf.n_valid)
+                   if inf.n_valid is not None else None)
         v_n = None
         v_rows = None
         if inf.verify_out is not None:
@@ -2601,17 +2756,29 @@ class DecodeEngine:
         if v_rows is not None:
             rows = [list(v_rows[s][:int(v_n[s])]) + list(seq[s])
                     for s in range(self.n_slots)]
+        elif n_valid is not None:
+            # fused scan: the device already found each slot's
+            # committed prefix (eos / max_new_tokens cut); the
+            # overshoot rows past it are dead-row ride-along, dropped
+            # here (the _finished break below stays as backstop)
+            rows = [list(seq[s][:int(n_valid[s])])
+                    for s in range(self.n_slots)]
         else:
             rows = seq
+        # host-loop observability (ISSUE 16): the token sync is done —
+        # everything until the next decode dispatch is host-loop wall
+        if self.record_timing:
+            self._last_sync_end = self._clock()
         dt = time.perf_counter() - inf.t0
         if self.paged_kv:
-            # mirror the device-side filled advance (decode chunk
-            # + verify's accepted+bonus) into the host tables, and
-            # release blocks that slid out of every window — the
-            # "pop blocks" half of the paged rewind contract
+            # mirror the device-side filled advance (decode writes —
+            # n_rounds * decode_chunk under a fused scan — + verify's
+            # accepted+bonus) into the host tables, and release blocks
+            # that slid out of every window — the "pop blocks" half of
+            # the paged rewind contract
             for slot in active:
                 tab = self._kv_tabs[slot]
-                tab.length += self.decode_chunk + (
+                tab.length += inf.decode_tokens + (
                     int(v_n[slot]) if v_n is not None else 0)
                 self._free_expired_blocks(tab)
         if self.paranoid:
@@ -2656,7 +2823,7 @@ class DecodeEngine:
                             state.request.tenant, gap,
                             n=len(appended))
                     clock.last_commit_t = now_c
-                    clock.rounds += 1
+                    clock.rounds += inf.n_rounds
                     clock.event(now_c, "commit", n=len(appended))
             if self._finished(state):
                 self._finish(state, slot)
@@ -2789,6 +2956,7 @@ class DecodeEngine:
             drafts = (self._plan_drafts(active)
                       if self.spec is not None else None)
             spec_round = drafts is not None and any(drafts.values())
+            fuse_k = self._plan_fused(active, spec_round)
             if self.paged_kv:
                 # allocation on demand: reserve every block this
                 # round's writes will cross into (verify width + the
@@ -2799,7 +2967,7 @@ class DecodeEngine:
                 for slot in list(active):
                     if self._slots[slot] is None:
                         continue   # preempted by an earlier reserve
-                    n_tok = self.decode_chunk
+                    n_tok = max(fuse_k, 1) * self.decode_chunk
                     if spec_round:
                         n_tok += len(drafts.get(slot, ())) + 1
                     if self._ensure_tab(
@@ -2817,6 +2985,12 @@ class DecodeEngine:
                     drafts = {s: d for s, d in drafts.items()
                               if s in active}
                     spec_round = any(drafts.values())
+                if fuse_k and self._requeue:
+                    # a pool-pressure preemption during reservation is
+                    # a scheduling decision: fall back to stepped (the
+                    # extra reserved blocks stay table-owned for the
+                    # following rounds — nothing leaks)
+                    fuse_k = 0
                 if not active:
                     # every slot was preempted for blocks: the round
                     # ends with no decode (requeues drain next round)
@@ -2864,17 +3038,47 @@ class DecodeEngine:
                 # accelerator, never a requirement
                 self.stats["spec_fallback_rounds"] += 1
             td0 = self._clock() if self.record_timing else 0.0
+            if self.record_timing and self._last_sync_end is not None:
+                # host-loop wall: previous round's token sync to this
+                # dispatch — the per-round cost a fused scan amortizes
+                self._observe("serving_host_step_s",
+                              td0 - self._last_sync_end)
+            n_valid = None
             with self._span("serving.decode_chunk",
-                            active=len(active),
+                            active=len(active), fused=fuse_k,
                             rids=[self._slots[s].request.id
                                   for s in active],
                             **self._traces_of(active)):
-                pool_op, self._toks, seq = self._decode_jit(
-                    self._params, self._state, pool_op,
-                    self._toks, jnp.asarray(self._temps),
-                    jnp.asarray(self._top_ks), self._next_key())
+                if fuse_k:
+                    # fused K-round scan: draw the SAME K host keys K
+                    # stepped rounds would (RNG-stream parity), hand
+                    # eos ids + max_new headroom to the device for
+                    # on-device stop detection
+                    keys = jnp.stack([self._next_key()
+                                      for _ in range(fuse_k)])
+                    eos_ids = np.full(self.n_slots, -1, np.int32)
+                    remaining = np.zeros(self.n_slots, np.int32)
+                    for s in active:
+                        st = self._slots[s]
+                        if st.request.eos_id is not None:
+                            eos_ids[s] = int(st.request.eos_id)
+                        remaining[s] = (st.request.max_new_tokens
+                                        - len(st.tokens))
+                    (pool_op, self._toks, seq,
+                     n_valid) = self._fused_jit(
+                        self._params, self._state, pool_op,
+                        self._toks, jnp.asarray(self._temps),
+                        jnp.asarray(self._top_ks),
+                        jnp.asarray(eos_ids),
+                        jnp.asarray(remaining), keys)
+                    self._observe("serving_fused_rounds", fuse_k)
+                else:
+                    pool_op, self._toks, seq = self._decode_jit(
+                        self._params, self._state, pool_op,
+                        self._toks, jnp.asarray(self._temps),
+                        jnp.asarray(self._top_ks), self._next_key())
                 if not self.async_rounds:
-                    seq = np.asarray(seq)  # [B, chunk]; forces the
+                    seq = np.asarray(seq)  # [B, T]; forces the
                     #               whole round (verify included) done
             self._pool = self._strip_pool(pool_op)
             inf = _InflightRound(
@@ -2884,7 +3088,10 @@ class DecodeEngine:
                 t0=t0, td0=td0,
                 dispatch_end=(self._clock() if self.record_timing
                               else 0.0),
-                ver_dt=ver_dt)
+                ver_dt=ver_dt,
+                n_rounds=max(fuse_k, 1),
+                decode_tokens=max(fuse_k, 1) * self.decode_chunk,
+                n_valid=n_valid)
             if self.async_rounds:
                 # round N's fetch waits for the NEXT step: stash the
                 # dispatched round and return. The round-time
@@ -3256,6 +3463,7 @@ class DecodeEngine:
                 "tp": self.tp,
                 "use_flash_paged": self.use_flash_paged,
                 "async_rounds": self.async_rounds,
+                "fused_rounds": self.fused_rounds,
             },
             # paged bookkeeping rides the snapshot for inspection and
             # exact-capacity restores (restore REBUILDS device blocks
@@ -3365,7 +3573,8 @@ class DecodeEngine:
             flight_recorder=cfg.get("flight_recorder", 256),
             tp=tp, use_flash_paged=use_flash_paged,
             tenants=tenants,
-            async_rounds=cfg.get("async_rounds", False))
+            async_rounds=cfg.get("async_rounds", False),
+            fused_rounds=cfg.get("fused_rounds", 0))
         spec_state = snapshot.get("spec")
         if spec_state and eng.spec is not None:
             # resume K-adaptation where the crash left it (final ids

@@ -124,7 +124,8 @@ class GenerationResult:
     #: tokens the n-gram table proposed for this request, and how many
     #: of them verification accepted — acceptance rate per request is
     #: ``spec_accepted / spec_drafted`` (0/0 when the request never
-    #: drafted, e.g. sampling requests or spec-off engines)
+    #: drafted, e.g. spec-off engines; sampling requests draft too —
+    #: stochastic acceptance, ISSUE 16)
     spec_drafted: int = 0
     spec_accepted: int = 0
     #: per-request phase breakdown from the engine's phase clock
@@ -372,6 +373,19 @@ class Scheduler:
     @property
     def pending(self) -> int:
         return len(self._queue)
+
+    def decision_pending(self) -> bool:
+        """True when the NEXT scheduling round needs a per-round
+        decision from this scheduler — queued arrivals to admit (and,
+        in the weighted-fair subclass, the preemption planning that
+        only ever fires for queued arrivals). The fused multi-round
+        decode path (ISSUE 16) asks this before dispatching a K-round
+        scan: while it is False, K rounds of pure decode can run as
+        one device program without the scheduler's input; the moment
+        it turns True the engine falls back to per-round stepping so
+        admission/QoS keep their per-round cadence. Tombstone-aware
+        in the subclass via the ``pending`` property."""
+        return bool(self.pending)
 
     @property
     def full(self) -> bool:

@@ -28,7 +28,13 @@ three ways, fastest first:
    counters printed at the end show how often the free drafts were
    right; this trained pattern-following LM accepts nearly all of
    them).
-6. **Tensor-parallel sharding** (``tp=2``) — the same paged engine
+6. **Fused multi-round decode** (``fused_rounds=K``) — whenever no
+   admission/deadline/draft decision is pending, the engine dispatches
+   ONE jitted K-round scan instead of K per-round steps: streamed
+   deltas arrive ``K * decode_chunk`` tokens at a time (watch the
+   delta batch sizes printed below) and greedy ids stay identical to
+   the stepped engine — same computation, 1/K the host round-trips.
+7. **Tensor-parallel sharding** (``tp=2``) — the same paged engine
    sharded over attention heads: decode/verify/chunk run as
    ``shard_map`` programs, each shard holds HALF the KV bytes behind
    the SAME host block tables, and greedy ids stay identical to the
@@ -229,6 +235,33 @@ def main():
           f"when idle, fragmentation "
           f"{paged.stats['frag_tokens']} tokens")
     print("paged compile counts:", paged.compile_counts())
+
+    # Fused multi-round decode (ISSUE 16): the continuous-batching
+    # workload again with fused_rounds=4 — once the queue drains, each
+    # dispatch is ONE on-device scan over up to 4 decode rounds, so
+    # streamed deltas land 16 tokens (4 rounds x decode_chunk=4) at a
+    # time instead of 4, and every greedy id matches the stepped
+    # engine's from step 3.
+    fused = DecodeEngine(net, n_slots=4, decode_chunk=4,
+                         fused_rounds=4, emit_deltas=True)
+    fused_reqs = {
+        fused.submit(Request(prompt=PATTERN[:k], max_new_tokens=n)): k
+        for k, n in [(3, 16), (5, 8), (2, 12), (4, 10), (6, 6)]
+    }
+    fused_results = {}
+    delta_batches = {}
+    while fused.has_work():
+        fused.step(fused_results)
+        for rid, toks in fused.drain_deltas().items():
+            delta_batches.setdefault(rid, []).append(len(toks))
+    ok = all(
+        fused_results[frid].tokens == results[rid].tokens
+        for frid, rid in zip(sorted(fused_results), sorted(results)))
+    print("fused engine == stepped engine per request:", ok)
+    for rid in sorted(delta_batches):
+        print(f"fused req {rid} (prompt {fused_reqs[rid]} toks): "
+              f"delta batches {delta_batches[rid]}")
+    print("fused compile counts:", fused.compile_counts())
 
     # Tensor-parallel sharded decode (ISSUE 12): the paged engine
     # again, sharded 2-ways over attention heads. The host block

@@ -194,6 +194,10 @@ FLEET_ROWS = LIVE_ROWS + (
     ("serving_kv_import_s", "kv_import"),
     ("serving_admission_warm_s", "admission_warm"),
     ("serving_admission_cold_s", "admission_cold"),
+    # host-loop rows (ISSUE 16): inter-dispatch host wall (the cost
+    # fused decode amortizes) + rounds fused per scan dispatch
+    ("serving_host_step_s", "host_step"),
+    ("serving_fused_rounds", "fused_rounds"),
 )
 
 #: per-tenant rows (ISSUE 13): the per-request families that carry

@@ -14,6 +14,7 @@ import json
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.models.zoo import transformer_lm
@@ -27,6 +28,8 @@ from deeplearning4j_tpu.serving import (
     Request,
     Scheduler,
     greedy_acceptance,
+    residual_sample,
+    stochastic_acceptance,
 )
 
 V = 12
@@ -136,6 +139,106 @@ class TestGreedyAcceptance:
         acc = np.asarray(greedy_acceptance(targets, draft,
                                            jnp.asarray([3])))
         assert acc.tolist() == [1]
+
+
+class TestStochasticAcceptance:
+    """The rejection-sampling acceptance rule (ISSUE 16): with the
+    n-gram drafter's point-mass q, a draft token is accepted with
+    probability p_tau(draft) and a rejection redraws from the residual
+    (draft-banned, renormalized) distribution — together the emitted
+    marginals are EXACTLY the target model's sampling distribution."""
+
+    def test_greedy_rows_keep_the_equality_rule(self):
+        """temps == 0 rows are bit-identical to greedy_acceptance —
+        the engine's greedy bit-parity invariant does not depend on
+        the accept-draw key."""
+        rng = np.random.default_rng(0)
+        probs = rng.dirichlet(np.ones(V), size=(4, 3)).astype(
+            np.float32)
+        draft = jnp.asarray(rng.integers(0, V, (4, 3)), jnp.int32)
+        lens = jnp.asarray([3, 3, 2, 0], jnp.int32)
+        targets = jnp.argmax(jnp.asarray(probs), axis=-1).astype(
+            jnp.int32)
+        want = np.asarray(greedy_acceptance(targets, draft, lens))
+        for seed in (0, 1, 7):
+            got = np.asarray(stochastic_acceptance(
+                jnp.asarray(probs), draft, lens,
+                jnp.zeros(4), jnp.full(4, V, jnp.int32),
+                jax.random.key(seed)))
+            assert got.tolist() == want.tolist()
+
+    def test_certain_and_impossible_drafts(self):
+        """p_tau(draft) == 1 always accepts (u < 1 for uniform
+        [0, 1)); p_tau(draft) == 0 — e.g. a draft outside the top-k
+        support — always rejects, regardless of key."""
+        probs = np.full((2, 2, V), 1e-9, np.float32)
+        probs[:, :, 3] = 1.0                 # point mass on class 3
+        draft = jnp.asarray([[3, 3], [3, 5]], jnp.int32)
+        lens = jnp.asarray([2, 2], jnp.int32)
+        for seed in (0, 5):
+            acc = np.asarray(stochastic_acceptance(
+                jnp.asarray(probs), draft, lens,
+                jnp.ones(2), jnp.full(2, 2, jnp.int32),
+                jax.random.key(seed)))
+            assert acc.tolist() == [2, 1]
+
+    def test_residual_sample_bans_after_topk(self):
+        """The ban applies AFTER the rank filter: banning the top-1
+        class of a top_k=2 row must redistribute to the SECOND class,
+        never admit the third — and greedy rows ignore the ban."""
+        probs = np.zeros((2, V), np.float32)
+        probs[:, 0], probs[:, 1], probs[:, 2] = 0.6, 0.3, 0.1
+        ban = jnp.asarray([0, 0], jnp.int32)
+        do_ban = jnp.asarray([True, True])
+        temps = jnp.asarray([1.0, 0.0])
+        top_ks = jnp.full(2, 2, jnp.int32)
+        for seed in range(8):
+            tok = np.asarray(residual_sample(
+                jnp.asarray(probs), ban, do_ban, temps, top_ks,
+                jax.random.key(seed)))
+            assert tok[0] == 1        # only class in residual support
+            assert tok[1] == 0        # greedy: argmax despite the ban
+
+    def test_emitted_marginals_match_target_sampling(self):
+        """Distribution-level sanity (the ISSUE 16 acceptance gate):
+        Monte-Carlo the accept-or-residual pipeline for a FIXED target
+        row and drafted token; the emitted-token marginal must match
+        p_tau within tolerance. Checked at an unfiltered row and a
+        top-k row, each under a temperature that reshapes p."""
+        rng = np.random.default_rng(4)
+        base = rng.dirichlet(np.ones(V) * 0.7).astype(np.float32)
+        n = 4000
+        for temp, top_k, drafted in ((0.7, V, 3), (1.3, 4, 1)):
+            probs1 = jnp.asarray(base)[None, None, :]   # [1, 1, V]
+            temps = jnp.asarray([temp])
+            tks = jnp.full(1, top_k, jnp.int32)
+            draft = jnp.full((1, 1), drafted, jnp.int32)
+            lens = jnp.ones(1, jnp.int32)
+
+            def emit(key):
+                ka, kb = jax.random.split(key)
+                acc = stochastic_acceptance(
+                    probs1, draft, lens, temps, tks, ka)
+                rejected = acc < 1
+                bonus = residual_sample(
+                    jnp.asarray(base)[None, :], draft[:, 0],
+                    rejected, temps, tks, kb)
+                return jnp.where(acc == 1, drafted, bonus)[0]
+
+            keys = jax.random.split(jax.random.key(11), n)
+            toks = np.asarray(jax.vmap(emit)(keys))
+            emp = np.bincount(toks, minlength=V) / n
+            # the law the pipeline must reproduce: p_tau — temperature
+            # + rank-top-k applied to the same row (sampler semantics)
+            logp = np.log(np.maximum(base, 1e-30))
+            order = np.argsort(-logp, kind="stable")
+            keep = order[:top_k]
+            scaled = np.full(V, -np.inf)
+            scaled[keep] = logp[keep] / temp
+            p_tau = np.exp(scaled - scaled.max())
+            p_tau /= p_tau.sum()
+            assert float(np.abs(emp - p_tau).sum()) < 0.08, (
+                temp, top_k, emp, p_tau)
 
 
 class TestSpecParity:
@@ -282,9 +385,11 @@ class TestSpecParity:
             prompt, 12, stream_max_t=window)
 
     def test_sampling_requests_ride_the_verify_pass(self):
-        """A temperature>0 request never drafts (greedy-match
-        acceptance would bias its distribution) but shares the pool
-        with drafting neighbours: the greedy neighbour stays exact,
+        """A temperature>0 request DRAFTS under stochastic acceptance
+        (ISSUE 16: the Leviathan p/q rejection rule preserves its
+        sampling distribution exactly, so the greedy-only exclusion is
+        gone) and shares the pool with a greedy neighbour: the greedy
+        neighbour stays bit-exact (its rows keep the equality rule),
         the sampled one is seed-deterministic."""
         def run():
             eng = DecodeEngine(_net(), n_slots=2, decode_chunk=2,
@@ -298,7 +403,7 @@ class TestSpecParity:
         g2, s2, _ = run()
         assert g1.tokens == _solo_generate([1, 2, 3, 1, 2, 3, 1], 10)
         assert g1.spec_drafted > 0
-        assert s1.spec_drafted == 0       # sampling slots never draft
+        assert s1.spec_drafted > 0    # sampling slots draft too now
         assert len(s1.tokens) == 8
         assert s1.tokens == s2.tokens     # seed-deterministic
         assert acc1 > 0

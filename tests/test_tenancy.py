@@ -531,6 +531,44 @@ class TestEngineTenancy:
         assert res[a].tokens == rr[ra].tokens
         assert res[b].tokens == rr[rb].tokens
 
+    def test_spec_drafted_sampling_stream_faults_on_preemption(self):
+        """ISSUE 16 regression: sampling traffic rides the spec
+        verify pass now (stochastic acceptance), so a preempted
+        sampling stream can have DRAFTED tokens in flight — the
+        preemption contract is unchanged: a sampling request that
+        already streamed terminates ``"fault"`` (an RNG redraw would
+        splice two sequences), never a silent requeue-and-splice."""
+        reg = _registry(max_slots=2)
+        eng = DecodeEngine(_net(), n_slots=2, decode_chunk=2, seed=0,
+                           tenants=reg, spec_draft_len=3,
+                           emit_deltas=True)
+        # repetitive prompt: the n-gram table reliably drafts for it
+        f1 = eng.submit(Request([1, 2, 3, 1, 2, 3, 1], 50,
+                                temperature=0.9, top_k=4,
+                                tenant="flood"))
+        # the neighbour sits in a HIGHER class: the sampling flood
+        # stream is the only preemptible slot when premium arrives
+        f2 = eng.submit(Request([2, 3, 4], 50, tenant="standard"))
+        res = {}
+        streamed = {}
+        for _ in range(5):   # stream + draft before the preemption
+            eng.step(res)
+            for rid, toks in eng.drain_deltas().items():
+                streamed.setdefault(rid, []).extend(toks)
+        state = next(s for s in eng._slots
+                     if s is not None and s.request.id == f1)
+        assert state.spec_drafted > 0
+        assert len(streamed.get(f1, ())) > 0
+        p = eng.submit(Request([4, 5, 6], 4, tenant="premium"))
+        res.update(eng.run())
+        assert eng.stats["qos_preempted"] >= 1
+        assert res[p].finish_reason == "length"
+        assert res[f1].finish_reason == "fault"
+        assert res[f1].spec_drafted > 0
+        # the fault terminal returns exactly what was streamed — no
+        # RNG-spliced continuation
+        assert res[f1].tokens[:len(streamed[f1])] == streamed[f1]
+
     def test_tenant_queue_bound_sheds_only_that_tenant(self):
         reg = _registry(max_queued=1)
         eng = DecodeEngine(_net(), n_slots=1, decode_chunk=2, seed=0,
