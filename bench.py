@@ -2671,6 +2671,202 @@ def bench_kv_transfer():
     return [row_warm, row_itl]
 
 
+def bench_kv_tier():
+    """Tiered KV cache rows (ISSUE 17 tentpole).
+
+    Row 1 — ``kv_tier_thrash_speedup``: a cache-thrashing
+    long-prompt workload whose working set is ~4x the HBM block pool
+    (6 distinct 512-token prompts x 32 blocks each = 192 blocks over
+    a 48-block pool) cycled round-robin, so every revisit finds its
+    prefix EVICTED from the trie. The no-tier engine recomputes the
+    full 512-token prefill per revisit (the seed behavior); the
+    tiered engine reloads the spilled payload from host DRAM through
+    the jitted ``kv_import`` scatter. Gates: >= 2x tokens/s (the
+    host-DRAM sibling of PR 14's 5.8x warm-vs-recompute gap), ids
+    BIT-IDENTICAL between the two engines on every request, zero
+    retrace across the timed passes, and every timed tiered
+    admission actually reloaded (no silent recomputes inflating the
+    denominator's twin).
+
+    Row 2 — ``kv_tier_spill_itl_storm_ratio``: the PR 14/16 storm
+    gate with SPILL CHURN active — the admission storm's unique
+    prompts overflow an 8-row trie, so every storm round evicts and
+    spills (staged gather at eviction, host pack drained at
+    round end). The victim stream's ITL must stay within the same
+    <= 1.1x + 3 ms envelope as the tier-off engine, proving the
+    spill path stays off the decode hot path."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm_flagship
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import DecodeEngine, Request
+
+    # window 544 (not the transfer bench's 1024): the pool floor is
+    # one slot's window + a round of writes, and the thrash row needs
+    # a pool SMALL enough that 6 resident prompts are 4x over it
+    V, width, n_layers, window, bt = 64, 512, 4, 544, 16
+    conf = transformer_lm_flagship(
+        vocab=V, width=width, n_layers=n_layers, n_heads=8, seed=11)
+    for c in conf.confs:
+        c.compute_dtype = "bfloat16"
+        if hasattr(c.layer, "stream_max_t"):
+            c.layer.stream_max_t = window
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    prompt_len, n_gen, n_prompts = 512, 8, 6
+    prompts = [rng.integers(0, V, prompt_len).tolist()
+               for _ in range(n_prompts)]
+    kv_blocks = 48  # 6 x 32-block prefixes = 192 wanted: 4x pool
+    eng_kw = dict(n_slots=1, decode_chunk=8, paged_kv=True,
+                  block_tokens=bt, kv_blocks=kv_blocks,
+                  prefix_cache_rows=8, prefill_chunk=64, seed=0)
+
+    # --- row 1: thrash throughput, tier vs no-tier ------------------
+    def one_pass(eng, ids_out=None):
+        toks = 0
+        for p in prompts:
+            rid = eng.submit(Request(list(p), n_gen))
+            res = eng.run()[rid]
+            toks += len(res.tokens)
+            if ids_out is not None:
+                ids_out.append(res.tokens)
+        return toks
+
+    walls, all_ids = {}, {}
+    tier_counts = None
+    for tiered in (False, True):
+        eng = DecodeEngine(net, **dict(
+            eng_kw, kv_host_tier_bytes=(64 << 20) if tiered else 0))
+        one_pass(eng)        # pass 1: cold compute (tier: spills)
+        one_pass(eng)        # pass 2: warm-up the revisit path
+        #                      (tier: first reload compiles its
+        #                      kv_import bucket — excluded, like
+        #                      every bench's compile warm-up)
+        if tiered:
+            tier_counts = eng.compile_counts()
+            reloads0 = eng.kv_tier.stats["reloads"]
+        ids = []
+        t0 = time.perf_counter()
+        toks = one_pass(eng, ids) + one_pass(eng, ids)
+        walls[tiered] = (toks, time.perf_counter() - t0)
+        all_ids[tiered] = ids
+        if tiered:
+            if eng.compile_counts() != tier_counts:
+                _fail_gate(
+                    f"tiered engine retraced during the timed "
+                    f"passes: {tier_counts} -> "
+                    f"{eng.compile_counts()}")
+            reloaded = eng.kv_tier.stats["reloads"] - reloads0
+            if reloaded < 2 * n_prompts:
+                _fail_gate(
+                    f"only {reloaded}/{2 * n_prompts} timed "
+                    "admissions reloaded from the tier — the rest "
+                    "recomputed, so the speedup is mislabeled")
+            s = eng.kv_tier.stats
+            if s["spills"] != (s["reloads"] + s["drops"]
+                               + len(eng.kv_tier)):
+                _fail_gate(f"tier books don't reconcile: {s} vs "
+                           f"{len(eng.kv_tier)} resident")
+    if all_ids[True] != all_ids[False]:
+        _fail_gate("tiered engine ids diverged from the no-tier "
+                   "engine under thrash — spill/reload corrupted "
+                   "state")
+    (toks_off, wall_off), (toks_on, wall_on) = walls[False], walls[True]
+    tps_off = toks_off / max(wall_off, 1e-9)
+    tps_on = toks_on / max(wall_on, 1e-9)
+    if tps_on < 2.0 * tps_off:
+        _fail_gate(
+            f"tiered thrash throughput {tps_on:.1f} tok/s is under "
+            f"2x the no-tier engine's {tps_off:.1f} tok/s — the "
+            "host reload is not beating recompute")
+    row_thrash = {
+        "metric": "kv_tier_thrash_speedup",
+        "value": round(tps_on / max(tps_off, 1e-9), 2),
+        "unit": (f"tokens/s over a round-robin of {n_prompts} "
+                 f"distinct {prompt_len}-token prompts whose "
+                 f"{n_prompts * prompt_len // bt} prefix blocks are "
+                 f"~4x the {kv_blocks}-block pool (2 timed passes; "
+                 f"width-{width} {n_layers}-layer transformer, "
+                 "bf16); no-tier engine recomputes every revisit, "
+                 "tiered engine reloads from host DRAM"),
+        "vs_baseline": None,  # the seed engine HAS no spill tier
+        "tier_tokens_per_s": round(tps_on, 1),
+        "no_tier_tokens_per_s": round(tps_off, 1),
+        "id_match": 1.0,
+        "compile_counts": tier_counts,
+    }
+
+    # --- row 2: victim ITL with spill churn active ------------------
+    def victim_itl(eng, storm_rng, storm):
+        rid = eng.submit(Request(
+            storm_rng.integers(0, V, 24).tolist(), 256))
+        res = {}
+        fed = 0
+        while eng.has_work():
+            # storm prompts span >= 2 complete blocks so every trie
+            # eviction they force is SPILLABLE (a sub-block victim
+            # has nothing packed to spill)
+            if storm and fed < 24 and eng.scheduler.pending < 2:
+                eng.submit(Request(
+                    storm_rng.integers(0, V, 40).tolist(), 2))
+                fed += 1
+            eng.step(res)
+        r = res[rid]
+        return ((r.timing["e2e_s"] - r.timing["ttft_s"])
+                / (len(r.tokens) - 1))
+
+    # unique storm prompts overflow the 8-row trie: every storm
+    # admission evicts an earlier row -> spill churn DURING the
+    # victim's decode (the exact hot-path hazard under test)
+    storm_kw = dict(n_slots=8, decode_chunk=32, paged_kv=True,
+                    block_tokens=bt, prefill_chunk=8,
+                    prefix_cache_rows=8, admission_policy="decode",
+                    async_rounds=True, seed=0,
+                    kv_host_tier_bytes=64 << 20)
+    storm_rng = np.random.default_rng(1)
+    eng = DecodeEngine(net, **storm_kw)
+    eng.submit(Request(storm_rng.integers(0, V, 40).tolist(), 34))
+    eng.run()  # compile warm-up, excluded
+    # one untimed interleaved pair: the storm overflows the trie and
+    # compiles BOTH kv_gather spill buckets (the storm rows' small
+    # bucket and the evicted victim row's 32-block bucket) before
+    # anything is measured
+    victim_itl(eng, storm_rng, storm=False)
+    victim_itl(eng, storm_rng, storm=True)
+    idles, storms = [], []
+    spills0 = eng.kv_tier.stats["spills"]
+    for _ in range(3):
+        idles.append(victim_itl(eng, storm_rng, storm=False))
+        storms.append(victim_itl(eng, storm_rng, storm=True))
+    idle_med, storm_med = sorted(idles)[1], sorted(storms)[1]
+    churn = eng.kv_tier.stats["spills"] - spills0
+    if churn < 10:
+        _fail_gate(
+            f"the storm only drove {churn} spills — the ITL gate "
+            "is not measuring spill churn")
+    # same envelope as bench_kv_transfer row 2 (PR 14/16): 1.1x
+    # ratio + 3 ms absolute slack for CPU-proxy scheduler noise
+    if storm_med > 1.1 * idle_med + 3e-3:
+        _fail_gate(
+            f"victim ITL with spill churn is "
+            f"{storm_med * 1e3:.2f}ms vs idle "
+            f"{idle_med * 1e3:.2f}ms (> 1.1x + 3ms slack): the "
+            "spill path is leaking onto the decode hot path")
+    row_itl = {
+        "metric": "kv_tier_spill_itl_storm_ratio",
+        "value": round(storm_med / idle_med, 3),
+        "unit": ("victim-stream mean ITL under a trie-overflowing "
+                 "admission storm with the host tier spilling every "
+                 "eviction, over idle-admission ITL (async_rounds, "
+                 "decode-priority, median of 3 interleaved pairs; "
+                 "gate <= 1.1x + 3ms CPU slack)"),
+        "vs_baseline": None,
+        "trials": 3,
+        "idle_itl_ms": round(idle_med * 1e3, 2),
+        "storm_itl_ms": round(storm_med * 1e3, 2),
+        "storm_spills": churn,
+    }
+    return [row_thrash, row_itl]
+
+
 def bench_tenant_qos_overhead():
     """Multi-tenant QoS row (ISSUE 13 acceptance): tenancy must be
     FREE when unused. Single-tenant traffic (every request on the
@@ -3320,6 +3516,7 @@ def main() -> None:
                bench_router_wal_overhead,
                bench_tenant_qos_overhead,
                bench_kv_transfer,
+               bench_kv_tier,
                bench_observability_overhead,
                bench_train_observability_overhead,
                bench_w2v, bench_dbn, bench_allreduce):

@@ -419,7 +419,13 @@ def gateway_from_args(args):
                 getattr(args, "use_flash_paged", "auto")],
             tenants=tenants,
             async_rounds=getattr(args, "async_rounds", False),
-            fused_rounds=getattr(args, "fused_rounds", 0))
+            fused_rounds=getattr(args, "fused_rounds", 0),
+            kv_host_tier_bytes=getattr(args, "kv_host_tier_bytes",
+                                       0),
+            kv_disk_tier_path=getattr(args, "kv_disk_tier_path",
+                                      None),
+            kv_disk_tier_bytes=getattr(args, "kv_disk_tier_bytes",
+                                       None))
 
     return ServingGateway.boot(
         engine, snapshot_path=args.snapshot,
@@ -500,6 +506,17 @@ def _serve_child_argv(args, port: int, replica_id: str):
                  str(args.block_tokens)]
         if args.kv_blocks is not None:
             argv += ["--kv-blocks", str(args.kv_blocks)]
+        if getattr(args, "kv_host_tier_bytes", 0):
+            argv += ["--kv-host-tier-bytes",
+                     str(args.kv_host_tier_bytes)]
+        if getattr(args, "kv_disk_tier_path", None):
+            # per-replica subdirectory: ring files are engine-local
+            argv += ["--kv-disk-tier-path",
+                     os.path.join(args.kv_disk_tier_path,
+                                  replica_id)]
+            if getattr(args, "kv_disk_tier_bytes", None) is not None:
+                argv += ["--kv-disk-tier-bytes",
+                         str(args.kv_disk_tier_bytes)]
     if getattr(args, "tp", 1) != 1:
         argv += ["--tp", str(args.tp)]
     if getattr(args, "use_flash_paged", "auto") != "auto":
@@ -819,6 +836,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "off). Greedy ids stay bit-identical to "
                         "stepped mode; SSE deltas arrive in chunks "
                         "of up to K * decode_chunk tokens")
+    s.add_argument("--kv-host-tier-bytes", type=int, default=0,
+                   help="host-DRAM spill-tier budget in bytes "
+                        "(ISSUE 17): trie victims evicted under HBM "
+                        "pressure pack into a host LRU this large "
+                        "and reload via the jitted KV import instead "
+                        "of recomputing (0 = off; needs --paged-kv "
+                        "and --prefix-cache-rows > 0)")
+    s.add_argument("--kv-disk-tier-path", default=None,
+                   help="disk-ring directory for spill-tier "
+                        "overflow (ISSUE 17): payloads past the "
+                        "host budget demote to files here instead "
+                        "of dropping (unset = host-only tier)")
+    s.add_argument("--kv-disk-tier-bytes", type=int, default=None,
+                   help="byte cap for the disk ring (oldest files "
+                        "dropped past it; unset = unbounded)")
     s.add_argument("--snapshot", default=None,
                    help="drain-snapshot path: written on shutdown, "
                         "restored on boot when present")
@@ -877,6 +909,15 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--paged-kv", action="store_true")
     fl.add_argument("--block-tokens", type=int, default=16)
     fl.add_argument("--kv-blocks", type=int, default=None)
+    fl.add_argument("--kv-host-tier-bytes", type=int, default=0,
+                    help="host-DRAM spill-tier budget per replica "
+                         "(ISSUE 17; 0 = off)")
+    fl.add_argument("--kv-disk-tier-path", default=None,
+                    help="disk-ring base directory for spill-tier "
+                         "overflow (each replica rings a "
+                         "subdirectory)")
+    fl.add_argument("--kv-disk-tier-bytes", type=int, default=None,
+                    help="per-replica disk-ring byte cap")
     fl.add_argument("--async-rounds", action="store_true",
                     help="double-buffered decode rounds on every "
                          "replica (ISSUE 14)")

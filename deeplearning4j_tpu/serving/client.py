@@ -456,21 +456,36 @@ class GatewayClient:
         return self._get_text("/v1/metrics")
 
     # -- KV transfer plane (ISSUE 14) ----------------------------------
-    #: query-string token cap: http.server rejects request lines over
-    #: 64 KiB with 414, so a very long prompt ships only its leading
-    #: tokens — SAFE, because any cached prefix of a truncated prompt
-    #: is a cached prefix of the full prompt (the radix-trie prefix
-    #: property), and real exports are window-bounded far below this
+    #: GET query-string token cap: http.server rejects request lines
+    #: over 64 KiB with 414. Prompts past the cap now ship via
+    #: ``POST /v1/kv/export`` (token list in the JSON body — no
+    #: request-line limit; ISSUE 17 satellite); against a pre-POST
+    #: server the 404/405 falls back to a truncated GET, which is
+    #: SAFE: any cached prefix of a truncated prompt is a cached
+    #: prefix of the full prompt (the radix-trie prefix property),
+    #: and real exports are window-bounded far below this anyway
     KV_EXPORT_QUERY_TOKENS = 8000
 
     def kv_export(self, tokens: List[int]) -> Optional[bytes]:
-        """``GET /v1/kv/export?tokens=...`` — the replica's longest
-        cached prefix of ``tokens`` as a framed binary payload
-        (serving/kv_transfer.py wire format), or ``None`` on 404
-        (nothing cached / not a paged engine — the soft miss the
-        router's recompute fallback absorbs). Other non-200s raise.
-        Prompts past :data:`KV_EXPORT_QUERY_TOKENS` query on their
-        leading tokens only (see the cap's note)."""
+        """The replica's longest cached prefix of ``tokens`` as a
+        framed binary payload (serving/kv_transfer.py wire format),
+        or ``None`` on 404 (nothing cached / not a paged engine — the
+        soft miss the router's recompute fallback absorbs). Other
+        non-200s raise. Short prompts use the original
+        ``GET /v1/kv/export?tokens=...``; prompts past
+        :data:`KV_EXPORT_QUERY_TOKENS` use the POST JSON-body form,
+        falling back to a truncated GET when the server predates it
+        (see the cap's note)."""
+        if len(tokens) > self.KV_EXPORT_QUERY_TOKENS:
+            try:
+                return self._kv_export_post(tokens)
+            except GatewayError as e:
+                if e.status not in (404, 405):
+                    raise
+                # 405 = pre-POST server; 404 from such a server is
+                # ambiguous (missing route vs cold) — the truncated
+                # GET below disambiguates at the cost of one
+                # round-trip on genuinely cold long prompts
         path = ("/v1/kv/export?tokens="
                 + ",".join(str(int(t)) for t
                            in tokens[:self.KV_EXPORT_QUERY_TOKENS]))
@@ -481,6 +496,31 @@ class GatewayClient:
             raw = resp.read()
             if resp.status == 404:
                 return None
+            if resp.status != 200:
+                try:
+                    data = json.loads(raw) if raw else {}
+                except ValueError:
+                    data = {"body": raw[:256].decode("latin-1")}
+                raise GatewayError(resp.status, data)
+            return raw
+        finally:
+            conn.close()
+
+    def _kv_export_post(self, tokens: List[int]) -> bytes:
+        """``POST /v1/kv/export`` with ``{"tokens": [...]}`` — the
+        full token list rides the body, so nothing is truncated.
+        Raises :class:`GatewayError` on every non-200 (404 included:
+        the caller maps it to the truncated-GET fallback)."""
+        body = json.dumps(
+            {"tokens": [int(t) for t in tokens]}).encode()
+        conn = self._connect()
+        try:
+            conn.request(
+                "POST", "/v1/kv/export", body=body,
+                headers={"Content-Type": "application/json",
+                         "Content-Length": str(len(body))})
+            resp = conn.getresponse()
+            raw = resp.read()
             if resp.status != 200:
                 try:
                     data = json.loads(raw) if raw else {}

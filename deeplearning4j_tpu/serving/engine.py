@@ -412,6 +412,27 @@ SERVING_TRACK_HELP = {
                             "(the pow2 K-bucket actually run; "
                             "fused_rounds > 0 engines only, "
                             "ISSUE 16)",
+    "serving_kv_spill_s": "trie-victim spill wall (host copy + pack "
+                          "of the staged device gather, off the "
+                          "decode hot path; ISSUE 17 KV tier)",
+    "serving_kv_reload_s": "tier-reload wall (host/disk payload "
+                           "re-imported via the jitted kv_import "
+                           "scatter + trie re-seed; ISSUE 17)",
+    "serving_kv_tier_hits": "prefix lookups answered per tier "
+                            "({tier=hbm|host|disk} labeled; hbm = "
+                            "trie hits, host/disk = tier reload "
+                            "matches; ISSUE 17)",
+    "serving_kv_tier_spills": "trie victims admitted to the spill "
+                              "tier (ISSUE 17)",
+    "serving_kv_tier_reloads": "spilled prefixes reloaded into the "
+                               "trie (ISSUE 17)",
+    "serving_kv_tier_drops": "spilled prefixes lost (budget "
+                             "overflow, reload fault, clear; "
+                             "ISSUE 17)",
+    "serving_kv_tier_host_bytes": "payload bytes resident in the "
+                                  "host-DRAM tier (gauge; ISSUE 17)",
+    "serving_kv_tier_disk_bytes": "payload bytes resident in the "
+                                  "disk ring (gauge; ISSUE 17)",
 }
 
 
@@ -672,7 +693,10 @@ class DecodeEngine:
                  use_flash_paged=None,
                  tenants: Optional[TenantRegistry] = None,
                  async_rounds: bool = False,
-                 fused_rounds: int = 0):
+                 fused_rounds: int = 0,
+                 kv_host_tier_bytes: int = 0,
+                 kv_disk_tier_path: Optional[str] = None,
+                 kv_disk_tier_bytes: Optional[int] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots {n_slots} < 1")
         if decode_chunk < 1:
@@ -864,6 +888,35 @@ class DecodeEngine:
         else:
             self.prefix_cache = (RadixPrefixCache(prefix_cache_rows)
                                  if prefix_cache_rows else None)
+        # -- tiered KV spill store (ISSUE 17; default off = the
+        # evict-to-recompute engine). Trie victims export via the
+        # jitted kv_gather into packed DKV1 payloads held in a
+        # host-DRAM LRU (then a disk ring, then dropped); a trie miss
+        # at admission checks the tier BEFORE recomputing and reloads
+        # through the jitted kv_import scatter — same pow2-bucketed
+        # executables as the cross-replica transfer plane, zero new
+        # retraces. ------------------------------------------------
+        self.kv_host_tier_bytes = int(kv_host_tier_bytes or 0)
+        self.kv_disk_tier_path = kv_disk_tier_path
+        self.kv_disk_tier_bytes = kv_disk_tier_bytes
+        self.kv_tier = None
+        #: spills staged this round: the eviction hook dispatches ONLY
+        #: the device gather (async); the host copy + pack drains at
+        #: the END of step() so spilling never blocks the decode round
+        self._pending_spills: List[Tuple] = []
+        if (self.kv_host_tier_bytes or kv_disk_tier_path):
+            if not isinstance(self.prefix_cache, PagedPrefixCache):
+                raise ValueError(
+                    "the KV spill tier needs paged_kv=True and "
+                    "prefix_cache_rows > 0 (it spills paged trie "
+                    "victims)")
+            from deeplearning4j_tpu.serving.kv_tier import KVTierStore
+
+            self.kv_tier = KVTierStore(
+                host_budget_bytes=self.kv_host_tier_bytes,
+                disk_path=kv_disk_tier_path,
+                disk_budget_bytes=kv_disk_tier_bytes)
+            self.prefix_cache.on_evict = self._stage_spill
         #: host-side per-slot n-gram draft tables (None = spec off —
         #: the engine is then the bit-identical PR 3 engine)
         self.spec = (NgramDraftTable() if self.spec_draft_len
@@ -924,7 +977,9 @@ class DecodeEngine:
                              "serving_admission_warm_s",
                              "serving_admission_cold_s",
                              "serving_host_step_s",
-                             "serving_fused_rounds")}
+                             "serving_fused_rounds",
+                             "serving_kv_spill_s",
+                             "serving_kv_reload_s")}
         self.describe_metrics()
         # -- async double-buffered rounds (ISSUE 14; default off =
         # the bit-identical synchronous engine): round N's token
@@ -980,6 +1035,14 @@ class DecodeEngine:
             "kv_exports": 0, "kv_exported_tokens": 0,
             "kv_imports": 0, "kv_imported_tokens": 0,
             "kv_imported_blocks": 0, "kv_import_declined": 0,
+            # tiered KV spill store (ISSUE 17): mirrored from the
+            # KVTierStore each refresh (nonzero only with a tier)
+            "kv_tier_spills": 0, "kv_tier_reloads": 0,
+            "kv_tier_drops": 0, "kv_tier_demotions": 0,
+            "kv_tier_hits_host": 0, "kv_tier_hits_disk": 0,
+            "kv_tier_host_bytes": 0, "kv_tier_disk_bytes": 0,
+            "kv_tier_spill_skipped": 0, "kv_tier_reload_declined": 0,
+            "kv_tier_reload_faults": 0, "kv_tier_exports": 0,
         }
         for key in self.FAILURE_KEYS:
             self.stats[key] = 0
@@ -1861,6 +1924,16 @@ class DecodeEngine:
         if isinstance(self.prefix_cache, PagedPrefixCache):
             tabs.extend(self.prefix_cache._payloads.values())
         self.stats["frag_tokens"] = pool.fragmentation_tokens(tabs)
+        if self.kv_tier is not None:
+            t = self.kv_tier.stats
+            self.stats["kv_tier_spills"] = t["spills"]
+            self.stats["kv_tier_reloads"] = t["reloads"]
+            self.stats["kv_tier_drops"] = t["drops"]
+            self.stats["kv_tier_demotions"] = t["demotions"]
+            self.stats["kv_tier_hits_host"] = t["hits_host"]
+            self.stats["kv_tier_hits_disk"] = t["hits_disk"]
+            self.stats["kv_tier_host_bytes"] = self.kv_tier.host_bytes
+            self.stats["kv_tier_disk_bytes"] = self.kv_tier.disk_bytes
 
     # -- cross-replica KV transfer (ISSUE 14) --------------------------
     def export_kv(self, prompt,
@@ -1874,9 +1947,28 @@ class DecodeEngine:
         gather. Layout-invariant: a TP=N engine exports full logical
         blocks (host reassembly), so the receiver's width need not
         match."""
-        from deeplearning4j_tpu.serving.kv_transfer import export_prefix
+        from deeplearning4j_tpu.serving.kv_transfer import (
+            KVTransferTooLarge,
+            export_prefix,
+        )
 
-        return export_prefix(self, prompt, cap_bytes=cap_bytes)
+        payload = export_prefix(self, prompt, cap_bytes=cap_bytes)
+        if payload is None and self.kv_tier is not None:
+            # tier fallback (ISSUE 17): a trie-cold replica whose
+            # host/disk tier still holds the prefix is a working
+            # donor — serve the stored DKV1 payload directly, zero
+            # device work (the payload stays resident: an export is
+            # read-only)
+            self.drain_spills()  # a just-evicted prefix may be staged
+            ent = self.kv_tier.match(prompt)
+            if ent is not None:
+                _key, payload, _tier = ent
+                if cap_bytes is not None and len(payload) > cap_bytes:
+                    raise KVTransferTooLarge(
+                        f"tier export is {len(payload)} bytes, over "
+                        f"the {cap_bytes}-byte cap")
+                self.stats["kv_tier_exports"] += 1
+        return payload
 
     def import_kv(self, payload: bytes):
         """Splice a peer's exported prefix into this engine's pool
@@ -1891,6 +1983,115 @@ class DecodeEngine:
         from deeplearning4j_tpu.serving.kv_transfer import import_prefix
 
         return import_prefix(self, payload)
+
+    # -- tiered KV spill store (ISSUE 17) ------------------------------
+    #: staged-spill cap: each staged spill pins one gathered block
+    #: stack on device until the end-of-round drain — under a
+    #: pathological eviction storm the cap bounds that transient
+    #: footprint, and overflow victims fall back to the seed behavior
+    #: (dropped, recompute later)
+    MAX_PENDING_SPILLS = 8
+
+    def _stage_spill(self, tokens, tab) -> None:
+        """Pressure-eviction hook (installed as
+        ``prefix_cache.on_evict``): stage the victim's blocks for the
+        host tier. ONLY the jitted ``kv_gather`` dispatches here —
+        an async device op whose result is computed from the current
+        (immutable) pool value, so the victim's blocks can be freed
+        and recycled immediately. The device-to-host copy and the
+        DKV1 pack are deferred to :meth:`drain_spills` at the end of
+        the round, keeping the export off the decode hot path."""
+        tier = self.kv_tier
+        if tier is None or self._pool is None:
+            return
+        matched, floor, bt = tab.length, tab.floor, self.block_tokens
+        if matched - floor <= 0:
+            return
+        want = list(range(floor // bt, (matched - 1) // bt + 1))
+        if any(g not in tab.blocks for g in want):
+            return  # window slide broke contiguity: nothing to spill
+        bids = [tab.blocks[g] for g in want]
+        if any(b in self.block_pool.poisoned for b in bids):
+            return  # quarantined state must never be spilled
+        key = tuple(int(t) for t in tokens)
+        if len(self._pending_spills) >= self.MAX_PENDING_SPILLS:
+            self.stats["kv_tier_spill_skipped"] += 1
+            return
+        from deeplearning4j_tpu.serving.kv_transfer import _pow2_bucket
+
+        width = _pow2_bucket(len(bids))
+        ids = np.full(width, self.kv_blocks, np.int32)
+        ids[:len(bids)] = bids
+        gathered = self._kv_gather_jit(self._pool, jnp.asarray(ids))
+        self._pending_spills.append(
+            (key, want, floor, len(bids), gathered))
+
+    def drain_spills(self) -> int:
+        """Pack every staged spill into the tier (device-to-host copy
+        + DKV1 frame). Runs at the end of ``step()`` — after the next
+        round has already dispatched — and before any tier read that
+        must see just-evicted entries (export fallback, snapshot).
+        Returns the number of payloads drained."""
+        if not self._pending_spills:
+            return 0
+        from deeplearning4j_tpu.serving.kv_transfer import pack_prefix
+
+        staged, self._pending_spills = self._pending_spills, []
+        for key, want, floor, n, gathered in staged:
+            t0 = self._clock()
+            layers = []
+            for name in sorted(gathered):
+                st = gathered[name]
+                layers.append((name, np.asarray(st["pk"])[:n],
+                               np.asarray(st["pv"])[:n]))
+            payload = pack_prefix(list(key), want, floor,
+                                  self.block_tokens, layers)
+            tier = self.kv_tier.put(key, payload)
+            self._observe("serving_kv_spill_s", self._clock() - t0)
+            with self._span("serving.kv_spill", tokens=len(key),
+                            blocks=n, tier=tier,
+                            bytes=len(payload)):
+                pass
+        return len(staged)
+
+    def _tier_reload(self, prompt) -> bool:
+        """Admission-side tier check (the ladder's upward half): on a
+        trie miss, the longest tier payload sharing a usable prefix
+        with ``prompt`` re-imports through the jitted ``kv_import``
+        scatter (``import_prefix`` — same pow2 buckets as the
+        cross-replica plane, zero new executables) and re-seeds the
+        trie. True = the caller should re-run its trie lookup. Every
+        fault falls through to recompute: a malformed payload is
+        dropped from the tier, a soft decline (pool/trie pressure)
+        leaves it resident for a later retry."""
+        ent = self.kv_tier.match(prompt)
+        if ent is None:
+            return False
+        key, payload, tier_name = ent
+        from deeplearning4j_tpu.serving.kv_transfer import (
+            KVTransferError,
+            import_prefix,
+        )
+
+        t0 = self._clock()
+        try:
+            out = import_prefix(self, payload)
+        except KVTransferError:
+            self.kv_tier.drop(key)
+            self.stats["kv_tier_reload_faults"] += 1
+            return False
+        if not out.get("imported"):
+            self.stats["kv_tier_reload_declined"] += 1
+            return False
+        self.kv_tier.take(key)
+        dt = self._clock() - t0
+        self._observe("serving_kv_reload_s", dt)
+        with self._span("serving.kv_reload", tier=tier_name,
+                        tokens=out.get("tokens"),
+                        blocks=out.get("blocks"),
+                        bytes=len(payload)):
+            pass
+        return True
 
     def _one_hot_prompt(self, prompt, bucket):
         x = np.zeros((1, self.vocab, bucket), np.float32)
@@ -1919,6 +2120,21 @@ class DecodeEngine:
         rnn, matched, hit, tab = None, 0, None, None
         if self.prefix_cache is not None:
             hit = self.prefix_cache.lookup(request.prompt)
+            if (self.kv_tier is not None
+                    and (hit is None
+                         or hit.matched <= self.prefix_cache.payload(
+                             hit.row).floor)):
+                # tier ladder, upward half (ISSUE 17): a trie miss
+                # (or an unusable sub-floor hit) checks host DRAM,
+                # then disk, BEFORE recomputing — a hit re-imports
+                # through the jitted kv_import scatter and re-seeds
+                # the trie, so the re-run lookup splices it exactly
+                # like a never-evicted entry
+                if hit is not None:
+                    self.prefix_cache.release(hit)
+                    hit = None
+                if self._tier_reload(request.prompt):
+                    hit = self.prefix_cache.lookup(request.prompt)
             if hit is not None and self.paged_kv:
                 payload = self.prefix_cache.payload(hit.row)
                 if hit.matched > payload.floor:
@@ -3109,6 +3325,12 @@ class DecodeEngine:
                 if self.record_timing:
                     self._observe("serving_round_s",
                                   self._clock() - rt0)
+        if self._pending_spills:
+            # end-of-round spill drain (ISSUE 17): the gathers were
+            # dispatched at eviction time and the next round's device
+            # work is already in flight — the host copy + pack lands
+            # here, off the decode hot path
+            self.drain_spills()
         if self.paged_kv:
             self._paged_stats_refresh()
         self._round += 1
@@ -3155,6 +3377,22 @@ class DecodeEngine:
             for key in ("hits", "misses", "evictions"):
                 self.tracer.counter(f"serving_prefix_{key}",
                                     self.prefix_cache.stats[key])
+        if self.kv_tier is not None:
+            # per-tier ladder counters (ISSUE 17): hbm = trie hits,
+            # host/disk = tier reload matches — one labeled track
+            # each so the federation prices the ladder per rung
+            t = self.kv_tier.stats
+            for tier, value in (("hbm", self.prefix_cache.stats["hits"]),
+                                ("host", t["hits_host"]),
+                                ("disk", t["hits_disk"])):
+                self.tracer.counter(
+                    f'serving_kv_tier_hits{{tier="{tier}"}}', value)
+            for key in ("spills", "reloads", "drops"):
+                self.tracer.counter(f"serving_kv_tier_{key}", t[key])
+            self.tracer.counter("serving_kv_tier_host_bytes",
+                                self.kv_tier.host_bytes)
+            self.tracer.counter("serving_kv_tier_disk_bytes",
+                                self.kv_tier.disk_bytes)
         self._emit_tp_gauges()
         self._emit_tenant_gauges()
 
@@ -3402,6 +3640,10 @@ class DecodeEngine:
             # recompute a round that is already done)
             inf, self._inflight = self._inflight, None
             self._land_round(inf)
+        if self._pending_spills:
+            # land staged spills too: the payloads are droppable, but
+            # the staged gathers reference THIS process's pool
+            self.drain_spills()
         now = self._clock()
 
         def entry(req: Request) -> Dict[str, Any]:
@@ -3464,6 +3706,12 @@ class DecodeEngine:
                 "use_flash_paged": self.use_flash_paged,
                 "async_rounds": self.async_rounds,
                 "fused_rounds": self.fused_rounds,
+                # tier contents are droppable cache (ISSUE 17):
+                # record the knobs, never the payloads — a restored
+                # engine re-tiers under its own pressure
+                "kv_host_tier_bytes": self.kv_host_tier_bytes,
+                "kv_disk_tier_path": self.kv_disk_tier_path,
+                "kv_disk_tier_bytes": self.kv_disk_tier_bytes,
             },
             # paged bookkeeping rides the snapshot for inspection and
             # exact-capacity restores (restore REBUILDS device blocks
@@ -3574,7 +3822,10 @@ class DecodeEngine:
             tp=tp, use_flash_paged=use_flash_paged,
             tenants=tenants,
             async_rounds=cfg.get("async_rounds", False),
-            fused_rounds=cfg.get("fused_rounds", 0))
+            fused_rounds=cfg.get("fused_rounds", 0),
+            kv_host_tier_bytes=cfg.get("kv_host_tier_bytes", 0),
+            kv_disk_tier_path=cfg.get("kv_disk_tier_path"),
+            kv_disk_tier_bytes=cfg.get("kv_disk_tier_bytes"))
         spec_state = snapshot.get("spec")
         if spec_state and eng.spec is not None:
             # resume K-adaptation where the crash left it (final ids

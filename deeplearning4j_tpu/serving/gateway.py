@@ -169,6 +169,8 @@ class _GatewayHandler(JsonHandler):
             self.gateway._handle_warmup(self)
         elif path == "/v1/kv/import":
             self.gateway._handle_kv_import(self)
+        elif path == "/v1/kv/export":
+            self.gateway._handle_kv_export_post(self)
         else:
             self.send_json({"error": f"no such endpoint {path}"}, 404,
                            close=True)
@@ -966,6 +968,13 @@ class ServingGateway:
             "role": self.role,
             "kv_transfer": bool(eng.paged_kv
                                 and eng.prefix_cache is not None),
+            # spill-tier block (ISSUE 17): entry counts + budgets so
+            # the router's donor pick can prefer a tier-warm replica
+            # over a cold one. KVTierStore.health() is lock-free by
+            # contract (GIL-atomic ints), preserving this probe's
+            # answer-instantly property.
+            "kv_tier": (eng.kv_tier.health()
+                        if eng.kv_tier is not None else None),
         }
 
     def _metrics_text(self) -> str:
@@ -1013,6 +1022,35 @@ class ServingGateway:
                 {"error": "tokens=<comma-separated ids> required"},
                 400, close=True)
             return
+        self._kv_export_reply(handler, tokens)
+
+    def _handle_kv_export_post(self, handler) -> None:
+        """``POST /v1/kv/export`` with ``{"tokens": [...]}`` in the
+        JSON body: same export as the GET form, without the GET
+        query-string length ceiling (http.server caps the request
+        line at 64 KiB, which clamps GET to ~8000 token ids — the
+        PR 14 known fact this variant lifts; ISSUE 17 satellite).
+        The GET form stays for compatibility; clients fall back to
+        prefix truncation only against pre-POST servers."""
+        try:
+            body = handler.read_json()
+        except Exception:
+            handler.send_json({"error": "malformed JSON body"}, 400,
+                              close=True)
+            return
+        tokens = body.get("tokens") if isinstance(body, dict) else None
+        if (not isinstance(tokens, list) or not tokens
+                or not all(isinstance(t, int) for t in tokens)):
+            handler.send_json(
+                {"error": 'body must be {"tokens": [<ids>]} with a '
+                          "non-empty integer list"}, 400, close=True)
+            return
+        self._kv_export_reply(handler, tokens)
+
+    def _kv_export_reply(self, handler, tokens: List[int]) -> None:
+        """Shared export body for the GET and POST forms: engine
+        export under the transfer cap, mapped to 200 binary / 404
+        cold / 413 over-cap / 503 stopped."""
         from deeplearning4j_tpu.serving.kv_transfer import (
             KVTransferTooLarge,
         )

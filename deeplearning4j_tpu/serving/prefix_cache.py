@@ -102,6 +102,13 @@ class RadixPrefixCache:
         self._by_row: Dict[int, _Node] = {}
         self._ref: Dict[int, int] = {}
         self._clock = 0
+        #: pressure-eviction hook (ISSUE 17): called as
+        #: ``on_evict(prefix_tokens, payload)`` just before an LRU
+        #: victim's payload is dropped, so the engine can spill it to
+        #: the host/disk KV tier. Fires ONLY for ``_evict_lru``
+        #: pressure evictions — quarantine invalidations bypass it by
+        #: design (poisoned state must never be spilled and reloaded).
+        self.on_evict = None
         self.stats: Dict[str, int] = {
             "hits": 0, "misses": 0, "inserts": 0, "evictions": 0,
             "declined": 0, "tokens_matched": 0, "invalidations": 0,
@@ -319,12 +326,20 @@ class RadixPrefixCache:
             node = node.parent
         return tuple(t for edge in reversed(parts) for t in edge)
 
+    def _spill_victim(self, node: _Node) -> None:
+        """Give ``on_evict`` the victim's prefix + payload BEFORE the
+        drop (pressure evictions only — the spill seam the KV tier
+        rides; a no-op here because the dense cache's row payloads are
+        cheap to recompute and the tier speaks block tables)."""
+
     def _evict_lru(self) -> Optional[int]:
         victims = [nd for row, nd in self._by_row.items()
                    if self._ref.get(row, 0) == 0]
         if not victims:
             return None
         node = min(victims, key=lambda nd: nd.last_use)
+        if self.on_evict is not None:
+            self._spill_victim(node)
         # one prune implementation: _drop_node unmaps + prunes, and —
         # the victim being unleased — puts the row on the free list;
         # take it straight back for the caller's immediate reuse
@@ -526,6 +541,24 @@ class PagedPrefixCache(RadixPrefixCache):
         self._touch(node)
         self.stats["inserts"] += 1
         return True
+
+    def _spill_victim(self, node: _Node) -> None:
+        """Paged spill seam (ISSUE 17): hand the pressure victim's
+        prefix tokens + frozen block table to ``on_evict`` while its
+        blocks are still referenced — the hook dispatches the jitted
+        ``kv_gather`` against the CURRENT pool value (device arrays
+        are immutable, so the gathered snapshot survives the blocks'
+        recycling). A hook fault must never turn an eviction into an
+        engine fault: the tier is an optimization, the drop proceeds
+        regardless."""
+        prefix = self.row_prefix(node.row)
+        payload = self._payloads.get(node.row)
+        if prefix is None or payload is None:
+            return
+        try:
+            self.on_evict(prefix, payload)
+        except Exception:
+            pass
 
     def _drop_node(self, node: _Node) -> int:
         payload = self._payloads.pop(node.row, None)

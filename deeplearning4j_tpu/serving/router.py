@@ -201,6 +201,11 @@ class _Replica:
         #: (paged engine + prefix trie) — scraped from healthz so a
         #: dense fleet never pays a 404 round-trip per affinity miss
         self.kv_capable = False
+        #: resident spill-tier payload count (ISSUE 17), scraped from
+        #: the healthz ``kv_tier`` block: a host/disk-tier-warm
+        #: replica serves exports straight from the tier (zero device
+        #: work), so the donor pick prefers it over a cold one
+        self.kv_tier_entries = 0
         self.backoff_until = 0.0  # 429 Retry-After parking
         #: per-TENANT 429 parking (ISSUE 13): a replica's
         #: tenant-scoped 429 (its payload names the tenant) parks
@@ -967,6 +972,8 @@ class ServingRouter:
                 payload.get("prefix_tokens_reused", 0))
             replica.role = str(payload.get("role") or "any")
             replica.kv_capable = bool(payload.get("kv_transfer"))
+            replica.kv_tier_entries = int(
+                (payload.get("kv_tier") or {}).get("entries", 0))
 
     def _note_failure(self, replica: _Replica) -> None:
         """One failed health scrape OR data-plane break: the breaker
@@ -1241,6 +1248,17 @@ class ServingRouter:
                 donors = sorted(
                     (r for r in cands if r.replica_id in warm),
                     key=lambda r: -warm[r.replica_id])
+                # tier-warm replicas next (ISSUE 17): a replica whose
+                # spill tier holds payloads serves exports straight
+                # from host DRAM/disk with zero device work — a
+                # strictly better bet than a believed-cold replica,
+                # and the export falls through to the tier even when
+                # the TRIE evicted the key (the exact case the
+                # belief map cannot see)
+                donors += sorted(
+                    (r for r in cands
+                     if r.kv_tier_entries > 0 and r not in donors),
+                    key=lambda r: -r.kv_tier_entries)
                 # the rendezvous-top fallback (the key's designated
                 # owner, warm whenever the key has seen traffic even
                 # if the belief map forgot) only makes sense when the
@@ -1351,6 +1369,13 @@ class ServingRouter:
                 donors = sorted(
                     (r for r in cands if r.replica_id in warm),
                     key=lambda r: -warm[r.replica_id])
+                # tier-warm before cold (ISSUE 17): same ladder as
+                # the affinity-miss pick — the spill tier answers
+                # exports the trie already evicted
+                donors += sorted(
+                    (r for r in cands
+                     if r.kv_tier_entries > 0 and r not in donors),
+                    key=lambda r: -r.kv_tier_entries)
                 donors += [r for r in cands if r not in donors]
             ok = False
             for donor in donors[:3]:

@@ -198,6 +198,12 @@ FLEET_ROWS = LIVE_ROWS + (
     # fused decode amortizes) + rounds fused per scan dispatch
     ("serving_host_step_s", "host_step"),
     ("serving_fused_rounds", "fused_rounds"),
+    # spill-tier rows (ISSUE 17): spill pack wall + tier reload wall
+    # — read kv_reload against admission_cold above to price
+    # reload-vs-recompute, exactly as admission_warm prices the
+    # trie-warm half
+    ("serving_kv_spill_s", "kv_spill"),
+    ("serving_kv_reload_s", "kv_reload"),
 )
 
 #: per-tenant rows (ISSUE 13): the per-request families that carry

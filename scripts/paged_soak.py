@@ -70,7 +70,7 @@ def _workload(rng, n_requests: int, vocab: int, window: int):
 def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
              n_slots: int = 4, window: int = 32, block_tokens: int = 4,
              kv_blocks: int = 18, tp: int = 1,
-             use_flash_paged=None,
+             use_flash_paged=None, host_tier_bytes: int = 0,
              verbose: bool = False) -> Dict[str, Any]:
     """One seeded soak; returns a summary dict and raises
     AssertionError on any gate violation. ``tp > 1`` (ISSUE 12) runs
@@ -79,7 +79,16 @@ def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
     the head-sliced pool shards hold identical byte counts
     (total/TP), and zero blocks leak per shard (block ids are
     shard-invariant, so the host leak audit IS the per-shard audit —
-    asserted against the device shards to prove it)."""
+    asserted against the device shards to prove it).
+
+    ``host_tier_bytes > 0`` (ISSUE 17) arms the host-DRAM spill tier
+    under the same pressure churn: trie victims spill, later cohort
+    hits reload, and the gates extend with — ids STILL bit-identical
+    to the dense engine (spill/reload must be invisible), resident
+    host bytes never exceed the budget (peak-tracked every round),
+    the tier actually exercised (spills and reloads both non-zero),
+    and the tier counters reconcile: spills == reloads + drops +
+    resident entries."""
     from scripts._leakcheck import assert_no_leaks, leak_baseline
 
     from deeplearning4j_tpu.serving import DecodeEngine, Request
@@ -96,7 +105,8 @@ def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
             paged_kv=paged, block_tokens=block_tokens,
             kv_blocks=kv_blocks if paged else None,
             tp=tp if paged else 1,
-            use_flash_paged=use_flash_paged if paged else None)
+            use_flash_paged=use_flash_paged if paged else None,
+            kv_host_tier_bytes=host_tier_bytes if paged else 0)
 
     # dense reference: the ids every paged finish must match
     ref_eng = build(False)
@@ -107,11 +117,14 @@ def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
     ids = [eng.submit(Request(list(p), n)) for p, n in cases]
     t0 = time.perf_counter()
     results: Dict[int, Any] = {}
-    frag_peak = used_peak = 0
+    frag_peak = used_peak = tier_bytes_peak = 0
     while eng.has_work():
         eng.step(results)
         frag_peak = max(frag_peak, eng.stats["frag_tokens"])
         used_peak = max(used_peak, eng.stats["blocks_used"])
+        if eng.kv_tier is not None:
+            tier_bytes_peak = max(tier_bytes_peak,
+                                  eng.kv_tier.host_bytes)
     wall_s = time.perf_counter() - t0
 
     # -- gates ---------------------------------------------------------
@@ -156,6 +169,28 @@ def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
     assert counts["paged_tok"] == 1, counts
     assert counts["chunk_prefill"] <= 2, counts
 
+    tier_stats = None
+    if eng.kv_tier is not None:
+        # spill-tier gates (ISSUE 17): budget held at every sampled
+        # instant, the churn actually exercised both directions, and
+        # the conservation invariant closed the books — every spill
+        # is accounted for as a reload, a drop, or a resident entry
+        # (the trie clear above dropped whatever was still resident
+        # in the TRIE, not the tier, so residents may be non-zero)
+        tier_stats = dict(eng.kv_tier.stats)
+        assert tier_bytes_peak <= host_tier_bytes, (
+            f"host tier peaked at {tier_bytes_peak} bytes over the "
+            f"{host_tier_bytes}-byte budget")
+        assert tier_stats["spills"] > 0, (
+            f"pressure churn never spilled: {tier_stats}")
+        assert tier_stats["reloads"] > 0, (
+            f"cohort re-hits never reloaded: {tier_stats}")
+        assert tier_stats["spills"] == (
+            tier_stats["reloads"] + tier_stats["drops"]
+            + len(eng.kv_tier)), (
+            f"tier books don't reconcile: {tier_stats} vs "
+            f"{len(eng.kv_tier)} resident")
+
     # the engine is in-process (no sockets), but the sharded runtime
     # must not strand helper threads either — the shared soak policy
     assert_no_leaks(baseline)
@@ -176,6 +211,8 @@ def run_soak(n_requests: int = 160, seed: int = 0, vocab: int = 12,
         "trie_evictions": eng.prefix_cache.stats["evictions"],
         "prefill_tokens_skipped": eng.stats["prefill_tokens_skipped"],
         "compile_counts": counts,
+        "tier": tier_stats,
+        "tier_bytes_peak": tier_bytes_peak,
     }
     if verbose:
         for k, v in summary.items():
@@ -198,6 +235,10 @@ def main(argv=None) -> int:
                          "checks")
     ap.add_argument("--use-flash-paged", default="auto",
                     choices=("auto", "on", "off", "interpret"))
+    ap.add_argument("--host-tier-bytes", type=int, default=0,
+                    help="arm the host-DRAM spill tier (ISSUE 17) "
+                         "with this byte budget; adds the "
+                         "spill/reload churn gates (0 = off)")
     args = ap.parse_args(argv)
     if args.tp > 1:
         # a CPU host needs virtual devices for the TP mesh — set
@@ -215,7 +256,9 @@ def main(argv=None) -> int:
           f"{args.kv_blocks} blocks, tp {args.tp}")
     summary = run_soak(n_requests=n, seed=args.seed,
                        kv_blocks=args.kv_blocks, tp=args.tp,
-                       use_flash_paged=toggle, verbose=True)
+                       use_flash_paged=toggle,
+                       host_tier_bytes=args.host_tier_bytes,
+                       verbose=True)
     print(f"PASS in {summary['wall_s']}s")
     return 0
 

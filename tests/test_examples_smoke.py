@@ -66,13 +66,22 @@ def test_all_examples_listed():
 #: tier-1 covers the same paths through tests/test_mnist_e2e.py and
 #: tests/test_scaleout.py (FSDP composes validated in
 #: MULTICHIP_r05.json)
+#: ISSUE 17 added tests/test_kv_tier.py + the tier paged-soak
+#: variant (~+45 s of tier-1): the next-heaviest smokes
+#: (long_context_transformer ~6 s, pipeline_4d_training ~7 s) join
+#: the slow tier — tier-1 covers the same paths through
+#: tests/test_remat_transformer.py (remat/long-context lowering)
+#: and tests/test_homogeneous_pipeline.py +
+#: tests/test_pipeline_solver.py (4D pipeline partitioning)
 SLOW_EXAMPLES = {"flagship_transformer.py", "streaming_decode.py",
                  "serving_router.py",
                  "sequence_parallel_transformer.py",
                  "moe_expert_parallel.py",
                  "serving_gateway.py",
                  "mnist_mlp.py",
-                 "fsdp_zero3_training.py"}
+                 "fsdp_zero3_training.py",
+                 "long_context_transformer.py",
+                 "pipeline_4d_training.py"}
 
 
 @pytest.mark.parametrize(

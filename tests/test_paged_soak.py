@@ -31,6 +31,31 @@ def test_paged_soak_tp2_fast():
     assert summary["used_blocks_peak"] <= summary["kv_blocks"]
 
 
+def test_paged_soak_tier_fast():
+    """ISSUE 17 satellite: the same pressure churn with the host-DRAM
+    spill tier armed — trie victims spill instead of dropping, cohort
+    re-hits reload through the jitted import, and the soak's tier
+    gates assert bit-parity with the dense engine (spill/reload
+    invisible in ids), the budget held at every sampled peak, both
+    churn directions exercised, and the conservation invariant
+    spills == reloads + drops + resident."""
+    summary = run_soak(n_requests=24, seed=0,
+                       host_tier_bytes=1 << 20)
+    assert summary["tier"]["spills"] > 0
+    assert summary["tier"]["reloads"] > 0
+    assert summary["tier_bytes_peak"] <= 1 << 20
+    assert summary["used_blocks_peak"] <= summary["kv_blocks"]
+
+
+@pytest.mark.slow
+def test_paged_soak_tier_full():
+    summary = run_soak(n_requests=160, seed=0,
+                       host_tier_bytes=1 << 20)
+    assert summary["tier"]["spills"] >= 10
+    assert summary["tier"]["reloads"] >= 5
+    assert summary["used_blocks_peak"] == summary["kv_blocks"]
+
+
 @pytest.mark.slow
 def test_paged_soak_full():
     summary = run_soak(n_requests=160, seed=0)
